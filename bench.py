@@ -1,0 +1,80 @@
+"""Benchmark: GPT-2-small ZeRO-1 bf16 training throughput on one chip
+(BASELINE.md tracked config 1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline: achieved model-FLOPs utilization (MFU) divided by the
+reference's published sustained utilization (>54% of peak on A100,
+blogs/deepspeed-ulysses/README.md:83) — i.e. vs_baseline >= 1.0 means we
+sustain a higher fraction of peak than the reference's headline number.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    n_devices = len(jax.devices())
+    batch, seq = 8, 512
+    cfg = GPT2Config(vocab_size=50257, n_positions=seq, n_embd=768,
+                     n_layer=12, n_head=12, dropout=0.0)
+    model = GPT2LMHeadModel(cfg)
+
+    config = {
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+
+    global_bs = engine.train_batch_size()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(global_bs, seq), dtype=np.int32)
+    b = {"input_ids": ids, "labels": ids.copy()}
+
+    # warmup / compile
+    engine.train_batch(batch=b)
+    engine.train_batch(batch=b)
+
+    steps = 5
+    t0 = time.time()
+    for _ in range(steps):
+        engine.train_batch(batch=b)
+    # engine.train_batch blocks on the loss read, so t1 is post-device-work
+    t1 = time.time()
+
+    step_time = (t1 - t0) / steps
+    tokens_per_sec = global_bs * seq / step_time
+    tokens_per_sec_chip = tokens_per_sec / n_devices
+
+    # model FLOPs: ~6 * N * tokens for fwd+bwd (N = non-embedding params)
+    n_params = sum(int(np.prod(p.shape)) for p in
+                   jax.tree_util.tree_leaves(engine.state.master_params))
+    n_embed = cfg.vocab_size * cfg.n_embd + cfg.n_positions * cfg.n_embd
+    flops_per_token = 6 * (n_params - n_embed)
+    achieved_tflops = tokens_per_sec_chip * flops_per_token / 1e12
+    peak_tflops = 197.0  # v5e bf16 peak per chip
+    mfu = achieved_tflops / peak_tflops
+    ref_util = 0.54  # reference's published sustained fraction of peak
+
+    print(json.dumps({
+        "metric": "gpt2s_zero1_bf16_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / ref_util, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

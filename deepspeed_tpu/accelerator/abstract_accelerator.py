@@ -1,0 +1,120 @@
+"""Accelerator abstraction — the portability seam.
+
+TPU-native re-design of the reference's ``DeepSpeedAccelerator``
+(reference: accelerator/abstract_accelerator.py:10-293).  The reference
+exposes ~70 torch-device methods (streams, events, pinning, RNG, dtype
+support, op-builder discovery).  Under JAX many of those concepts are
+either free (streams/events — XLA schedules asynchronously), owned by the
+runtime (RNG is functional), or moved (op builders are Pallas kernels
+selected by platform), so the surface here is the meaningful subset:
+device enumeration/placement, synchronization, memory stats, dtype
+support, the communication-backend name, and kernel-namespace discovery.
+"""
+
+import abc
+
+
+class DeepSpeedAccelerator(abc.ABC):
+
+    def __init__(self):
+        self._name = None
+        self._communication_backend_name = None
+
+    # ---------------- Device APIs ----------------
+    @abc.abstractmethod
+    def is_synchronized_device(self):
+        """True when compute is synchronous with the host (CPU)."""
+
+    @abc.abstractmethod
+    def device_name(self, device_index=None):
+        """'tpu' / 'cpu' (+ ':<index>')."""
+
+    @abc.abstractmethod
+    def device(self, device_index=None):
+        """The jax.Device object."""
+
+    @abc.abstractmethod
+    def device_count(self):
+        """Local (per-process) addressable device count."""
+
+    @abc.abstractmethod
+    def global_device_count(self):
+        """Total devices across all processes."""
+
+    @abc.abstractmethod
+    def current_device(self):
+        ...
+
+    @abc.abstractmethod
+    def synchronize(self, device_index=None):
+        """Block the host until pending device work completes."""
+
+    # ---------------- RNG ----------------
+    @abc.abstractmethod
+    def initial_seed(self, seed):
+        """Return a PRNGKey; functional analog of manual_seed."""
+
+    # ---------------- Memory ----------------
+    @abc.abstractmethod
+    def memory_allocated(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def max_memory_allocated(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def available_memory(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def memory_stats(self, device_index=None):
+        ...
+
+    # ---------------- Dtype support ----------------
+    @abc.abstractmethod
+    def is_bf16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self):
+        ...
+
+    # ---------------- Misc ----------------
+    @abc.abstractmethod
+    def communication_backend_name(self):
+        """'xla-ici' on TPU; 'gloo-sim' on the CPU simulator."""
+
+    @abc.abstractmethod
+    def on_accelerator(self, array):
+        """True when the array is resident on this accelerator type."""
+
+    @abc.abstractmethod
+    def default_dtype(self):
+        """Preferred compute dtype (bf16 on TPU)."""
+
+    @abc.abstractmethod
+    def device_put(self, array, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def host_put(self, array):
+        """Move array to host memory (offload target)."""
+
+    # ---------------- Kernel namespace ----------------
+    @abc.abstractmethod
+    def op_builder_dir(self):
+        """Python package holding this platform's kernels
+        (reference: abstract_accelerator.py op_builder_dir)."""
+
+    @abc.abstractmethod
+    def supports_pallas(self):
+        """True when Pallas TPU kernels can run (real TPU, or interpret mode)."""

@@ -1,0 +1,99 @@
+"""CPU accelerator backend — the simulated-mesh test platform
+(reference: accelerator/cpu_accelerator.py; the pg_sim analog is
+XLA_FLAGS=--xla_force_host_platform_device_count=N)."""
+
+import jax
+import jax.numpy as jnp
+
+from .abstract_accelerator import DeepSpeedAccelerator
+from ..utils.memory import host_memory_usage
+
+
+class CPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "xla-host"
+
+    def _devices(self):
+        return [d for d in jax.local_devices() if d.platform == "cpu"] or jax.local_devices()
+
+    def is_synchronized_device(self):
+        return True
+
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return "cpu"
+        return f"cpu:{device_index}"
+
+    def device(self, device_index=None):
+        return self._devices()[device_index or 0]
+
+    def device_count(self):
+        return len(self._devices())
+
+    def global_device_count(self):
+        return jax.device_count()
+
+    def current_device(self):
+        return self._devices()[0]
+
+    def synchronize(self, device_index=None):
+        pass
+
+    def initial_seed(self, seed):
+        return jax.random.PRNGKey(seed)
+
+    def memory_allocated(self, device_index=None):
+        used, _, _ = host_memory_usage()
+        return int(used * 1024**3)
+
+    def max_memory_allocated(self, device_index=None):
+        return self.memory_allocated(device_index)
+
+    def total_memory(self, device_index=None):
+        _, _, total = host_memory_usage()
+        return int(total * 1024**3)
+
+    def available_memory(self, device_index=None):
+        return self.total_memory() - self.memory_allocated()
+
+    def memory_stats(self, device_index=None):
+        return {"bytes_in_use": self.memory_allocated(),
+                "bytes_limit": self.total_memory()}
+
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return True
+
+    def supported_dtypes(self):
+        return [jnp.float32, jnp.bfloat16, jnp.float16]
+
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    def on_accelerator(self, array):
+        try:
+            return all(d.platform == "cpu" for d in array.devices())
+        except Exception:
+            return False
+
+    def default_dtype(self):
+        return jnp.float32
+
+    def device_put(self, array, device_index=None):
+        return jax.device_put(array, self.device(device_index))
+
+    def host_put(self, array):
+        import numpy as np
+        return np.asarray(array)
+
+    def op_builder_dir(self):
+        return "deepspeed_tpu.ops.reference"
+
+    def supports_pallas(self):
+        # Pallas TPU kernels run on CPU only in interpret mode.
+        return False

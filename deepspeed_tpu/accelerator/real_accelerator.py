@@ -1,0 +1,61 @@
+"""Accelerator auto-detection (reference: accelerator/real_accelerator.py:24,52-245).
+
+Selection order: the ``DS_ACCELERATOR`` env var wins; otherwise probe the
+JAX default backend — TPU (including the experimental 'axon' tunnel
+platform) then CPU.
+"""
+
+import os
+
+from ..utils.logging import logger
+
+SUPPORTED_ACCELERATOR_LIST = ["tpu", "cpu"]
+
+ds_accelerator = None
+
+
+def _validate_accelerator(accel_name):
+    if accel_name not in SUPPORTED_ACCELERATOR_LIST:
+        raise ValueError(
+            f"DS_ACCELERATOR must be one of {SUPPORTED_ACCELERATOR_LIST}, got {accel_name}")
+    return accel_name
+
+
+def is_current_accelerator_supported():
+    return get_accelerator().device_name() in SUPPORTED_ACCELERATOR_LIST
+
+
+def get_accelerator():
+    global ds_accelerator
+    if ds_accelerator is not None:
+        return ds_accelerator
+
+    accelerator_name = None
+    if "DS_ACCELERATOR" in os.environ:
+        accelerator_name = _validate_accelerator(os.environ["DS_ACCELERATOR"])
+
+    if accelerator_name is None:
+        try:
+            import jax
+            platform = jax.default_backend()
+        except Exception:
+            platform = "cpu"
+        # 'axon' is the tunneled TPU platform exposed in this environment.
+        if platform in ("tpu", "axon"):
+            accelerator_name = "tpu"
+        else:
+            accelerator_name = "cpu"
+
+    if accelerator_name == "tpu":
+        from .tpu_accelerator import TPU_Accelerator
+        ds_accelerator = TPU_Accelerator()
+    else:
+        from .cpu_accelerator import CPU_Accelerator
+        ds_accelerator = CPU_Accelerator()
+    logger.info(f"Setting ds_accelerator to {ds_accelerator._name}")
+    return ds_accelerator
+
+
+def set_accelerator(accel_obj):
+    global ds_accelerator
+    ds_accelerator = accel_obj

@@ -1,0 +1,108 @@
+"""TPU accelerator backend (the analog of cuda_accelerator.py in the
+reference, accelerator/cuda_accelerator.py)."""
+
+import jax
+import jax.numpy as jnp
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "xla-ici"
+
+    def _devices(self):
+        return jax.local_devices()
+
+    # ---------------- Device APIs ----------------
+    def is_synchronized_device(self):
+        return False
+
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return "tpu"
+        return f"tpu:{device_index}"
+
+    def device(self, device_index=None):
+        return self._devices()[device_index or 0]
+
+    def device_count(self):
+        return len(self._devices())
+
+    def global_device_count(self):
+        return jax.device_count()
+
+    def current_device(self):
+        return self._devices()[0]
+
+    def synchronize(self, device_index=None):
+        (jnp.zeros((), device=self.device(device_index)) + 0).block_until_ready()
+
+    # ---------------- RNG ----------------
+    def initial_seed(self, seed):
+        return jax.random.PRNGKey(seed)
+
+    # ---------------- Memory ----------------
+    def _stats(self, device_index=None):
+        try:
+            return self.device(device_index).memory_stats() or {}
+        except Exception:
+            return {}
+
+    def memory_allocated(self, device_index=None):
+        return self._stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index=None):
+        return self._stats(device_index).get("peak_bytes_in_use", 0)
+
+    def total_memory(self, device_index=None):
+        return self._stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index=None):
+        s = self._stats(device_index)
+        return s.get("bytes_limit", 0) - s.get("bytes_in_use", 0)
+
+    def memory_stats(self, device_index=None):
+        return self._stats(device_index)
+
+    # ---------------- Dtype support ----------------
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        # fp16 compute works on TPU but bf16 is the native fast path.
+        return True
+
+    def supported_dtypes(self):
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8]
+
+    # ---------------- Misc ----------------
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    def on_accelerator(self, array):
+        try:
+            devs = array.devices()
+        except Exception:
+            return False
+        return any(d.platform in ("tpu", "axon") for d in devs)
+
+    def default_dtype(self):
+        return jnp.bfloat16
+
+    def device_put(self, array, device_index=None):
+        return jax.device_put(array, self.device(device_index))
+
+    def host_put(self, array):
+        import numpy as np
+        return np.asarray(array)
+
+    # ---------------- Kernel namespace ----------------
+    def op_builder_dir(self):
+        return "deepspeed_tpu.ops.pallas"
+
+    def supports_pallas(self):
+        return True

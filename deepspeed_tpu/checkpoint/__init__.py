@@ -1,0 +1,1 @@
+from .engine import load_checkpoint, save_checkpoint  # noqa: F401

@@ -1,0 +1,143 @@
+"""Checkpoint save/load (reference: runtime/checkpoint_engine/
+checkpoint_engine.py:9 CheckpointEngine; engine.py:3097 save_checkpoint,
+:2753 load_checkpoint; `latest` tag file convention).
+
+Storage backend: orbax when available (async, sharded, multi-host) with a
+numpy .npz fallback.  The on-disk layout mirrors the reference:
+
+    <dir>/<tag>/state/...       — TrainState pytree
+    <dir>/<tag>/client_state.json
+    <dir>/latest                — text file holding the newest tag
+"""
+
+import json
+import os
+import pickle
+
+import jax
+import numpy as np
+
+from ..utils.logging import logger
+from ..utils.tree import flatten_with_names
+
+
+def _try_orbax():
+    try:
+        import orbax.checkpoint as ocp
+        return ocp
+    except Exception:
+        return None
+
+
+def save_checkpoint(save_dir, tag, state, client_state=None, save_latest=True):
+    ckpt_dir = os.path.join(save_dir, str(tag))
+    os.makedirs(ckpt_dir, exist_ok=True)
+    state_dir = os.path.join(ckpt_dir, "state")
+
+    ocp = _try_orbax()
+    saved = False
+    if ocp is not None:
+        try:
+            ckptr = ocp.PyTreeCheckpointer()
+            ckptr.save(os.path.abspath(state_dir), state, force=True)
+            saved = True
+        except Exception as e:
+            logger.warning(f"orbax save failed ({e}); falling back to npz")
+    if not saved:
+        _npz_save(state_dir, state)
+
+    with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
+        json.dump(_jsonable(client_state or {}), f)
+    if save_latest:
+        with open(os.path.join(save_dir, "latest"), "w") as f:
+            f.write(str(tag))
+    logger.info(f"Saved checkpoint {tag} to {save_dir}")
+    return ckpt_dir
+
+
+def load_checkpoint(load_dir, tag, template_state):
+    if tag is None:
+        latest_path = os.path.join(load_dir, "latest")
+        if not os.path.exists(latest_path):
+            raise ValueError(f"No 'latest' file in {load_dir}; pass tag=")
+        with open(latest_path) as f:
+            tag = f.read().strip()
+    ckpt_dir = os.path.join(load_dir, str(tag))
+    state_dir = os.path.join(ckpt_dir, "state")
+
+    state = None
+    ocp = _try_orbax()
+    if ocp is not None and os.path.isdir(state_dir) and not \
+            os.path.exists(os.path.join(state_dir, "leaves.pkl")):
+        try:
+            ckptr = ocp.PyTreeCheckpointer()
+            raw = ckptr.restore(os.path.abspath(state_dir))
+            state = _match_into_template(raw, template_state)
+        except Exception as e:
+            logger.warning(f"orbax restore failed ({e}); trying npz")
+    if state is None:
+        state = _npz_load(state_dir, template_state)
+
+    client_path = os.path.join(ckpt_dir, "client_state.json")
+    client_state = {}
+    if os.path.exists(client_path):
+        with open(client_path) as f:
+            client_state = json.load(f)
+    logger.info(f"Loaded checkpoint {tag} from {load_dir}")
+    return state, client_state
+
+
+def _match_into_template(raw, template_state):
+    """Reassemble a restored (dict-ified) pytree into the template's
+    structure/shardings, matching leaves by their dotted path name —
+    robust to orbax turning namedtuples into dicts."""
+    raw_names, raw_leaves, _ = flatten_with_names(raw)
+    raw_map = dict(zip(raw_names, raw_leaves))
+    t_names, t_leaves, treedef = flatten_with_names(template_state)
+    new_leaves = []
+    for name, tmpl in zip(t_names, t_leaves):
+        if name not in raw_map:
+            raise KeyError(f"checkpoint missing leaf {name}")
+        arr = np.asarray(raw_map[name])
+        if hasattr(tmpl, "sharding"):
+            arr = jax.device_put(arr.astype(tmpl.dtype), tmpl.sharding)
+        new_leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def _npz_save(state_dir, state):
+    os.makedirs(state_dir, exist_ok=True)
+    names, leaves, treedef = flatten_with_names(state)
+    arrays = {}
+    for i, leaf in enumerate(leaves):
+        arrays[f"leaf_{i}"] = np.asarray(leaf)
+    np.savez(os.path.join(state_dir, "leaves.npz"), **arrays)
+    with open(os.path.join(state_dir, "leaves.pkl"), "wb") as f:
+        pickle.dump({"names": names, "n": len(leaves)}, f)
+
+
+def _npz_load(state_dir, template_state):
+    data = np.load(os.path.join(state_dir, "leaves.npz"))
+    leaves_t, treedef = jax.tree_util.tree_flatten(template_state)
+    if len(leaves_t) != len(data.files):
+        raise ValueError(
+            f"checkpoint has {len(data.files)} leaves, template expects "
+            f"{len(leaves_t)} — universal-checkpoint reshape required")
+    new_leaves = []
+    for i, tmpl in enumerate(leaves_t):
+        arr = data[f"leaf_{i}"]
+        if hasattr(tmpl, "sharding"):
+            arr = jax.device_put(arr.astype(tmpl.dtype), tmpl.sharding)
+        new_leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def _jsonable(d):
+    out = {}
+    for k, v in d.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except TypeError:
+            out[k] = str(v)
+    return out

@@ -1,0 +1,7 @@
+from .comm import (ReduceOp, all_gather, all_gather_into_tensor, all_reduce,  # noqa: F401
+                   all_to_all, all_to_all_single, axis_index, barrier,
+                   broadcast, broadcast_object_list, comms_logger, configure,
+                   get_local_rank, get_rank, get_world_size,
+                   inference_all_reduce, init_distributed, is_initialized,
+                   log_summary, ppermute, reduce, reduce_scatter,
+                   reduce_scatter_tensor, scatter, send_recv_next)

@@ -1,0 +1,146 @@
+"""Per-op communication logging (reference: deepspeed/utils/comms_logging.py:67
+CommsLogger; comm/comm.py:101-142 timed_op; comm/comm.py:422 log_summary).
+
+Eager collective calls record (latency, size, alg-bw, bus-bw).  Traced
+collectives inside jit cannot be timed individually (XLA fuses and
+schedules them); those are covered by the xprof profiler integration in
+``deepspeed_tpu.profiling``.
+"""
+
+import math
+
+from ..utils.logging import log_dist, logger
+
+
+def get_caller_func(frame=3):
+    import sys
+    return sys._getframe(frame).f_code.co_name
+
+
+def get_msg_size_from_args(x):
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(x):
+        if hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            total += leaf.size * leaf.dtype.itemsize
+    return total
+
+
+def convert_size(size_bytes):
+    if size_bytes == 0:
+        return "0B"
+    size_name = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    s = round(size_bytes / p, 2)
+    return "%s %s" % (s, size_name[i])
+
+
+def calc_bw_log(comm_op, size, duration_ms, n_ranks):
+    """algbw / busbw in GB/s (NCCL-tests convention)."""
+    duration = max(duration_ms / 1000.0, 1e-9)
+    n = max(n_ranks, 1)
+    if comm_op in ("all_to_all_single", "all_to_all"):
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / n)
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter",
+                     "reduce_scatter_tensor"):
+        size *= n
+        tput = size / duration
+        busbw = (size / duration) * ((n - 1) / n)
+    elif comm_op in ("all_reduce", "inference_all_reduce"):
+        tput = size * 2 / duration
+        busbw = (size / duration) * (2 * (n - 1) / n)
+    else:  # broadcast / ppermute / reduce / scatter / others
+        tput = size / duration
+        busbw = tput
+    return tput / 1e9, busbw / 1e9
+
+
+class CommsLogger:
+
+    def __init__(self):
+        self.comms_dict = {}
+        self.verbose = False
+        self.debug = False
+        self.prof_ops = []
+        self.prof_all = True
+        self.enabled = False
+
+    def configure(self, deepspeed_config=None, enabled=None, prof_all=None,
+                  prof_ops=None, verbose=None, debug=None):
+        if deepspeed_config is not None:
+            comms_config = getattr(deepspeed_config, "comms_config", None)
+            if comms_config is not None:
+                self.enabled = comms_config.enabled
+                self.prof_all = comms_config.prof_all
+                self.prof_ops = comms_config.prof_ops
+                self.verbose = comms_config.verbose
+                self.debug = comms_config.debug
+        if enabled is not None:
+            self.enabled = enabled
+        if prof_all is not None:
+            self.prof_all = prof_all
+        if prof_ops is not None:
+            self.prof_ops = prof_ops
+        if verbose is not None:
+            self.verbose = verbose
+        if debug is not None:
+            self.debug = debug
+
+    def start_profiling_comms(self):
+        self.enabled = True
+
+    def stop_profiling_comms(self):
+        self.enabled = False
+
+    def append(self, raw_name, record_name, latency, msg_size, n_ranks=None):
+        if not self.enabled:
+            return
+        if not self.prof_all and raw_name not in self.prof_ops:
+            return
+        import jax
+        n_ranks = n_ranks or jax.device_count()
+        algbw, busbw = calc_bw_log(raw_name, msg_size, latency, n_ranks)
+        if raw_name in self.comms_dict:
+            if msg_size in self.comms_dict[raw_name]:
+                self.comms_dict[raw_name][msg_size][0] += 1
+                self.comms_dict[raw_name][msg_size][1].append(latency)
+                self.comms_dict[raw_name][msg_size][2].append(algbw)
+                self.comms_dict[raw_name][msg_size][3].append(busbw)
+            else:
+                self.comms_dict[raw_name][msg_size] = [1, [latency], [algbw], [busbw]]
+        else:
+            self.comms_dict[raw_name] = {msg_size: [1, [latency], [algbw], [busbw]]}
+        if self.verbose:
+            log_dist(
+                f"comm op: {raw_name} | time (ms): {latency:.2f} | "
+                f"msg size: {convert_size(msg_size)} | algbw (GB/s): {algbw:.2f} | "
+                f"busbw (GB/s): {busbw:.2f}", ranks=[0])
+
+    def log_all(self, print_log=True, show_straggler=False):
+        from ..utils.timer import trim_mean
+        if print_log:
+            header = f"{'Comm. Op': <20}{'Message Size': <20}{'Count': <20}" \
+                     f"{'Total Latency(ms)': <20}{'Avg Latency(ms)': <20}" \
+                     f"{'tput_avg (GB/s)': <20}{'busbw_avg (GB/s)': <20}"
+            print(header)
+        msg_stats = {}
+        for record_name in self.comms_dict.keys():
+            if print_log:
+                print(record_name)
+            for msg_size, vals in sorted(self.comms_dict[record_name].items()):
+                count = vals[0]
+                total_lat = sum(vals[1])
+                avg_lat = trim_mean(vals[1], 0.1)
+                avg_algbw = trim_mean(vals[2], 0.1)
+                avg_busbw = trim_mean(vals[3], 0.1)
+                msg_stats.setdefault(record_name, {})[msg_size] = {
+                    "count": count, "total_latency_ms": total_lat,
+                    "avg_latency_ms": avg_lat, "algbw_gbps": avg_algbw,
+                    "busbw_gbps": avg_busbw}
+                if print_log:
+                    print(f"{' ': <20}{convert_size(msg_size): <20}{count: <20}"
+                          f"{total_lat: <20.2f}{avg_lat: <20.2f}"
+                          f"{avg_algbw: <20.2f}{avg_busbw: <20.2f}")
+        return msg_stats

@@ -1,0 +1,49 @@
+"""Inference config (reference: deepspeed/inference/config.py —
+DeepSpeedInferenceConfig pydantic model)."""
+
+import dataclasses
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+@dataclasses.dataclass
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """reference: inference/config.py DeepSpeedTPConfig"""
+    enabled: bool = True
+    tp_size: int = 1
+
+
+@dataclasses.dataclass
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    tensor_parallel: DeepSpeedTPConfig = dataclasses.field(
+        default_factory=DeepSpeedTPConfig)
+    dtype: str = "bfloat16"
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+    replace_with_kernel_inject: bool = False  # [compat] kernels auto-select
+    max_tokens: int = 1024
+    checkpoint: str = None
+    zero_init: bool = False
+
+    def __post_init__(self):
+        if isinstance(self.tensor_parallel, int):
+            self.tensor_parallel = DeepSpeedTPConfig(tp_size=self.tensor_parallel)
+        if isinstance(self.tensor_parallel, dict):
+            self.tensor_parallel = DeepSpeedTPConfig.from_dict(self.tensor_parallel)
+
+    @classmethod
+    def from_kwargs(cls, **kwargs):
+        known = {f.name for f in dataclasses.fields(cls)}
+        if "tp_size" in kwargs:
+            kwargs["tensor_parallel"] = {"tp_size": kwargs.pop("tp_size")}
+        if "mp_size" in kwargs:  # deprecated alias (reference keeps it too)
+            kwargs["tensor_parallel"] = {"tp_size": kwargs.pop("mp_size")}
+        return cls(**{k: v for k, v in kwargs.items() if k in known})
+
+    @property
+    def jax_dtype(self):
+        import jax.numpy as jnp
+        return {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+                "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+                "float32": jnp.float32, "fp32": jnp.float32}.get(
+                    str(self.dtype).replace("torch.", ""), jnp.bfloat16)

@@ -1,0 +1,225 @@
+"""GPT-2 in flax — the first model family (BASELINE configs 1-2).
+
+TPU-native model zoo entry: the reference has no training model zoo (it
+wraps user nn.Modules) but its inference stack ships per-arch modules
+(deepspeed/model_implementations/transformers/ds_gpt.py, module_inject
+policies for GPT2).  Here the model is a flax module whose ``__call__``
+returns the LM loss when labels are given — matching the engine contract
+(the reference engine also expects the wrapped module to return loss,
+runtime/engine.py:1886).
+
+Weight layout follows HF GPT-2 so checkpoints convert 1:1
+(``from_hf_state_dict``).
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import TENSOR_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.1
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_remat: bool = False  # activation checkpointing per block
+
+    @staticmethod
+    def small():
+        return GPT2Config()
+
+    @staticmethod
+    def medium():
+        return GPT2Config(n_embd=1024, n_layer=24, n_head=16)
+
+    @staticmethod
+    def large():
+        return GPT2Config(n_embd=1280, n_layer=36, n_head=20)
+
+    @staticmethod
+    def tiny():
+        """Test-size model (the SimpleModel analog, reference:
+        tests/unit/simple_model.py)."""
+        return GPT2Config(vocab_size=256, n_positions=128, n_embd=64,
+                          n_layer=2, n_head=4, dropout=0.0)
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        B, T, C = x.shape
+        nh, hd = cfg.n_head, cfg.n_embd // cfg.n_head
+        dense = functools_partial_dense(cfg)
+        qkv = dense(3 * cfg.n_embd, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, nh, hd)
+        k = k.reshape(B, T, nh, hd)
+        v = v.reshape(B, T, nh, hd)
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(hd).astype(x.dtype)
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        att = jnp.where(mask[None, None], att, jnp.finfo(att.dtype).min)
+        att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(x.dtype)
+        att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
+        y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, C)
+        y = dense(cfg.n_embd, name="c_proj")(y)
+        y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return y
+
+
+def functools_partial_dense(cfg):
+    def make(features, name):
+        return nn.Dense(features, name=name,
+                        kernel_init=nn.initializers.normal(cfg.initializer_range),
+                        bias_init=nn.initializers.zeros)
+    return make
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        dense = functools_partial_dense(cfg)
+        h = dense(4 * cfg.n_embd, name="c_fc")(x)
+        h = nn.gelu(h, approximate=True)
+        h = dense(cfg.n_embd, name="c_proj")(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return h
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_1")(x),
+            deterministic)
+        x = x + MLP(cfg, name="mlp")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_2")(x),
+            deterministic)
+        return x
+
+
+class GPT2LMHeadModel(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, position_ids=None):
+        cfg = self.config
+        deterministic = not self.has_rng("dropout")
+        B, T = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(cfg.initializer_range),
+                         (cfg.vocab_size, cfg.n_embd))
+        wpe = self.param("wpe", nn.initializers.normal(cfg.initializer_range),
+                         (cfg.n_positions, cfg.n_embd))
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :]
+        x = wte[input_ids] + wpe[position_ids]
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        block = Block
+        if cfg.use_remat:
+            block = nn.remat(Block, static_argnums=(2,))
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"h_{i}")(x, deterministic)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f")(x)
+        logits = x @ wte.T  # tied embeddings (HF GPT-2 convention)
+        if labels is None:
+            return logits
+        loss = cross_entropy_loss(logits, labels)
+        return loss, logits
+
+
+def cross_entropy_loss(logits, labels, ignore_index=-100):
+    """Shifted next-token CE, mean over valid positions (fp32 accumulate)."""
+    shift_logits = logits[:, :-1].astype(jnp.float32)
+    shift_labels = labels[:, 1:]
+    valid = shift_labels != ignore_index
+    safe_labels = jnp.where(valid, shift_labels, 0)
+    logp = jax.nn.log_softmax(shift_logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def gpt2_tensor_rules(name, shape):
+    """Tensor-parallel PartitionSpecs for GPT-2 params (the AutoTP analog,
+    reference: module_inject/auto_tp.py:188 — column-split c_attn/c_fc,
+    row-split c_proj with allreduce; here XLA inserts the allreduce)."""
+    if name.endswith("c_attn.kernel") or name.endswith("c_fc.kernel"):
+        return P(None, TENSOR_AXIS)
+    if name.endswith("c_attn.bias") or name.endswith("c_fc.bias"):
+        return P(TENSOR_AXIS)
+    if name.endswith("c_proj.kernel"):
+        return P(TENSOR_AXIS, None)
+    if name.endswith("wte") or name.endswith("wpe"):
+        return P(None, None)
+    return None
+
+
+# Attach rules so the engine picks them up (engine reads
+# model.tensor_sharding_rules).
+GPT2LMHeadModel.tensor_sharding_rules = staticmethod(gpt2_tensor_rules)
+
+
+def from_hf_state_dict(state_dict, config: GPT2Config):
+    """Convert an HF transformers GPT-2 state dict (torch tensors or numpy)
+    to this module's param tree (reference interop analog:
+    module_inject/load_checkpoint.py)."""
+
+    def g(key):
+        v = state_dict[key]
+        if hasattr(v, "numpy"):
+            v = v.detach().cpu().numpy()
+        return np.asarray(v)
+
+    params = {
+        "wte": g("transformer.wte.weight") if "transformer.wte.weight" in state_dict
+        else g("wte.weight"),
+        "wpe": g("transformer.wpe.weight") if "transformer.wpe.weight" in state_dict
+        else g("wpe.weight"),
+    }
+    prefix = "transformer." if "transformer.wte.weight" in state_dict else ""
+
+    def ln(i, which):
+        return {"scale": g(f"{prefix}h.{i}.{which}.weight"),
+                "bias": g(f"{prefix}h.{i}.{which}.bias")}
+
+    for i in range(config.n_layer):
+        # HF GPT-2 Conv1D stores (in, out) — same as flax Dense kernel.
+        params[f"h_{i}"] = {
+            "ln_1": ln(i, "ln_1"),
+            "ln_2": ln(i, "ln_2"),
+            "attn": {
+                "c_attn": {"kernel": g(f"{prefix}h.{i}.attn.c_attn.weight"),
+                           "bias": g(f"{prefix}h.{i}.attn.c_attn.bias")},
+                "c_proj": {"kernel": g(f"{prefix}h.{i}.attn.c_proj.weight"),
+                           "bias": g(f"{prefix}h.{i}.attn.c_proj.bias")},
+            },
+            "mlp": {
+                "c_fc": {"kernel": g(f"{prefix}h.{i}.mlp.c_fc.weight"),
+                         "bias": g(f"{prefix}h.{i}.mlp.c_fc.bias")},
+                "c_proj": {"kernel": g(f"{prefix}h.{i}.mlp.c_proj.weight"),
+                           "bias": g(f"{prefix}h.{i}.mlp.c_proj.bias")},
+            },
+        }
+    params["ln_f"] = {"scale": g(f"{prefix}ln_f.weight"),
+                      "bias": g(f"{prefix}ln_f.bias")}
+    return {"params": params}

@@ -1,0 +1,117 @@
+"""Monitoring backends (reference: deepspeed/monitor/monitor.py:29
+MonitorMaster fan-out -> TensorBoard/W&B/CSV writers).
+
+Events are (name, value, global_sample) triples, written on rank 0 only.
+"""
+
+import os
+
+from ..utils.logging import logger
+
+
+class Monitor:
+
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+        self.enabled = getattr(monitor_config, "enabled", False)
+
+    def write_events(self, event_list):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    """reference: monitor/tensorboard.py:13"""
+
+    def __init__(self, tensorboard_config):
+        super().__init__(tensorboard_config)
+        self.summary_writer = None
+        if not self.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            log_dir = os.path.join(tensorboard_config.output_path,
+                                   tensorboard_config.job_name)
+            self.summary_writer = SummaryWriter(log_dir=log_dir)
+        except Exception as e:
+            logger.warning(f"TensorBoard not available, disabling: {e}")
+            self.enabled = False
+
+    def write_events(self, event_list, flush=True):
+        if self.summary_writer is None:
+            return
+        for event in event_list:
+            self.summary_writer.add_scalar(*event)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    """reference: monitor/wandb.py:12"""
+
+    def __init__(self, wandb_config):
+        super().__init__(wandb_config)
+        self._wandb = None
+        if not self.enabled:
+            return
+        try:
+            import wandb
+            self._wandb = wandb
+            wandb.init(project=wandb_config.project, group=wandb_config.group,
+                       entity=wandb_config.team)
+        except Exception as e:
+            logger.warning(f"wandb not available, disabling: {e}")
+            self.enabled = False
+
+    def write_events(self, event_list):
+        if self._wandb is None:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: value}, step=int(step))
+
+
+class csvMonitor(Monitor):
+    """reference: monitor/csv_monitor.py:12 — one csv file per event name."""
+
+    def __init__(self, csv_config):
+        super().__init__(csv_config)
+        self.filenames = {}
+        if not self.enabled:
+            return
+        self.output_path = os.path.join(csv_config.output_path, csv_config.job_name)
+        os.makedirs(self.output_path, exist_ok=True)
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        import csv
+        for name, value, step in event_list:
+            fname = os.path.join(self.output_path,
+                                 name.replace("/", "_") + ".csv")
+            new = fname not in self.filenames
+            self.filenames[fname] = True
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new and os.path.getsize(fname) == 0:
+                    w.writerow(["step", name])
+                w.writerow([int(step), value])
+
+
+class MonitorMaster(Monitor):
+    """reference: monitor/monitor.py:29 MonitorMaster"""
+
+    def __init__(self, ds_config):
+        self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard_config)
+        self.wandb_monitor = WandbMonitor(ds_config.wandb_config)
+        self.csv_monitor = csvMonitor(ds_config.csv_config)
+        self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
+                        or self.csv_monitor.enabled)
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        if self.tb_monitor.enabled:
+            self.tb_monitor.write_events(event_list)
+        if self.wandb_monitor.enabled:
+            self.wandb_monitor.write_events(event_list)
+        if self.csv_monitor.enabled:
+            self.csv_monitor.write_events(event_list)

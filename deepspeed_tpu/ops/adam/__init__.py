@@ -1,0 +1,1 @@
+from .fused_adam import fused_adam_update, scale_by_fused_adam  # noqa: F401

@@ -1,0 +1,4 @@
+from .mesh import (MESH_AXES, BATCH_AXES, DATA_AXIS, EXPERT_AXIS, FSDP_AXIS,  # noqa: F401
+                   PIPE_AXIS, SEQUENCE_AXIS, TENSOR_AXIS, MeshConfig,
+                   MeshManager, build_mesh, get_mesh, init_mesh, mesh_manager,
+                   single_device_mesh)

@@ -1,0 +1,187 @@
+"""Named device mesh — the TPU-native replacement for process groups.
+
+The reference builds explicit rank lists per parallel dimension
+(deepspeed/utils/groups.py:116-610 — data/model/expert/sequence groups and
+their cartesian products via ProcessTopology, runtime/pipe/topology.py:12).
+On TPU the whole topology is one ``jax.sharding.Mesh`` with named axes;
+"groups" are axis names, and every collective is an axis-scoped XLA op.
+
+Axis order is chosen for ICI locality: the innermost axes ("tensor",
+then "sequence"/"fsdp") carry per-layer collectives and must ride the
+fastest links; "pipe" is outermost so stage boundaries can cross DCN in
+multi-slice deployments.
+"""
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical axis order, outermost -> innermost.
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
+FSDP_AXIS = "fsdp"
+SEQUENCE_AXIS = "sequence"
+TENSOR_AXIS = "tensor"
+
+MESH_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS)
+
+# Axes over which a batch is split (batch-sharding axes): data + fsdp.
+# ZeRO treats fsdp as extra data parallelism (reference: engine.py:1155
+# seq_dp_world_size batch math).
+BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes per named axis; -1 on ``data`` means "absorb remaining devices"."""
+    pipe: int = 1
+    data: int = -1
+    expert: int = 1
+    fsdp: int = 1
+    sequence: int = 1
+    tensor: int = 1
+
+    def resolved(self, n_devices: int) -> "MeshConfig":
+        sizes = dataclasses.asdict(self)
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        n_auto = sum(1 for v in sizes.values() if v == -1)
+        if n_auto > 1:
+            raise ValueError("only one mesh axis may be -1")
+        if n_auto == 1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}")
+            auto = n_devices // fixed
+            sizes = {k: (auto if v == -1 else v) for k, v in sizes.items()}
+        total = math.prod(sizes.values())
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {total} devices but {n_devices} are available")
+        return MeshConfig(**sizes)
+
+    @property
+    def shape(self):
+        return tuple(getattr(self, ax) for ax in MESH_AXES)
+
+    def axis_size(self, axis: str) -> int:
+        return getattr(self, axis)
+
+
+def build_mesh(config: Optional[MeshConfig] = None,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """Construct the global mesh.
+
+    Uses ``jax.devices()`` order, which JAX arranges for ICI contiguity on
+    TPU slices; ``mesh_utils.create_device_mesh`` is used when the
+    requested shape allows it (it optimises for ICI torus wraparound).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    config = (config or MeshConfig()).resolved(n)
+    shape = config.shape
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def single_device_mesh(device=None) -> Mesh:
+    devices = [device] if device is not None else jax.devices()[:1]
+    return Mesh(np.asarray(devices).reshape((1,) * len(MESH_AXES)), MESH_AXES)
+
+
+class MeshManager:
+    """Process-group registry analog: holds the active mesh + axis queries
+    (reference: deepspeed/utils/groups.py module-level registry)."""
+
+    def __init__(self):
+        self._mesh: Optional[Mesh] = None
+        self._config: Optional[MeshConfig] = None
+
+    def init(self, config: Optional[MeshConfig] = None, devices=None, mesh: Optional[Mesh] = None):
+        if mesh is not None:
+            unknown = set(mesh.axis_names) - set(MESH_AXES)
+            if unknown:
+                raise ValueError(
+                    f"user mesh has axes {sorted(unknown)} outside the canonical "
+                    f"set {MESH_AXES}; rename them so batch/ZeRO sharding rules "
+                    f"can address them")
+            self._mesh = mesh
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            self._config = MeshConfig(**{ax: sizes.get(ax, 1) for ax in MESH_AXES})
+        else:
+            self._config = (config or MeshConfig()).resolved(
+                len(devices) if devices is not None else jax.device_count())
+            self._mesh = build_mesh(self._config, devices)
+        return self._mesh
+
+    @property
+    def initialized(self):
+        return self._mesh is not None
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            self.init()
+        return self._mesh
+
+    @property
+    def config(self) -> MeshConfig:
+        if self._config is None:
+            self.init()
+        return self._config
+
+    def reset(self):
+        self._mesh = None
+        self._config = None
+
+    # -------- groups.py-parity world-size/rank queries --------
+    def axis_size(self, axis) -> int:
+        if isinstance(axis, (tuple, list)):
+            return math.prod(self.axis_size(a) for a in axis)
+        return self.config.axis_size(axis)
+
+    def world_size(self) -> int:
+        return math.prod(self.config.shape)
+
+    def data_parallel_world_size(self) -> int:
+        # ZeRO counts fsdp shards as data-parallel replicas for batch math.
+        return self.axis_size(BATCH_AXES)
+
+    def model_parallel_world_size(self) -> int:
+        return self.axis_size(TENSOR_AXIS)
+
+    def expert_parallel_world_size(self) -> int:
+        return self.axis_size(EXPERT_AXIS)
+
+    def sequence_parallel_world_size(self) -> int:
+        return self.axis_size(SEQUENCE_AXIS)
+
+    def pipe_parallel_world_size(self) -> int:
+        return self.axis_size(PIPE_AXIS)
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+
+# Module-level singleton, mirroring the reference's global group registry.
+mesh_manager = MeshManager()
+
+
+def get_mesh() -> Mesh:
+    return mesh_manager.mesh
+
+
+def init_mesh(config: Optional[MeshConfig] = None, devices=None, mesh=None) -> Mesh:
+    return mesh_manager.init(config, devices, mesh)

@@ -1,0 +1,280 @@
+"""DeepSpeed-style config system (reference: deepspeed/runtime/config.py —
+DeepSpeedConfig; getters config.py:127-524; batch reconciliation
+``_configure_train_batch_size``).
+
+One JSON/dict config drives every feature.  The schema is kept
+key-compatible with the reference so existing ds_config.json files work;
+TPU-specific extensions live under the ``"mesh"`` key (axis sizes for the
+device mesh, replacing world-size/mpu plumbing).
+"""
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+from ..parallel.mesh import MeshConfig
+from ..utils.logging import logger
+from .config_utils import DeepSpeedConfigModel, dict_raise_error_on_duplicate_keys, submodel
+from .constants import *  # noqa: F401,F403
+from .zero.config import DeepSpeedZeroConfig
+
+
+@dataclasses.dataclass
+class FP16Config(DeepSpeedConfigModel):
+    """reference: runtime/config.py fp16 section + fp16/loss_scaler.py"""
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0          # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+    fp16_master_weights_and_grads: bool = False
+
+    @property
+    def dynamic(self):
+        return self.loss_scale == 0
+
+
+@dataclasses.dataclass
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    immediate_grad_update: bool = False  # [compat]
+
+
+@dataclasses.dataclass
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: str = None
+    params: dict = dataclasses.field(default_factory=dict)
+    legacy_fusion: bool = False  # [compat]
+
+
+@dataclasses.dataclass
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: str = None
+    params: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    """reference: utils/comms_logging.py config"""
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """reference: runtime/activation_checkpointing/config.py.
+    On TPU this maps to jax.checkpoint (remat) policies; partitioned
+    activations map to sequence/tensor-sharded remat."""
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False      # offload saved residuals to host
+    contiguous_memory_optimization: bool = False  # [compat]
+    number_checkpoints: int = None       # [compat]
+    synchronize_checkpoint_boundary: bool = False  # [compat]
+    profile: bool = False
+
+
+@dataclasses.dataclass
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+@dataclasses.dataclass
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: str = None
+    team: str = None
+    project: str = "deepspeed"
+
+
+@dataclasses.dataclass
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+@dataclasses.dataclass
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    """reference: profiling/config.py"""
+    enabled: bool = False
+    recompute_fwd_factor: float = 0.0
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: str = None
+
+
+@dataclasses.dataclass
+class CheckpointConfig(DeepSpeedConfigModel):
+    """reference: runtime/config.py checkpoint section"""
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: dict = dataclasses.field(default_factory=dict)
+    async_save: bool = False
+
+
+@dataclasses.dataclass
+class DataTypesConfig(DeepSpeedConfigModel):
+    grad_accum_dtype: str = None  # None => same as compute dtype
+
+
+@dataclasses.dataclass
+class PipelineConfig(DeepSpeedConfigModel):
+    """Pipeline engine knobs (reference: pipe engine config usage)."""
+    stages: str = "auto"
+    partition: str = "best"
+    seed_layers: bool = False
+    activation_checkpoint_interval: int = 0
+    pipe_partitioned: bool = True
+    grad_partitioned: bool = True
+
+
+class DeepSpeedConfig:
+    """Parsed top-level config object.
+
+    Accepts a dict or a JSON file path.  Performs the reference's batch
+    reconciliation: train_batch = micro_batch * grad_accum * dp_world
+    (reference: runtime/config.py _configure_train_batch_size).
+    """
+
+    def __init__(self, config, mesh=None, dp_world_size: Optional[int] = None):
+        if isinstance(config, (str, os.PathLike)):
+            if not os.path.exists(config):
+                raise ValueError(f"DeepSpeed config path does not exist: {config}")
+            with open(config) as f:
+                self._param_dict = json.load(
+                    f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            self._param_dict = config
+        elif isinstance(config, DeepSpeedConfig):
+            self._param_dict = config._param_dict
+        else:
+            raise ValueError(
+                f"Expected a string path or dict, got: {type(config)}")
+        d = self._param_dict
+
+        # --- mesh topology (TPU extension) ---
+        mesh_dict = d.get(MESH, {})
+        known = {f.name for f in dataclasses.fields(MeshConfig)}
+        unknown = set(mesh_dict) - known
+        if unknown:
+            logger.warning(f"Unknown mesh axes ignored: {unknown}")
+        self.mesh_config = MeshConfig(**{k: v for k, v in mesh_dict.items() if k in known})
+
+        # --- feature sections ---
+        self.zero_config = DeepSpeedZeroConfig.from_dict(d.get(ZERO_OPTIMIZATION, {}))
+        self.fp16_config = FP16Config.from_dict(d.get(FP16, {}))
+        self.bf16_config = BF16Config.from_dict(d.get(BF16, d.get("bfloat16", {})))
+        self.optimizer_config = OptimizerConfig.from_dict(d[OPTIMIZER]) if OPTIMIZER in d else None
+        self.scheduler_config = SchedulerConfig.from_dict(d[SCHEDULER]) if SCHEDULER in d else None
+        self.comms_config = CommsLoggerConfig.from_dict(d.get(COMMS_LOGGER, {}))
+        self.activation_checkpointing_config = ActivationCheckpointingConfig.from_dict(
+            d.get(ACTIVATION_CHECKPOINTING, {}))
+        self.tensorboard_config = TensorBoardConfig.from_dict(d.get(MONITOR_TENSORBOARD, {}))
+        self.wandb_config = WandbConfig.from_dict(d.get(MONITOR_WANDB, {}))
+        self.csv_config = CSVConfig.from_dict(d.get(MONITOR_CSV, {}))
+        self.flops_profiler_config = FlopsProfilerConfig.from_dict(
+            d.get("flops_profiler", {}))
+        self.checkpoint_config = CheckpointConfig.from_dict(d.get(CHECKPOINT, {}))
+        self.data_types_config = DataTypesConfig.from_dict(d.get(DATA_TYPES, {}))
+        self.pipeline_config = PipelineConfig.from_dict(d.get(PIPELINE, {}))
+
+        # --- scalars ---
+        self.gradient_clipping = d.get(GRADIENT_CLIPPING, 0.0)
+        self.prescale_gradients = d.get(PRESCALE_GRADIENTS, False)
+        self.gradient_predivide_factor = d.get(GRADIENT_PREDIVIDE_FACTOR, 1.0)
+        self.steps_per_print = d.get(STEPS_PER_PRINT, STEPS_PER_PRINT_DEFAULT)
+        self.wall_clock_breakdown = d.get(WALL_CLOCK_BREAKDOWN, False)
+        self.dump_state = d.get(DUMP_STATE, False)
+        self.sparse_gradients_enabled = d.get(SPARSE_GRADIENTS, False)
+        self.memory_breakdown = d.get("memory_breakdown", False)
+        self.seed = d.get("seed", 42)
+        self.disable_allgather = d.get("disable_allgather", False)
+        self.communication_data_type = d.get("communication_data_type", None)
+        self.train_micro_batch_size_per_gpu_raw = d.get(TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps_raw = d.get(GRADIENT_ACCUMULATION_STEPS)
+        self.train_batch_size_raw = d.get(TRAIN_BATCH_SIZE)
+
+        # Precision sanity (reference: config sanity checks)
+        if self.fp16_config.enabled and self.bf16_config.enabled:
+            raise ValueError("fp16 and bf16 cannot both be enabled")
+
+        self._batch_assertion_done = False
+        if dp_world_size is not None:
+            self.resolve_batch_sizes(dp_world_size)
+
+    # ---------------- batch-size reconciliation ----------------
+    def resolve_batch_sizes(self, dp_world_size: int):
+        """Solve train_batch = micro * grad_accum * dp_world with any two
+        given (reference: runtime/config.py _configure_train_batch_size)."""
+        train = self.train_batch_size_raw
+        micro = self.train_micro_batch_size_per_gpu_raw
+        gas = self.gradient_accumulation_steps_raw
+
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            gas = train // (micro * dp_world_size)
+        elif train is not None and gas is not None:
+            micro = train // (gas * dp_world_size)
+        elif micro is not None and gas is not None:
+            train = micro * gas * dp_world_size
+        elif train is not None:
+            gas = 1
+            micro = train // dp_world_size
+        elif micro is not None:
+            gas = 1
+            train = micro * dp_world_size
+        else:
+            micro = TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT
+            gas = GRADIENT_ACCUMULATION_STEPS_DEFAULT
+            train = micro * gas * dp_world_size
+
+        if train != micro * gas * dp_world_size:
+            raise ValueError(
+                f"Check batch related parameters. train_batch_size is not equal "
+                f"to micro_batch_per_gpu * gradient_acc_step * world_size "
+                f"{train} != {micro} * {gas} * {dp_world_size}")
+        if micro is None or micro <= 0 or (gas is not None and gas <= 0):
+            raise ValueError("batch sizes must be positive")
+
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+        self._batch_assertion_done = True
+        return train, micro, gas
+
+    # ---------------- convenience ----------------
+    @property
+    def zero_enabled(self):
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self):
+        return self.zero_config.stage
+
+    @property
+    def precision_dtype(self):
+        import jax.numpy as jnp
+        if self.bf16_config.enabled:
+            return jnp.bfloat16
+        if self.fp16_config.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    def print_config(self):
+        logger.info("DeepSpeedConfig:")
+        for k, v in sorted(self.__dict__.items()):
+            if not k.startswith("_"):
+                logger.info(f"  {k:35} {v}")

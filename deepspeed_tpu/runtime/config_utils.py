@@ -1,0 +1,133 @@
+"""Config model base (reference: deepspeed/runtime/config_utils.py —
+DeepSpeedConfigModel with deprecated-field aliasing, there built on pinned
+pydantic v1).  Re-implemented on dataclasses to stay dependency-free: each
+config section is a dataclass that accepts a plain dict, warns on unknown
+keys, and supports deprecated aliases."""
+
+import dataclasses
+from typing import Any, Dict
+
+from ..utils.logging import logger
+
+
+class ConfigError(Exception):
+    pass
+
+
+def _coerce(value, field_type):
+    # Best-effort scalar coercion (JSON "1e8" strings for big ints, etc.)
+    try:
+        if field_type is int and isinstance(value, (str, float)):
+            return int(float(value))
+        if field_type is float and isinstance(value, (str, int)):
+            return float(value)
+    except (TypeError, ValueError):
+        pass
+    return value
+
+
+@dataclasses.dataclass
+class DeepSpeedConfigModel:
+    """Base: construct from dict with unknown-key warnings and aliases.
+
+    Subclasses may define ``_deprecated`` mapping old->new field names.
+    """
+
+    _deprecated: Dict[str, str] = dataclasses.field(
+        default_factory=dict, repr=False, compare=False)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any] = None, **extra):
+        d = dict(d or {})
+        d.update(extra)
+        field_map = {f.name: f for f in dataclasses.fields(cls)
+                     if f.name != "_deprecated"}
+        deprecated = {}
+        for f in dataclasses.fields(cls):
+            if f.name == "_deprecated" and f.default_factory is not dataclasses.MISSING:
+                deprecated = f.default_factory()
+        # cls-level mapping wins
+        deprecated = dict(deprecated, **getattr(cls, "DEPRECATED", {}))
+        kwargs = {}
+        for key, value in d.items():
+            name = key
+            if name in deprecated:
+                new = deprecated[name]
+                logger.warning(
+                    f"Config parameter {name} is deprecated, use {new} instead")
+                name = new
+            if name in field_map:
+                f = field_map[name]
+                sub = _resolve_submodel(f)
+                if sub is not None and isinstance(value, dict):
+                    value = sub.from_dict(value)
+                elif sub is not None and isinstance(value, bool):
+                    # {"tensorboard": true} style shorthand
+                    value = sub.from_dict({"enabled": value})
+                else:
+                    value = _coerce(value, f.type)
+                kwargs[name] = value
+            else:
+                logger.warning(f"Unknown config key ignored: {cls.__name__}.{key}")
+        obj = cls(**kwargs)
+        obj._validate()
+        return obj
+
+    def _validate(self):
+        ...
+
+    def to_dict(self):
+        out = {}
+        for f in dataclasses.fields(self):
+            if f.name == "_deprecated":
+                continue
+            v = getattr(self, f.name)
+            if isinstance(v, DeepSpeedConfigModel):
+                v = v.to_dict()
+            out[f.name] = v
+        return out
+
+    def __repr__(self):
+        body = ", ".join(f"{k}={v!r}" for k, v in self.to_dict().items())
+        return f"{type(self).__name__}({body})"
+
+
+def _resolve_submodel(f: dataclasses.Field):
+    t = f.type
+    if isinstance(t, str):
+        return None  # string annotations resolved by subclasses using metadata
+    if isinstance(t, type) and issubclass(t, DeepSpeedConfigModel):
+        return t
+    sub = f.metadata.get("model") if f.metadata else None
+    return sub
+
+
+def submodel(model_cls, **kw):
+    """Field factory for a nested config section."""
+    return dataclasses.field(default_factory=model_cls.from_dict,
+                             metadata={"model": model_cls}, **kw)
+
+
+def get_scalar_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys in the JSON config
+    (reference: config_utils.py dict_raise_error_on_duplicate_keys)."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _v in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError("Duplicate keys in DeepSpeed config: {}".format(keys))
+    return d

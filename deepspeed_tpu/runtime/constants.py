@@ -1,0 +1,82 @@
+"""Config key constants & defaults (reference: deepspeed/runtime/constants.py)."""
+
+# Batch size keys
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+
+# Optimizer / scheduler
+OPTIMIZER = "optimizer"
+SCHEDULER = "scheduler"
+MAX_GRAD_NORM = "max_grad_norm"
+
+ADAM_OPTIMIZER = "adam"
+ADAMW_OPTIMIZER = "adamw"
+FUSED_ADAM = "fusedadam"
+LAMB_OPTIMIZER = "lamb"
+LION_OPTIMIZER = "lion"
+ADAGRAD_OPTIMIZER = "adagrad"
+SGD_OPTIMIZER = "sgd"
+ONEBIT_ADAM_OPTIMIZER = "onebitadam"
+ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
+ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
+DEEPSPEED_OPTIMIZERS = [
+    ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM, LAMB_OPTIMIZER, LION_OPTIMIZER,
+    ADAGRAD_OPTIMIZER, SGD_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER,
+    ZERO_ONE_ADAM_OPTIMIZER,
+]
+
+# Precision
+FP16 = "fp16"
+BF16 = "bf16"
+FP16_ENABLED = "enabled"
+FP16_LOSS_SCALE = "loss_scale"
+FP16_INITIAL_SCALE_POWER = "initial_scale_power"
+FP16_LOSS_SCALE_WINDOW = "loss_scale_window"
+FP16_HYSTERESIS = "hysteresis"
+FP16_MIN_LOSS_SCALE = "min_loss_scale"
+
+GRADIENT_CLIPPING = "gradient_clipping"
+PRESCALE_GRADIENTS = "prescale_gradients"
+GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
+
+STEPS_PER_PRINT = "steps_per_print"
+WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
+DUMP_STATE = "dump_state"
+
+ZERO_OPTIMIZATION = "zero_optimization"
+
+# Default values
+TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT = 1
+GRADIENT_ACCUMULATION_STEPS_DEFAULT = 1
+STEPS_PER_PRINT_DEFAULT = 10
+
+ROUTE_TRAIN = "train"
+ROUTE_EVAL = "eval"
+ROUTE_PREDICT = "predict"
+ROUTE_ENCODE = "encode"
+
+# Mesh / topology (TPU-native extension; replaces mpu/world_size knobs)
+MESH = "mesh"
+
+# Activation checkpointing
+ACTIVATION_CHECKPOINTING = "activation_checkpointing"
+
+# Communication
+COMMS_LOGGER = "comms_logger"
+SPARSE_GRADIENTS = "sparse_gradients"
+
+# Monitoring
+MONITOR_TENSORBOARD = "tensorboard"
+MONITOR_WANDB = "wandb"
+MONITOR_CSV = "csv_monitor"
+
+# Checkpoint
+CHECKPOINT = "checkpoint"
+LOAD_UNIVERSAL_CHECKPOINT = "load_universal"
+
+# Data types
+DATA_TYPES = "data_types"
+GRAD_ACCUM_DTYPE = "grad_accum_dtype"
+
+PIPELINE = "pipeline"

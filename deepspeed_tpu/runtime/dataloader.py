@@ -1,0 +1,89 @@
+"""Data loading (reference: deepspeed/runtime/dataloader.py —
+DeepSpeedDataLoader + RepeatingLoader).
+
+TPU-native: batches are numpy arrays assembled on host then device_put
+with the batch sharding (data+fsdp axes), so each chip receives only its
+slice (the analog of per-rank DistributedSampler sharding)."""
+
+import numpy as np
+
+from ..parallel.mesh import BATCH_AXES
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration
+    (reference: dataloader.py RepeatingLoader)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch
+
+
+class DeepSpeedDataLoader:
+    """Minimal epoch-based loader over an indexable dataset.
+
+    Yields host numpy batches of the *global* batch size
+    (micro_batch * dp_world); the engine shards them over the mesh's
+    batch axes on device_put.  ``data_sampler`` may reorder indices
+    (curriculum learning plugs in here, reference:
+    runtime/data_pipeline/data_sampling)."""
+
+    def __init__(self, dataset, batch_size, collate_fn=None, shuffle=False,
+                 seed=0, drop_last=True, data_sampler=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.data_sampler = data_sampler
+        self.epoch = 0
+        self.len = len(dataset) // batch_size if drop_last else \
+            -(-len(dataset) // batch_size)
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.len
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.data_sampler is not None:
+            indices = list(self.data_sampler)
+        elif self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            indices = rng.permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        for start in range(0, n - (self.batch_size - 1 if self.drop_last else 0),
+                           self.batch_size):
+            chunk = indices[start:start + self.batch_size]
+            if not chunk:
+                return
+            yield self.collate_fn([self.dataset[i] for i in chunk])
+
+
+def _default_collate(samples):
+    """Stack leaf-wise: list of dicts/tuples/arrays -> batched numpy."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: _default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(_default_collate([s[i] for s in samples])
+                           for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])

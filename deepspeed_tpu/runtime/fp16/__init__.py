@@ -1,0 +1,3 @@
+from .loss_scaler import (CreateLossScaler, LossScaleState, LossScalerBase,  # noqa: F401
+                          dynamic_loss_scale_state, has_inf_or_nan,
+                          static_loss_scale_state, update_scale)

@@ -1,0 +1,109 @@
+"""Dynamic loss scaling (reference: deepspeed/runtime/fp16/loss_scaler.py:91
+DynamicLossScaler; LossScaler static variant :48).
+
+Functional design: the scaler state is a small pytree carried through the
+jitted train step, and the update rule is pure so the whole
+overflow-check / scale-adjust / skip-step logic compiles into the step
+(no host round-trip, unlike the reference's CPU-side overflow check).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+CONSECUTIVE_HYSTERESIS = "consecutive_hysteresis"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScaleState(NamedTuple):
+    loss_scale: jnp.ndarray        # f32 scalar
+    good_steps: jnp.ndarray        # i32 scalar: steps since last overflow
+    hysteresis: jnp.ndarray        # i32 scalar: remaining tolerated overflows
+
+
+def static_loss_scale_state(scale: float) -> LossScaleState:
+    return LossScaleState(jnp.float32(scale), jnp.int32(0), jnp.int32(1))
+
+
+def dynamic_loss_scale_state(initial_scale_power=16, hysteresis=2) -> LossScaleState:
+    return LossScaleState(jnp.float32(2.0**initial_scale_power), jnp.int32(0),
+                          jnp.int32(hysteresis))
+
+
+def has_inf_or_nan(tree) -> jnp.ndarray:
+    """Global overflow flag over a grad pytree
+    (reference: loss_scaler.py has_overflow_serial / stage3.py:2174)."""
+    leaves = [jnp.logical_not(jnp.isfinite(x)).any()
+              for x in jax.tree_util.tree_leaves(tree)]
+    if not leaves:
+        return jnp.bool_(False)
+    return jnp.stack(leaves).any()
+
+
+def update_scale(state: LossScaleState, overflow: jnp.ndarray, *,
+                 dynamic: bool = True, scale_window: int = 1000,
+                 min_scale: float = 1.0, scale_factor: float = 2.0,
+                 max_hysteresis: int = 2,
+                 consecutive_hysteresis: bool = False) -> LossScaleState:
+    """Pure update (reference: DynamicLossScaler.update_scale
+    fp16/loss_scaler.py:175)."""
+    if not dynamic:
+        return state
+
+    def on_overflow(s):
+        hyst = s.hysteresis - 1
+        new_scale = jnp.where(hyst <= 0,
+                              jnp.maximum(s.loss_scale / scale_factor, min_scale),
+                              s.loss_scale)
+        new_hyst = jnp.where(hyst <= 0, jnp.int32(max_hysteresis), hyst)
+        return LossScaleState(new_scale, jnp.int32(0), new_hyst)
+
+    def on_good(s):
+        grow = (s.good_steps + 1) % scale_window == 0
+        new_scale = jnp.where(grow, s.loss_scale * scale_factor, s.loss_scale)
+        hyst = jnp.int32(max_hysteresis) if consecutive_hysteresis else s.hysteresis
+        return LossScaleState(new_scale, s.good_steps + 1, hyst)
+
+    return jax.lax.cond(overflow, on_overflow, on_good, state)
+
+
+class LossScalerBase:
+    """Object-API shim matching the reference loss scaler classes."""
+
+    def __init__(self, state: LossScaleState, dynamic: bool, **kwargs):
+        self.state = state
+        self.dynamic = dynamic
+        self.kwargs = kwargs
+
+    @property
+    def loss_scale(self):
+        return float(self.state.loss_scale)
+
+    def scale_gradient(self, g):
+        return jax.tree_util.tree_map(lambda x: x * self.state.loss_scale, g)
+
+    def backward(self, loss):
+        return loss * self.state.loss_scale
+
+    def update_scale(self, overflow):
+        self.state = update_scale(self.state, jnp.bool_(overflow),
+                                  dynamic=self.dynamic, **self.kwargs)
+
+
+def CreateLossScaler(dtype, static_loss_scale, dynamic_scaling, dynamic_loss_args=None):
+    """Factory (reference: loss_scaler.py CreateLossScaler)."""
+    import jax.numpy as jnp_
+    if dtype == jnp_.float16 and dynamic_scaling:
+        args = dynamic_loss_args or {}
+        state = dynamic_loss_scale_state(
+            initial_scale_power=args.get("initial_scale_power", 16))
+        return LossScalerBase(state, dynamic=True,
+                              scale_window=args.get("loss_scale_window", 1000),
+                              min_scale=args.get("min_loss_scale", 1.0),
+                              max_hysteresis=args.get("hysteresis", 2))
+    scale = static_loss_scale if dtype == jnp_.float16 else 1.0
+    return LossScalerBase(static_loss_scale_state(scale), dynamic=False)

@@ -1,0 +1,163 @@
+"""LR schedules (reference: deepspeed/runtime/lr_schedules.py:23,267,370,634,723,774
+— LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR, WarmupCosineLR).
+
+Each schedule is a pure ``step -> lr`` callable (optax-schedule
+compatible).  The math is written with ``jnp.where`` so the schedule can
+be traced inside the jitted train step (optax.scale_by_schedule) as well
+as called with Python ints; a thin stateful wrapper provides the
+reference's ``step()/get_lr()/state_dict()`` object API.
+"""
+
+import jax.numpy as jnp
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR,
+                      WARMUP_COSINE_LR]
+
+WARMUP_LOG_RATE = "log"
+WARMUP_LINEAR_RATE = "linear"
+
+
+def lr_range_test(lr_range_test_min_lr=1e-3, lr_range_test_step_size=2000,
+                  lr_range_test_step_rate=1.0, lr_range_test_staircase=False, **_):
+    """reference: lr_schedules.py:23 LRRangeTest"""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1 + interval * lr_range_test_step_rate)
+
+    return schedule
+
+
+def one_cycle(cycle_min_lr=0.0, cycle_max_lr=1e-3, decay_lr_rate=0.0,
+              cycle_first_step_size=2000, cycle_second_step_size=None,
+              cycle_first_stair_count=0, cycle_second_stair_count=None,
+              decay_step_size=0, **_):
+    """reference: lr_schedules.py:267 OneCycle (LR half; momentum cycling
+    composes via optax.inject_hyperparams when needed)"""
+    second = cycle_second_step_size if cycle_second_step_size is not None \
+        else cycle_first_step_size
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * step / cycle_first_step_size
+        down_frac = (step - cycle_first_step_size) / second
+        down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * down_frac
+        if decay_step_size > 0 and decay_lr_rate > 0:
+            decay_steps = (step - cycle_first_step_size - second) / decay_step_size
+            tail = cycle_min_lr / (1 + decay_steps * decay_lr_rate)
+        else:
+            tail = jnp.full_like(step, cycle_min_lr)
+        out = jnp.where(step <= cycle_first_step_size, up,
+                        jnp.where(step <= cycle_first_step_size + second, down, tail))
+        return out
+
+    return schedule
+
+
+def _warmup_gamma(step, warmup_num_steps, warmup_type):
+    if warmup_type == WARMUP_LOG_RATE:
+        return jnp.log(step + 1.0) / jnp.log(jnp.float32(warmup_num_steps))
+    return jnp.minimum(1.0, step / warmup_num_steps)
+
+
+def warmup_lr(warmup_min_lr=0.0, warmup_max_lr=1e-3, warmup_num_steps=1000,
+              warmup_type=WARMUP_LOG_RATE, **_):
+    """reference: lr_schedules.py:634 WarmupLR"""
+    warmup_num_steps = max(2, warmup_num_steps)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        gamma = _warmup_gamma(step, warmup_num_steps, warmup_type)
+        warm = warmup_min_lr + (warmup_max_lr - warmup_min_lr) * gamma
+        return jnp.where(step < warmup_num_steps, warm, warmup_max_lr)
+
+    return schedule
+
+
+def warmup_decay_lr(total_num_steps, warmup_min_lr=0.0, warmup_max_lr=1e-3,
+                    warmup_num_steps=1000, warmup_type=WARMUP_LOG_RATE, **_):
+    """reference: lr_schedules.py:723 WarmupDecayLR (linear decay to 0)"""
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+    warmup_num_steps_ = max(2, warmup_num_steps)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = (total_num_steps - step) / max(1, total_num_steps - warmup_num_steps_)
+        decay = warmup_max_lr * jnp.maximum(0.0, frac)
+        return jnp.where(step < warmup_num_steps_, base(step), decay)
+
+    return schedule
+
+
+def warmup_cosine_lr(total_num_steps, warmup_min_ratio=0.0, warmup_num_steps=1000,
+                     cos_min_ratio=0.0001, warmup_type=WARMUP_LINEAR_RATE,
+                     base_lr=1.0, **_):
+    """reference: lr_schedules.py:774 WarmupCosineLR (ratios of base lr)"""
+    warmup_num_steps_ = max(2, warmup_num_steps)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        g = _warmup_gamma(step, warmup_num_steps_, warmup_type)
+        warm_ratio = warmup_min_ratio + (1 - warmup_min_ratio) * g
+        progress = jnp.clip((step - warmup_num_steps_) /
+                            max(1, total_num_steps - warmup_num_steps_), 0.0, 1.0)
+        cosine = 0.5 * (1 + jnp.cos(jnp.pi * progress))
+        cos_ratio = cos_min_ratio + (1 - cos_min_ratio) * cosine
+        ratio = jnp.where(step < warmup_num_steps_, warm_ratio, cos_ratio)
+        return base_lr * ratio
+
+    return schedule
+
+
+_FACTORIES = {
+    LR_RANGE_TEST: lr_range_test,
+    ONE_CYCLE: one_cycle,
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+    WARMUP_COSINE_LR: warmup_cosine_lr,
+}
+
+
+def get_lr_schedule(name, params):
+    if name not in _FACTORIES:
+        raise ValueError(
+            f"Scheduler type {name} not supported; valid: {VALID_LR_SCHEDULES}")
+    return _FACTORIES[name](**params)
+
+
+class LRScheduler:
+    """Stateful wrapper with the torch-style API the reference returns
+    from initialize() (step/get_lr/state_dict/load_state_dict)."""
+
+    def __init__(self, schedule_fn, last_step=0):
+        self.schedule_fn = schedule_fn
+        self.last_batch_iteration = last_step
+
+    def step(self, last_batch_iteration=None):
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+
+    def get_lr(self):
+        return [float(self.schedule_fn(self.last_batch_iteration))]
+
+    def get_last_lr(self):
+        return self.get_lr()
+
+    def state_dict(self):
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd):
+        self.last_batch_iteration = sd["last_batch_iteration"]
+
+    # optax compatibility: the wrapper itself is a schedule callable.
+    def __call__(self, step):
+        return self.schedule_fn(step)

@@ -1,0 +1,22 @@
+"""Pipeline-parallel engine (reference: runtime/pipe/engine.py:351
+PipelineEngine.train_batch; schedule runtime/pipe/schedule.py).
+
+Round-1 scaffold: the schedule executor lands with the parallelism
+milestone (see runtime/pipe/schedule.py for the instruction stream);
+construction validates config so PipelineModule flows are exercised.
+"""
+
+from ..engine import DeepSpeedEngine
+from .module import PipelineModule
+
+
+class PipelineEngine(DeepSpeedEngine):
+
+    def __init__(self, model: PipelineModule, **kwargs):
+        if not isinstance(model, PipelineModule):
+            raise TypeError("PipelineEngine requires a PipelineModule")
+        self.pipeline_module = model
+        raise NotImplementedError(
+            "PipelineEngine schedule executor lands in the parallelism "
+            "milestone; use DeepSpeedEngine (ZeRO/TP/SP cover most TPU "
+            "topologies thanks to fast ICI)")

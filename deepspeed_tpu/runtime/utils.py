@@ -1,0 +1,132 @@
+"""Runtime math utilities (reference: deepspeed/runtime/utils.py —
+clip_grad_norm_ :317, CheckOverflow :183, partition_balanced :575,
+see_memory_usage :763, DummyOptim :41)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.memory import see_memory_usage  # noqa: F401  (re-export parity)
+
+
+class DummyOptim:
+    """Placeholder when the client manages its own optimizer
+    (reference: runtime/utils.py:41)."""
+
+    def __init__(self, params=None):
+        self.params = params
+
+
+def global_norm(tree, ord=2.0):
+    """L2 (or Lp / inf) norm over a pytree of gradients.
+
+    Under jit with sharded grads, XLA inserts the cross-shard psum for
+    the squared-sum automatically — the analog of the reference's
+    manual allreduce of local norms (runtime/utils.py:317).
+    """
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "dtype")]
+    if not leaves:
+        return jnp.float32(0.0)
+    if ord == float("inf"):
+        return jnp.max(jnp.stack([jnp.max(jnp.abs(x)).astype(jnp.float32)
+                                  for x in leaves]))
+    sq = sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    return jnp.sqrt(sq)
+
+
+def clip_grad_norm_(grads, max_norm, norm=None, eps=1e-6):
+    """Scale grads so global norm <= max_norm; returns (clipped, total_norm)
+    (reference: runtime/utils.py:317 clip_grad_norm_)."""
+    total_norm = global_norm(grads) if norm is None else norm
+    clip_coef = jnp.minimum(1.0, max_norm / (total_norm + eps))
+    clipped = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * clip_coef).astype(g.dtype), grads)
+    return clipped, total_norm
+
+
+def clip_gradients(grads, max_norm=1.0):
+    clipped, _ = clip_grad_norm_(grads, max_norm)
+    return clipped
+
+
+def partition_uniform(num_items, num_parts):
+    """Equal-count split boundaries (reference: utils.py partition_uniform)."""
+    parts = [0] * (num_parts + 1)
+    chunksize = num_items // num_parts
+    residual = num_items % num_parts
+    for p in range(num_parts):
+        parts[p + 1] = parts[p] + chunksize + (1 if p < residual else 0)
+    return parts
+
+
+def prefix_sum_inc(weights):
+    ps = [0]
+    for w in weights:
+        ps.append(ps[-1] + w)
+    return ps[1:]
+
+
+def partition_balanced(weights, num_parts):
+    """Split ``weights`` into ``num_parts`` contiguous chunks minimizing the
+    max chunk weight — binary search over the bottleneck value
+    (reference: runtime/utils.py:575 partition_balanced, used by
+    PipelineModule layer partitioning)."""
+    n = len(weights)
+    if num_parts >= n:
+        return list(range(n + 1)) + [n] * (num_parts - n)
+    prefix = [0]
+    for w in weights:
+        prefix.append(prefix[-1] + w)
+
+    def can_split(limit):
+        parts, last, count = [0], 0, 0
+        for i in range(1, n + 1):
+            if prefix[i] - prefix[last] > limit:
+                if i - 1 == last:
+                    return None  # single item exceeds limit
+                parts.append(i - 1)
+                last = i - 1
+                count += 1
+                if count >= num_parts:
+                    return None
+        parts.append(n)
+        while len(parts) < num_parts + 1:
+            parts.insert(-1, parts[-2])
+        return parts
+
+    lo = max(weights) if weights else 0
+    hi = sum(weights) or 1
+    best = can_split(hi)
+    while lo <= hi:
+        mid = (lo + hi) // 2
+        res = can_split(mid)
+        if res is not None:
+            best = res
+            hi = mid - 1
+        else:
+            lo = mid + 1
+    return best
+
+
+class CheckOverflow:
+    """Host-callable overflow check (reference: runtime/utils.py:183).
+    Inside the jitted step, use fp16.loss_scaler.has_inf_or_nan."""
+
+    def __init__(self, param_groups=None, mpu=None, zero_reduce_scatter=False,
+                 deepspeed=None):
+        ...
+
+    def check(self, grads):
+        from .fp16.loss_scaler import has_inf_or_nan
+        return bool(has_inf_or_nan(grads))
+
+
+def get_global_norm(norm_list):
+    return float(np.sqrt(sum(n**2 for n in norm_list)))
+
+
+def ensure_directory_exists(filename):
+    import os
+    dirname = os.path.dirname(filename)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)

@@ -1,0 +1,4 @@
+from .config import (DeepSpeedZeroConfig, DeepSpeedZeroOffloadOptimizerConfig,  # noqa: F401
+                     DeepSpeedZeroOffloadParamConfig, OffloadDeviceEnum)
+from .partition import (ZeroShardingRules, zero_param_sharding,  # noqa: F401
+                        zero_grad_sharding, zero_opt_sharding)

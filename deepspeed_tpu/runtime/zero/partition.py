@@ -1,0 +1,154 @@
+"""ZeRO partitioning as sharding rules.
+
+The reference implements ZeRO with explicit flat buffers, grad hooks and
+collective calls (runtime/zero/stage_1_and_2.py:96, stage3.py:75,
+partition_parameters.py:299).  On TPU the same *placement semantics* are
+expressed as sharding rules over the mesh's fsdp axis; the XLA SPMD
+partitioner then inserts exactly the reduce-scatter / all-gather pattern
+ZeRO executes by hand, and overlaps them with compute (the reference's
+``overlap_comm`` + prefetch machinery).
+
+Hybrid sharding falls out of the mesh shape: with both ``data`` and
+``fsdp`` axes > 1, states shard over fsdp and replicate over data — the
+semantics of MiCS (runtime/zero/mics.py:33) and ZeRO++ hpZ secondary
+partitions (partition_parameters.py:1123-1233).
+"""
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS
+from ...utils.logging import logger
+from .config import DeepSpeedZeroConfig
+
+
+def _mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    try:
+        return mesh.shape[axis]
+    except Exception:
+        return 1
+
+
+def _spec_get(spec: Optional[P], dim: int):
+    if spec is None or dim >= len(spec):
+        return None
+    return spec[dim]
+
+
+def shard_leaf_spec(shape, mesh: Mesh, axis_name: str, base_spec: Optional[P] = None,
+                    min_size: int = 0):
+    """Choose a PartitionSpec sharding one dim of ``shape`` over ``axis_name``.
+
+    Respects an existing (e.g. tensor-parallel) ``base_spec``: the fsdp
+    axis is added to the largest divisible dim not already sharded.
+    Leaves smaller than ``min_size`` elements stay as-is (the analog of
+    param_persistence_threshold, reference zero/config.py:218).
+    """
+    axis_size = _mesh_axis_size(mesh, axis_name)
+    if axis_size <= 1:
+        return base_spec or P()
+    n = int(np.prod(shape)) if len(shape) else 0
+    if n < max(min_size, axis_size) or len(shape) == 0:
+        return base_spec or P()
+    base = tuple(base_spec) if base_spec is not None else ()
+    base = base + (None,) * (len(shape) - len(base))
+    # Prefer the largest dim; tie-break toward dim 0 (param-major layout).
+    order = sorted(range(len(shape)), key=lambda d: (-shape[d], d))
+    for d in order:
+        cur = base[d]
+        if cur is not None:
+            continue
+        if shape[d] % axis_size == 0:
+            new = list(base)
+            new[d] = axis_name
+            return P(*new)
+    return P(*base)
+
+
+@dataclasses.dataclass
+class ZeroShardingRules:
+    """Produces shardings for params / grads / optimizer states given the
+    ZeRO stage (see module docstring for the stage table)."""
+
+    mesh: Mesh
+    stage: int = 0
+    param_persistence_threshold: int = 0
+    tensor_rules: Optional[Callable] = None  # (name, shape) -> PartitionSpec
+
+    def _base_spec(self, name, shape):
+        if self.tensor_rules is not None:
+            spec = self.tensor_rules(name, shape)
+            if spec is not None:
+                return spec
+        return P()
+
+    def param_spec(self, name, leaf) -> P:
+        shape = getattr(leaf, "shape", ())
+        base = self._base_spec(name, shape)
+        if self.stage >= 3:
+            return shard_leaf_spec(shape, self.mesh, FSDP_AXIS, base,
+                                   min_size=self.param_persistence_threshold)
+        return base
+
+    def opt_spec(self, name, leaf) -> P:
+        shape = getattr(leaf, "shape", ())
+        base = self._base_spec(name, shape)
+        if self.stage >= 1:
+            return shard_leaf_spec(shape, self.mesh, FSDP_AXIS, base)
+        return base
+
+    def grad_spec(self, name, leaf) -> P:
+        shape = getattr(leaf, "shape", ())
+        base = self._base_spec(name, shape)
+        if self.stage >= 2:
+            return shard_leaf_spec(shape, self.mesh, FSDP_AXIS, base)
+        return base
+
+    # ---- tree-level helpers ----
+    def _tree_shardings(self, tree, spec_fn):
+        from ...utils.tree import named_leaves
+        flat, treedef = jax.tree_util.tree_flatten(tree)
+        names = [n for n, _ in named_leaves(tree)]
+        shardings = [NamedSharding(self.mesh, spec_fn(n, l))
+                     for n, l in zip(names, flat)]
+        return jax.tree_util.tree_unflatten(treedef, shardings)
+
+    def param_shardings(self, params):
+        return self._tree_shardings(params, self.param_spec)
+
+    def grad_shardings(self, params):
+        return self._tree_shardings(params, self.grad_spec)
+
+    def opt_shardings(self, opt_state, params=None):
+        """Shard optimizer-state leaves that mirror a parameter; scalars
+        (step counts, loss-scale) stay replicated."""
+
+        def spec_fn(name, leaf):
+            shape = getattr(leaf, "shape", ())
+            if len(shape) == 0:
+                return P()
+            # State leaves mirror some param; shard like stage>=1 states.
+            return self.opt_spec(name, leaf)
+
+        return self._tree_shardings(opt_state, spec_fn)
+
+
+def zero_param_sharding(params, mesh, config: DeepSpeedZeroConfig, tensor_rules=None):
+    rules = ZeroShardingRules(mesh=mesh, stage=config.stage,
+                              param_persistence_threshold=config.param_persistence_threshold,
+                              tensor_rules=tensor_rules)
+    return rules.param_shardings(params)
+
+
+def zero_grad_sharding(params, mesh, config: DeepSpeedZeroConfig, tensor_rules=None):
+    rules = ZeroShardingRules(mesh=mesh, stage=config.stage, tensor_rules=tensor_rules)
+    return rules.grad_shardings(params)
+
+
+def zero_opt_sharding(opt_state, mesh, config: DeepSpeedZeroConfig, tensor_rules=None):
+    rules = ZeroShardingRules(mesh=mesh, stage=config.stage, tensor_rules=tensor_rules)
+    return rules.opt_shardings(opt_state)

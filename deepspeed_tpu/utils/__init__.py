@@ -1,0 +1,5 @@
+from .logging import logger, log_dist, print_rank_0, warning_once  # noqa: F401
+from .timer import SynchronizedWallClockTimer, ThroughputTimer  # noqa: F401
+from .tree import (flatten_with_names, named_leaves, tree_bytes,  # noqa: F401
+                   tree_dtype_cast, tree_zeros_like)
+from .memory import see_memory_usage  # noqa: F401

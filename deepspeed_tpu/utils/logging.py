@@ -1,0 +1,84 @@
+"""Rank-filtered logging (reference: deepspeed/utils/logging.py)."""
+
+import functools
+import logging
+import os
+import sys
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class LoggerFactory:
+
+    @staticmethod
+    def create_logger(name=None, level=logging.INFO):
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d:%(funcName)s] %(message)s")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+_level = log_levels.get(os.environ.get("DSTPU_LOG_LEVEL", "info").lower(), logging.INFO)
+logger = LoggerFactory.create_logger(name="DeepSpeedTPU", level=_level)
+
+
+@functools.lru_cache(None)
+def warning_once(*args, **kwargs):
+    """Emit a warning only once per unique message."""
+    logger.warning(*args, **kwargs)
+
+
+logger.warning_once = warning_once
+
+
+def _get_rank():
+    # Process index is 0 on a single host; multi-host via jax.distributed.
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log on listed process ranks only (reference: utils/logging.py log_dist)."""
+    should = ranks is None or ranks == [-1]
+    rank = _get_rank()
+    if not should:
+        should = rank in set(ranks)
+    if should:
+        final_message = "[Rank {}] {}".format(rank, message)
+        logger.log(level, final_message)
+
+
+def print_rank_0(message):
+    if _get_rank() == 0:
+        print(message)
+
+
+def get_current_level():
+    return logger.getEffectiveLevel()
+
+
+def should_log_le(max_log_level_str):
+    if not isinstance(max_log_level_str, str):
+        raise ValueError("max_log_level_str must be a string")
+    max_log_level_str = max_log_level_str.lower()
+    if max_log_level_str not in log_levels:
+        raise ValueError(f"{max_log_level_str} is not a valid log level")
+    return get_current_level() <= log_levels[max_log_level_str]

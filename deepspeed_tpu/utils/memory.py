@@ -1,0 +1,49 @@
+"""Device/host memory introspection (reference: runtime/utils.py:763 see_memory_usage)."""
+
+from .logging import logger
+
+
+def device_memory_stats(device=None):
+    try:
+        import jax
+        if device is None:
+            device = jax.devices()[0]
+        stats = device.memory_stats()
+        return stats or {}
+    except Exception:
+        return {}
+
+
+def host_memory_usage():
+    """Return (used_GB, percent, total_GB) of host RAM from /proc/meminfo."""
+    try:
+        info = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                parts = line.split(":")
+                if len(parts) == 2:
+                    info[parts[0].strip()] = parts[1].strip()
+
+        def _gb(key):
+            return float(info[key].split()[0]) / (1024**2)
+
+        total = _gb("MemTotal")
+        avail = _gb("MemAvailable")
+        used = total - avail
+        return used, (used / total * 100.0 if total else 0.0), total
+    except Exception:
+        return 0.0, 0.0, 0.0
+
+
+def see_memory_usage(message, force=False, ranks=None):
+    if not force:
+        return
+    stats = device_memory_stats()
+    ma = stats.get("bytes_in_use", 0) / (1024**3)
+    peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+    limit = stats.get("bytes_limit", 0) / (1024**3)
+    used, percent, _total = host_memory_usage()
+    logger.info(message)
+    logger.info(
+        f"DeviceMem InUse {ma:.2f} GB  Peak {peak:.2f} GB  Limit {limit:.2f} GB  "
+        f"| HostMem used {used:.2f} GB ({percent:.1f}%)")

@@ -1,0 +1,232 @@
+"""Wall-clock and throughput timers (reference: deepspeed/utils/timer.py:43,198).
+
+On TPU the device is asynchronous relative to the host; a timer that must
+reflect device time calls ``block_until_ready`` on a sentinel array before
+reading the host clock (the analog of the reference's device-event timers).
+"""
+
+import time
+
+from .logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _sync_device():
+    try:
+        import jax
+        # Blocks until all committed device work is complete.
+        (jax.device_put(0.0) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    """Group of named timers (reference: utils/timer.py:43)."""
+
+    class Timer:
+
+        def __init__(self, name):
+            self.name_ = name
+            self.started_ = False
+            self.elapsed_ = 0.0
+            self.start_time = 0.0
+            self.records = []
+
+        def start(self, sync=False):
+            assert not self.started_, f"{self.name_} timer has already been started"
+            if sync:
+                _sync_device()
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, reset=False, record=False, sync=False):
+            assert self.started_, "timer is not started"
+            if sync:
+                _sync_device()
+            elapsed = time.time() - self.start_time
+            if reset:
+                self.elapsed_ = elapsed
+            else:
+                self.elapsed_ += elapsed
+            if record:
+                self.records.append(self.elapsed_)
+            self.started_ = False
+
+        def reset(self):
+            self.started_ = False
+            self.elapsed_ = 0.0
+            self.records = []
+
+        def elapsed(self, reset=True):
+            started = self.started_
+            if started:
+                self.stop()
+            elapsed = self.elapsed_
+            if reset:
+                self.reset()
+            if started:
+                self.start()
+            return elapsed
+
+        def mean(self):
+            if not self.records:
+                return 0.0
+            return sum(self.records) / len(self.records)
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    def get_timers(self):
+        return self.timers
+
+    @staticmethod
+    def memory_usage():
+        from .memory import device_memory_stats
+        stats = device_memory_stats()
+        alloc = stats.get("bytes_in_use", 0) / (1024**3)
+        peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+        return f"Mem alloc {alloc:.2f} GB peak {peak:.2f} GB"
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += " | {}: {:.2f}".format(name, elapsed_time)
+        log_dist(string, ranks=ranks or [0])
+
+
+class NoopTimer:
+    """Disabled-timer stand-in so call sites stay unconditional."""
+
+    class Timer:
+
+        def start(self, **kwargs):
+            ...
+
+        def reset(self):
+            ...
+
+        def stop(self, **kwargs):
+            ...
+
+        def elapsed(self, **kwargs):
+            return 0
+
+        def mean(self):
+            return 0
+
+    def __init__(self):
+        self.timer = self.Timer()
+
+    def __call__(self, name):
+        return self.timer
+
+    def get_timers(self):
+        return {}
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        ...
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPS printer (reference: utils/timer.py:198)."""
+
+    def __init__(self, config, batch_size, start_step=2, steps_per_output=None,
+                 monitor_memory=False, logging_fn=None):
+        self.config = config
+        self.start_time = 0
+        self.end_time = 0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0
+        self.step_elapsed_time = 0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn
+        if self.logging is None:
+            from .logging import logger
+            self.logging = logger.info
+        self.initialized = False
+
+    @property
+    def enabled(self):
+        return getattr(self.config, "enabled", True)
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        if not self.enabled:
+            return
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _sync_device()
+            self.start_time = time.time()
+
+    def stop(self, global_step=False, report_speed=True):
+        if not self.enabled or not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _sync_device()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step:
+                if report_speed and self.steps_per_output and \
+                        self.global_step_count % self.steps_per_output == 0:
+                    self.logging(
+                        "epoch={}/micro_step={}/global_step={}, RunningAvgSamplesPerSec={:.6g}, "
+                        "CurrSamplesPerSec={:.6g}".format(
+                            self.epoch_count, self.micro_step_count, self.global_step_count,
+                            self.avg_samples_per_sec(),
+                            self.batch_size / self.step_elapsed_time))
+                self.step_elapsed_time = 0
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > self.start_step:
+            samples_per_step = self.batch_size
+            total_step_offset = self.global_step_count - self.start_step
+            avg_time_per_step = self.total_elapsed_time / max(total_step_offset, 1)
+            return samples_per_step / max(avg_time_per_step, 1e-12)
+        return float("-inf")
+
+
+def trim_mean(data, trim_percent):
+    """Mean excluding outliers at both ends (reference: utils/timer.py)."""
+    assert 0.0 <= trim_percent <= 1.0
+    n = len(data)
+    if n == 0:
+        return 0.0
+    data_ = sorted(data)
+    trim_count = int(trim_percent * n)
+    trimmed = data_[trim_count:n - trim_count] or data_
+    return sum(trimmed) / len(trimmed)

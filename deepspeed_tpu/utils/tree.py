@@ -1,0 +1,70 @@
+"""Pytree helpers used throughout the runtime.
+
+The reference flattens param groups into contiguous flat buffers
+(runtime/zero/stage_1_and_2.py:637); under XLA the pytree itself is the
+canonical container and flattening is only needed at the optimizer-kernel
+and checkpoint boundaries, so these helpers stay small.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def named_leaves(tree):
+    """Yield (dot.joined.path, leaf) pairs in a stable order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        yield _path_str(path), leaf
+
+
+def _path_str(path):
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return ".".join(parts)
+
+
+def flatten_with_names(tree):
+    """Return (names, leaves, treedef)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    names = [_path_str(p) for p, _ in flat]
+    leaves = [l for _, l in flat]
+    return names, leaves, treedef
+
+
+def tree_bytes(tree):
+    return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "dtype"))
+
+
+def tree_parameter_count(tree):
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "shape"))
+
+
+def tree_dtype_cast(tree, dtype, predicate=None):
+    """Cast floating leaves to ``dtype`` (predicate filters leaves)."""
+
+    def _cast(x):
+        if not hasattr(x, "dtype"):
+            return x
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        if predicate is not None and not predicate(x):
+            return x
+        return x.astype(dtype)
+
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+def tree_zeros_like(tree, dtype=None):
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros(x.shape, dtype or x.dtype), tree)

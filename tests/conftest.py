@@ -1,0 +1,51 @@
+"""Test harness: simulated 8-device CPU mesh.
+
+The reference exercises multi-rank logic by forking local processes
+(tests/unit/common.py:380 DistributedTest) or monkey-patching a fake
+process group (deepspeed/tools/pg_sim/pg.py).  The TPU-native analog is
+XLA's host-platform device multiplexing: one process, 8 virtual CPU
+devices, real collectives through the SPMD partitioner.
+"""
+
+import os
+
+# Must be set before jax backend init.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ["DS_ACCELERATOR"] = "cpu"
+
+import jax  # noqa: E402
+
+# The config update must come before any backend initialization; it also
+# overrides environments (like axon TPU tunnels) whose site hooks force
+# their own jax_platforms selection.
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    """Each test starts with a fresh (uninitialized) global mesh."""
+    from deepspeed_tpu.parallel.mesh import mesh_manager
+    mesh_manager.reset()
+    yield
+    mesh_manager.reset()
+
+
+@pytest.fixture
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def tiny_lm_batch(rng, batch=8, seq=16, vocab=256):
+    ids = rng.integers(0, vocab, size=(batch, seq), dtype=np.int32)
+    return {"input_ids": ids, "labels": ids.copy()}

@@ -1,0 +1,80 @@
+"""Config parsing + batch reconciliation (reference:
+tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+def test_basic_config():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 16,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.001}},
+        "fp16": {"enabled": False},
+        "zero_optimization": {"stage": 2},
+        "gradient_clipping": 1.0,
+    })
+    cfg.resolve_batch_sizes(dp_world_size=4)
+    assert cfg.train_batch_size == 16
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 1
+    assert cfg.zero_config.stage == 2
+    assert cfg.optimizer_config.type == "Adam"
+    assert cfg.gradient_clipping == 1.0
+
+
+def test_batch_reconciliation_two_given():
+    cfg = DeepSpeedConfig({"train_batch_size": 32,
+                           "train_micro_batch_size_per_gpu": 2})
+    cfg.resolve_batch_sizes(dp_world_size=4)
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_mismatch_raises():
+    cfg = DeepSpeedConfig({"train_batch_size": 10,
+                           "train_micro_batch_size_per_gpu": 2,
+                           "gradient_accumulation_steps": 2})
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_sizes(dp_world_size=4)
+
+
+def test_fp16_bf16_conflict():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"fp16": {"enabled": True}, "bf16": {"enabled": True}})
+
+
+def test_zero_deprecated_alias():
+    cfg = DeepSpeedConfig({"zero_optimization": {
+        "stage": 3, "stage3_max_live_parameters": 123}})
+    assert cfg.zero_config.max_live_parameters == 123
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 8,
+                             "bf16": {"enabled": True}}))
+    cfg = DeepSpeedConfig(str(p))
+    assert cfg.bf16_config.enabled
+    import jax.numpy as jnp
+    assert cfg.precision_dtype == jnp.bfloat16
+
+
+def test_duplicate_keys_rejected(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p))
+
+
+def test_mesh_section():
+    cfg = DeepSpeedConfig({"mesh": {"data": 2, "fsdp": 4}})
+    assert cfg.mesh_config.data == 2
+    assert cfg.mesh_config.fsdp == 4
+
+
+def test_scheduler_section():
+    cfg = DeepSpeedConfig({"scheduler": {"type": "WarmupLR", "params": {
+        "warmup_min_lr": 0, "warmup_max_lr": 0.001, "warmup_num_steps": 1000}}})
+    assert cfg.scheduler_config.type == "WarmupLR"

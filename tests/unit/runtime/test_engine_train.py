@@ -1,0 +1,140 @@
+"""End-to-end engine training on the simulated mesh — the "SimpleModel"
+loss-goes-down tests (reference pattern: tests/unit/simple_model.py +
+tests/unit/runtime/test_ds_initialize.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+
+def _batch(rng, n=16, seq=16, vocab=256):
+    ids = rng.integers(0, vocab, size=(n, seq), dtype=np.int32)
+    return {"input_ids": ids, "labels": ids.copy()}
+
+
+def _make_engine(config_overrides=None, **kw):
+    cfg = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }
+    cfg.update(config_overrides or {})
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, **kw)
+    return engine
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_train_loss_decreases(stage, rng, eight_devices):
+    engine = _make_engine({"zero_optimization": {"stage": stage}})
+    losses = []
+    batch = _batch(rng)  # overfit one batch
+    for _ in range(10):
+        loss = engine.train_batch(batch=batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+    assert engine.global_steps == 10
+
+
+def test_zero_stages_match_replicated(rng, eight_devices):
+    """ZeRO sharding must not change the math: stage 0 vs stage 3 losses
+    must track step-for-step (reference invariant:
+    tests/unit/runtime/zero/test_zero.py loss parity)."""
+    batch = _batch(rng)
+    losses = {}
+    for stage in (0, 3):
+        from deepspeed_tpu.parallel.mesh import mesh_manager
+        mesh_manager.reset()
+        engine = _make_engine({"zero_optimization": {"stage": stage}},
+                              rng=jax.random.PRNGKey(7))
+        losses[stage] = [float(engine.train_batch(batch=batch)) for _ in range(5)]
+    np.testing.assert_allclose(losses[0], losses[3], rtol=2e-3)
+
+
+def test_bf16_training(rng, eight_devices):
+    engine = _make_engine({"bf16": {"enabled": True},
+                           "zero_optimization": {"stage": 2}})
+    batch = _batch(rng)
+    l0 = float(engine.train_batch(batch=batch))
+    for _ in range(8):
+        l = float(engine.train_batch(batch=batch))
+    assert l < l0
+
+
+def test_fp16_dynamic_loss_scale(rng, eight_devices):
+    engine = _make_engine({"fp16": {"enabled": True, "initial_scale_power": 8}})
+    batch = _batch(rng)
+    for _ in range(3):
+        engine.train_batch(batch=batch)
+    assert engine.loss_scale > 0
+
+
+def test_forward_backward_step_parity(rng, eight_devices):
+    """Eager triple must produce the same optimization trajectory as
+    train_batch."""
+    batch = _batch(rng)
+    from deepspeed_tpu.parallel.mesh import mesh_manager
+
+    engine_a = _make_engine(rng=jax.random.PRNGKey(3))
+    la = [float(engine_a.train_batch(batch=batch)) for _ in range(3)]
+
+    mesh_manager.reset()
+    engine_b = _make_engine(rng=jax.random.PRNGKey(3))
+    lb = []
+    gas = engine_b.gradient_accumulation_steps()
+    micro = {k: v.reshape(gas, -1, *v.shape[1:]) for k, v in batch.items()}
+    for _ in range(3):
+        step_losses = []
+        for g in range(gas):
+            mb = {k: v[g] for k, v in micro.items()}
+            loss = engine_b.backward(batch=mb)
+            step_losses.append(float(loss))
+        engine_b.step()
+        lb.append(sum(step_losses) / len(step_losses))
+    np.testing.assert_allclose(la, lb, rtol=1e-4)
+
+
+def test_lr_schedule_integration(rng, eight_devices):
+    engine = _make_engine({"scheduler": {"type": "WarmupLR", "params": {
+        "warmup_min_lr": 0.0, "warmup_max_lr": 1e-3, "warmup_num_steps": 100,
+        "warmup_type": "linear"}}})
+    batch = _batch(rng)
+    engine.train_batch(batch=batch)
+    lr1 = engine.get_lr()[0]
+    engine.train_batch(batch=batch)
+    lr2 = engine.get_lr()[0]
+    assert lr2 > lr1
+
+
+def test_eval_batch(rng, eight_devices):
+    engine = _make_engine()
+    batch = _batch(rng)
+    loss = engine.eval_batch(batch=batch)
+    assert np.isfinite(float(loss))
+
+
+def test_checkpoint_roundtrip(tmp_path, rng, eight_devices):
+    """Save/load round trip (reference: tests/unit/checkpoint/)."""
+    from deepspeed_tpu.parallel.mesh import mesh_manager
+    batch = _batch(rng)
+    engine = _make_engine(rng=jax.random.PRNGKey(5))
+    for _ in range(3):
+        engine.train_batch(batch=batch)
+    loss_before = float(engine.train_batch(batch=batch))
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+
+    mesh_manager.reset()
+    engine2 = _make_engine(rng=jax.random.PRNGKey(99))
+    engine2.train_batch(batch=batch)  # init params differently
+    engine2.load_checkpoint(str(tmp_path), tag="t1")
+    assert engine2.global_steps == 4
+    # params identical -> same next loss
+    mesh_manager_loss = float(engine2.train_batch(batch=batch))
+    engine_loss = float(engine.train_batch(batch=batch))
+    np.testing.assert_allclose(mesh_manager_loss, engine_loss, rtol=1e-5)

@@ -1,0 +1,68 @@
+"""LR schedule math (reference: tests/unit/runtime/test_lr_schedulers.py)."""
+
+import math
+
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (LRScheduler, get_lr_schedule,
+                                                one_cycle, warmup_cosine_lr,
+                                                warmup_decay_lr, warmup_lr)
+
+
+def test_warmup_lr():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10,
+                  warmup_type="linear")
+    assert s(0) == 0.0
+    assert abs(s(5) - 0.05) < 1e-9
+    assert s(10) == 0.1
+    assert s(100) == 0.1
+
+
+def test_warmup_log_rate():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=100,
+                  warmup_type="log")
+    assert s(0) == 0.0
+    assert s(50) < 0.1
+    assert s(100) == 0.1
+
+
+def test_warmup_decay():
+    s = warmup_decay_lr(total_num_steps=100, warmup_max_lr=0.1,
+                        warmup_num_steps=10, warmup_type="linear")
+    assert abs(s(10) - 0.1) < 1e-9
+    assert abs(s(100)) < 1e-9
+    assert s(55) == pytest.approx(0.05)
+
+
+def test_warmup_cosine():
+    s = warmup_cosine_lr(total_num_steps=100, warmup_num_steps=10, base_lr=1.0,
+                         cos_min_ratio=0.0)
+    assert s(10) == pytest.approx(1.0)
+    assert s(100) == pytest.approx(0.0, abs=1e-6)
+    assert s(55) == pytest.approx(0.5, abs=0.01)
+
+
+def test_one_cycle():
+    s = one_cycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10)
+    assert s(0) == pytest.approx(0.01)
+    assert s(10) == pytest.approx(0.1)
+    assert s(20) == pytest.approx(0.01)
+
+
+def test_scheduler_object_api():
+    sched = LRScheduler(get_lr_schedule("WarmupLR", {
+        "warmup_min_lr": 0, "warmup_max_lr": 0.1, "warmup_num_steps": 10,
+        "warmup_type": "linear"}))
+    for _ in range(5):
+        sched.step()
+    assert sched.get_lr()[0] == pytest.approx(0.05)
+    sd = sched.state_dict()
+    sched2 = LRScheduler(get_lr_schedule("WarmupLR", {
+        "warmup_max_lr": 0.1, "warmup_num_steps": 10, "warmup_type": "linear"}))
+    sched2.load_state_dict(sd)
+    assert sched2.get_lr() == sched.get_lr()
+
+
+def test_unknown_schedule():
+    with pytest.raises(ValueError):
+        get_lr_schedule("Nope", {})

@@ -1,0 +1,67 @@
+"""ZeRO sharding-rule unit tests (reference:
+tests/unit/runtime/zero/test_zero.py partitioning assertions)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
+from deepspeed_tpu.runtime.zero.partition import (ZeroShardingRules,
+                                                  shard_leaf_spec)
+
+
+@pytest.fixture
+def mesh(eight_devices):
+    return mesh_manager.init(MeshConfig(data=1, fsdp=8))
+
+
+def test_shard_leaf_spec_picks_divisible_dim(mesh):
+    spec = shard_leaf_spec((16, 24), mesh, "fsdp")
+    assert spec == P(None, "fsdp")
+    spec = shard_leaf_spec((64, 24), mesh, "fsdp")
+    assert spec == P("fsdp", None)
+
+
+def test_shard_leaf_spec_small_stays_replicated(mesh):
+    spec = shard_leaf_spec((4,), mesh, "fsdp")
+    assert spec == P()
+    spec = shard_leaf_spec((64,), mesh, "fsdp", min_size=1000)
+    assert spec == P()
+
+
+def test_shard_respects_base_spec(mesh):
+    base = P(None, "tensor")
+    spec = shard_leaf_spec((64, 32), mesh, "fsdp", base_spec=base)
+    assert spec == P("fsdp", "tensor")
+
+
+def test_stage_semantics(mesh):
+    params = {"w": jnp.zeros((64, 64)), "b": jnp.zeros((64,))}
+
+    r0 = ZeroShardingRules(mesh=mesh, stage=0)
+    assert r0.param_spec("w", params["w"]) == P()
+    assert r0.opt_spec("w", params["w"]) == P()
+    assert r0.grad_spec("w", params["w"]) == P()
+
+    r1 = ZeroShardingRules(mesh=mesh, stage=1)
+    assert r1.param_spec("w", params["w"]) == P()
+    assert r1.opt_spec("w", params["w"]) == P("fsdp", None)
+    assert r1.grad_spec("w", params["w"]) == P()
+
+    r2 = ZeroShardingRules(mesh=mesh, stage=2)
+    assert r2.grad_spec("w", params["w"]) == P("fsdp", None)
+    assert r2.param_spec("w", params["w"]) == P()
+
+    r3 = ZeroShardingRules(mesh=mesh, stage=3)
+    assert r3.param_spec("w", params["w"]) == P("fsdp", None)
+
+
+def test_persistence_threshold(mesh):
+    r3 = ZeroShardingRules(mesh=mesh, stage=3, param_persistence_threshold=10_000)
+    small = jnp.zeros((64,))
+    big = jnp.zeros((256, 256))
+    assert r3.param_spec("s", small) == P()
+    assert r3.param_spec("b", big) == P("fsdp", None)
+    # optimizer states shard regardless of persistence threshold
+    assert r3.opt_spec("s", small) == P("fsdp")

@@ -1,0 +1,38 @@
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel.mesh import (MESH_AXES, MeshConfig, build_mesh,
+                                         mesh_manager)
+
+
+def test_mesh_config_resolution():
+    cfg = MeshConfig(data=-1).resolved(8)
+    assert cfg.data == 8
+    assert cfg.shape == (1, 8, 1, 1, 1, 1)
+
+    cfg = MeshConfig(data=2, fsdp=-1).resolved(8)
+    assert cfg.fsdp == 4
+
+    with pytest.raises(ValueError):
+        MeshConfig(data=3).resolved(8)
+    with pytest.raises(ValueError):
+        MeshConfig(data=-1, fsdp=-1).resolved(8)
+
+
+def test_build_mesh_axes(eight_devices):
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    assert mesh.axis_names == MESH_AXES
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["fsdp"] == 2
+    assert mesh.shape["tensor"] == 2
+    assert mesh.size == 8
+
+
+def test_mesh_manager_queries(eight_devices):
+    mesh_manager.init(MeshConfig(data=2, fsdp=4))
+    assert mesh_manager.world_size() == 8
+    assert mesh_manager.data_parallel_world_size() == 8  # data * fsdp
+    assert mesh_manager.model_parallel_world_size() == 1
+    sh = mesh_manager.sharding("data")
+    assert sh.mesh.size == 8
