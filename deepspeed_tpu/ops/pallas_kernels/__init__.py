@@ -1,0 +1,17 @@
+"""Pallas TPU kernels — the native-kernel layer.
+
+The reference ships CUDA kernels for its hot ops (csrc/transformer/*,
+csrc/adam/multi_tensor_adam.cu, inference kernels under
+deepspeed/inference/v2/kernels/**).  Here the hot ops are Pallas TPU
+kernels; everything XLA already fuses well (bias-add, gelu, residual,
+dropout, rope) stays in jnp by design — see each module's docstring.
+
+Every public op dispatches: TPU backend -> Pallas kernel; other
+backends -> numerically-identical jnp reference (also used by the unit
+tests, mirroring the reference's kernel-vs-torch tests,
+tests/unit/ops/adam/test_cpu_adam.py:34-43).
+"""
+
+from .flash_attention import flash_attention, mha_reference  # noqa: F401
+from .rms_norm import rms_norm, rms_norm_reference  # noqa: F401
+from .rope import apply_rotary_pos_emb, rope_cos_sin  # noqa: F401

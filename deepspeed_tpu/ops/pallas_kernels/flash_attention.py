@@ -1,0 +1,361 @@
+"""Flash attention — fused causal attention Pallas kernel (fwd + bwd).
+
+TPU-native replacement for the reference's fused attention kernels
+(csrc/transformer/inference/csrc/softmax.cu + the blocked_flash kernels
+under deepspeed/inference/v2/kernels/ragged_ops/ and the CUTLASS
+evoformer attention csrc/deepspeed4science/evoformer_attn).
+
+Design (TPU-first):
+- online-softmax streaming over key blocks; fp32 accumulators in VMEM;
+  the (BQ, D) @ (D, BK) score matmul and the (BQ, BK) @ (BK, D) value
+  matmul both land on the MXU.
+- grid = (batch, heads, q_blocks); K/V for one (batch, head) live in
+  VMEM and are walked in BK-sized slices with ``pl.ds`` — for
+  long-context the sequence axis is sharded first (ring attention /
+  Ulysses, deepspeed_tpu/sequence/), so per-chip T stays VMEM-friendly.
+- causal is bottom-right aligned (query i attends keys <= i + Tk - Tq,
+  the kv-cache decode convention) and skips whole key blocks past the
+  diagonal.
+- backward = two kernels (dq; dk+dv) recomputing scores from the saved
+  logsumexp, the standard flash-attention-2 scheme.
+- GQA: kv heads are indexed via ``h // rep`` in the BlockSpec index
+  maps — K/V are never materialized at query-head width. dk/dv are
+  accumulated across each query-head group with the head axis innermost
+  in the grid so output-block revisits are consecutive.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+_NEG_INF = float("-inf")
+
+
+def mha_reference(q, k, v, causal=True, sm_scale=None):
+    """jnp reference attention. q:[B,Tq,Hq,D] k,v:[B,Tk,Hkv,D] -> [B,Tq,Hq,D].
+
+    Supports GQA (Hq a multiple of Hkv). Causal is bottom-right aligned.
+    Softmax in fp32.
+    """
+    B, Tq, Hq, D = q.shape
+    _, Tk, Hkv, _ = k.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    if Hq != Hkv:
+        rep = Hq // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool), k=Tk - Tq)
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def _causal_mask(s, q_start, k_start, offset, block_q, block_k):
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos + offset >= k_pos, s, _NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                sm_scale, causal, block_k, kv_len, offset):
+    qi = pl.program_id(2)
+    block_q = q_ref.shape[2]
+    d = q_ref.shape[3]
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [BQ, D]
+
+    num_k_blocks = kv_len // block_k
+    if causal:
+        # keys visible to the last query row of this block
+        last_k = (qi + 1) * block_q - 1 + offset
+        num_k_blocks = jnp.clip(last_k // block_k + 1, 0, num_k_blocks)
+
+    def body(ki, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [BQ, BK]
+        if causal:
+            s = _causal_mask(s, qi * block_q, ki * block_k, offset,
+                             block_q, block_k)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # m_new is -inf only for fully-masked rows; guard the exp shift
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - shift[:, None])
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev, _NEG_INF) - shift)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
+
+    l_safe = jnp.where(l > 0, l, 1.0)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # logsumexp of the scaled scores, used by the backward kernels
+    lse = jnp.where(l > 0, m + jnp.log(l_safe), _NEG_INF)
+    lse_ref[0, 0] = lse.astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    # layout q:[B,Hq,Tq,D]  k,v:[B,Hkv,Tk,D]
+    B, Hq, Tq, D = q.shape
+    Hkv, Tk = k.shape[1], k.shape[2]
+    rep = Hq // Hkv
+    offset = Tk - Tq
+    grid = (B, Hq, Tq // block_q)
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_k=block_k, kv_len=Tk, offset=offset)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h // rep, 0, 0)),
+            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h // rep, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, Tq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   sm_scale, causal, block_k, kv_len, offset):
+    qi = pl.program_id(2)
+    block_q = q_ref.shape[2]
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+
+    num_k_blocks = kv_len // block_k
+    if causal:
+        last_k = (qi + 1) * block_q - 1 + offset
+        num_k_blocks = jnp.clip(last_k // block_k + 1, 0, num_k_blocks)
+
+    def body(ki, dq):
+        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi * block_q, ki * block_k, offset,
+                             block_q, block_k)
+        lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+        p = jnp.exp(s - lse_safe[:, None])
+        p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dq = dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dq
+
+    dq0 = jnp.zeros((block_q, q_ref.shape[3]), jnp.float32)
+    dq = jax.lax.fori_loop(0, num_k_blocks, body, dq0)
+    dq_ref[0, 0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sm_scale, causal, block_q, q_len,
+                    offset, rep):
+    # grid = (B, k_blocks, Hq): head axis innermost so the dk/dv output
+    # blocks for one kv head are revisited consecutively while the
+    # query-head group accumulates into them.
+    ki = pl.program_id(1)
+    h = pl.program_id(2)
+    block_k = k_ref.shape[2]
+    k_blk = k_ref[0, 0].astype(jnp.float32)
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+
+    num_q_blocks = q_len // block_q
+    if causal:
+        first_q = jnp.maximum(ki * block_k - offset, 0)
+        first_q_block = first_q // block_q
+    else:
+        first_q_block = 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) \
+            * sm_scale
+        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [BQ,BK]
+        if causal:
+            s = _causal_mask(s, qi * block_q, ki * block_k, offset,
+                             block_q, block_k)
+        lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+        p = jnp.exp(s - lse_safe[:, None])
+        p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    d = k_ref.shape[3]
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first_q_block, num_q_blocks, body, (dk0, dv0))
+
+    @pl.when(h % rep == 0)
+    def _init():
+        dk_ref[0, 0] = dk
+        dv_ref[0, 0] = dv
+
+    @pl.when(h % rep != 0)
+    def _accum():
+        dk_ref[0, 0] += dk
+        dv_ref[0, 0] += dv
+
+
+def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
+    q, k, v, out, lse = res
+    B, Hq, Tq, D = q.shape
+    Hkv, Tk = k.shape[1], k.shape[2]
+    rep = Hq // Hkv
+    offset = Tk - Tq
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # [B,Hq,Tq]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_k=block_k, kv_len=Tk, offset=offset),
+        grid=(B, Hq, Tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h // rep, 0, 0)),
+            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h // rep, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv accumulate over the query-head group in fp32; cast at the end.
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, q_len=Tq, offset=offset, rep=rep),
+        grid=(B, Tk // block_k, Hq),
+        in_specs=[
+            pl.BlockSpec((1, 1, Tq, D), lambda b, i, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, i, h: (b, h // rep, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, i, h: (b, h // rep, i, 0)),
+            pl.BlockSpec((1, 1, Tq, D), lambda b, i, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tq), lambda b, i, h: (b, h, 0)),
+            pl.BlockSpec((1, 1, Tq), lambda b, i, h: (b, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D), lambda b, i, h: (b, h // rep, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, i, h: (b, h // rep, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v.shape, jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bhtd(q, k, v, sm_scale, causal, block_q, block_k,
+                          interpret):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _bwd_rule(sm_scale, causal, block_q, block_k, interpret, res, g):
+    return _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret)
+
+
+_flash_attention_bhtd.defvjp(_fwd_rule, _bwd_rule)
+
+
+def _use_pallas():
+    return jax.default_backend() in ("tpu",)
+
+
+def flash_attention(q, k, v, causal=True, sm_scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    force_pallas=False, interpret=False):
+    """Fused attention. q:[B,Tq,Hq,D], k,v:[B,Tk,Hkv,D] -> [B,Tq,Hq,D].
+
+    On TPU lowers to the Pallas flash kernel; elsewhere (or for shapes
+    the kernel doesn't tile) falls back to the fused-by-XLA jnp
+    reference. ``force_pallas=True`` raises instead of falling back.
+    ``interpret=True`` runs the kernel in interpreter mode (CPU test
+    path).
+    """
+    B, Tq, Hq, D = q.shape
+    _, Tk, Hkv, _ = k.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    # MXU/VPU lane alignment: blocks and head dim in multiples of 128
+    tileable = (Tq % block_q == 0 and Tk % block_k == 0 and Hq % Hkv == 0
+                and D % 128 == 0 and block_q % 128 == 0 and block_k % 128 == 0)
+    if not tileable:
+        if force_pallas:
+            raise ValueError(
+                f"flash_attention kernel cannot tile Tq={Tq}, Tk={Tk}, "
+                f"Hq={Hq}, Hkv={Hkv} with block_q={block_q}, block_k={block_k}")
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    if not (force_pallas or interpret or _use_pallas()):
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+
+    # kernel layout [B, H, T, D]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash_attention_bhtd(qt, kt, vt, float(sm_scale), bool(causal),
+                                int(block_q), int(block_k), bool(interpret))
+    return out.transpose(0, 2, 1, 3)

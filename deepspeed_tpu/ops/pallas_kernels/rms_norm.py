@@ -1,0 +1,118 @@
+"""Fused RMSNorm Pallas kernel (fwd + bwd).
+
+TPU-native analog of the reference's rms_norm CUDA kernel
+(csrc/transformer/inference/csrc/rms_norm.cu behind
+ops/transformer/inference/op_binding/rms_norm.py): one VMEM pass
+computes the fp32 row rms and the normalized, weighted output.
+
+Backward recomputes the rms from the saved input (cheaper than saving
+it) and emits per-row-block partial weight grads that the wrapper sums —
+the TPU version of the reference kernel's cross-block atomics.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_BLOCK_ROWS = 512
+
+
+def rms_norm_reference(x, weight, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def _fwd_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    o_ref[:] = (x * jax.lax.rsqrt(var + eps) * w[None, :]).astype(o_ref.dtype)
+
+
+def _bwd_kernel(x_ref, w_ref, dy_ref, dx_ref, dwp_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    d = x.shape[-1]
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    r = jax.lax.rsqrt(var + eps)
+    xhat = x * r
+    dxhat = dy * w[None, :]
+    # dx = r * (dxhat - xhat * mean(dxhat * xhat))
+    dx = r * (dxhat - xhat * (jnp.sum(dxhat * xhat, axis=-1, keepdims=True) / d))
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+    dwp_ref[0, :] = jnp.sum(dy * xhat, axis=0)
+
+
+def _rows_view(x):
+    d = x.shape[-1]
+    return x.reshape(-1, d)
+
+
+def _row_block(n):
+    """Largest divisor of n that is <= _BLOCK_ROWS (keeps one block's
+    fp32 input + output well inside VMEM for any row count)."""
+    block = min(_BLOCK_ROWS, n)
+    while n % block:
+        block -= 1
+    return block
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms_norm_2d(x, w, eps, interpret):
+    return _fwd(x, w, eps, interpret)
+
+
+def _fwd(x, w, eps, interpret):
+    n, d = x.shape
+    block = _row_block(n)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=(n // block,),
+        in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
+                  pl.BlockSpec((d,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        interpret=interpret,
+    )(x, w)
+
+
+def _fwd_rule(x, w, eps, interpret):
+    return _fwd(x, w, eps, interpret), (x, w)
+
+
+def _bwd_rule(eps, interpret, res, dy):
+    x, w = res
+    n, d = x.shape
+    block = _row_block(n)
+    nblocks = n // block
+    dx, dw_partial = pl.pallas_call(
+        functools.partial(_bwd_kernel, eps=eps),
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
+                  pl.BlockSpec((d,), lambda i: (0,)),
+                  pl.BlockSpec((block, d), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
+                   pl.BlockSpec((1, d), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n, d), x.dtype),
+                   jax.ShapeDtypeStruct((nblocks, d), jnp.float32)],
+        interpret=interpret,
+    )(x, w, dy)
+    return dx, jnp.sum(dw_partial, axis=0).astype(w.dtype)
+
+
+_rms_norm_2d.defvjp(_fwd_rule, _bwd_rule)
+
+
+def rms_norm(x, weight, eps=1e-6, force_pallas=False, interpret=False):
+    """RMSNorm over the last dim. Any leading shape; weight: [D]."""
+    use_kernel = force_pallas or interpret or jax.default_backend() == "tpu"
+    if not use_kernel:
+        return rms_norm_reference(x, weight, eps)
+    shape = x.shape
+    out = _rms_norm_2d(_rows_view(x), weight, float(eps), bool(interpret))
+    return out.reshape(shape)

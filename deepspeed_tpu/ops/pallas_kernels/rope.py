@@ -1,0 +1,35 @@
+"""Rotary position embeddings.
+
+The reference implements rope as a CUDA kernel
+(csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu behind
+ops/transformer/inference/op_binding/*). On TPU a standalone rope kernel
+is a pessimization: rope is a cheap elementwise op that XLA fuses
+directly into the surrounding QK matmuls, so the idiomatic
+implementation is plain jnp — kept in the kernels package because it IS
+the kernel-layer op, just compiler-fused instead of hand-scheduled.
+"""
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(positions, head_dim, theta=10000.0, dtype=jnp.float32):
+    """cos/sin tables for ``positions`` (any shape) -> [..., head_dim//2]."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rotary_pos_emb(x, cos, sin):
+    """Rotate pairs (HF Llama convention: split halves).
+
+    x: [..., T, H, D]; cos/sin: [T, D/2] or broadcastable [..., T, 1, D/2].
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    if cos.ndim == 2:  # [T, half] -> align T, broadcast the head axis
+        cos = cos[:, None, :]
+        sin = sin[:, None, :]
+    cos = cos.astype(x.dtype)
+    sin = sin.astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)

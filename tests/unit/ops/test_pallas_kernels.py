@@ -1,0 +1,180 @@
+"""Kernel-vs-reference numeric tests (reference pattern:
+tests/unit/ops/adam/test_cpu_adam.py _compare_optimizers).
+
+Pallas kernels run in interpreter mode on the CPU test mesh; numerics
+must match the jnp reference to fp32 tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.pallas_kernels import (apply_rotary_pos_emb,
+                                              flash_attention, mha_reference,
+                                              rms_norm, rms_norm_reference,
+                                              rope_cos_sin)
+
+
+class TestFlashAttention:
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_matches_reference(self, causal):
+        rng = np.random.default_rng(0)
+        B, T, H, D = 2, 256, 2, 128
+        q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        out = flash_attention(q, k, v, causal=causal, interpret=True,
+                              block_q=128, block_k=128)
+        ref = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gqa_forward(self):
+        rng = np.random.default_rng(1)
+        B, T, Hq, Hkv, D = 1, 256, 4, 2, 128
+        q = jnp.asarray(rng.standard_normal((B, T, Hq, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), jnp.float32)
+        out = flash_attention(q, k, v, causal=True, interpret=True,
+                              block_q=128, block_k=128)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_gradients_match_reference(self, causal):
+        rng = np.random.default_rng(2)
+        B, T, H, D = 1, 256, 2, 128
+        q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+
+        def loss_kernel(q, k, v):
+            o = flash_attention(q, k, v, causal=causal, interpret=True,
+                                block_q=128, block_k=128)
+            return jnp.sum(o * o)
+
+        def loss_ref(q, k, v):
+            o = mha_reference(q, k, v, causal=causal)
+            return jnp.sum(o * o)
+
+        g_kernel = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for gk, gr, name in zip(g_kernel, g_ref, "qkv"):
+            np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
+                                       atol=5e-4, rtol=5e-4,
+                                       err_msg=f"d{name} mismatch")
+
+    def test_fallback_on_untiled_shapes(self):
+        # odd T -> jnp reference path, still correct
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.standard_normal((1, 37, 2, 16)), jnp.float32)
+        out = flash_attention(q, q, q, causal=True)
+        ref = mha_reference(q, q, q, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_force_pallas_raises_on_untiled(self):
+        q = jnp.zeros((1, 37, 2, 16), jnp.float32)
+        with pytest.raises(ValueError, match="cannot tile"):
+            flash_attention(q, q, q, force_pallas=True)
+
+    def test_causal_decode_alignment(self):
+        # Tq != Tk with causal: bottom-right aligned (kv-cache decode)
+        rng = np.random.default_rng(4)
+        B, Tq, Tk, H, D = 1, 128, 384, 2, 128
+        q = jnp.asarray(rng.standard_normal((B, Tq, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, Tk, H, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, Tk, H, D)), jnp.float32)
+        out = flash_attention(q, k, v, causal=True, interpret=True,
+                              block_q=128, block_k=128)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gqa_gradients(self):
+        rng = np.random.default_rng(5)
+        B, T, Hq, Hkv, D = 1, 256, 4, 2, 128
+        q = jnp.asarray(rng.standard_normal((B, T, Hq, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), jnp.float32)
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(
+                fn(q, k, v) ** 2)
+
+        g_kernel = jax.grad(
+            loss(lambda q, k, v: flash_attention(
+                q, k, v, causal=True, interpret=True,
+                block_q=128, block_k=128)), argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(
+            loss(lambda q, k, v: mha_reference(q, k, v, causal=True)),
+            argnums=(0, 1, 2))(q, k, v)
+        for gk, gr, name in zip(g_kernel, g_ref, "qkv"):
+            np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
+                                       atol=5e-4, rtol=5e-4,
+                                       err_msg=f"d{name} mismatch")
+
+
+class TestRMSNorm:
+
+    def test_forward(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((4, 64, 256)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((256,)), jnp.float32)
+        out = rms_norm(x, w, interpret=True)
+        ref = rms_norm_reference(x, w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_gradients(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((8, 128)), jnp.float32)
+        w = jnp.asarray(1.0 + 0.1 * rng.standard_normal((128,)), jnp.float32)
+
+        gk = jax.grad(lambda x, w: jnp.sum(rms_norm(x, w, interpret=True) ** 2),
+                      argnums=(0, 1))(x, w)
+        gr = jax.grad(lambda x, w: jnp.sum(rms_norm_reference(x, w) ** 2),
+                      argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(gk[0]), np.asarray(gr[0]),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(gk[1]), np.asarray(gr[1]),
+                                   atol=1e-4, rtol=1e-4)
+
+
+class TestRope:
+
+    def test_rotation_preserves_norm(self):
+        rng = np.random.default_rng(0)
+        T, H, D = 16, 2, 8
+        x = jnp.asarray(rng.standard_normal((1, T, H, D)), jnp.float32)
+        cos, sin = rope_cos_sin(jnp.arange(T), D)
+        y = apply_rotary_pos_emb(x, cos, sin)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(x), axis=-1),
+            np.linalg.norm(np.asarray(y), axis=-1), atol=1e-5, rtol=1e-5)
+
+    def test_position_zero_is_identity(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((1, 1, 2, 8)), jnp.float32)
+        cos, sin = rope_cos_sin(jnp.zeros((1,)), 8)
+        y = apply_rotary_pos_emb(x, cos, sin)
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6)
+
+    def test_relative_property(self):
+        # <rope(q,m), rope(k,n)> depends only on m-n
+        rng = np.random.default_rng(2)
+        D = 16
+        q = jnp.asarray(rng.standard_normal((1, 1, 1, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 1, 1, D)), jnp.float32)
+
+        def dot_at(m, n):
+            cq, sq = rope_cos_sin(jnp.array([m], jnp.float32), D)
+            ck, sk = rope_cos_sin(jnp.array([n], jnp.float32), D)
+            qr = apply_rotary_pos_emb(q, cq, sq)
+            kr = apply_rotary_pos_emb(k, ck, sk)
+            return float(jnp.sum(qr * kr))
+
+        assert abs(dot_at(5, 3) - dot_at(12, 10)) < 1e-4
