@@ -1,0 +1,291 @@
+"""Llama / Llama-2 model family in flax — the flagship (BASELINE configs 3-5).
+
+TPU-native model zoo entry. The reference has no training model zoo; its
+inference stack ships Llama via kernel-injection policies
+(deepspeed/module_inject/containers/llama.py, inference v2
+model_implementations/llama_v2/model.py). Here the model is a flax
+module built on the Pallas kernel layer: flash attention
+(ops/pallas_kernels/flash_attention.py), fused RMSNorm, and
+XLA-fused RoPE.
+
+Weight layout follows HF ``LlamaForCausalLM`` so checkpoints convert 1:1
+(``from_hf_state_dict``, the analog of the reference's checkpoint-
+injection loaders module_inject/load_checkpoint.py).
+
+Decode path: ``__call__`` accepts a ``cache`` (see ``init_cache``) and
+``cache_index``; prefill/training uses the flash kernel, single-token
+decode uses an XLA-fused masked attention over the cache.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.pallas_kernels import (apply_rotary_pos_emb, flash_attention,
+                                  rope_cos_sin)
+from ..parallel.mesh import TENSOR_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    use_remat: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def llama2_7b():
+        return LlamaConfig()
+
+    @staticmethod
+    def llama2_13b():
+        return LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                           num_hidden_layers=40, num_attention_heads=40,
+                           num_key_value_heads=40)
+
+    @staticmethod
+    def llama2_70b():
+        return LlamaConfig(hidden_size=8192, intermediate_size=28672,
+                           num_hidden_layers=80, num_attention_heads=64,
+                           num_key_value_heads=8)
+
+    @staticmethod
+    def tiny():
+        """Test-size model (SimpleModel analog) with GQA exercised."""
+        return LlamaConfig(vocab_size=256, hidden_size=64,
+                           intermediate_size=128, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           max_position_embeddings=128)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.ones, (x.shape[-1],))
+        # Pallas kernel on TPU; jnp reference elsewhere (rms_norm dispatches)
+        from ..ops.pallas_kernels import rms_norm
+        return rms_norm(x, w, eps=self.eps)
+
+
+def _dense(cfg, features, name, use_bias=False):
+    return nn.Dense(features, use_bias=use_bias, name=name,
+                    kernel_init=nn.initializers.normal(cfg.initializer_range))
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, cache=None, cache_index=None):
+        cfg = self.config
+        B, T, C = x.shape
+        nh, nkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                       cfg.head_dim)
+        q = _dense(cfg, nh * hd, "q_proj")(x).reshape(B, T, nh, hd)
+        k = _dense(cfg, nkv * hd, "k_proj")(x).reshape(B, T, nkv, hd)
+        v = _dense(cfg, nkv * hd, "v_proj")(x).reshape(B, T, nkv, hd)
+
+        cos, sin = rope_cos_sin(positions, hd, theta=cfg.rope_theta)
+        # positions: [B, T] -> tables [B, T, half]; add the head axis
+        q = apply_rotary_pos_emb(q, cos[:, :, None, :], sin[:, :, None, :])
+        k = apply_rotary_pos_emb(k, cos[:, :, None, :], sin[:, :, None, :])
+
+        new_cache = None
+        if cache is None:
+            y = flash_attention(q, k, v, causal=True)
+        else:
+            k_cache, v_cache = cache
+            if isinstance(cache_index, int) and \
+                    cache_index + T > k_cache.shape[1]:
+                raise ValueError(
+                    f"KV cache overflow: writing [{cache_index}, "
+                    f"{cache_index + T}) into capacity {k_cache.shape[1]} "
+                    f"(dynamic_update_slice would silently clamp)")
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, cache_index, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, cache_index, 0, 0))
+            new_cache = (k_cache, v_cache)
+            if isinstance(cache_index, int) and T > 1:
+                # prefill: static slice of the live prefix -> flash kernel
+                kv_len = cache_index + T
+                y = flash_attention(q, k_cache[:, :kv_len].astype(q.dtype),
+                                    v_cache[:, :kv_len].astype(q.dtype),
+                                    causal=True)
+            else:
+                y = _decode_attention(q, k_cache, v_cache, cache_index + T)
+
+        y = y.reshape(B, T, nh * hd)
+        out = _dense(cfg, C, "o_proj")(y)
+        return (out, new_cache) if cache is not None else out
+
+
+def _decode_attention(q, k_cache, v_cache, kv_len):
+    """Masked attention over a padded KV cache (decode path; XLA-fused).
+
+    q: [B, T, Hq, D]; caches: [B, S, Hkv, D]; valid keys are [0, kv_len).
+    """
+    B, T, Hq, D = q.shape
+    S, Hkv = k_cache.shape[1], k_cache.shape[2]
+    rep = Hq // Hkv
+    # GQA without materializing repeated caches: group the q heads
+    qg = q.reshape(B, T, Hkv, rep, D)
+    scores = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k_cache).astype(jnp.float32)
+    scores = scores / (D ** 0.5)
+    q_pos = kv_len - T + jnp.arange(T)  # absolute position of each query
+    k_pos = jnp.arange(S)
+    mask = k_pos[None, :] <= q_pos[:, None]  # causal + cache-length bound
+    scores = jnp.where(mask[None, None, None], scores, float("-inf"))
+    p = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bhrqk,bkhd->bqhrd", p, v_cache)
+    return out.reshape(B, T, Hq, D).astype(q.dtype)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        gate = _dense(cfg, cfg.intermediate_size, "gate_proj")(x)
+        up = _dense(cfg, cfg.intermediate_size, "up_proj")(x)
+        h = nn.silu(gate) * up
+        return _dense(cfg, cfg.hidden_size, "down_proj")(h)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, cache=None, cache_index=None):
+        cfg = self.config
+        attn_in = RMSNorm(cfg.rms_norm_eps, name="input_layernorm")(x)
+        attn = LlamaAttention(cfg, name="self_attn")
+        if cache is not None:
+            a, new_cache = attn(attn_in, positions, cache, cache_index)
+        else:
+            a = attn(attn_in, positions)
+            new_cache = None
+        x = x + a
+        mlp_in = RMSNorm(cfg.rms_norm_eps, name="post_attention_layernorm")(x)
+        x = x + LlamaMLP(cfg, name="mlp")(mlp_in)
+        return (x, new_cache) if cache is not None else x
+
+
+class LlamaForCausalLM(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, positions=None,
+                 cache=None, cache_index=None):
+        cfg = self.config
+        B, T = input_ids.shape
+        embed = self.param("embed_tokens",
+                           nn.initializers.normal(cfg.initializer_range),
+                           (cfg.vocab_size, cfg.hidden_size))
+        x = embed[input_ids]
+        if positions is None:
+            start = 0 if cache_index is None else cache_index
+            positions = jnp.broadcast_to(start + jnp.arange(T)[None, :], (B, T))
+        block = LlamaBlock
+        if cfg.use_remat:
+            block = nn.remat(LlamaBlock, static_argnums=())
+        new_caches = [] if cache is not None else None
+        for i in range(cfg.num_hidden_layers):
+            if cache is not None:
+                x, c = block(cfg, name=f"layers_{i}")(x, positions, cache[i],
+                                                      cache_index)
+                new_caches.append(c)
+            else:
+                x = block(cfg, name=f"layers_{i}")(x, positions)
+        x = RMSNorm(cfg.rms_norm_eps, name="norm")(x)
+        if cfg.tie_word_embeddings:
+            logits = x @ embed.T
+        else:
+            lm_head = self.param("lm_head",
+                                 nn.initializers.normal(cfg.initializer_range),
+                                 (cfg.vocab_size, cfg.hidden_size))
+            logits = x @ lm_head.T
+        if labels is not None:
+            from .gpt2 import cross_entropy_loss
+            loss = cross_entropy_loss(logits, labels)
+            return (loss, logits) if cache is None else (loss, logits, new_caches)
+        return logits if cache is None else (logits, new_caches)
+
+    def init_cache(self, batch_size, max_len, dtype=jnp.bfloat16):
+        cfg = self.config
+        shape = (batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(cfg.num_hidden_layers)]
+
+
+def llama_tensor_rules(name, shape):
+    """Tensor-parallel PartitionSpecs (AutoTP analog, reference:
+    module_inject/auto_tp.py — column-split q/k/v/gate/up, row-split
+    o_proj/down_proj; XLA inserts the row-parallel allreduce)."""
+    col = ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj")
+    row = ("o_proj", "down_proj")
+    if any(f"{m}.kernel" in name for m in col):
+        return P(None, TENSOR_AXIS)
+    if any(f"{m}.kernel" in name for m in row):
+        return P(TENSOR_AXIS, None)
+    if name.endswith("embed_tokens") or name.endswith("lm_head"):
+        return P(None, None)
+    return None
+
+
+LlamaForCausalLM.tensor_sharding_rules = staticmethod(llama_tensor_rules)
+
+
+def from_hf_state_dict(state_dict, config: LlamaConfig):
+    """HF transformers LlamaForCausalLM state dict -> this module's params.
+
+    HF Linear stores [out, in]; flax Dense kernels are [in, out] so
+    weights transpose on the way in.
+    """
+
+    def g(key, transpose=False):
+        v = state_dict[key]
+        if hasattr(v, "numpy"):
+            v = v.detach().cpu().numpy()
+        v = np.asarray(v)
+        return v.T if transpose else v
+
+    prefix = "model." if "model.embed_tokens.weight" in state_dict else ""
+    params = {"embed_tokens": g(f"{prefix}embed_tokens.weight")}
+    for i in range(config.num_hidden_layers):
+        lp = f"{prefix}layers.{i}."
+        params[f"layers_{i}"] = {
+            "input_layernorm": {"weight": g(f"{lp}input_layernorm.weight")},
+            "post_attention_layernorm": {
+                "weight": g(f"{lp}post_attention_layernorm.weight")},
+            "self_attn": {
+                m: {"kernel": g(f"{lp}self_attn.{m}.weight", transpose=True)}
+                for m in ("q_proj", "k_proj", "v_proj", "o_proj")},
+            "mlp": {
+                m: {"kernel": g(f"{lp}mlp.{m}.weight", transpose=True)}
+                for m in ("gate_proj", "up_proj", "down_proj")},
+        }
+    params["norm"] = {"weight": g(f"{prefix}norm.weight")}
+    if not config.tie_word_embeddings:
+        params["lm_head"] = g("lm_head.weight")
+    return {"params": params}

@@ -54,6 +54,12 @@ def mha_reference(q, k, v, causal=True, sm_scale=None):
         mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool), k=Tk - Tq)
         scores = jnp.where(mask[None, None], scores, _NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
+    if causal and Tq > Tk:
+        # rows with no visible keys: return 0, matching the kernel's
+        # l=0 guard (otherwise softmax over all -inf yields NaN)
+        valid = jnp.tril(jnp.ones((Tq, Tk), dtype=bool),
+                         k=Tk - Tq).any(axis=-1)
+        p = jnp.where(valid[None, None, :, None], p, 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
     return out.astype(q.dtype)
 

@@ -94,6 +94,25 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    def test_causal_decode_gradients(self):
+        # bwd kernels with Tq != Tk exercise the offset-dependent bounds
+        rng = np.random.default_rng(6)
+        B, Tq, Tk, H, D = 1, 128, 384, 2, 128
+        q = jnp.asarray(rng.standard_normal((B, Tq, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, Tk, H, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, Tk, H, D)), jnp.float32)
+
+        gk = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, interpret=True,
+            block_q=128, block_k=128) ** 2), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(
+            mha_reference(q, k, v, causal=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gk, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4,
+                                       err_msg=f"d{name} mismatch")
+
     def test_gqa_gradients(self):
         rng = np.random.default_rng(5)
         B, T, Hq, Hkv, D = 1, 256, 4, 2, 128
