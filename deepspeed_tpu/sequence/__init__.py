@@ -1,0 +1,2 @@
+from .layer import DistributedAttention, ulysses_attention, seq_all_to_all
+from .ring import ring_attention

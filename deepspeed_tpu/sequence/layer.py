@@ -1,0 +1,110 @@
+"""DeepSpeed-Ulysses sequence parallelism, TPU-native.
+
+Reference: deepspeed/sequence/layer.py — ``DistributedAttention`` wraps a
+local attention module; ``_SeqAllToAll`` (layer.py:44, single_all_to_all
+:15) scatters heads / gathers sequence before local attention and does
+the inverse after, so each rank computes full-sequence attention for a
+slice of heads. Groups come from deepspeed/utils/groups.py:519-566.
+
+TPU-native design: the "sequence group" is the ``sequence`` mesh axis.
+Two execution modes, selected automatically:
+
+* **SPMD (under jit)** — activations are global arrays; the head<->seq
+  layout swap is expressed as a pair of ``with_sharding_constraint``
+  calls and GSPMD inserts the all-to-all on the sequence axis. This is
+  the idiomatic form: no manual collectives, XLA overlaps the a2a with
+  the qkv projections.
+* **collective (inside shard_map)** — per-shard arrays; the swap is an
+  explicit ``jax.lax.all_to_all`` on the axis name, mirroring the
+  reference's ``dist.all_to_all_single`` exactly.
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import BATCH_AXES, SEQUENCE_AXIS, mesh_manager
+
+
+def _axis_bound(axis_name: str) -> bool:
+    """True when called under a trace that binds ``axis_name`` (i.e.
+    inside shard_map over a mesh with that axis)."""
+    try:
+        jax.lax.axis_size(axis_name)
+        return True
+    except Exception:
+        return False
+
+
+def seq_all_to_all(x, scatter_idx: int, gather_idx: int,
+                   axis_name: str = SEQUENCE_AXIS):
+    """Per-shard head<->sequence exchange (reference: single_all_to_all,
+    sequence/layer.py:15). Splits dim ``scatter_idx`` across the axis and
+    concatenates received chunks along ``gather_idx``."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=scatter_idx,
+                              concat_axis=gather_idx, tiled=True)
+
+
+def ulysses_attention(local_attn: Callable, q, k, v, *args,
+                      axis_name: str = SEQUENCE_AXIS,
+                      scatter_idx: int = 2, gather_idx: int = 1,
+                      **kwargs):
+    """Run ``local_attn(q, k, v, ...)`` with Ulysses head-scatter /
+    seq-gather around it.
+
+    q/k/v layout: [batch, seq, heads, head_dim] (seq-sharded on entry in
+    SPMD mode; per-shard seq slice in collective mode). ``local_attn``
+    sees full sequence length and ``heads / sp`` heads.
+    """
+    if _axis_bound(axis_name):
+        qh = seq_all_to_all(q, scatter_idx, gather_idx, axis_name)
+        kh = seq_all_to_all(k, scatter_idx, gather_idx, axis_name)
+        vh = seq_all_to_all(v, scatter_idx, gather_idx, axis_name)
+        out = local_attn(qh, kh, vh, *args, **kwargs)
+        return seq_all_to_all(out, gather_idx, scatter_idx, axis_name)
+
+    # SPMD path: swap which dim carries the sequence axis; GSPMD lowers
+    # each constraint transition to an all-to-all over ICI.
+    mesh = mesh_manager.mesh
+    if mesh_manager.sequence_parallel_world_size() == 1:
+        return local_attn(q, k, v, *args, **kwargs)
+
+    def spec(seq_dim_sharded):
+        ndim = q.ndim
+        s = [None] * ndim
+        s[0] = BATCH_AXES
+        if seq_dim_sharded:
+            s[gather_idx] = axis_name
+        else:
+            s[scatter_idx] = axis_name
+        return NamedSharding(mesh, P(*s))
+
+    seq_sharded = spec(True)
+    head_sharded = spec(False)
+    q = jax.lax.with_sharding_constraint(q, head_sharded)
+    k = jax.lax.with_sharding_constraint(k, head_sharded)
+    v = jax.lax.with_sharding_constraint(v, head_sharded)
+    out = local_attn(q, k, v, *args, **kwargs)
+    return jax.lax.with_sharding_constraint(out, seq_sharded)
+
+
+class DistributedAttention:
+    """API-parity wrapper (reference: sequence/layer.py:60
+    ``DistributedAttention(local_attention, sequence_process_group,
+    scatter_idx, gather_idx)``)."""
+
+    def __init__(self, local_attention: Callable,
+                 sequence_axis: str = SEQUENCE_AXIS,
+                 scatter_idx: int = 2, gather_idx: int = 1):
+        self.local_attn = local_attention
+        self.axis_name = sequence_axis
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        return ulysses_attention(self.local_attn, query, key, value, *args,
+                                 axis_name=self.axis_name,
+                                 scatter_idx=self.scatter_idx,
+                                 gather_idx=self.gather_idx, **kwargs)

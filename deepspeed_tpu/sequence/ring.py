@@ -1,0 +1,85 @@
+"""Ring attention — context parallelism over the sequence axis.
+
+The reference has NO ring/blockwise context parallelism (SURVEY §2.5:
+Ulysses all-to-all is its only long-sequence strategy). Ring attention
+is the TPU-idiomatic upgrade: K/V shards rotate around the ``sequence``
+axis via ``ppermute`` (nearest-neighbour ICI hops — the topology ring
+attention was designed for) while each chip accumulates online-softmax
+partial results for its resident Q shard. Peak memory is O(T/sp) per
+chip with no head-count divisibility requirement (Ulysses needs
+heads % sp == 0).
+
+Call inside ``shard_map`` with q/k/v sharded [B, T/sp, H, D] on the
+sequence axis. Causal masking uses global positions derived from
+``axis_index``, so whole remote blocks in the strict upper triangle
+contribute nothing (their probabilities mask to zero; the ppermute ring
+still runs full circle, which keeps the schedule static for XLA).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.mesh import SEQUENCE_AXIS
+
+_NEG_INF = float("-inf")
+
+
+def ring_attention(q, k, v, axis_name: str = SEQUENCE_AXIS, causal: bool = True,
+                   sm_scale=None):
+    """Blockwise ring attention. Per-shard q/k/v: [B, Tl, H(q/kv), D].
+
+    GQA supported (q heads a multiple of kv heads). Accumulation in fp32;
+    returns q.dtype. Equivalent to full causal attention over the global
+    sequence (top-left aligned, Tq == Tk).
+    """
+    B, Tl, Hq, D = q.shape
+    Hkv = k.shape[2]
+    rep = Hq // Hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+
+    sp = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    # kv blocks flow to the NEXT rank each step, so after s steps rank r
+    # holds the block that originated at rank (r - s) mod sp.
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    qg = (q.astype(jnp.float32) * sm_scale).reshape(B, Tl, Hkv, rep, D)
+    q_pos = my * Tl + jnp.arange(Tl)
+
+    def step(carry, s):
+        o, l, m, kc, vc = carry
+        src = (my - s) % sp
+        scores = jnp.einsum("bqhrd,bkhd->bhrqk", qg,
+                            kc.astype(jnp.float32))  # [B,Hkv,rep,Tl,Tk]
+        if causal:
+            k_pos = src * Tl + jnp.arange(Tl)
+            mask = k_pos[None, :] <= q_pos[:, None]
+            scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
+
+        s_max = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, s_max)
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.where(jnp.isneginf(scores), 0.0,
+                      jnp.exp(scores - safe_m[..., None]))
+        alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        o_new = alpha[..., None] * o + jnp.einsum(
+            "bhrqk,bkhd->bhrqd", p, vc.astype(jnp.float32))
+
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (o_new, l_new, m_new, kc, vc), None
+
+    o0 = jnp.zeros((B, Hkv, rep, Tl, D), jnp.float32)
+    l0 = jnp.zeros((B, Hkv, rep, Tl), jnp.float32)
+    m0 = jnp.full((B, Hkv, rep, Tl), _NEG_INF, jnp.float32)
+    (o, l, m, _, _), _ = jax.lax.scan(
+        step, (o0, l0, m0, k, v), jnp.arange(sp))
+
+    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
+    # [B,Hkv,rep,Tl,D] -> [B,Tl,Hq,D]
+    out = out.transpose(0, 3, 1, 2, 4).reshape(B, Tl, Hq, D)
+    return out.astype(q.dtype)
