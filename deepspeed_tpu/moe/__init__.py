@@ -1,0 +1,4 @@
+from .layer import MoE
+from .experts import Experts
+from .sharded_moe import MOELayer, TopKGate, top1gating, top2gating
+from .capacity_bins import CapacityBins

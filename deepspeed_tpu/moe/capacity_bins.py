@@ -1,0 +1,71 @@
+"""Capacity bins — bounded static-shape buckets for MoE capacity.
+
+Reference: deepspeed/moe/capacity_bins.py (Habana-fork feature, 331 LoC)
+— snaps the dynamic no-drop capacity to a configured set of bins so
+static-graph hardware compiles a bounded number of graphs, and adapts
+bin edges from usage statistics.
+
+TPU-native role: under jit, capacity must be static. Training loops that
+want no-drop semantics pick a bin on the HOST from observed expert
+counts, pass it as the static ``capacity`` to ``MoE``/``TopKGate``, and
+accept one recompile per bin (bounded by ``num_bins``, exactly the
+fork's goal).
+"""
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class CapacityBins:
+    """Host-side bin selector + usage stats (reference API surface:
+    CapacityBins.get_binned_capacity / get_stats / set_bins)."""
+    num_bins: int
+    min_bin: int = 8
+    max_bin: int = 0          # 0 -> derived on first use from tokens
+    alignment: int = 8        # bins align to MXU-friendly multiples
+
+    def __post_init__(self):
+        self._bins: Optional[np.ndarray] = None
+        self._usage = None
+
+    def _ensure_bins(self, max_capacity: int):
+        if self._bins is None:
+            hi = self.max_bin or int(max_capacity)
+            lo = min(self.min_bin, hi)
+            edges = np.unique(np.linspace(lo, hi, self.num_bins).round()
+                              .astype(np.int64))
+            a = self.alignment
+            edges = np.unique(((edges + a - 1) // a) * a)
+            self._bins = edges
+            self._usage = np.zeros(len(edges), dtype=np.int64)
+
+    def get_binned_capacity(self, required_capacity: int,
+                            max_capacity: int = 0) -> int:
+        """Smallest bin >= required_capacity (host-side, static result).
+
+        A requirement above the top bin EXTENDS the bin set (one new
+        aligned bin, hence one extra compile) instead of silently
+        under-sizing — the reference asserts bins[-1] covers the worst
+        case for the same reason."""
+        self._ensure_bins(max_capacity or required_capacity)
+        if required_capacity > self._bins[-1]:
+            a = self.alignment
+            new_bin = ((int(required_capacity) + a - 1) // a) * a
+            self._bins = np.append(self._bins, new_bin)
+            self._usage = np.append(self._usage, 0)
+        idx = int(np.searchsorted(self._bins, required_capacity))
+        self._usage[idx] += 1
+        return int(self._bins[idx])
+
+    def get_stats(self):
+        if self._bins is None:
+            return {"bins": [], "usage": []}
+        return {"bins": self._bins.tolist(), "usage": self._usage.tolist()}
+
+    def set_bins(self, bins: Sequence[int]):
+        self._bins = np.asarray(sorted(set(int(b) for b in bins)),
+                                dtype=np.int64)
+        self._usage = np.zeros(len(self._bins), dtype=np.int64)

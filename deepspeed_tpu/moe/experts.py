@@ -1,0 +1,58 @@
+"""Expert bank: E independent expert networks with stacked parameters.
+
+Reference: deepspeed/moe/experts.py — ``Experts`` deep-copies the expert
+module E/ep times and loops over chunks. TPU-native: ONE vmapped module
+whose params carry a leading [E] axis sharded over the ``expert`` mesh
+axis — the loop becomes a batched einsum on the MXU and expert
+parallelism falls out of the sharding annotation.
+"""
+
+from typing import Any, Callable, Type
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel.mesh import EXPERT_AXIS
+
+
+class ExpertMLP(nn.Module):
+    """Default FFN expert (h -> 4h -> h unless sizes given)."""
+    d_model: int
+    d_ff: int = 0
+    activation: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x):
+        d_ff = self.d_ff or 4 * self.d_model
+        h = nn.Dense(d_ff, name="wi")(x)
+        return nn.Dense(self.d_model, name="wo")(self.activation(h))
+
+
+class Experts(nn.Module):
+    """Vmap the expert over a leading [E] param axis.
+
+    Input/output: [E, C, M] — expert e sees its capacity slots only.
+    """
+    expert_cls: Type[nn.Module]
+    num_experts: int
+    expert_kwargs: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        Vmapped = nn.vmap(
+            self.expert_cls,
+            in_axes=0, out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            metadata_params={nn.PARTITION_NAME: EXPERT_AXIS})
+        kwargs = dict(self.expert_kwargs or {})
+        return Vmapped(name="experts", **kwargs)(x)
+
+
+def moe_tensor_rules(name: str, shape):
+    """PartitionSpec rule for stacked expert params: leading dim on the
+    expert axis (compose with model TP rules in ZeroShardingRules)."""
+    if "experts" in name:
+        from jax.sharding import PartitionSpec as P
+        return P(*([EXPERT_AXIS] + [None] * (len(shape) - 1)))
+    return None

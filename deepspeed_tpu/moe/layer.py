@@ -1,0 +1,60 @@
+"""User-facing MoE layer (reference: deepspeed/moe/layer.py:19 ``MoE``).
+
+Wraps TopKGate + Experts into one drop-in FFN replacement, including
+Residual MoE (PR-MoE, reference: layer.py:144 — a dense residual MLP
+mixed with the MoE output through a learned 2-way coefficient).
+"""
+
+from typing import Any, Optional, Type
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .experts import ExpertMLP, Experts
+from .sharded_moe import MOELayer, TopKGate
+
+
+class MoE(nn.Module):
+    hidden_size: int
+    num_experts: int = 1
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+    top2_2nd_expert_sampling: bool = True
+    use_residual: bool = False
+    expert_cls: Type[nn.Module] = ExpertMLP
+    expert_kwargs: Any = None
+    capacity: Optional[int] = None   # static override (CapacityBins)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, used_token=None):
+        """Returns (output, l_aux, exp_counts) — reference MoE.forward
+        signature (layer.py:19)."""
+        kwargs = dict(self.expert_kwargs or {})
+        kwargs.setdefault("d_model", self.hidden_size)
+        gate = TopKGate(
+            num_experts=self.num_experts, k=self.k,
+            capacity_factor=self.capacity_factor,
+            eval_capacity_factor=self.eval_capacity_factor,
+            min_capacity=self.min_capacity,
+            noisy_gate_policy=self.noisy_gate_policy,
+            drop_tokens=self.drop_tokens, use_rts=self.use_rts,
+            top2_2nd_expert_sampling=self.top2_2nd_expert_sampling,
+            capacity=self.capacity, name="gate")
+        experts = Experts(expert_cls=self.expert_cls,
+                          num_experts=self.num_experts,
+                          expert_kwargs=kwargs, name="deepspeed_experts")
+        out, l_aux, exp_counts = MOELayer(
+            gate=gate, experts=experts, name="moe_layer")(
+                x, train=train, used_token=used_token)
+
+        if self.use_residual:
+            res = self.expert_cls(name="residual_mlp", **kwargs)(x)
+            coef = nn.Dense(2, name="coefficient")(x)
+            coef = nn.softmax(coef, axis=-1)
+            out = out * coef[..., 0:1] + res * coef[..., 1:2]
+        return out, l_aux, exp_counts

@@ -1,0 +1,262 @@
+"""GShard-style gating + expert-parallel MoE layer, TPU-native.
+
+Reference: deepspeed/moe/sharded_moe.py — ``top1gating`` (:207),
+``top2gating`` (:311), ``TopKGate`` (:386), ``MOELayer`` (:522) with an
+explicit ``_AllToAll`` autograd fn (:97) over the expert process group.
+
+TPU-native design differences:
+* **Static capacity.** The reference computes capacity from runtime
+  token counts; under XLA every shape is static, so capacity is derived
+  from the (static) token count at trace time. ``drop_tokens=False``
+  maps to ``capacity == tokens`` (the provable upper bound) — optionally
+  bucketed via ``CapacityBins`` (the fork's capacity-bins feature,
+  deepspeed/moe/capacity_bins.py, which exists for exactly this reason:
+  bounding the number of compiled graphs on static-shape hardware).
+* **SPMD dispatch.** No hand-written all-to-all: the dispatch einsum
+  ``sec,sm->ecm`` with tokens sharded on the data axes and the ``e``
+  output dim constrained to the ``expert`` mesh axis IS the all-to-all;
+  GSPMD inserts and schedules it over ICI. Experts compute on their
+  resident shard of the ``e`` dim.
+* Gating math runs in fp32 (matching the reference's "everything is in
+  fp32 in this function").
+"""
+
+import math
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import EXPERT_AXIS, mesh_manager
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int) -> int:
+    """Static capacity (reference: sharded_moe.py _capacity)."""
+    cap = math.ceil(num_tokens / num_experts * capacity_factor)
+    return max(cap, min_capacity)
+
+
+def _gumbel(rng, shape):
+    return jax.random.gumbel(rng, shape, dtype=jnp.float32)
+
+
+def _select_top_capacity(mask, priority, capacity):
+    """Keep at most ``capacity`` set entries per expert column, highest
+    ``priority`` first (reference: _top_idx + scatter, sharded_moe.py).
+    mask/priority: [S, E]. Ties break toward lower token index
+    (lax.top_k), matching FIFO priority."""
+    _, top_idx = jax.lax.top_k(priority.T, min(capacity, mask.shape[0]))
+    sel = jnp.sum(jax.nn.one_hot(top_idx, mask.shape[0], dtype=mask.dtype),
+                  axis=1)                                     # [E, S]
+    return mask * sel.T
+
+
+def _combine_from(gates_masked, locations_s, mask, capacity):
+    """combine_weights [S, E, C] from per-token slot indices (reference:
+    _calculate_expert_weight / locations1_sc path). Dropped tokens have a
+    zeroed gate row, so their (bogus) slot-0 one-hot contributes 0."""
+    loc_sc = jax.nn.one_hot(locations_s, capacity, dtype=jnp.float32)
+    return jnp.einsum("se,sc->sec", gates_masked, loc_sc)
+
+
+def top1gating(logits, capacity_factor: float = 1.0, min_capacity: int = 8,
+               used_token=None, noisy_gate_policy: Optional[str] = None,
+               drop_tokens: bool = True, use_rts: bool = True, rng=None,
+               capacity: Optional[int] = None):
+    """Top-1 gating (reference: sharded_moe.py:207).
+
+    Returns (l_aux, combine_weights [S,E,C] fp32, dispatch_mask bool,
+    exp_counts [E]).
+    """
+    S, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    if capacity is None:
+        capacity = _capacity(S, E, capacity_factor, min_capacity) \
+            if drop_tokens else S
+
+    if noisy_gate_policy == "RSample":
+        if rng is None:
+            raise ValueError("noisy_gate_policy='RSample' needs an rng")
+        rng, sub = jax.random.split(rng)
+        logits_w_noise = logits + _gumbel(sub, logits.shape)
+    gates = jax.nn.softmax(logits, axis=1)
+
+    indices1 = jnp.argmax(
+        logits_w_noise if noisy_gate_policy == "RSample" else gates, axis=1)
+    mask1 = jax.nn.one_hot(indices1, E, dtype=jnp.int32)
+    if used_token is not None:
+        mask1 = jnp.einsum("s,se->se", used_token.astype(mask1.dtype), mask1)
+
+    exp_counts = jnp.sum(mask1, axis=0)
+
+    # load-balancing aux loss (Switch/GShard form)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1.astype(jnp.float32), axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    # Random Token Selection priority (reference: use_rts branch); without
+    # rng the priority is the mask itself -> FIFO by token index.
+    if use_rts and rng is not None:
+        priority = mask1.astype(jnp.float32) * \
+            jax.random.uniform(rng, mask1.shape, dtype=jnp.float32)
+    else:
+        priority = mask1.astype(jnp.float32)
+    mask1 = _select_top_capacity(mask1, priority, capacity)
+
+    locations1 = jnp.cumsum(mask1, axis=0) - 1
+    locations1_s = jnp.sum(locations1 * mask1, axis=1)
+
+    gates_masked = gates * mask1.astype(jnp.float32)
+    combine_weights = _combine_from(gates_masked, locations1_s, mask1,
+                                    capacity)
+    dispatch_mask = combine_weights.astype(bool)
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+def top2gating(logits, capacity_factor: float = 1.0, min_capacity: int = 8,
+               drop_tokens: bool = True, top2_2nd_expert_sampling: bool = True,
+               rng=None, capacity: Optional[int] = None):
+    """Top-2 gating (reference: sharded_moe.py:311)."""
+    S, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=1)
+
+    indices1 = jnp.argmax(gates, axis=1)
+    mask1 = jax.nn.one_hot(indices1, E, dtype=jnp.int32)
+
+    if top2_2nd_expert_sampling:
+        if rng is None:
+            raise ValueError("top2_2nd_expert_sampling needs an rng; pass "
+                             "rng= or set top2_2nd_expert_sampling=False")
+        logits = logits + _gumbel(rng, logits.shape)
+    logits_except1 = jnp.where(mask1.astype(bool), -jnp.inf, logits)
+    indices2 = jnp.argmax(logits_except1, axis=1)
+    mask2 = jax.nn.one_hot(indices2, E, dtype=jnp.int32)
+
+    locations1 = jnp.cumsum(mask1, axis=0) - 1
+    locations2 = jnp.cumsum(mask2, axis=0) - 1
+    locations2 = locations2 + jnp.sum(mask1, axis=0, keepdims=True)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1.astype(jnp.float32), axis=0)
+    l_aux = jnp.mean(me * ce) * E * E
+
+    exp_counts = jnp.sum(mask1 + mask2, axis=0)
+
+    if capacity is None:
+        capacity = _capacity(S, E, capacity_factor * 2, min_capacity) \
+            if drop_tokens else 2 * S
+    mask1 = mask1 * (locations1 < capacity).astype(mask1.dtype)
+    mask2 = mask2 * (locations2 < capacity).astype(mask2.dtype)
+
+    locations1_s = jnp.sum(locations1 * mask1, axis=1)
+    locations2_s = jnp.sum(locations2 * mask2, axis=1)
+
+    mask1_f = mask1.astype(jnp.float32)
+    mask2_f = mask2.astype(jnp.float32)
+    gates1_s = jnp.einsum("se,se->s", gates, mask1_f)
+    gates2_s = jnp.einsum("se,se->s", gates, mask2_f)
+    denom = jnp.clip(gates1_s + gates2_s,
+                     jnp.finfo(jnp.float32).eps, None)
+    gates1_s = gates1_s / denom
+    gates2_s = gates2_s / denom
+
+    combine_weights = _combine_from(gates1_s[:, None] * mask1_f,
+                                    locations1_s, mask1, capacity)
+    combine_weights = combine_weights + _combine_from(
+        gates2_s[:, None] * mask2_f, locations2_s, mask2, capacity)
+    dispatch_mask = combine_weights.astype(bool)
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+class TopKGate(nn.Module):
+    """Gate network (reference: sharded_moe.py:386 TopKGate — an fp32
+    Linear over the model dim + top-k gating)."""
+    num_experts: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 8
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+    top2_2nd_expert_sampling: bool = True
+    capacity: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, used_token=None):
+        if self.k not in (1, 2):
+            raise ValueError("Only top-1 and top-2 gating supported "
+                             "(reference parity)")
+        if self.noisy_gate_policy not in (None, "Jitter", "RSample"):
+            raise ValueError(
+                f"Unsupported noisy_gate_policy {self.noisy_gate_policy!r}; "
+                f"choose None, 'Jitter', or 'RSample'")
+        wg = self.param("wg", nn.initializers.lecun_normal(),
+                        (x.shape[-1], self.num_experts), jnp.float32)
+        x = x.astype(jnp.float32)
+        rng = self.make_rng("gating") if self.has_rng("gating") else None
+        if self.noisy_gate_policy == "Jitter" and train:
+            if rng is None:
+                raise ValueError("noisy_gate_policy='Jitter' needs "
+                                 "rngs={'gating': ...}")
+            rng, sub = jax.random.split(rng)
+            eps = 1e-2  # reference: multiplicative_jitter epsilon
+            x = x * jax.random.uniform(sub, x.shape, jnp.float32,
+                                       1.0 - eps, 1.0 + eps)
+        logits = x @ wg
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        if self.k == 1:
+            policy = self.noisy_gate_policy if train else None
+            policy = policy if policy == "RSample" else None  # Jitter applied
+            return top1gating(logits, cf, self.min_capacity, used_token,
+                              policy, self.drop_tokens, self.use_rts, rng,
+                              capacity=self.capacity)
+        return top2gating(
+            logits, cf, self.min_capacity, self.drop_tokens,
+            self.top2_2nd_expert_sampling and train,
+            rng, capacity=self.capacity)
+
+
+class MOELayer(nn.Module):
+    """Dispatch -> experts -> combine (reference: sharded_moe.py:522).
+
+    The reference reshapes to [ep, E/ep, C, M] and calls ``_AllToAll``
+    before/after the experts; here the ``e`` dim of the dispatched tensor
+    carries a sharding constraint on the ``expert`` mesh axis and GSPMD
+    emits the equivalent all-to-all pair.
+    """
+    gate: TopKGate
+    experts: Any  # Experts module ([E, C, M] -> [E, C, M])
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, used_token=None):
+        orig_shape = x.shape
+        d_model = orig_shape[-1]
+        tokens = x.reshape(-1, d_model)
+
+        l_aux, combine_weights, dispatch_mask, exp_counts = self.gate(
+            tokens, train=train, used_token=used_token)
+
+        dispatched = jnp.einsum("sec,sm->ecm",
+                                dispatch_mask.astype(x.dtype), tokens)
+        dispatched = _expert_sharded(dispatched)
+        expert_out = self.experts(dispatched)
+        expert_out = _expert_sharded(expert_out)
+        out = jnp.einsum("sec,ecm->sm",
+                         combine_weights.astype(x.dtype), expert_out)
+        return out.reshape(orig_shape), l_aux, exp_counts
+
+
+def _expert_sharded(t):
+    """Constrain the leading expert dim to the expert mesh axis."""
+    if not mesh_manager.initialized or \
+            mesh_manager.expert_parallel_world_size() == 1:
+        return t
+    mesh = mesh_manager.mesh
+    spec = [EXPERT_AXIS] + [None] * (t.ndim - 1)
+    return jax.lax.with_sharding_constraint(
+        t, NamedSharding(mesh, P(*spec)))

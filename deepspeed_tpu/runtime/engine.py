@@ -396,13 +396,16 @@ class DeepSpeedEngine:
     def _split_microbatches(self, batch):
         """[gas*dp_batch, ...] -> [gas, dp_batch, ...] on host."""
         gas = self.gradient_accumulation_steps()
+        expect = self.train_batch_size()
 
         def reshape(x):
             x = np.asarray(x)
-            if x.shape[0] % gas != 0:
+            if x.shape[0] != expect:
                 raise ValueError(
-                    f"global batch dim {x.shape[0]} not divisible by "
-                    f"gradient_accumulation_steps={gas}")
+                    f"train_batch leading dim is {x.shape[0]} but "
+                    f"train_batch_size={expect} (= micro_batch "
+                    f"{self.train_micro_batch_size_per_gpu()} x gas {gas} x "
+                    f"dp_world {self.dp_world_size}); feed the GLOBAL batch")
             return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
 
         return jax.tree_util.tree_map(reshape, batch)
