@@ -1,1 +1,2 @@
-from .module import LayerSpec, PipelineModule, TiedLayerSpec  # noqa: F401
+from .module import LayerSpec, PipelineModule, TiedLayerSpec
+from .engine import PipelineEngine, gpipe_spmd
