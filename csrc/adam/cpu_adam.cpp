@@ -1,0 +1,61 @@
+// Host-side vectorized Adam for ZeRO-Offload.
+//
+// TPU-native equivalent of the reference's CPU Adam extension
+// (reference: csrc/adam/cpu_adam_impl.cpp, csrc/includes/cpu_adam.h:47
+// Adam_Optimizer::Step_AVX — AVX2/AVX512 SIMD + OpenMP). Here the inner
+// loop is written scalar-simple and compiled with -O3 -march=native
+// -fopenmp: the compiler emits the same fused AVX mul/add pattern the
+// reference hand-codes, and OpenMP splits leaves across host cores.
+//
+// Math matches optax.adamw (decoupled weight decay when adamw_mode) /
+// classic L2 Adam otherwise, with bias correction:
+//   m <- b1*m + (1-b1)*g ; v <- b2*v + (1-b2)*g^2
+//   update = (m/(1-b1^t)) / (sqrt(v/(1-b2^t)) + eps) [+ wd*p if adamw]
+//   p <- p - lr*update
+//
+// C ABI only (loaded via ctypes; no pybind11 in this toolchain).
+
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+void ds_adam_step(float* p, const float* g, float* m, float* v,
+                  int64_t n, float lr, float beta1, float beta2, float eps,
+                  float weight_decay, int64_t step, int adamw_mode) {
+    const float bc1 = 1.0f - powf(beta1, (float)step);
+    const float bc2 = 1.0f - powf(beta2, (float)step);
+    const float one_m_b1 = 1.0f - beta1;
+    const float one_m_b2 = 1.0f - beta2;
+
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        float grad = g[i];
+        if (!adamw_mode && weight_decay > 0.0f) grad += weight_decay * p[i];
+        float mi = beta1 * m[i] + one_m_b1 * grad;
+        float vi = beta2 * v[i] + one_m_b2 * grad * grad;
+        m[i] = mi;
+        v[i] = vi;
+        float upd = (mi / bc1) / (sqrtf(vi / bc2) + eps);
+        if (adamw_mode && weight_decay > 0.0f) upd += weight_decay * p[i];
+        p[i] -= lr * upd;
+    }
+}
+
+// fp32 -> bf16 (round-to-nearest-even) for pushing updated master params
+// back to the device without a Python-side conversion pass.
+void ds_f32_to_bf16(const float* src, uint16_t* dst, int64_t n) {
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        union { float f; uint32_t u; } x;
+        x.f = src[i];
+        if ((x.u & 0x7fffffff) > 0x7f800000) {  // NaN: rounding would
+            dst[i] = 0x7fc0;                    // overflow into Inf
+            continue;
+        }
+        uint32_t rounding = 0x7fff + ((x.u >> 16) & 1);
+        dst[i] = (uint16_t)((x.u + rounding) >> 16);
+    }
+}
+
+}  // extern "C"

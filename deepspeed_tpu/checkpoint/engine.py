@@ -55,13 +55,19 @@ def save_checkpoint(save_dir, tag, state, client_state=None, save_latest=True):
     return ckpt_dir
 
 
-def load_checkpoint(load_dir, tag, template_state):
+def resolve_tag(load_dir, tag):
+    """Resolve tag=None through the ``latest`` file."""
     if tag is None:
         latest_path = os.path.join(load_dir, "latest")
         if not os.path.exists(latest_path):
             raise ValueError(f"No 'latest' file in {load_dir}; pass tag=")
         with open(latest_path) as f:
             tag = f.read().strip()
+    return tag
+
+
+def load_checkpoint(load_dir, tag, template_state):
+    tag = resolve_tag(load_dir, tag)
     ckpt_dir = os.path.join(load_dir, str(tag))
     state_dir = os.path.join(ckpt_dir, "state")
 

@@ -1,1 +1,2 @@
 from .fused_adam import fused_adam_update, scale_by_fused_adam  # noqa: F401
+from .cpu_adam import DeepSpeedCPUAdam  # noqa: F401

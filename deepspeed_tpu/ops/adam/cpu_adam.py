@@ -1,0 +1,101 @@
+"""DeepSpeedCPUAdam — host Adam over numpy state for ZeRO-Offload.
+
+Reference: deepspeed/ops/adam/cpu_adam.py:13 ``DeepSpeedCPUAdam`` — a
+torch optimizer whose step calls the AVX C++ extension on pinned host
+tensors. TPU-native version: state is plain numpy (host DRAM); ``step``
+calls the C ABI op (csrc/adam/cpu_adam.cpp) per leaf, or an equivalent
+vectorized numpy path when no toolchain is available.
+"""
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ..op_builder.cpu_adam import CPUAdamBuilder
+
+
+class DeepSpeedCPUAdam:
+    """Flat per-leaf Adam on host fp32 arrays (params updated in place)."""
+
+    def __init__(self, params, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adamw_mode=True, use_native=True):
+        import jax
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+        flat, self.treedef = jax.tree_util.tree_flatten(params)
+        # force writable owned copies (np.asarray over a jax buffer is a
+        # read-only view; the step updates master in place)
+        self.master = [np.array(p, dtype=np.float32, order="C", copy=True)
+                       for p in flat]
+        self.m = [np.zeros_like(p) for p in self.master]
+        self.v = [np.zeros_like(p) for p in self.master]
+        self._lib = CPUAdamBuilder().try_load() if use_native else None
+
+    @property
+    def native(self):
+        return self._lib is not None
+
+    def step(self, grads, lr: Optional[float] = None):
+        """grads: flat list or pytree matching init params. In-place
+        update of self.master; returns the master list."""
+        import jax
+        if not isinstance(grads, (list, tuple)):
+            grads = jax.tree_util.tree_leaves(grads)
+        if len(grads) != len(self.master):
+            raise ValueError(f"{len(grads)} grads for "
+                             f"{len(self.master)} params")
+        self.step_count += 1
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        for p, g, m, v in zip(self.master, grads, self.m, self.v):
+            g = np.ascontiguousarray(np.asarray(g), dtype=np.float32)
+            if self._lib is not None:
+                self._lib.ds_adam_step(
+                    p.reshape(-1), g.reshape(-1), m.reshape(-1),
+                    v.reshape(-1), p.size, lr, b1, b2, self.eps,
+                    self.weight_decay, self.step_count,
+                    int(self.adamw_mode))
+            else:
+                self._numpy_step(p, g, m, v, lr)
+        return self.master
+
+    def _numpy_step(self, p, g, m, v, lr):
+        b1, b2 = self.betas
+        if not self.adamw_mode and self.weight_decay > 0:
+            g = g + self.weight_decay * p
+        m *= b1
+        m += (1 - b1) * g
+        v *= b2
+        v += (1 - b2) * g * g
+        bc1 = 1 - b1 ** self.step_count
+        bc2 = 1 - b2 ** self.step_count
+        upd = (m / bc1) / (np.sqrt(v / bc2) + self.eps)
+        if self.adamw_mode and self.weight_decay > 0:
+            upd = upd + self.weight_decay * p
+        p -= lr * upd
+
+    def master_bf16(self, i: int):
+        """Leaf i as bf16-rounded uint16 buffer (native) or ml_dtypes
+        view — the push-back payload for device compute params."""
+        import ml_dtypes
+        p = self.master[i]
+        if self._lib is not None:
+            out = np.empty(p.shape, dtype=np.uint16)
+            self._lib.ds_f32_to_bf16(p.reshape(-1), out.reshape(-1), p.size)
+            return out.view(ml_dtypes.bfloat16)
+        return p.astype(ml_dtypes.bfloat16)
+
+    def state_dict(self):
+        return {"step": self.step_count, "master": self.master,
+                "m": self.m, "v": self.v}
+
+    def load_state_dict(self, sd):
+        self.step_count = int(sd["step"])
+        for dst, src in ((self.master, sd["master"]), (self.m, sd["m"]),
+                         (self.v, sd["v"])):
+            for i, a in enumerate(src):
+                dst[i][...] = a

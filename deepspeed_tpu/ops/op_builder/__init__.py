@@ -1,0 +1,2 @@
+from .builder import OpBuilder
+from .cpu_adam import CPUAdamBuilder

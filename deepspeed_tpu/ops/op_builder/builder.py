@@ -1,0 +1,99 @@
+"""Native op build system — compile-on-first-use C++ host ops.
+
+Reference: op_builder/builder.py:463 ``OpBuilder.load()/jit_load()`` —
+JIT-compiles CUDA/C++ torch extensions with ninja and caches the .so.
+TPU-native version: host ops only (device ops are Pallas/XLA), compiled
+with g++ straight to a shared library and loaded through ctypes (no
+pybind11/torch extension machinery), cached per source-hash.
+"""
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+from typing import List, Optional
+
+from ...utils.logging import logger
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+
+def _cache_dir():
+    d = os.environ.get("DS_BUILD_CACHE",
+                       os.path.join(_REPO_ROOT, ".ds_op_cache"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class OpBuilder:
+    """Compile ``sources`` into lib<name>.so and load it (ctypes)."""
+
+    NAME = "op"
+
+    def __init__(self):
+        self._lib: Optional[ctypes.CDLL] = None
+
+    def sources(self) -> List[str]:
+        raise NotImplementedError
+
+    def extra_flags(self) -> List[str]:
+        return []
+
+    def compiler(self) -> str:
+        return os.environ.get("CXX", "g++")
+
+    def is_compatible(self) -> bool:
+        return shutil.which(self.compiler()) is not None
+
+    def _source_hash(self, paths) -> str:
+        h = hashlib.sha256()
+        for p in paths:
+            with open(p, "rb") as f:
+                h.update(f.read())
+        h.update(" ".join(self.extra_flags()).encode())
+        return h.hexdigest()[:16]
+
+    def lib_path(self) -> str:
+        paths = [os.path.join(_REPO_ROOT, s) for s in self.sources()]
+        tag = self._source_hash(paths)
+        return os.path.join(_cache_dir(), f"lib{self.NAME}_{tag}.so")
+
+    def build(self) -> str:
+        paths = [os.path.join(_REPO_ROOT, s) for s in self.sources()]
+        out = self.lib_path()
+        if os.path.exists(out):
+            return out
+        cmd = ([self.compiler(), "-O3", "-march=native", "-fopenmp",
+                "-shared", "-fPIC"] + self.extra_flags() + paths +
+               ["-o", out])
+        logger.info(f"Building native op {self.NAME}: {' '.join(cmd)}")
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native op {self.NAME} failed to build:\n{e.stderr}") from e
+        return out
+
+    def load(self) -> ctypes.CDLL:
+        """Compile if needed and dlopen. Raises if no toolchain."""
+        if self._lib is not None:
+            return self._lib
+        if not self.is_compatible():
+            raise RuntimeError(
+                f"no C++ compiler ({self.compiler()}) for op {self.NAME}")
+        self._lib = ctypes.CDLL(self.build())
+        self._configure(self._lib)
+        return self._lib
+
+    def try_load(self) -> Optional[ctypes.CDLL]:
+        try:
+            return self.load()
+        except Exception as e:
+            logger.warning(f"native op {self.NAME} unavailable "
+                           f"({e}); using numpy fallback")
+            return None
+
+    def _configure(self, lib: ctypes.CDLL):
+        """Subclasses set argtypes/restype here."""

@@ -1,0 +1,25 @@
+"""CPUAdamBuilder (reference: op_builder/cpu_adam.py CPUAdamBuilder)."""
+
+import ctypes
+
+import numpy as np
+
+from .builder import OpBuilder
+
+
+class CPUAdamBuilder(OpBuilder):
+    NAME = "deepspeed_cpu_adam"
+
+    def sources(self):
+        return ["csrc/adam/cpu_adam.cpp"]
+
+    def _configure(self, lib):
+        f32p = np.ctypeslib.ndpointer(dtype=np.float32, flags="C_CONTIGUOUS")
+        u16p = np.ctypeslib.ndpointer(dtype=np.uint16, flags="C_CONTIGUOUS")
+        lib.ds_adam_step.argtypes = [
+            f32p, f32p, f32p, f32p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int64, ctypes.c_int]
+        lib.ds_adam_step.restype = None
+        lib.ds_f32_to_bf16.argtypes = [f32p, u16p, ctypes.c_int64]
+        lib.ds_f32_to_bf16.restype = None

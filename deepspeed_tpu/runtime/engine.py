@@ -132,6 +132,23 @@ class DeepSpeedEngine:
             param_persistence_threshold=zc.param_persistence_threshold,
             tensor_rules=tensor_rules)
 
+        # ZeRO-Offload (reference: stage_1_and_2.py cpu_offload path;
+        # partial ratio = ZeRO-Offload++ engine.py:725)
+        self._offload = None
+        self._offload_cfg = None
+        if zc.offload_optimizer.device == "cpu":
+            self._offload_cfg = zc.offload_optimizer
+        elif zc.offload_optimizer.device not in ("none", None):
+            raise ValueError(
+                f"offload_optimizer.device="
+                f"{zc.offload_optimizer.device!r} unsupported; TPU-VM "
+                f"offload targets host DRAM ('cpu')")
+        if zc.offload_param.device not in ("none", None):
+            raise NotImplementedError(
+                "offload_param is not implemented (optimizer-state "
+                "offload is; parameter offload to host memory_kind is a "
+                "future tier) — remove the offload_param section")
+
         # model functions
         self._resolve_model_fns(model)
 
@@ -235,6 +252,9 @@ class DeepSpeedEngine:
         master_sh = self.sharding_rules.opt_shardings(master)
         master = jax.jit(lambda t: t, out_shardings=master_sh)(master)
 
+        if self._offload_cfg is not None:
+            master = self._setup_offload(master)
+
         opt_state = self.opt_transform.init(master)
         opt_sh = self.sharding_rules.opt_shardings(opt_state)
         opt_state = jax.jit(lambda t: t, out_shardings=opt_sh)(opt_state)
@@ -258,6 +278,47 @@ class DeepSpeedEngine:
         n_params = tree_parameter_count(master)
         log_dist(f"Engine state initialized: {n_params/1e6:.2f}M params "
                  f"(master fp32 sharded: stage {self.zero_stage})", ranks=[0])
+
+    def _setup_offload(self, master):
+        """Move the offload-selected leaves' fp32 master + optimizer
+        states to host; on device they exist only in compute dtype.
+        Device-resident leaves keep the normal fused path via
+        optax.masked."""
+        import optax
+        from .zero.offload import OffloadCoordinator, select_offload_mask
+        if self._opt_factory is not None or \
+                (self.client_optimizer is not None):
+            raise ValueError("ZeRO-Offload requires a config-defined "
+                             "optimizer (Adam/AdamW), not a client optax "
+                             "transformation (host Adam must mirror it)")
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "ZeRO-Offload host step is single-controller today: "
+                "np.asarray over fsdp-sharded grads needs per-process "
+                "addressable-shard gathering on multi-host pods")
+        oc = self._config.optimizer_config
+        opt_type = (oc.type if oc is not None else "adamw").lower()
+        if opt_type not in ("adam", "adamw"):
+            raise ValueError(f"offload_optimizer supports Adam/AdamW, "
+                             f"got {opt_type!r}")
+        opt_params = dict(oc.params) if oc is not None else {}
+        # mirror build_optimizer's decay semantics (optimizers.py:69):
+        # decoupled decay unless adam_w_mode is explicitly False
+        adamw_mode = opt_params.get("adam_w_mode", True) or \
+            opt_type == "adamw"
+        mask = select_offload_mask(master, self._offload_cfg.ratio)
+        self._offload = OffloadCoordinator(
+            master, mask, opt_cfg=opt_params,
+            compute_dtype=self.compute_dtype,
+            adamw_mode=adamw_mode)
+        master = self._offload.initial_device_leaves(master)
+        flat, treedef = jax.tree_util.tree_flatten(master)
+        device_mask = jax.tree_util.tree_unflatten(
+            treedef, [not m for m in mask])
+        self.opt_transform = optax.masked(self.opt_transform, device_mask)
+        self.optimizer = self.opt_transform
+        self._offload_device_mask = device_mask
+        return master
 
     def init_params(self, example_batch, rng=None):
         """Explicitly initialize parameters from an example batch (flax)."""
@@ -423,6 +484,7 @@ class DeepSpeedEngine:
         opt = self.opt_transform
         rules = self.sharding_rules
         loss_fn = self._loss_fn
+        off_mask = self._offload.mask if self._offload is not None else None
 
         param_sh = rules.param_shardings(self.state.master_params)
         grad_sh = rules.grad_shardings(self.state.master_params)
@@ -481,6 +543,17 @@ class DeepSpeedEngine:
 
             updates, new_opt_state = opt.update(grads, state.opt_state,
                                                 state.master_params)
+            off_grads = ()
+            if off_mask is not None:
+                # export the offloaded leaves' (unscaled, clipped) grads
+                # for the host Adam; their device "updates" (passed
+                # through optax.masked unchanged) must not touch params.
+                gflat, gdef = jax.tree_util.tree_flatten(grads)
+                off_grads = tuple(g for g, m in zip(gflat, off_mask) if m)
+                uflat = jax.tree_util.tree_flatten(updates)[0]
+                uflat = [jnp.zeros_like(u) if m else u
+                         for u, m in zip(uflat, off_mask)]
+                updates = jax.tree_util.tree_unflatten(gdef, uflat)
             new_master = jax.tree_util.tree_map(
                 lambda p, u: (p + u.astype(p.dtype))
                 if jnp.issubdtype(p.dtype, jnp.floating) else p,
@@ -518,7 +591,7 @@ class DeepSpeedEngine:
                        "grad_norm": grad_norm.astype(jnp.float32),
                        "overflow": overflow,
                        "loss_scale": new_ls.loss_scale}
-            return new_state, metrics
+            return new_state, metrics, off_grads
 
         self._jit_train_step = jax.jit(train_step, donate_argnums=(0,))
 
@@ -562,8 +635,18 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).start()
         micro = self._split_microbatches(batch)
         device_batch = self._shard_batch(micro, leading_gas=True)
-        self.state, metrics = self._jit_train_step(self.state, device_batch,
-                                                   self._next_rng())
+        self.state, metrics, off_grads = self._jit_train_step(
+            self.state, device_batch, self._next_rng())
+        if self._offload is not None:
+            skip = bool(metrics["overflow"]) if self.fp16_enabled else False
+            # scheduler value when one exists; otherwise None -> the host
+            # Adam's own lr (config params / 1e-3 default, matching the
+            # device build_optimizer default — get_lr()'s 0.0 fallback
+            # would silently freeze offloaded leaves)
+            lr = self.get_lr()[0] if self.lr_scheduler is not None else None
+            new_master = self._offload.apply_grads(
+                self.state.master_params, off_grads, lr=lr, skip=skip)
+            self.state = self.state._replace(master_params=new_master)
         self.timers(TRAIN_BATCH_TIMER).stop(sync=True)
         self.tput_timer.stop(global_step=True)
 
@@ -630,6 +713,10 @@ class DeepSpeedEngine:
         ``backward`` recomputes fwd+bwd for the batch of the preceding
         ``forward`` (or an explicit ``batch=``) and accumulates grads.
         """
+        if self._offload is not None:
+            raise NotImplementedError(
+                "ZeRO-Offload runs through train_batch (the fused step); "
+                "the eager forward/backward/step triple is not offloaded")
         if batch is not None and not self._params_initialized:
             self.init_params(self._cast_batch(batch))
         if self._jit_grad_step is None:
@@ -766,12 +853,19 @@ class DeepSpeedEngine:
     def get_params(self, dtype=None):
         """Gather full (replicated) params — the zero_to_fp32 analog
         (reference: utils/zero_to_fp32.py)."""
+        master = self.state.master_params
+        if self._offload is not None:
+            # offloaded leaves live on device only in compute dtype; the
+            # true fp32 master is host-side
+            flat, treedef = jax.tree_util.tree_flatten(master)
+            for slot, i in enumerate(self._offload.off_idx):
+                flat[i] = jnp.asarray(self._offload.host_adam.master[slot])
+            master = jax.tree_util.tree_unflatten(treedef, flat)
         replicated = NamedSharding(self.mesh, P())
         full = jax.jit(
             lambda t: t,
             out_shardings=jax.tree_util.tree_map(lambda _: replicated,
-                                                 self.state.master_params))(
-            self.state.master_params)
+                                                 master))(master)
         if dtype is not None:
             full = jax.tree_util.tree_map(
                 lambda x: x.astype(dtype)
@@ -792,6 +886,16 @@ class DeepSpeedEngine:
             if self.lr_scheduler else None,
         })
         _save(save_dir, tag, self.state, client_state, save_latest=save_latest)
+        if self._offload is not None:
+            sd = self._offload.state_dict()
+            payload = {"step": np.int64(sd["step"]),
+                       "off_idx": np.asarray(sd["off_idx"])}
+            for i in range(len(sd["master"])):
+                payload[f"master_{i}"] = sd["master"][i]
+                payload[f"m_{i}"] = sd["m"][i]
+                payload[f"v_{i}"] = sd["v"][i]
+            np.savez(os.path.join(save_dir, str(tag),
+                                  "zero_offload_host_state.npz"), **payload)
         return True
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
@@ -802,6 +906,19 @@ class DeepSpeedEngine:
                              "(pass model_parameters or run a batch)")
         state, client_state = _load(load_dir, tag, self.state)
         self.state = state
+        if self._offload is not None and load_optimizer_states:
+            from ..checkpoint.engine import resolve_tag
+            tag = resolve_tag(load_dir, tag)
+            path = os.path.join(load_dir, str(tag),
+                                "zero_offload_host_state.npz")
+            z = np.load(path)
+            n = len(self._offload.off_idx)
+            self._offload.load_state_dict({
+                "step": int(z["step"]),
+                "off_idx": z["off_idx"].tolist(),
+                "master": [z[f"master_{i}"] for i in range(n)],
+                "m": [z[f"m_{i}"] for i in range(n)],
+                "v": [z[f"v_{i}"] for i in range(n)]})
         if client_state:
             self.global_steps = client_state.get("global_steps", 0)
             self.global_samples = client_state.get("global_samples", 0)

@@ -2,3 +2,4 @@ from .config import (DeepSpeedZeroConfig, DeepSpeedZeroOffloadOptimizerConfig,  
                      DeepSpeedZeroOffloadParamConfig, OffloadDeviceEnum)
 from .partition import (ZeroShardingRules, zero_param_sharding,  # noqa: F401
                         zero_grad_sharding, zero_opt_sharding)
+from .offload import OffloadCoordinator, select_offload_mask  # noqa: F401

@@ -1,0 +1,112 @@
+"""ZeRO-Offload — optimizer states + fp32 master params in host DRAM.
+
+Reference semantics (runtime/zero/stage_1_and_2.py cpu_offload path +
+csrc/adam cpu_adam + ZeRO-Offload++ ``zero_partial_offload``,
+engine.py:725): gradients stream device->host, the host CPU runs the
+vectorized Adam on fp32 master copies, and updated bf16/fp16 params
+stream back. Device HBM then holds only compute-dtype params and
+transient grads — the states (fp32 master + two fp32 moments, 12
+bytes/param) live in DRAM.
+
+TPU-native design: the engine's compiled step updates NON-offloaded
+leaves as usual (optax.masked) and returns the offloaded leaves' fp32
+grads as an extra output. This coordinator applies DeepSpeedCPUAdam to
+them on host and pushes bf16/fp16 views back via device_put. The
+``ratio`` knob (ZeRO-Offload++ twin-flow, partial offload) selects the
+largest leaves until ``ratio`` of total elements are host-resident.
+"""
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.adam.cpu_adam import DeepSpeedCPUAdam
+from ...utils.logging import log_dist
+
+
+def select_offload_mask(params, ratio: float) -> List[bool]:
+    """Flat leaf mask: True = offload to host. Largest leaves first
+    until >= ratio of total elements are offloaded."""
+    flat = jax.tree_util.tree_leaves(params)
+    sizes = [int(np.prod(p.shape)) if hasattr(p, "shape") else 0
+             for p in flat]
+    total = sum(sizes) or 1
+    order = sorted(range(len(flat)), key=lambda i: -sizes[i])
+    mask = [False] * len(flat)
+    acc = 0
+    for i in order:
+        if acc / total >= ratio:
+            break
+        mask[i] = True
+        acc += sizes[i]
+    return mask
+
+
+class OffloadCoordinator:
+    """Owns host optimizer state for the offloaded leaves."""
+
+    def __init__(self, master_params, mask: List[bool], opt_cfg: dict,
+                 compute_dtype, adamw_mode: bool = True):
+        self.mask = mask
+        self.compute_dtype = compute_dtype
+        flat, self.treedef = jax.tree_util.tree_flatten(master_params)
+        self.off_idx = [i for i, m in enumerate(mask) if m]
+        off_params = [np.asarray(flat[i], dtype=np.float32)
+                      for i in self.off_idx]
+        p = dict(opt_cfg or {})
+        betas = p.get("betas", (p.get("beta1", 0.9), p.get("beta2", 0.999)))
+        self.host_adam = DeepSpeedCPUAdam(
+            off_params,
+            lr=p.get("lr", 1e-3),
+            betas=tuple(betas),
+            eps=p.get("eps", 1e-8),
+            weight_decay=p.get("weight_decay", 0.0),
+            adamw_mode=adamw_mode)
+        n_off = sum(int(np.prod(a.shape)) for a in off_params)
+        log_dist(f"ZeRO-Offload: {len(self.off_idx)} leaves "
+                 f"({n_off/1e6:.2f}M params) host-resident "
+                 f"(native={'yes' if self.host_adam.native else 'numpy'})",
+                 ranks=[0])
+
+    def initial_device_leaves(self, master_params):
+        """Replace offloaded leaves of the device master tree with
+        compute-dtype copies (the fp32 master stays host-side only)."""
+        flat, treedef = jax.tree_util.tree_flatten(master_params)
+        for i in self.off_idx:
+            flat[i] = jnp.asarray(flat[i], dtype=self.compute_dtype)
+        return jax.tree_util.tree_unflatten(treedef, flat)
+
+    def apply_grads(self, state_master, off_grads, lr: Optional[float],
+                    skip: bool = False):
+        """Host Adam on the offloaded grads; returns the master tree with
+        refreshed compute-dtype leaves. ``skip`` mirrors the fp16
+        overflow roll-back."""
+        if skip:
+            return state_master
+        np_grads = [np.asarray(g, dtype=np.float32) for g in off_grads]
+        self.host_adam.step(np_grads, lr=lr)
+        flat, treedef = jax.tree_util.tree_flatten(state_master)
+        for slot, i in enumerate(self.off_idx):
+            if self.compute_dtype == jnp.bfloat16:
+                payload = self.host_adam.master_bf16(slot)
+            else:
+                payload = self.host_adam.master[slot].astype(
+                    np.dtype(self.compute_dtype))
+            flat[i] = jax.device_put(payload, flat[i].sharding)
+        return jax.tree_util.tree_unflatten(treedef, flat)
+
+    # -- checkpoint --------------------------------------------------------
+    def state_dict(self):
+        sd = self.host_adam.state_dict()
+        return {"step": sd["step"],
+                "master": [np.asarray(a) for a in sd["master"]],
+                "m": [np.asarray(a) for a in sd["m"]],
+                "v": [np.asarray(a) for a in sd["v"]],
+                "off_idx": list(self.off_idx)}
+
+    def load_state_dict(self, sd):
+        if list(sd["off_idx"]) != list(self.off_idx):
+            raise ValueError("offload leaf layout mismatch on restore")
+        self.host_adam.load_state_dict(sd)
