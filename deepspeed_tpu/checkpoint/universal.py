@@ -1,0 +1,111 @@
+"""Universal checkpoint — topology-independent offline reshape tools.
+
+Reference: deepspeed/checkpoint/ds_to_universal.py:352 explodes ZeRO
+shards into per-parameter fp32 fragment files (extract_zero_shards :92,
+merge_tp_slices :189) so any (TP, PP, DP) target can reload;
+deepspeed/utils/zero_to_fp32.py:194 merges shards into one fp32
+state_dict.
+
+TPU-native situation: checkpoints already store LOGICAL arrays (orbax
+resharding handles mesh changes on load), so elastic resume needs no
+offline merge. These tools exist for the reference's remaining use
+cases: exporting per-parameter fp32 fragments for surgery/inspection,
+and producing a single fp32 state file for downstream consumers.
+"""
+
+import json
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+from ..utils.tree import flatten_with_names
+from .engine import load_checkpoint, resolve_tag
+
+UNIVERSAL_DIR = "zero"  # reference layout: <out>/zero/<param>/fp32.*
+
+
+def ds_to_universal(ckpt_dir: str, output_dir: str, tag: Optional[str] = None,
+                    template_state=None):
+    """Explode a checkpoint into per-parameter fp32 fragment files.
+
+    Layout (reference parity, ds_to_universal.py):
+        <output_dir>/zero/<param_path>/fp32.npy
+        <output_dir>/zero/<param_path>/exp_avg.npy      (when present)
+        <output_dir>/zero/<param_path>/exp_avg_sq.npy   (when present)
+        <output_dir>/universal_meta.json
+    """
+    state, client_state = load_checkpoint(ckpt_dir, tag, template_state)
+    master = state.master_params if hasattr(state, "master_params") else state
+    out_root = os.path.join(output_dir, UNIVERSAL_DIR)
+    os.makedirs(out_root, exist_ok=True)
+
+    names = dict(flatten_with_names(master))
+    moments = _find_adam_moments(state)
+    count = 0
+    for name, leaf in names.items():
+        pdir = os.path.join(out_root, name.replace("/", "_"))
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, "fp32.npy"),
+                np.asarray(leaf, dtype=np.float32))
+        for mom_name, tree in moments.items():
+            mleaf = dict(flatten_with_names(tree)).get(name)
+            if mleaf is not None and getattr(mleaf, "shape", None) == \
+                    getattr(leaf, "shape", None):
+                np.save(os.path.join(pdir, f"{mom_name}.npy"),
+                        np.asarray(mleaf, dtype=np.float32))
+        count += 1
+    meta = {"param_count": count,
+            "client_state": {k: v for k, v in (client_state or {}).items()
+                             if isinstance(v, (int, float, str, bool))}}
+    with open(os.path.join(output_dir, "universal_meta.json"), "w") as f:
+        json.dump(meta, f)
+    logger.info(f"Universal checkpoint: {count} params -> {output_dir}")
+    return output_dir
+
+
+def _find_adam_moments(state) -> Dict[str, Any]:
+    """Locate mu/nu trees in an optax state (ScaleByAdamState anywhere in
+    the chain)."""
+    moments = {}
+
+    def walk(node):
+        if hasattr(node, "mu") and hasattr(node, "nu"):
+            moments.setdefault("exp_avg", node.mu)
+            moments.setdefault("exp_avg_sq", node.nu)
+        if isinstance(node, (tuple, list)):
+            for c in node:
+                walk(c)
+
+    if hasattr(state, "opt_state"):
+        walk(state.opt_state)
+    return moments
+
+
+def load_universal_params(universal_dir: str) -> Dict[str, np.ndarray]:
+    """Read back the per-parameter fp32 fragments as {name: array}."""
+    root = os.path.join(universal_dir, UNIVERSAL_DIR)
+    out = {}
+    for pname in sorted(os.listdir(root)):
+        f = os.path.join(root, pname, "fp32.npy")
+        if os.path.exists(f):
+            out[pname] = np.load(f)
+    return out
+
+
+def zero_to_fp32(ckpt_dir: str, output_file: str, tag: Optional[str] = None,
+                 template_state=None) -> Dict[str, np.ndarray]:
+    """Merge a checkpoint into ONE fp32 state dict file (reference:
+    deepspeed/utils/zero_to_fp32.py:194
+    convert_zero_checkpoint_to_fp32_state_dict)."""
+    state, _ = load_checkpoint(ckpt_dir, tag, template_state)
+    master = state.master_params if hasattr(state, "master_params") else state
+    sd = {name: np.asarray(leaf, dtype=np.float32)
+          for name, leaf in flatten_with_names(master)
+          if hasattr(leaf, "shape")}
+    with open(output_file, "wb") as f:
+        pickle.dump(sd, f)
+    logger.info(f"fp32 state dict ({len(sd)} tensors) -> {output_file}")
+    return sd

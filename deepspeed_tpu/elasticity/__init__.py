@@ -1,0 +1,4 @@
+from .elasticity import (compute_elastic_config, ensure_immutable_elastic_config,
+                         get_compatible_gpus)
+from .config import ElasticityConfig, ElasticityError, ElasticityConfigError, \
+    ElasticityIncompatibleWorldSize
