@@ -16,15 +16,36 @@ and producing a single fp32 state file for downstream consumers.
 import json
 import os
 import pickle
+import urllib.parse
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 from ..utils.logging import logger
-from ..utils.tree import flatten_with_names
+from ..utils.tree import flatten_with_name_parts, flatten_with_names
 from .engine import load_checkpoint, resolve_tag
 
 UNIVERSAL_DIR = "zero"  # reference layout: <out>/zero/<param>/fp32.*
+
+
+def _esc(segment: str) -> str:
+    """Escape one param-path segment into a safe directory name.
+
+    Injective: percent-encoding with '.' also escaped (so '.'/'..' can
+    never appear), and the empty segment maps to '%empty' — a string
+    quote() can never emit for any other input ('%' itself becomes
+    '%25'). The fragment layout keeps one directory PER PATH SEGMENT,
+    like the reference's nested param dirs, so 'a/b_c' and 'a_b/c' can
+    never collide."""
+    if segment == "":
+        return "%empty"
+    return urllib.parse.quote(segment, safe="").replace(".", "%2E")
+
+
+def _unesc(segment: str) -> str:
+    if segment == "%empty":
+        return ""
+    return urllib.parse.unquote(segment)
 
 
 def ds_to_universal(ckpt_dir: str, output_dir: str, tag: Optional[str] = None,
@@ -42,16 +63,21 @@ def ds_to_universal(ckpt_dir: str, output_dir: str, tag: Optional[str] = None,
     out_root = os.path.join(output_dir, UNIVERSAL_DIR)
     os.makedirs(out_root, exist_ok=True)
 
-    names = dict(flatten_with_names(master))
+    parts_list, leaves, _ = flatten_with_name_parts(master)
     moments = _find_adam_moments(state)
+    moment_maps = {}
+    for mom_name, tree in moments.items():
+        m_parts, m_leaves, _ = flatten_with_name_parts(tree)
+        moment_maps[mom_name] = {tuple(p): l
+                                 for p, l in zip(m_parts, m_leaves)}
     count = 0
-    for name, leaf in names.items():
-        pdir = os.path.join(out_root, name.replace("/", "_"))
+    for parts, leaf in zip(parts_list, leaves):
+        pdir = os.path.join(out_root, *[_esc(p) for p in parts])
         os.makedirs(pdir, exist_ok=True)
         np.save(os.path.join(pdir, "fp32.npy"),
                 np.asarray(leaf, dtype=np.float32))
-        for mom_name, tree in moments.items():
-            mleaf = dict(flatten_with_names(tree)).get(name)
+        for mom_name, mmap in moment_maps.items():
+            mleaf = mmap.get(tuple(parts))
             if mleaf is not None and getattr(mleaf, "shape", None) == \
                     getattr(leaf, "shape", None):
                 np.save(os.path.join(pdir, f"{mom_name}.npy"),
@@ -85,13 +111,20 @@ def _find_adam_moments(state) -> Dict[str, Any]:
 
 
 def load_universal_params(universal_dir: str) -> Dict[str, np.ndarray]:
-    """Read back the per-parameter fp32 fragments as {name: array}."""
+    """Read back the per-parameter fp32 fragments as {dot.name: array}."""
     root = os.path.join(universal_dir, UNIVERSAL_DIR)
     out = {}
-    for pname in sorted(os.listdir(root)):
-        f = os.path.join(root, pname, "fp32.npy")
-        if os.path.exists(f):
-            out[pname] = np.load(f)
+    for dirpath, _, filenames in sorted(os.walk(root)):
+        if "fp32.npy" not in filenames:
+            continue
+        rel = os.path.relpath(dirpath, root)
+        name = ".".join(_unesc(s) for s in rel.split(os.sep))
+        if name in out:
+            # distinct on disk, ambiguous once dot-joined (a segment
+            # containing a literal '.') — refuse to silently overwrite
+            raise ValueError(
+                f"fragment name collision after joining segments: {name!r}")
+        out[name] = np.load(os.path.join(dirpath, "fp32.npy"))
     return out
 
 
@@ -102,8 +135,9 @@ def zero_to_fp32(ckpt_dir: str, output_file: str, tag: Optional[str] = None,
     convert_zero_checkpoint_to_fp32_state_dict)."""
     state, _ = load_checkpoint(ckpt_dir, tag, template_state)
     master = state.master_params if hasattr(state, "master_params") else state
+    names, leaves, _ = flatten_with_names(master)
     sd = {name: np.asarray(leaf, dtype=np.float32)
-          for name, leaf in flatten_with_names(master)
+          for name, leaf in zip(names, leaves)
           if hasattr(leaf, "shape")}
     with open(output_file, "wb") as f:
         pickle.dump(sd, f)

@@ -1,0 +1,101 @@
+"""Elasticity config (reference: deepspeed/elasticity/config.py:28
+``ElasticityConfig`` — same JSON schema for drop-in parity; "gpus" keys
+kept verbatim, meaning chips here).
+
+Example section::
+
+    "elasticity": {
+        "enabled": true,
+        "max_train_batch_size": 2000,
+        "micro_batch_sizes": [2, 4, 6],
+        "min_gpus": 1,
+        "max_gpus": 10000,
+        "min_time": 20,
+        "prefer_larger_batch": true,
+        "ignore_non_elastic_batch_info": false,
+        "version": 0.2,
+        "model_parallel_size": 1,
+        "num_gpus_per_node": 1
+    }
+"""
+
+
+class ElasticityError(Exception):
+    """Base elasticity error (reference: elasticity/config.py:10)."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Bad or missing elasticity configuration."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """World size not in the valid chip-count list for this config."""
+
+
+ENABLED_DEFAULT = False
+MIN_GPUS_DEFAULT = 1
+MAX_GPUS_DEFAULT = 10000
+MIN_TIME_DEFAULT = 0
+VERSION_DEFAULT = 0.2
+PREFER_LARGER_BATCH_DEFAULT = True
+IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT = False
+MODEL_PARALLEL_SIZE_DEFAULT = 1
+NUM_GPUS_PER_NODE_DEFAULT = 1
+LATEST_ELASTICITY_VERSION = 0.2
+
+
+class ElasticityConfig:
+
+    def __init__(self, param_dict):
+        self.enabled = param_dict.get("enabled", ENABLED_DEFAULT)
+        if self.enabled:
+            if "max_train_batch_size" not in param_dict:
+                raise ElasticityConfigError(
+                    "Elasticity config missing max_train_batch_size")
+            if "micro_batch_sizes" not in param_dict:
+                raise ElasticityConfigError(
+                    "Elasticity config missing micro_batch_sizes")
+        self.max_acceptable_batch_size = param_dict.get(
+            "max_train_batch_size", 2000)
+        self.micro_batches = param_dict.get("micro_batch_sizes", [2, 4, 6])
+
+        if not isinstance(self.micro_batches, list):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be a list, got "
+                f"{type(self.micro_batches)}")
+        if not all(isinstance(m, int) and m > 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be positive ints, got "
+                f"{self.micro_batches}")
+
+        self.min_gpus = param_dict.get("min_gpus", MIN_GPUS_DEFAULT)
+        self.max_gpus = param_dict.get("max_gpus", MAX_GPUS_DEFAULT)
+        if self.min_gpus < 1 or self.max_gpus < 1:
+            raise ElasticityConfigError(
+                f"min/max chips must be > 0, got {self.min_gpus}, "
+                f"{self.max_gpus}")
+        if self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError(
+                f"min_gpus ({self.min_gpus}) > max_gpus ({self.max_gpus})")
+
+        self.model_parallel_size = param_dict.get(
+            "model_parallel_size", MODEL_PARALLEL_SIZE_DEFAULT)
+        self.num_gpus_per_node = param_dict.get(
+            "num_gpus_per_node", NUM_GPUS_PER_NODE_DEFAULT)
+        if self.model_parallel_size < 1 or self.num_gpus_per_node < 1:
+            raise ElasticityConfigError(
+                "model_parallel_size and num_gpus_per_node must be > 0")
+
+        self.min_time = param_dict.get("min_time", MIN_TIME_DEFAULT)
+        self.version = param_dict.get("version", VERSION_DEFAULT)
+        self.prefer_larger_batch_size = param_dict.get(
+            "prefer_larger_batch", PREFER_LARGER_BATCH_DEFAULT)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            "ignore_non_elastic_batch_info",
+            IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+
+    def repr(self):
+        return self.__dict__
+
+    def __repr__(self):
+        return str(self.__dict__)

@@ -1,0 +1,245 @@
+"""Elastic batch-size planning — pure arithmetic, ported semantics.
+
+Reference: deepspeed/elasticity/elasticity.py:233 ``compute_elastic_config``
+— given micro-batch candidates and a max acceptable global batch, find a
+global batch size compatible with the largest set of chip counts, so the
+scheduler can scale the job up/down without touching convergence
+(global = micro * grad_accum * dp_world stays fixed).
+
+TPU reading: "gpus" = chips; a "node" = one TPU host (a v5e host owns 4
+or 8 chips); scaling events are slice resizes. The math is identical —
+only the recovery mechanism differs (jax.distributed re-init + orbax
+resharded restore instead of torchelastic rendezvous).
+"""
+
+import json
+import math
+import os
+from functools import reduce
+
+from ..utils.logging import logger
+from .config import (LATEST_ELASTICITY_VERSION, ElasticityConfig,
+                     ElasticityConfigError, ElasticityError,
+                     ElasticityIncompatibleWorldSize)
+
+# Highly composite numbers: batch sizes with many divisors give many
+# valid dp-world sizes (same table idea as the reference, re-derived —
+# each entry has more divisors than any smaller positive integer).
+_HCN = [
+    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260,
+    1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720, 45360,
+    50400, 55440, 83160, 110880, 166320, 221760, 277200, 332640, 498960,
+    554400, 665280, 720720,
+]
+
+DEEPSPEED_ELASTICITY_CONFIG = "DEEPSPEED_ELASTICITY_CONFIG"
+
+
+def _lcm(values):
+    return reduce(lambda a, b: a * b // math.gcd(a, b), values)
+
+
+def _candidate_batch_sizes(bases, max_batch):
+    """For each base, the largest HCN-scaled multiple <= max_batch
+    (bases already >= max_batch pass through)."""
+    out = set()
+    for base in bases:
+        if base >= max_batch:
+            out.add(base)
+            continue
+        limit = max_batch // base
+        scale = 1
+        for h in _HCN:
+            if h > limit:
+                break
+            scale = h
+        out.add(scale * base)
+    return sorted(out)
+
+
+def get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus):
+    """All chip counts w for which batch_size = micro * k * w works for
+    some candidate micro-batch (w divides batch_size // micro)."""
+    valid = set()
+    for micro in micro_batches:
+        if batch_size % micro:
+            continue
+        slots = batch_size // micro
+        for w in range(1, int(math.isqrt(slots)) + 1):
+            if slots % w == 0:
+                for cand in (w, slots // w):
+                    if min_gpus <= cand <= max_gpus:
+                        valid.add(cand)
+    return sorted(valid)
+
+
+def _best_candidate(candidates, micro_batches, min_gpus, max_gpus,
+                    prefer_larger):
+    best_batch = min(micro_batches)
+    best_valid = []
+    for batch in candidates:
+        valid = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        better = len(valid) > len(best_valid) or (
+            len(valid) == len(best_valid) and
+            (batch > best_batch if prefer_larger else batch < best_batch))
+        if better:
+            best_batch, best_valid = batch, valid
+    return best_batch, best_valid
+
+
+def get_compatible_gpus(micro_batches, max_acceptable_batch_size,
+                        min_gpus=None, max_gpus=None, prefer_larger=True):
+    """v0.1 algorithm (reference: _get_compatible_gpus_v01): candidates
+    are each micro-batch and their LCM, HCN-scaled up to the cap; pick
+    the one compatible with the most chip counts."""
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+    if any(m > max_acceptable_batch_size for m in micro_batches):
+        raise ElasticityConfigError(
+            "every micro batch must be <= max_acceptable_batch_size")
+    bases = list(micro_batches) + [_lcm(micro_batches)]
+    candidates = _candidate_batch_sizes(bases, max_acceptable_batch_size)
+    return _best_candidate(candidates, micro_batches, min_gpus, max_gpus,
+                           prefer_larger)
+
+
+def _compatible_gpus_v02(micro_batches, max_acceptable_batch_size,
+                         current_num_gpus, min_gpus, max_gpus,
+                         prefer_larger, num_gpus_per_node,
+                         model_parallel_size):
+    """v0.2: node-granular version — v0.1 at node level scaled by the
+    per-node dp size, with a fallback anchored at the current world size
+    when it is not in the valid list (reference: _get_compatible_gpus_v02)."""
+    if num_gpus_per_node % model_parallel_size:
+        raise ElasticityError(
+            f"chips per host ({num_gpus_per_node}) must be divisible by "
+            f"model parallel size ({model_parallel_size})")
+    dp_per_node = num_gpus_per_node // model_parallel_size
+
+    def pick_micro(batch):
+        chosen = None
+        for micro in micro_batches:
+            if (batch // current_num_gpus) % micro == 0:
+                if chosen is None or (prefer_larger and micro > chosen):
+                    chosen = micro
+        return chosen
+
+    node_batch, node_worlds = get_compatible_gpus(
+        micro_batches, int(max_acceptable_batch_size / dp_per_node),
+        int(min_gpus / num_gpus_per_node), int(max_gpus / num_gpus_per_node),
+        prefer_larger)
+    batch = int(node_batch) * dp_per_node
+    dp_worlds = [w * dp_per_node for w in node_worlds]
+    if current_num_gpus // model_parallel_size in dp_worlds:
+        return batch, dp_worlds, pick_micro(batch)
+
+    # current world not valid: anchor on it and fill up to the cap.
+    # Micro batches whose minimum global batch (micro * current_dp)
+    # already exceeds the cap contribute no candidate (a floor of 0
+    # would otherwise produce a batch size of 0).
+    current_dp = (current_num_gpus / num_gpus_per_node) * dp_per_node
+    anchored = [int(math.floor(max_acceptable_batch_size / (m * current_dp)))
+                * m * current_dp for m in micro_batches
+                if m * current_dp <= max_acceptable_batch_size]
+    if not anchored:
+        raise ElasticityError(
+            f"no micro batch in {micro_batches} fits "
+            f"max_train_batch_size={max_acceptable_batch_size} at the "
+            f"current dp world size {int(current_dp)}")
+    batch = max(anchored) if prefer_larger else min(anchored)
+    return batch, [int(current_dp)], pick_micro(batch)
+
+
+def elasticity_enabled(ds_config: dict) -> bool:
+    return ds_config.get("elasticity", {}).get("enabled", False)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict):
+    """Assert the config the scheduler planned with matches the runtime's
+    (reference: elasticity.py:208)."""
+    if DEEPSPEED_ELASTICITY_CONFIG not in os.environ:
+        logger.warning(
+            f"{DEEPSPEED_ELASTICITY_CONFIG} env var not found — cannot "
+            "guarantee the scheduler scales with compatible chip counts.")
+        return
+    sched = ElasticityConfig(json.loads(os.environ[DEEPSPEED_ELASTICITY_CONFIG]))
+    run = ElasticityConfig(runtime_elastic_config_dict)
+    for field in ("max_acceptable_batch_size", "micro_batches", "version"):
+        if getattr(run, field) != getattr(sched, field):
+            raise ElasticityConfigError(
+                f"elastic config field '{field}' differs between scheduler "
+                f"({getattr(sched, field)}) and runtime "
+                f"({getattr(run, field)})")
+
+
+def compute_elastic_config(ds_config: dict, target_deepspeed_version: str = "",
+                           world_size: int = 0, return_microbatch: bool = False):
+    """Compute (final_batch_size, valid_chip_counts[, micro_batch]).
+
+    Reference: elasticity/elasticity.py:233. ``target_deepspeed_version``
+    is accepted for API parity (no legacy versions exist here).
+    """
+    if not isinstance(ds_config, dict):
+        raise ValueError(f"expected dict config, got {type(ds_config)}")
+    if "elasticity" not in ds_config:
+        raise ElasticityConfigError(
+            "'elasticity' section missing from config")
+    section = ds_config["elasticity"]
+    if not section.get("enabled", False):
+        raise ElasticityConfigError("elasticity is disabled in config")
+
+    cfg = ElasticityConfig(section)
+    version = float(cfg.version)
+    if cfg.model_parallel_size > 1 and version != 0.2:
+        raise ElasticityConfigError(
+            f"elasticity v{cfg.version} does not support model parallelism")
+    if version > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"elasticity v{cfg.version} > latest supported "
+            f"v{LATEST_ELASTICITY_VERSION}")
+
+    micro_candidate = None
+    if version == 0.1:
+        batch, valid = get_compatible_gpus(
+            cfg.micro_batches, cfg.max_acceptable_batch_size,
+            cfg.min_gpus, cfg.max_gpus, cfg.prefer_larger_batch_size)
+    elif version == 0.2:
+        current = world_size
+        if current == 0:
+            ws = os.environ.get("WORLD_SIZE", "")
+            if not ws.isnumeric():
+                raise ElasticityConfigError(
+                    "elasticity v0.2 needs WORLD_SIZE (argument or env var)")
+            current = int(ws)
+        batch, valid, micro_candidate = _compatibles_v02_entry(cfg, current)
+    else:
+        raise NotImplementedError(f"unknown elasticity version {cfg.version}")
+    batch = int(batch)
+
+    logger.info(f"Elastic batch {batch}, valid dp world sizes: {valid}")
+
+    def largest_divisible_micro(ws):
+        for m in sorted(set(cfg.micro_batches), reverse=True):
+            if (batch // ws) % m == 0:
+                return m
+        raise ElasticityError(
+            f"no micro batch in {cfg.micro_batches} divides "
+            f"{batch}/{ws}")
+
+    if world_size > 0:
+        if world_size not in valid:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not in valid chip counts {valid}")
+        return batch, valid, largest_divisible_micro(world_size)
+    if return_microbatch:
+        if version == 0.2:
+            return batch, valid, micro_candidate
+        return batch, valid, largest_divisible_micro(world_size or 1)
+    return batch, valid
+
+
+def _compatibles_v02_entry(cfg, current_num_gpus):
+    return _compatible_gpus_v02(
+        cfg.micro_batches, cfg.max_acceptable_batch_size, current_num_gpus,
+        cfg.min_gpus, cfg.max_gpus, cfg.prefer_larger_batch_size,
+        cfg.num_gpus_per_node, cfg.model_parallel_size)

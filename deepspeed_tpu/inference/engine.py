@@ -7,9 +7,16 @@ PartitionSpec rule set instead of module surgery,
 module_inject/auto_tp.py:188), jit-compiles the forward (the CUDA-graph
 analog, engine.py:518-546), and provides greedy/sampling ``generate``.
 
-Round-1 scope: full-sequence forward + incremental decode recompute.
-The paged-KV ragged engine (FastGen parity) lands with the inference
-milestone in ``deepspeed_tpu/inference/v2``.
+Decode design (models exposing ``init_cache``, e.g. Llama): one jitted
+prefill over the prompt writes the KV cache, then the ENTIRE decode loop
+runs as a single ``lax.scan`` jit — sampling included — so a generate
+call costs two dispatches total and O(T) attention work (the reference's
+softmax_context KV-cache kernel semantics,
+csrc/transformer/inference/csrc/pt_binding.cpp, done the XLA way).
+Models without a cache fall back to fixed-buffer full recompute.
+
+The paged-KV ragged engine (FastGen parity) lives in
+``deepspeed_tpu/inference/v2``.
 """
 
 from typing import Any, Optional
@@ -23,6 +30,36 @@ from ..parallel.mesh import MeshConfig, TENSOR_AXIS, mesh_manager
 from ..runtime.zero.partition import ZeroShardingRules
 from ..utils.logging import logger
 from .config import DeepSpeedInferenceConfig
+
+
+def make_sampler(temperature: float, top_k: Optional[int]):
+    """Token sampler usable under jit. Greedy when temperature == 0."""
+
+    def sample(logits, rng):
+        logits = logits.astype(jnp.float32)
+        if temperature and temperature > 0:
+            logits = logits / temperature
+            if top_k:
+                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                logits = jnp.where(logits < kth,
+                                   jnp.finfo(logits.dtype).min, logits)
+            return jax.random.categorical(rng, logits, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    return sample
+
+
+def _truncate_at_eos(full, prompt_len, eos_token_id):
+    """Replace tokens after the first EOS in each row's generated part
+    (batched generate cannot early-exit inside the scan; this post-pass
+    gives the same user-visible result)."""
+    gen = full[:, prompt_len:]
+    eos_pos = np.where(gen == eos_token_id, np.arange(gen.shape[1])[None, :],
+                       gen.shape[1])
+    first = eos_pos.min(axis=1)
+    mask = np.arange(gen.shape[1])[None, :] > first[:, None]
+    gen = np.where(mask, eos_token_id, gen)
+    return np.concatenate([full[:, :prompt_len], gen], axis=1)
 
 
 class InferenceEngine:
@@ -52,14 +89,28 @@ class InferenceEngine:
         if params is not None:
             self.set_params(params)
         self._jit_forward = None
+        self._decode_fns = {}  # (shape/sampler key) -> (prefill, decode)
 
     def set_params(self, params):
         """Cast to the inference dtype and place with TP sharding (the
-        checkpoint-load + weight-shard step, reference engine.py:325)."""
+        checkpoint-load + weight-shard step, reference engine.py:325).
+
+        With no model-provided rules and tp > 1, AutoTP infers the
+        column/row pattern from the param tree itself (reference:
+        module_inject/auto_tp.py:188)."""
         cast = jax.tree_util.tree_map(
             lambda x: jnp.asarray(x).astype(self.dtype)
             if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
             else jnp.asarray(x), params)
+        tp = dict(self.mesh.shape).get(TENSOR_AXIS, 1)
+        if self._rules.tensor_rules is None and tp > 1:
+            from ..module_inject import infer_tensor_sharding_rules
+            from ..moe.experts import moe_tensor_rules
+            from ..runtime.zero.partition import compose_tensor_rules
+            # moe rules first: stacked [E, ...] expert banks must land on
+            # the expert axis even when a heuristic TP rule also matches
+            self._rules.tensor_rules = compose_tensor_rules(
+                moe_tensor_rules, infer_tensor_sharding_rules(cast, tp))
         sh = self._rules.param_shardings(cast)
         self.params = jax.jit(lambda t: t, out_shardings=sh)(cast)
 
@@ -85,37 +136,100 @@ class InferenceEngine:
                  top_k: Optional[int] = None, rng=None, eos_token_id=None):
         """Autoregressive decode. Greedy when temperature==0.
 
-        Runs on a fixed-size token buffer so the forward compiles once:
-        with causal attention, logits at position t ignore the padding
-        after t, so the buffer can be oversized and sliced at the live
-        position (the bucketed-compilation idea Dynamic SplitFuse uses,
-        blogs/deepspeed-fastgen/README.md:90-103)."""
+        Models exposing ``init_cache`` (model ``__call__`` accepting
+        ``cache``/``cache_index``) get the KV-cache path: one prefill +
+        one scanned decode jit, O(T) attention per emitted token. Others
+        fall back to fixed-buffer full recompute."""
         ids = np.asarray(input_ids)
         if ids.ndim == 1:
             ids = ids[None]
+        if self.params is None:
+            raise ValueError("set_params(params) before generate")
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if hasattr(self.module, "init_cache"):
+            return self._generate_cached(ids, max_new_tokens, temperature,
+                                         top_k, rng, eos_token_id)
+        return self._generate_recompute(ids, max_new_tokens, temperature,
+                                        top_k, rng, eos_token_id)
+
+    # -- KV-cache path ------------------------------------------------
+    def _get_decode_fns(self, B, T0, max_new, temperature, top_k):
+        key = (B, T0, max_new, float(temperature or 0.0), top_k)
+        if key in self._decode_fns:
+            return self._decode_fns[key]
+        apply_fn = self._apply_fn
+        sample = make_sampler(temperature, top_k)
+
+        def prefill(params, ids, cache, rng):
+            # cache_index=0 is static: the model takes the flash-kernel
+            # prefill branch (models/llama.py:128)
+            logits, cache = apply_fn(params, ids, cache=cache, cache_index=0)
+            first = sample(logits[:, -1, :], rng)
+            return first, cache
+
+        def decode(params, cache, first_tok, rng):
+            def step(carry, _):
+                cache, tok, idx, rng = carry
+                logits, cache = apply_fn(params, tok[:, None], cache=cache,
+                                         cache_index=idx)
+                rng, sub = jax.random.split(rng)
+                nxt = sample(logits[:, -1, :], sub)
+                return (cache, nxt, idx + 1, rng), nxt
+
+            init = (cache, first_tok, jnp.int32(T0), rng)
+            _, toks = jax.lax.scan(step, init, None, length=max_new - 1)
+            return toks.T  # [B, max_new-1]
+
+        fns = (jax.jit(prefill, donate_argnums=(2,)),
+               jax.jit(decode, donate_argnums=(1,)))
+        self._decode_fns[key] = fns
+        return fns
+
+    def _generate_cached(self, ids, max_new, temperature, top_k, rng,
+                         eos_token_id):
+        B, T0 = ids.shape
+        total = T0 + max_new
+        cache = self.module.init_cache(B, total, dtype=self.dtype)
+        prefill, decode = self._get_decode_fns(B, T0, max_new, temperature,
+                                               top_k)
+        rng, r1, r2 = jax.random.split(rng, 3)
+        first, cache = prefill(self.params, jnp.asarray(ids), cache, r1)
+        if max_new > 1:
+            rest = decode(self.params, cache, first, r2)
+            out = jnp.concatenate([first[:, None], rest], axis=1)
+        else:
+            out = first[:, None]
+        out = np.asarray(out)
+        full = np.concatenate([np.asarray(ids), out], axis=1)
+        if eos_token_id is not None:
+            full = _truncate_at_eos(full, T0, eos_token_id)
+        return full
+
+    # -- no-cache fallback --------------------------------------------
+    def _generate_recompute(self, ids, max_new_tokens, temperature, top_k,
+                            rng, eos_token_id):
+        """Fixed-size buffer + full forward per token: with causal
+        attention, logits at position t ignore padding after t, so the
+        buffer is oversized and sliced at the live position (the
+        bucketed-compilation idea Dynamic SplitFuse uses,
+        blogs/deepspeed-fastgen/README.md:90-103)."""
         B, T0 = ids.shape
         total = T0 + max_new_tokens
-        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        sample = make_sampler(temperature, top_k)
         buf = np.zeros((B, total), dtype=ids.dtype)
         buf[:, :T0] = ids
         cur = T0
         for _ in range(max_new_tokens):
             logits = self.forward(buf)  # fixed shape -> single compile
-            next_logits = logits[:, cur - 1, :]
-            if temperature and temperature > 0:
-                next_logits = next_logits / temperature
-                if top_k:
-                    kth = jnp.sort(next_logits, axis=-1)[:, -top_k][:, None]
-                    next_logits = jnp.where(next_logits < kth,
-                                            jnp.finfo(next_logits.dtype).min,
-                                            next_logits)
-                rng, sub = jax.random.split(rng)
-                nxt = jax.random.categorical(sub, next_logits, axis=-1)
-            else:
-                nxt = jnp.argmax(next_logits, axis=-1)
-            nxt = np.asarray(nxt)
+            rng, sub = jax.random.split(rng)
+            nxt = np.asarray(sample(logits[:, cur - 1, :], sub))
             buf[:, cur] = nxt
             cur += 1
             if eos_token_id is not None and np.all(nxt == eos_token_id):
                 break
-        return buf[:, :cur]
+        # same output contract as the cached path: always [B, T0+max_new],
+        # per-row tokens after the first EOS replaced by EOS
+        if eos_token_id is not None:
+            buf[:, cur:] = eos_token_id
+            return _truncate_at_eos(buf, T0, eos_token_id)
+        return buf

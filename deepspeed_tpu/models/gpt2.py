@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..ops.pallas_kernels import flash_attention
 from ..parallel.mesh import TENSOR_AXIS
 
 
@@ -35,6 +36,7 @@ class GPT2Config:
     layer_norm_epsilon: float = 1e-5
     initializer_range: float = 0.02
     use_remat: bool = False  # activation checkpointing per block
+    use_flash: bool = True   # fused Pallas attention (no attn-prob dropout)
 
     @staticmethod
     def small():
@@ -70,12 +72,17 @@ class CausalSelfAttention(nn.Module):
         q = q.reshape(B, T, nh, hd)
         k = k.reshape(B, T, nh, hd)
         v = v.reshape(B, T, nh, hd)
-        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(hd).astype(x.dtype)
-        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
-        att = jnp.where(mask[None, None], att, jnp.finfo(att.dtype).min)
-        att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(x.dtype)
-        att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
-        y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, C)
+        if cfg.use_flash and (deterministic or cfg.dropout == 0.0):
+            # fused Pallas flash kernel — never materializes the [T,T]
+            # score matrix (the attn-prob dropout is a no-op here anyway)
+            y = flash_attention(q, k, v, causal=True).reshape(B, T, C)
+        else:
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(hd).astype(x.dtype)
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            att = jnp.where(mask[None, None], att, jnp.finfo(att.dtype).min)
+            att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(x.dtype)
+            att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
+            y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, C)
         y = dense(cfg.n_embd, name="c_proj")(y)
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
         return y
@@ -148,13 +155,21 @@ class GPT2LMHeadModel(nn.Module):
 
 
 def cross_entropy_loss(logits, labels, ignore_index=-100):
-    """Shifted next-token CE, mean over valid positions (fp32 accumulate)."""
-    shift_logits = logits[:, :-1].astype(jnp.float32)
+    """Shifted next-token CE, mean over valid positions (fp32 accumulate).
+
+    logsumexp formulation: the only [B,T,V]-sized fp32 tensor is fused
+    into the reduction — no materialized fp32 copy of the logits (a
+    [B,T,V] fp32 temp is ~2x the largest activation and OOMs long-seq
+    configs; XLA fuses the cast+max+sum chain into two passes)."""
+    shift_logits = logits[:, :-1]
     shift_labels = labels[:, 1:]
     valid = shift_labels != ignore_index
     safe_labels = jnp.where(valid, shift_labels, 0)
-    logp = jax.nn.log_softmax(shift_logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    lse = jax.scipy.special.logsumexp(
+        shift_logits.astype(jnp.float32), axis=-1)  # [B,T] fp32
+    picked = jnp.take_along_axis(
+        shift_logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = lse - picked.astype(jnp.float32)
     nll = jnp.where(valid, nll, 0.0)
     return nll.sum() / jnp.maximum(valid.sum(), 1)
 

@@ -1,0 +1,1 @@
+from .auto_tp import AutoTP, infer_tensor_sharding_rules

@@ -51,8 +51,13 @@ class Experts(nn.Module):
 
 def moe_tensor_rules(name: str, shape):
     """PartitionSpec rule for stacked expert params: leading dim on the
-    expert axis (compose with model TP rules in ZeroShardingRules)."""
-    if "experts" in name:
+    expert axis (compose with model TP rules in ZeroShardingRules).
+
+    Matches the exact ``experts`` path segment (the module scope the
+    vmapped bank creates above; names are dot-joined by
+    utils/tree.py:_path_str), not a substring — a user param named
+    e.g. ``my_experts_proj`` must not be expert-sharded."""
+    if "experts" in name.split("."):
         from jax.sharding import PartitionSpec as P
         return P(*([EXPERT_AXIS] + [None] * (len(shape) - 1)))
     return None

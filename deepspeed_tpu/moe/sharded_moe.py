@@ -174,7 +174,14 @@ def top2gating(logits, capacity_factor: float = 1.0, min_capacity: int = 8,
 
 class TopKGate(nn.Module):
     """Gate network (reference: sharded_moe.py:386 TopKGate — an fp32
-    Linear over the model dim + top-k gating)."""
+    Linear over the model dim + top-k gating).
+
+    Behavioral difference from the reference (intentional): 2nd-expert
+    Gumbel sampling (``top2_2nd_expert_sampling``) and jitter noise are
+    applied only when ``train=True``; the reference samples
+    unconditionally, so its eval routing is stochastic. Deterministic
+    eval routing is the deliberate choice here.
+    """
     num_experts: int
     k: int = 1
     capacity_factor: float = 1.0

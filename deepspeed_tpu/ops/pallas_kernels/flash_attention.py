@@ -114,9 +114,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
     l_safe = jnp.where(l > 0, l, 1.0)
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # logsumexp of the scaled scores, used by the backward kernels
+    # logsumexp of the scaled scores, used by the backward kernels.
+    # Stored with a trailing singleton dim: Mosaic requires the last two
+    # block dims to be (8k, 128k) or equal to the array dims, which a
+    # bare (1, 1, block_q) block violates.
     lse = jnp.where(l > 0, m + jnp.log(l_safe), _NEG_INF)
-    lse_ref[0, 0] = lse.astype(jnp.float32)
+    lse_ref[0, 0] = lse.astype(jnp.float32)[:, None]
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
@@ -138,11 +141,11 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, Hq, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, Hq, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hq, Tq, 1), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -158,8 +161,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     block_q = q_ref.shape[2]
     q = q_ref[0, 0].astype(jnp.float32) * sm_scale
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
+    lse = lse_ref[0, 0, :, 0]
+    delta = delta_ref[0, 0, :, 0]
 
     num_k_blocks = kv_len // block_k
     if causal:
@@ -213,8 +216,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) \
             * sm_scale
         do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [BQ,BK]
         if causal:
@@ -256,7 +259,7 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
     offset = Tk - Tq
     do = g
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)  # [B,Hq,Tq]
+                    axis=-1, keepdims=True)  # [B,Hq,Tq,1] (lane-dim rule)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
@@ -267,8 +270,8 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h // rep, 0, 0)),
             pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h // rep, 0, 0)),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -285,8 +288,8 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, block_k, D), lambda b, i, h: (b, h // rep, i, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, i, h: (b, h // rep, i, 0)),
             pl.BlockSpec((1, 1, Tq, D), lambda b, i, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tq), lambda b, i, h: (b, h, 0)),
-            pl.BlockSpec((1, 1, Tq), lambda b, i, h: (b, h, 0)),
+            pl.BlockSpec((1, 1, Tq, 1), lambda b, i, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tq, 1), lambda b, i, h: (b, h, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D), lambda b, i, h: (b, h // rep, i, 0)),
@@ -346,9 +349,12 @@ def flash_attention(q, k, v, causal=True, sm_scale=None,
 
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tk)
-    # MXU/VPU lane alignment: blocks and head dim in multiples of 128
+    # Sequence blocks in multiples of 128 for MXU tiling; head dim in
+    # multiples of 64 (Mosaic pads a 64-wide minor dim to the 128-lane
+    # registers — half lane efficiency on the D axis, still far cheaper
+    # than materializing [T,T] scores in HBM).
     tileable = (Tq % block_q == 0 and Tk % block_k == 0 and Hq % Hkv == 0
-                and D % 128 == 0 and block_q % 128 == 0 and block_k % 128 == 0)
+                and D % 64 == 0 and block_q % 128 == 0 and block_k % 128 == 0)
     if not tileable:
         if force_pallas:
             raise ValueError(

@@ -1,0 +1,2 @@
+from .flops_profiler import (FlopsProfiler, get_model_profile,
+                             cost_analysis_of, peak_tflops)

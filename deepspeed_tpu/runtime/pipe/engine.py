@@ -134,8 +134,14 @@ class _PipelinedLM:
         specs = self.module.layer_specs
 
         def sig(s):
+            if isinstance(s, TiedLayerSpec):
+                # Tied specs must never merge into the homogeneous block
+                # run — merging would stack fresh per-layer params where
+                # the user requested weight tying. Unique per object, so
+                # even two identical tied specs stay separate.
+                return ("tied", id(s))
             if isinstance(s, LayerSpec):
-                return (s.typename, s.module_args,
+                return (type(s), s.typename, s.module_args,
                         tuple(sorted(s.module_kwargs.items())))
             return type(s)
 

@@ -69,6 +69,25 @@ def shard_leaf_spec(shape, mesh: Mesh, axis_name: str, base_spec: Optional[P] = 
     return P(*base)
 
 
+def compose_tensor_rules(*rules):
+    """First-match composition of (name, shape) -> PartitionSpec rules;
+    None entries are skipped. Returns None when nothing remains."""
+    active = [r for r in rules if r is not None]
+    if not active:
+        return None
+    if len(active) == 1:
+        return active[0]
+
+    def composed(name, shape):
+        for r in active:
+            spec = r(name, shape)
+            if spec is not None:
+                return spec
+        return None
+
+    return composed
+
+
 @dataclasses.dataclass
 class ZeroShardingRules:
     """Produces shardings for params / grads / optimizer states given the

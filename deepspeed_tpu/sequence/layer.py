@@ -59,6 +59,14 @@ def ulysses_attention(local_attn: Callable, q, k, v, *args,
     sees full sequence length and ``heads / sp`` heads.
     """
     if _axis_bound(axis_name):
+        sp = jax.lax.axis_size(axis_name)
+        for arr, what in ((q, "query"), (k, "key"), (v, "value")):
+            if arr.shape[scatter_idx] % sp != 0:
+                raise ValueError(
+                    f"Ulysses requires {what} heads "
+                    f"({arr.shape[scatter_idx]}) divisible by the "
+                    f"sequence-parallel degree ({sp}); GQA kv heads < sp "
+                    f"need ring attention instead (sequence/ring.py)")
         qh = seq_all_to_all(q, scatter_idx, gather_idx, axis_name)
         kh = seq_all_to_all(k, scatter_idx, gather_idx, axis_name)
         vh = seq_all_to_all(v, scatter_idx, gather_idx, axis_name)
@@ -68,8 +76,15 @@ def ulysses_attention(local_attn: Callable, q, k, v, *args,
     # SPMD path: swap which dim carries the sequence axis; GSPMD lowers
     # each constraint transition to an all-to-all over ICI.
     mesh = mesh_manager.mesh
-    if mesh_manager.sequence_parallel_world_size() == 1:
+    sp = mesh_manager.sequence_parallel_world_size()
+    if sp == 1:
         return local_attn(q, k, v, *args, **kwargs)
+    for arr, what in ((q, "query"), (k, "key"), (v, "value")):
+        if arr.shape[scatter_idx] % sp != 0:
+            raise ValueError(
+                f"Ulysses requires {what} heads ({arr.shape[scatter_idx]}) "
+                f"divisible by the sequence-parallel degree ({sp}); GQA kv "
+                f"heads < sp need ring attention instead (sequence/ring.py)")
 
     def spec(seq_dim_sharded):
         ndim = q.ndim

@@ -36,6 +36,10 @@ def ring_attention(q, k, v, axis_name: str = SEQUENCE_AXIS, causal: bool = True,
     """
     B, Tl, Hq, D = q.shape
     Hkv = k.shape[2]
+    if Hq % Hkv != 0:
+        raise ValueError(
+            f"GQA requires query heads ({Hq}) to be a multiple of kv "
+            f"heads ({Hkv})")
     rep = Hq // Hkv
     if sm_scale is None:
         sm_scale = 1.0 / (D ** 0.5)

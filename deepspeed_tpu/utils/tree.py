@@ -19,17 +19,7 @@ def named_leaves(tree):
 
 
 def _path_str(path):
-    parts = []
-    for p in path:
-        if isinstance(p, jax.tree_util.DictKey):
-            parts.append(str(p.key))
-        elif isinstance(p, jax.tree_util.SequenceKey):
-            parts.append(str(p.idx))
-        elif isinstance(p, jax.tree_util.GetAttrKey):
-            parts.append(str(p.name))
-        else:
-            parts.append(str(p))
-    return ".".join(parts)
+    return ".".join(_key_str(p) for p in path)
 
 
 def flatten_with_names(tree):
@@ -38,6 +28,26 @@ def flatten_with_names(tree):
     names = [_path_str(p) for p, _ in flat]
     leaves = [l for _, l in flat]
     return names, leaves, treedef
+
+
+def flatten_with_name_parts(tree):
+    """Return (parts, leaves, treedef); ``parts`` are per-leaf lists of
+    path segments (no lossy joining — callers that build filesystem
+    layouts from names need the segments to stay collision-free)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    parts = [[_key_str(k) for k in p] for p, _ in flat]
+    leaves = [l for _, l in flat]
+    return parts, leaves, treedef
+
+
+def _key_str(p):
+    if isinstance(p, jax.tree_util.DictKey):
+        return str(p.key)
+    if isinstance(p, jax.tree_util.SequenceKey):
+        return str(p.idx)
+    if isinstance(p, jax.tree_util.GetAttrKey):
+        return str(p.name)
+    return str(p)
 
 
 def tree_bytes(tree):

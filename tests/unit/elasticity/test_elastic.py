@@ -1,0 +1,125 @@
+"""Elastic batch-math parity tests.
+
+Expected values pinned from the reference's own suite
+(reference: tests/unit/elasticity/test_elastic.py — batch 9792 with 23
+valid chip counts for the 10k config, mbsize 17 at world 64, etc.).
+"""
+
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity import (ElasticityConfigError, ElasticityError,
+                                      ElasticityIncompatibleWorldSize,
+                                      compute_elastic_config)
+
+
+@pytest.fixture
+def ds_config():
+    return {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 10000,
+            "micro_batch_sizes": [8, 12, 16, 17],
+            "min_gpus": 32,
+            "max_gpus": 1500,
+            "min_time": 20,
+            "version": 0.1,
+        }
+    }
+
+
+def test_basic_10k(ds_config):
+    batch, valid = compute_elastic_config(ds_config)
+    for w in valid:
+        assert batch % w == 0
+        per = batch // w
+        assert any(per % mb == 0
+                   for mb in ds_config["elasticity"]["micro_batch_sizes"])
+    assert len(valid) == 23
+    assert batch == 9792
+
+
+def test_disabled(ds_config):
+    ds_config["elasticity"]["enabled"] = False
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config)
+
+
+def test_valid_world_size(ds_config):
+    batch, valid, mbsize = compute_elastic_config(ds_config, world_size=64)
+    assert mbsize == 17
+
+
+def test_invalid_world_size(ds_config):
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(ds_config, world_size=128)
+
+
+def test_future_elastic_version(ds_config):
+    ds_config["elasticity"]["version"] = 0.3
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config)
+
+
+def test_missing_max_batch(ds_config):
+    del ds_config["elasticity"]["max_train_batch_size"]
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config)
+
+
+def test_missing_micro_batch(ds_config):
+    del ds_config["elasticity"]["micro_batch_sizes"]
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config)
+
+
+def test_empty_config():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": True}})
+
+
+@pytest.mark.parametrize("key,value", [
+    ("micro_batch_sizes", [1, "a", 3]),
+    ("micro_batch_sizes", [1, 0, 3]),
+    ("micro_batch_sizes", "not-a-list"),
+    ("min_gpus", 0),
+    ("max_gpus", 0),
+])
+def test_invalid_config_values(key, value, ds_config):
+    ds_config["elasticity"][key] = value
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config)
+
+
+def test_model_parallel_v1_invalid(ds_config):
+    ds_config["elasticity"]["model_parallel_size"] = 4
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config)
+
+
+def test_model_parallel_v2_valid(ds_config, monkeypatch):
+    ds_config["elasticity"].update(
+        model_parallel_size=4, num_gpus_per_node=8, version=0.2)
+    monkeypatch.setenv("WORLD_SIZE", "16")
+    compute_elastic_config(ds_config)
+
+
+def test_model_parallel_v2_invalid(ds_config):
+    ds_config["elasticity"].update(
+        model_parallel_size=16, num_gpus_per_node=8, version=0.2)
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config, world_size=16)
+
+
+def test_proper_mbsz(ds_config):
+    ds_config["elasticity"].update(
+        max_train_batch_size=32, micro_batch_sizes=[1, 2, 3, 7], min_gpus=1)
+    batch, valid, mbsize = compute_elastic_config(ds_config, world_size=7)
+    assert mbsize == 3
+
+
+def test_v02_determinism(ds_config):
+    ds_config["elasticity"].update(version=0.2, num_gpus_per_node=4)
+    a = compute_elastic_config(ds_config, world_size=64)
+    b = compute_elastic_config(ds_config, world_size=64)
+    assert a == b

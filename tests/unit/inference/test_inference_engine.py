@@ -52,3 +52,62 @@ def test_generate_greedy(model_and_params, eight_devices):
     # greedy decode is deterministic
     out2 = engine.generate(np.array([[1, 2, 3]], np.int32), max_new_tokens=4)
     np.testing.assert_array_equal(out, out2)
+
+
+class TestKVCacheDecode:
+    """Cached decode path (reference analog: softmax_context KV-cache
+    attention, ops/transformer/inference/op_binding/softmax_context.py)."""
+
+    @pytest.fixture
+    def llama(self):
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        ids = np.zeros((1, 8), np.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)
+        return cfg, model, params
+
+    def test_cached_matches_recompute(self, llama, eight_devices):
+        """KV-cache greedy decode must produce the same tokens as the
+        full-recompute fallback."""
+        cfg, model, params = llama
+        prompt = np.array([[3, 1, 4, 1, 5]], np.int32)
+
+        engine = deepspeed_tpu.init_inference(model, tp_size=2,
+                                              dtype="float32")
+        engine.set_params(params)
+        assert hasattr(model, "init_cache")
+        out_cached = engine.generate(prompt, max_new_tokens=6)
+
+        out_recompute = engine._generate_recompute(
+            prompt, 6, 0.0, None, jax.random.PRNGKey(0), None)
+        np.testing.assert_array_equal(out_cached, np.asarray(out_recompute))
+
+    def test_cached_decode_is_O_total(self, llama, eight_devices):
+        """The scanned decode compiles two functions total (prefill +
+        decode), regardless of token count."""
+        cfg, model, params = llama
+        engine = deepspeed_tpu.init_inference(model, tp_size=1,
+                                              dtype="float32")
+        engine.set_params(params)
+        engine.generate(np.array([[1, 2]], np.int32), max_new_tokens=8)
+        assert len(engine._decode_fns) == 1
+        engine.generate(np.array([[1, 2]], np.int32), max_new_tokens=8)
+        assert len(engine._decode_fns) == 1  # cache hit, no recompiles
+
+    def test_eos_truncation(self, llama, eight_devices):
+        from deepspeed_tpu.inference.engine import _truncate_at_eos
+        full = np.array([[9, 9, 5, 2, 7, 2, 6]])
+        out = _truncate_at_eos(full, 2, eos_token_id=2)
+        # prompt [9,9] intact; generated [5,2,7,2,6] -> [5,2,2,2,2]
+        np.testing.assert_array_equal(out, [[9, 9, 5, 2, 2, 2, 2]])
+
+    def test_sampling_with_temperature(self, llama, eight_devices):
+        cfg, model, params = llama
+        engine = deepspeed_tpu.init_inference(model, tp_size=1)
+        engine.set_params(params)
+        out = engine.generate(np.array([[1, 2, 3]], np.int32),
+                              max_new_tokens=5, temperature=0.8, top_k=10,
+                              rng=jax.random.PRNGKey(7))
+        assert out.shape == (1, 8)
+        assert (np.asarray(out) < cfg.vocab_size).all()

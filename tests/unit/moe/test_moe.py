@@ -163,3 +163,49 @@ class TestCapacityBins:
         cap = bins.get_binned_capacity(20)
         _, combine, _, _ = top1gating(logits, 1.0, 4, capacity=cap)
         assert combine.shape[-1] == cap
+
+
+class TestMoEEngineSharding:
+
+    def test_engine_shards_expert_bank(self, eight_devices, rng):
+        """Engine-trained MoE model must place stacked expert params on
+        the expert mesh axis (the moe_tensor_rules composition — without
+        it the [E, ...] banks replicate at E-times memory)."""
+        import flax.linen as nn
+
+        import deepspeed_tpu
+        from deepspeed_tpu.parallel.mesh import EXPERT_AXIS
+
+        class TinyMoEModel(nn.Module):
+            @nn.compact
+            def __call__(self, batch_x, labels=None):
+                out, l_aux, _ = MoE(hidden_size=16, num_experts=8,
+                                    min_capacity=8,
+                                    expert_kwargs={"d_ff": 32})(batch_x)
+                loss = jnp.mean((out - batch_x) ** 2) + 0.01 * l_aux
+                return loss, out
+
+        mesh_manager.reset()
+        mesh_manager.init(MeshConfig(data=1, expert=8),
+                          devices=eight_devices)
+        config = {
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "steps_per_print": 0,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=TinyMoEModel(), config=config)
+        x = jnp.asarray(rng.standard_normal((4, 8, 16)).astype(np.float32))
+        loss = engine.train_batch(batch={"batch_x": x})
+        assert np.isfinite(float(loss))
+
+        from deepspeed_tpu.utils.tree import flatten_with_names
+        names, leaves, _ = flatten_with_names(engine.state.master_params)
+        expert_leaves = [(n, l) for n, l in zip(names, leaves)
+                         if "experts" in n.split(".") and hasattr(l, "sharding")]
+        assert expert_leaves, "no expert params found"
+        for n, l in expert_leaves:
+            spec = l.sharding.spec
+            assert spec and spec[0] == EXPERT_AXIS, \
+                f"{n} not sharded on expert axis: {spec}"

@@ -1,0 +1,27 @@
+"""Every subpackage and module must import cleanly.
+
+Closes the round-1 hole where ``deepspeed_tpu.elasticity`` shipped
+re-exporting modules that did not exist and nothing noticed.
+"""
+
+import importlib
+import pkgutil
+
+import deepspeed_tpu
+
+
+def _iter_module_names():
+    yield "deepspeed_tpu"
+    for info in pkgutil.walk_packages(deepspeed_tpu.__path__,
+                                      prefix="deepspeed_tpu."):
+        yield info.name
+
+
+def test_all_modules_importable():
+    failures = []
+    for name in _iter_module_names():
+        try:
+            importlib.import_module(name)
+        except Exception as e:  # noqa: BLE001 — collecting all failures
+            failures.append(f"{name}: {type(e).__name__}: {e}")
+    assert not failures, "unimportable modules:\n" + "\n".join(failures)
