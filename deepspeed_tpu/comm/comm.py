@@ -97,8 +97,19 @@ def init_distributed(dist_backend=None,
     multi_host = world_size > 1 or _os.environ.get("JAX_COORDINATOR_ADDRESS") \
         or int(_os.environ.get("WORLD_SIZE", "1")) > 1
     if multi_host and not _initialized:
+        # jax auto-detects SLURM/OMPI/TPU-metadata clusters but has no
+        # generic env-var path, so the launcher's rendezvous env
+        # (launcher/launch.py build_env) is forwarded explicitly here.
+        kwargs = {}
+        if _os.environ.get("JAX_COORDINATOR_ADDRESS"):
+            kwargs = dict(
+                coordinator_address=_os.environ["JAX_COORDINATOR_ADDRESS"],
+                num_processes=int(_os.environ.get(
+                    "JAX_NUM_PROCESSES", _os.environ.get("WORLD_SIZE", "1"))),
+                process_id=int(_os.environ.get(
+                    "JAX_PROCESS_ID", _os.environ.get("RANK", "0"))))
         try:
-            _jax.distributed.initialize()
+            _jax.distributed.initialize(**kwargs)
         except Exception as e:  # already initialized / single process
             if verbose:
                 logger.info(f"jax.distributed.initialize skipped: {e}")

@@ -227,10 +227,16 @@ def compute_elastic_config(ds_config: dict, target_deepspeed_version: str = "",
             f"{batch}/{ws}")
 
     if world_size > 0:
-        if world_size not in valid:
+        # ``valid`` holds DATA-PARALLEL world sizes; a chip count must be
+        # reduced by the model-parallel degree before membership / batch
+        # arithmetic (reference: valid_gpus are dp ranks in v0.2)
+        dp_world = world_size // cfg.model_parallel_size
+        if dp_world not in valid:
             raise ElasticityIncompatibleWorldSize(
-                f"world size {world_size} not in valid chip counts {valid}")
-        return batch, valid, largest_divisible_micro(world_size)
+                f"world size {world_size} (dp {dp_world} at "
+                f"mp={cfg.model_parallel_size}) not in valid dp world "
+                f"sizes {valid}")
+        return batch, valid, largest_divisible_micro(dp_world)
     if return_microbatch:
         if version == 0.2:
             return batch, valid, micro_candidate

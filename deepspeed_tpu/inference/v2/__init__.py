@@ -1,0 +1,5 @@
+from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+from .ragged_manager import (BlockedKVCacheManager, DSStateManager,
+                             SchedulingError, SchedulingResult,
+                             SequenceDescriptor)
+from .ragged_wrapper import RaggedBatchWrapper

@@ -1,0 +1,191 @@
+"""InferenceEngineV2 — FastGen-parity continuous batching engine.
+
+Reference: deepspeed/inference/v2/engine_v2.py:30 ``InferenceEngineV2``
+(``put(batch_uids, batch_tokens)`` forward over a RaggedBatchWrapper,
+``can_schedule``/SchedulingResult, ``flush``) + scheduling_utils.py.
+
+TPU-native: the device function is ONE jitted ragged forward with fixed
+shapes (token budget / seq slots / block tables); the KV pools are a
+donated pytree that stays on device between calls. Dynamic SplitFuse
+(fixed token budgets, prompts split across steps, decodes fused in —
+blogs/deepspeed-fastgen/README.md:90-103) is the ``schedule`` method.
+"""
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.llama import LlamaConfig
+from ...utils.logging import logger
+from .model import init_kv_pools, ragged_forward
+from .ragged_manager import (DSStateManager, SchedulingError,
+                             SchedulingResult)
+from .ragged_wrapper import RaggedBatchWrapper
+
+
+@dataclasses.dataclass
+class RaggedInferenceEngineConfig:
+    """Engine limits (reference: v2/config_v2.py RaggedInferenceEngineConfig
+    + DSStateManagerConfig)."""
+    token_budget: int = 256          # max tokens per forward (SplitFuse)
+    max_ragged_sequence_count: int = 8
+    max_tracked_sequences: int = 64
+    n_kv_blocks: int = 128
+    kv_block_size: int = 128
+    max_blocks_per_seq: int = 16
+    kv_dtype: str = "bfloat16"
+
+
+class InferenceEngineV2:
+
+    def __init__(self, params, config: LlamaConfig,
+                 engine_config: Optional[RaggedInferenceEngineConfig] = None):
+        self._config = engine_config or RaggedInferenceEngineConfig()
+        ec = self._config
+        self.model_config = config
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self._state_manager = DSStateManager(
+            max_tracked_sequences=ec.max_tracked_sequences,
+            max_ragged_sequence_count=ec.max_ragged_sequence_count,
+            max_context=ec.max_blocks_per_seq * ec.kv_block_size,
+            n_blocks=ec.n_kv_blocks, block_size=ec.kv_block_size)
+        self.pools = init_kv_pools(config, ec.n_kv_blocks,
+                                   ec.kv_block_size,
+                                   dtype=jnp.dtype(ec.kv_dtype))
+        self._jit_forward = jax.jit(
+            lambda params, pools, *args: ragged_forward(
+                params, config, pools, *args,
+                block_size=ec.kv_block_size),
+            donate_argnums=(1,))
+
+    # -- reference API -------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return self._state_manager.free_blocks
+
+    def query(self, uid: int) -> Tuple[int, int]:
+        """(max_context_remaining, seen_tokens) for a sequence."""
+        seq = self._state_manager.get_sequence(uid)
+        seen = seq.seen_tokens if seq else 0
+        return self._state_manager.max_context - seen, seen
+
+    def can_schedule(self, uids: Iterable[int],
+                     lengths: Iterable[int]) -> SchedulingResult:
+        ec = self._config
+        uids, lengths = list(uids), list(lengths)
+        if len(uids) > ec.max_ragged_sequence_count:
+            return SchedulingResult.BatchFull
+        if sum(lengths) > ec.token_budget:
+            return SchedulingResult.BatchFull
+        need = 0
+        for uid, n in zip(uids, lengths):
+            seq = self._state_manager.get_sequence(uid)
+            if seq is None:
+                need += -(-n // ec.kv_block_size)
+            else:
+                need += seq.kv_blocks_needed(n, ec.kv_block_size)
+        if need > self.free_blocks:
+            return SchedulingResult.OutOfKVBlocks
+        return SchedulingResult.Success
+
+    def put(self, batch_uids: Iterable[int], batch_tokens: Iterable,
+            do_checks: bool = True) -> np.ndarray:
+        """One forward over a ragged batch; returns logits
+        [len(batch_uids), vocab] for each sequence's LAST packed token."""
+        batch_uids = list(batch_uids)
+        batch_tokens = [np.asarray(t, np.int32).reshape(-1)
+                        for t in batch_tokens]
+        if do_checks:
+            res = self.can_schedule(batch_uids,
+                                    [len(t) for t in batch_tokens])
+            if res != SchedulingResult.Success:
+                raise SchedulingError(res)
+
+        ec = self._config
+        wrapper = RaggedBatchWrapper(
+            token_budget=ec.token_budget,
+            max_seqs=ec.max_ragged_sequence_count,
+            max_blocks_per_seq=ec.max_blocks_per_seq)
+        for uid, toks in zip(batch_uids, batch_tokens):
+            seq = self._state_manager.get_or_create_sequence(uid)
+            self._state_manager.kv.maybe_allocate(seq, len(toks))
+            seq.pre_forward(len(toks))
+            wrapper.insert_sequence(seq, toks, do_checks=do_checks)
+        rb = wrapper.finalize(self._state_manager)
+
+        logits, self.pools = self._jit_forward(
+            self.params, self.pools, rb.token_ids, rb.token_seq,
+            rb.token_pos, rb.seq_lens, rb.block_tables, rb.logits_idx)
+
+        for uid in batch_uids:
+            self._state_manager.get_sequence(uid).post_forward()
+        return np.asarray(logits[:len(batch_uids)])
+
+    def flush(self, uid: int) -> None:
+        self._state_manager.flush_sequence(uid)
+
+    # -- Dynamic SplitFuse scheduler + serving loop ---------------------
+    def schedule(self, pending: Dict[int, np.ndarray],
+                 active_decode: Dict[int, int]
+                 ) -> Tuple[List[int], List[np.ndarray]]:
+        """Pick this step's work: all decode tokens first, then prompt
+        chunks until the token budget fills (Dynamic SplitFuse)."""
+        ec = self._config
+        uids, toks = [], []
+        budget = ec.token_budget
+        slots = ec.max_ragged_sequence_count
+        for uid, tok in active_decode.items():
+            if budget <= 0 or slots <= 0:
+                break
+            uids.append(uid)
+            toks.append(np.asarray([tok], np.int32))
+            budget -= 1
+            slots -= 1
+        for uid, prompt in pending.items():
+            if budget <= 0 or slots <= 0:
+                break
+            chunk = prompt[:budget]
+            uids.append(uid)
+            toks.append(chunk)
+            budget -= len(chunk)
+            slots -= 1
+        return uids, toks
+
+    def generate_batch(self, prompts: Dict[int, Iterable[int]],
+                       max_new_tokens: int = 32,
+                       eos_token_id: Optional[int] = None
+                       ) -> Dict[int, List[int]]:
+        """Greedy continuous-batching serving loop (the MII-side loop the
+        reference leaves out of deepspeed; here for tests/benchmarks)."""
+        pending = {uid: np.asarray(p, np.int32).reshape(-1)
+                   for uid, p in prompts.items()}
+        done: Dict[int, List[int]] = {uid: [] for uid in prompts}
+        decode: Dict[int, int] = {}
+        remaining = {uid: max_new_tokens for uid in prompts}
+
+        while pending or decode:
+            uids, toks = self.schedule(pending, decode)
+            if not uids:
+                raise SchedulingError(SchedulingResult.BatchFull)
+            logits = self.put(uids, toks)
+            for row, (uid, chunk) in enumerate(zip(uids, toks)):
+                if uid in pending:
+                    rest = pending[uid][len(chunk):]
+                    if len(rest):
+                        pending[uid] = rest
+                        continue  # mid-prompt: logits not sampled
+                    del pending[uid]
+                nxt = int(np.argmax(logits[row]))
+                done[uid].append(nxt)
+                remaining[uid] -= 1
+                finished = remaining[uid] <= 0 or (
+                    eos_token_id is not None and nxt == eos_token_id)
+                if finished:
+                    decode.pop(uid, None)
+                    self.flush(uid)
+                else:
+                    decode[uid] = nxt
+        return done

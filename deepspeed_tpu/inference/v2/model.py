@@ -1,0 +1,141 @@
+"""Ragged (paged-KV) Llama forward — the FastGen model path.
+
+Reference: deepspeed/inference/v2/model_implementations/
+inference_transformer_base.py:617 + kernels/ragged_ops/ (blocked_flash
+paged attention, linear_blocked_kv_rotary, logits_gather).
+
+TPU-native formulation: every shape is fixed by the engine limits
+(token_budget, max_seqs, max_blocks_per_seq, block_size), so one XLA
+compilation serves every mix of prefill chunks and decode tokens.
+Per layer:
+  1. qkv projection for the packed [budget] tokens + RoPE at their
+     absolute positions (linear_blocked_kv_rotary analog);
+  2. scatter k/v into the global block pool at
+     ``block_table[seq, pos // bs] * bs + pos % bs`` (padding tokens are
+     routed to a reserved scratch block);
+  3. per-token attention over the owning sequence's gathered KV with a
+     causal/length mask (blocked_flash analog — gather-based XLA version;
+     the Pallas paged-attention kernel is the optimization path);
+  4. logits computed ONLY at each sequence's last packed token
+     (logits_gather analog) — the [budget, V] matrix never materializes.
+
+Params are the flax Llama layout (models/llama.py), used functionally.
+"""
+
+import dataclasses
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...models.llama import LlamaConfig
+from ...ops.pallas_kernels import apply_rotary_pos_emb, rope_cos_sin
+
+
+def init_kv_pools(cfg: LlamaConfig, n_blocks: int, block_size: int,
+                  dtype=jnp.bfloat16):
+    """Per-layer (k, v) pools with one extra scratch block (index
+    ``n_blocks``) that absorbs padding-token writes."""
+    shape = ((n_blocks + 1) * block_size, cfg.num_key_value_heads,
+             cfg.head_dim)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(cfg.num_hidden_layers)]
+
+
+def _rms(x, w, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                   keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+            ).astype(x.dtype) * w
+
+
+def ragged_forward(params, cfg: LlamaConfig, pools, token_ids, token_seq,
+                   token_pos, seq_lens, block_tables, logits_idx,
+                   block_size: int):
+    """One ragged forward.
+
+    token_ids/token_seq/token_pos: [budget]; seq_lens: [S];
+    block_tables: [S, max_blocks]; logits_idx: [S].
+    Returns (logits [S, vocab], new_pools).
+    """
+    p = params["params"] if "params" in params else params
+    S, max_blocks = block_tables.shape
+    bs = block_size
+    ctx = max_blocks * bs
+    nh, nkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    rep = nh // nkv
+
+    x = p["embed_tokens"][token_ids]  # [B, C]
+    B = x.shape[0]
+
+    cos, sin = rope_cos_sin(token_pos[None, :], hd, theta=cfg.rope_theta)
+    cos, sin = cos[0], sin[0]  # [B, hd/2]
+
+    # scratch-block routing for padding tokens (token_seq == S)
+    pad_tables = jnp.concatenate(
+        [block_tables, jnp.zeros((1, max_blocks), jnp.int32)], axis=0)
+
+    # per-token flat write index into the pool's token axis
+    def flat_write_idx(pool_tokens):
+        scratch_block = pool_tokens // bs - 1
+        tables = pad_tables.at[S].set(scratch_block)
+        block = tables[token_seq.clip(0, S), token_pos // bs]
+        return block * bs + token_pos % bs
+
+    # per-slot gather indices [S, ctx]; gathered slot j of a sequence is
+    # absolute position j (blocks are appended in order), valid while
+    # j < seq_len
+    gather_idx = (block_tables * bs)[:, :, None] + jnp.arange(bs)
+    gather_idx = gather_idx.reshape(S, ctx)
+    k_abs = jnp.arange(ctx)
+
+    seq_of_token = jnp.clip(token_seq, 0, S - 1)
+
+    new_pools = []
+    scale = 1.0 / (hd ** 0.5)
+    for layer in range(cfg.num_hidden_layers):
+        lp = p[f"layers_{layer}"]
+        k_pool, v_pool = pools[layer]
+        widx = flat_write_idx(k_pool.shape[0])
+
+        h = _rms(x, lp["input_layernorm"]["weight"], cfg.rms_norm_eps)
+        q = (h @ lp["self_attn"]["q_proj"]["kernel"]).reshape(B, nh, hd)
+        k = (h @ lp["self_attn"]["k_proj"]["kernel"]).reshape(B, nkv, hd)
+        v = (h @ lp["self_attn"]["v_proj"]["kernel"]).reshape(B, nkv, hd)
+        q = apply_rotary_pos_emb(q[:, None], cos[:, None, None, :],
+                                 sin[:, None, None, :])[:, 0]
+        k = apply_rotary_pos_emb(k[:, None], cos[:, None, None, :],
+                                 sin[:, None, None, :])[:, 0]
+
+        k_pool = k_pool.at[widx].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[widx].set(v.astype(v_pool.dtype))
+        new_pools.append((k_pool, v_pool))
+
+        K = k_pool[gather_idx]  # [S, ctx, nkv, hd]
+        V = v_pool[gather_idx]
+        Kt = K[seq_of_token]    # [B, ctx, nkv, hd]
+        Vt = V[seq_of_token]
+        qg = q.reshape(B, nkv, rep, hd).astype(jnp.float32) * scale
+        scores = jnp.einsum("bkrd,bckd->bkrc", qg,
+                            Kt.astype(jnp.float32))  # [B, nkv, rep, ctx]
+        visible = k_abs[None, :] <= token_pos[:, None]  # causal
+        within = k_abs[None, :] < seq_lens[seq_of_token][:, None]
+        mask = visible & within
+        scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bkrc,bckd->bkrd", probs.astype(Vt.dtype), Vt)
+        attn = attn.reshape(B, nh * hd).astype(x.dtype)
+        x = x + attn @ lp["self_attn"]["o_proj"]["kernel"]
+
+        h = _rms(x, lp["post_attention_layernorm"]["weight"],
+                 cfg.rms_norm_eps)
+        gate = h @ lp["mlp"]["gate_proj"]["kernel"]
+        up = h @ lp["mlp"]["up_proj"]["kernel"]
+        x = x + (jax.nn.silu(gate) * up) @ lp["mlp"]["down_proj"]["kernel"]
+
+    x = _rms(x, p["norm"]["weight"], cfg.rms_norm_eps)
+    last = x[logits_idx]  # [S, C] — logits only where needed
+    head = p["embed_tokens"] if cfg.tie_word_embeddings else p["lm_head"]
+    logits = last @ head.T
+    return logits.astype(jnp.float32), new_pools

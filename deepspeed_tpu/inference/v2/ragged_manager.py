@@ -1,0 +1,159 @@
+"""Ragged sequence + paged KV-cache state management.
+
+Reference: deepspeed/inference/v2/ragged/ragged_manager.py:19
+``DSStateManager`` (sequence table), kv_cache.py ``BlockedKVCacheManager``
+(paged allocation), blocked_allocator.py (free-list block allocator),
+sequence_descriptor.py (per-sequence tracking).
+
+TPU-native reading: all of this is HOST-side bookkeeping — plain Python/
+numpy. The device only ever sees fixed-shape arrays (block tables, token
+metadata) so every forward compiles once. The device KV pool itself
+lives in the engine as a donated pytree of [n_blocks, block, Hkv, D]
+arrays per layer.
+"""
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class SchedulingResult(enum.Enum):
+    Success = 0
+    EngineFull = 1         # no free sequence slot
+    OutOfKVBlocks = 2      # allocator exhausted
+    BatchFull = 3          # token budget exceeded
+    UnknownSequence = 4
+
+
+class SchedulingError(RuntimeError):
+    def __init__(self, result: SchedulingResult):
+        super().__init__(f"cannot schedule batch: {result.name}")
+        self.result = result
+
+
+class BlockedAllocator:
+    """Free-list allocator over KV block ids (reference:
+    v2/ragged/blocked_allocator.py)."""
+
+    def __init__(self, n_blocks: int):
+        self.n_blocks = n_blocks
+        self._free = list(range(n_blocks - 1, -1, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise SchedulingError(SchedulingResult.OutOfKVBlocks)
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, blocks: List[int]) -> None:
+        self._free.extend(blocks)
+
+
+@dataclasses.dataclass
+class SequenceDescriptor:
+    """Per-sequence tracking (reference: v2/ragged/sequence_descriptor.py).
+
+    ``seen_tokens``: tokens whose KV is already cached.
+    ``in_flight_tokens``: tokens scheduled in the current forward.
+    """
+    uid: int
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    seen_tokens: int = 0
+    in_flight_tokens: int = 0
+
+    @property
+    def cur_allocated_blocks(self) -> int:
+        return len(self.blocks)
+
+    def kv_blocks_needed(self, new_tokens: int, block_size: int) -> int:
+        total = self.seen_tokens + self.in_flight_tokens + new_tokens
+        needed = -(-total // block_size)  # ceil
+        return max(0, needed - len(self.blocks))
+
+    def pre_forward(self, n_tokens: int) -> None:
+        self.in_flight_tokens += n_tokens
+
+    def post_forward(self) -> None:
+        self.seen_tokens += self.in_flight_tokens
+        self.in_flight_tokens = 0
+
+
+class BlockedKVCacheManager:
+    """Paged KV allocation over a fixed pool (reference:
+    v2/ragged/kv_cache.py:208 BlockedKVCacheManager)."""
+
+    def __init__(self, n_blocks: int, block_size: int):
+        self.block_size = block_size
+        self.allocator = BlockedAllocator(n_blocks)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    def maybe_allocate(self, seq: SequenceDescriptor, new_tokens: int):
+        need = seq.kv_blocks_needed(new_tokens, self.block_size)
+        if need:
+            seq.blocks.extend(self.allocator.allocate(need))
+
+    def release(self, seq: SequenceDescriptor):
+        self.allocator.free(seq.blocks)
+        seq.blocks = []
+
+
+class DSStateManager:
+    """Sequence table + KV manager (reference: ragged_manager.py:19).
+
+    ``max_tracked_sequences`` bounds the host table;
+    ``max_ragged_sequence_count`` bounds sequences per forward (the
+    device's fixed seq-slot dimension).
+    """
+
+    def __init__(self, max_tracked_sequences: int = 256,
+                 max_ragged_sequence_count: int = 32,
+                 max_context: int = 8192,
+                 n_blocks: int = 1024, block_size: int = 128):
+        self.max_tracked_sequences = max_tracked_sequences
+        self.max_ragged_sequence_count = max_ragged_sequence_count
+        self.max_context = max_context
+        self.kv = BlockedKVCacheManager(n_blocks, block_size)
+        self._seqs: Dict[int, SequenceDescriptor] = {}
+
+    @property
+    def free_blocks(self) -> int:
+        return self.kv.free_blocks
+
+    @property
+    def tracked_sequences(self) -> Dict[int, SequenceDescriptor]:
+        return self._seqs
+
+    @property
+    def n_tracked_sequences(self) -> int:
+        return len(self._seqs)
+
+    def get_sequence(self, uid: int) -> Optional[SequenceDescriptor]:
+        return self._seqs.get(uid)
+
+    def get_or_create_sequence(self, uid: int) -> SequenceDescriptor:
+        if uid in self._seqs:
+            return self._seqs[uid]
+        if len(self._seqs) >= self.max_tracked_sequences:
+            raise SchedulingError(SchedulingResult.EngineFull)
+        seq = SequenceDescriptor(uid=uid)
+        self._seqs[uid] = seq
+        return seq
+
+    def flush_sequence(self, uid: int) -> None:
+        seq = self._seqs.pop(uid, None)
+        if seq is not None:
+            self.kv.release(seq)
+
+    def block_table(self, seq: SequenceDescriptor,
+                    max_blocks: int) -> np.ndarray:
+        t = np.zeros((max_blocks,), np.int32)
+        t[:len(seq.blocks)] = seq.blocks
+        return t

@@ -1,0 +1,100 @@
+"""Fixed-budget ragged batch packing.
+
+Reference: deepspeed/inference/v2/ragged/ragged_wrapper.py
+``RaggedBatchWrapper`` packs a step's tokens + per-sequence metadata
+into pinned host buffers sized to the engine limits, so the device
+kernel launch geometry never changes.
+
+Here the fixed shapes are exactly what XLA needs for a single
+compilation: every forward sees [token_budget] packed tokens and
+[max_seqs] sequence slots regardless of the actual batch — unused slots
+are masked. This is the Dynamic SplitFuse fixed-token-budget idea
+(blogs/deepspeed-fastgen/README.md:90-103) falling out naturally.
+"""
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+from .ragged_manager import (DSStateManager, SchedulingError,
+                             SchedulingResult, SequenceDescriptor)
+
+
+@dataclasses.dataclass
+class RaggedBatch:
+    """Device-ready arrays for one forward (all fixed-shape)."""
+    token_ids: np.ndarray      # [budget] int32, 0-padded
+    token_seq: np.ndarray      # [budget] int32 slot index (max_seqs = pad)
+    token_pos: np.ndarray      # [budget] int32 absolute position
+    seq_lens: np.ndarray       # [max_seqs] int32 kv length AFTER this step
+    block_tables: np.ndarray   # [max_seqs, max_blocks] int32
+    logits_idx: np.ndarray     # [max_seqs] int32 packed index of last token
+    seq_active: np.ndarray     # [max_seqs] bool
+    uids: List[int]            # active uid per slot (host only)
+
+
+class RaggedBatchWrapper:
+
+    def __init__(self, token_budget: int = 512, max_seqs: int = 32,
+                 max_blocks_per_seq: int = 64):
+        self.token_budget = token_budget
+        self.max_seqs = max_seqs
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.clear()
+
+    def clear(self):
+        self._tokens: List[np.ndarray] = []
+        self._seqs: List[SequenceDescriptor] = []
+
+    @property
+    def current_tokens(self) -> int:
+        return int(sum(len(t) for t in self._tokens))
+
+    @property
+    def current_sequences(self) -> int:
+        return len(self._seqs)
+
+    def can_fit(self, n_tokens: int) -> bool:
+        return (self.current_tokens + n_tokens <= self.token_budget
+                and len(self._seqs) < self.max_seqs)
+
+    def insert_sequence(self, seq: SequenceDescriptor, tokens,
+                        do_checks: bool = True):
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if do_checks and not self.can_fit(len(tokens)):
+            raise SchedulingError(SchedulingResult.BatchFull)
+        self._seqs.append(seq)
+        self._tokens.append(tokens)
+
+    def finalize(self, manager: DSStateManager) -> RaggedBatch:
+        B, S = self.token_budget, self.max_seqs
+        token_ids = np.zeros((B,), np.int32)
+        token_seq = np.full((B,), S, np.int32)  # S = padding slot
+        token_pos = np.zeros((B,), np.int32)
+        seq_lens = np.zeros((S,), np.int32)
+        tables = np.zeros((S, self.max_blocks_per_seq), np.int32)
+        logits_idx = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        uids = []
+
+        cursor = 0
+        for slot, (seq, toks) in enumerate(zip(self._seqs, self._tokens)):
+            n = len(toks)
+            start = seq.seen_tokens  # positions of these tokens
+            token_ids[cursor:cursor + n] = toks
+            token_seq[cursor:cursor + n] = slot
+            token_pos[cursor:cursor + n] = np.arange(start, start + n)
+            seq_lens[slot] = start + n
+            if len(seq.blocks) > self.max_blocks_per_seq:
+                raise SchedulingError(SchedulingResult.OutOfKVBlocks)
+            tables[slot] = manager.block_table(seq, self.max_blocks_per_seq)
+            logits_idx[slot] = cursor + n - 1
+            active[slot] = True
+            uids.append(seq.uid)
+            cursor += n
+
+        return RaggedBatch(token_ids=token_ids, token_seq=token_seq,
+                           token_pos=token_pos, seq_lens=seq_lens,
+                           block_tables=tables, logits_idx=logits_idx,
+                           seq_active=active, uids=uids)

@@ -1,0 +1,2 @@
+from .runner import main as runner_main
+from .launch import main as launch_main

@@ -1,0 +1,116 @@
+"""``dstpu bench`` — collective microbenchmarks over mesh axes
+(reference: bin/ds_bench → DeepSpeedExamples' communication benchmarks;
+reports algbw/busbw per size like the reference's comms logger).
+
+Runs all_reduce / all_gather / reduce_scatter / all_to_all / ppermute
+over a chosen mesh axis via shard_map, sweeping message sizes. Works on
+a simulated CPU mesh (correctness/CI) and on real chips (numbers).
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+# busbw factors (ring-algorithm accounting, matches the reference's
+# utils/comms_logging.py:get_bw convention)
+def _busbw(op, size_bytes, t, world):
+    algbw = size_bytes / t
+    if op == "all_reduce":
+        return algbw * 2 * (world - 1) / world
+    if op in ("all_gather", "reduce_scatter", "all_to_all"):
+        return algbw * (world - 1) / world
+    return algbw  # ppermute/broadcast
+
+
+def bench_collectives(axis="fsdp", sizes=None, trials=5, dtype="float32"):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import mesh_manager
+
+    if not mesh_manager.initialized:
+        mesh_manager.init()
+    mesh = mesh_manager.mesh
+    world = dict(mesh.shape).get(axis, 1)
+    if world < 2:
+        # pick the largest axis instead
+        axis, world = max(dict(mesh.shape).items(), key=lambda kv: kv[1])
+    sizes = sizes or [2 ** p for p in range(16, 27, 2)]  # 64KB..64MB elems/4
+    dt = jnp.dtype(dtype)
+    results = []
+
+    def timed(fn, x):
+        jfn = jax.jit(fn)
+        jax.block_until_ready(jfn(x))  # compile
+        t0 = time.time()
+        for _ in range(trials):
+            out = jfn(x)
+        jax.block_until_ready(out)
+        return (time.time() - t0) / trials
+
+    from jax import shard_map
+
+    for n in sizes:
+        n = (n // world) * world or world
+        x = jnp.arange(n, dtype=dt)
+        sh = jax.NamedSharding(mesh, P(axis))
+        x = jax.device_put(x, sh)
+        size_bytes = n * dt.itemsize
+        spec = P(axis)
+
+        ops = {
+            "all_reduce": (lambda v: jax.lax.psum(v, axis), spec, spec),
+            "all_gather": (lambda v: jax.lax.all_gather(v, axis,
+                                                        tiled=True),
+                           spec, P()),
+            "ppermute": (lambda v: jax.lax.ppermute(
+                v, axis, [(i, (i + 1) % world) for i in range(world)]),
+                spec, spec),
+        }
+        for op, (fn, in_spec, out_spec) in ops.items():
+            try:
+                # all_gather's replicated output can't be statically
+                # proven replicated; disable the varying-mesh-axes check
+                f = shard_map(fn, mesh=mesh, in_specs=in_spec,
+                              out_specs=out_spec, check_vma=False)
+            except TypeError:  # older jax: check_rep
+                f = shard_map(fn, mesh=mesh, in_specs=in_spec,
+                              out_specs=out_spec, check_rep=False)
+            t = timed(f, x)
+            results.append({
+                "op": op, "axis": axis, "world": world,
+                "size_bytes": size_bytes, "time_ms": t * 1e3,
+                "algbw_GBps": size_bytes / t / 1e9,
+                "busbw_GBps": _busbw(op, size_bytes, t, world) / 1e9,
+            })
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="dstpu bench")
+    p.add_argument("--axis", default="fsdp")
+    p.add_argument("--trials", type=int, default=5)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--maxsize", type=int, default=26,
+                   help="max message size as log2(elements)")
+    args = p.parse_args(argv)
+    sizes = [2 ** q for q in range(16, args.maxsize + 1, 2)]
+    rows = bench_collectives(axis=args.axis, sizes=sizes,
+                             trials=args.trials, dtype=args.dtype)
+    hdr = f"{'op':14s} {'axis':8s} {'world':5s} {'size':>12s} " \
+          f"{'time(ms)':>10s} {'algbw GB/s':>11s} {'busbw GB/s':>11s}"
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows:
+        print(f"{r['op']:14s} {r['axis']:8s} {r['world']:<5d} "
+              f"{r['size_bytes']:>12,d} {r['time_ms']:>10.3f} "
+              f"{r['algbw_GBps']:>11.2f} {r['busbw_GBps']:>11.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""``dstpu report`` — environment/compat report (reference:
+deepspeed/env_report.py:182 ``ds_report``: op compatibility table +
+torch/cuda version block)."""
+
+import importlib
+import platform
+import sys
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def _version(mod):
+    try:
+        m = importlib.import_module(mod)
+        return getattr(m, "__version__", "unknown")
+    except Exception:
+        return None
+
+
+def collect():
+    import jax
+
+    info = {
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+        "jax": _version("jax"),
+        "jaxlib": _version("jaxlib"),
+        "flax": _version("flax"),
+        "optax": _version("optax"),
+        "orbax": _version("orbax.checkpoint"),
+        "numpy": _version("numpy"),
+        "deepspeed_tpu": _version("deepspeed_tpu"),
+    }
+    try:
+        devs = jax.devices()
+        info["backend"] = jax.default_backend()
+        info["device_count"] = len(devs)
+        info["device_kind"] = devs[0].device_kind if devs else "none"
+        info["process_count"] = jax.process_count()
+    except Exception as e:
+        info["backend"] = f"unavailable ({e})"
+
+    from ..accelerator import get_accelerator
+    acc = get_accelerator()
+    info["accelerator"] = acc.device_name()
+    info["supports_pallas"] = bool(getattr(acc, "supports_pallas",
+                                           lambda: False)())
+    from ..profiling.flops_profiler import peak_tflops
+    info["peak_bf16_tflops"] = peak_tflops()
+
+    # op-build status (reference's op compatibility table)
+    ops = {}
+    try:
+        from ..ops.op_builder.cpu_adam import CPUAdamBuilder
+        ops["cpu_adam"] = CPUAdamBuilder().is_compatible()
+    except Exception:
+        ops["cpu_adam"] = False
+    ops["pallas_flash_attention"] = info["supports_pallas"]
+    ops["pallas_rms_norm"] = info["supports_pallas"]
+    ops["fused_adam"] = info["supports_pallas"]
+    info["ops"] = ops
+    return info
+
+
+def main(argv=None):
+    info = collect()
+    print("-" * 64)
+    print("DeepSpeed-TPU environment report (ds_report analog)")
+    print("-" * 64)
+    for k in ("python", "platform", "deepspeed_tpu", "jax", "jaxlib",
+              "flax", "optax", "orbax", "numpy"):
+        print(f"{k:24s} {info.get(k)}")
+    print("-" * 64)
+    for k in ("backend", "device_count", "device_kind", "process_count",
+              "accelerator", "peak_bf16_tflops"):
+        if k in info:
+            print(f"{k:24s} {info[k]}")
+    print("-" * 64)
+    print("op name".ljust(32), "compatible")
+    for op, ok in info.get("ops", {}).items():
+        print(op.ljust(32), GREEN_OK if ok else RED_NO)
+    print("-" * 64)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

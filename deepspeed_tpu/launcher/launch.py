@@ -1,0 +1,116 @@
+"""Per-host process spawner (reference: deepspeed/launcher/launch.py:132).
+
+Forks one worker process per local "device slot", sets the JAX
+distributed-rendezvous env (the RANK/LOCAL_RANK/WORLD_SIZE analog:
+``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``),
+handles SIGINT/SIGTERM by tearing down the whole tree (reference:
+terminate_process_tree launch.py:118), and propagates the first non-zero
+exit code.
+
+On real TPU-VMs one process per HOST is the norm (all local chips belong
+to one process), so ``--nproc_per_node`` defaults to 1; values > 1 exist
+for the CPU-simulation path where each process fakes its local devices
+via ``--xla_force_host_platform_device_count``.
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from ..utils.logging import logger
+
+
+def parse_args(args=None):
+    p = argparse.ArgumentParser(description="deepspeed_tpu per-host launcher")
+    p.add_argument("--node_rank", type=int, default=0,
+                   help="rank of this host in the pod")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--master_addr", default="127.0.0.1",
+                   help="coordinator address (reference MASTER_ADDR)")
+    p.add_argument("--master_port", type=int, default=29500)
+    p.add_argument("--cpu_sim_devices", type=int, default=0,
+                   help="fake this many CPU devices per process "
+                        "(testing without TPU hardware)")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(args)
+
+
+def build_env(args, local_rank):
+    """Worker env: JAX rendezvous + reference-compatible rank vars."""
+    env = dict(os.environ)
+    world = args.nnodes * args.nproc_per_node
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    env.update({
+        "JAX_COORDINATOR_ADDRESS": f"{args.master_addr}:{args.master_port}",
+        "JAX_NUM_PROCESSES": str(world),
+        "JAX_PROCESS_ID": str(rank),
+        # reference-compatible names so user scripts keep working
+        "RANK": str(rank),
+        "LOCAL_RANK": str(local_rank),
+        "WORLD_SIZE": str(world),
+        "MASTER_ADDR": args.master_addr,
+        "MASTER_PORT": str(args.master_port),
+    })
+    if args.cpu_sim_devices:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["DS_ACCELERATOR"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.cpu_sim_devices}").strip()
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    procs = []
+
+    def terminate(signum=None, frame=None):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    signal.signal(signal.SIGINT, terminate)
+    signal.signal(signal.SIGTERM, terminate)
+
+    for local_rank in range(args.nproc_per_node):
+        cmd = [sys.executable, args.training_script] + \
+            args.training_script_args
+        env = build_env(args, local_rank)
+        logger.info(f"launch: rank={env['RANK']} cmd={' '.join(cmd)}")
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    rc = 0
+    try:
+        while procs:
+            for p in list(procs):
+                code = p.poll()
+                if code is None:
+                    continue
+                procs.remove(p)
+                if code != 0:
+                    rc = rc or code
+                    logger.error(f"worker pid={p.pid} exited rc={code}; "
+                                 "terminating remaining workers")
+                    terminate()
+                    procs.clear()
+                    break
+            time.sleep(0.2)
+    finally:
+        terminate()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

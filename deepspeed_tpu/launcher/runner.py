@@ -1,0 +1,128 @@
+"""``dstpu`` front-end launcher (reference: deepspeed/launcher/runner.py:390
+``main`` — hostfile parse, world-info build, multinode runner selection).
+
+Subcommands:
+    dstpu [launch] script.py args...   pod/multi-host launch
+    dstpu report                       environment report (ds_report analog)
+    dstpu bench                        collective microbenchmarks (ds_bench)
+
+Hostfile format (reference parity, runner.py:202 fetch_hostfile):
+    hostname1 slots=4
+    hostname2 slots=4
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+from collections import OrderedDict
+
+from ..utils.logging import logger
+from .multinode_runner import RUNNERS, LocalRunner
+
+
+def fetch_hostfile(path):
+    """Parse ``host slots=N`` lines -> OrderedDict[host, slots]
+    (reference: runner.py:202)."""
+    if not path or not os.path.isfile(path):
+        return None
+    pool = OrderedDict()
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=")[1])
+            if host in pool:
+                raise ValueError(f"duplicate host {host} in hostfile")
+            pool[host] = slots
+    return pool
+
+
+def parse_args(args=None):
+    p = argparse.ArgumentParser(
+        prog="dstpu", description="deepspeed_tpu launcher")
+    p.add_argument("--hostfile", default="",
+                   help="host slots=N file; default: single local host")
+    p.add_argument("--include", default="",
+                   help="host filter, e.g. host1@host2 (subset of hostfile)")
+    p.add_argument("--num_nodes", type=int, default=-1)
+    p.add_argument("--num_procs", type=int, default=-1,
+                   help="processes per host (default: hostfile slots)")
+    p.add_argument("--master_addr", default="")
+    p.add_argument("--master_port", type=int, default=29500)
+    p.add_argument("--launcher", default="",
+                   choices=["", "local", "ssh", "pdsh", "gcloud"])
+    p.add_argument("--tpu_name", default="", help="gcloud launcher TPU name")
+    p.add_argument("--zone", default="", help="gcloud launcher zone")
+    p.add_argument("--cpu_sim_devices", type=int, default=0,
+                   help="simulate N CPU devices per process (no hardware)")
+    p.add_argument("--force_multi", action="store_true")
+    p.add_argument("user_script", nargs="?", default=None)
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p.parse_args(args)
+
+
+def main(args=None):
+    argv = sys.argv[1:] if args is None else list(args)
+    if argv and argv[0] == "report":
+        from .env_report import main as report_main
+        return report_main(argv[1:])
+    if argv and argv[0] == "bench":
+        from .comm_bench import main as bench_main
+        return bench_main(argv[1:])
+    if argv and argv[0] == "launch":
+        argv = argv[1:]
+    args = parse_args(argv)
+    if not args.user_script:
+        logger.error("no training script given; see dstpu --help")
+        return 2
+
+    pool = fetch_hostfile(args.hostfile) or OrderedDict(
+        [("localhost", max(args.num_procs, 1))])
+    if args.include:
+        keep = set(args.include.split("@"))
+        pool = OrderedDict((h, s) for h, s in pool.items() if h in keep)
+    if args.num_nodes > 0:
+        pool = OrderedDict(list(pool.items())[:args.num_nodes])
+    if args.num_procs > 0:
+        pool = OrderedDict((h, args.num_procs) for h in pool)
+
+    multi = len(pool) > 1 or args.force_multi
+    if not args.master_addr:
+        args.master_addr = next(iter(pool)) if multi else "127.0.0.1"
+
+    launcher = args.launcher or ("ssh" if multi else "local")
+    if launcher == "gcloud" and not args.tpu_name:
+        logger.error("--launcher gcloud requires --tpu_name")
+        return 2
+    runner_cls = RUNNERS[launcher]
+    runner = runner_cls(args, pool) if launcher != "gcloud" else \
+        runner_cls(args, pool, tpu_name=args.tpu_name, zone=args.zone)
+    if not runner.backend_exists():
+        logger.error(f"launcher backend '{launcher}' not available")
+        return 2
+
+    env = {}
+    for key in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS", "DS_ACCELERATOR",
+                "TPU_NAME"):
+        if key in os.environ:
+            env[key] = os.environ[key]
+
+    cmds = runner.get_cmd(env, pool)
+    logger.info(f"dstpu: {len(pool)} host(s) x "
+                f"{next(iter(pool.values()))} proc(s), launcher={launcher}")
+    procs = [subprocess.Popen(c) for c in cmds]
+    rc = 0
+    for p in procs:
+        rc = rc or p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,9 @@ class GPT2Config:
     initializer_range: float = 0.02
     use_remat: bool = False  # activation checkpointing per block
     use_flash: bool = True   # fused Pallas attention (no attn-prob dropout)
+    # CE in sequence chunks so [B,T,V] logits never materialize (0 = off).
+    # Training-loss path only; the logits output is then None.
+    loss_chunk: int = 0
 
     @staticmethod
     def small():
@@ -147,11 +150,63 @@ class GPT2LMHeadModel(nn.Module):
         for i in range(cfg.n_layer):
             x = block(cfg, name=f"h_{i}")(x, deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f")(x)
+        if labels is not None and cfg.loss_chunk:
+            loss = chunked_cross_entropy_from_hidden(
+                x, wte, labels, chunk=cfg.loss_chunk)
+            return loss, None
         logits = x @ wte.T  # tied embeddings (HF GPT-2 convention)
         if labels is None:
             return logits
         loss = cross_entropy_loss(logits, labels)
         return loss, logits
+
+
+def chunked_cross_entropy_from_hidden(x, w, labels, ignore_index=-100,
+                                      chunk=256):
+    """Shifted next-token CE computed from hidden states WITHOUT ever
+    materializing the full [B,T,V] logits.
+
+    ``x``: [B,T,C] final hidden states; ``w``: [V,C] unembedding. The
+    sequence is walked in T-chunks inside a scan whose body is
+    ``jax.checkpoint``-ed: forward keeps only per-chunk logits alive,
+    backward recomputes them per chunk (the big-vocab CE trick; at
+    GPT-2-small shapes the logits chain is the largest activation and
+    the main HBM-traffic term, see bench notes). Numerics match
+    ``cross_entropy_loss`` (fp32 logsumexp accumulation).
+    """
+    xs = x[:, :-1]
+    ys = labels[:, 1:]
+    B, T, C = xs.shape
+    n_chunks = max(1, (T + chunk - 1) // chunk)
+    pad = n_chunks * chunk - T
+    if pad:
+        xs = jnp.pad(xs, ((0, 0), (0, pad), (0, 0)))
+        ys = jnp.pad(ys, ((0, 0), (0, pad)),
+                     constant_values=ignore_index)
+    # [n_chunks, B, chunk, C] so scan walks the sequence
+    xs = xs.reshape(B, n_chunks, chunk, C).transpose(1, 0, 2, 3)
+    ys = ys.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_loss(xc, yc):
+        logits = xc @ w.T  # [B, chunk, V] — the only logits ever live
+        valid = yc != ignore_index
+        safe = jnp.where(valid, yc, 0)
+        lse = jax.scipy.special.logsumexp(
+            logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(logits, safe[..., None],
+                                     axis=-1)[..., 0]
+        nll = jnp.where(valid, lse - picked.astype(jnp.float32), 0.0)
+        return nll.sum(), valid.sum()
+
+    def body(carry, inp):
+        total, count = carry
+        s, c = chunk_loss(*inp)
+        return (total + s, count + c), None
+
+    (total, count), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.int32(0)), (xs, ys))
+    return total / jnp.maximum(count, 1)
 
 
 def cross_entropy_loss(logits, labels, ignore_index=-100):

@@ -53,10 +53,14 @@ def _rows_view(x):
     return x.reshape(-1, d)
 
 
-def _row_block(n):
-    """Largest divisor of n that is <= _BLOCK_ROWS (keeps one block's
-    fp32 input + output well inside VMEM for any row count)."""
-    block = min(_BLOCK_ROWS, n)
+def _row_block(n, d):
+    """Largest divisor of n whose fp32 working set fits scoped VMEM.
+
+    The kernels hold ~6 block-sized fp32 buffers (x, out, xhat, dxhat,
+    dx, temps); budget each at 2MB so the total stays well under the
+    16MB scoped-vmem limit even for wide models (d=4096 -> 128 rows)."""
+    budget_rows = max(8, (2 << 20) // (4 * d))
+    block = min(_BLOCK_ROWS, budget_rows, n)
     while n % block:
         block -= 1
     return block
@@ -69,7 +73,7 @@ def _rms_norm_2d(x, w, eps, interpret):
 
 def _fwd(x, w, eps, interpret):
     n, d = x.shape
-    block = _row_block(n)
+    block = _row_block(n, d)
     return pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
         grid=(n // block,),
@@ -88,7 +92,7 @@ def _fwd_rule(x, w, eps, interpret):
 def _bwd_rule(eps, interpret, res, dy):
     x, w = res
     n, d = x.shape
-    block = _row_block(n)
+    block = _row_block(n, d)
     nblocks = n // block
     dx, dw_partial = pl.pallas_call(
         functools.partial(_bwd_kernel, eps=eps),

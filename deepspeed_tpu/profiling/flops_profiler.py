@@ -149,7 +149,14 @@ class FlopsProfiler:
         return f"{self._elapsed:.3f} s" if as_string else self._elapsed
 
     def get_flops_per_step(self):
-        return self._profile().get("flops", 0.0)
+        """Per-device flops of ONE train step. cost_analysis counts a
+        lax.scan body once, so the per-microbatch count is multiplied by
+        the engine's gradient-accumulation factor."""
+        flops = self._profile().get("flops", 0.0)
+        gas = 1
+        if self.engine is not None:
+            gas = self.engine.gradient_accumulation_steps()
+        return flops * gas
 
     def get_mfu(self):
         """Model FLOPs utilization over the profiled window.

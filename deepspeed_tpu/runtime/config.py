@@ -189,6 +189,15 @@ class DeepSpeedConfig:
         self.checkpoint_config = CheckpointConfig.from_dict(d.get(CHECKPOINT, {}))
         self.data_types_config = DataTypesConfig.from_dict(d.get(DATA_TYPES, {}))
         self.pipeline_config = PipelineConfig.from_dict(d.get(PIPELINE, {}))
+        # curriculum learning: legacy top-level section or nested under
+        # data_efficiency.data_sampling (reference: data_pipeline/config.py)
+        self.curriculum_config = d.get("curriculum_learning", None)
+        if self.curriculum_config is None:
+            self.curriculum_config = d.get("data_efficiency", {}).get(
+                "data_sampling", {}).get("curriculum_learning", None)
+        if self.curriculum_config is not None and \
+                not self.curriculum_config.get("enabled", True):
+            self.curriculum_config = None
 
         # --- scalars ---
         self.gradient_clipping = d.get(GRADIENT_CLIPPING, 0.0)

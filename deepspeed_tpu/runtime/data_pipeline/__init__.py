@@ -1,0 +1,3 @@
+from .curriculum_scheduler import CurriculumScheduler
+from .data_sampling import CurriculumDataSampler, truncate_to_difficulty
+from .random_ltd import RandomLTDScheduler, random_ltd_layer

@@ -1,0 +1,93 @@
+"""Curriculum learning difficulty scheduler.
+
+Reference: deepspeed/runtime/data_pipeline/curriculum_scheduler.py:11
+``CurriculumScheduler`` — schedules a "difficulty" (typically sequence
+length) over training steps. Schedule types and their JSON configs are
+kept verbatim for drop-in parity:
+
+  fixed_discrete:  {"difficulty": [d0, d1, ...], "max_step": [s0, ...]}
+  fixed_linear:    {"total_curriculum_step": N, "difficulty_step": k}
+  fixed_root:      {"total_curriculum_step": N, "difficulty_step": k,
+                    "root_degree": r}
+  custom:          set_custom_get_difficulty(fn)
+
+Pure host-side arithmetic — the difficulty feeds the data sampler (and
+optionally the model) per step; under XLA the resulting seq-len change
+is one extra compilation per distinct difficulty (the schedule
+quantizes via difficulty_step precisely so there are few of them).
+"""
+
+import math
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config: dict):
+        for key in ("minimum_difficulty", "maximum_difficulty",
+                    "schedule_type"):
+            if key not in config:
+                raise ValueError(f"curriculum config requires '{key}'")
+        self.min_difficulty = config["minimum_difficulty"]
+        self.max_difficulty = config["maximum_difficulty"]
+        self.schedule_type = config["schedule_type"]
+        self.current_difficulty = self.min_difficulty
+        sc = config.get("schedule_config", {})
+        self.schedule_config = sc
+        self._custom_fn = None
+
+        if self.schedule_type == "fixed_discrete":
+            if "difficulty" not in sc or "max_step" not in sc:
+                raise ValueError("fixed_discrete needs schedule_config "
+                                 "{'difficulty': [...], 'max_step': [...]}")
+            if len(sc["max_step"]) != len(sc["difficulty"]) - 1:
+                raise ValueError("max_step must have one less element "
+                                 "than difficulty")
+        elif self.schedule_type in ("fixed_linear", "fixed_root"):
+            for key in ("total_curriculum_step", "difficulty_step"):
+                if key not in sc:
+                    raise ValueError(
+                        f"{self.schedule_type} needs schedule_config "
+                        f"'{key}'")
+            if self.schedule_type == "fixed_root" and \
+                    "root_degree" not in sc:
+                raise ValueError("fixed_root needs 'root_degree'")
+        elif self.schedule_type != "custom":
+            raise ValueError(
+                f"unknown curriculum schedule {self.schedule_type}")
+
+    def set_custom_get_difficulty(self, fn):
+        self._custom_fn = fn
+
+    def get_difficulty(self, global_steps: int) -> int:
+        sc = self.schedule_config
+        if self.schedule_type == "fixed_discrete":
+            for diff, max_step in zip(sc["difficulty"], sc["max_step"]):
+                if global_steps <= max_step:
+                    return diff
+            return sc["difficulty"][-1]
+        if self.schedule_type == "custom":
+            if self._custom_fn is None:
+                raise ValueError("custom schedule: call "
+                                 "set_custom_get_difficulty first")
+            return self._custom_fn(global_steps)
+        # fixed_linear / fixed_root (root_degree 1 == linear)
+        degree = sc.get("root_degree", 1) \
+            if self.schedule_type == "fixed_root" else 1
+        frac = min(1.0, (global_steps / sc["total_curriculum_step"])
+                   ** (1.0 / degree))
+        diff = self.min_difficulty + frac * (self.max_difficulty -
+                                             self.min_difficulty)
+        step = sc["difficulty_step"]
+        diff = int(diff / step) * step
+        return max(self.min_difficulty, min(self.max_difficulty, diff))
+
+    def update_difficulty(self, global_steps: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_steps)
+        return self.current_difficulty
+
+    # checkpointable state (reference keeps a .state dict)
+    def state_dict(self):
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd):
+        self.current_difficulty = sd["current_difficulty"]

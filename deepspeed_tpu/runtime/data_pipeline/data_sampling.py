@@ -1,0 +1,51 @@
+"""Curriculum data sampling (reference:
+deepspeed/runtime/data_pipeline/data_sampling/ — the curriculum sampler
+wired through deepspeed_io, runtime/dataloader.py).
+
+``truncate_to_difficulty`` is the seqlen-metric transform (reference
+truncation/reshape modes for the seqlen curriculum); the sampler wraps
+any batch iterator and applies the scheduler's current difficulty.
+"""
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+def truncate_to_difficulty(batch: Dict, difficulty: int,
+                           keys=("input_ids", "labels", "attention_mask")):
+    """Truncate sequence-shaped arrays to the current difficulty
+    (seqlen curriculum, 'truncate' mode)."""
+    out = dict(batch)
+    for k in keys:
+        if k in out and hasattr(out[k], "shape") \
+                and np.asarray(out[k]).ndim >= 2:
+            out[k] = np.asarray(out[k])[:, :difficulty]
+    return out
+
+
+class CurriculumDataSampler:
+    """Iterator wrapper: applies the curriculum transform per batch and
+    advances the schedule on ``step()`` (the engine calls it each
+    train_batch; reference: engine curriculum wiring engine.py)."""
+
+    def __init__(self, loader, scheduler: CurriculumScheduler,
+                 transform: Optional[Callable] = None):
+        self.loader = loader
+        self.scheduler = scheduler
+        self.transform = transform or truncate_to_difficulty
+        self.global_steps = 0
+
+    def __iter__(self):
+        for batch in self.loader:
+            yield self.transform(batch, self.scheduler.current_difficulty)
+
+    def step(self):
+        self.global_steps += 1
+        return self.scheduler.update_difficulty(self.global_steps)
+
+    @property
+    def current_difficulty(self):
+        return self.scheduler.current_difficulty

@@ -1,0 +1,58 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference: deepspeed/runtime/data_pipeline/data_routing/basic_layer.py:14
+``RandomLayerTokenDrop`` + csrc/random_ltd/ (token_sort.cu,
+gather_scatter.cu — CUDA kernels for sampling/gather/scatter).
+
+TPU-native: the kernels collapse to ``jax.random.permutation`` +
+``jnp.take``/scatter — XLA fuses them; no custom kernels needed (the
+reference's random_ltd CUDA exists only because eager torch would
+launch many tiny kernels).
+
+``random_ltd_layer(layer_fn, x, keep, rng)`` runs ``layer_fn`` on a
+random subset of ``keep`` tokens and scatters results back (dropped
+tokens pass through unchanged — the reference's residual-passthrough
+semantics). The scheduler anneals ``keep`` from min to max seq length.
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+def random_ltd_layer(layer_fn: Callable, x, keep: int, rng):
+    """x: [B, T, C]; run layer_fn on ``keep`` randomly-selected tokens.
+
+    Returns [B, T, C]: processed tokens scattered back into place,
+    dropped tokens passed through (basic_layer.py semantics).
+    """
+    B, T = x.shape[0], x.shape[1]
+    if keep >= T:
+        return layer_fn(x)
+    perm = jax.vmap(lambda r: jax.random.permutation(r, T))(
+        jax.random.split(rng, B))            # [B, T]
+    sel = jnp.sort(perm[:, :keep], axis=1)   # keep original order
+    sub = jnp.take_along_axis(x, sel[..., None], axis=1)  # [B, keep, C]
+    out = layer_fn(sub)
+    return jax.vmap(lambda xi, si, oi: xi.at[si].set(oi))(x, sel, out)
+
+
+class RandomLTDScheduler:
+    """Anneals the kept-token count (reference:
+    data_routing/scheduler.py RandomLTDScheduler — fixed_linear)."""
+
+    def __init__(self, min_value: int, max_value: int,
+                 total_ltd_step: int, difficulty_step: int = 1):
+        self.scheduler = CurriculumScheduler({
+            "minimum_difficulty": min_value,
+            "maximum_difficulty": max_value,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": total_ltd_step,
+                                "difficulty_step": difficulty_step},
+        })
+
+    def get_current_seq(self, global_steps: int) -> int:
+        return self.scheduler.get_difficulty(global_steps)

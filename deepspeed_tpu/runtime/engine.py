@@ -108,6 +108,8 @@ class DeepSpeedEngine:
         self._step_metrics = {}
         self._flops_profile = None
         self._profile_batch_struct = None
+        self.curriculum_scheduler = None
+        self.curriculum_sampler = None
 
         # precision
         self.compute_dtype = self._config.precision_dtype
@@ -403,10 +405,20 @@ class DeepSpeedEngine:
 
     def deepspeed_io(self, dataset, batch_size=None, route="train"):
         bs = batch_size or self.train_batch_size()
-        data_sampler = None
-        return DeepSpeedDataLoader(dataset, batch_size=bs,
-                                   collate_fn=self.collate_fn,
-                                   data_sampler=data_sampler)
+        loader = DeepSpeedDataLoader(dataset, batch_size=bs,
+                                     collate_fn=self.collate_fn,
+                                     data_sampler=None)
+        cc = getattr(self._config, "curriculum_config", None)
+        if cc is not None and route == "train":
+            # curriculum sampler wiring (reference: engine.py deepspeed_io
+            # + data_pipeline curriculum sampler)
+            from .data_pipeline import (CurriculumDataSampler,
+                                        CurriculumScheduler)
+            self.curriculum_scheduler = CurriculumScheduler(cc)
+            self.curriculum_sampler = CurriculumDataSampler(
+                loader, self.curriculum_scheduler)
+            return self.curriculum_sampler
+        return loader
 
     # ------------------------------------------------------------------
     # config accessors (reference: engine.py scalar accessors)
@@ -682,6 +694,8 @@ class DeepSpeedEngine:
             self.global_steps += 1
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
+            if self.curriculum_sampler is not None:
+                self.curriculum_sampler.step()
         self.global_samples += self.train_batch_size()
         self.micro_steps += self.gradient_accumulation_steps()
         self._step_metrics = {k: v for k, v in metrics.items()}

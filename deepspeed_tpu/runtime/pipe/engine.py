@@ -17,15 +17,29 @@ is bounded via ``jax.checkpoint`` around the per-tick stage body
 (rematerialize in backward), giving the 1F1B memory profile with the
 GPipe wire schedule.
 
-Stage composition rule: the pipelined run of layers must be homogeneous
+Stage composition rule: the pipelined layer run must be homogeneous
 (identical LayerSpec typename/arguments) so all stages execute one
 program — the XLA single-program constraint. Heterogeneous head/tail
 layers (embedding, final norm, LM head — the reference's typical
 first/last stage contents, including TiedLayerSpec embeddings) run
-OUTSIDE the pipelined region under plain SPMD, sharded over data/tensor
-axes. Stages are uniform (equal layers per stage); a non-uniform
-``PipelineModule.parts`` raises rather than being silently resplit.
-``TiedLayerSpec`` pre/post layers sharing a key share one params entry.
+INSIDE the pipelined region, gated to their stage with ``lax.cond``
+(device-varying predicate, collective-free branches → each stage
+executes only its own branch): embedding on stage 0 at microbatch
+injection, head + loss on the last stage at collection. Losses
+accumulate per tick — outputs are never buffered across microbatches
+(the 1F1B O(P)-not-O(M) memory idea, reference
+runtime/pipe/schedule.py:189 TrainSchedule).
+
+Stages may be NON-UNIFORM: ``PipelineModule.parts`` (param-count /
+regex / explicit ``layer_weights`` balancing, reference
+pipe/module.py:387) assigns each stage a different number of block
+layers; stages run a masked scan over the max count (idle slots
+pass activations through — the same bubble cost real non-uniform
+pipelines pay in time). Pre layers must fall in stage 0's part and
+post layers in the last stage's. ``TiedLayerSpec`` pre/post layers
+sharing a key share one params entry; the pipe-axis psum of their
+cotangents in shard_map's transpose is exactly the reference's
+tied-weight allreduce (pipe/module.py:440-464).
 """
 
 import functools
@@ -110,25 +124,36 @@ class _PipelinedLM:
         self.remat = remat
         self.loss_fn = module.loss_fn
         self._split_roles()
-        n_blocks = len(self.block_specs)
-        if n_blocks % num_stages != 0:
+        self._assign_stage_counts()
+
+    def _assign_stage_counts(self):
+        """Derive per-stage block counts from PipelineModule.parts
+        (non-uniform allowed; reference balancing pipe/module.py:387).
+
+        Constraints of the single-SPMD-program executor: every pre spec
+        lives in stage 0's part, every post spec in the last stage's.
+        """
+        n_pre, n_blocks = len(self.pre_specs), len(self.block_specs)
+        P_ = self.num_stages
+        parts = self.module.parts
+        if len(parts) != P_ + 1:
+            # module was built with a different stage count — uniform split
+            from ...runtime.utils import partition_uniform
+            parts = partition_uniform(len(self.module.layer_specs), P_)
+        if parts[1] < n_pre:
             raise ValueError(
-                f"{n_blocks} pipelined layers not divisible by "
-                f"num_stages={num_stages}")
-        self.layers_per_stage = n_blocks // num_stages
-        # The SPMD executor runs one program on every stage, so stages
-        # must be uniform. PipelineModule.parts spans ALL specs (pre/post
-        # included) so its default output is legitimately lumpy; but an
-        # EXPLICIT layer_weights request for a non-uniform split cannot
-        # be honored — fail loudly rather than silently resplit.
-        parts = module.parts
-        if module._layer_weights is not None and len(parts) == num_stages + 1:
-            sizes = {parts[i + 1] - parts[i] for i in range(num_stages)}
-            if len(sizes) > 1:
-                raise NotImplementedError(
-                    f"PipelineModule.parts={parts} is non-uniform; the SPMD "
-                    f"schedule requires equal layers per stage "
-                    f"({self.layers_per_stage} each)")
+                f"parts={parts}: the first {n_pre} (pre) layers must all "
+                f"be in stage 0 — rebalance with layer_weights")
+        if parts[P_ - 1] > n_pre + n_blocks:
+            raise ValueError(
+                f"parts={parts}: the last {len(self.post_specs)} (post) "
+                f"layers must all be in stage {P_ - 1}")
+        lo, hi = n_pre, n_pre + n_blocks
+        self.stage_block_counts = [
+            max(0, min(parts[s + 1], hi) - max(parts[s], lo))
+            for s in range(P_)]
+        assert sum(self.stage_block_counts) == n_blocks
+        self.max_layers_per_stage = max(self.stage_block_counts + [1])
 
     def _split_roles(self):
         specs = self.module.layer_specs
@@ -189,6 +214,16 @@ class _PipelinedLM:
             return fwd(module, {"params": p}, x)
         return module.apply({"params": p}, x)
 
+    def unstack_blocks(self, params):
+        """[num_stages, max_k] padded block params -> list of per-layer
+        param trees in pipeline order (padding slots dropped)."""
+        out = []
+        for s, count in enumerate(self.stage_block_counts):
+            for l in range(count):
+                out.append(jax.tree_util.tree_map(
+                    lambda v: v[s, l], params["blocks"]))
+        return out
+
     # -- params -----------------------------------------------------------
     def init(self, rng, input_ids, labels=None, **kw):
         x = jnp.asarray(input_ids)[:1]
@@ -204,12 +239,19 @@ class _PipelinedLM:
         for _ in range(len(self.block_specs)):
             rng, sub = jax.random.split(rng)
             block_ps.append(self.block_mod.init(sub, h)["params"])
-        # stack [L] then fold to [num_stages, L/stage]
-        stacked = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs).reshape(
-                (self.num_stages, self.layers_per_stage) + xs[0].shape),
-            *block_ps)
-        params["blocks"] = stacked
+        # arrange into [num_stages, max_k] with zero padding for stages
+        # holding fewer than max_k layers (masked out at execution)
+        max_k = self.max_layers_per_stage
+        it = iter(block_ps)
+        per_stage = []
+        zero = jax.tree_util.tree_map(jnp.zeros_like, block_ps[0])
+        for count in self.stage_block_counts:
+            stage_ps = [next(it) for _ in range(count)]
+            stage_ps += [zero] * (max_k - count)
+            per_stage.append(jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *stage_ps))
+        params["blocks"] = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *per_stage)
         for key, spec, m in zip(self.post_keys, self.post_specs,
                                 self.post_mods):
             if key not in params:
@@ -228,61 +270,127 @@ class _PipelinedLM:
         if x.shape[0] % M != 0:
             raise ValueError(f"batch {x.shape[0]} not divisible by "
                              f"microbatches {M}")
-        h = x
-        for key, spec, m in zip(self.pre_keys, self.pre_specs,
-                                self.pre_mods):
-            h = self._apply_layer(spec, m, params[key], h)
-
-        # [Btot, ...] -> [M, b, ...], batch dim stays on the data axes
-        h = h.reshape((M, x.shape[0] // M) + h.shape[1:])
-        h = jax.lax.with_sharding_constraint(
-            h, NamedSharding(mesh, P(None, BATCH_AXES)))
-        y = None
+        b = x.shape[0] // M
+        toks = x.reshape((M, b) + x.shape[1:])
+        toks = jax.lax.with_sharding_constraint(
+            toks, NamedSharding(mesh, P(None, BATCH_AXES)))
         if labels is not None:
             y = jnp.asarray(labels).reshape(
-                (M, x.shape[0] // M) + jnp.asarray(labels).shape[1:])
+                (M, b) + jnp.asarray(labels).shape[1:])
+        else:
+            y = jnp.zeros((1,), jnp.int32)  # placeholder arg (unused)
 
         block_mod = self.block_mod
-        post_mods = self.post_mods
-        post_specs = self.post_specs
+        pre = list(zip(self.pre_specs, self.pre_mods))
+        post = list(zip(self.post_specs, self.post_mods))
+        n_pre = len(self.pre_keys)
+        pre_params = [params[k] for k in self.pre_keys]
         post_params = [params[k] for k in self.post_keys]
+        k_counts = np.asarray(self.stage_block_counts, np.int32)
+        max_k = self.max_layers_per_stage
         apply_layer = self._apply_layer
         loss_fn = self.loss_fn
         remat = self.remat
+        train = labels is not None
 
-        def stage_fn(bp, act):
-            def one_layer(a, lp):
-                return block_mod.apply({"params": lp}, a), None
-            body = functools.partial(jax.lax.scan, one_layer)
-            if remat:
-                body = jax.checkpoint(body)
-            out, _ = body(act, bp)
-            return out
+        def inject(tok, pre_ps):
+            h = tok
+            for (spec, m), pp in zip(pre, pre_ps):
+                h = apply_layer(spec, m, pp, h)
+            return h
 
-        def pipe_body(block_params, h_mbs, y_mbs, *post_ps):
-            bp = jax.tree_util.tree_map(lambda v: v[0], block_params)
-            outs = gpipe_spmd(stage_fn, bp, h_mbs)
-            # post layers + loss under the pipe trace; only the last
-            # stage's value survives the psum mask.
-            o = outs.reshape((-1,) + outs.shape[2:])
-            for spec, m, pp in zip(post_specs, post_mods, post_ps):
+        def collect(act, post_ps):
+            o = act
+            for (spec, m), pp in zip(post, post_ps):
                 o = apply_layer(spec, m, pp, o)
-            if y_mbs is None:
-                # inference: replicate final [Btot, ...] outputs
-                nstages = jax.lax.axis_size(PIPE_AXIS)
-                stage = jax.lax.axis_index(PIPE_AXIS)
-                return jax.lax.psum(
-                    jnp.where(stage == nstages - 1, o, 0.0), PIPE_AXIS)
-            yf = y_mbs.reshape((-1,) + y_mbs.shape[2:])
-            loss = loss_fn(o, yf)
-            return _last_stage_scalar(loss)
+            return o
 
-        in_specs = (P(PIPE_AXIS), P(), P()) + (P(),) * len(post_params)
+        def pipe_body(block_params, toks, y, *rest):
+            pre_ps, post_ps = rest[:n_pre], rest[n_pre:]
+            bp = jax.tree_util.tree_map(lambda v: v[0], block_params)
+            nstages = jax.lax.axis_size(PIPE_AXIS)
+            stage = jax.lax.axis_index(PIPE_AXIS)
+            k_s = jnp.asarray(k_counts)[stage]
+            perm = [(i, i + 1) for i in range(nstages - 1)]
+
+            def stage_fn(act):
+                def one_layer(a, xs):
+                    lp, li = xs
+                    new = block_mod.apply({"params": lp}, a)
+                    # idle (padded) slots pass the activation through
+                    return jnp.where(li < k_s, new, a), None
+
+                def run(a):
+                    out, _ = jax.lax.scan(one_layer, a,
+                                          (bp, jnp.arange(max_k)))
+                    return out
+                return jax.checkpoint(run)(act) if remat else run(act)
+
+            act_sd = jax.eval_shape(lambda t: inject(t, pre_ps), toks[0])
+            state0 = jnp.zeros(act_sd.shape, act_sd.dtype)
+            out_sd = jax.eval_shape(lambda a: collect(a, post_ps), state0)
+
+            if train:
+                acc0 = jnp.float32(0.0)
+            else:
+                acc0 = jnp.zeros((M,) + out_sd.shape, out_sd.dtype)
+
+            def tick(carry, t):
+                state, acc = carry
+                t_in = jnp.clip(t, 0, M - 1)
+                tok = jax.lax.dynamic_index_in_dim(toks, t_in, 0,
+                                                   keepdims=False)
+                # stage-gated head/tail: cond predicates are device-
+                # varying and the branches are collective-free, so each
+                # stage runs only its own branch (no wasted embed/head
+                # matmuls on inner stages)
+                inp = jax.lax.cond(stage == 0,
+                                   lambda: inject(tok, pre_ps).astype(
+                                       state.dtype),
+                                   lambda: state)
+                out = stage_fn(inp)
+                idx = t - (nstages - 1)
+                valid = idx >= 0
+                i_clip = jnp.clip(idx, 0, M - 1)
+                if train:
+                    yv = jax.lax.dynamic_index_in_dim(y, i_clip, 0,
+                                                      keepdims=False)
+                    l = jax.lax.cond(
+                        stage == nstages - 1,
+                        lambda: loss_fn(collect(out, post_ps),
+                                        yv).astype(jnp.float32),
+                        lambda: jnp.float32(0.0))
+                    acc = acc + jnp.where(valid, l, 0.0)
+                else:
+                    o = jax.lax.cond(
+                        stage == nstages - 1,
+                        lambda: collect(out, post_ps),
+                        lambda: jnp.zeros(out_sd.shape, out_sd.dtype))
+                    acc = jnp.where(
+                        valid,
+                        jax.lax.dynamic_update_index_in_dim(
+                            acc, o, i_clip, 0), acc)
+                nxt = jax.lax.ppermute(out, PIPE_AXIS, perm)
+                return (nxt, acc), None
+
+            (_, acc), _ = jax.lax.scan(tick, (state0, acc0),
+                                       jnp.arange(M + nstages - 1))
+            if train:
+                # mean of per-microbatch means; replicate off last stage
+                return _last_stage_scalar(acc / M)
+            flat = acc.reshape((-1,) + acc.shape[2:])
+            return jax.lax.psum(
+                jnp.where(stage == nstages - 1, flat,
+                          jnp.zeros_like(flat)), PIPE_AXIS)
+
+        in_specs = (P(PIPE_AXIS), P(), P()) + \
+            (P(),) * (len(pre_params) + len(post_params))
         fn = shard_map(pipe_body, mesh=mesh, axis_names={PIPE_AXIS},
                        in_specs=in_specs, out_specs=P(), check_vma=False)
         # jit wrapper: inlines under an enclosing trace; eagerly it works
         # around partial-manual shard_map rejecting unmentioned auto axes
-        return jax.jit(fn)(params["blocks"], h, y, *post_params)
+        return jax.jit(fn)(params["blocks"], toks, y,
+                           *pre_params, *post_params)
 
     def tensor_sharding_rules(self, name, shape):
         # Match only the wrapper's own top-level "blocks" collection

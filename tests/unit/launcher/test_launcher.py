@@ -1,0 +1,128 @@
+"""Launcher tests (reference pattern: tests/unit/launcher/test_run.py).
+
+The multi-process test is the repo's multi-host simulation: two real OS
+processes rendezvous through jax.distributed (gRPC coordinator — the
+TPU-pod bring-up path) on the CPU backend, each contributing fake local
+devices, and run a global psum over the combined mesh.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import fetch_hostfile, parse_args
+from deepspeed_tpu.launcher.launch import build_env
+from deepspeed_tpu.launcher.multinode_runner import (GcloudTPURunner,
+                                                     PDSHRunner, SSHRunner)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def test_fetch_hostfile(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text(textwrap.dedent("""
+        # comment
+        worker-0 slots=4
+        worker-1 slots=4   # trailing comment
+    """))
+    pool = fetch_hostfile(str(hf))
+    assert pool == {"worker-0": 4, "worker-1": 4}
+
+
+def test_fetch_hostfile_duplicate(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("w0 slots=2\nw0 slots=4\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(str(hf))
+
+
+def test_build_env_ranks():
+    args = parse_args(["--num_procs", "2", "train.py"])
+
+    class A:
+        node_rank, nnodes, nproc_per_node = 1, 2, 4
+        master_addr, master_port = "10.0.0.1", 29500
+        cpu_sim_devices = 0
+
+    env = build_env(A, local_rank=3)
+    assert env["RANK"] == "7"
+    assert env["WORLD_SIZE"] == "8"
+    assert env["LOCAL_RANK"] == "3"
+    assert env["JAX_PROCESS_ID"] == "7"
+    assert env["JAX_COORDINATOR_ADDRESS"] == "10.0.0.1:29500"
+
+
+def test_ssh_runner_cmds():
+    args = parse_args(["--master_port", "29501", "train.py", "--foo"])
+    args.master_addr = "w0"
+    args.user_script = "train.py"
+    args.user_args = ["--foo"]
+    r = SSHRunner(args, {"w0": 4, "w1": 4})
+    cmds = r.get_cmd({"PYTHONPATH": "/x"}, None)
+    assert len(cmds) == 2
+    assert cmds[0][0] == "ssh" and cmds[0][1] == "w0"
+    assert "--node_rank=1" in cmds[1][-1]
+    assert "PYTHONPATH=/x" in cmds[1][-1]
+
+
+def test_gcloud_runner_cmd():
+    args = parse_args(["train.py"])
+    args.master_addr = "w0"
+    args.user_script = "train.py"
+    args.user_args = []
+    r = GcloudTPURunner(args, {"w0": 1, "w1": 1}, tpu_name="pod", zone="z")
+    (cmd,) = r.get_cmd({}, None)
+    assert cmd[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh"]
+    assert "--worker=all" in cmd
+
+
+WORKER = """
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import deepspeed_tpu.comm as dist
+dist.init_distributed()  # consumes the launcher's rendezvous env
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
+
+mesh_manager.reset()
+mesh_manager.init(MeshConfig(data=jax.device_count()))
+mesh = mesh_manager.mesh
+n = jax.device_count()
+x = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")),
+    np.full((jax.local_device_count(),), jax.process_index() + 1.0,
+            np.float32),
+    (n,))
+total = jax.jit(lambda v: v.sum(), out_shardings=NamedSharding(mesh, P()))(x)
+# world=2 procs x 2 local devices: sum = 2*1 + 2*2 = 6
+if jax.process_index() == 0:
+    with open({out!r}, "w") as f:
+        f.write(f"{{n}} {{float(total)}}")
+"""
+
+
+@pytest.mark.slow
+def test_multiprocess_cpu_launch(tmp_path):
+    """dstpu --num_procs 2 --cpu_sim_devices 2: two processes rendezvous
+    and psum over a 4-device global mesh (the multi-host bring-up path)."""
+    out = tmp_path / "result.txt"
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo=REPO, out=str(out)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--num_procs", "2", "--cpu_sim_devices", "2",
+         "--master_port", "29871", str(script)],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    n, total = out.read_text().split()
+    assert n == "4" and float(total) == 6.0

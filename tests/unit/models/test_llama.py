@@ -126,3 +126,47 @@ class TestLlamaTraining:
         losses = [float(engine.train_batch(batch=batch)) for _ in range(10)]
         assert losses[-1] < losses[0]
         assert all(np.isfinite(l) for l in losses)
+
+
+def test_chunked_ce_matches_dense(rng):
+    """chunked_cross_entropy_from_hidden == cross_entropy_loss on the
+    same hidden states (gradients too)."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import (chunked_cross_entropy_from_hidden,
+                                           cross_entropy_loss)
+    B, T, C, V = 2, 37, 16, 97
+    x = jnp.asarray(rng.standard_normal((B, T, C)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((V, C)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, (B, T)), jnp.int32)
+    labels = labels.at[0, 5].set(-100)  # ignore_index exercised
+
+    def dense(x, w):
+        return cross_entropy_loss(x @ w.T, labels)
+
+    def chunked(x, w):
+        return chunked_cross_entropy_from_hidden(x, w, labels, chunk=8)
+
+    l1, (gx1, gw1) = jax.value_and_grad(dense, argnums=(0, 1))(x, w)
+    l2, (gx2, gw2) = jax.value_and_grad(chunked, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gpt2_loss_chunk_config(rng):
+    """GPT2 with loss_chunk on gives the same loss as off."""
+    import dataclasses
+    import jax
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    cfg = GPT2Config.tiny()
+    ids = np.asarray(rng.integers(0, 256, (2, 32)), np.int32)
+    m1 = GPT2LMHeadModel(cfg)
+    params = m1.init(jax.random.PRNGKey(0), ids)
+    l1, _ = m1.apply(params, ids, labels=ids)
+    m2 = GPT2LMHeadModel(dataclasses.replace(cfg, loss_chunk=16))
+    l2, aux = m2.apply(params, ids, labels=ids)
+    assert aux is None
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)

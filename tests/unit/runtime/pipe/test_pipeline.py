@@ -103,10 +103,7 @@ def test_pipeline_engine_loss_parity(eight_devices, rng):
     # sequential reference with the SAME params
     params = jax.device_get(engine.get_params())["params"]
     h = EmbedLayer().apply({"params": params["pre_0"]}, ids)
-    blocks = jax.tree_util.tree_map(
-        lambda v: v.reshape((-1,) + v.shape[2:]), params["blocks"])
-    for i in range(4):
-        lp = jax.tree_util.tree_map(lambda v: v[i], blocks)
+    for lp in engine.module.unstack_blocks(params):
         h = Block().apply({"params": lp}, h)
     logits = Head().apply({"params": params["post_0"]}, h)
     ref_loss = float(ce_loss(logits, ids))
@@ -138,14 +135,31 @@ def test_pipeline_module_partitioning():
     assert pm_uniform.parts == [0, 2, 4, 6, 8]
 
 
-def test_indivisible_blocks_raises(eight_devices):
+def test_indivisible_blocks_supported(eight_devices, rng):
+    """3 blocks over 4 stages: non-uniform masked execution (one stage
+    passes activations through) still matches the sequential model."""
     pm = _pipeline_module(n_blocks=3, num_stages=4)
     config = {"train_micro_batch_size_per_gpu": 2,
               "gradient_accumulation_steps": 4,
               "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+              "zero_optimization": {"stage": 0},
               "steps_per_print": 0}
-    with pytest.raises(ValueError, match="not divisible"):
-        deepspeed_tpu.initialize(model=pm, config=config)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=pm, config=config)
+    gbs = engine.train_batch_size()
+    ids = rng.integers(0, VOCAB, size=(gbs, 8), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    engine.init_params(batch)
+    pipe_loss = float(engine.eval_batch(batch=batch))
+
+    params = jax.device_get(engine.get_params())["params"]
+    h = EmbedLayer().apply({"params": params["pre_0"]}, ids)
+    layer_params = engine.module.unstack_blocks(params)
+    assert len(layer_params) == 3
+    for lp in layer_params:
+        h = Block().apply({"params": lp}, h)
+    logits = Head().apply({"params": params["post_0"]}, h)
+    np.testing.assert_allclose(pipe_loss, float(ce_loss(logits, ids)),
+                               rtol=1e-4)
 
 
 def test_pipeline_inference_output_shape(eight_devices, rng):
@@ -202,10 +216,40 @@ def test_tied_layer_spec_shares_params(eight_devices, rng):
     assert engine.micro_steps == 4         # counts pipeline microbatches
 
 
-def test_non_uniform_parts_raises():
-    pm = PipelineModule([LayerSpec(Block) for _ in range(8)],
-                        num_stages=4, loss_fn=ce_loss,
-                        layer_weights=[9, 1, 1, 1, 1, 1, 1, 1])
+def test_non_uniform_weighted_parts(eight_devices, rng):
+    """Explicit layer_weights produce non-uniform stages (reference:
+    pipe/module.py:387 param-count balancing) that train with loss
+    parity against the sequential model."""
     from deepspeed_tpu.runtime.pipe.engine import _PipelinedLM
-    with pytest.raises(NotImplementedError, match="non-uniform"):
-        _PipelinedLM(pm, num_stages=4, num_microbatches=2)
+    specs = ([LayerSpec(EmbedLayer)] +
+             [LayerSpec(Block) for _ in range(6)] +
+             [LayerSpec(Head)])
+    pm = PipelineModule(specs, num_stages=4, loss_fn=ce_loss,
+                        layer_weights=[5, 1, 1, 1, 1, 1, 1, 5])
+    wrapper = _PipelinedLM(pm, num_stages=4, num_microbatches=4)
+    counts = wrapper.stage_block_counts
+    assert sum(counts) == 6
+    assert len(set(counts)) > 1, f"expected non-uniform, got {counts}"
+
+    config = {"train_micro_batch_size_per_gpu": 2,
+              "gradient_accumulation_steps": 4,
+              "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+              "zero_optimization": {"stage": 0},
+              "steps_per_print": 0}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=pm, config=config)
+    gbs = engine.train_batch_size()
+    ids = rng.integers(0, VOCAB, size=(gbs, 8), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    engine.init_params(batch)
+    pipe_loss = float(engine.eval_batch(batch=batch))
+
+    params = jax.device_get(engine.get_params())["params"]
+    h = EmbedLayer().apply({"params": params["pre_0"]}, ids)
+    for lp in engine.module.unstack_blocks(params):
+        h = Block().apply({"params": lp}, h)
+    logits = Head().apply({"params": params["post_0"]}, h)
+    np.testing.assert_allclose(pipe_loss, float(ce_loss(logits, ids)),
+                               rtol=1e-4)
+
+    loss = float(engine.train_batch(batch=batch))
+    assert np.isfinite(loss)

@@ -1,0 +1,126 @@
+"""Data-efficiency tests (reference shape:
+tests/unit/runtime/test_data_efficiency.py — curriculum schedules,
+random-LTD)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumDataSampler,
+                                                 CurriculumScheduler,
+                                                 RandomLTDScheduler,
+                                                 random_ltd_layer,
+                                                 truncate_to_difficulty)
+
+
+class TestCurriculumScheduler:
+
+    def test_fixed_linear(self):
+        s = CurriculumScheduler({
+            "minimum_difficulty": 8, "maximum_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8}})
+        assert s.get_difficulty(0) == 8
+        assert s.get_difficulty(50) == 32  # 8 + 0.5*56 = 36 -> floor to 32
+        assert s.get_difficulty(100) == 64
+        assert s.get_difficulty(10_000) == 64
+
+    def test_fixed_root(self):
+        s = CurriculumScheduler({
+            "minimum_difficulty": 8, "maximum_difficulty": 64,
+            "schedule_type": "fixed_root",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8, "root_degree": 2}})
+        # sqrt schedule front-loads difficulty vs linear
+        assert s.get_difficulty(25) >= 32
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({
+            "minimum_difficulty": 1, "maximum_difficulty": 3,
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [1, 2, 3],
+                                "max_step": [5, 10]}})
+        assert s.get_difficulty(3) == 1
+        assert s.get_difficulty(7) == 2
+        assert s.get_difficulty(11) == 3
+
+    def test_bad_config_raises(self):
+        with pytest.raises(ValueError):
+            CurriculumScheduler({"schedule_type": "fixed_linear"})
+        with pytest.raises(ValueError):
+            CurriculumScheduler({
+                "minimum_difficulty": 1, "maximum_difficulty": 2,
+                "schedule_type": "nope"})
+
+
+def test_engine_curriculum_changes_seqlen():
+    """The curriculum schedule changes the fed sequence length over
+    steps (VERDICT done-criterion)."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 0,
+        "curriculum_learning": {
+            "enabled": True,
+            "minimum_difficulty": 8,
+            "maximum_difficulty": 32,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 8},
+        },
+    }
+    rng = np.random.default_rng(0)
+    data = [{"input_ids": (ids := rng.integers(0, 256, size=(32,),
+                                               dtype=np.int32)),
+             "labels": ids.copy()} for _ in range(64)]
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=model, config=config, training_data=data)
+    assert isinstance(loader, CurriculumDataSampler)
+
+    seen = []
+    for _ in range(6):
+        batch = next(engine.data_iterator)
+        seen.append(batch["input_ids"].shape[1])
+        engine.train_batch(batch=batch)
+    assert seen[0] == 8
+    assert seen[-1] == 32
+    assert len(set(seen)) > 1, f"difficulty never changed: {seen}"
+
+
+def test_truncate_transform():
+    b = {"input_ids": np.ones((2, 16), np.int32),
+         "labels": np.ones((2, 16), np.int32), "other": 3}
+    out = truncate_to_difficulty(b, 4)
+    assert out["input_ids"].shape == (2, 4)
+    assert out["other"] == 3
+
+
+class TestRandomLTD:
+
+    def test_layer_keeps_subset_and_passthrough(self):
+        B, T, C, keep = 2, 16, 4, 6
+        x = jnp.asarray(np.random.default_rng(0).standard_normal((B, T, C)),
+                        jnp.float32)
+        marker = lambda t: t + 100.0
+        out = random_ltd_layer(marker, x, keep, jax.random.PRNGKey(0))
+        changed = np.isclose(np.asarray(out - x), 100.0).all(axis=-1)
+        assert (changed.sum(axis=1) == keep).all()
+
+    def test_keep_all_is_identity_wrap(self):
+        x = jnp.ones((1, 4, 2))
+        out = random_ltd_layer(lambda t: t * 2, x, 4, jax.random.PRNGKey(0))
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+
+    def test_scheduler_anneals(self):
+        s = RandomLTDScheduler(min_value=128, max_value=512,
+                               total_ltd_step=100, difficulty_step=16)
+        assert s.get_current_seq(0) == 128
+        assert s.get_current_seq(100) == 512
+        assert s.get_current_seq(50) in range(128, 513, 16)

@@ -1,0 +1,86 @@
+"""Tensor-fragment API tests (reference shape:
+tests/unit/runtime/zero/test_zero_tensor_fragment.py)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.utils.tensor_fragment import (
+    engine_param_names, safe_get_full_fp32_param, safe_get_full_grad,
+    safe_get_full_optimizer_state, safe_set_full_fp32_param,
+    safe_set_full_optimizer_state)
+
+
+@pytest.fixture(scope="module", params=[1, 3])
+def engine(request):
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": request.param},
+        "steps_per_print": 0,
+    }
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    ids = np.random.default_rng(0).integers(
+        0, 256, size=(eng.train_batch_size(), 32), dtype=np.int32)
+    eng.train_batch(batch={"input_ids": ids, "labels": ids.copy()})
+    return eng
+
+
+def test_get_full_param_all_names(engine):
+    names = engine_param_names(engine)
+    assert names
+    for name in names[:5]:
+        v = safe_get_full_fp32_param(engine, name)
+        assert v is not None and v.dtype == np.float32
+    assert safe_get_full_fp32_param(engine, "no.such.param") is None
+
+
+def test_set_full_param_roundtrip(engine):
+    name = engine_param_names(engine)[0]
+    orig = safe_get_full_fp32_param(engine, name)
+    new = orig + 1.5
+    assert safe_set_full_fp32_param(engine, name, new)
+    got = safe_get_full_fp32_param(engine, name)
+    np.testing.assert_allclose(got, new, rtol=1e-6)
+    safe_set_full_fp32_param(engine, name, orig)  # restore
+    with pytest.raises(ValueError):
+        safe_set_full_fp32_param(engine, name, np.zeros((3,)))
+
+
+def test_optimizer_state_access(engine):
+    name = engine_param_names(engine)[0]
+    m = safe_get_full_optimizer_state(engine, name, "exp_avg")
+    v = safe_get_full_optimizer_state(engine, name, "exp_avg_sq")
+    assert m is not None and v is not None
+    assert m.shape == safe_get_full_fp32_param(engine, name).shape
+    # after one Adam step some moment entries must be non-zero
+    assert np.abs(m).sum() > 0
+
+    new = np.zeros_like(m)
+    assert safe_set_full_optimizer_state(engine, name, "exp_avg", new)
+    got = safe_get_full_optimizer_state(engine, name, "exp_avg")
+    np.testing.assert_allclose(got, 0.0)
+
+
+def test_grad_access_on_eager_path():
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 0,
+    }
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    ids = np.random.default_rng(0).integers(
+        0, 256, size=(eng.train_batch_size(), 32), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    eng.init_params(batch)
+    name = engine_param_names(eng)[0]
+    assert safe_get_full_grad(eng, name) is None  # before backward
+    eng.backward(batch=batch)
+    g = safe_get_full_grad(eng, name)
+    assert g is not None and np.abs(g).sum() > 0
+    eng.step()
