@@ -1,0 +1,2 @@
+from .autotuner import Autotuner, TrialResult
+from .config import AutotuningConfig

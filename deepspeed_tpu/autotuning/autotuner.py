@@ -1,0 +1,181 @@
+"""Autotuner — config search over timed trial runs.
+
+Reference: deepspeed/autotuning/autotuner.py (2,723 LoC package):
+enumerates (ZeRO stage, micro-batch, offload) configs, launches each as
+an experiment, ranks by the chosen metric, and emits the best config.
+
+TPU-native reading: trials run IN PROCESS (an engine per trial — jit
+cache makes retries cheap and a failed trial surfaces as a Python
+exception rather than a dead remote job), infeasible configs are pruned
+first by a memory model (params bytes vs HBM — the reference's
+model-based tuner), and OOM during a trial marks the config infeasible
+instead of crashing the search.
+"""
+
+import dataclasses
+import itertools
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+from .config import AutotuningConfig
+
+
+@dataclasses.dataclass
+class TrialResult:
+    config: Dict[str, Any]
+    feasible: bool
+    tokens_per_sec: float = 0.0
+    step_time_ms: float = 0.0
+    error: str = ""
+    metric: str = "throughput"
+
+    @property
+    def metric_value(self):
+        """Higher is better for ranking (latency negated)."""
+        if self.metric == "latency":
+            return -self.step_time_ms
+        return self.tokens_per_sec
+
+
+class Autotuner:
+    """Search driver.
+
+    ``engine_factory(overrides: dict) -> engine`` builds a fresh engine
+    for a trial config; ``batch_factory(engine) -> batch`` supplies a
+    matching global batch. The caller owns model construction so any
+    knob (remat, flash, mesh) can participate via overrides.
+    """
+
+    def __init__(self, base_config: dict,
+                 engine_factory: Callable[[Dict[str, Any]], Any],
+                 batch_factory: Callable[[Any], Any],
+                 tuning: Optional[AutotuningConfig] = None,
+                 model_info: Optional[Dict[str, Any]] = None):
+        self.base_config = base_config
+        self.engine_factory = engine_factory
+        self.batch_factory = batch_factory
+        self.tuning = tuning or AutotuningConfig.from_dict(base_config)
+        # model_info enables memory pre-pruning (the model-based tuner):
+        # {"num_params", "hidden_size", "num_layers", "seq",
+        #  "hbm_bytes", "world_size"}
+        self.model_info = model_info
+        self.results: List[TrialResult] = []
+
+    # -- candidate enumeration ----------------------------------------
+    def candidates(self) -> List[Dict[str, Any]]:
+        t = self.tuning
+        micro = t.micro_batch_sizes or [1, 2, 4, 8, 16, 32]
+        stages = t.zero_stages if t.zero_stages is not None else \
+            [self.base_config.get("zero_optimization", {}).get("stage", 0)]
+        gas = t.gradient_accumulation_steps or \
+            [self.base_config.get("gradient_accumulation_steps", 1)]
+        remats = [False, True] if t.tune_remat else [None]
+        combos = []
+        for m, s, g, r in itertools.product(micro, stages, gas, remats):
+            c = {"train_micro_batch_size_per_gpu": m,
+                 "zero_optimization": {"stage": s},
+                 "gradient_accumulation_steps": g}
+            if r is not None:
+                c["use_remat"] = r
+            combos.append(c)
+        if self.model_info:
+            combos = [c for c in combos if self._fits_memory(c)]
+        if t.tuner_type == "random":
+            rng = np.random.default_rng(t.seed)
+            rng.shuffle(combos)
+        return combos[:t.max_trials]
+
+    def _fits_memory(self, overrides: Dict[str, Any]) -> bool:
+        mi = self.model_info
+        micro_tokens = overrides["train_micro_batch_size_per_gpu"] * \
+            mi.get("seq", 1024)
+        est = self.estimate_bytes(
+            mi["num_params"], overrides["zero_optimization"]["stage"],
+            micro_tokens, mi.get("hidden_size", 1024),
+            mi.get("num_layers", 12), world=mi.get("world_size", 1))
+        budget = mi.get("hbm_bytes", 16 << 30)
+        if est > budget:
+            self.results.append(TrialResult(
+                config=overrides, feasible=False, metric=self.tuning.metric,
+                error=f"pruned: est {est/1e9:.1f}GB > "
+                      f"HBM {budget/1e9:.1f}GB"))
+            return False
+        return True
+
+    # -- memory pre-pruning (model-based tuner) -----------------------
+    @staticmethod
+    def estimate_bytes(n_params: int, stage: int, micro_tokens: int,
+                       hidden: int, n_layers: int, world: int = 1) -> int:
+        """Rough per-chip bytes: bf16 params + fp32 master + 2 fp32 Adam
+        moments (ZeRO divides state terms by the shard count) plus a
+        linear activation term."""
+        shard = max(1, world) if stage >= 1 else 1
+        param_shard = max(1, world) if stage >= 3 else 1
+        state = n_params * (4 + 4 + 4) / shard
+        params16 = n_params * 2 / param_shard
+        acts = micro_tokens * hidden * n_layers * 8  # ~4 bf16 tensors/layer
+        return int(state + params16 + acts)
+
+    # -- trials -------------------------------------------------------
+    def run_trial(self, overrides: Dict[str, Any]) -> TrialResult:
+        t = self.tuning
+        try:
+            engine = self.engine_factory(overrides)
+            batch = self.batch_factory(engine)
+            for _ in range(t.warmup_steps):
+                float(engine.train_batch(batch=batch))
+            t0 = time.time()
+            loss = None
+            for _ in range(t.trial_steps):
+                loss = engine.train_batch(batch=batch)
+            float(loss)
+            dt = (time.time() - t0) / t.trial_steps
+            leaves = batch.values() if isinstance(batch, dict) else batch
+            tokens = 0
+            for v in leaves:
+                arr = np.asarray(v)
+                tokens = max(tokens, arr.shape[0] * (
+                    arr.shape[1] if arr.ndim > 1 else 1))
+            return TrialResult(config=overrides, feasible=True,
+                               tokens_per_sec=tokens / dt,
+                               step_time_ms=dt * 1e3,
+                               metric=t.metric)
+        except Exception as e:  # OOM / bad config -> infeasible trial
+            msg = str(e)
+            kind = "oom" if "RESOURCE_EXHAUSTED" in msg or \
+                "memory" in msg.lower() else "error"
+            logger.info(f"trial {overrides} infeasible ({kind}): "
+                        f"{msg[:200]}")
+            return TrialResult(config=overrides, feasible=False,
+                               metric=t.metric,
+                               error=f"{kind}: {msg[:500]}")
+
+    def tune(self) -> TrialResult:
+        """Run the search; returns the best trial (reference: the
+        autotuner's 'optimal' experiment selection)."""
+        best: Optional[TrialResult] = None
+        for overrides in self.candidates():
+            r = self.run_trial(overrides)
+            self.results.append(r)
+            if r.feasible and (best is None or
+                               r.metric_value > best.metric_value):
+                best = r
+        if best is None:
+            raise RuntimeError("autotuning found no feasible config")
+        self.write_results()
+        logger.info(f"autotuning best: {best.config} -> "
+                    f"{best.tokens_per_sec:,.0f} tokens/s")
+        return best
+
+    def write_results(self):
+        os.makedirs(self.tuning.results_dir, exist_ok=True)
+        path = os.path.join(self.tuning.results_dir, "results.json")
+        with open(path, "w") as f:
+            json.dump([dataclasses.asdict(r) for r in self.results], f,
+                      indent=2)
+        return path

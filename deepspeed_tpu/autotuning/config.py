@@ -1,0 +1,28 @@
+"""Autotuning config (reference: deepspeed/autotuning/config.py — the
+``autotuning`` section: enabled, metric, start/end profile steps, tuner
+type, max trials)."""
+
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class AutotuningConfig:
+    enabled: bool = False
+    metric: str = "throughput"          # throughput | latency
+    tuner_type: str = "gridsearch"      # gridsearch | random | model_based
+    max_trials: int = 50
+    trial_steps: int = 3                # timed steps per trial
+    warmup_steps: int = 2
+    micro_batch_sizes: Optional[List[int]] = None   # None = auto sweep
+    zero_stages: Optional[List[int]] = None         # None = [current]
+    gradient_accumulation_steps: Optional[List[int]] = None
+    tune_remat: bool = False
+    results_dir: str = "autotuning_results"
+    seed: int = 0
+
+    @classmethod
+    def from_dict(cls, d: dict):
+        sec = d.get("autotuning", {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in sec.items() if k in known})

@@ -1,0 +1,7 @@
+from .compress import (apply_compression, init_compression,
+                       redundancy_clean)
+from .config import CompressionConfig
+from .quantizers import (asym_quantize, binary_quantize, ptq_dequantize,
+                         ptq_quantize, sym_quantize, ternary_quantize)
+from .pruners import head_prune_mask, magnitude_prune, row_prune_mask
+from .scheduler import CompressionScheduler

@@ -1,0 +1,66 @@
+"""Compression config parsing (reference: deepspeed/compression/config.py
+— the ``compression_training`` section with weight_quantization /
+activation_quantization / sparse_pruning / row_pruning / head_pruning /
+channel_pruning / layer_reduction; shared_parameters + different_groups
+with ``modules`` patterns)."""
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+TECHNIQUES = ("weight_quantization", "activation_quantization",
+              "sparse_pruning", "row_pruning", "head_pruning",
+              "channel_pruning")
+
+
+@dataclasses.dataclass
+class TechniqueGroup:
+    """One ``different_groups`` entry: which params + its parameters."""
+    name: str
+    modules: List[str]                  # substring patterns ('*' = all)
+    params: Dict[str, Any]
+    related_modules: Optional[List[str]] = None
+
+
+@dataclasses.dataclass
+class TechniqueConfig:
+    enabled: bool = False
+    shared: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    groups: List[TechniqueGroup] = dataclasses.field(default_factory=list)
+
+    @property
+    def schedule_offset(self) -> int:
+        return int(self.shared.get("schedule_offset", 0))
+
+
+class CompressionConfig:
+
+    def __init__(self, ds_config: dict):
+        section = ds_config.get("compression_training", {})
+        self.techniques: Dict[str, TechniqueConfig] = {}
+        for tech in TECHNIQUES:
+            tc = TechniqueConfig()
+            sub = section.get(tech, {})
+            shared = sub.get("shared_parameters", {})
+            tc.enabled = shared.get("enabled", False)
+            tc.shared = shared
+            for gname, g in sub.get("different_groups", {}).items():
+                params = dict(g.get("params", {}))
+                tc.groups.append(TechniqueGroup(
+                    name=gname,
+                    modules=g.get("modules", ["*"]),
+                    params=params,
+                    related_modules=g.get("related_modules")))
+            self.techniques[tech] = tc
+        self.layer_reduction = section.get("layer_reduction",
+                                           {"enabled": False})
+
+    def enabled(self, tech: str) -> bool:
+        return self.techniques.get(tech, TechniqueConfig()).enabled
+
+    def any_enabled(self) -> bool:
+        return any(t.enabled for t in self.techniques.values()) or \
+            self.layer_reduction.get("enabled", False)
+
+
+def module_matches(name: str, patterns: List[str]) -> bool:
+    return any(p == "*" or p in name for p in patterns)

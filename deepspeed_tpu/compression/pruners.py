@@ -1,0 +1,73 @@
+"""Pruning transforms (reference: deepspeed/compression/basic_layer.py
+LinearLayer_Compress pruning modes — sparse (unstructured magnitude),
+row, head, channel — mask computed from weight magnitude, applied with
+straight-through gradients)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def magnitude_prune(w, ratio: float, structured: str = "none"):
+    """Zero the smallest-|w| entries. ``ratio`` = fraction pruned.
+
+    structured: 'none' (per-element), 'row' (prune whole output rows by
+    L1 norm), matching the reference's sparse/row pruning methods."""
+    return _prune_fwd(w, ratio, structured)
+
+
+def _prune_fwd(w, ratio, structured):
+    return w * prune_mask(w, ratio, structured)
+
+
+def prune_mask(w, ratio, structured="none"):
+    wf = jnp.abs(w.astype(jnp.float32))
+    if structured == "row":
+        score = wf.sum(axis=-1)
+        k = max(1, int(score.shape[0] * (1 - ratio)))
+        thresh = jnp.sort(score)[-k]
+        return (score >= thresh).astype(w.dtype)[:, None]
+    flat = wf.reshape(-1)
+    k = max(1, int(flat.shape[0] * (1 - ratio)))
+    thresh = jnp.sort(flat)[-k]
+    return (wf >= thresh).astype(w.dtype)
+
+
+magnitude_prune.defvjp(
+    lambda w, r, s: (_prune_fwd(w, r, s), None),
+    lambda r, s, res, ct: (ct,))
+
+
+def row_prune_mask(w, ratio):
+    """[out-rows] keep mask by row L1 norm (reference row pruning)."""
+    return prune_mask(w, ratio, "row")[:, 0]
+
+
+def head_prune_mask(w_qkv, num_heads: int, ratio: float):
+    """Per-head keep mask from the attention projection's magnitude
+    (reference head pruning: rank heads by the L1 of their slice).
+
+    w_qkv: [in, heads * head_dim] column layout; returns [heads] mask."""
+    d_in, d_out = w_qkv.shape
+    hd = d_out // num_heads
+    score = jnp.abs(w_qkv.astype(jnp.float32)).reshape(
+        d_in, num_heads, hd).sum(axis=(0, 2))
+    k = max(1, int(num_heads * (1 - ratio)))
+    thresh = jnp.sort(score)[-k]
+    return (score >= thresh)
+
+
+def apply_head_mask(w, num_heads: int, mask, axis: int = 1):
+    """Zero pruned heads in a [in, heads*hd] (axis=1) or [heads*hd, out]
+    (axis=0) projection."""
+    if axis == 1:
+        d_in, d_out = w.shape
+        hd = d_out // num_heads
+        return (w.reshape(d_in, num_heads, hd) *
+                mask[None, :, None].astype(w.dtype)).reshape(w.shape)
+    d_in, d_out = w.shape
+    hd = d_in // num_heads
+    return (w.reshape(num_heads, hd, d_out) *
+            mask[:, None, None].astype(w.dtype)).reshape(w.shape)

@@ -1,0 +1,146 @@
+"""Quantizers — fake-quant (QAT) with straight-through gradients, plus
+real weight-only PTQ (ZeroQuant-style).
+
+Reference: deepspeed/compression/utils.py:62-220 (SymQuantizer,
+AsymQuantizer, TernaryQuantizer, BinaryQuantizer — torch autograd
+Functions with clone-through backward) and csrc/quantization/ (the
+group-wise int kernels). Under XLA the fake-quant path is a
+``jax.custom_vjp`` identity-gradient function — the round/clamp chain
+fuses into neighbouring ops; no custom kernels needed.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _group_view(x, num_groups):
+    return x.reshape(num_groups, -1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def sym_quantize(x, num_bits: int = 8, num_groups: int = 1):
+    """Symmetric group-wise fake quantization (utils.py:62).
+
+    Straight-through estimator: gradients pass unchanged."""
+    return _sym_fwd(x, num_bits, num_groups)
+
+
+def _sym_fwd(x, num_bits, num_groups):
+    q_range = 2 ** num_bits
+    g = _group_view(x.astype(jnp.float32), num_groups)
+    max_in = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+    scale = 2 * max_in / q_range
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(g / scale), -q_range // 2, q_range // 2 - 1)
+    return (q * scale).reshape(x.shape).astype(x.dtype)
+
+
+sym_quantize.defvjp(
+    lambda x, b, g: (_sym_fwd(x, b, g), None),
+    lambda b, g, res, ct: (ct,))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def asym_quantize(x, num_bits: int = 8, num_groups: int = 1):
+    """Asymmetric group-wise fake quantization (utils.py:104)."""
+    return _asym_fwd(x, num_bits, num_groups)
+
+
+def _asym_fwd(x, num_bits, num_groups):
+    q_range = 2 ** num_bits
+    g = _group_view(x.astype(jnp.float32), num_groups)
+    lo = jnp.min(g, axis=-1, keepdims=True)
+    hi = jnp.max(g, axis=-1, keepdims=True)
+    scale = (hi - lo) / q_range
+    scale = jnp.where(scale == 0, 1.0, scale)
+    zero = jnp.round(lo / scale) * scale
+    q = jnp.clip(jnp.round((g - zero) / scale), 0, q_range - 1)
+    return (q * scale + zero).reshape(x.shape).astype(x.dtype)
+
+
+asym_quantize.defvjp(
+    lambda x, b, g: (_asym_fwd(x, b, g), None),
+    lambda b, g, res, ct: (ct,))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def ternary_quantize(x, num_groups: int = 1):
+    """Ternary {-a, 0, +a} quantization (utils.py:148)."""
+    return _ternary_fwd(x, num_groups)
+
+
+def _ternary_fwd(x, num_groups):
+    g = _group_view(x.astype(jnp.float32), num_groups)
+    thres = 0.7 * jnp.mean(jnp.abs(g), axis=-1, keepdims=True)
+    mask = jnp.abs(g) > thres
+    alpha = jnp.sum(jnp.abs(g) * mask, axis=-1, keepdims=True) / \
+        jnp.maximum(mask.sum(axis=-1, keepdims=True), 1)
+    return (jnp.sign(g) * alpha * mask).reshape(x.shape).astype(x.dtype)
+
+
+ternary_quantize.defvjp(
+    lambda x, g: (_ternary_fwd(x, g), None),
+    lambda g, res, ct: (ct,))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def binary_quantize(x, num_groups: int = 1):
+    """Binary {-a, +a} quantization (utils.py:189)."""
+    return _binary_fwd(x, num_groups)
+
+
+def _binary_fwd(x, num_groups):
+    g = _group_view(x.astype(jnp.float32), num_groups)
+    alpha = jnp.mean(jnp.abs(g), axis=-1, keepdims=True)
+    return (jnp.sign(g) * alpha).reshape(x.shape).astype(x.dtype)
+
+
+binary_quantize.defvjp(
+    lambda x, g: (_binary_fwd(x, g), None),
+    lambda g, res, ct: (ct,))
+
+
+QUANTIZERS = {
+    "symmetric": sym_quantize,
+    "asymmetric": asym_quantize,
+    "ternary": lambda x, num_bits=2, num_groups=1:
+        ternary_quantize(x, num_groups),
+    "binary": lambda x, num_bits=1, num_groups=1:
+        binary_quantize(x, num_groups),
+}
+
+
+# ---------------------------------------------------------------------------
+# real PTQ (ZeroQuant-style weight-only, reference: inference/quantization)
+# ---------------------------------------------------------------------------
+def ptq_quantize(w, num_bits: int = 8,
+                 group_size: int = 128) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Actually store int8: returns (q [same shape, int8], scales).
+
+    Group-wise symmetric over the LAST axis in ``group_size`` chunks
+    (csrc/quantization/quantize.cu block layout)."""
+    if num_bits > 8:
+        raise ValueError("ptq supports <= 8 bits")
+    shape = w.shape
+    d = shape[-1]
+    gs = min(group_size, d)
+    if d % gs:
+        gs = d  # irregular tail: one group per row
+    g = w.astype(jnp.float32).reshape(-1, gs)
+    max_in = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+    q_range = 2 ** (num_bits - 1) - 1
+    scale = jnp.where(max_in == 0, 1.0, max_in / q_range)
+    q = jnp.clip(jnp.round(g / scale), -q_range - 1, q_range)
+    return (q.astype(jnp.int8).reshape(shape),
+            scale.reshape(shape[:-1] + (d // gs,)))
+
+
+def ptq_dequantize(q, scales, dtype=jnp.bfloat16):
+    shape = q.shape
+    d = shape[-1]
+    gs = d // scales.shape[-1]
+    g = q.astype(jnp.float32).reshape(-1, gs) * scales.reshape(-1, 1)
+    return g.reshape(shape).astype(dtype)

@@ -1,0 +1,24 @@
+"""Compression scheduler (reference: deepspeed/compression/scheduler.py
+``compression_scheduler`` — enables each technique once training passes
+its ``schedule_offset`` step)."""
+
+from typing import Dict
+
+from .config import CompressionConfig
+
+
+class CompressionScheduler:
+
+    def __init__(self, config: CompressionConfig):
+        self.config = config
+        self.active: Dict[str, bool] = {t: False
+                                        for t in config.techniques}
+
+    def step(self, global_steps: int) -> Dict[str, bool]:
+        for tech, tc in self.config.techniques.items():
+            self.active[tech] = tc.enabled and \
+                global_steps >= tc.schedule_offset
+        return dict(self.active)
+
+    def is_active(self, tech: str) -> bool:
+        return self.active.get(tech, False)

@@ -230,6 +230,10 @@ def compute_elastic_config(ds_config: dict, target_deepspeed_version: str = "",
         # ``valid`` holds DATA-PARALLEL world sizes; a chip count must be
         # reduced by the model-parallel degree before membership / batch
         # arithmetic (reference: valid_gpus are dp ranks in v0.2)
+        if world_size % cfg.model_parallel_size:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not divisible by "
+                f"model_parallel_size {cfg.model_parallel_size}")
         dp_world = world_size // cfg.model_parallel_size
         if dp_world not in valid:
             raise ElasticityIncompatibleWorldSize(

@@ -128,30 +128,49 @@ class InferenceEngineV2:
         self._state_manager.flush_sequence(uid)
 
     # -- Dynamic SplitFuse scheduler + serving loop ---------------------
+    def _blocks_needed(self, uid: int, n_tokens: int) -> int:
+        ec = self._config
+        seq = self._state_manager.get_sequence(uid)
+        if seq is None:
+            return -(-n_tokens // ec.kv_block_size)
+        return seq.kv_blocks_needed(n_tokens, ec.kv_block_size)
+
     def schedule(self, pending: Dict[int, np.ndarray],
                  active_decode: Dict[int, int]
                  ) -> Tuple[List[int], List[np.ndarray]]:
         """Pick this step's work: all decode tokens first, then prompt
-        chunks until the token budget fills (Dynamic SplitFuse)."""
+        chunks until the token budget fills (Dynamic SplitFuse). KV-block
+        aware: work that cannot get blocks this step is deferred, not
+        failed — sequences it skips run once others finish and free
+        their blocks."""
         ec = self._config
         uids, toks = [], []
         budget = ec.token_budget
         slots = ec.max_ragged_sequence_count
+        blocks = self.free_blocks
         for uid, tok in active_decode.items():
             if budget <= 0 or slots <= 0:
                 break
+            need = self._blocks_needed(uid, 1)
+            if need > blocks:
+                continue  # deferred until blocks free up
             uids.append(uid)
             toks.append(np.asarray([tok], np.int32))
             budget -= 1
             slots -= 1
+            blocks -= need
         for uid, prompt in pending.items():
             if budget <= 0 or slots <= 0:
                 break
             chunk = prompt[:budget]
+            need = self._blocks_needed(uid, len(chunk))
+            if need > blocks:
+                continue
             uids.append(uid)
             toks.append(chunk)
             budget -= len(chunk)
             slots -= 1
+            blocks -= need
         return uids, toks
 
     def generate_batch(self, prompts: Dict[int, Iterable[int]],
@@ -169,7 +188,9 @@ class InferenceEngineV2:
         while pending or decode:
             uids, toks = self.schedule(pending, decode)
             if not uids:
-                raise SchedulingError(SchedulingResult.BatchFull)
+                # nothing schedulable and nothing in flight that could
+                # free blocks -> genuinely stuck
+                raise SchedulingError(SchedulingResult.OutOfKVBlocks)
             logits = self.put(uids, toks)
             for row, (uid, chunk) in enumerate(zip(uids, toks)):
                 if uid in pending:

@@ -67,6 +67,14 @@ def bench_collectives(axis="fsdp", sizes=None, trials=5, dtype="float32"):
             "all_gather": (lambda v: jax.lax.all_gather(v, axis,
                                                         tiled=True),
                            spec, P()),
+            "reduce_scatter": (
+                lambda v: jax.lax.psum_scatter(v, axis, tiled=True),
+                spec, spec),
+            "all_to_all": (
+                lambda v: jax.lax.all_to_all(
+                    v.reshape(world, -1), axis, split_axis=0,
+                    concat_axis=0, tiled=True).reshape(-1),
+                spec, spec),
             "ppermute": (lambda v: jax.lax.ppermute(
                 v, axis, [(i, (i + 1) % world) for i in range(world)]),
                 spec, spec),

@@ -1,0 +1,201 @@
+"""BLOOM model family in flax (BASELINE config 5's architecture).
+
+TPU-native model zoo entry (reference: the BLOOM kernel-injection policy
+module_inject/containers/bloom.py + model_implementations/transformers/
+ds_bloom.py). ALiBi attention biases, fused query_key_value projection,
+word-embedding LayerNorm, tied LM head — HF ``BloomForCausalLM`` weight
+layout so checkpoints convert 1:1.
+
+ALiBi biases are additive per-head slopes on key distance; the flash
+kernel has no bias input yet, so attention uses the XLA einsum path
+(fusion keeps it competitive at BLOOM's 2048 context).
+"""
+
+import dataclasses
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import TENSOR_AXIS
+from .gpt2 import cross_entropy_loss
+
+
+@dataclasses.dataclass(frozen=True)
+class BloomConfig:
+    vocab_size: int = 250880
+    hidden_size: int = 4096
+    n_layer: int = 30
+    n_head: int = 32
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_remat: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.n_head
+
+    @staticmethod
+    def bloom_7b():
+        return BloomConfig()
+
+    @staticmethod
+    def tiny():
+        return BloomConfig(vocab_size=256, hidden_size=64, n_layer=2,
+                           n_head=4)
+
+
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes (the published geometric sequence)."""
+    def pow2_slopes(n):
+        start = 2 ** (-(2 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        return np.asarray(pow2_slopes(n_heads), np.float32)
+    closest = 2 ** math.floor(math.log2(n_heads))
+    base = pow2_slopes(closest)
+    extra = pow2_slopes(2 * closest)[0::2][:n_heads - closest]
+    return np.asarray(base + extra, np.float32)
+
+
+class BloomAttention(nn.Module):
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        B, T, C = x.shape
+        nh, hd = cfg.n_head, cfg.head_dim
+        qkv = nn.Dense(3 * C, name="query_key_value",
+                       kernel_init=nn.initializers.normal(
+                           cfg.initializer_range))(x)
+        # HF BLOOM fuses as [heads, 3, head_dim]
+        qkv = qkv.reshape(B, T, nh, 3, hd)
+        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+            hd).astype(x.dtype)
+        slopes = jnp.asarray(alibi_slopes(nh))
+        dist = jnp.arange(T)[None, :] - jnp.arange(T)[:, None]  # k - q
+        alibi = slopes[:, None, None] * jnp.minimum(dist, 0)[None]
+        scores = scores + alibi.astype(scores.dtype)
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        scores = jnp.where(mask[None, None], scores,
+                           jnp.finfo(scores.dtype).min)
+        p = jax.nn.softmax(scores.astype(jnp.float32),
+                           axis=-1).astype(x.dtype)
+        y = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, C)
+        return nn.Dense(C, name="dense")(y)
+
+
+class BloomBlock(nn.Module):
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                         name="input_layernorm")(x)
+        x = x + BloomAttention(cfg, name="self_attention")(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                         name="post_attention_layernorm")(x)
+        h = nn.Dense(4 * cfg.hidden_size, name="dense_h_to_4h")(h)
+        h = nn.gelu(h, approximate=True)
+        x = x + nn.Dense(cfg.hidden_size, name="dense_4h_to_h")(h)
+        return x
+
+
+class BloomForCausalLM(nn.Module):
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None):
+        cfg = self.config
+        emb = self.param("word_embeddings",
+                         nn.initializers.normal(cfg.initializer_range),
+                         (cfg.vocab_size, cfg.hidden_size))
+        x = emb[input_ids]
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                         name="word_embeddings_layernorm")(x)
+        block = BloomBlock
+        if cfg.use_remat:
+            block = nn.remat(BloomBlock)
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f")(x)
+        logits = x @ emb.T  # tied
+        if labels is None:
+            return logits
+        return cross_entropy_loss(logits, labels), logits
+
+
+def bloom_tensor_rules(name, shape):
+    """TP rules (the BLOOM injection-policy sharding,
+    module_inject/containers/bloom.py: qkv column, dense/4h_to_h row)."""
+    if "query_key_value.kernel" in name or "dense_h_to_4h.kernel" in name:
+        return P(None, TENSOR_AXIS)
+    if "query_key_value.bias" in name or "dense_h_to_4h.bias" in name:
+        return P(TENSOR_AXIS)
+    if ".dense.kernel" in name or "dense_4h_to_h.kernel" in name:
+        return P(TENSOR_AXIS, None)
+    return None
+
+
+BloomForCausalLM.tensor_sharding_rules = staticmethod(bloom_tensor_rules)
+
+
+def from_hf_state_dict(state_dict, config: BloomConfig):
+    """HF BloomForCausalLM state dict -> this module's params.
+
+    HF stores fused qkv as [3*h, h] with rows interleaved per head as
+    [head, 3, head_dim]; flax Dense kernels transpose to [in, out]."""
+
+    def g(key, transpose=False):
+        v = state_dict[key]
+        if hasattr(v, "numpy"):
+            v = v.detach().cpu().numpy()
+        v = np.asarray(v)
+        return v.T if transpose else v
+
+    prefix = "transformer." if "transformer.word_embeddings.weight" in \
+        state_dict else ""
+    params = {
+        "word_embeddings": g(f"{prefix}word_embeddings.weight"),
+        "word_embeddings_layernorm": {
+            "scale": g(f"{prefix}word_embeddings_layernorm.weight"),
+            "bias": g(f"{prefix}word_embeddings_layernorm.bias")},
+        "ln_f": {"scale": g(f"{prefix}ln_f.weight"),
+                 "bias": g(f"{prefix}ln_f.bias")},
+    }
+    for i in range(config.n_layer):
+        lp = f"{prefix}h.{i}."
+        params[f"h_{i}"] = {
+            "input_layernorm": {
+                "scale": g(f"{lp}input_layernorm.weight"),
+                "bias": g(f"{lp}input_layernorm.bias")},
+            "post_attention_layernorm": {
+                "scale": g(f"{lp}post_attention_layernorm.weight"),
+                "bias": g(f"{lp}post_attention_layernorm.bias")},
+            "self_attention": {
+                "query_key_value": {
+                    "kernel": g(f"{lp}self_attention.query_key_value."
+                                f"weight", transpose=True),
+                    "bias": g(f"{lp}self_attention.query_key_value.bias")},
+                "dense": {
+                    "kernel": g(f"{lp}self_attention.dense.weight",
+                                transpose=True),
+                    "bias": g(f"{lp}self_attention.dense.bias")},
+            },
+            "dense_h_to_4h": {
+                "kernel": g(f"{lp}mlp.dense_h_to_4h.weight",
+                            transpose=True),
+                "bias": g(f"{lp}mlp.dense_h_to_4h.bias")},
+            "dense_4h_to_h": {
+                "kernel": g(f"{lp}mlp.dense_4h_to_h.weight",
+                            transpose=True),
+                "bias": g(f"{lp}mlp.dense_4h_to_h.bias")},
+        }
+    return {"params": params}

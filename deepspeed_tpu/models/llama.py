@@ -45,6 +45,9 @@ class LlamaConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
     use_remat: bool = False
+    # Mistral-style local attention: keys further than this behind the
+    # query are masked out (None = full causal)
+    sliding_window: Optional[int] = None
 
     @property
     def head_dim(self):
@@ -111,7 +114,10 @@ class LlamaAttention(nn.Module):
 
         new_cache = None
         if cache is None:
-            y = flash_attention(q, k, v, causal=True)
+            if cfg.sliding_window is not None and T > cfg.sliding_window:
+                y = _windowed_attention(q, k, v, cfg.sliding_window)
+            else:
+                y = flash_attention(q, k, v, causal=True)
         else:
             k_cache, v_cache = cache
             if isinstance(cache_index, int) and \
@@ -126,23 +132,50 @@ class LlamaAttention(nn.Module):
                 v_cache, v.astype(v_cache.dtype), (0, cache_index, 0, 0))
             new_cache = (k_cache, v_cache)
             if isinstance(cache_index, int) and T > 1:
-                # prefill: static slice of the live prefix -> flash kernel
+                # prefill: static slice of the live prefix
                 kv_len = cache_index + T
-                y = flash_attention(q, k_cache[:, :kv_len].astype(q.dtype),
-                                    v_cache[:, :kv_len].astype(q.dtype),
-                                    causal=True)
+                kp = k_cache[:, :kv_len].astype(q.dtype)
+                vp = v_cache[:, :kv_len].astype(q.dtype)
+                if cfg.sliding_window is not None and \
+                        kv_len > cfg.sliding_window:
+                    y = _windowed_attention(q, kp, vp, cfg.sliding_window)
+                else:
+                    y = flash_attention(q, kp, vp, causal=True)
             else:
-                y = _decode_attention(q, k_cache, v_cache, cache_index + T)
+                y = _decode_attention(q, k_cache, v_cache, cache_index + T,
+                                      window=cfg.sliding_window)
 
         y = y.reshape(B, T, nh * hd)
         out = _dense(cfg, C, "o_proj")(y)
         return (out, new_cache) if cache is not None else out
 
 
-def _decode_attention(q, k_cache, v_cache, kv_len):
+def _windowed_attention(q, k, v, window):
+    """Causal attention restricted to the last ``window`` keys (Mistral
+    sliding-window; XLA-fused einsum path — the flash kernel carries no
+    window argument yet). Supports Tq != Tk bottom-right aligned (the
+    kv-cache prefill convention)."""
+    B, Tq, Hq, D = q.shape
+    Tk, Hkv = k.shape[1], k.shape[2]
+    rep = Hq // Hkv
+    qg = q.reshape(B, Tq, Hkv, rep, D)
+    scores = jnp.einsum("bqhrd,bkhd->bhrqk", qg,
+                        k).astype(jnp.float32) / (D ** 0.5)
+    qpos = (Tk - Tq + jnp.arange(Tq))[:, None]  # absolute positions
+    kpos = jnp.arange(Tk)[None, :]
+    mask = (kpos <= qpos) & (kpos > qpos - window)
+    scores = jnp.where(mask[None, None, None], scores, float("-inf"))
+    p = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhrqk,bkhd->bqhrd", p, v)
+    return out.reshape(B, Tq, Hq, D).astype(q.dtype)
+
+
+def _decode_attention(q, k_cache, v_cache, kv_len, window=None):
     """Masked attention over a padded KV cache (decode path; XLA-fused).
 
     q: [B, T, Hq, D]; caches: [B, S, Hkv, D]; valid keys are [0, kv_len).
+    ``window``: Mistral sliding window — keys further than this behind a
+    query are masked (keeps decode consistent with windowed training).
     """
     B, T, Hq, D = q.shape
     S, Hkv = k_cache.shape[1], k_cache.shape[2]
@@ -154,6 +187,8 @@ def _decode_attention(q, k_cache, v_cache, kv_len):
     q_pos = kv_len - T + jnp.arange(T)  # absolute position of each query
     k_pos = jnp.arange(S)
     mask = k_pos[None, :] <= q_pos[:, None]  # causal + cache-length bound
+    if window is not None:
+        mask = mask & (k_pos[None, :] > q_pos[:, None] - window)
     scores = jnp.where(mask[None, None, None], scores, float("-inf"))
     p = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
     out = jnp.einsum("bhrqk,bkhd->bqhrd", p, v_cache)

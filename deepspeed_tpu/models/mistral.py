@@ -1,0 +1,38 @@
+"""Mistral model family — Llama geometry + GQA + sliding-window local
+attention (reference: the Mistral inference-v2 implementation,
+deepspeed/inference/v2/model_implementations/mistral/model.py).
+
+Architecturally Llama with 8 kv heads and a 4096-token attention
+window; the HF weight layout is identical to Llama, so the module and
+converter are shared (models/llama.py) and this file provides the
+config factories + aliases.
+"""
+
+import dataclasses
+
+from .llama import (LlamaConfig, LlamaForCausalLM, from_hf_state_dict,
+                    llama_tensor_rules)
+
+MistralForCausalLM = LlamaForCausalLM
+mistral_tensor_rules = llama_tensor_rules
+
+
+class MistralConfig:
+    """Factories producing LlamaConfig instances with Mistral shapes."""
+
+    @staticmethod
+    def mistral_7b() -> LlamaConfig:
+        return LlamaConfig(vocab_size=32000, hidden_size=4096,
+                           intermediate_size=14336,
+                           num_hidden_layers=32, num_attention_heads=32,
+                           num_key_value_heads=8,
+                           max_position_embeddings=32768,
+                           rope_theta=10000.0, sliding_window=4096)
+
+    @staticmethod
+    def tiny() -> LlamaConfig:
+        return dataclasses.replace(LlamaConfig.tiny(), sliding_window=16)
+
+
+__all__ = ["MistralConfig", "MistralForCausalLM", "from_hf_state_dict",
+           "mistral_tensor_rules"]

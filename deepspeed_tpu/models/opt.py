@@ -1,0 +1,177 @@
+"""OPT model family in flax.
+
+TPU-native model zoo entry (reference: the OPT kernel-injection policy
+module_inject/containers/opt.py + model_implementations/transformers/
+ds_opt.py). Pre-LN decoder, learned positional embeddings with OPT's
++2 offset, ReLU FFN — HF ``OPTForCausalLM`` weight layout.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.pallas_kernels import flash_attention
+from ..parallel.mesh import TENSOR_AXIS
+from .gpt2 import cross_entropy_loss
+
+
+@dataclasses.dataclass(frozen=True)
+class OPTConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 2048
+    ffn_dim: int = 8192
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 32
+    max_position_embeddings: int = 2048
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_remat: bool = False
+    use_flash: bool = True
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def opt_1_3b():
+        return OPTConfig()
+
+    @staticmethod
+    def opt_6_7b():
+        return OPTConfig(hidden_size=4096, ffn_dim=16384,
+                         num_hidden_layers=32)
+
+    @staticmethod
+    def tiny():
+        return OPTConfig(vocab_size=256, hidden_size=64, ffn_dim=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         max_position_embeddings=128)
+
+
+class OPTAttention(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        B, T, C = x.shape
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        q = nn.Dense(C, name="q_proj")(x).reshape(B, T, nh, hd)
+        k = nn.Dense(C, name="k_proj")(x).reshape(B, T, nh, hd)
+        v = nn.Dense(C, name="v_proj")(x).reshape(B, T, nh, hd)
+        if cfg.use_flash:
+            y = flash_attention(q, k, v, causal=True).reshape(B, T, C)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                hd).astype(x.dtype)
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
+            p = jax.nn.softmax(s.astype(jnp.float32),
+                               axis=-1).astype(x.dtype)
+            y = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, C)
+        return nn.Dense(C, name="out_proj")(y)
+
+
+class OPTDecoderLayer(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                         name="self_attn_layer_norm")(x)
+        x = x + OPTAttention(cfg, name="self_attn")(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                         name="final_layer_norm")(x)
+        h = nn.relu(nn.Dense(cfg.ffn_dim, name="fc1")(h))
+        x = x + nn.Dense(cfg.hidden_size, name="fc2")(h)
+        return x
+
+
+class OPTForCausalLM(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None):
+        cfg = self.config
+        B, T = input_ids.shape
+        emb = self.param("embed_tokens",
+                         nn.initializers.normal(cfg.initializer_range),
+                         (cfg.vocab_size, cfg.hidden_size))
+        # OPT's learned positions carry a +2 offset (HF convention)
+        pos = self.param("embed_positions",
+                         nn.initializers.normal(cfg.initializer_range),
+                         (cfg.max_position_embeddings + 2,
+                          cfg.hidden_size))
+        x = emb[input_ids] + pos[jnp.arange(T) + 2][None]
+        layer = OPTDecoderLayer
+        if cfg.use_remat:
+            layer = nn.remat(OPTDecoderLayer)
+        for i in range(cfg.num_hidden_layers):
+            x = layer(cfg, name=f"layers_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                         name="final_layer_norm")(x)
+        logits = x @ emb.T  # tied
+        if labels is None:
+            return logits
+        return cross_entropy_loss(logits, labels), logits
+
+
+def opt_tensor_rules(name, shape):
+    col = ("q_proj", "k_proj", "v_proj", "fc1")
+    row = ("out_proj", "fc2")
+    if any(f"{m}.kernel" in name for m in col):
+        return P(None, TENSOR_AXIS)
+    if any(f"{m}.bias" in name for m in col):
+        return P(TENSOR_AXIS)
+    if any(f"{m}.kernel" in name for m in row):
+        return P(TENSOR_AXIS, None)
+    return None
+
+
+OPTForCausalLM.tensor_sharding_rules = staticmethod(opt_tensor_rules)
+
+
+def from_hf_state_dict(state_dict, config: OPTConfig):
+    """HF OPTForCausalLM state dict -> this module's params."""
+
+    def g(key, transpose=False):
+        v = state_dict[key]
+        if hasattr(v, "numpy"):
+            v = v.detach().cpu().numpy()
+        v = np.asarray(v)
+        return v.T if transpose else v
+
+    prefix = "model.decoder." if "model.decoder.embed_tokens.weight" in \
+        state_dict else "decoder."
+    params = {
+        "embed_tokens": g(f"{prefix}embed_tokens.weight"),
+        "embed_positions": g(f"{prefix}embed_positions.weight"),
+        "final_layer_norm": {
+            "scale": g(f"{prefix}final_layer_norm.weight"),
+            "bias": g(f"{prefix}final_layer_norm.bias")},
+    }
+    for i in range(config.num_hidden_layers):
+        lp = f"{prefix}layers.{i}."
+        params[f"layers_{i}"] = {
+            "self_attn_layer_norm": {
+                "scale": g(f"{lp}self_attn_layer_norm.weight"),
+                "bias": g(f"{lp}self_attn_layer_norm.bias")},
+            "final_layer_norm": {
+                "scale": g(f"{lp}final_layer_norm.weight"),
+                "bias": g(f"{lp}final_layer_norm.bias")},
+            "self_attn": {
+                m: {"kernel": g(f"{lp}self_attn.{m}.weight",
+                                transpose=True),
+                    "bias": g(f"{lp}self_attn.{m}.bias")}
+                for m in ("q_proj", "k_proj", "v_proj", "out_proj")},
+            "fc1": {"kernel": g(f"{lp}fc1.weight", transpose=True),
+                    "bias": g(f"{lp}fc1.bias")},
+            "fc2": {"kernel": g(f"{lp}fc2.weight", transpose=True),
+                    "bias": g(f"{lp}fc2.bias")},
+        }
+    return {"params": params}

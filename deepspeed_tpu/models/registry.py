@@ -1,0 +1,89 @@
+"""Model-family registry — the injection-policy table.
+
+Reference: deepspeed/module_inject/replace_policy.py maps HF
+architectures to injection policies (BERT/GPT2/Llama/Bloom/OPT/…).
+Here a policy is (config factories, flax module, HF converter, TP
+rules); ``from_pretrained_state_dict`` dispatches on the HF
+``model_type``/architecture name so ``init_inference(model_type=...)``
+works for every family with no per-model user code.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+from . import bloom, gpt2, llama, mistral, opt
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelPolicy:
+    name: str
+    config_cls: Any
+    model_cls: Any
+    from_hf: Callable
+    tensor_rules: Optional[Callable]
+    hf_keys: tuple          # state-dict key prefixes that identify it
+
+
+POLICIES: Dict[str, ModelPolicy] = {}
+
+
+def register(policy: ModelPolicy):
+    POLICIES[policy.name] = policy
+    return policy
+
+
+register(ModelPolicy(
+    name="gpt2", config_cls=gpt2.GPT2Config,
+    model_cls=gpt2.GPT2LMHeadModel, from_hf=gpt2.from_hf_state_dict,
+    tensor_rules=gpt2.gpt2_tensor_rules,
+    hf_keys=("transformer.wte.weight", "wte.weight")))
+register(ModelPolicy(
+    name="llama", config_cls=llama.LlamaConfig,
+    model_cls=llama.LlamaForCausalLM, from_hf=llama.from_hf_state_dict,
+    tensor_rules=llama.llama_tensor_rules,
+    hf_keys=("model.embed_tokens.weight",)))
+register(ModelPolicy(
+    name="mistral", config_cls=mistral.MistralConfig,
+    model_cls=mistral.MistralForCausalLM,
+    from_hf=mistral.from_hf_state_dict,
+    tensor_rules=mistral.mistral_tensor_rules,
+    hf_keys=()))
+register(ModelPolicy(
+    name="bloom", config_cls=bloom.BloomConfig,
+    model_cls=bloom.BloomForCausalLM, from_hf=bloom.from_hf_state_dict,
+    tensor_rules=bloom.bloom_tensor_rules,
+    hf_keys=("transformer.word_embeddings.weight",)))
+register(ModelPolicy(
+    name="opt", config_cls=opt.OPTConfig,
+    model_cls=opt.OPTForCausalLM, from_hf=opt.from_hf_state_dict,
+    tensor_rules=opt.opt_tensor_rules,
+    hf_keys=("model.decoder.embed_tokens.weight",)))
+
+
+def get_policy(name: str) -> ModelPolicy:
+    key = name.lower()
+    if key not in POLICIES:
+        raise KeyError(f"no model policy '{name}'; known: "
+                       f"{sorted(POLICIES)}")
+    return POLICIES[key]
+
+
+def detect_policy(state_dict) -> ModelPolicy:
+    """Identify the architecture from HF state-dict keys (the
+    replace_policy auto-detection analog)."""
+    for policy in POLICIES.values():
+        if any(k in state_dict for k in policy.hf_keys):
+            return policy
+    raise KeyError("could not detect model family from state dict; "
+                   f"known families: {sorted(POLICIES)}")
+
+
+def from_pretrained_state_dict(state_dict, config,
+                               model_type: Optional[str] = None):
+    """(model, params) from an HF state dict + this framework's config
+    object. ``model_type`` overrides detection."""
+    policy = get_policy(model_type) if model_type else \
+        detect_policy(state_dict)
+    model = policy.model_cls(config)
+    params = policy.from_hf(state_dict, config)
+    return model, params

@@ -45,7 +45,12 @@ def _bwd_kernel(x_ref, w_ref, dy_ref, dx_ref, dwp_ref, *, eps):
     # dx = r * (dxhat - xhat * mean(dxhat * xhat))
     dx = r * (dxhat - xhat * (jnp.sum(dxhat * xhat, axis=-1, keepdims=True) / d))
     dx_ref[:] = dx.astype(dx_ref.dtype)
-    dwp_ref[0, :] = jnp.sum(dy * xhat, axis=0)
+    # partial dw for this row block. The block row-count is padded to 8
+    # (Mosaic requires the last two block dims be (8k, 128k) or match
+    # the array); rows 1..7 are zeroed so the wrapper can sum everything.
+    row = jax.lax.broadcasted_iota(jnp.int32, dwp_ref.shape, 0)
+    dwp_ref[:] = jnp.where(row == 0, jnp.sum(dy * xhat, axis=0)[None, :],
+                           0.0)
 
 
 def _rows_view(x):
@@ -101,9 +106,9 @@ def _bwd_rule(eps, interpret, res, dy):
                   pl.BlockSpec((d,), lambda i: (0,)),
                   pl.BlockSpec((block, d), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
-                   pl.BlockSpec((1, d), lambda i: (i, 0))],
+                   pl.BlockSpec((8, d), lambda i: (i, 0))],
         out_shape=[jax.ShapeDtypeStruct((n, d), x.dtype),
-                   jax.ShapeDtypeStruct((nblocks, d), jnp.float32)],
+                   jax.ShapeDtypeStruct((nblocks * 8, d), jnp.float32)],
         interpret=interpret,
     )(x, w, dy)
     return dx, jnp.sum(dw_partial, axis=0).astype(w.dtype)

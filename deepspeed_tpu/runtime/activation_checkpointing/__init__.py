@@ -1,0 +1,4 @@
+from .checkpointing import (CheckpointFunction, checkpoint, configure,
+                            is_configured, model_parallel_cuda_manual_seed,
+                            partition_activations_policy, remat,
+                            reset)

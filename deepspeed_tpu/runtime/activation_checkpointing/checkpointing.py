@@ -1,0 +1,132 @@
+"""Activation checkpointing subsystem — configurable remat.
+
+Reference: deepspeed/runtime/activation_checkpointing/checkpointing.py
+(1,185 LoC): Megatron-style ``CheckpointFunction`` (:487) with
+partitioned activations across model-parallel ranks (:376), CPU
+checkpointing, contiguous buffers, an RNG tracker (:125) and a module
+``configure`` entry (:1093).
+
+TPU-native mapping — most of that machinery IS ``jax.checkpoint``:
+- CheckpointFunction          -> jax.checkpoint(fn) (recompute in bwd)
+- partition_activations       -> a remat policy that keeps saved
+                                 residuals sharded over tensor/sequence
+                                 axes (save-with-sharding; XLA keeps the
+                                 per-chip fragment only)
+- cpu_checkpointing           -> jax.checkpoint offload policy
+                                 (save_and_offload_only_these_names /
+                                 offload to pinned_host memory space)
+- RNG tracker                 -> nothing: jax threads explicit PRNG keys
+                                 through remat deterministically
+- contiguous buffers          -> nothing: XLA owns allocation
+
+``configure(config)`` + ``checkpoint(fn, *args)`` keep the reference's
+module-level API so ported training code runs unchanged.
+"""
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+
+from ...utils.logging import logger
+
+_config = None
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Configure the checkpointing behavior (reference:
+    checkpointing.py:1093 ``configure`` — same signature shape)."""
+    global _config
+    cfg = {}
+    if deepspeed_config is not None:
+        section = deepspeed_config if isinstance(deepspeed_config, dict) \
+            else {}
+        cfg.update(section.get("activation_checkpointing", {}))
+    if partition_activations is not None:
+        cfg["partition_activations"] = partition_activations
+    if checkpoint_in_cpu is not None:
+        cfg["cpu_checkpointing"] = checkpoint_in_cpu
+    if num_checkpoints is not None:
+        cfg["number_checkpoints"] = num_checkpoints
+    for noop in ("contiguous_checkpointing", "synchronize", "profile"):
+        pass  # XLA owns allocation/sync; accepted for parity
+    _config = cfg
+    logger.info(f"activation checkpointing configured: {cfg}")
+    return cfg
+
+
+def is_configured() -> bool:
+    return _config is not None
+
+
+def reset():
+    """Reference parity (clears buffers there; stateless here)."""
+    global _config
+    _config = None
+
+
+def model_parallel_cuda_manual_seed(seed: int):
+    """Reference-parity no-op: JAX PRNG keys are explicit, so remat
+    replays dropout deterministically without a global RNG tracker
+    (reference: checkpointing.py:125 CudaRNGStatesTracker)."""
+    return None
+
+
+def _policy_from_config(cfg):
+    if not cfg:
+        return None
+    if cfg.get("cpu_checkpointing"):
+        try:
+            return jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=[],
+                offload_src="device", offload_dst="pinned_host")
+        except Exception:
+            logger.warning("cpu_checkpointing: offload policy unavailable "
+                           "on this jax version; using full remat")
+            return jax.checkpoint_policies.nothing_saveable
+    if cfg.get("partition_activations"):
+        # keep matmul results (the big residuals XLA would otherwise
+        # re-all-gather under tensor parallelism); everything else
+        # recomputes
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return None
+
+
+def partition_activations_policy():
+    """The remat policy equivalent of partition_activations=True."""
+    return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+
+
+def checkpoint(function: Callable, *args, **kwargs):
+    """Checkpoint a function call (reference: checkpointing.py:1012
+    ``checkpoint(function, *args)``) — runs it now, recomputes in
+    backward."""
+    policy = _policy_from_config(_config)
+    fn = jax.checkpoint(function, policy=policy) if policy is not None \
+        else jax.checkpoint(function)
+    return fn(*args, **kwargs)
+
+
+def remat(function: Optional[Callable] = None, *,
+          policy: Optional[Any] = None,
+          prevent_cse: bool = True):
+    """Decorator form with an explicit policy (the non-reentrant
+    variant's role, reference checkpointing.py:730)."""
+    if function is None:
+        return functools.partial(remat, policy=policy,
+                                 prevent_cse=prevent_cse)
+    return jax.checkpoint(function, policy=policy,
+                          prevent_cse=prevent_cse)
+
+
+class CheckpointFunction:
+    """API-parity shim for code that calls
+    ``CheckpointFunction.apply(run_fn, *args)`` (reference:
+    checkpointing.py:487)."""
+
+    @staticmethod
+    def apply(run_function, *args):
+        return checkpoint(run_function, *args)

@@ -49,3 +49,11 @@ class CurriculumDataSampler:
     @property
     def current_difficulty(self):
         return self.scheduler.current_difficulty
+
+    # loader-interface delegation: callers treat the sampler exactly
+    # like the DeepSpeedDataLoader it wraps (len, batch_size, ...)
+    def __len__(self):
+        return len(self.loader)
+
+    def __getattr__(self, name):
+        return getattr(self.loader, name)

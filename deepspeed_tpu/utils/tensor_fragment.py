@@ -113,21 +113,17 @@ def safe_set_full_optimizer_state(engine, name: str, state_key: str,
     new_leaf = jax.device_put(arr, old.sharding) \
         if hasattr(old, "sharding") else arr
 
-    field = _STATE_ALIASES.get(state_key, state_key)
-    new_moments = jax.tree_util.tree_unflatten(
-        treedef, leaves[:i] + [new_leaf] + leaves[i + 1:])
-
-    def rebuild(node):
-        if hasattr(node, field):
-            return node._replace(**{field: new_moments})
-        if isinstance(node, tuple) and not hasattr(node, "_fields"):
-            return tuple(rebuild(c) for c in node)
-        if isinstance(node, list):
-            return [rebuild(c) for c in node]
-        return node
-
+    # Replace the leaf wherever it sits in the (arbitrarily nested,
+    # namedtuple-wrapped) opt_state by identity — flatten/unflatten
+    # preserves every wrapper (MaskedState, chains, ...).
+    flat, state_def = jax.tree_util.tree_flatten(engine.state.opt_state)
+    hits = [j for j, leaf in enumerate(flat) if leaf is old]
+    if not hits:
+        return False
+    for j in hits:
+        flat[j] = new_leaf
     engine.state = engine.state._replace(
-        opt_state=rebuild(engine.state.opt_state))
+        opt_state=jax.tree_util.tree_unflatten(state_def, flat))
     return True
 
 

@@ -1,0 +1,83 @@
+"""Autotuner tests (reference shape: tests/unit/autotuning/)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.autotuning import Autotuner, AutotuningConfig
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.parallel.mesh import mesh_manager
+
+
+@pytest.fixture
+def factories():
+    def engine_factory(overrides):
+        mesh_manager.reset()
+        config = {
+            "train_micro_batch_size_per_gpu":
+                overrides["train_micro_batch_size_per_gpu"],
+            "gradient_accumulation_steps":
+                overrides.get("gradient_accumulation_steps", 1),
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": overrides.get("zero_optimization",
+                                               {"stage": 0}),
+            "steps_per_print": 0,
+        }
+        model = GPT2LMHeadModel(GPT2Config.tiny())
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                                   config=config)
+        return engine
+
+    def batch_factory(engine):
+        ids = np.random.default_rng(0).integers(
+            0, 256, size=(engine.train_batch_size(), 16), dtype=np.int32)
+        return {"input_ids": ids, "labels": ids.copy()}
+
+    return engine_factory, batch_factory
+
+
+def test_candidate_enumeration():
+    t = AutotuningConfig(enabled=True, micro_batch_sizes=[2, 4],
+                         zero_stages=[0, 1], max_trials=10)
+    a = Autotuner({}, None, None, tuning=t)
+    cands = a.candidates()
+    assert len(cands) == 4
+    assert {c["train_micro_batch_size_per_gpu"] for c in cands} == {2, 4}
+    assert {c["zero_optimization"]["stage"] for c in cands} == {0, 1}
+
+
+def test_tune_picks_feasible_best(factories, tmp_path):
+    ef, bf = factories
+    t = AutotuningConfig(enabled=True, micro_batch_sizes=[2, 4],
+                         zero_stages=[1], trial_steps=2, warmup_steps=1,
+                         results_dir=str(tmp_path))
+    a = Autotuner({}, ef, bf, tuning=t)
+    best = a.tune()
+    assert best.feasible and best.tokens_per_sec > 0
+    assert len(a.results) == 2
+    import json
+    with open(tmp_path / "results.json") as f:
+        rows = json.load(f)
+    assert len(rows) == 2
+
+
+def test_infeasible_trial_is_caught(factories):
+    ef, bf = factories
+
+    def exploding_factory(overrides):
+        raise MemoryError("RESOURCE_EXHAUSTED: fake OOM")
+
+    t = AutotuningConfig(enabled=True, micro_batch_sizes=[2],
+                         zero_stages=[0])
+    a = Autotuner({}, exploding_factory, bf, tuning=t)
+    with pytest.raises(RuntimeError, match="no feasible"):
+        a.tune()
+    assert a.results[0].error.startswith("oom")
+
+
+def test_memory_estimate_monotone():
+    e = Autotuner.estimate_bytes
+    # more shards -> less per-chip state
+    assert e(int(1e9), 3, 4096, 4096, 32, world=8) < \
+        e(int(1e9), 1, 4096, 4096, 32, world=8) < \
+        e(int(1e9), 0, 4096, 4096, 32, world=8)

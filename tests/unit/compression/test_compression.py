@@ -1,0 +1,152 @@
+"""Compression tests (reference shape:
+tests/unit/compression/test_compression.py — quantizer numerics, pruning
+masks, config-driven init_compression)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.compression import (CompressionConfig,
+                                       CompressionScheduler,
+                                       apply_compression, asym_quantize,
+                                       binary_quantize, head_prune_mask,
+                                       init_compression, magnitude_prune,
+                                       ptq_dequantize, ptq_quantize,
+                                       redundancy_clean, sym_quantize,
+                                       ternary_quantize)
+
+
+@pytest.fixture
+def w(rng):
+    return jnp.asarray(rng.standard_normal((64, 128)).astype(np.float32))
+
+
+class TestQuantizers:
+
+    def test_sym_quantize_error_bounded(self, w):
+        q = sym_quantize(w, 8, num_groups=4)
+        scale = 2 * np.abs(np.asarray(w).reshape(4, -1)).max(-1) / 256
+        err = np.abs(np.asarray(q - w)).reshape(4, -1).max(-1)
+        # interior values round within scale/2; the clipped positive
+        # extreme can err by a full step
+        assert (err <= scale + 1e-6).all()
+        # more bits, less error
+        q4 = sym_quantize(w, 4, num_groups=4)
+        assert np.abs(np.asarray(q4 - w)).mean() > \
+            np.abs(np.asarray(q - w)).mean()
+
+    def test_asym_handles_shifted_data(self, rng):
+        x = jnp.asarray(rng.random((32, 32)).astype(np.float32)) + 5.0
+        qa = asym_quantize(x, 8)
+        qs = sym_quantize(x, 8)
+        assert np.abs(np.asarray(qa - x)).mean() < \
+            np.abs(np.asarray(qs - x)).mean()
+
+    def test_ternary_binary_levels(self, w):
+        t = np.unique(np.round(np.asarray(ternary_quantize(w)), 6))
+        assert len(t) <= 3
+        b = np.unique(np.round(np.asarray(binary_quantize(w)), 6))
+        assert len(b) <= 2
+
+    def test_straight_through_gradients(self, w):
+        g = jax.grad(lambda x: sym_quantize(x, 8).sum())(w)
+        np.testing.assert_allclose(np.asarray(g), 1.0)
+        g = jax.grad(lambda x: magnitude_prune(x, 0.5).sum())(w)
+        np.testing.assert_allclose(np.asarray(g), 1.0)
+
+    def test_ptq_roundtrip(self, w):
+        q, scales = ptq_quantize(w, 8, group_size=32)
+        assert q.dtype == jnp.int8
+        back = ptq_dequantize(q, scales, dtype=jnp.float32)
+        # int8 groupwise: ~1% relative error on N(0,1) data
+        assert np.abs(np.asarray(back - w)).mean() < 0.01
+
+
+class TestPruning:
+
+    def test_magnitude_prune_ratio(self, w):
+        p = np.asarray(magnitude_prune(w, 0.75))
+        assert abs((p == 0).mean() - 0.75) < 0.02
+
+    def test_row_prune(self, w):
+        p = np.asarray(magnitude_prune(w, 0.5, "row"))
+        zero_rows = (p == 0).all(axis=1).sum()
+        assert zero_rows == 32
+
+    def test_head_prune_mask(self, rng):
+        w = rng.standard_normal((64, 8 * 16)).astype(np.float32)
+        w[:, :16] *= 10  # head 0 loud
+        mask = np.asarray(head_prune_mask(jnp.asarray(w), 8, 0.5))
+        assert mask[0] and mask.sum() == 4
+
+
+class TestConfigDriven:
+
+    CFG = {
+        "compression_training": {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True,
+                                      "schedule_offset": 10},
+                "different_groups": {
+                    "wq1": {"params": {"start_bits": 8,
+                                       "quantization_type": "symmetric",
+                                       "quantize_groups": 1},
+                            "modules": ["attn", "mlp"]},
+                },
+            },
+            "sparse_pruning": {
+                "shared_parameters": {"enabled": True,
+                                      "schedule_offset": 20},
+                "different_groups": {
+                    "sp1": {"params": {"dense_ratio": 0.5},
+                            "modules": ["mlp"]},
+                },
+            },
+        }
+    }
+
+    def test_init_compression_transforms_matching_params(self, rng):
+        params = {
+            "attn": {"kernel": jnp.asarray(
+                rng.standard_normal((32, 32)).astype(np.float32))},
+            "mlp": {"kernel": jnp.asarray(
+                rng.standard_normal((32, 32)).astype(np.float32))},
+            "norm": {"scale": jnp.ones((32,))},
+        }
+        out = apply_compression(params, self.CFG)
+        assert not np.allclose(np.asarray(out["attn"]["kernel"]),
+                               np.asarray(params["attn"]["kernel"]))
+        # mlp: quantized AND half-pruned
+        assert (np.asarray(out["mlp"]["kernel"]) == 0).mean() > 0.4
+        # 1-D norm scale untouched
+        np.testing.assert_array_equal(np.asarray(out["norm"]["scale"]),
+                                      np.asarray(params["norm"]["scale"]))
+
+    def test_scheduler_offsets(self):
+        cfg = CompressionConfig(self.CFG)
+        s = CompressionScheduler(cfg)
+        a = s.step(5)
+        assert not a["weight_quantization"] and not a["sparse_pruning"]
+        a = s.step(15)
+        assert a["weight_quantization"] and not a["sparse_pruning"]
+        a = s.step(25)
+        assert a["weight_quantization"] and a["sparse_pruning"]
+
+    def test_redundancy_clean_shrinks_rows(self, rng):
+        cfg = {
+            "compression_training": {
+                "row_pruning": {
+                    "shared_parameters": {"enabled": True},
+                    "different_groups": {
+                        "rp1": {"params": {"dense_ratio": 0.5},
+                                "modules": ["mlp"]},
+                    },
+                },
+            }
+        }
+        params = {"mlp": {"kernel": jnp.asarray(
+            rng.standard_normal((16, 8)).astype(np.float32))}}
+        cleaned, masks = redundancy_clean(params, cfg)
+        assert cleaned["mlp"]["kernel"].shape == (8, 8)
+        assert len(masks) == 1

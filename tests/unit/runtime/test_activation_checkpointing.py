@@ -1,0 +1,73 @@
+"""Activation checkpointing API tests (reference shape:
+tests/unit/runtime/activation_checkpointing/test_activation_checkpointing.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.activation_checkpointing import (
+    CheckpointFunction, checkpoint, configure, is_configured, remat, reset)
+
+
+@pytest.fixture(autouse=True)
+def clean_config():
+    reset()
+    yield
+    reset()
+
+
+def _f(x):
+    return jnp.tanh(x @ x.T).sum()
+
+
+def test_checkpoint_matches_plain(rng):
+    x = jnp.asarray(rng.standard_normal((16, 16)).astype(np.float32))
+    assert np.allclose(float(checkpoint(_f, x)), float(_f(x)))
+    g1 = jax.grad(lambda x: checkpoint(_f, x))(x)
+    g2 = jax.grad(_f)(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5)
+
+
+def test_configure_and_policies(rng):
+    assert not is_configured()
+    configure(deepspeed_config={
+        "activation_checkpointing": {"partition_activations": True}})
+    assert is_configured()
+    x = jnp.asarray(rng.standard_normal((8, 8)).astype(np.float32))
+    g = jax.grad(lambda x: checkpoint(_f, x))(x)
+    np.testing.assert_allclose(np.asarray(g),
+                               np.asarray(jax.grad(_f)(x)), rtol=1e-5)
+
+
+def test_checkpoint_function_shim(rng):
+    x = jnp.asarray(rng.standard_normal((8, 8)).astype(np.float32))
+    assert np.allclose(float(CheckpointFunction.apply(_f, x)),
+                       float(_f(x)))
+
+
+def test_remat_decorator(rng):
+    @remat
+    def f(x):
+        return jnp.sum(jnp.sin(x) ** 2)
+
+    x = jnp.asarray(rng.standard_normal((32,)).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(f)(x)),
+        np.asarray(jax.grad(lambda x: jnp.sum(jnp.sin(x) ** 2))(x)),
+        rtol=1e-5)
+
+
+def test_remat_reduces_saved_residuals(rng):
+    """Remat's purpose: fewer saved residuals between fwd and bwd."""
+    from jax._src.ad_checkpoint import saved_residuals
+
+    def deep(x):
+        for _ in range(4):
+            x = jnp.tanh(x @ x.T)
+        return x.sum()
+
+    x = jnp.asarray(rng.standard_normal((32, 32)).astype(np.float32))
+    plain = saved_residuals(deep, x)
+    rematted = saved_residuals(jax.checkpoint(deep), x)
+    assert len(rematted) < len(plain)
