@@ -1,0 +1,123 @@
+"""Pluggable checkpoint engines.
+
+Reference: deepspeed/runtime/checkpoint_engine/checkpoint_engine.py:9
+abstract ``CheckpointEngine`` with ``TorchCheckpointEngine``
+(torch.save/load) and ``NebulaCheckpointEngine`` (async tiered saves to
+the MSFT Nebula service, deepspeed/nebula/).
+
+TPU-native: the synchronous engine wraps this package's orbax/npz
+save/load; the async engine is the Nebula analog — saves run on a
+background thread (orbax's own async machinery handles device->host
+streaming), ``commit()`` waits for durability. Selected via the config
+section ``checkpoint_engine: {"type": "sync"|"async"}``.
+"""
+
+import abc
+import concurrent.futures
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from ..utils.logging import logger
+from .engine import load_checkpoint, save_checkpoint
+
+
+class CheckpointEngine(abc.ABC):
+    """Reference-parity surface: create/save/load/commit."""
+
+    def __init__(self, config_params: Optional[dict] = None):
+        self.config = config_params or {}
+
+    def create(self, tag: str):
+        """Start a checkpoint under ``tag`` (bookkeeping hook)."""
+        self._tag = tag
+
+    @abc.abstractmethod
+    def save(self, state, path: str, tag: str,
+             client_state: Optional[Dict[str, Any]] = None,
+             save_latest: bool = True): ...
+
+    @abc.abstractmethod
+    def load(self, path: str, tag: Optional[str],
+             template_state=None): ...
+
+    @abc.abstractmethod
+    def commit(self, tag: str) -> bool:
+        """Block until everything saved under ``tag`` is durable."""
+
+
+class SyncCheckpointEngine(CheckpointEngine):
+    """TorchCheckpointEngine analog: synchronous save/load."""
+
+    def save(self, state, path: str, tag: str, client_state=None,
+             save_latest: bool = True):
+        return save_checkpoint(path, tag, state, client_state=client_state,
+                               save_latest=save_latest)
+
+    def load(self, path: str, tag: Optional[str], template_state=None):
+        return load_checkpoint(path, tag, template_state)
+
+    def commit(self, tag: str) -> bool:
+        return True
+
+
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Nebula analog: the save runs on a background thread so training
+    continues; ``commit`` (or the next save) joins it. State arrays are
+    snapshot to host BEFORE returning, so the training loop may donate/
+    overwrite device buffers immediately."""
+
+    def __init__(self, config_params: Optional[dict] = None):
+        super().__init__(config_params)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt")
+        self._inflight: Dict[str, concurrent.futures.Future] = {}
+        self._lock = threading.Lock()
+
+    def save(self, state, path: str, tag: str, client_state=None,
+             save_latest: bool = True):
+        import jax
+        import numpy as np
+        host_state = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if hasattr(x, "dtype") else x, state)
+
+        def run():
+            return save_checkpoint(path, tag, host_state,
+                                   client_state=client_state,
+                                   save_latest=save_latest)
+
+        with self._lock:
+            prev = self._inflight.get(tag)
+            if prev is not None:
+                prev.result()  # serialize saves to the same tag
+            fut = self._pool.submit(run)
+            self._inflight[tag] = fut
+        return fut
+
+    def load(self, path: str, tag: Optional[str], template_state=None):
+        self.commit_all()
+        return load_checkpoint(path, tag, template_state)
+
+    def commit(self, tag: str) -> bool:
+        with self._lock:
+            fut = self._inflight.pop(tag, None)
+        if fut is not None:
+            fut.result()
+        return True
+
+    def commit_all(self):
+        with self._lock:
+            futs = list(self._inflight.values())
+            self._inflight.clear()
+        for f in futs:
+            f.result()
+
+
+def get_checkpoint_engine(config: Optional[dict] = None) -> CheckpointEngine:
+    cfg = (config or {}).get("checkpoint_engine", {})
+    kind = cfg.get("type", "sync")
+    if kind == "async":
+        return AsyncCheckpointEngine(cfg)
+    if kind == "sync":
+        return SyncCheckpointEngine(cfg)
+    raise ValueError(f"unknown checkpoint_engine type {kind!r}")

@@ -55,6 +55,18 @@ from .utils import clip_grad_norm_, global_norm
 from .zero.partition import ZeroShardingRules, compose_tensor_rules
 
 
+def _put_with_fallback(tree, shardings):
+    """device_put that tolerates backends unable to move device buffers
+    straight into another memory kind (some PJRT plugins): falls back to
+    a host numpy round trip."""
+    try:
+        return jax.device_put(tree, shardings)
+    except ValueError:
+        host = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if hasattr(x, "dtype") else x, tree)
+        return jax.device_put(host, shardings)
+
+
 class TrainState(NamedTuple):
     """All device-resident training state, donated through the jit step."""
     master_params: Any          # fp32, sharded per ZeRO opt rules
@@ -149,11 +161,23 @@ class DeepSpeedEngine:
                 f"offload_optimizer.device="
                 f"{zc.offload_optimizer.device!r} unsupported; TPU-VM "
                 f"offload targets host DRAM ('cpu')")
-        if zc.offload_param.device not in ("none", None):
-            raise NotImplementedError(
-                "offload_param is not implemented (optimizer-state "
-                "offload is; parameter offload to host memory_kind is a "
-                "future tier) — remove the offload_param section")
+        # ZeRO-Infinity parameter offload: master fp32 params (and
+        # optimizer state) live in HOST memory (pinned_host memory kind);
+        # the jitted step streams them to device for the compute view and
+        # writes updates back to host (reference: swap_tensor/
+        # partitioned_param_swapper.py semantics, with XLA's memory-space
+        # propagation replacing the hand-written swap pipelines).
+        self._param_offload_host = zc.offload_param.device == "cpu"
+        if zc.offload_param.device not in ("none", None, "cpu"):
+            raise ValueError(
+                f"offload_param.device={zc.offload_param.device!r} "
+                "unsupported; TPU-VM offload targets host DRAM ('cpu'); "
+                "an NVMe tier would layer on the same seam")
+
+        # checkpoint engine: validated (and constructed) at init so a
+        # config typo fails here, not hours later at the first save
+        self._checkpoint_engine = None
+        _ = self.checkpoint_engine
 
         # model functions
         self._resolve_model_fns(model)
@@ -276,6 +300,23 @@ class DeepSpeedEngine:
         opt_state = self.opt_transform.init(master)
         opt_sh = self.sharding_rules.opt_shardings(opt_state)
         opt_state = jax.jit(lambda t: t, out_shardings=opt_sh)(opt_state)
+        if self._param_offload_host:
+            # optimizer state is BUILT from device-resident params first
+            # (eager zeros_like on pinned_host inputs makes mismatched
+            # buffers); only then do both trees move to host. Both swap
+            # legs run OUTSIDE jit — this XLA/PJRT combination rejects
+            # memory-space ops inside compiled programs (SPMD
+            # annotate_device_placement RET_CHECK; remote AOT SIGABRT) —
+            # so every compute entry point swaps host->device first and
+            # back after (_swap_state_in/_swap_state_out).
+            host_m_sh = jax.tree_util.tree_map(
+                lambda s: s.with_memory_kind("pinned_host"), master_sh)
+            host_o_sh = jax.tree_util.tree_map(
+                lambda s: s.with_memory_kind("pinned_host"), opt_sh)
+            master = _put_with_fallback(master, host_m_sh)
+            opt_state = _put_with_fallback(opt_state, host_o_sh)
+            self._offload_state_sh = (host_m_sh, host_o_sh)
+            self._device_state_sh = (master_sh, opt_sh)
 
         if self.fp16_enabled:
             fc = self._config.fp16_config
@@ -668,8 +709,10 @@ class DeepSpeedEngine:
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                                sharding=x.sharding),
                 device_batch)
+        self._swap_state_in()
         self.state, metrics, off_grads = self._jit_train_step(
             self.state, device_batch, self._next_rng())
+        self._swap_state_out()
         if self._offload is not None:
             skip = bool(metrics["overflow"]) if self.fp16_enabled else False
             # scheduler value when one exists; otherwise None -> the host
@@ -723,7 +766,9 @@ class DeepSpeedEngine:
         if self._jit_eval_step is None:
             self._compile_eval_step()
         device_batch = self._shard_batch(batch)
+        self._swap_state_in()
         loss, _ = self._jit_eval_step(self.state.master_params, device_batch)
+        self._swap_state_out()
         return loss
 
     # -- eager triple: forward / backward / step (host-driven accumulation)
@@ -736,7 +781,9 @@ class DeepSpeedEngine:
             self._compile_eval_step()
         self.timers(FORWARD_GLOBAL_TIMER).start()
         device_batch = self._shard_batch(batch)
+        self._swap_state_in()
         loss, aux = self._jit_eval_step(self.state.master_params, device_batch)
+        self._swap_state_out()
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         self._last_fwd_batch = device_batch
         return loss if aux is None else (loss, aux)
@@ -764,6 +811,7 @@ class DeepSpeedEngine:
                 raise ValueError("backward() without a preceding forward(); "
                                  "pass batch= explicitly")
         self.timers(BACKWARD_GLOBAL_TIMER).start()
+        self._swap_state_in()
         loss_val, grads = self._jit_grad_step(self.state.master_params,
                                               self.state.loss_scale.loss_scale,
                                               device_batch, self._next_rng())
@@ -774,6 +822,7 @@ class DeepSpeedEngine:
                 jnp.add, self._accum_grads, grads)
         self._accum_count += 1
         self.micro_steps += 1
+        self._swap_state_out()
         self.timers(BACKWARD_GLOBAL_TIMER).stop()
         self._last_loss = loss_val
         return loss_val
@@ -788,11 +837,13 @@ class DeepSpeedEngine:
         if self._jit_apply_grads is None:
             self._compile_apply_grads()
         self.timers(STEP_GLOBAL_TIMER).start()
+        self._swap_state_in()
         self.state, metrics = self._jit_apply_grads(self.state,
                                                     self._accum_grads,
                                                     jnp.int32(self._accum_count))
         self._accum_grads = None
         self._accum_count = 0
+        self._swap_state_out()
         overflow = bool(metrics["overflow"]) if self.fp16_enabled else False
         if overflow:
             self.skipped_steps += 1
@@ -907,9 +958,20 @@ class DeepSpeedEngine:
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, full)
         return full
 
+    @property
+    def checkpoint_engine(self):
+        """Pluggable sync/async engine (reference:
+        runtime/checkpoint_engine/checkpoint_engine.py:9; async =
+        the Nebula-tier analog), selected by the ``checkpoint_engine``
+        config section."""
+        if getattr(self, "_checkpoint_engine", None) is None:
+            from ..checkpoint.checkpoint_engine import get_checkpoint_engine
+            self._checkpoint_engine = get_checkpoint_engine(
+                getattr(self._config, "_param_dict", {}))
+        return self._checkpoint_engine
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
-        from ..checkpoint.engine import save_checkpoint as _save
         tag = tag or f"global_step{self.global_steps}"
         client_state = dict(client_state or {})
         client_state.update({
@@ -920,7 +982,10 @@ class DeepSpeedEngine:
             "lr_scheduler": self.lr_scheduler.state_dict()
             if self.lr_scheduler else None,
         })
-        _save(save_dir, tag, self.state, client_state, save_latest=save_latest)
+        self.checkpoint_engine.create(tag)
+        self.checkpoint_engine.save(self.state, save_dir, tag,
+                                    client_state=client_state,
+                                    save_latest=save_latest)
         if self._offload is not None:
             sd = self._offload.state_dict()
             payload = {"step": np.int64(sd["step"]),
@@ -929,17 +994,21 @@ class DeepSpeedEngine:
                 payload[f"master_{i}"] = sd["master"][i]
                 payload[f"m_{i}"] = sd["m"][i]
                 payload[f"v_{i}"] = sd["v"][i]
+            self.checkpoint_engine.commit(tag)  # dir must exist first
             np.savez(os.path.join(save_dir, str(tag),
                                   "zero_offload_host_state.npz"), **payload)
+        # async engine: join + surface background errors; one future per
+        # tag would otherwise leak (and swallow exceptions) forever
+        self.checkpoint_engine.commit(tag)
         return True
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False):
-        from ..checkpoint.engine import load_checkpoint as _load
         if self.state is None:
             raise ValueError("initialize params before load_checkpoint "
                              "(pass model_parameters or run a batch)")
-        state, client_state = _load(load_dir, tag, self.state)
+        state, client_state = self.checkpoint_engine.load(
+            load_dir, tag, self.state)
         self.state = state
         if self._offload is not None and load_optimizer_states:
             from ..checkpoint.engine import resolve_tag
@@ -987,6 +1056,31 @@ class DeepSpeedEngine:
     def zero_grad(self):
         self._accum_grads = None
         self._accum_count = 0
+
+    def _swap_state_in(self):
+        """Param-offload swap-in: state host -> device (no-op otherwise).
+        Runs outside jit — see _compile_train_step's offload comment."""
+        if not self._param_offload_host or self.state is None:
+            return
+        if not hasattr(self, "_device_state_sh"):
+            return  # state not built yet
+        dm_sh, do_sh = self._device_state_sh
+        self.state = self.state._replace(
+            master_params=_put_with_fallback(self.state.master_params,
+                                             dm_sh),
+            opt_state=_put_with_fallback(self.state.opt_state, do_sh))
+
+    def _swap_state_out(self):
+        """Param-offload swap-out: state device -> pinned host."""
+        if not self._param_offload_host or self.state is None:
+            return
+        if not hasattr(self, "_offload_state_sh"):
+            return
+        m_sh, o_sh = self._offload_state_sh
+        self.state = self.state._replace(
+            master_params=_put_with_fallback(self.state.master_params,
+                                             m_sh),
+            opt_state=_put_with_fallback(self.state.opt_state, o_sh))
 
     def get_loss(self):
         return self._last_loss

@@ -79,3 +79,38 @@ def test_fragment_paths_collision_free(tmp_path):
     d1 = os.path.join(*[_esc(s) for s in p1[0]])
     d2 = os.path.join(*[_esc(s) for s in p2[0]])
     assert d1 != d2
+
+
+class TestCheckpointEngines:
+    """Pluggable sync/async engines (reference:
+    runtime/checkpoint_engine/ + nebula async tier)."""
+
+    def test_async_engine_roundtrip(self, trained_engine, tmp_path):
+        from deepspeed_tpu.checkpoint.checkpoint_engine import (
+            AsyncCheckpointEngine)
+        eng = AsyncCheckpointEngine()
+        fut = eng.save(trained_engine.state, str(tmp_path / "ck"), "t1")
+        assert eng.commit("t1")
+        assert fut.done()
+        state, _ = eng.load(str(tmp_path / "ck"), "t1",
+                            trained_engine.state)
+        import jax
+        a = jax.tree_util.tree_leaves(trained_engine.state.master_params)
+        b = jax.tree_util.tree_leaves(state.master_params)
+        np.testing.assert_allclose(np.asarray(a[0]), np.asarray(b[0]))
+
+    def test_engine_config_selection(self, trained_engine, tmp_path):
+        from deepspeed_tpu.checkpoint.checkpoint_engine import (
+            AsyncCheckpointEngine, SyncCheckpointEngine,
+            get_checkpoint_engine)
+        assert isinstance(get_checkpoint_engine({}), SyncCheckpointEngine)
+        assert isinstance(
+            get_checkpoint_engine({"checkpoint_engine": {"type": "async"}}),
+            AsyncCheckpointEngine)
+
+    def test_engine_save_checkpoint_via_plugin(self, trained_engine,
+                                               tmp_path):
+        import os
+        trained_engine._checkpoint_engine = None
+        trained_engine.save_checkpoint(str(tmp_path / "ck2"), tag="s")
+        assert os.path.exists(tmp_path / "ck2" / "latest")
