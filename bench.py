@@ -73,11 +73,11 @@ def bench_config1():
     """GPT-2-small ZeRO-1 bf16 (BASELINE config 1, the scored metric)."""
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
 
-    seq = 1024
-    cfg = GPT2Config(vocab_size=50304, n_positions=seq, n_embd=768,
+    seq = 512
+    cfg = GPT2Config(vocab_size=50304, n_positions=1024, n_embd=768,
                      n_layer=12, n_head=12, dropout=0.0, use_flash=True)
     config = {
-        "train_micro_batch_size_per_gpu": 16,
+        "train_micro_batch_size_per_gpu": 32,
         "gradient_accumulation_steps": 32,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},

@@ -17,8 +17,16 @@ aslanxie/DeepSpeed v0.14.0), built idiomatically on JAX/XLA/pjit/Pallas:
   (reference: deepspeed/checkpoint/*)
 """
 
+import sys as _sys
+
 from . import comm  # noqa: F401
+from . import zero_api as zero  # noqa: F401  (deepspeed.zero parity)
 from .accelerator import get_accelerator  # noqa: F401
+from .zero_api import OnDevice  # noqa: F401  (deepspeed.OnDevice parity)
+
+# make `import deepspeed_tpu.zero` / `from deepspeed_tpu.zero import Init`
+# work — the attribute alias alone is not a registered submodule
+_sys.modules[__name__ + ".zero"] = zero
 from .runtime.config import DeepSpeedConfig  # noqa: F401
 from .runtime.engine import DeepSpeedEngine
 from .utils import logger, log_dist  # noqa: F401

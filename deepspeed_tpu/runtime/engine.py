@@ -380,19 +380,42 @@ class DeepSpeedEngine:
         return master
 
     def init_params(self, example_batch, rng=None):
-        """Explicitly initialize parameters from an example batch (flax)."""
+        """Initialize parameters from an example batch (flax) —
+        SHARDED AT BIRTH: the init function is jitted with the ZeRO
+        shardings computed from its eval_shape, so no host or single
+        device ever materializes the full tree (the reference's
+        ``zero.Init`` metaclass hook, partition_parameters.py:299,
+        achieved functionally)."""
         if self._params_initialized:
             return
         if self._init_fn is None:
             raise ValueError("model has no init(); pass model_parameters")
         rng = rng if rng is not None else self._next_rng()
         example = self._cast_batch(example_batch)
+
         if isinstance(example, dict):
-            params = self._init_fn(rng, **example)
+            def init_fn(r):
+                return self._init_fn(r, **example)
         elif isinstance(example, (tuple, list)):
-            params = self._init_fn(rng, *example)
+            def init_fn(r):
+                return self._init_fn(r, *example)
         else:
-            params = self._init_fn(rng, example)
+            def init_fn(r):
+                return self._init_fn(r, example)
+
+        try:
+            from ..zero_api import sharded_init
+            params = sharded_init(init_fn, rng,
+                                  rules=self.sharding_rules)
+        except Exception as e:
+            # fallback: some init fns resist tracing (host-side logic).
+            # Loud — the fallback materializes the FULL tree in one
+            # memory, the exact thing sharded-at-birth exists to avoid.
+            logger.warning(
+                f"sharded-at-birth init failed ({type(e).__name__}: "
+                f"{str(e)[:200]}); falling back to eager unsharded init "
+                "— large models may OOM here")
+            params = init_fn(rng)
         self._setup_state(params)
 
     def _build_optimizer_transform(self, client_optimizer):

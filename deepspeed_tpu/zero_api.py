@@ -1,0 +1,90 @@
+"""``deepspeed_tpu.zero`` — the reference's ``deepspeed.zero`` surface.
+
+Reference: ``deepspeed.zero.Init`` (partition_parameters.py:807) hooks
+module ``__init__`` so every parameter is partitioned AT CONSTRUCTION —
+no rank ever holds the full model; ``deepspeed.OnDevice`` (utils/
+init_on_device.py) builds modules on a meta device for zero-cost
+construction.
+
+TPU-native: both are natural here. ``Init`` is a context manager kept
+for drop-in parity — engines ALWAYS init sharded-at-birth (the init
+function is jitted with ZeRO out_shardings computed from eval_shape, see
+runtime/engine.py:init_params); the context just lets user code express
+intent / carry config. ``sharded_init`` is the standalone functional
+form. ``OnDevice`` gives abstract (shape/dtype-only) construction via
+eval_shape — the meta-device analog.
+"""
+
+import contextlib
+from typing import Any, Callable, Optional
+
+import jax
+
+from .parallel.mesh import mesh_manager
+from .runtime.zero.config import DeepSpeedZeroConfig
+from .runtime.zero.partition import ZeroShardingRules
+
+# tri-state: None = no Init context; True/False = context's `enabled`
+_init_active: Optional[bool] = None
+
+
+def init_is_active() -> bool:
+    return bool(_init_active)
+
+
+@contextlib.contextmanager
+def Init(module=None, data_parallel_group=None, mem_efficient_linear=True,
+         remote_device=None, pin_memory=False, config_dict_or_path=None,
+         config=None, enabled=True, dtype=None, mpu=None):
+    """API-parity context (reference: zero.Init). Engines already init
+    sharded-at-birth unconditionally; ``sharded_init`` honors
+    ``Init(enabled=False)`` by skipping the sharded placement (the
+    reference's meaning of a disabled Init context)."""
+    global _init_active
+    prev, _init_active = _init_active, bool(enabled)
+    try:
+        yield
+    finally:
+        _init_active = prev
+
+
+def sharded_init(init_fn: Callable, *args, stage: int = 3,
+                 tensor_rules: Optional[Callable] = None, mesh=None,
+                 rules: Optional[ZeroShardingRules] = None,
+                 **kwargs):
+    """Run a param-producing ``init_fn`` jitted with ZeRO shardings so
+    the full tree never materializes in one memory. Inside
+    ``Init(enabled=False)`` this degrades to a plain (unsharded) init.
+
+    Example::
+
+        params = zero.sharded_init(model.init, rng, example_ids)
+    """
+    if _init_active is False:
+        return init_fn(*args, **kwargs)
+    if rules is None:
+        if mesh is None:
+            if not mesh_manager.initialized:
+                mesh_manager.init()
+            mesh = mesh_manager.mesh
+        rules = ZeroShardingRules(mesh=mesh, stage=stage,
+                                  tensor_rules=tensor_rules)
+    shapes = jax.eval_shape(lambda: init_fn(*args, **kwargs))
+    sh = rules.opt_shardings(shapes)
+    return jax.jit(lambda: init_fn(*args, **kwargs),
+                   out_shardings=sh)()
+
+
+@contextlib.contextmanager
+def OnDevice(dtype=None, device: str = "meta", enabled: bool = True):
+    """Meta-init context (reference: deepspeed.OnDevice,
+    utils/init_on_device.py). With device='meta', use ``abstract_init``
+    for shape/dtype-only trees; other devices are a no-op here (JAX
+    places via shardings, not a current-device global)."""
+    yield
+
+
+def abstract_init(init_fn: Callable, *args, **kwargs):
+    """Shape/dtype-only init (zero FLOPs, zero memory) — the meta-device
+    analog: returns a tree of ShapeDtypeStructs."""
+    return jax.eval_shape(lambda: init_fn(*args, **kwargs))

@@ -1,6 +1,7 @@
 """ZeRO sharding-rule unit tests (reference:
 tests/unit/runtime/zero/test_zero.py partitioning assertions)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -65,3 +66,50 @@ def test_persistence_threshold(mesh):
     assert r3.param_spec("b", big) == P("fsdp", None)
     # optimizer states shard regardless of persistence threshold
     assert r3.opt_spec("s", small) == P("fsdp")
+
+
+class TestShardedAtBirthInit:
+    """zero.Init / sharded init parity (reference:
+    partition_parameters.py:299 — no rank holds the full model)."""
+
+    def test_engine_init_params_born_sharded(self, eight_devices):
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+        from deepspeed_tpu.parallel.mesh import FSDP_AXIS, mesh_manager
+        mesh_manager.reset()
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3},
+            "steps_per_print": 0,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2LMHeadModel(GPT2Config.tiny()), config=config)
+        ids = np.zeros((engine.train_batch_size(), 16), np.int32)
+        engine.init_params({"input_ids": ids, "labels": ids})
+        wte = engine.state.master_params["params"]["wte"]
+        assert FSDP_AXIS in tuple(wte.sharding.spec)
+
+    def test_zero_init_context_and_sharded_init(self, eight_devices):
+        import deepspeed_tpu
+        from deepspeed_tpu import zero
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+        from deepspeed_tpu.parallel.mesh import mesh_manager
+        mesh_manager.reset()
+        mesh_manager.init(MeshConfig(data=1, fsdp=-1))
+        model = GPT2LMHeadModel(GPT2Config.tiny())
+        ids = np.zeros((1, 8), np.int32)
+        with zero.Init():
+            assert zero.init_is_active()
+            params = zero.sharded_init(model.init, jax.random.PRNGKey(0),
+                                       ids)
+        assert not zero.init_is_active()
+        leaves = jax.tree_util.tree_leaves(params)
+        assert any(ax is not None
+                   for l in leaves if l.ndim >= 2
+                   for ax in tuple(l.sharding.spec))
+
+        abstract = zero.abstract_init(model.init, jax.random.PRNGKey(0),
+                                      ids)
+        assert all(isinstance(l, jax.ShapeDtypeStruct)
+                   for l in jax.tree_util.tree_leaves(abstract))
