@@ -94,11 +94,11 @@ def bench_config2():
     """GPT-2-medium ZeRO-2 (BASELINE config 2; single-chip scale-down)."""
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
 
-    seq = 1024
-    cfg = GPT2Config(vocab_size=50304, n_positions=seq, n_embd=1024,
+    seq = 512
+    cfg = GPT2Config(vocab_size=50304, n_positions=1024, n_embd=1024,
                      n_layer=24, n_head=16, dropout=0.0, use_flash=True)
     config = {
-        "train_micro_batch_size_per_gpu": 8,
+        "train_micro_batch_size_per_gpu": 16,
         "gradient_accumulation_steps": 32,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},

@@ -12,6 +12,8 @@ tests, mirroring the reference's kernel-vs-torch tests,
 tests/unit/ops/adam/test_cpu_adam.py:34-43).
 """
 
+from .block_sparse_attention import (block_sparse_attention,  # noqa: F401
+                                     block_sparse_reference, make_layout)
 from .flash_attention import flash_attention, mha_reference  # noqa: F401
 from .rms_norm import rms_norm, rms_norm_reference  # noqa: F401
 from .rope import apply_rotary_pos_emb, rope_cos_sin  # noqa: F401

@@ -1,0 +1,415 @@
+"""Block-sparse attention — Pallas kernel over a static block layout.
+
+Reference: deepspeed/ops/sparse_attention/ (Triton block-sparse matmul/
+softmax, ops/sparse_attention/matmul.py:819 + softmax.py:296) with
+BigBird/Longformer/Fixed patterns from sparsity_config.py:727.
+
+TPU-native (splash-attention style): the [n_q_blocks, n_k_blocks] bool
+layout is compiled into per-q-block index tables — each grid step loops
+over only ITS active key blocks (a static ``max_active`` bound with a
+per-row count), so skipped blocks cost nothing. The online-softmax body
+matches the dense flash kernel (flash_attention.py); the backward
+recomputes probabilities from the saved logsumexp with the same tables
+(dq) and their transpose (dk/dv).
+
+Sparsity patterns (sparsity_config.py analogs): ``fixed`` (local blocks
++ periodic global columns), ``longformer`` (sliding window + global
+tokens), ``bigbird`` (window + global + seeded random blocks).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+
+
+# ---------------------------------------------------------------------------
+# layouts (sparsity_config.py analogs)
+# ---------------------------------------------------------------------------
+def make_layout(pattern: str, n_q_blocks: int, n_k_blocks: int,
+                num_local_blocks: int = 4, num_global_blocks: int = 1,
+                num_random_blocks: int = 0, seed: int = 0) -> np.ndarray:
+    """[n_q_blocks, n_k_blocks] bool block mask."""
+    L = np.zeros((n_q_blocks, n_k_blocks), bool)
+    q = np.arange(n_q_blocks)[:, None]
+    k = np.arange(n_k_blocks)[None, :]
+    if pattern in ("fixed", "longformer", "bigbird"):
+        # sliding window of local blocks
+        L |= (np.abs(q - k) < num_local_blocks)
+        # global columns (and rows) at the start
+        L[:, :num_global_blocks] = True
+        L[:num_global_blocks, :] = True
+    else:
+        raise ValueError(f"unknown sparsity pattern {pattern!r}")
+    if pattern == "bigbird" and num_random_blocks:
+        rng = np.random.default_rng(seed)
+        for i in range(n_q_blocks):
+            L[i, rng.choice(n_k_blocks, size=num_random_blocks,
+                            replace=False)] = True
+    return L
+
+
+def _tables(layout: np.ndarray, causal: bool, block_q: int,
+            block_k: int):
+    """Per-q-block active k-block index table (+ counts), and the
+    transpose for the dk/dv pass."""
+    nq, nk = layout.shape
+    eff = layout.copy()
+    if causal:
+        # block (i, j) is reachable if ANY of its (q, k) pairs is causal:
+        # the block's last query row must not precede its first key col
+        # (block-index tril is only right when block_q == block_k)
+        q_last = (np.arange(nq)[:, None] + 1) * block_q - 1
+        k_first = np.arange(nk)[None, :] * block_k
+        eff &= (q_last >= k_first)
+    q_idx, q_cnt = [], []
+    for i in range(nq):
+        idx = np.nonzero(eff[i])[0]
+        q_idx.append(idx)
+        q_cnt.append(len(idx))
+    max_a = max(q_cnt + [1])
+    qt = np.zeros((nq, max_a), np.int32)
+    for i, idx in enumerate(q_idx):
+        qt[i, :len(idx)] = idx
+    k_idx, k_cnt = [], []
+    for j in range(nk):
+        idx = np.nonzero(eff[:, j])[0]
+        k_idx.append(idx)
+        k_cnt.append(len(idx))
+    max_b = max(k_cnt + [1])
+    kt = np.zeros((nk, max_b), np.int32)
+    for j, idx in enumerate(k_idx):
+        kt[j, :len(idx)] = idx
+    return (qt, np.asarray(q_cnt, np.int32),
+            kt, np.asarray(k_cnt, np.int32), eff)
+
+
+# ---------------------------------------------------------------------------
+# reference
+# ---------------------------------------------------------------------------
+def block_sparse_reference(q, k, v, layout, block_q, block_k,
+                           causal=True, sm_scale=None):
+    """Dense attention with the block mask expanded elementwise."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    mask = np.kron(np.asarray(layout),
+                   np.ones((block_q, block_k), bool))[:Tq, :Tk]
+    if causal:
+        mask &= np.tril(np.ones((Tq, Tk), bool))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    s = jnp.where(jnp.asarray(mask)[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    row_any = jnp.asarray(mask.any(axis=1))
+    p = jnp.where(row_any[None, None, :, None], p, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+def _causal_mask(s, q_start, k_start, block_q, block_k):
+    qp = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    kp = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(qp >= kp, s, _NEG_INF)
+
+
+def _fwd_kernel(qt_ref, qcnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                sm_scale, causal, block_k, max_active):
+    qi = pl.program_id(2)
+    block_q, d = q_ref.shape[2], q_ref.shape[3]
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+    count = qcnt_ref[qi]
+
+    def body(j, carry):
+        acc, m_prev, l_prev = carry
+        ki = qt_ref[qi, j]
+        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(
+            jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi * block_q, ki * block_k,
+                             block_q, block_k)
+        s = jnp.where(j < count, s, _NEG_INF)  # padded table slots
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - shift[:, None])
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev,
+                                  _NEG_INF) - shift)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, max_active, body, (acc0, m0, l0))
+
+    l_safe = jnp.where(l > 0, l, 1.0)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse = jnp.where(l > 0, m + jnp.log(l_safe), _NEG_INF)
+    lse_ref[0, 0] = lse.astype(jnp.float32)[:, None]
+
+
+def _bwd_dq_kernel(qt_ref, qcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, sm_scale, causal, block_k,
+                   max_active):
+    qi = pl.program_id(2)
+    block_q = q_ref.shape[2]
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]
+    delta = delta_ref[0, 0, :, 0]
+    count = qcnt_ref[qi]
+
+    def body(j, dq):
+        ki = qt_ref[qi, j]
+        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(
+            jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi * block_q, ki * block_k,
+                             block_q, block_k)
+        s = jnp.where(j < count, s, _NEG_INF)
+        lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+        p = jnp.exp(s - lse_safe[:, None])
+        p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, q_ref.shape[3]), jnp.float32)
+    dq = jax.lax.fori_loop(0, max_active, body, dq0)
+    dq_ref[0, 0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(kt_ref, kcnt_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, *, sm_scale,
+                    causal, block_q, max_active):
+    ki = pl.program_id(1)
+    block_k = k_ref.shape[2]
+    k_blk = k_ref[0, 0].astype(jnp.float32)
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    count = kcnt_ref[ki]
+
+    def body(j, carry):
+        dk, dv = carry
+        qi = kt_ref[ki, j]
+        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(
+            jnp.float32) * sm_scale
+        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(
+            jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi * block_q, ki * block_k,
+                             block_q, block_k)
+        s = jnp.where(j < count, s, _NEG_INF)
+        lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+        p = jnp.exp(s - lse_safe[:, None])
+        p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    d = k_ref.shape[3]
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, max_active, body, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# plumbing
+# ---------------------------------------------------------------------------
+def _fwd(q, k, v, layout_key, sm_scale, causal, block_q, block_k,
+         interpret):
+    qt, qcnt, _, _, _ = _LAYOUTS[layout_key]
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
+                               causal=causal, block_k=block_k,
+                               max_active=qt.shape[1])
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, H, Tq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, D),
+                             lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, Tk, D), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, Tk, D), lambda b, h, i, *_: (b, h, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, D),
+                             lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda b, h, i, *_: (b, h, i, 0)),
+            ]),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(qt), jnp.asarray(qcnt), q, k, v)
+    return out, lse
+
+
+# registry: hashable key -> tables (jax custom_vjp nondiff args must hash)
+_LAYOUTS = {}
+
+
+def _register_layout(layout: np.ndarray, causal: bool, block_q: int,
+                     block_k: int):
+    key = (layout.tobytes(), layout.shape, bool(causal), block_q, block_k)
+    if key not in _LAYOUTS:
+        _LAYOUTS[key] = _tables(layout, causal, block_q, block_k)
+    return key
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _bs_attention_bhtd(q, k, v, layout_key, sm_scale, causal, block_q,
+                       block_k, interpret):
+    out, _ = _fwd(q, k, v, layout_key, sm_scale, causal, block_q,
+                  block_k, interpret)
+    return out
+
+
+def _fwd_rule(q, k, v, layout_key, sm_scale, causal, block_q, block_k,
+              interpret):
+    out, lse = _fwd(q, k, v, layout_key, sm_scale, causal, block_q,
+                    block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _bwd_rule(layout_key, sm_scale, causal, block_q, block_k, interpret,
+              res, g):
+    q, k, v, out, lse = res
+    qt, qcnt, kt, kcnt, _ = _LAYOUTS[layout_key]
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
+                          causal=causal, block_k=block_k,
+                          max_active=qt.shape[1]),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, H, Tq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, D),
+                             lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, Tk, D), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, Tk, D), lambda b, h, i, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_q, D),
+                             lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda b, h, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda b, h, i, *_: (b, h, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block_q, D),
+                                   lambda b, h, i, *_: (b, h, i, 0))),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(qt), jnp.asarray(qcnt), q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q,
+                          max_active=kt.shape[1]),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, Tk // block_k, H),
+            in_specs=[
+                pl.BlockSpec((1, 1, Tq, D), lambda b, i, h, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_k, D),
+                             lambda b, i, h, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_k, D),
+                             lambda b, i, h, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, Tq, D), lambda b, i, h, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, Tq, 1), lambda b, i, h, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, Tq, 1), lambda b, i, h, *_: (b, h, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, block_k, D),
+                             lambda b, i, h, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_k, D),
+                             lambda b, i, h, *_: (b, h, i, 0)),
+            ]),
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(kt), jnp.asarray(kcnt), q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+_bs_attention_bhtd.defvjp(_fwd_rule, _bwd_rule)
+
+
+def block_sparse_attention(q, k, v, layout, causal=True, sm_scale=None,
+                           block_q=128, block_k=128, force_pallas=False,
+                           interpret=False):
+    """Block-sparse attention. q/k/v: [B, T, H, D]; layout:
+    [T//block_q, T//block_k] bool (see ``make_layout``).
+
+    On TPU lowers to the Pallas kernel; elsewhere the dense masked
+    reference (XLA-fused) computes identical values.
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    layout = np.asarray(layout, bool)
+    ok = (Tq % block_q == 0 and Tk % block_k == 0 and
+          layout.shape == (Tq // block_q, Tk // block_k) and
+          D % 64 == 0 and block_q % 128 == 0 and block_k % 128 == 0)
+    use_pallas = force_pallas or interpret or \
+        (ok and jax.default_backend() == "tpu")
+    if not ok and (force_pallas or interpret):
+        raise ValueError(
+            f"cannot tile Tq={Tq} Tk={Tk} layout={layout.shape} "
+            f"block=({block_q},{block_k})")
+    if not use_pallas:
+        return block_sparse_reference(q, k, v, layout, block_q, block_k,
+                                      causal=causal, sm_scale=sm_scale)
+    key = _register_layout(layout, causal, int(block_q), int(block_k))
+    qt = q.transpose(0, 2, 1, 3)
+    kt_ = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _bs_attention_bhtd(qt, kt_, vt, key, float(sm_scale),
+                             bool(causal), int(block_q), int(block_k),
+                             bool(interpret))
+    return out.transpose(0, 2, 1, 3)

@@ -774,8 +774,28 @@ class DeepSpeedEngine:
                 f"step={self.global_steps} loss={float(loss):.4f} "
                 f"lr={self.get_lr()[0]:.3e} "
                 f"loss_scale={float(metrics['loss_scale']):.0f} "
-                f"grad_norm={float(metrics['grad_norm']):.3f}", ranks=[0])
+                f"grad_norm={float(metrics['grad_norm']):.3f}"
+                f"{self._mfu_suffix()}", ranks=[0])
         return loss
+
+    def _mfu_suffix(self) -> str:
+        """' mfu=xx.x%' for the periodic log (reference: ThroughputTimer
+        TFLOPS print, utils/timer.py:198). Uses the step wall time from
+        the throughput timer and the XLA-counted per-microbatch flops
+        (x gas). Empty until a flops profile exists — the AOT cost
+        analysis is computed lazily on the first print."""
+        try:
+            avg = self.tput_timer.avg_samples_per_sec()
+            if not avg or avg <= 0:
+                return ""
+            step_time = self.train_batch_size() / avg
+            prof = self.get_flops_profile()
+            from ..profiling.flops_profiler import peak_tflops
+            flops = prof["flops"] * self.gradient_accumulation_steps()
+            mfu = flops / step_time / (peak_tflops() * 1e12)
+            return f" mfu={mfu * 100:.1f}%"
+        except Exception:
+            return ""
 
     def eval_batch(self, data_iter=None, batch=None, compute_loss=True):
         if batch is None:

@@ -1,0 +1,67 @@
+"""Progressive Layer Drop (PLD).
+
+Reference: deepspeed/runtime/progressive_layer_drop.py —
+``ProgressiveLayerDrop`` keeps a global keep-probability theta that
+anneals from 1.0 toward a floor with ``theta(t) = (1 - theta_bar) *
+exp(-gamma * t) + theta_bar``, and each transformer layer is kept with a
+depth-scaled probability during training (Bert-PLD paper).
+
+TPU-native: the schedule is host arithmetic; the stochastic layer skip
+is a ``lax.cond``-free ``jnp.where`` blend under jit —
+``maybe_drop_layer`` computes the layer on every step (static graph,
+XLA requirement) and selects pass-through with probability 1-p, scaling
+by 1/p at train time (inverted-dropout convention) so eval needs no
+rescale.
+"""
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    """Schedule holder (reference parity: same ctor args + get_theta)."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta      # the floor (theta_bar)
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def get_state(self):
+        return {"progressive_layer_drop": True,
+                "pld_theta": self.get_theta()}
+
+    def update_state(self, global_step: int) -> float:
+        def _prob(x, g, t):
+            return (1.0 - t) * math.exp(-g * x) + t
+
+        self.current_theta = _prob(global_step, self.gamma, self.theta)
+        return self.current_theta
+
+    def layer_keep_prob(self, layer_idx: int, num_layers: int) -> float:
+        """Depth-scaled keep probability: deeper layers drop more
+        aggressively (PLD paper's i/L scaling)."""
+        return 1.0 - (layer_idx + 1) / num_layers * \
+            (1.0 - self.current_theta)
+
+
+def maybe_drop_layer(layer_fn: Callable, x, keep_prob, rng,
+                     train: bool = True):
+    """Apply ``layer_fn`` with probability ``keep_prob`` else identity.
+
+    Residual-style layers ONLY (output must be a valid replacement for
+    the input). Output = where(keep, layer(x)/p, x) — the compute always
+    runs (static graph); the expectation matches eval behavior.
+    """
+    if not train or keep_prob >= 1.0:
+        return layer_fn(x)
+    y = layer_fn(x)
+    keep = jax.random.bernoulli(rng, keep_prob)
+    # inverted scaling on the residual delta keeps E[out] == layer(x)
+    scaled = x + (y - x) / keep_prob
+    return jnp.where(keep, scaled, x)

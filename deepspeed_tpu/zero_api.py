@@ -21,7 +21,6 @@ from typing import Any, Callable, Optional
 import jax
 
 from .parallel.mesh import mesh_manager
-from .runtime.zero.config import DeepSpeedZeroConfig
 from .runtime.zero.partition import ZeroShardingRules
 
 # tri-state: None = no Init context; True/False = context's `enabled`

@@ -124,3 +124,65 @@ class TestRandomLTD:
         assert s.get_current_seq(0) == 128
         assert s.get_current_seq(100) == 512
         assert s.get_current_seq(50) in range(128, 513, 16)
+
+
+class TestProgressiveLayerDrop:
+    """PLD schedule + stochastic layer skip (reference:
+    runtime/progressive_layer_drop.py)."""
+
+    def test_theta_schedule(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import (
+            ProgressiveLayerDrop)
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.001)
+        assert pld.get_theta() == 1.0
+        pld.update_state(0)
+        assert abs(pld.get_theta() - 1.0) < 1e-9
+        pld.update_state(10_000)
+        assert 0.5 < pld.get_theta() < 0.51
+        # deeper layers drop more
+        pld.update_state(5000)
+        p0 = pld.layer_keep_prob(0, 12)
+        p11 = pld.layer_keep_prob(11, 12)
+        assert p0 > p11
+
+    def test_maybe_drop_layer_expectation(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import (
+            maybe_drop_layer)
+        x = jnp.ones((4, 8))
+        layer = lambda t: t + 1.0
+        # keep_prob 1 or eval: exact layer output
+        np.testing.assert_allclose(
+            np.asarray(maybe_drop_layer(layer, x, 1.0,
+                                        jax.random.PRNGKey(0))), 2.0)
+        np.testing.assert_allclose(
+            np.asarray(maybe_drop_layer(layer, x, 0.3,
+                                        jax.random.PRNGKey(0),
+                                        train=False)), 2.0)
+        # expectation over many draws ~= layer output
+        outs = [np.asarray(maybe_drop_layer(layer, x, 0.7,
+                                            jax.random.PRNGKey(i)))[0, 0]
+                for i in range(400)]
+        assert abs(np.mean(outs) - 2.0) < 0.1
+
+
+def test_eigenvalue_power_iteration():
+    """Top Hessian eigenvalue of a known quadratic (reference:
+    runtime/eigenvalue.py role for MoQ curvature)."""
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+    evals = np.array([5.0, 2.0, 0.5], np.float32)
+    A = jnp.diag(jnp.asarray(evals))
+
+    def loss(x):
+        return 0.5 * x @ A @ x
+
+    x0 = jnp.asarray([1.0, 1.0, 1.0], jnp.float32)
+    est = Eigenvalue(max_iter=200, tol=1e-5).compute_eigenvalue(loss, x0)
+    assert abs(est - 5.0) < 1e-2
+
+    # pytree params work too
+    def loss_tree(p):
+        return 0.5 * (3.0 * jnp.sum(p["a"] ** 2) + jnp.sum(p["b"] ** 2))
+
+    est = Eigenvalue(max_iter=200, tol=1e-5).compute_eigenvalue(
+        loss_tree, {"a": jnp.ones((4,)), "b": jnp.ones((2, 2))})
+    assert abs(est - 3.0) < 1e-2
