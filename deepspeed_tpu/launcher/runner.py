@@ -68,6 +68,35 @@ def parse_args(args=None):
     return p.parse_args(args)
 
 
+def _elastic_main(argv):
+    """``dstpu elastic`` — elastic batch planning from a config file
+    (reference: bin/ds_elastic)."""
+    import argparse
+    import json
+
+    from ..elasticity import compute_elastic_config
+
+    p = argparse.ArgumentParser(prog="dstpu elastic")
+    p.add_argument("-c", "--config", required=True,
+                   help="DeepSpeed config json with an elasticity section")
+    p.add_argument("-w", "--world-size", type=int, default=0)
+    args = p.parse_args(argv)
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    print(json.dumps({"elasticity": ds_config.get("elasticity")}, indent=2))
+    if args.world_size:
+        batch, valid, micro = compute_elastic_config(
+            ds_config, world_size=args.world_size)
+        print(f"\nWith world size {args.world_size}:")
+        print(f"  final batch size .... {batch}")
+        print(f"  micro batch size .... {micro}")
+    else:
+        batch, valid = compute_elastic_config(ds_config)
+        print(f"\nfinal batch size ..... {batch}")
+    print(f"valid chip counts .... {valid}")
+    return 0
+
+
 def main(args=None):
     argv = sys.argv[1:] if args is None else list(args)
     if argv and argv[0] == "report":
@@ -76,6 +105,8 @@ def main(args=None):
     if argv and argv[0] == "bench":
         from .comm_bench import main as bench_main
         return bench_main(argv[1:])
+    if argv and argv[0] == "elastic":
+        return _elastic_main(argv[1:])
     if argv and argv[0] == "launch":
         argv = argv[1:]
     args = parse_args(argv)

@@ -282,15 +282,22 @@ def _fwd(q, k, v, layout_key, sm_scale, causal, block_q, block_k,
     return out, lse
 
 
-# registry: hashable key -> tables (jax custom_vjp nondiff args must hash)
+# registry: hashable key -> tables (jax custom_vjp nondiff args must hash).
+# Bounded: regenerating layouts per step (e.g. reseeded bigbird) must not
+# grow host memory / kernel-cache entries forever.
 _LAYOUTS = {}
+_LAYOUTS_MAX = 64
 
 
 def _register_layout(layout: np.ndarray, causal: bool, block_q: int,
                      block_k: int):
     key = (layout.tobytes(), layout.shape, bool(causal), block_q, block_k)
     if key not in _LAYOUTS:
+        while len(_LAYOUTS) >= _LAYOUTS_MAX:
+            _LAYOUTS.pop(next(iter(_LAYOUTS)))  # FIFO eviction
         _LAYOUTS[key] = _tables(layout, causal, block_q, block_k)
+    else:
+        _LAYOUTS[key] = _LAYOUTS.pop(key)  # refresh recency
     return key
 
 
@@ -387,6 +394,13 @@ def block_sparse_attention(q, k, v, layout, causal=True, sm_scale=None,
 
     On TPU lowers to the Pallas kernel; elsewhere the dense masked
     reference (XLA-fused) computes identical values.
+
+    VMEM bound: the kernels stage full K/V per (batch, head) in VMEM
+    (the index tables skip compute, not residency), so per-head K+V must
+    fit ~12MB — e.g. bf16 D=128 up to ~T=24k. Longer sequences should
+    shard T first (ring attention / Ulysses, deepspeed_tpu/sequence) or
+    lower the per-call chunk; a streamed-KV variant via index-mapped
+    BlockSpecs over the prefetched tables is the planned refinement.
     """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -402,6 +416,12 @@ def block_sparse_attention(q, k, v, layout, causal=True, sm_scale=None,
         raise ValueError(
             f"cannot tile Tq={Tq} Tk={Tk} layout={layout.shape} "
             f"block=({block_q},{block_k})")
+    kv_bytes = 2 * Tk * D * jnp.dtype(k.dtype).itemsize
+    if use_pallas and kv_bytes > 12 * 2 ** 20:
+        raise ValueError(
+            f"per-head K+V ({kv_bytes / 2**20:.1f}MB) exceeds the VMEM "
+            f"budget; shard the sequence axis first (sequence/ring.py) "
+            f"or reduce the per-call chunk")
     if not use_pallas:
         return block_sparse_reference(q, k, v, layout, block_q, block_k,
                                       causal=causal, sm_scale=sm_scale)

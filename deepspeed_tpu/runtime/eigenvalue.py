@@ -45,6 +45,16 @@ class Eigenvalue:
         self.gas_boundary_resolution = gas_boundary_resolution
         self.layer_name = layer_name
         self.layer_num = layer_num
+        # one compiled HVP per loss_fn — re-jitting per call would pay a
+        # full trace+compile every gas boundary
+        self._hvp_cache = {}
+
+    def _hvp_for(self, loss_fn):
+        key = id(loss_fn)
+        if key not in self._hvp_cache:
+            self._hvp_cache[key] = jax.jit(
+                lambda p, t: jax.jvp(jax.grad(loss_fn), (p,), (t,))[1])
+        return self._hvp_cache[key]
 
     def compute_eigenvalue(self, loss_fn: Callable, params,
                            rng: Optional[jax.Array] = None) -> float:
@@ -55,21 +65,22 @@ class Eigenvalue:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         leaves, treedef = jax.tree_util.tree_flatten(params)
         keys = jax.random.split(rng, len(leaves))
+        # tangent dtypes must match the primals (bf16 params etc.)
         v = jax.tree_util.tree_unflatten(treedef, [
-            jax.random.normal(k, l.shape, jnp.float32)
+            jax.random.normal(k, l.shape, jnp.float32).astype(l.dtype)
             for k, l in zip(keys, leaves)])
         v = _scale(v, 1.0 / (_norm(v) + self.stability))
 
-        @jax.jit
-        def hvp(p, tangent):
-            return jax.jvp(jax.grad(loss_fn), (p,), (tangent,))[1]
+        hvp = self._hvp_for(loss_fn)
 
         eig = 0.0
         for i in range(self.max_iter):
             hv = hvp(params, v)
             new_eig = float(jnp.real(_dot(v, hv)))
             n = _norm(hv)
-            v = _scale(hv, 1.0 / (n + self.stability))
+            v = _scale(hv, (1.0 / (n + self.stability)))
+            v = jax.tree_util.tree_map(
+                lambda x, l: x.astype(l.dtype), v, params)
             if eig and abs((new_eig - eig) / (abs(eig) + 1e-12)) < self.tol:
                 eig = new_eig
                 break

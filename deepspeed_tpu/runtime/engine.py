@@ -179,6 +179,31 @@ class DeepSpeedEngine:
         self._checkpoint_engine = None
         _ = self.checkpoint_engine
 
+        # progressive layer drop + eigenvalue (reference: engine.py PLD
+        # config -> scheduler stepped per global step; eigenvalue feeds
+        # MoQ). Model code reads engine.get_pld_theta() per step.
+        d = getattr(self._config, "_param_dict", {})
+        pld_cfg = d.get("progressive_layer_drop", {})
+        self.progressive_layer_drop = None
+        if pld_cfg.get("enabled", False):
+            from .progressive_layer_drop import ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld_cfg.get("theta", 0.5),
+                gamma=pld_cfg.get("gamma", 0.001))
+        ev_cfg = d.get("eigenvalue", {})
+        self.eigenvalue = None
+        if ev_cfg.get("enabled", False):
+            from .eigenvalue import Eigenvalue
+            self.eigenvalue = Eigenvalue(
+                verbose=ev_cfg.get("verbose", False),
+                max_iter=ev_cfg.get("max_iter", 100),
+                tol=ev_cfg.get("tol", 1e-2),
+                stability=ev_cfg.get("stability", 1e-6),
+                gas_boundary_resolution=ev_cfg.get(
+                    "gas_boundary_resolution", 1),
+                layer_name=ev_cfg.get("layer_name", ""),
+                layer_num=ev_cfg.get("layer_num", 0))
+
         # model functions
         self._resolve_model_fns(model)
 
@@ -762,6 +787,8 @@ class DeepSpeedEngine:
                 self.lr_scheduler.step()
             if self.curriculum_sampler is not None:
                 self.curriculum_sampler.step()
+            if self.progressive_layer_drop is not None:
+                self.progressive_layer_drop.update_state(self.global_steps)
         self.global_samples += self.train_batch_size()
         self.micro_steps += self.gradient_accumulation_steps()
         self._step_metrics = {k: v for k, v in metrics.items()}
@@ -791,7 +818,13 @@ class DeepSpeedEngine:
             step_time = self.train_batch_size() / avg
             prof = self.get_flops_profile()
             from ..profiling.flops_profiler import peak_tflops
-            flops = prof["flops"] * self.gradient_accumulation_steps()
+            gas = self.gradient_accumulation_steps()
+            # cost_analysis counts the gas scan body once; scale by gas
+            # but don't multiply the once-per-step optimizer/clip flops
+            # (~30 flops/param for Adam + norms) gas times
+            n = tree_parameter_count(self.state.master_params)
+            opt_est = min(30.0 * n, prof["flops"] * 0.5)
+            flops = prof["flops"] * gas - (gas - 1) * opt_est
             mfu = flops / step_time / (peak_tflops() * 1e12)
             return f" mfu={mfu * 100:.1f}%"
         except Exception:
@@ -1124,6 +1157,13 @@ class DeepSpeedEngine:
             master_params=_put_with_fallback(self.state.master_params,
                                              m_sh),
             opt_state=_put_with_fallback(self.state.opt_state, o_sh))
+
+    def get_pld_theta(self) -> float:
+        """Current PLD keep-probability (reference: engine pld_theta);
+        1.0 when PLD is disabled."""
+        if self.progressive_layer_drop is None:
+            return 1.0
+        return self.progressive_layer_drop.get_theta()
 
     def get_loss(self):
         return self._last_loss

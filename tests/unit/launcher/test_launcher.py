@@ -101,10 +101,20 @@ x = jax.make_array_from_process_local_data(
             np.float32),
     (n,))
 total = jax.jit(lambda v: v.sum(), out_shardings=NamedSharding(mesh, P()))(x)
+
+# multi-host broadcast_object_list (comm.py:396 multi-process branch)
+payload = [np.float32(41.0) if jax.process_index() == 0
+           else np.float32(-1.0)]
+payload = dist.broadcast_object_list(payload, src=0)
+bcast_ok = float(np.asarray(payload[0])) == 41.0
+# asserts on EVERY process: a failure on rank 1 exits nonzero and the
+# launcher's fail-fast turns it into a test failure
+assert bcast_ok, f"rank {{jax.process_index()}} got {{payload[0]}}"
+
 # world=2 procs x 2 local devices: sum = 2*1 + 2*2 = 6
 if jax.process_index() == 0:
     with open({out!r}, "w") as f:
-        f.write(f"{{n}} {{float(total)}}")
+        f.write(f"{{n}} {{float(total)}} {{int(bcast_ok)}}")
 """
 
 
@@ -124,5 +134,6 @@ def test_multiprocess_cpu_launch(tmp_path):
          "--master_port", "29871", str(script)],
         env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
     assert r.returncode == 0, r.stderr[-2000:]
-    n, total = out.read_text().split()
+    n, total, bcast_ok = out.read_text().split()
     assert n == "4" and float(total) == 6.0
+    assert bcast_ok == "1", "broadcast_object_list multi-host failed"
