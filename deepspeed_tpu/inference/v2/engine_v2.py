@@ -37,6 +37,7 @@ class RaggedInferenceEngineConfig:
     kv_block_size: int = 128
     max_blocks_per_seq: int = 16
     kv_dtype: str = "bfloat16"
+    tp_size: int = 1                 # tensor-parallel degree
 
 
 class InferenceEngineV2:
@@ -55,11 +56,40 @@ class InferenceEngineV2:
         self.pools = init_kv_pools(config, ec.n_kv_blocks,
                                    ec.kv_block_size,
                                    dtype=jnp.dtype(ec.kv_dtype))
+        if ec.tp_size > 1:
+            self._apply_tp_sharding(ec.tp_size)
         self._jit_forward = jax.jit(
             lambda params, pools, *args: ragged_forward(
                 params, config, pools, *args,
                 block_size=ec.kv_block_size),
             donate_argnums=(1,))
+
+    def _apply_tp_sharding(self, tp: int):
+        """Shard weights with the model's TP rules and the KV pools over
+        the tensor axis (kv-head dim); GSPMD then partitions the ragged
+        forward exactly like the reference's TP FastGen engine
+        (v2/model_implementations/sharding/)."""
+        from ...models.llama import llama_tensor_rules
+        from ...parallel.mesh import (MeshConfig, TENSOR_AXIS,
+                                      mesh_manager)
+        from ...runtime.zero.partition import ZeroShardingRules
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if not mesh_manager.initialized:
+            mesh_manager.init(MeshConfig(data=-1, tensor=tp))
+        mesh = mesh_manager.mesh
+        rules = ZeroShardingRules(mesh=mesh, stage=0,
+                                  tensor_rules=llama_tensor_rules)
+        self.params = jax.device_put(
+            self.params, rules.param_shardings(self.params))
+        nkv = self.model_config.num_key_value_heads
+        pool_spec = P(None, TENSOR_AXIS, None) if nkv % tp == 0 else P()
+        if nkv % tp:
+            logger.warning(f"kv heads ({nkv}) not divisible by tp={tp}; "
+                           "KV pools stay replicated")
+        self.pools = jax.device_put(
+            self.pools, jax.tree_util.tree_map(
+                lambda _: NamedSharding(mesh, pool_spec), self.pools))
 
     # -- reference API -------------------------------------------------
     @property
