@@ -143,3 +143,21 @@ def zero_to_fp32(ckpt_dir: str, output_file: str, tag: Optional[str] = None,
         pickle.dump(sd, f)
     logger.info(f"fp32 state dict ({len(sd)} tensors) -> {output_file}")
     return sd
+
+
+def _cli():
+    """CLI parity with the user-facing zero_to_fp32.py script
+    (reference: deepspeed/utils/zero_to_fp32.py — run as
+    ``python -m deepspeed_tpu.checkpoint.universal <ckpt_dir> <out>``)."""
+    import argparse
+    p = argparse.ArgumentParser(
+        description="merge a checkpoint into one fp32 state-dict file")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("-t", "--tag", default=None)
+    args = p.parse_args()
+    zero_to_fp32(args.checkpoint_dir, args.output_file, tag=args.tag)
+
+
+if __name__ == "__main__":
+    _cli()

@@ -1,5 +1,6 @@
 from .bloom import BloomConfig, BloomForCausalLM  # noqa: F401
 from .gpt2 import GPT2Config, GPT2LMHeadModel  # noqa: F401
+from .gptneox import GPTNeoXConfig, GPTNeoXForCausalLM  # noqa: F401
 from .llama import LlamaConfig, LlamaForCausalLM  # noqa: F401
 from .mistral import MistralConfig, MistralForCausalLM  # noqa: F401
 from .opt import OPTConfig, OPTForCausalLM  # noqa: F401

@@ -1,0 +1,202 @@
+"""GPT-NeoX model family in flax.
+
+TPU-native model zoo entry (reference: the GPTNeoX kernel-injection
+policy module_inject/containers/gptneox.py + replace_policy.py).
+Architecture: PARALLEL attention + MLP residual branches (one shared
+input LayerNorm pair per block), fused query_key_value with the
+[heads, 3, head_dim] interleave, partial rotary embeddings
+(``rotary_pct``), untied embed_in/embed_out — HF ``GPTNeoXForCausalLM``
+weight layout.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.pallas_kernels import apply_rotary_pos_emb, rope_cos_sin
+from ..parallel.mesh import TENSOR_AXIS
+from .gpt2 import cross_entropy_loss
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoXConfig:
+    vocab_size: int = 50432
+    hidden_size: int = 6144
+    intermediate_size: int = 24576
+    num_hidden_layers: int = 44
+    num_attention_heads: int = 64
+    rotary_pct: float = 0.25
+    rotary_emb_base: float = 10000.0
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    use_parallel_residual: bool = True
+    hidden_act: str = "gelu"   # HF NeoX/Pythia: EXACT gelu (not tanh)
+    use_flash: bool = True
+    use_remat: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def pythia_1b():
+        return GPTNeoXConfig(vocab_size=50304, hidden_size=2048,
+                             intermediate_size=8192,
+                             num_hidden_layers=16,
+                             num_attention_heads=8)
+
+    @staticmethod
+    def tiny():
+        return GPTNeoXConfig(vocab_size=256, hidden_size=64,
+                             intermediate_size=128, num_hidden_layers=2,
+                             num_attention_heads=4,
+                             max_position_embeddings=128)
+
+
+class GPTNeoXAttention(nn.Module):
+    config: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        B, T, C = x.shape
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        qkv = nn.Dense(3 * C, name="query_key_value")(x)
+        qkv = qkv.reshape(B, T, nh, 3, hd)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+
+        rot = int(hd * cfg.rotary_pct)
+        pos = jnp.arange(T)[None, :]
+        cos, sin = rope_cos_sin(pos, rot, theta=cfg.rotary_emb_base)
+        q_rot = apply_rotary_pos_emb(q[..., :rot], cos[:, :, None, :],
+                                     sin[:, :, None, :])
+        k_rot = apply_rotary_pos_emb(k[..., :rot], cos[:, :, None, :],
+                                     sin[:, :, None, :])
+        q = jnp.concatenate([q_rot, q[..., rot:]], axis=-1)
+        k = jnp.concatenate([k_rot, k[..., rot:]], axis=-1)
+
+        if cfg.use_flash:
+            from ..ops.pallas_kernels import flash_attention
+            y = flash_attention(q, k, v, causal=True).reshape(B, T, C)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                hd).astype(x.dtype)
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
+            p = jax.nn.softmax(s.astype(jnp.float32),
+                               axis=-1).astype(x.dtype)
+            y = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, C)
+        return nn.Dense(C, name="dense")(y)
+
+
+class GPTNeoXLayer(nn.Module):
+    config: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        a_in = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                            name="input_layernorm")(x)
+        attn = GPTNeoXAttention(cfg, name="attention")(a_in)
+        m_in = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                            name="post_attention_layernorm")(
+            x if cfg.use_parallel_residual else x + attn)
+        h = nn.Dense(cfg.intermediate_size, name="dense_h_to_4h")(m_in)
+        h = nn.gelu(h, approximate=(cfg.hidden_act == "gelu_new"))
+        mlp = nn.Dense(cfg.hidden_size, name="dense_4h_to_h")(h)
+        # parallel: x + attn(ln1(x)) + mlp(ln2(x)); sequential differs
+        # only in m_in's input (ln2(x + attn)) — the sum is the same form
+        return x + attn + mlp
+
+
+class GPTNeoXForCausalLM(nn.Module):
+    config: GPTNeoXConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None):
+        cfg = self.config
+        emb = self.param("embed_in",
+                         nn.initializers.normal(cfg.initializer_range),
+                         (cfg.vocab_size, cfg.hidden_size))
+        x = emb[input_ids]
+        layer = GPTNeoXLayer
+        if cfg.use_remat:
+            layer = nn.remat(GPTNeoXLayer)
+        for i in range(cfg.num_hidden_layers):
+            x = layer(cfg, name=f"layers_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="final_layer_norm")(x)
+        head = self.param("embed_out",
+                          nn.initializers.normal(cfg.initializer_range),
+                          (cfg.vocab_size, cfg.hidden_size))
+        logits = x @ head.T
+        if labels is None:
+            return logits
+        return cross_entropy_loss(logits, labels), logits
+
+
+def gptneox_tensor_rules(name, shape):
+    if "query_key_value.kernel" in name or "dense_h_to_4h.kernel" in name:
+        return P(None, TENSOR_AXIS)
+    if "query_key_value.bias" in name or "dense_h_to_4h.bias" in name:
+        return P(TENSOR_AXIS)
+    if "attention.dense.kernel" in name or "dense_4h_to_h.kernel" in name:
+        return P(TENSOR_AXIS, None)
+    return None
+
+
+GPTNeoXForCausalLM.tensor_sharding_rules = staticmethod(gptneox_tensor_rules)
+
+
+def from_hf_state_dict(state_dict, config: GPTNeoXConfig):
+    """HF GPTNeoXForCausalLM state dict -> this module's params."""
+
+    def g(key, transpose=False):
+        v = state_dict[key]
+        if hasattr(v, "numpy"):
+            v = v.detach().cpu().numpy()
+        v = np.asarray(v)
+        return v.T if transpose else v
+
+    prefix = "gpt_neox." if "gpt_neox.embed_in.weight" in state_dict else ""
+    params = {
+        "embed_in": g(f"{prefix}embed_in.weight"),
+        "embed_out": g("embed_out.weight"),
+        "final_layer_norm": {
+            "scale": g(f"{prefix}final_layer_norm.weight"),
+            "bias": g(f"{prefix}final_layer_norm.bias")},
+    }
+    for i in range(config.num_hidden_layers):
+        lp = f"{prefix}layers.{i}."
+        params[f"layers_{i}"] = {
+            "input_layernorm": {
+                "scale": g(f"{lp}input_layernorm.weight"),
+                "bias": g(f"{lp}input_layernorm.bias")},
+            "post_attention_layernorm": {
+                "scale": g(f"{lp}post_attention_layernorm.weight"),
+                "bias": g(f"{lp}post_attention_layernorm.bias")},
+            "attention": {
+                "query_key_value": {
+                    "kernel": g(f"{lp}attention.query_key_value.weight",
+                                transpose=True),
+                    "bias": g(f"{lp}attention.query_key_value.bias")},
+                "dense": {
+                    "kernel": g(f"{lp}attention.dense.weight",
+                                transpose=True),
+                    "bias": g(f"{lp}attention.dense.bias")},
+            },
+            "dense_h_to_4h": {
+                "kernel": g(f"{lp}mlp.dense_h_to_4h.weight",
+                            transpose=True),
+                "bias": g(f"{lp}mlp.dense_h_to_4h.bias")},
+            "dense_4h_to_h": {
+                "kernel": g(f"{lp}mlp.dense_4h_to_h.weight",
+                            transpose=True),
+                "bias": g(f"{lp}mlp.dense_4h_to_h.bias")},
+        }
+    return {"params": params}

@@ -11,7 +11,7 @@ works for every family with no per-model user code.
 import dataclasses
 from typing import Any, Callable, Dict, Optional
 
-from . import bloom, gpt2, llama, mistral, opt
+from . import bloom, gpt2, gptneox, llama, mistral, opt
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +53,12 @@ register(ModelPolicy(
     model_cls=bloom.BloomForCausalLM, from_hf=bloom.from_hf_state_dict,
     tensor_rules=bloom.bloom_tensor_rules,
     hf_keys=("transformer.word_embeddings.weight",)))
+register(ModelPolicy(
+    name="gptneox", config_cls=gptneox.GPTNeoXConfig,
+    model_cls=gptneox.GPTNeoXForCausalLM,
+    from_hf=gptneox.from_hf_state_dict,
+    tensor_rules=gptneox.gptneox_tensor_rules,
+    hf_keys=("gpt_neox.embed_in.weight", "embed_in.weight")))
 register(ModelPolicy(
     name="opt", config_cls=opt.OPTConfig,
     model_cls=opt.OPTForCausalLM, from_hf=opt.from_hf_state_dict,
