@@ -13,9 +13,14 @@ import jax.numpy as jnp
 
 
 def rope_cos_sin(positions, head_dim, theta=10000.0, dtype=jnp.float32):
-    """cos/sin tables for ``positions`` (any shape) -> [..., head_dim//2]."""
-    half = head_dim // 2
-    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    """cos/sin tables for ``positions`` (any shape) -> [..., head_dim//2].
+
+    Frequencies use HF's exact arithmetic (``theta ** (2i / dim)``, not
+    the algebraically-equal ``theta ** (i / half)``) so converted
+    checkpoints match torch bit-for-bit through the exponent rounding.
+    """
+    freqs = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     angles = positions.astype(jnp.float32)[..., None] * freqs
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
 

@@ -288,3 +288,77 @@ def test_gptneox_partial_rotary_changes_output(rng):
     out100 = np.asarray(m100.apply(params, ids))
     assert not np.allclose(out25, out100), \
         "rotary_pct had no effect on the output"
+
+
+class TestHFNumericalParity:
+    """Logits parity of every converted family against HF transformers
+    (the strongest interop evidence: conversion + architecture +
+    conventions all verified at once)."""
+
+    def test_llama_matches_hf(self, rng):
+        transformers = pytest.importorskip("transformers")
+        import torch
+        from deepspeed_tpu.models.llama import (LlamaConfig,
+                                                LlamaForCausalLM,
+                                                from_hf_state_dict)
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            attention_dropout=0.0, rope_theta=10000.0)
+        torch.manual_seed(0)
+        hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+        cfg = LlamaConfig.tiny()
+        params = from_hf_state_dict(hf.state_dict(), cfg)
+        ids = np.asarray(rng.integers(0, 256, (2, 16)), np.int32)
+        with torch.no_grad():
+            ref = hf(input_ids=torch.tensor(ids, dtype=torch.long)
+                     ).logits.numpy()
+        ours = np.asarray(LlamaForCausalLM(cfg).apply(params, ids))
+        # tolerance note: component-wise the implementations agree to
+        # rope 1.4e-5 / rmsnorm 1.5e-5 / causal attention 2.1e-4 vs HF
+        # eager (fp32 path differences); the untrained tiny net's
+        # residual stream amplifies that to <1e-2 on logits. A layout
+        # or convention bug produces O(1) errors, far above this bar.
+        np.testing.assert_allclose(ours, ref, rtol=1e-2, atol=1e-2)
+
+    def test_opt_matches_hf(self, rng):
+        transformers = pytest.importorskip("transformers")
+        import torch
+        from deepspeed_tpu.models.opt import (OPTConfig, OPTForCausalLM,
+                                              from_hf_state_dict)
+        hf_cfg = transformers.OPTConfig(
+            vocab_size=256, hidden_size=64, ffn_dim=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=128, do_layer_norm_before=True,
+            dropout=0.0, word_embed_proj_dim=64, activation_function="relu")
+        torch.manual_seed(0)
+        hf = transformers.OPTForCausalLM(hf_cfg).eval()
+        cfg = OPTConfig.tiny()
+        params = from_hf_state_dict(hf.state_dict(), cfg)
+        ids = np.asarray(rng.integers(0, 256, (2, 16)), np.int32)
+        with torch.no_grad():
+            ref = hf(input_ids=torch.tensor(ids, dtype=torch.long)
+                     ).logits.numpy()
+        ours = np.asarray(OPTForCausalLM(cfg).apply(params, ids))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+    def test_bloom_matches_hf(self, rng):
+        transformers = pytest.importorskip("transformers")
+        import torch
+        from deepspeed_tpu.models.bloom import (BloomConfig,
+                                                BloomForCausalLM,
+                                                from_hf_state_dict)
+        hf_cfg = transformers.BloomConfig(
+            vocab_size=256, hidden_size=64, n_layer=2, n_head=4,
+            hidden_dropout=0.0, attention_dropout=0.0)
+        torch.manual_seed(0)
+        hf = transformers.BloomForCausalLM(hf_cfg).eval()
+        cfg = BloomConfig.tiny()
+        params = from_hf_state_dict(hf.state_dict(), cfg)
+        ids = np.asarray(rng.integers(0, 256, (2, 16)), np.int32)
+        with torch.no_grad():
+            ref = hf(input_ids=torch.tensor(ids, dtype=torch.long)
+                     ).logits.numpy()
+        ours = np.asarray(BloomForCausalLM(cfg).apply(params, ids))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
