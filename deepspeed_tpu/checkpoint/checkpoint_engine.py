@@ -78,6 +78,15 @@ class AsyncCheckpointEngine(CheckpointEngine):
              save_latest: bool = True):
         import jax
         import numpy as np
+        if jax.process_count() > 1:
+            # np.asarray on a non-fully-addressable sharded array raises
+            # deep inside the snapshot; fail with an actionable message
+            # instead (the sync orbax engine handles multi-host saves).
+            raise NotImplementedError(
+                "AsyncCheckpointEngine snapshots state to one host and "
+                "only supports single-process runs; use the sync "
+                "checkpoint engine (checkpoint_engine.type='sync') on "
+                f"multi-host meshes (process_count={jax.process_count()})")
         host_state = jax.tree_util.tree_map(
             lambda x: np.asarray(x) if hasattr(x, "dtype") else x, state)
 

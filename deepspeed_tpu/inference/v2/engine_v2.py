@@ -110,9 +110,16 @@ class InferenceEngineV2:
             return SchedulingResult.BatchFull
         if sum(lengths) > ec.token_budget:
             return SchedulingResult.BatchFull
+        max_ctx = self._state_manager.max_context
         need = 0
         for uid, n in zip(uids, lengths):
             seq = self._state_manager.get_sequence(uid)
+            seen = (seq.seen_tokens + seq.in_flight_tokens) if seq else 0
+            if seen + n > max_ctx:
+                # would overrun the per-sequence block table — catching it
+                # here (not in finalize) keeps put() side-effect free on
+                # rejection.
+                return SchedulingResult.SequenceTooLong
             if seq is None:
                 need += -(-n // ec.kv_block_size)
             else:
@@ -139,12 +146,38 @@ class InferenceEngineV2:
             token_budget=ec.token_budget,
             max_seqs=ec.max_ragged_sequence_count,
             max_blocks_per_seq=ec.max_blocks_per_seq)
-        for uid, toks in zip(batch_uids, batch_tokens):
-            seq = self._state_manager.get_or_create_sequence(uid)
-            self._state_manager.kv.maybe_allocate(seq, len(toks))
-            seq.pre_forward(len(toks))
-            wrapper.insert_sequence(seq, toks, do_checks=do_checks)
-        rb = wrapper.finalize(self._state_manager)
+        # Host accounting is transactional: any failure during insertion/
+        # finalize (e.g. OutOfKVBlocks with do_checks=False) rolls back the
+        # in_flight counts, newly allocated blocks, and newly created
+        # sequence entries, so a failed put() cannot poison later
+        # scheduling.
+        staged = []  # [seq, n_in_flight, blocks_before, created] — the
+        # record is staged BEFORE allocation so a maybe_allocate failure
+        # still rolls back the just-created sequence entry.
+        try:
+            for uid, toks in zip(batch_uids, batch_tokens):
+                created = self._state_manager.get_sequence(uid) is None
+                seq = self._state_manager.get_or_create_sequence(uid)
+                rec = [seq, 0, len(seq.blocks), created]
+                staged.append(rec)
+                self._state_manager.kv.maybe_allocate(seq, len(toks))
+                seq.pre_forward(len(toks))
+                rec[1] = len(toks)
+                wrapper.insert_sequence(seq, toks, do_checks=do_checks)
+            rb = wrapper.finalize(self._state_manager)
+        except Exception:
+            # reverse order so duplicate-uid end-slices compose
+            for seq, n, blocks_before, created in reversed(staged):
+                seq.in_flight_tokens -= n
+                if len(seq.blocks) > blocks_before:
+                    self._state_manager.kv.allocator.free(
+                        seq.blocks[blocks_before:])
+                    del seq.blocks[blocks_before:]
+            for seq, _, _, created in staged:
+                if (created and seq.seen_tokens == 0
+                        and seq.in_flight_tokens == 0):
+                    self._state_manager.tracked_sequences.pop(seq.uid, None)
+            raise
 
         logits, self.pools = self._jit_forward(
             self.params, self.pools, rb.token_ids, rb.token_seq,

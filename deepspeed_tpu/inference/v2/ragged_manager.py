@@ -25,6 +25,7 @@ class SchedulingResult(enum.Enum):
     OutOfKVBlocks = 2      # allocator exhausted
     BatchFull = 3          # token budget exceeded
     UnknownSequence = 4
+    SequenceTooLong = 5    # would exceed max_blocks_per_seq * block_size
 
 
 class SchedulingError(RuntimeError):

@@ -250,7 +250,7 @@ def _bwd_dkv_kernel(kt_ref, kcnt_ref, q_ref, k_ref, v_ref, do_ref,
 # ---------------------------------------------------------------------------
 def _fwd(q, k, v, layout_key, sm_scale, causal, block_q, block_k,
          interpret):
-    qt, qcnt, _, _, _ = _LAYOUTS[layout_key]
+    qt, qcnt, _, _, _ = layout_key.tables
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
@@ -282,9 +282,33 @@ def _fwd(q, k, v, layout_key, sm_scale, causal, block_q, block_k,
     return out, lse
 
 
-# registry: hashable key -> tables (jax custom_vjp nondiff args must hash).
-# Bounded: regenerating layouts per step (e.g. reseeded bigbird) must not
-# grow host memory / kernel-cache entries forever.
+class _LayoutTables:
+    """Hashable handle carrying its own lookup tables.
+
+    custom_vjp nondiff args must hash/compare; hashing by the layout key
+    keeps jit caches stable across re-registrations of an equal layout,
+    while the tables ride on the object itself — so an interning-dict
+    eviction can never invalidate a key a live traced function still
+    holds (the earlier bounded-registry design could KeyError inside
+    grad after 64 distinct layouts)."""
+
+    __slots__ = ("key", "tables")
+
+    def __init__(self, key, tables):
+        self.key = key
+        self.tables = tables
+
+    def __hash__(self):
+        return hash(self.key)
+
+    def __eq__(self, other):
+        return isinstance(other, _LayoutTables) and self.key == other.key
+
+
+# interning dict: equal layouts share one handle so repeated calls hit
+# the jit cache. Bounded: regenerating layouts per step (e.g. reseeded
+# bigbird) must not grow host memory forever — eviction only drops the
+# interning entry, never tables a live trace references.
 _LAYOUTS = {}
 _LAYOUTS_MAX = 64
 
@@ -295,10 +319,11 @@ def _register_layout(layout: np.ndarray, causal: bool, block_q: int,
     if key not in _LAYOUTS:
         while len(_LAYOUTS) >= _LAYOUTS_MAX:
             _LAYOUTS.pop(next(iter(_LAYOUTS)))  # FIFO eviction
-        _LAYOUTS[key] = _tables(layout, causal, block_q, block_k)
+        _LAYOUTS[key] = _LayoutTables(
+            key, _tables(layout, causal, block_q, block_k))
     else:
         _LAYOUTS[key] = _LAYOUTS.pop(key)  # refresh recency
-    return key
+    return _LAYOUTS[key]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
@@ -319,7 +344,7 @@ def _fwd_rule(q, k, v, layout_key, sm_scale, causal, block_q, block_k,
 def _bwd_rule(layout_key, sm_scale, causal, block_q, block_k, interpret,
               res, g):
     q, k, v, out, lse = res
-    qt, qcnt, kt, kcnt, _ = _LAYOUTS[layout_key]
+    qt, qcnt, kt, kcnt, _ = layout_key.tables
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     do = g

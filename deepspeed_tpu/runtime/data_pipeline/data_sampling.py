@@ -56,4 +56,11 @@ class CurriculumDataSampler:
         return len(self.loader)
 
     def __getattr__(self, name):
-        return getattr(self.loader, name)
+        # guard against infinite recursion when 'loader' itself is absent
+        # (e.g. attribute access during unpickling, before __init__ ran)
+        try:
+            loader = object.__getattribute__(self, "loader")
+        except AttributeError:
+            raise AttributeError(
+                f"{type(self).__name__!r} object has no attribute {name!r}")
+        return getattr(loader, name)

@@ -9,6 +9,7 @@ per iteration, no retain_graph bookkeeping; works on whole param trees
 or any sub-tree.
 """
 
+import weakref
 from typing import Callable, Optional
 
 import jax
@@ -46,15 +47,26 @@ class Eigenvalue:
         self.layer_name = layer_name
         self.layer_num = layer_num
         # one compiled HVP per loss_fn — re-jitting per call would pay a
-        # full trace+compile every gas boundary
-        self._hvp_cache = {}
+        # full trace+compile every gas boundary. Keyed by weakref so a
+        # new loss_fn reusing a dead function's id() can never pick up a
+        # stale compiled HVP of a different loss.
+        self._hvp_cache = weakref.WeakKeyDictionary()
 
     def _hvp_for(self, loss_fn):
-        key = id(loss_fn)
-        if key not in self._hvp_cache:
-            self._hvp_cache[key] = jax.jit(
+        try:
+            hvp = self._hvp_cache.get(loss_fn)
+        except TypeError:  # unhashable/unweakrefable callables: no cache
+            return jax.jit(
                 lambda p, t: jax.jvp(jax.grad(loss_fn), (p,), (t,))[1])
-        return self._hvp_cache[key]
+        if hvp is None:
+            # close over a weak proxy, not loss_fn itself — a strong
+            # closure would keep the key alive forever and the weak
+            # entry could never be collected
+            ref = weakref.proxy(loss_fn)
+            hvp = jax.jit(
+                lambda p, t: jax.jvp(jax.grad(ref), (p,), (t,))[1])
+            self._hvp_cache[loss_fn] = hvp
+        return hvp
 
     def compute_eigenvalue(self, loss_fn: Callable, params,
                            rng: Optional[jax.Array] = None) -> float:

@@ -21,11 +21,10 @@ def tiny_llama():
 
 
 def _engine(cfg, params, **over):
-    ec = RaggedInferenceEngineConfig(
-        token_budget=32, max_ragged_sequence_count=4, n_kv_blocks=16,
-        kv_block_size=8, max_blocks_per_seq=8, kv_dtype="float32",
-        **over)
-    return InferenceEngineV2(params, cfg, ec)
+    kw = dict(token_budget=32, max_ragged_sequence_count=4, n_kv_blocks=16,
+              kv_block_size=8, max_blocks_per_seq=8, kv_dtype="float32")
+    kw.update(over)
+    return InferenceEngineV2(params, cfg, RaggedInferenceEngineConfig(**kw))
 
 
 class TestStateManager:
@@ -141,6 +140,47 @@ class TestEngineV2:
         v2.put([1], [np.arange(10)])
         assert v2.free_blocks < free0
         v2.flush(1)
+        assert v2.free_blocks == free0
+
+    def test_can_schedule_rejects_overlong_sequence(self, tiny_llama):
+        """A sequence that would overrun max_blocks_per_seq * block_size
+        is rejected up front (not mid-put), even when the KV pool has
+        free blocks — and a resuming sequence's seen tokens count."""
+        cfg, _, params = tiny_llama
+        v2 = _engine(cfg, params, token_budget=128, n_kv_blocks=64,
+                     max_blocks_per_seq=2)   # per-seq cap: 2*8 = 16 tokens
+        assert v2.can_schedule([1], [16]) == SchedulingResult.Success
+        assert (v2.can_schedule([1], [17])
+                == SchedulingResult.SequenceTooLong)
+        v2.put([1], [np.arange(12)])
+        assert v2.can_schedule([1], [4]) == SchedulingResult.Success
+        assert (v2.can_schedule([1], [5])
+                == SchedulingResult.SequenceTooLong)
+
+    def test_put_failure_rolls_back_host_accounting(self, tiny_llama):
+        """A put() that fails mid-batch (overlong seq with do_checks off)
+        must leave no trace: in-flight counts, block allocation, and the
+        sequence table are restored, and the engine keeps serving."""
+        cfg, _, params = tiny_llama
+        v2 = _engine(cfg, params, token_budget=128, n_kv_blocks=64,
+                     max_blocks_per_seq=2)   # per-seq cap: 16 tokens
+        free0 = v2.free_blocks
+        v2.put([7], [np.arange(10)])         # 10 seen tokens
+        free_mid = v2.free_blocks
+        seq = v2._state_manager.get_sequence(7)
+        # batch of (existing seq overrunning its block table, fresh seq):
+        # insert_sequence/finalize raises after host mutation started
+        with pytest.raises(SchedulingError):
+            v2.put([7, 8], [np.arange(10), np.arange(4)], do_checks=False)
+        assert seq.in_flight_tokens == 0
+        assert seq.seen_tokens == 10
+        assert v2.free_blocks == free_mid
+        assert v2._state_manager.get_sequence(8) is None  # rolled back
+        # engine still serves both sequences within bounds
+        v2.put([7, 8], [np.arange(4), np.arange(4)])
+        assert v2._state_manager.get_sequence(7).seen_tokens == 14
+        v2.flush(7)
+        v2.flush(8)
         assert v2.free_blocks == free0
 
 
