@@ -18,9 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...models.llama import LlamaConfig
 from ...utils.logging import logger
-from .model import init_kv_pools, ragged_forward
+from .model import init_kv_pools, normalize_params, ragged_forward
 from .ragged_manager import (DSStateManager, SchedulingError,
                              SchedulingResult)
 from .ragged_wrapper import RaggedBatchWrapper
@@ -42,48 +41,81 @@ class RaggedInferenceEngineConfig:
 
 class InferenceEngineV2:
 
-    def __init__(self, params, config: LlamaConfig,
+    def __init__(self, params, config,
                  engine_config: Optional[RaggedInferenceEngineConfig] = None):
         self._config = engine_config or RaggedInferenceEngineConfig()
         ec = self._config
         self.model_config = config
-        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        # one-time policy/LayerContainer mapping: family params ->
+        # (static arch spec, normalized tree) — reference analog:
+        # v2/model_implementations/layer_container_base.py
+        self.spec, self.tree = normalize_params(
+            jax.tree_util.tree_map(jnp.asarray, params), config)
         self._state_manager = DSStateManager(
             max_tracked_sequences=ec.max_tracked_sequences,
             max_ragged_sequence_count=ec.max_ragged_sequence_count,
             max_context=ec.max_blocks_per_seq * ec.kv_block_size,
             n_blocks=ec.n_kv_blocks, block_size=ec.kv_block_size)
-        self.pools = init_kv_pools(config, ec.n_kv_blocks,
+        self.pools = init_kv_pools(self.spec, ec.n_kv_blocks,
                                    ec.kv_block_size,
                                    dtype=jnp.dtype(ec.kv_dtype))
         if ec.tp_size > 1:
             self._apply_tp_sharding(ec.tp_size)
+        spec = self.spec
         self._jit_forward = jax.jit(
-            lambda params, pools, *args: ragged_forward(
-                params, config, pools, *args,
+            lambda tree, pools, *args: ragged_forward(
+                tree, spec, pools, *args,
                 block_size=ec.kv_block_size),
             donate_argnums=(1,))
 
     def _apply_tp_sharding(self, tp: int):
-        """Shard weights with the model's TP rules and the KV pools over
-        the tensor axis (kv-head dim); GSPMD then partitions the ragged
+        """Shard the normalized tree with generic TP rules (column-split
+        in-projections, row-split out-projections — the AutoTP pattern
+        applied to the normalized layout) and the KV pools over the
+        tensor axis (kv-head dim); GSPMD then partitions the ragged
         forward exactly like the reference's TP FastGen engine
         (v2/model_implementations/sharding/)."""
-        from ...models.llama import llama_tensor_rules
         from ...parallel.mesh import (MeshConfig, TENSOR_AXIS,
                                       mesh_manager)
-        from ...runtime.zero.partition import ZeroShardingRules
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         if not mesh_manager.initialized:
             mesh_manager.init(MeshConfig(data=-1, tensor=tp))
         mesh = mesh_manager.mesh
-        rules = ZeroShardingRules(mesh=mesh, stage=0,
-                                  tensor_rules=llama_tensor_rules)
-        self.params = jax.device_put(
-            self.params, rules.param_shardings(self.params))
-        nkv = self.model_config.num_key_value_heads
-        pool_spec = P(None, TENSOR_AXIS, None) if nkv % tp == 0 else P()
+        col = {"wq", "wk", "wv", "w_gate", "w_up", "w_in"}
+        colb = {"bq", "bk", "bv", "b_in"}
+        row = {"wo", "w_down", "w_out"}
+
+        def spec_for(key, leaf):
+            if key in col:
+                return P(None, TENSOR_AXIS)
+            if key in colb:
+                return P(TENSOR_AXIS)
+            if key in row:
+                return P(TENSOR_AXIS, None)
+            if key == "we_gate" or key == "we_up":
+                return P(None, None, TENSOR_AXIS)
+            if key == "we_down":
+                return P(None, TENSOR_AXIS, None)
+            return P()
+
+        def shard_tree(tree):
+            out = {}
+            for k, v in tree.items():
+                if k == "layers":
+                    out[k] = [
+                        {lk: jax.device_put(
+                            lv, NamedSharding(mesh, spec_for(lk, lv)))
+                         if lv is not None else None
+                         for lk, lv in layer.items()}
+                        for layer in v]
+                else:
+                    out[k] = jax.device_put(v, NamedSharding(mesh, P()))
+            return out
+
+        self.tree = shard_tree(self.tree)
+        nkv = self.spec.n_kv_heads
+        pool_spec = P(TENSOR_AXIS, None, None) if nkv % tp == 0 else P()
         if nkv % tp:
             logger.warning(f"kv heads ({nkv}) not divisible by tp={tp}; "
                            "KV pools stay replicated")
@@ -180,8 +212,9 @@ class InferenceEngineV2:
             raise
 
         logits, self.pools = self._jit_forward(
-            self.params, self.pools, rb.token_ids, rb.token_seq,
-            rb.token_pos, rb.seq_lens, rb.block_tables, rb.logits_idx)
+            self.tree, self.pools, rb.token_ids, rb.token_seq,
+            rb.token_pos, rb.token_qidx, rb.seq_lens, rb.q_counts,
+            rb.block_tables, rb.logits_idx)
 
         for uid in batch_uids:
             self._state_manager.get_sequence(uid).post_forward()

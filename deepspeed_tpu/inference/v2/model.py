@@ -1,141 +1,474 @@
-"""Ragged (paged-KV) Llama forward — the FastGen model path.
+"""Ragged (paged-KV) forward — the FastGen model path, all families.
 
 Reference: deepspeed/inference/v2/model_implementations/
-inference_transformer_base.py:617 + kernels/ragged_ops/ (blocked_flash
-paged attention, linear_blocked_kv_rotary, logits_gather).
+inference_transformer_base.py:617 (the shared ragged transformer),
+per-family impls (llama_v2/mistral/mixtral/opt/phi/qwen/falcon
+model.py), and the ragged kernel set under kernels/ragged_ops/
+(blocked_flash paged attention, linear_blocked_kv_rotary, logits_gather,
+moe_scatter/moe_gather + cutlass_ops/moe_gemm for MoE).
 
-TPU-native formulation: every shape is fixed by the engine limits
-(token_budget, max_seqs, max_blocks_per_seq, block_size), so one XLA
-compilation serves every mix of prefill chunks and decode tokens.
-Per layer:
-  1. qkv projection for the packed [budget] tokens + RoPE at their
-     absolute positions (linear_blocked_kv_rotary analog);
-  2. scatter k/v into the global block pool at
-     ``block_table[seq, pos // bs] * bs + pos % bs`` (padding tokens are
-     routed to a reserved scratch block);
-  3. per-token attention over the owning sequence's gathered KV with a
-     causal/length mask (blocked_flash analog — gather-based XLA version;
-     the Pallas paged-attention kernel is the optimization path);
-  4. logits computed ONLY at each sequence's last packed token
-     (logits_gather analog) — the [budget, V] matrix never materializes.
-
-Params are the flax Llama layout (models/llama.py), used functionally.
+TPU-native formulation:
+- every shape is fixed by the engine limits (token_budget, max_seqs,
+  max_blocks_per_seq, block_size), so ONE XLA compilation serves every
+  mix of prefill chunks and decode tokens;
+- attention runs the Pallas paged-attention kernel
+  (ops/pallas_kernels/paged_attention.py) straight over the blocked KV
+  pool — no [budget, ctx] KV gather materializes;
+- model families are described by a static ``RaggedSpec`` + a
+  *normalized* parameter tree built once at engine init
+  (``normalize_params``), so the forward itself is generic — the
+  TPU analog of the reference's policy/LayerContainer mapping
+  (v2/model_implementations/layer_container_base.py);
+- MoE layers (Mixtral) use top-k routing + ``jax.lax.ragged_dot``
+  grouped GEMM over the stacked expert bank — the moe_scatter/moe_gemm/
+  moe_gather pipeline as one sorted ragged matmul;
+- logits are computed ONLY at each sequence's last packed token
+  (logits_gather analog) — the [budget, V] matrix never materializes.
 """
 
 import dataclasses
-from typing import Any, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from ...models.llama import LlamaConfig
 from ...ops.pallas_kernels import apply_rotary_pos_emb, rope_cos_sin
+from ...ops.pallas_kernels.paged_attention import paged_attention
 
 
-def init_kv_pools(cfg: LlamaConfig, n_blocks: int, block_size: int,
-                  dtype=jnp.bfloat16):
-    """Per-layer (k, v) pools with one extra scratch block (index
-    ``n_blocks``) that absorbs padding-token writes."""
-    shape = ((n_blocks + 1) * block_size, cfg.num_key_value_heads,
-             cfg.head_dim)
-    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-            for _ in range(cfg.num_hidden_layers)]
+# ---------------------------------------------------------------------------
+# architecture spec + param normalization (the policy/LayerContainer seam)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class RaggedSpec:
+    """Static architecture descriptor for the generic ragged forward."""
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+    vocab_size: int
+    norm: str = "rms"          # "rms" | "ln"
+    eps: float = 1e-5
+    pos: str = "rope"          # "rope" | "learned" | "alibi"
+    rope_theta: float = 10000.0
+    rope_pct: float = 1.0      # partial rotary (NeoX)
+    pos_offset: int = 0        # OPT's +2
+    act: str = "silu_gate"     # "silu_gate" | "gelu" | "gelu_tanh" | "relu"
+    parallel_residual: bool = False
+    embed_ln: bool = False     # BLOOM word_embeddings_layernorm
+    window: int = 0            # sliding window (Mistral), 0 = off
+    n_experts: int = 0         # MoE expert count (Mixtral), 0 = dense
+    top_k: int = 2
 
 
-def _rms(x, w, eps):
-    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
-                   keepdims=True)
-    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
-            ).astype(x.dtype) * w
+def _unfuse_interleaved(kernel, bias, nh, hd):
+    """[C, nh*3*hd] fused qkv with [heads, 3, head_dim] interleave
+    (NeoX/BLOOM) -> (wq, wk, wv, bq, bk, bv)."""
+    C = kernel.shape[0]
+    k4 = kernel.reshape(C, nh, 3, hd)
+    ws = [k4[:, :, i].reshape(C, nh * hd) for i in range(3)]
+    if bias is None:
+        return ws + [None, None, None]
+    b4 = bias.reshape(nh, 3, hd)
+    bs = [b4[:, i].reshape(nh * hd) for i in range(3)]
+    return ws + bs
 
 
-def ragged_forward(params, cfg: LlamaConfig, pools, token_ids, token_seq,
-                   token_pos, seq_lens, block_tables, logits_idx,
-                   block_size: int):
-    """One ragged forward.
-
-    token_ids/token_seq/token_pos: [budget]; seq_lens: [S];
-    block_tables: [S, max_blocks]; logits_idx: [S].
-    Returns (logits [S, vocab], new_pools).
-    """
+def normalize_params(params, config) -> Tuple[RaggedSpec, Dict[str, Any]]:
+    """Model-family params -> (spec, normalized tree). Dispatches on the
+    config class name; runs once at engine init (host side)."""
     p = params["params"] if "params" in params else params
-    S, max_blocks = block_tables.shape
+    name = type(config).__name__
+    if name not in _ADAPTERS:
+        raise ValueError(
+            f"no ragged-inference adapter for {name}; known: "
+            f"{sorted(_ADAPTERS)}")
+    return _ADAPTERS[name](p, config)
+
+
+def _adapt_llama(p, cfg):
+    spec = RaggedSpec(
+        n_layers=cfg.num_hidden_layers, n_heads=cfg.num_attention_heads,
+        n_kv_heads=cfg.num_key_value_heads, head_dim=cfg.head_dim,
+        vocab_size=cfg.vocab_size, norm="rms", eps=cfg.rms_norm_eps,
+        pos="rope", rope_theta=cfg.rope_theta, act="silu_gate",
+        window=cfg.sliding_window or 0)
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        lp = p[f"layers_{i}"]
+        layers.append({
+            "ln1_scale": lp["input_layernorm"]["weight"],
+            "wq": lp["self_attn"]["q_proj"]["kernel"],
+            "wk": lp["self_attn"]["k_proj"]["kernel"],
+            "wv": lp["self_attn"]["v_proj"]["kernel"],
+            "wo": lp["self_attn"]["o_proj"]["kernel"],
+            "ln2_scale": lp["post_attention_layernorm"]["weight"],
+            "w_gate": lp["mlp"]["gate_proj"]["kernel"],
+            "w_up": lp["mlp"]["up_proj"]["kernel"],
+            "w_down": lp["mlp"]["down_proj"]["kernel"],
+        })
+    head = p["embed_tokens"] if cfg.tie_word_embeddings else p["lm_head"]
+    tree = {"embed": p["embed_tokens"], "layers": layers,
+            "final_scale": p["norm"]["weight"], "head": head}
+    return spec, tree
+
+
+def _adapt_mixtral(p, cfg):
+    spec = RaggedSpec(
+        n_layers=cfg.num_hidden_layers, n_heads=cfg.num_attention_heads,
+        n_kv_heads=cfg.num_key_value_heads, head_dim=cfg.head_dim,
+        vocab_size=cfg.vocab_size, norm="rms", eps=cfg.rms_norm_eps,
+        pos="rope", rope_theta=cfg.rope_theta, act="silu_gate",
+        window=cfg.sliding_window or 0,
+        n_experts=cfg.num_local_experts, top_k=cfg.num_experts_per_tok)
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        lp = p[f"layers_{i}"]
+        moe = lp["block_sparse_moe"]
+        layers.append({
+            "ln1_scale": lp["input_layernorm"]["weight"],
+            "wq": lp["q_proj"]["kernel"], "wk": lp["k_proj"]["kernel"],
+            "wv": lp["v_proj"]["kernel"], "wo": lp["o_proj"]["kernel"],
+            "ln2_scale": lp["post_attention_layernorm"]["weight"],
+            "router": moe["gate"], "we_gate": moe["w1"],
+            "we_up": moe["w3"], "we_down": moe["w2"],
+        })
+    head = p["embed_tokens"] if cfg.tie_word_embeddings else p["lm_head"]
+    tree = {"embed": p["embed_tokens"], "layers": layers,
+            "final_scale": p["norm"]["weight"], "head": head}
+    return spec, tree
+
+
+def _adapt_gptneox(p, cfg):
+    nh, hd = cfg.num_attention_heads, cfg.head_dim
+    spec = RaggedSpec(
+        n_layers=cfg.num_hidden_layers, n_heads=nh, n_kv_heads=nh,
+        head_dim=hd, vocab_size=cfg.vocab_size, norm="ln",
+        eps=cfg.layer_norm_eps, pos="rope",
+        rope_theta=cfg.rotary_emb_base, rope_pct=cfg.rotary_pct,
+        act="gelu_tanh" if cfg.hidden_act == "gelu_new" else "gelu",
+        parallel_residual=cfg.use_parallel_residual)
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        lp = p[f"layers_{i}"]
+        qkv = lp["attention"]["query_key_value"]
+        wq, wk, wv, bq, bk, bv = _unfuse_interleaved(
+            qkv["kernel"], qkv.get("bias"), nh, hd)
+        layers.append({
+            "ln1_scale": lp["input_layernorm"]["scale"],
+            "ln1_bias": lp["input_layernorm"]["bias"],
+            "wq": wq, "wk": wk, "wv": wv, "bq": bq, "bk": bk, "bv": bv,
+            "wo": lp["attention"]["dense"]["kernel"],
+            "bo": lp["attention"]["dense"]["bias"],
+            "ln2_scale": lp["post_attention_layernorm"]["scale"],
+            "ln2_bias": lp["post_attention_layernorm"]["bias"],
+            "w_in": lp["dense_h_to_4h"]["kernel"],
+            "b_in": lp["dense_h_to_4h"]["bias"],
+            "w_out": lp["dense_4h_to_h"]["kernel"],
+            "b_out": lp["dense_4h_to_h"]["bias"],
+        })
+    tree = {"embed": p["embed_in"], "layers": layers,
+            "final_scale": p["final_layer_norm"]["scale"],
+            "final_bias": p["final_layer_norm"]["bias"],
+            "head": p["embed_out"]}
+    return spec, tree
+
+
+def _adapt_opt(p, cfg):
+    spec = RaggedSpec(
+        n_layers=cfg.num_hidden_layers, n_heads=cfg.num_attention_heads,
+        n_kv_heads=cfg.num_attention_heads, head_dim=cfg.head_dim,
+        vocab_size=cfg.vocab_size, norm="ln",
+        eps=cfg.layer_norm_epsilon, pos="learned", pos_offset=2,
+        act="relu")
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        lp = p[f"layers_{i}"]
+        layers.append({
+            "ln1_scale": lp["self_attn_layer_norm"]["scale"],
+            "ln1_bias": lp["self_attn_layer_norm"]["bias"],
+            "wq": lp["self_attn"]["q_proj"]["kernel"],
+            "bq": lp["self_attn"]["q_proj"]["bias"],
+            "wk": lp["self_attn"]["k_proj"]["kernel"],
+            "bk": lp["self_attn"]["k_proj"]["bias"],
+            "wv": lp["self_attn"]["v_proj"]["kernel"],
+            "bv": lp["self_attn"]["v_proj"]["bias"],
+            "wo": lp["self_attn"]["out_proj"]["kernel"],
+            "bo": lp["self_attn"]["out_proj"]["bias"],
+            "ln2_scale": lp["final_layer_norm"]["scale"],
+            "ln2_bias": lp["final_layer_norm"]["bias"],
+            "w_in": lp["fc1"]["kernel"], "b_in": lp["fc1"]["bias"],
+            "w_out": lp["fc2"]["kernel"], "b_out": lp["fc2"]["bias"],
+        })
+    tree = {"embed": p["embed_tokens"], "pos_emb": p["embed_positions"],
+            "layers": layers,
+            "final_scale": p["final_layer_norm"]["scale"],
+            "final_bias": p["final_layer_norm"]["bias"],
+            "head": p["embed_tokens"]}
+    return spec, tree
+
+
+def _adapt_gpt2(p, cfg):
+    nh = cfg.n_head
+    hd = cfg.n_embd // nh
+    C = cfg.n_embd
+    spec = RaggedSpec(
+        n_layers=cfg.n_layer, n_heads=nh, n_kv_heads=nh, head_dim=hd,
+        vocab_size=cfg.vocab_size, norm="ln",
+        eps=cfg.layer_norm_epsilon, pos="learned", act="gelu_tanh")
+    layers = []
+    for i in range(cfg.n_layer):
+        lp = p[f"h_{i}"]
+        wqkv = lp["attn"]["c_attn"]["kernel"]   # [C, 3C] contiguous
+        bqkv = lp["attn"]["c_attn"]["bias"]
+        layers.append({
+            "ln1_scale": lp["ln_1"]["scale"], "ln1_bias": lp["ln_1"]["bias"],
+            "wq": wqkv[:, :C], "wk": wqkv[:, C:2 * C], "wv": wqkv[:, 2 * C:],
+            "bq": bqkv[:C], "bk": bqkv[C:2 * C], "bv": bqkv[2 * C:],
+            "wo": lp["attn"]["c_proj"]["kernel"],
+            "bo": lp["attn"]["c_proj"]["bias"],
+            "ln2_scale": lp["ln_2"]["scale"], "ln2_bias": lp["ln_2"]["bias"],
+            "w_in": lp["mlp"]["c_fc"]["kernel"],
+            "b_in": lp["mlp"]["c_fc"]["bias"],
+            "w_out": lp["mlp"]["c_proj"]["kernel"],
+            "b_out": lp["mlp"]["c_proj"]["bias"],
+        })
+    tree = {"embed": p["wte"], "pos_emb": p["wpe"], "layers": layers,
+            "final_scale": p["ln_f"]["scale"],
+            "final_bias": p["ln_f"]["bias"], "head": p["wte"]}
+    return spec, tree
+
+
+def _adapt_bloom(p, cfg):
+    nh, hd = cfg.n_head, cfg.head_dim
+    spec = RaggedSpec(
+        n_layers=cfg.n_layer, n_heads=nh, n_kv_heads=nh, head_dim=hd,
+        vocab_size=cfg.vocab_size, norm="ln",
+        eps=cfg.layer_norm_epsilon, pos="alibi", act="gelu_tanh",
+        embed_ln=True)
+    layers = []
+    for i in range(cfg.n_layer):
+        lp = p[f"h_{i}"]
+        qkv = lp["self_attention"]["query_key_value"]
+        wq, wk, wv, bq, bk, bv = _unfuse_interleaved(
+            qkv["kernel"], qkv.get("bias"), nh, hd)
+        layers.append({
+            "ln1_scale": lp["input_layernorm"]["scale"],
+            "ln1_bias": lp["input_layernorm"]["bias"],
+            "wq": wq, "wk": wk, "wv": wv, "bq": bq, "bk": bk, "bv": bv,
+            "wo": lp["self_attention"]["dense"]["kernel"],
+            "bo": lp["self_attention"]["dense"]["bias"],
+            "ln2_scale": lp["post_attention_layernorm"]["scale"],
+            "ln2_bias": lp["post_attention_layernorm"]["bias"],
+            "w_in": lp["dense_h_to_4h"]["kernel"],
+            "b_in": lp["dense_h_to_4h"]["bias"],
+            "w_out": lp["dense_4h_to_h"]["kernel"],
+            "b_out": lp["dense_4h_to_h"]["bias"],
+        })
+    tree = {"embed": p["word_embeddings"],
+            "embed_ln_scale": p["word_embeddings_layernorm"]["scale"],
+            "embed_ln_bias": p["word_embeddings_layernorm"]["bias"],
+            "layers": layers,
+            "final_scale": p["ln_f"]["scale"],
+            "final_bias": p["ln_f"]["bias"],
+            "head": p["word_embeddings"]}
+    return spec, tree
+
+
+_ADAPTERS = {
+    "LlamaConfig": _adapt_llama,       # also Mistral (shared config)
+    "MixtralConfig": _adapt_mixtral,
+    "GPTNeoXConfig": _adapt_gptneox,
+    "OPTConfig": _adapt_opt,
+    "GPT2Config": _adapt_gpt2,
+    "BloomConfig": _adapt_bloom,
+}
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+def init_kv_pools(spec: RaggedSpec, n_blocks: int, block_size: int,
+                  dtype=jnp.bfloat16):
+    """Per-layer (k, v) pools ``[Hkv, (n_blocks+1)*block, D]`` with one
+    extra scratch block (index ``n_blocks``) absorbing padding-token
+    writes. kv-head-major so the paged kernel's per-block DMA tiles are
+    contiguous ``[block, D]`` slabs."""
+    shape = (spec.n_kv_heads, (n_blocks + 1) * block_size, spec.head_dim)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(spec.n_layers)]
+
+
+def _norm(x, scale, bias, kind, eps):
+    xf = x.astype(jnp.float32)
+    if kind == "rms":
+        var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        out = (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
+        return out
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    out = out.astype(x.dtype) * scale
+    return out + bias if bias is not None else out
+
+
+def _act(h, kind):
+    if kind == "gelu":
+        return jax.nn.gelu(h, approximate=False)
+    if kind == "gelu_tanh":
+        return jax.nn.gelu(h, approximate=True)
+    if kind == "relu":
+        return jax.nn.relu(h)
+    raise ValueError(kind)
+
+
+def _rotate(x, cos, sin, rot):
+    """Partial rotary on [B, H, D] at per-token angles cos/sin
+    [B, rot//2], via the shared half-split helper (the single source of
+    the rotation convention — same op the v1 models apply)."""
+    xr = apply_rotary_pos_emb(x[..., :rot], cos[:, None, :],
+                              sin[:, None, :])
+    if rot == x.shape[-1]:
+        return xr
+    return jnp.concatenate([xr, x[..., rot:]], axis=-1)
+
+
+def _alibi_slopes(n_heads: int) -> np.ndarray:
+    from ...models.bloom import alibi_slopes
+    return alibi_slopes(n_heads)
+
+
+def moe_mlp_ragged(x, router, we_gate, we_up, we_down, top_k):
+    """Grouped-GEMM MoE MLP over packed tokens [B, C].
+
+    TPU-native moe_scatter/moe_gemm/moe_gather: route -> sort tokens by
+    expert -> ``jax.lax.ragged_dot`` over the stacked expert bank ->
+    unsort -> weighted combine. One compilation, no per-expert loop.
+    Reference: deepspeed/inference/v2/kernels/ragged_ops/{moe_scatter,
+    moe_gather,top_k_gating} + cutlass_ops/moe_gemm.
+    """
+    from ...models.mixtral import moe_route
+
+    B, C = x.shape
+    E = router.shape[1]
+    w, idx = moe_route(x @ router, top_k)           # [B, k]
+
+    flat_e = idx.reshape(-1)                        # [B*k]
+    order = jnp.argsort(flat_e, stable=True)
+    xs = jnp.repeat(x, top_k, axis=0)[order]        # sorted by expert
+    group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+
+    g = jax.lax.ragged_dot(xs, we_gate.astype(xs.dtype), group_sizes)
+    u = jax.lax.ragged_dot(xs, we_up.astype(xs.dtype), group_sizes)
+    h = jax.nn.silu(g) * u
+    o = jax.lax.ragged_dot(h, we_down.astype(h.dtype), group_sizes)
+
+    inv = jnp.argsort(order)
+    o = o[inv].reshape(B, top_k, C)
+    return jnp.sum(o * w[..., None].astype(o.dtype), axis=1)
+
+
+# ---------------------------------------------------------------------------
+# the generic ragged forward
+# ---------------------------------------------------------------------------
+def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
+                   token_pos, token_qidx, seq_lens, q_counts,
+                   block_tables, logits_idx, block_size: int,
+                   interpret: bool = False):
+    """One ragged forward over the paged KV pools.
+
+    token_* arrays: [budget]; seq_lens/q_counts/logits_idx: [S];
+    block_tables: [S, max_blocks]. Returns (logits [S, vocab],
+    new_pools).
+    """
+    S = block_tables.shape[0]
     bs = block_size
-    ctx = max_blocks * bs
-    nh, nkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
-                   cfg.head_dim)
+    nh, nkv, hd = spec.n_heads, spec.n_kv_heads, spec.head_dim
     rep = nh // nkv
 
-    x = p["embed_tokens"][token_ids]  # [B, C]
-    B = x.shape[0]
+    x = tree["embed"][token_ids]                    # [B, C]
+    B, C = x.shape
+    if spec.pos == "learned":
+        x = x + tree["pos_emb"][token_pos + spec.pos_offset]
+    if spec.embed_ln:
+        x = _norm(x, tree["embed_ln_scale"], tree["embed_ln_bias"],
+                  "ln", spec.eps)
 
-    cos, sin = rope_cos_sin(token_pos[None, :], hd, theta=cfg.rope_theta)
-    cos, sin = cos[0], sin[0]  # [B, hd/2]
+    rot = int(hd * spec.rope_pct)
+    if spec.pos == "rope":
+        cos, sin = rope_cos_sin(token_pos[None, :], rot,
+                                theta=spec.rope_theta)
+        cos, sin = cos[0], sin[0]                   # [B, rot/2]
+    slopes = _alibi_slopes(nh) if spec.pos == "alibi" else None
 
     # scratch-block routing for padding tokens (token_seq == S)
     pad_tables = jnp.concatenate(
-        [block_tables, jnp.zeros((1, max_blocks), jnp.int32)], axis=0)
+        [block_tables, jnp.zeros((1, block_tables.shape[1]), jnp.int32)],
+        axis=0)
 
-    # per-token flat write index into the pool's token axis
     def flat_write_idx(pool_tokens):
         scratch_block = pool_tokens // bs - 1
         tables = pad_tables.at[S].set(scratch_block)
         block = tables[token_seq.clip(0, S), token_pos // bs]
         return block * bs + token_pos % bs
 
-    # per-slot gather indices [S, ctx]; gathered slot j of a sequence is
-    # absolute position j (blocks are appended in order), valid while
-    # j < seq_len
-    gather_idx = (block_tables * bs)[:, :, None] + jnp.arange(bs)
-    gather_idx = gather_idx.reshape(S, ctx)
-    k_abs = jnp.arange(ctx)
-
-    seq_of_token = jnp.clip(token_seq, 0, S - 1)
-
     new_pools = []
-    scale = 1.0 / (hd ** 0.5)
-    for layer in range(cfg.num_hidden_layers):
-        lp = p[f"layers_{layer}"]
+    for layer in range(spec.n_layers):
+        lp = tree["layers"][layer]
         k_pool, v_pool = pools[layer]
-        widx = flat_write_idx(k_pool.shape[0])
+        widx = flat_write_idx(k_pool.shape[1])
 
-        h = _rms(x, lp["input_layernorm"]["weight"], cfg.rms_norm_eps)
-        q = (h @ lp["self_attn"]["q_proj"]["kernel"]).reshape(B, nh, hd)
-        k = (h @ lp["self_attn"]["k_proj"]["kernel"]).reshape(B, nkv, hd)
-        v = (h @ lp["self_attn"]["v_proj"]["kernel"]).reshape(B, nkv, hd)
-        q = apply_rotary_pos_emb(q[:, None], cos[:, None, None, :],
-                                 sin[:, None, None, :])[:, 0]
-        k = apply_rotary_pos_emb(k[:, None], cos[:, None, None, :],
-                                 sin[:, None, None, :])[:, 0]
+        h = _norm(x, lp["ln1_scale"], lp.get("ln1_bias"), spec.norm,
+                  spec.eps)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if lp.get("bq") is not None:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(B, nh, hd)
+        k = k.reshape(B, nkv, hd)
+        v = v.reshape(B, nkv, hd)
+        if spec.pos == "rope":
+            q = _rotate(q, cos, sin, rot)
+            k = _rotate(k, cos, sin, rot)
 
-        k_pool = k_pool.at[widx].set(k.astype(k_pool.dtype))
-        v_pool = v_pool.at[widx].set(v.astype(v_pool.dtype))
+        k_pool = k_pool.at[:, widx].set(
+            k.transpose(1, 0, 2).astype(k_pool.dtype))
+        v_pool = v_pool.at[:, widx].set(
+            v.transpose(1, 0, 2).astype(v_pool.dtype))
         new_pools.append((k_pool, v_pool))
 
-        K = k_pool[gather_idx]  # [S, ctx, nkv, hd]
-        V = v_pool[gather_idx]
-        Kt = K[seq_of_token]    # [B, ctx, nkv, hd]
-        Vt = V[seq_of_token]
-        qg = q.reshape(B, nkv, rep, hd).astype(jnp.float32) * scale
-        scores = jnp.einsum("bkrd,bckd->bkrc", qg,
-                            Kt.astype(jnp.float32))  # [B, nkv, rep, ctx]
-        visible = k_abs[None, :] <= token_pos[:, None]  # causal
-        within = k_abs[None, :] < seq_lens[seq_of_token][:, None]
-        mask = visible & within
-        scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bkrc,bckd->bkrd", probs.astype(Vt.dtype), Vt)
+        attn = paged_attention(
+            q, k_pool, v_pool, block_tables, seq_lens, q_counts,
+            token_seq, token_qidx, block_size=bs,
+            alibi_slopes=slopes, window=spec.window,
+            interpret=interpret)
         attn = attn.reshape(B, nh * hd).astype(x.dtype)
-        x = x + attn @ lp["self_attn"]["o_proj"]["kernel"]
+        attn_out = attn @ lp["wo"]
+        if lp.get("bo") is not None:
+            attn_out = attn_out + lp["bo"]
 
-        h = _rms(x, lp["post_attention_layernorm"]["weight"],
-                 cfg.rms_norm_eps)
-        gate = h @ lp["mlp"]["gate_proj"]["kernel"]
-        up = h @ lp["mlp"]["up_proj"]["kernel"]
-        x = x + (jax.nn.silu(gate) * up) @ lp["mlp"]["down_proj"]["kernel"]
+        mlp_in = x if spec.parallel_residual else x + attn_out
+        h = _norm(mlp_in, lp["ln2_scale"], lp.get("ln2_bias"), spec.norm,
+                  spec.eps)
+        if spec.n_experts:
+            mlp_out = moe_mlp_ragged(h, lp["router"], lp["we_gate"],
+                                     lp["we_up"], lp["we_down"],
+                                     spec.top_k)
+        elif "w_gate" in lp:
+            mlp_out = (jax.nn.silu(h @ lp["w_gate"]) *
+                       (h @ lp["w_up"])) @ lp["w_down"]
+        else:
+            hh = h @ lp["w_in"] + lp["b_in"]
+            mlp_out = _act(hh, spec.act) @ lp["w_out"] + lp["b_out"]
+        if spec.parallel_residual:
+            x = x + attn_out + mlp_out
+        else:
+            x = mlp_in + mlp_out
 
-    x = _rms(x, p["norm"]["weight"], cfg.rms_norm_eps)
-    last = x[logits_idx]  # [S, C] — logits only where needed
-    head = p["embed_tokens"] if cfg.tie_word_embeddings else p["lm_head"]
-    logits = last @ head.T
+    x = _norm(x, tree["final_scale"], tree.get("final_bias"), spec.norm,
+              spec.eps)
+    last = x[logits_idx]                            # [S, C]
+    logits = last @ tree["head"].T
     return logits.astype(jnp.float32), new_pools

@@ -27,7 +27,9 @@ class RaggedBatch:
     token_ids: np.ndarray      # [budget] int32, 0-padded
     token_seq: np.ndarray      # [budget] int32 slot index (max_seqs = pad)
     token_pos: np.ndarray      # [budget] int32 absolute position
+    token_qidx: np.ndarray     # [budget] int32 within-slot index
     seq_lens: np.ndarray       # [max_seqs] int32 kv length AFTER this step
+    q_counts: np.ndarray       # [max_seqs] int32 tokens this step
     block_tables: np.ndarray   # [max_seqs, max_blocks] int32
     logits_idx: np.ndarray     # [max_seqs] int32 packed index of last token
     seq_active: np.ndarray     # [max_seqs] bool
@@ -72,7 +74,9 @@ class RaggedBatchWrapper:
         token_ids = np.zeros((B,), np.int32)
         token_seq = np.full((B,), S, np.int32)  # S = padding slot
         token_pos = np.zeros((B,), np.int32)
+        token_qidx = np.zeros((B,), np.int32)
         seq_lens = np.zeros((S,), np.int32)
+        q_counts = np.zeros((S,), np.int32)
         tables = np.zeros((S, self.max_blocks_per_seq), np.int32)
         logits_idx = np.zeros((S,), np.int32)
         active = np.zeros((S,), bool)
@@ -85,7 +89,9 @@ class RaggedBatchWrapper:
             token_ids[cursor:cursor + n] = toks
             token_seq[cursor:cursor + n] = slot
             token_pos[cursor:cursor + n] = np.arange(start, start + n)
+            token_qidx[cursor:cursor + n] = np.arange(n)
             seq_lens[slot] = start + n
+            q_counts[slot] = n
             if len(seq.blocks) > self.max_blocks_per_seq:
                 raise SchedulingError(SchedulingResult.OutOfKVBlocks)
             tables[slot] = manager.block_table(seq, self.max_blocks_per_seq)
@@ -95,6 +101,7 @@ class RaggedBatchWrapper:
             cursor += n
 
         return RaggedBatch(token_ids=token_ids, token_seq=token_seq,
-                           token_pos=token_pos, seq_lens=seq_lens,
+                           token_pos=token_pos, token_qidx=token_qidx,
+                           seq_lens=seq_lens, q_counts=q_counts,
                            block_tables=tables, logits_idx=logits_idx,
                            seq_active=active, uids=uids)

@@ -1,0 +1,217 @@
+"""Mixtral model family in flax — sparse-MoE Llama geometry.
+
+TPU-native model zoo entry (reference: the Mixtral inference-v2
+implementation deepspeed/inference/v2/model_implementations/mixtral/
+model.py + moe kernels kernels/ragged_ops/{moe_scatter,moe_gather,
+top_k_gating} and cutlass_ops/moe_gemm).
+
+Architecture = Llama attention (GQA + RoPE + RMSNorm) with the MLP
+replaced by a top-k routed expert bank, HF ``MixtralForCausalLM`` weight
+layout (block_sparse_moe.gate + experts.{i}.w1/w2/w3). Expert weights
+are stored STACKED ``[E, ...]`` so the device sees one tensor per
+projection — the TPU-native grouped-GEMM layout (``jax.lax.ragged_dot``
+in the serving path, dense one-hot combine in this training module).
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.pallas_kernels import (apply_rotary_pos_emb, flash_attention,
+                                  rope_cos_sin)
+from ..parallel.mesh import EXPERT_AXIS, TENSOR_AXIS
+from .llama import RMSNorm, _dense
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    num_local_experts: int = 8
+    num_experts_per_tok: int = 2
+    max_position_embeddings: int = 32768
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 1e6
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    use_remat: bool = False
+    sliding_window: Optional[int] = None
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def mixtral_8x7b():
+        return MixtralConfig()
+
+    @staticmethod
+    def tiny():
+        return MixtralConfig(vocab_size=256, hidden_size=64,
+                             intermediate_size=96, num_hidden_layers=2,
+                             num_attention_heads=4, num_key_value_heads=2,
+                             num_local_experts=4, num_experts_per_tok=2,
+                             max_position_embeddings=128)
+
+
+def moe_route(logits, top_k):
+    """HF Mixtral routing: softmax over all experts, take top-k, renorm.
+
+    Returns (weights [B,k] fp32, expert indices [B,k] int32)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    w, idx = jax.lax.top_k(probs, top_k)
+    w = w / jnp.sum(w, axis=-1, keepdims=True)
+    return w, idx
+
+
+class MixtralSparseMoE(nn.Module):
+    """Dense-combine MoE block (training/tiny-model path; the serving
+    path uses the grouped-GEMM formulation in inference/v2/model.py)."""
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        B, T, C = x.shape
+        E, I = cfg.num_local_experts, cfg.intermediate_size
+        init = nn.initializers.normal(cfg.initializer_range)
+        router = self.param("gate", init, (C, E))
+        w1 = self.param("w1", init, (E, C, I))   # gate proj
+        w3 = self.param("w3", init, (E, C, I))   # up proj
+        w2 = self.param("w2", init, (E, I, C))   # down proj
+
+        xt = x.reshape(B * T, C)
+        weights, idx = moe_route(xt @ router, cfg.num_experts_per_tok)
+        # dense one-hot combine: every expert computes every token, the
+        # router mask selects — exact, XLA-fused, fine at zoo scale
+        g = jnp.einsum("tc,eci->eti", xt, w1)
+        u = jnp.einsum("tc,eci->eti", xt, w3)
+        h = jax.nn.silu(g) * u
+        o = jnp.einsum("eti,eic->etc", h, w2)    # [E, BT, C]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [BT, k, E]
+        combine = jnp.einsum("tk,tke->te", weights, onehot)
+        out = jnp.einsum("te,etc->tc", combine.astype(o.dtype), o)
+        return out.reshape(B, T, C)
+
+
+class MixtralDecoderLayer(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        nh, nkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                       cfg.head_dim)
+        B, T, C = x.shape
+        h = RMSNorm(eps=cfg.rms_norm_eps, name="input_layernorm")(x)
+        q = _dense(cfg, nh * hd, "q_proj")(h).reshape(B, T, nh, hd)
+        k = _dense(cfg, nkv * hd, "k_proj")(h).reshape(B, T, nkv, hd)
+        v = _dense(cfg, nkv * hd, "v_proj")(h).reshape(B, T, nkv, hd)
+        cos, sin = rope_cos_sin(positions, hd, theta=cfg.rope_theta)
+        q = apply_rotary_pos_emb(q, cos[:, :, None, :], sin[:, :, None, :])
+        k = apply_rotary_pos_emb(k, cos[:, :, None, :], sin[:, :, None, :])
+        y = flash_attention(q, k, v, causal=True).reshape(B, T, C)
+        x = x + _dense(cfg, C, "o_proj")(y)
+        h = RMSNorm(eps=cfg.rms_norm_eps,
+                    name="post_attention_layernorm")(x)
+        return x + MixtralSparseMoE(cfg, name="block_sparse_moe")(h)
+
+
+class MixtralForCausalLM(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None):
+        cfg = self.config
+        from .gpt2 import cross_entropy_loss
+        emb = self.param("embed_tokens",
+                         nn.initializers.normal(cfg.initializer_range),
+                         (cfg.vocab_size, cfg.hidden_size))
+        x = emb[input_ids]
+        positions = jnp.arange(input_ids.shape[1])[None, :]
+        layer = MixtralDecoderLayer
+        if cfg.use_remat:
+            layer = nn.remat(MixtralDecoderLayer)
+        for i in range(cfg.num_hidden_layers):
+            x = layer(cfg, name=f"layers_{i}")(x, positions)
+        x = RMSNorm(eps=cfg.rms_norm_eps, name="norm")(x)
+        if cfg.tie_word_embeddings:
+            head = emb
+        else:
+            head = self.param("lm_head",
+                              nn.initializers.normal(cfg.initializer_range),
+                              (cfg.vocab_size, cfg.hidden_size))
+        logits = x @ head.T
+        if labels is None:
+            return logits
+        return cross_entropy_loss(logits, labels), logits
+
+
+def mixtral_tensor_rules(name, shape):
+    """TP specs: attention like Llama; expert banks sharded over the
+    expert axis (EP) with TP on the intermediate dim."""
+    if any(name.endswith(f"{p}.kernel") for p in
+           ("q_proj", "k_proj", "v_proj")):
+        return P(None, TENSOR_AXIS)
+    if name.endswith("o_proj.kernel"):
+        return P(TENSOR_AXIS, None)
+    if name.endswith("w1") or name.endswith("w3"):
+        return P(EXPERT_AXIS, None, TENSOR_AXIS)
+    if name.endswith("w2"):
+        return P(EXPERT_AXIS, TENSOR_AXIS, None)
+    if name.endswith("gate"):
+        return P(None, None)
+    return None
+
+
+MixtralForCausalLM.tensor_sharding_rules = staticmethod(mixtral_tensor_rules)
+
+
+def from_hf_state_dict(state_dict, config: MixtralConfig):
+    """HF ``MixtralForCausalLM`` state dict -> this module's params
+    (experts stacked along a leading [E] axis)."""
+
+    def g(key, transpose=False):
+        v = state_dict[key]
+        if hasattr(v, "numpy"):
+            v = v.detach().cpu().numpy()
+        v = np.asarray(v)
+        return v.T if transpose else v
+
+    prefix = "model." if "model.embed_tokens.weight" in state_dict else ""
+    params = {"embed_tokens": g(f"{prefix}embed_tokens.weight"),
+              "norm": {"weight": g(f"{prefix}norm.weight")}}
+    if not config.tie_word_embeddings:
+        params["lm_head"] = g("lm_head.weight")
+    for i in range(config.num_hidden_layers):
+        lp = f"{prefix}layers.{i}."
+        moe = f"{lp}block_sparse_moe."
+        params[f"layers_{i}"] = {
+            "input_layernorm": {
+                "weight": g(f"{lp}input_layernorm.weight")},
+            "post_attention_layernorm": {
+                "weight": g(f"{lp}post_attention_layernorm.weight")},
+            "q_proj": {"kernel": g(f"{lp}self_attn.q_proj.weight", True)},
+            "k_proj": {"kernel": g(f"{lp}self_attn.k_proj.weight", True)},
+            "v_proj": {"kernel": g(f"{lp}self_attn.v_proj.weight", True)},
+            "o_proj": {"kernel": g(f"{lp}self_attn.o_proj.weight", True)},
+            "block_sparse_moe": {
+                "gate": g(f"{moe}gate.weight", True),
+                "w1": np.stack([g(f"{moe}experts.{e}.w1.weight", True)
+                                for e in range(config.num_local_experts)]),
+                "w3": np.stack([g(f"{moe}experts.{e}.w3.weight", True)
+                                for e in range(config.num_local_experts)]),
+                "w2": np.stack([g(f"{moe}experts.{e}.w2.weight", True)
+                                for e in range(config.num_local_experts)]),
+            },
+        }
+    return {"params": params}

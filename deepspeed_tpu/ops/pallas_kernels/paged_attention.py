@@ -1,0 +1,282 @@
+"""Paged attention — Pallas kernel over a blocked KV pool (FastGen hot op).
+
+TPU-native replacement for the reference's ragged attention kernel set
+(deepspeed/inference/v2/kernels/ragged_ops/blocked_flash/blocked_flash.py:15
+wrapping flash-attn's paged kernels, plus atom_builder/linear_blocked_kv_
+rotary). One kernel serves every Dynamic-SplitFuse batch shape: mixed
+prefill chunks and decode tokens, GQA, per-sequence lengths.
+
+Design (TPU-first):
+- The KV pool lives in HBM as ``[Hkv, (n_blocks+1)*block, D]`` and is
+  *viewed* ``[Hkv, n_blocks+1, block, D]`` by the kernel. The per-call
+  block table is scalar-prefetched, and the K/V BlockSpec index maps read
+  it — each grid step DMAs exactly the one pool block the sequence owns
+  (the classic TPU paged-attention formulation; no gather of
+  ``[budget, ctx]`` KV ever materializes in HBM).
+- Packed ragged queries are padded to per-sequence slots
+  ``[S, Hkv, Qmax, rep*D]`` outside the kernel (cheap: budget-sized).
+  Query absolute positions are derived in-kernel from the prefetched
+  ``seq_lens``/``q_counts`` — query row j of slot s sits at position
+  ``seq_lens[s] - q_counts[s] + j``, which makes causal masking exact
+  for prefill chunks, decode steps, and padding rows alike (padding
+  rows mask everything and produce 0).
+- Online softmax accumulates across KV blocks in VMEM scratch (fp32);
+  the output block is written once, on each (slot, head, q-tile)'s last
+  KV step.
+- Inactive tiles (query rows past q_counts, KV blocks past the sequence
+  length) skip compute via ``pl.when`` and clamp their index maps so no
+  fresh DMA is issued for them.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...utils.logging import logger
+
+_NEG_INF = float("-inf")
+
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_lens,
+                              q_counts, token_seq, token_qidx, *,
+                              block_size, sm_scale=None,
+                              alibi_slopes=None, window=0):
+    """XLA gather reference with identical semantics to the kernel.
+
+    q: [B, Hq, D] packed tokens; k_pool/v_pool: [Hkv, P, D] where
+    P = (n_blocks+1)*block_size; block_tables: [S, max_blocks];
+    seq_lens/q_counts: [S]; token_seq: [B] slot per token (S = padding);
+    token_qidx: [B] within-slot index; alibi_slopes: optional [Hq];
+    window: sliding-window size (0 = full causal). Returns [B, Hq, D].
+    """
+    B, nh, hd = q.shape
+    nkv = k_pool.shape[0]
+    rep = nh // nkv
+    S, max_blocks = block_tables.shape
+    ctx = max_blocks * block_size
+    if sm_scale is None:
+        sm_scale = 1.0 / (hd ** 0.5)
+
+    gather_idx = (block_tables * block_size)[:, :, None] + \
+        jnp.arange(block_size)
+    gather_idx = gather_idx.reshape(S, ctx)
+    slot = jnp.clip(token_seq, 0, S - 1)
+    K = k_pool[:, gather_idx]          # [Hkv, S, ctx, D]
+    V = v_pool[:, gather_idx]
+    Kt = K[:, slot]                    # [Hkv, B, ctx, D]
+    Vt = V[:, slot]
+    # query absolute position: seen + within-slot index
+    qpos = (seq_lens - q_counts)[slot] + token_qidx  # [B]
+
+    qg = q.reshape(B, nkv, rep, hd).astype(jnp.float32) * sm_scale
+    scores = jnp.einsum("bkrd,kbcd->bkrc", qg, Kt.astype(jnp.float32))
+    k_abs = jnp.arange(ctx)
+    if alibi_slopes is not None:
+        slopes = jnp.asarray(alibi_slopes,
+                             jnp.float32).reshape(nkv, rep)
+        dist = jnp.minimum(k_abs[None, :] - qpos[:, None], 0)  # [B, ctx]
+        scores = scores + slopes[None, :, :, None] * \
+            dist[:, None, None, :].astype(jnp.float32)
+    mask = k_abs[None, :] <= qpos[:, None]
+    mask &= k_abs[None, :] < seq_lens[slot][:, None]
+    if window:
+        mask &= k_abs[None, :] > qpos[:, None] - window
+    mask &= (token_seq < S)[:, None]
+    scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
+    any_valid = mask.any(axis=-1)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(any_valid[:, None, None, None], probs, 0.0)
+    out = jnp.einsum("bkrc,kbcd->bkrd", probs.astype(Vt.dtype), Vt)
+    return out.reshape(B, nh, hd).astype(q.dtype)
+
+
+def _paged_kernel(tables_ref, slens_ref, qcnt_ref, q_ref, k_ref, v_ref,
+                  *rest, sm_scale, block_size, rep, q_block, alibi,
+                  window):
+    if alibi:
+        slopes_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+    s = pl.program_id(0)
+    qi = pl.program_id(2)
+    bi = pl.program_id(3)
+    n_bi = pl.num_programs(3)
+    bs = block_size
+    hd = k_ref.shape[3]
+    rows = q_block * rep
+
+    @pl.when(bi == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    slen = slens_ref[s]
+    qcnt = qcnt_ref[s]
+    needed = (slen + bs - 1) // bs
+    active = jnp.logical_and(qi * q_block < qcnt, bi < needed)
+
+    @pl.when(active)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32).reshape(rows, hd) * sm_scale
+        k_blk = k_ref[0, 0].astype(jnp.float32)   # [bs, D]
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        x = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        # row r -> query index j = qi*q_block + r//rep, abs pos start+j
+        j = qi * q_block + \
+            jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 0) // rep
+        qpos = (slen - qcnt) + j
+        kpos = bi * bs + \
+            jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
+        if alibi:
+            # per-q-head slope, rows interleaved (q_idx, rep)
+            r_of_row = jax.lax.broadcasted_iota(
+                jnp.int32, (rows, 1), 0) % rep
+            slope = slopes_ref[0, 0][r_of_row[:, 0]][:, None]
+            x = x + slope * jnp.minimum(kpos - qpos, 0).astype(
+                jnp.float32)
+        mask = (kpos <= qpos) & (kpos < slen) & (j < qcnt)
+        if window:
+            mask &= kpos > qpos - window
+        x = jnp.where(mask, x, _NEG_INF)
+
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.max(x, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(x - shift[:, None])
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev, _NEG_INF)
+                        - shift)
+        l_ref[:, 0] = alpha * l_prev + jnp.sum(p, axis=1)
+        m_ref[:, 0] = m_new
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(bi == n_bi - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        out = acc_ref[...] / l_safe[:, None]
+        o_ref[0, 0] = out.reshape(q_block, rep * hd).astype(o_ref.dtype)
+
+
+def _paged_call(q4, kp4, vp4, tables, slens, qcnts, *, sm_scale,
+                block_size, rep, q_block, interpret, slopes=None,
+                window=0):
+    Sp1, nkv, Qmax, rephd = q4.shape
+    S = tables.shape[0]
+    hd = rephd // rep
+    max_blocks = tables.shape[1]
+    n_qt = Qmax // q_block
+    grid = (S, nkv, n_qt, max_blocks)
+
+    def kv_map(s, h, qi, bi, tables_ref, slens_ref, qcnt_ref):
+        bs = block_size
+        needed = (slens_ref[s] + bs - 1) // bs
+        # clamp inactive steps onto the previous block so no new DMA is
+        # issued for them (same index -> Pallas skips the copy)
+        b_eff = jnp.clip(bi, 0, jnp.maximum(needed - 1, 0))
+        return (h, tables_ref[s, b_eff], 0, 0)
+
+    kernel = functools.partial(_paged_kernel, sm_scale=sm_scale,
+                               block_size=block_size, rep=rep,
+                               q_block=q_block,
+                               alibi=slopes is not None,
+                               window=window)
+    in_specs = [
+        pl.BlockSpec((1, 1, q_block, rephd),
+                     lambda s, h, qi, bi, *_: (s, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_size, hd), kv_map),
+        pl.BlockSpec((1, 1, block_size, hd), kv_map),
+    ]
+    inputs = [tables, slens, qcnts, q4[:S], kp4, vp4]
+    if slopes is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, rep), lambda s, h, qi, bi, *_: (h, 0, 0)))
+        inputs.append(jnp.asarray(slopes, jnp.float32).reshape(
+            nkv, 1, rep))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, 1, q_block, rephd),
+                                   lambda s, h, qi, bi, *_: (s, h, qi, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((q_block * rep, hd), jnp.float32),
+                pltpu.VMEM((q_block * rep, 1), jnp.float32),
+                pltpu.VMEM((q_block * rep, 1), jnp.float32),
+            ]),
+        out_shape=jax.ShapeDtypeStruct((S, nkv, Qmax, rephd), q4.dtype),
+        interpret=interpret,
+    )(*inputs)
+    return out
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, seq_lens, q_counts,
+                    token_seq, token_qidx, *, block_size, sm_scale=None,
+                    alibi_slopes=None, window=0, q_block=128,
+                    force_pallas=False, interpret=False):
+    """Attention of packed ragged tokens over a paged KV pool.
+
+    q: [B, Hq, D] packed; k_pool/v_pool: [Hkv, (n_blocks+1)*block, D];
+    block_tables [S, max_blocks]; seq_lens/q_counts [S]; token_seq [B]
+    (S = padding slot); token_qidx [B] within-slot index;
+    alibi_slopes: optional [Hq] additive-bias slopes (BLOOM);
+    window: sliding-window size, 0 = full causal. -> [B, Hq, D].
+    """
+    B, nh, hd = q.shape
+    nkv = k_pool.shape[0]
+    rep = nh // nkv
+    S = block_tables.shape[0]
+    if sm_scale is None:
+        sm_scale = 1.0 / (hd ** 0.5)
+
+    q_block = int(min(q_block, max(B, 1)))
+    tileable = (hd % 64 == 0 and block_size % 128 == 0
+                and (rep * hd) % 128 == 0 and q_block % 8 == 0)
+    use_pallas = force_pallas or interpret or \
+        (tileable and jax.default_backend() == "tpu")
+    if not use_pallas:
+        if jax.default_backend() == "tpu" and not tileable:
+            logger.warning(
+                f"paged_attention falling back to the XLA gather path on "
+                f"TPU: shape not tileable (D={hd}, rep={rep}, "
+                f"block_size={block_size}, q_block={q_block}); the "
+                f"[budget, ctx] KV gather will materialize in HBM")
+        return paged_attention_reference(
+            q, k_pool, v_pool, block_tables, seq_lens, q_counts,
+            token_seq, token_qidx, block_size=block_size,
+            sm_scale=sm_scale, alibi_slopes=alibi_slopes, window=window)
+    if not tileable and not interpret:
+        raise ValueError(
+            f"paged_attention kernel cannot tile D={hd}, rep={rep}, "
+            f"block_size={block_size}, q_block={q_block}")
+
+    Qmax = -(-B // q_block) * q_block
+    n_blocks_p1 = k_pool.shape[1] // block_size
+    kp4 = k_pool.reshape(nkv, n_blocks_p1, block_size, hd)
+    vp4 = v_pool.reshape(nkv, n_blocks_p1, block_size, hd)
+
+    # pad packed -> per-slot slots (extra slot S absorbs padding tokens)
+    q4 = jnp.zeros((S + 1, nkv, Qmax, rep * hd), q.dtype)
+    q4 = q4.at[token_seq, :, token_qidx].set(
+        q.reshape(B, nkv, rep * hd))
+    out4 = _paged_call(q4, kp4, vp4, block_tables, seq_lens, q_counts,
+                       sm_scale=float(sm_scale),
+                       block_size=int(block_size), rep=rep,
+                       q_block=q_block, interpret=bool(interpret),
+                       slopes=alibi_slopes, window=int(window))
+    # gather with clipped slots and zero the padding rows — a select
+    # instead of concatenating a zero slab onto the whole output
+    out = out4[jnp.clip(token_seq, 0, S - 1), :, token_qidx]
+    out = jnp.where((token_seq < S)[:, None, None], out, 0)
+    return out.reshape(B, nh, hd)

@@ -203,12 +203,8 @@ class TestEngineV2TP:
         mesh_manager.reset()
         mesh_manager.init(MeshConfig(data=-1, tensor=2))
         v2 = _engine(cfg, params, tp_size=2)
-        # params actually sharded on the tensor axis
-        import jax
-        from deepspeed_tpu.utils.tree import flatten_with_names
-        names, leaves, _ = flatten_with_names(v2.params)
-        qk = dict(zip(names, leaves))[
-            "params.layers_0.self_attn.q_proj.kernel"]
+        # normalized tree actually sharded on the tensor axis
+        qk = v2.tree["layers"][0]["wq"]
         assert TENSOR_AXIS in tuple(qk.sharding.spec)
         # KV pools sharded on the kv-head dim
         kp = v2.pools[0][0]

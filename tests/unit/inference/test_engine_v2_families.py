@@ -1,0 +1,150 @@
+"""Multi-family ragged-engine parity: every registered family serves
+through InferenceEngineV2 and matches a dense no-cache greedy decode.
+
+Reference shape: deepspeed/inference/v2/model_implementations/* — the
+FastGen engine runs llama/mistral/mixtral/opt/qwen/falcon/phi; here the
+spec-driven ragged forward covers the shipped zoo families + Mixtral
+MoE via grouped-GEMM routing.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import InferenceEngineV2
+from deepspeed_tpu.inference.v2.engine_v2 import RaggedInferenceEngineConfig
+
+
+def _v2(params, cfg, **over):
+    kw = dict(token_budget=32, max_ragged_sequence_count=4, n_kv_blocks=32,
+              kv_block_size=8, max_blocks_per_seq=8, kv_dtype="float32")
+    kw.update(over)
+    return InferenceEngineV2(params, cfg, RaggedInferenceEngineConfig(**kw))
+
+
+def _dense_greedy(model, params, prompt, n_new):
+    """Teacher-forced greedy decode recomputing the full sequence each
+    step with the plain flax module (no KV cache) — the ground truth the
+    paged incremental path must reproduce."""
+    toks = list(prompt)
+    for _ in range(n_new):
+        logits = model.apply(params, np.asarray([toks], np.int32))
+        toks.append(int(np.argmax(np.asarray(logits)[0, -1])))
+    return toks[len(prompt):]
+
+
+def _check_family(model, params, cfg, prompts=None, n_new=5):
+    prompts = prompts or {1: [3, 1, 4, 1, 5], 2: [2, 7, 1]}
+    engine = _v2(params, cfg)
+    out = engine.generate_batch(prompts, max_new_tokens=n_new)
+    for uid, prompt in prompts.items():
+        ref = _dense_greedy(model, params, prompt, n_new)
+        assert out[uid] == ref, (uid, out[uid], ref)
+
+
+@pytest.fixture(autouse=True)
+def _data_mesh():
+    from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
+    mesh_manager.reset()
+    mesh_manager.init(MeshConfig(data=-1))
+    yield
+
+
+def _init(model, vocab=256):
+    return model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+
+
+def test_gptneox_family():
+    from deepspeed_tpu.models.gptneox import (GPTNeoXConfig,
+                                              GPTNeoXForCausalLM)
+    cfg = GPTNeoXConfig.tiny()   # parallel residual + partial rotary
+    model = GPTNeoXForCausalLM(cfg)
+    _check_family(model, _init(model), cfg)
+
+
+def test_gptneox_sequential_residual():
+    from deepspeed_tpu.models.gptneox import (GPTNeoXConfig,
+                                              GPTNeoXForCausalLM)
+    cfg = dataclasses.replace(GPTNeoXConfig.tiny(),
+                              use_parallel_residual=False)
+    model = GPTNeoXForCausalLM(cfg)
+    _check_family(model, _init(model), cfg)
+
+
+def test_opt_family():
+    from deepspeed_tpu.models.opt import OPTConfig, OPTForCausalLM
+    cfg = OPTConfig.tiny()       # learned positions (+2), relu FFN
+    model = OPTForCausalLM(cfg)
+    _check_family(model, _init(model), cfg)
+
+
+def test_gpt2_family():
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    cfg = GPT2Config.tiny()      # fused c_attn thirds, wpe, tied head
+    model = GPT2LMHeadModel(cfg)
+    _check_family(model, _init(model), cfg)
+
+
+def test_bloom_family():
+    from deepspeed_tpu.models.bloom import BloomConfig, BloomForCausalLM
+    cfg = BloomConfig.tiny()     # ALiBi + embedding LayerNorm
+    model = BloomForCausalLM(cfg)
+    _check_family(model, _init(model), cfg)
+
+
+def test_mistral_sliding_window():
+    from deepspeed_tpu.models.mistral import (MistralConfig,
+                                              MistralForCausalLM)
+    cfg = MistralConfig.tiny()   # sliding_window=16
+    model = MistralForCausalLM(cfg)
+    # long enough that the window actually clips context during decode
+    prompts = {1: list(np.random.default_rng(0).integers(0, 256, 24))}
+    engine = _v2(model.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 8), np.int32)), cfg,
+                 token_budget=64)
+    out = engine.generate_batch(prompts, max_new_tokens=4)
+    # dense reference: the flax module masks the window itself when the
+    # sequence exceeds it
+    ref = _dense_greedy(model, model.init(jax.random.PRNGKey(0),
+                                          np.zeros((1, 8), np.int32)),
+                        prompts[1], 4)
+    assert out[1] == ref
+
+
+def test_mixtral_moe_family():
+    from deepspeed_tpu.models.mixtral import (MixtralConfig,
+                                              MixtralForCausalLM)
+    cfg = MixtralConfig.tiny()   # 4 experts, top-2 routing
+    model = MixtralForCausalLM(cfg)
+    _check_family(model, _init(model), cfg)
+
+
+def test_mixtral_moe_routing_is_sparse():
+    """The ragged MoE path must agree with the dense one-hot combine —
+    same routing, grouped GEMM instead of all-experts compute."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.v2.model import moe_mlp_ragged
+    from deepspeed_tpu.models.mixtral import moe_route
+
+    rng = np.random.default_rng(0)
+    B, C, I, E, k = 12, 16, 24, 4, 2
+    x = jnp.asarray(rng.normal(size=(B, C)), jnp.float32)
+    router = jnp.asarray(rng.normal(size=(C, E)), jnp.float32)
+    w1 = jnp.asarray(rng.normal(size=(E, C, I)), jnp.float32)
+    w3 = jnp.asarray(rng.normal(size=(E, C, I)), jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(E, I, C)), jnp.float32)
+
+    out = moe_mlp_ragged(x, router, w1, w3, w2, k)
+
+    w, idx = moe_route(x @ router, k)
+    g = jnp.einsum("tc,eci->eti", x, w1)
+    u = jnp.einsum("tc,eci->eti", x, w3)
+    h = jax.nn.silu(g) * u
+    o = jnp.einsum("eti,eic->etc", h, w2)
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+    combine = jnp.einsum("tk,tke->te", w, onehot)
+    expect = jnp.einsum("te,etc->tc", combine, o)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=1e-4, atol=1e-4)
