@@ -44,32 +44,44 @@ def _block_dequantize(q, scale, n, dtype) -> jnp.ndarray:
     return g.reshape(-1)[:n].astype(dtype)
 
 
-def quantized_all_gather(x, axis_name: str, block: int = BLOCK):
+def quantized_all_gather(x, axis_name: str, block: int = BLOCK,
+                         dim: int = 0):
     """qwZ analog: all-gather with int8 payload (half the bf16 volume).
 
-    Per-shard ``x`` of shape [s, ...] -> gathered [world*s, ...].
-    Call inside shard_map over ``axis_name``."""
+    Per-shard ``x`` of shape [..., s, ...] -> gathered with ``dim``
+    expanded ``world``-fold. Call inside shard_map over ``axis_name``.
+    Dequantization is one vectorized [W, nb, block] multiply — no
+    per-shard host loop (an unrolled O(W) graph is hostile at 256
+    shards)."""
+    if dim:
+        x = jnp.swapaxes(x, 0, dim)
     shape = x.shape
     q, scale = _block_quantize(x, block)
     qg = jax.lax.all_gather(q, axis_name)       # [W, nb, block] int8
     sg = jax.lax.all_gather(scale, axis_name)   # [W, nb]
     world = qg.shape[0]
     n = np_prod(shape)
-    parts = [
-        _block_dequantize(qg[w], sg[w], n, x.dtype).reshape(shape)
-        for w in range(world)
-    ]
-    return jnp.concatenate(parts, axis=0)
+    deq = qg.astype(jnp.float32) * sg[..., None]          # [W, nb, blk]
+    out = deq.reshape(world, -1)[:, :n].astype(x.dtype)
+    out = out.reshape((world * shape[0],) + shape[1:])
+    if dim:
+        out = jnp.swapaxes(out, 0, dim)
+    return out
 
 
-def quantized_psum_scatter(x, axis_name: str, block: int = BLOCK):
+def quantized_psum_scatter(x, axis_name: str, block: int = BLOCK,
+                           dim: int = 0):
     """qgZ analog: reduce-scatter with int8 payload.
 
     Two-step like the reference (quantize -> all-to-all -> local
     reduce): each shard quantizes its contribution to every output
     partition, exchanges int8 over the wire, dequantizes and reduces
     locally. x: [W*s, ...] per shard -> returns this shard's [s, ...]
-    sum."""
+    sum. ``dim`` selects which axis is scattered."""
+    if dim:
+        x = jnp.swapaxes(x, 0, dim)
+        out = quantized_psum_scatter(x, axis_name, block)
+        return jnp.swapaxes(out, 0, dim)
     world = jax.lax.axis_size(axis_name)
     s = x.shape[0] // world
     n = np_prod((s,) + x.shape[1:])       # elements per partition

@@ -62,10 +62,14 @@ class InferenceEngineV2:
         if ec.tp_size > 1:
             self._apply_tp_sharding(ec.tp_size)
         spec = self.spec
+        tp_axis = None
+        if ec.tp_size > 1 and self.spec.n_kv_heads % ec.tp_size == 0:
+            from ...parallel.mesh import TENSOR_AXIS
+            tp_axis = TENSOR_AXIS
         self._jit_forward = jax.jit(
             lambda tree, pools, *args: ragged_forward(
                 tree, spec, pools, *args,
-                block_size=ec.kv_block_size),
+                block_size=ec.kv_block_size, tp_axis=tp_axis),
             donate_argnums=(1,))
 
     def _apply_tp_sharding(self, tp: int):

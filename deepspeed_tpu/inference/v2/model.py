@@ -375,12 +375,18 @@ def moe_mlp_ragged(x, router, we_gate, we_up, we_down, top_k):
 def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
                    token_pos, token_qidx, seq_lens, q_counts,
                    block_tables, logits_idx, block_size: int,
-                   interpret: bool = False):
+                   interpret: bool = False, tp_axis: Optional[str] = None):
     """One ragged forward over the paged KV pools.
 
     token_* arrays: [budget]; seq_lens/q_counts/logits_idx: [S];
     block_tables: [S, max_blocks]. Returns (logits [S, vocab],
     new_pools).
+
+    ``tp_axis``: mesh axis the kv-head dim is sharded over. pallas_call
+    cannot be auto-partitioned by GSPMD, so with TP the attention runs
+    inside shard_map over that axis — each shard computes its local
+    heads against its local slice of the KV pool (the reference's
+    per-rank sharded blocked_flash, v2/model_implementations/sharding/).
     """
     S = block_tables.shape[0]
     bs = block_size
@@ -401,6 +407,44 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
                                 theta=spec.rope_theta)
         cos, sin = cos[0], sin[0]                   # [B, rot/2]
     slopes = _alibi_slopes(nh) if spec.pos == "alibi" else None
+
+    def attend(q, k_pool, v_pool, slopes_arr):
+        return paged_attention(
+            q, k_pool, v_pool, block_tables, seq_lens, q_counts,
+            token_seq, token_qidx, block_size=bs,
+            alibi_slopes=slopes_arr, window=spec.window,
+            interpret=interpret)
+
+    if tp_axis is not None:
+        # head-sharded attention under shard_map (see docstring)
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as TPSpec
+        from ...parallel.mesh import mesh_manager
+
+        def attend(q, k_pool, v_pool, slopes_arr,  # noqa: F811
+                   _mesh=mesh_manager.mesh):
+            have_slopes = slopes_arr is not None
+            rep_spec = TPSpec()
+            in_specs = (TPSpec(None, tp_axis, None),
+                        TPSpec(tp_axis, None, None),
+                        TPSpec(tp_axis, None, None),
+                        rep_spec, rep_spec, rep_spec, rep_spec, rep_spec)
+            if have_slopes:
+                in_specs += (TPSpec(tp_axis),)
+
+            def local(q_l, kp_l, vp_l, bt, sl, qc, ts, tq, *s_l):
+                return paged_attention(
+                    q_l, kp_l, vp_l, bt, sl, qc, ts, tq, block_size=bs,
+                    alibi_slopes=s_l[0] if s_l else None,
+                    window=spec.window, interpret=interpret)
+
+            args = (q, k_pool, v_pool, block_tables, seq_lens, q_counts,
+                    token_seq, token_qidx)
+            if have_slopes:
+                args += (jnp.asarray(slopes_arr, jnp.float32),)
+            return shard_map(local, mesh=_mesh, in_specs=in_specs,
+                             out_specs=TPSpec(None, tp_axis, None),
+                             check_vma=False)(*args)
 
     # scratch-block routing for padding tokens (token_seq == S)
     pad_tables = jnp.concatenate(
@@ -439,11 +483,7 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
             v.transpose(1, 0, 2).astype(v_pool.dtype))
         new_pools.append((k_pool, v_pool))
 
-        attn = paged_attention(
-            q, k_pool, v_pool, block_tables, seq_lens, q_counts,
-            token_seq, token_qidx, block_size=bs,
-            alibi_slopes=slopes, window=spec.window,
-            interpret=interpret)
+        attn = attend(q, k_pool, v_pool, slopes)
         attn = attn.reshape(B, nh * hd).astype(x.dtype)
         attn_out = attn @ lp["wo"]
         if lp.get("bo") is not None:

@@ -240,7 +240,9 @@ def paged_attention(q, k_pool, v_pool, block_tables, seq_lens, q_counts,
     if sm_scale is None:
         sm_scale = 1.0 / (hd ** 0.5)
 
-    q_block = int(min(q_block, max(B, 1)))
+    # clamp to the token budget but keep a tile-aligned block (Qmax pads
+    # B up to a q_block multiple anyway, so rounding up stays valid)
+    q_block = int(min(q_block, -(-max(B, 1) // 8) * 8))
     tileable = (hd % 64 == 0 and block_size % 128 == 0
                 and (rep * hd) % 128 == 0 and q_block % 8 == 0)
     use_pallas = force_pallas or interpret or \

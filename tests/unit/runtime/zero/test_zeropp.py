@@ -1,0 +1,120 @@
+"""ZeRO++ end-to-end: qwZ/qgZ consumed by the compiled train step.
+
+Reference: deepspeed/runtime/zero/partition_parameters.py:989 (quantized
+weight all-gather), runtime/comm/coalesced_collectives.py (qgZ quantized
+reduce), docs/_tutorials/zeropp.md (qwZ halves all-gather volume; qgZ
+int8 all-to-all gradient reduction).
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
+
+
+def _train(stage, mesh_cfg, steps=6, **zero_extra):
+    mesh_manager.reset()
+    mesh_manager.init(mesh_cfg)
+    cfg = GPT2Config.tiny()
+    model = GPT2LMHeadModel(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage, **zero_extra},
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    gb = engine.train_batch_size()
+    rng = np.random.default_rng(0)
+    # fixed batch: overfitting gives a strong, comparable loss trajectory
+    ids = rng.integers(0, cfg.vocab_size, size=(gb, 16), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    losses = [float(engine.train_batch(batch=batch))
+              for _ in range(steps)]
+    return engine, losses
+
+
+def _lowered_text(engine):
+    """Optimized (post-SPMD-partitioning) HLO of the compiled train step
+    — the text where collective ops and their payload dtypes appear."""
+    import jax
+    gb = engine.train_batch_size()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, size=(gb, 16), dtype=np.int32)
+    b = engine._split_microbatches({"input_ids": ids, "labels": ids})
+    b = engine._shard_batch(b, leading_gas=True)
+    return engine._jit_train_step.lower(
+        engine.state, b, jax.random.PRNGKey(0)).compile().as_text()
+
+
+class TestZeroPlusPlus:
+
+    def test_qgz_loss_parity_stage2(self, eight_devices):
+        """dp2 x fsdp4 ZeRO-2: int8 grad reduce-scatter tracks the
+        uncompressed run within int8 tolerance, loss still falls."""
+        mesh = MeshConfig(data=2, fsdp=4)
+        _, base = _train(2, mesh)
+        _, qgz = _train(2, mesh, zero_quantized_gradients=True)
+        assert qgz[-1] < qgz[0], qgz          # still learning
+        for a, b in zip(base, qgz):
+            assert abs(a - b) / abs(a) < 0.05, (base, qgz)
+
+    def test_qwz_loss_parity_stage3(self, eight_devices):
+        """fsdp8 ZeRO-3: int8 param all-gather tracks the uncompressed
+        run within int8 tolerance."""
+        mesh = MeshConfig(data=1, fsdp=8)
+        _, base = _train(3, mesh, stage3_param_persistence_threshold=0)
+        _, qwz = _train(3, mesh, zero_quantized_weights=True,
+                        stage3_param_persistence_threshold=0)
+        assert qwz[-1] < qwz[0], qwz
+        for a, b in zip(base, qwz):
+            assert abs(a - b) / abs(a) < 0.05, (base, qwz)
+
+    def test_qwz_qgz_compose(self, eight_devices):
+        """qwZ (stage 3) is ignored-with-warning at stage 2 and qgZ at
+        stage 3 — but each works in its regime; stage-2 run with both
+        knobs on still trains (qgZ active, qwZ warned off)."""
+        mesh = MeshConfig(data=2, fsdp=4)
+        _, losses = _train(2, mesh, zero_quantized_gradients=True,
+                           zero_quantized_weights=True)
+        assert losses[-1] < losses[0]
+
+    def test_qwz_changes_collective_payload_in_hlo(self, eight_devices):
+        """The compiled HLO must actually move int8 over the wire for
+        the param gather when qwZ is on, and no s8 collectives when
+        off (the byte-volume assertion from the reference's 'qwZ halves
+        all-gather volume' claim)."""
+        mesh = MeshConfig(data=1, fsdp=8)
+        eng_off, _ = _train(3, mesh, steps=1,
+                            stage3_param_persistence_threshold=0)
+        eng_on, _ = _train(3, mesh, steps=1, zero_quantized_weights=True,
+                           stage3_param_persistence_threshold=0)
+        txt_off = _lowered_text(eng_off)
+        txt_on = _lowered_text(eng_on)
+
+        def s8_collectives(txt):
+            return [l for l in txt.splitlines()
+                    if ("all-gather" in l or "all_gather" in l)
+                    and "s8[" in l]
+
+        assert s8_collectives(txt_on), "qwZ HLO has no int8 all-gather"
+        assert not s8_collectives(txt_off)
+
+    def test_qgz_changes_collective_payload_in_hlo(self, eight_devices):
+        mesh = MeshConfig(data=2, fsdp=4)
+        eng_off, _ = _train(2, mesh, steps=1)
+        eng_on, _ = _train(2, mesh, steps=1,
+                           zero_quantized_gradients=True)
+        txt_off = _lowered_text(eng_off)
+        txt_on = _lowered_text(eng_on)
+
+        def s8_a2a(txt):
+            return [l for l in txt.splitlines()
+                    if ("all-to-all" in l or "all_to_all" in l)
+                    and "s8[" in l]
+
+        assert s8_a2a(txt_on), "qgZ HLO has no int8 all-to-all"
+        assert not s8_a2a(txt_off)
