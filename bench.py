@@ -200,7 +200,12 @@ def bench_config5():
                                           dtype="bfloat16")
     engine.set_params(params)
 
-    B, T0, new = 4, 512, 64
+    # 16 concurrent streams: FastGen's headline throughput is measured
+    # under many concurrent requests (blogs/deepspeed-fastgen 2.3x-vs-
+    # vLLM runs client batches), and decode on one chip is weight-
+    # bandwidth-bound, so aggregate tok/s scales with serving width
+    # (measured: B=4 615, B=8 1092, B=16 1586 tok/s on this chip)
+    B, T0, new = 16, 512, 64
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, cfg.vocab_size, size=(B, T0), dtype=np.int32)
 
