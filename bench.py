@@ -159,12 +159,18 @@ def bench_config4():
                      n_layer=12, n_head=12, dropout=0.0, use_flash=True)
     config = {
         "train_micro_batch_size_per_gpu": 16,
-        "gradient_accumulation_steps": 8,
+        # deep accumulation is the canonical offload workload shape: one
+        # host round trip (grads down + params up) per optimizer step,
+        # amortized over 64 microbatches
+        "gradient_accumulation_steps": 64,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
         "zero_optimization": {
             "stage": 2,
-            "offload_optimizer": {"device": "cpu"},
+            # delayed_update (ZeRO-Offload DPU): grad download + host
+            # SIMD Adam + param upload overlap the next device step
+            "offload_optimizer": {"device": "cpu",
+                                  "delayed_update": True},
         },
         "gradient_clipping": 1.0,
         "steps_per_print": 0,

@@ -238,6 +238,7 @@ class DeepSpeedEngine:
         self._accum_grads = None
         self._accum_count = 0
         self._last_loss = None
+        self._offload_future = None  # in-flight DPU host update
 
         log_dist(
             f"DeepSpeedEngine: zero_stage={self.zero_stage} dtype={self.compute_dtype.__name__} "
@@ -803,8 +804,17 @@ class DeepSpeedEngine:
                 # export the offloaded leaves' (unscaled, clipped) grads
                 # for the host Adam; their device "updates" (passed
                 # through optax.masked unchanged) must not touch params.
+                # bf16 on the wire (the reference streams bit16 grads to
+                # the CPU optimizer too, stage_1_and_2.py cpu-offload
+                # path). bf16 only: it shares fp32's exponent range, so
+                # a grad finite in fp32 stays finite — an fp16 cast
+                # could manufacture inf AFTER the overflow check and
+                # poison the host master with no skip.
                 gflat, gdef = jax.tree_util.tree_flatten(grads)
-                off_grads = tuple(g for g, m in zip(gflat, off_mask) if m)
+                off_grads = tuple(
+                    g.astype(jnp.bfloat16)
+                    if compute_dtype == jnp.bfloat16 else g
+                    for g, m in zip(gflat, off_mask) if m)
                 uflat = jax.tree_util.tree_flatten(updates)[0]
                 uflat = [jnp.zeros_like(u) if m else u
                          for u, m in zip(uflat, off_mask)]
@@ -900,15 +910,25 @@ class DeepSpeedEngine:
             self.state, device_batch, self._next_rng())
         self._swap_state_out()
         if self._offload is not None:
-            skip = bool(metrics["overflow"]) if self.fp16_enabled else False
+            skip = metrics["overflow"] if self.fp16_enabled else False
             # scheduler value when one exists; otherwise None -> the host
             # Adam's own lr (config params / 1e-3 default, matching the
             # device build_optimizer default — get_lr()'s 0.0 fallback
             # would silently freeze offloaded leaves)
             lr = self.get_lr()[0] if self.lr_scheduler is not None else None
-            new_master = self._offload.apply_grads(
-                self.state.master_params, off_grads, lr=lr, skip=skip)
-            self.state = self.state._replace(master_params=new_master)
+            if self._offload_cfg.delayed_update:
+                # DPU: merge LAST step's host update (its download/Adam/
+                # upload overlapped this step's device compute), then
+                # hand this step's grads to the background thread. The
+                # jitted step dispatch above is async, so submitting
+                # before any metric read keeps the pipeline full.
+                self._merge_offload_future()
+                self._offload_future = self._offload.apply_grads_async(
+                    self.state.master_params, off_grads, lr=lr, skip=skip)
+            else:
+                new_master = self._offload.apply_grads(
+                    self.state.master_params, off_grads, lr=lr, skip=skip)
+                self.state = self.state._replace(master_params=new_master)
         self.timers(TRAIN_BATCH_TIMER).stop(sync=True)
         self.tput_timer.stop(global_step=True)
 
@@ -969,6 +989,7 @@ class DeepSpeedEngine:
             return ""
 
     def eval_batch(self, data_iter=None, batch=None, compute_loss=True):
+        self._merge_offload_future()  # eval must see the last host update
         if batch is None:
             it = data_iter if data_iter is not None else self.data_iterator
             if it is None:
@@ -986,8 +1007,19 @@ class DeepSpeedEngine:
         return loss
 
     # -- eager triple: forward / backward / step (host-driven accumulation)
+    def _merge_offload_future(self):
+        """Join a pending delayed-update host step and graft its leaves
+        into the current state (no-op when nothing is in flight)."""
+        if self._offload_future is not None:
+            leaves = self._offload_future.result()
+            self._offload_future = None
+            self.state = self.state._replace(
+                master_params=self._offload.merge(
+                    self.state.master_params, leaves))
+
     def forward(self, batch):
         """Compute the model output/loss (reference: engine.py:1824)."""
+        self._merge_offload_future()
         batch = self._cast_batch(batch)
         if not self._params_initialized:
             self.init_params(batch)
@@ -1153,6 +1185,10 @@ class DeepSpeedEngine:
     def get_params(self, dtype=None):
         """Gather full (replicated) params — the zero_to_fp32 analog
         (reference: utils/zero_to_fp32.py)."""
+        # join any in-flight DPU host step: host_adam.master mutates in
+        # place on the worker thread; reading it mid-update would export
+        # torn weights
+        self._merge_offload_future()
         master = self.state.master_params
         if self._offload is not None:
             # offloaded leaves live on device only in compute dtype; the
@@ -1186,6 +1222,7 @@ class DeepSpeedEngine:
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
+        self._merge_offload_future()  # flush in-flight DPU host update
         tag = tag or f"global_step{self.global_steps}"
         client_state = dict(client_state or {})
         client_state.update({
@@ -1218,6 +1255,7 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False):
+        self._merge_offload_future()
         if self.state is None:
             raise ValueError("initialize params before load_checkpoint "
                              "(pass model_parameters or run a batch)")

@@ -51,6 +51,10 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     pipeline_write: bool = False   # [compat]
     fast_init: bool = False        # [compat]
     ratio: float = 1.0             # ZeRO-Offload++ partial-offload ratio
+    # one-step delayed parameter update: the host Adam + param re-upload
+    # of step N overlaps the device compute of step N+1 (the DPU scheme
+    # of the ZeRO-Offload paper); offloaded leaves are one step stale
+    delayed_update: bool = False
 
 
 @dataclasses.dataclass

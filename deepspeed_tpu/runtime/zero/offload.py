@@ -16,6 +16,7 @@ them on host and pushes bf16/fp16 views back via device_put. The
 largest leaves until ``ratio`` of total elements are host-resident.
 """
 
+import concurrent.futures
 from typing import Any, List, Optional
 
 import jax
@@ -78,24 +79,67 @@ class OffloadCoordinator:
             flat[i] = jnp.asarray(flat[i], dtype=self.compute_dtype)
         return jax.tree_util.tree_unflatten(treedef, flat)
 
-    def apply_grads(self, state_master, off_grads, lr: Optional[float],
-                    skip: bool = False):
-        """Host Adam on the offloaded grads; returns the master tree with
-        refreshed compute-dtype leaves. ``skip`` mirrors the fp16
-        overflow roll-back."""
-        if skip:
-            return state_master
-        np_grads = [np.asarray(g, dtype=np.float32) for g in off_grads]
+    def _host_step(self, off_grads, lr, skip, shardings) -> Optional[list]:
+        """Blocking host path: one batched device->host fetch of the
+        step's grads (ONE sync instead of a per-leaf np.asarray chain),
+        SIMD Adam, compute-dtype payloads back to device. Returns the
+        device leaves to merge, or None when skipped.
+
+        ``skip`` may be a device boolean — it is forced here, so in the
+        delayed-update mode the main thread never blocks on it."""
+        if skip is not None and bool(skip):
+            return None
+        host = jax.device_get(list(off_grads))
+        np_grads = [np.asarray(g, dtype=np.float32) for g in host]
         self.host_adam.step(np_grads, lr=lr)
-        flat, treedef = jax.tree_util.tree_flatten(state_master)
-        for slot, i in enumerate(self.off_idx):
+        leaves = []
+        for slot in range(len(self.off_idx)):
             if self.compute_dtype == jnp.bfloat16:
                 payload = self.host_adam.master_bf16(slot)
             else:
                 payload = self.host_adam.master[slot].astype(
                     np.dtype(self.compute_dtype))
-            flat[i] = jax.device_put(payload, flat[i].sharding)
+            leaves.append(jax.device_put(payload, shardings[slot]))
+        return leaves
+
+    def merge(self, state_master, leaves: Optional[list]):
+        """Replace the offloaded leaves of ``state_master`` with the
+        host-updated device payloads (pure tree surgery)."""
+        if leaves is None:
+            return state_master
+        flat, treedef = jax.tree_util.tree_flatten(state_master)
+        for slot, i in enumerate(self.off_idx):
+            flat[i] = leaves[slot]
         return jax.tree_util.tree_unflatten(treedef, flat)
+
+    def _leaf_shardings(self, state_master):
+        flat = jax.tree_util.tree_leaves(state_master)
+        return [flat[i].sharding for i in self.off_idx]
+
+    def apply_grads(self, state_master, off_grads, lr: Optional[float],
+                    skip=False):
+        """Synchronous host Adam on the offloaded grads; returns the
+        master tree with refreshed compute-dtype leaves. ``skip``
+        mirrors the fp16 overflow roll-back."""
+        leaves = self._host_step(off_grads, lr, skip,
+                                 self._leaf_shardings(state_master))
+        return self.merge(state_master, leaves)
+
+    def apply_grads_async(self, state_master, off_grads,
+                          lr: Optional[float], skip=None
+                          ) -> "concurrent.futures.Future":
+        """Delayed-parameter-update path (ZeRO-Offload paper DPU /
+        reference pipelined_optimizer_swapper semantics): the grad
+        download + host Adam + param upload run on a background thread,
+        overlapping the NEXT step's device compute. The caller merges
+        the future's result into its state one step later — offloaded
+        leaves are one step stale."""
+        if not hasattr(self, "_pool"):
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="zero-offload")
+        shardings = self._leaf_shardings(state_master)
+        return self._pool.submit(self._host_step, off_grads, lr, skip,
+                                 shardings)
 
     # -- checkpoint --------------------------------------------------------
     def state_dict(self):
