@@ -54,6 +54,26 @@ def _weight_transform(name, quant_active, prune_specs):
     return apply
 
 
+def build_prune_specs(cfg: "CompressionConfig"):
+    """(ratio, structured, patterns) list for the enabled pruning
+    techniques — shared by init_compression and the engine's in-step
+    transform so the dense_ratio/group semantics live in one place."""
+    prune_specs = []
+    sp = cfg.techniques["sparse_pruning"]
+    if sp.enabled:
+        for g in sp.groups:
+            prune_specs.append(
+                (1 - float(g.params.get("dense_ratio", 0.5)),
+                 "none", g.modules))
+    rp = cfg.techniques["row_pruning"]
+    if rp.enabled:
+        for g in rp.groups:
+            prune_specs.append(
+                (1 - float(g.params.get("dense_ratio", 0.5)),
+                 "row", g.modules))
+    return prune_specs
+
+
 def init_compression(params, ds_config: dict,
                      teacher_model=None) -> Callable:
     """Build ``transform(params) -> params`` from the config
@@ -66,19 +86,7 @@ def init_compression(params, ds_config: dict,
 
     wq = cfg.techniques["weight_quantization"]
     quant = wq if wq.enabled else None
-    prune_specs = []
-    sp = cfg.techniques["sparse_pruning"]
-    if sp.enabled:
-        for g in sp.groups:
-            prune_specs.append(
-                (1 - float(g.params.get("dense_ratio", 0.5)),
-                 "none", g.modules))
-    rp = cfg.techniques["row_pruning"]
-    if rp.enabled:
-        for g in rp.groups:
-            prune_specs.append((1 - float(g.params.get("dense_ratio",
-                                                       0.5)),
-                                "row", g.modules))
+    prune_specs = build_prune_specs(cfg)
 
     names, leaves, treedef = flatten_with_names(params)
     transforms = {}

@@ -1,10 +1,15 @@
-"""Compression scheduler (reference: deepspeed/compression/scheduler.py
-``compression_scheduler`` — enables each technique once training passes
-its ``schedule_offset`` step)."""
+"""Compression scheduler + MoQ bit-precision controller.
 
-from typing import Dict
+Reference: deepspeed/compression/scheduler.py ``compression_scheduler``
+(enables each technique once training passes its ``schedule_offset``
+step) and deepspeed/runtime/quantize.py ``Quantizer.compute_quantization``
+(MoQ: drop one bit each ``quantize_period`` steps, doubling the period —
+scaled by the curvature factor when eigenvalues are enabled — until
+``target_bits``)."""
 
-from .config import CompressionConfig
+from typing import Dict, List, Optional
+
+from .config import CompressionConfig, TechniqueConfig
 
 
 class CompressionScheduler:
@@ -22,3 +27,55 @@ class CompressionScheduler:
 
     def is_active(self, tech: str) -> bool:
         return self.active.get(tech, False)
+
+
+class MoQController:
+    """Host-side MoQ bit schedule, one entry per weight-quantization
+    group (reference: runtime/quantize.py:130-146 — at each period
+    boundary: ``period <<= 1; period *= factor; bits -= 1``, where
+    ``factor = 1 + floor(4 * eigenvalue)`` under eigenvalue modulation).
+
+    The current bits are fed to the jitted train step as a STATIC
+    argument: the step recompiles only on the handful of bit drops over
+    a run, not per step."""
+
+    def __init__(self, wq: TechniqueConfig):
+        self.offset = wq.schedule_offset
+        self.groups = []
+        for g in wq.groups:
+            p = g.params
+            start = int(p.get("start_bits", p.get("bits", 8)))
+            self.groups.append({
+                "name": g.name,
+                "modules": list(g.modules),
+                "bits": start,
+                "target": int(p.get("target_bits", start)),
+                "period": int(p.get("quantize_period", 100)),
+                "next_drop": None,          # absolute global step
+                "kind": p.get("quantization_type", "symmetric"),
+                "qgroups": int(p.get("quantize_groups", 1)),
+            })
+
+    def advance(self, global_step: int,
+                factors: Optional[List[int]] = None) -> bool:
+        """Advance the schedule to ``global_step``; returns True when
+        any group's bits changed. ``factors`` (per group, >= 1) stretch
+        the next period — high-curvature groups quantize more slowly."""
+        changed = False
+        for i, g in enumerate(self.groups):
+            if global_step < self.offset or g["bits"] <= g["target"]:
+                continue
+            if g["next_drop"] is None:
+                g["next_drop"] = self.offset + g["period"]
+            if global_step >= g["next_drop"]:
+                f = 1 if factors is None else max(1, int(factors[i]))
+                g["bits"] -= 1
+                g["period"] = g["period"] * 2 * f
+                g["next_drop"] = global_step + g["period"]
+                changed = True
+        return changed
+
+    def bits_tuple(self, active: bool) -> tuple:
+        """Static per-group bits for the jitted step; 0 = quantization
+        off (scheduler not yet past schedule_offset)."""
+        return tuple(g["bits"] if active else 0 for g in self.groups)

@@ -53,26 +53,35 @@ class Eigenvalue:
         self._hvp_cache = weakref.WeakKeyDictionary()
 
     def _hvp_for(self, loss_fn):
+        def build(fn):
+            def hvp(p, t, *aux):
+                g = lambda q: jax.grad(lambda qq: fn(qq, *aux))(q)
+                return jax.jvp(g, (p,), (t,))[1]
+            return jax.jit(hvp)
+
         try:
             hvp = self._hvp_cache.get(loss_fn)
         except TypeError:  # unhashable/unweakrefable callables: no cache
-            return jax.jit(
-                lambda p, t: jax.jvp(jax.grad(loss_fn), (p,), (t,))[1])
+            return build(loss_fn)
         if hvp is None:
             # close over a weak proxy, not loss_fn itself — a strong
             # closure would keep the key alive forever and the weak
             # entry could never be collected
-            ref = weakref.proxy(loss_fn)
-            hvp = jax.jit(
-                lambda p, t: jax.jvp(jax.grad(ref), (p,), (t,))[1])
+            hvp = build(weakref.proxy(loss_fn))
             self._hvp_cache[loss_fn] = hvp
         return hvp
 
     def compute_eigenvalue(self, loss_fn: Callable, params,
-                           rng: Optional[jax.Array] = None) -> float:
+                           rng: Optional[jax.Array] = None,
+                           aux: tuple = ()) -> float:
         """Top eigenvalue of d2(loss)/d(params)2 at ``params``.
 
-        ``loss_fn(params) -> scalar``; jit-compiled HVPs.
+        ``loss_fn(params, *aux) -> scalar``; jit-compiled HVPs. ``aux``
+        values are DYNAMIC inputs to the compiled HVP — anything that
+        changes between calls (current weights, the probe batch) must
+        ride here, not in a closure: closed-over arrays would be baked
+        in as trace-time constants and a cached HVP would silently
+        evaluate at stale values.
         """
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         leaves, treedef = jax.tree_util.tree_flatten(params)
@@ -87,7 +96,7 @@ class Eigenvalue:
 
         eig = 0.0
         for i in range(self.max_iter):
-            hv = hvp(params, v)
+            hv = hvp(params, v, *aux)
             new_eig = float(jnp.real(_dot(v, hv)))
             n = _norm(hv)
             v = _scale(hv, (1.0 / (n + self.stability)))

@@ -205,6 +205,28 @@ class DeepSpeedEngine:
                 layer_name=ev_cfg.get("layer_name", ""),
                 layer_num=ev_cfg.get("layer_num", 0))
 
+        # compression / MoQ loop (reference: engine wires the
+        # compression scheduler + runtime/quantize.py Quantizer into
+        # every step; here train_batch steps the scheduler, the MoQ
+        # controller picks per-group bits — modulated by eigenvalues at
+        # gas boundaries — and the jitted step fake-quantizes the
+        # compute view with those bits)
+        self.compression_scheduler = None
+        self._moq = None
+        self._compression_cfg = None
+        self._eig_factors = None
+        if d.get("compression_training"):
+            from ..compression.config import CompressionConfig
+            from ..compression.scheduler import (CompressionScheduler,
+                                                 MoQController)
+            cc = CompressionConfig(d)
+            if cc.any_enabled():
+                self._compression_cfg = cc
+                self.compression_scheduler = CompressionScheduler(cc)
+                wq = cc.techniques["weight_quantization"]
+                if wq.enabled:
+                    self._moq = MoQController(wq)
+
         # model functions
         self._resolve_model_fns(model)
 
@@ -686,6 +708,13 @@ class DeepSpeedEngine:
                 out.append(g)
             return jax.tree_util.tree_unflatten(treedef, out)
 
+        # ---- compression transform (MoQ fake-quant + pruning) applied
+        # to the compute view inside the step; bits are STATIC so the
+        # quantizer chain compiles in (recompile only on a bit drop) ----
+        comp_transform = None
+        if self.compression_scheduler is not None:
+            comp_transform = self._build_compression_transform()
+
         def make_micro_step(lp, sc, constrain=None):
             """Shared gas-microbatch body + zero accumulator: one source
             for the scaled-loss/accumulate math used by both the GSPMD
@@ -760,8 +789,11 @@ class DeepSpeedEngine:
                 check_vma=False)(lp_params, batch, rng, scale)
             return jax.tree_util.tree_unflatten(pdef, list(gflat)), loss_sum
 
-        def train_step(state: TrainState, batch, rng):
+        def train_step(state: TrainState, batch, rng, comp_bits=(),
+                       prune_on=False):
             lp_params = compute_view(state.master_params)
+            if comp_transform is not None:
+                lp_params = comp_transform(lp_params, comp_bits, prune_on)
             scale = state.loss_scale.loss_scale
 
             if qgz:
@@ -858,24 +890,156 @@ class DeepSpeedEngine:
                        "loss_scale": new_ls.loss_scale}
             return new_state, metrics, off_grads
 
-        self._jit_train_step = jax.jit(train_step, donate_argnums=(0,))
+        self._jit_train_step = jax.jit(train_step, donate_argnums=(0,),
+                                       static_argnums=(3, 4))
+
+    def _build_compression_transform(self):
+        """(lp_params, bits_tuple, prune_on) -> lp_params. Maps each
+        quantization group's matching >=2D leaves to its group index and
+        applies fake-quant (straight-through) with the step's static
+        bits; pruning applies when its schedule is active. Reference:
+        compression/compress.py init_compression + runtime/quantize.py
+        compute_quantization — stateless here (re-quantized from the
+        fp32 master every step), not in-place progressive overwrite."""
+        from ..compression.pruners import magnitude_prune
+        from ..compression.quantizers import QUANTIZERS
+        from ..compression.config import module_matches
+        from ..utils.tree import flatten_with_names
+
+        cc = self._compression_cfg
+        quant_leaf_group = {}
+        group_meta = []
+        if self._moq is not None:
+            for gi, g in enumerate(self._moq.groups):
+                group_meta.append((QUANTIZERS.get(g["kind"],
+                                                  QUANTIZERS["symmetric"]),
+                                   g["qgroups"]))
+            names, leaves, _ = flatten_with_names(self.state.master_params)
+            for n, l in zip(names, leaves):
+                if getattr(l, "ndim", 0) < 2:
+                    continue
+                for gi, g in enumerate(self._moq.groups):
+                    if module_matches(n, g["modules"]):
+                        quant_leaf_group[n] = gi
+                        break
+        from ..compression.compress import build_prune_specs
+        prune_specs = build_prune_specs(cc)
+
+        def transform(lp, bits, prune_on):
+            names, leaves, treedef = flatten_with_names(lp)
+            out = []
+            for n, l in zip(names, leaves):
+                gi = quant_leaf_group.get(n)
+                if gi is not None and gi < len(bits) and bits[gi] > 0:
+                    qfn, qgroups = group_meta[gi]
+                    l = qfn(l, int(bits[gi]), qgroups)
+                if prune_on and getattr(l, "ndim", 0) >= 2:
+                    for ratio, structured, patterns in prune_specs:
+                        if module_matches(n, patterns):
+                            l = magnitude_prune(l, ratio, structured)
+                            break
+                out.append(l)
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        return transform
+
+    def _compression_step_args(self, device_batch):
+        """Per-train_batch host-side scheduling: step the compression
+        scheduler, advance MoQ (eigenvalue-modulated at gas boundaries),
+        return the static (comp_bits, prune_on) for the jitted step."""
+        if self.compression_scheduler is None:
+            return (), False
+        active = self.compression_scheduler.step(self.global_steps)
+        comp_bits = ()
+        if self._moq is not None:
+            factors = self._eigenvalue_factors(device_batch)
+            self._moq.advance(self.global_steps, factors)
+            comp_bits = self._moq.bits_tuple(
+                active.get("weight_quantization", False))
+        prune_on = bool(active.get("sparse_pruning")
+                        or active.get("row_pruning"))
+        return comp_bits, prune_on
+
+    def _eigenvalue_factors(self, device_batch):
+        """Per-group curvature factors 1 + floor(4 * eig/eig_max)
+        (reference: quantize.py:71 factor; engine normalizes block
+        eigenvalues by their max). Eigenvalues refresh every
+        ``gas_boundary_resolution`` global steps via power-iteration
+        HVPs on the first microbatch; cached between refreshes.
+
+        The per-group loss fns are built ONCE and the changing state
+        (current master leaves, probe microbatch) rides through the
+        ``aux`` channel — so the compiled HVP is reused across refreshes
+        instead of retraced, and never evaluates at stale weights."""
+        if self.eigenvalue is None or self._moq is None:
+            return None
+        # nothing to modulate before the schedule starts or after every
+        # group reached its target — don't pay HVPs for dead factors
+        if self.global_steps < self._moq.offset or \
+                all(g["bits"] <= g["target"] for g in self._moq.groups):
+            return self._eig_factors
+        res = max(1, self.eigenvalue.gas_boundary_resolution)
+        if self._eig_factors is not None and self.global_steps % res:
+            return self._eig_factors
+        from ..compression.config import module_matches
+        from ..utils.tree import flatten_with_names
+        micro = jax.tree_util.tree_map(lambda x: x[0], device_batch)
+        master = self.state.master_params
+        names, leaves, treedef = flatten_with_names(master)
+        if not hasattr(self, "_eig_group_fns"):
+            loss_fn = self._loss_fn
+
+            def make(gi):
+                def group_loss(sub_tree, full_leaves, mb,
+                               _names=tuple(names), _tdef=treedef):
+                    merged = [sub_tree.get(n, l)
+                              for n, l in zip(_names, full_leaves)]
+                    params = jax.tree_util.tree_unflatten(_tdef, merged)
+                    loss, _ = loss_fn(params, mb, None)
+                    return loss
+                return group_loss
+
+            self._eig_group_fns = [make(gi)
+                                   for gi in range(len(self._moq.groups))]
+        eigs = []
+        for gi, g in enumerate(self._moq.groups):
+            sub = {n: l for n, l in zip(names, leaves)
+                   if getattr(l, "ndim", 0) >= 2
+                   and module_matches(n, g["modules"])}
+            if not sub:
+                eigs.append(0.0)
+                continue
+            eigs.append(abs(self.eigenvalue.compute_eigenvalue(
+                self._eig_group_fns[gi], sub,
+                aux=(tuple(leaves), micro))))
+        mx = max(eigs) or 1.0
+        self._eig_factors = [1 + int(4 * e / mx) for e in eigs]
+        return self._eig_factors
 
     def _compile_eval_step(self):
         loss_fn = self._loss_fn
         rules = self.sharding_rules
         compute_dtype = self.compute_dtype
         param_sh = rules.param_shardings(self.state.master_params)
+        comp_transform = None
+        if self.compression_scheduler is not None:
+            comp_transform = self._build_compression_transform()
 
-        def eval_step(master, batch):
+        def eval_step(master, batch, comp_bits=(), prune_on=False):
             lp = jax.tree_util.tree_map(
                 lambda x: x.astype(compute_dtype)
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, master)
             lp = jax.lax.with_sharding_constraint(lp, param_sh)
+            if comp_transform is not None:
+                # evaluate the same fake-quantized network the train
+                # step optimizes — eval on the raw master would report
+                # loss for a model that is never the QAT target
+                lp = comp_transform(lp, comp_bits, prune_on)
             # rng=None -> no dropout rng -> models run deterministically
             loss, aux = loss_fn(lp, batch, None)
             return loss, aux
 
-        self._jit_eval_step = jax.jit(eval_step)
+        self._jit_eval_step = jax.jit(eval_step, static_argnums=(2, 3))
 
     # ------------------------------------------------------------------
     # public training API (reference parity)
@@ -905,9 +1069,12 @@ class DeepSpeedEngine:
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                                sharding=x.sharding),
                 device_batch)
+        comp_bits, prune_on = self._compression_step_args(device_batch)
+        self._last_comp_args = (comp_bits, prune_on)
         self._swap_state_in()
         self.state, metrics, off_grads = self._jit_train_step(
-            self.state, device_batch, self._next_rng())
+            self.state, device_batch, self._next_rng(), comp_bits,
+            prune_on)
         self._swap_state_out()
         if self._offload is not None:
             skip = metrics["overflow"] if self.fp16_enabled else False
@@ -1002,7 +1169,9 @@ class DeepSpeedEngine:
             self._compile_eval_step()
         device_batch = self._shard_batch(batch)
         self._swap_state_in()
-        loss, _ = self._jit_eval_step(self.state.master_params, device_batch)
+        loss, _ = self._jit_eval_step(
+            self.state.master_params, device_batch,
+            *getattr(self, "_last_comp_args", ((), False)))
         self._swap_state_out()
         return loss
 
@@ -1028,7 +1197,9 @@ class DeepSpeedEngine:
         self.timers(FORWARD_GLOBAL_TIMER).start()
         device_batch = self._shard_batch(batch)
         self._swap_state_in()
-        loss, aux = self._jit_eval_step(self.state.master_params, device_batch)
+        loss, aux = self._jit_eval_step(
+            self.state.master_params, device_batch,
+            *getattr(self, "_last_comp_args", ((), False)))
         self._swap_state_out()
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         self._last_fwd_batch = device_batch
@@ -1233,6 +1404,12 @@ class DeepSpeedEngine:
             "lr_scheduler": self.lr_scheduler.state_dict()
             if self.lr_scheduler else None,
         })
+        if self._moq is not None:
+            # MoQ schedule state — without it a resume would restart at
+            # start_bits and silently regress the quantization level
+            client_state["moq"] = [
+                {"bits": g["bits"], "period": g["period"],
+                 "next_drop": g["next_drop"]} for g in self._moq.groups]
         self.checkpoint_engine.create(tag)
         self.checkpoint_engine.save(self.state, save_dir, tag,
                                     client_state=client_state,
@@ -1282,6 +1459,11 @@ class DeepSpeedEngine:
             if load_lr_scheduler_states and self.lr_scheduler is not None \
                     and client_state.get("lr_scheduler"):
                 self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+            if self._moq is not None and client_state.get("moq"):
+                for g, saved in zip(self._moq.groups, client_state["moq"]):
+                    g["bits"] = int(saved["bits"])
+                    g["period"] = int(saved["period"])
+                    g["next_drop"] = saved["next_drop"]
         return load_dir, client_state
 
     # ------------------------------------------------------------------
@@ -1361,8 +1543,13 @@ class DeepSpeedEngine:
             raise RuntimeError(
                 "get_flops_profile: run at least one train_batch first")
         from ..profiling.flops_profiler import cost_analysis_of
+        # profile the program training actually runs: with compression
+        # active, the default static args would lower an unquantized
+        # variant and miss the quant/prune ops
+        comp_bits, prune_on = getattr(self, "_last_comp_args", ((), False))
         lowered = self._jit_train_step.lower(
-            self.state, self._profile_batch_struct, self._rng)
+            self.state, self._profile_batch_struct, self._rng,
+            comp_bits, prune_on)
         self._flops_profile = cost_analysis_of(lowered.compile())
         return self._flops_profile
 
