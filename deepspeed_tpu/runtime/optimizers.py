@@ -48,11 +48,15 @@ def build_optimizer(opt_type, params_cfg=None, lr_schedule=None,
     lr_final = _lr_arg(lr, lr_schedule)
 
     if opt_type_l in (ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
-        # Compressed-communication Adam exists for slow interconnects
-        # (reference: runtime/fp16/onebit/adam.py). Over ICI the wire is
-        # fast enough that plain Adam wins; fall through with a note.
-        logger.warning(f"{opt_type_l}: compressed comm unnecessary over ICI; "
-                       "using uncompressed Adam math")
+        # Real error-feedback compressed optimizer: the ENGINE runs the
+        # 1-bit exchange inside its shard_map step (engine.py onebit
+        # path) — this factory is only reached when someone asks for the
+        # transformation outside the engine, where no communication
+        # context exists, so plain Adam math is the honest fallback.
+        logger.warning(f"{opt_type_l} outside the engine step has no "
+                       "collective context; using uncompressed Adam math "
+                       "(the engine's train_batch runs the real 1-bit "
+                       "exchange)")
         opt_type_l = ADAM_OPTIMIZER
     if opt_type_l == ONEBIT_LAMB_OPTIMIZER:
         logger.warning("onebitlamb: using uncompressed LAMB math over ICI")
@@ -110,6 +114,38 @@ def _scale_by_lr(lr):
     if callable(lr):
         return optax.scale_by_schedule(lambda count: -lr(count))
     return optax.scale(-lr)
+
+
+class OnebitAdamState(NamedTuple):
+    """1-bit Adam state (reference: runtime/fp16/onebit/adam.py —
+    exp_avg/exp_avg_sq + per-worker error buffers). ``error`` leaves
+    carry a leading [world] axis sharded over the batch axes: each
+    shard owns its own compression residual."""
+    count: jnp.ndarray
+    m: any
+    v: any
+    error: any
+
+
+def onebit_adam_state_factory(world: int):
+    """init(params) -> OnebitAdamState with fp32 moments and per-shard
+    error buffers (the engine's shard_map step owns the update math)."""
+
+    def init(params):
+        def zf(x):
+            return jnp.zeros(x.shape, jnp.float32) \
+                if jnp.issubdtype(x.dtype, jnp.floating) else \
+                jnp.zeros(x.shape, x.dtype)
+
+        m = jax.tree_util.tree_map(zf, params)
+        v = jax.tree_util.tree_map(zf, params)
+        err = jax.tree_util.tree_map(
+            lambda x: jnp.zeros((world,) + x.shape, jnp.float32)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else jnp.zeros((1,), jnp.float32), params)
+        return OnebitAdamState(count=jnp.int32(0), m=m, v=v, error=err)
+
+    return init
 
 
 def _lamb(lr, b1, b2, eps, weight_decay, max_coeff=10.0, min_coeff=0.01):

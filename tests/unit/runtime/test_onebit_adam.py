@@ -1,0 +1,101 @@
+"""1-bit Adam: error-feedback compressed optimizer in the engine step.
+
+Reference: deepspeed/runtime/fp16/onebit/adam.py (warmup -> frozen
+variance + compressed momentum allreduce), runtime/comm/nccl.py:52
+(compressed_allreduce with error compensation), tests/onebit/.
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
+
+
+def _train(opt_type, steps, freeze_step=10, lr=1e-3, seed=0):
+    mesh_manager.reset()
+    mesh_manager.init(MeshConfig(data=-1))
+    params = {"lr": lr}
+    if opt_type == "OneBitAdam":
+        params["freeze_step"] = freeze_step
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": opt_type, "params": params},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 0,
+    }
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 256, size=(engine.train_batch_size(), 16),
+                       dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    losses = [float(engine.train_batch(batch=batch))
+              for _ in range(steps)]
+    return engine, losses
+
+
+class TestOnebitAdam:
+
+    def test_warmup_matches_plain_adam(self, eight_devices):
+        """Before freeze_step the math is standard Adam with full-
+        precision averaging: trajectories must coincide."""
+        _, ref = _train("Adam", steps=6)
+        _, ob = _train("OneBitAdam", steps=6, freeze_step=100)
+        np.testing.assert_allclose(ob, ref, rtol=1e-4)
+
+    def test_convergence_parity_over_50_steps(self, eight_devices):
+        """The compressed stage (error feedback, 1-bit momentum wire)
+        tracks uncompressed Adam over >= 50 steps on the virtual mesh:
+        same overfitting trajectory within compression tolerance."""
+        _, ref = _train("Adam", steps=55)
+        engine, ob = _train("OneBitAdam", steps=55, freeze_step=5)
+        assert ob[-1] < ob[0] * 0.5, ob[-1]        # converged hard
+        # parity = comparable convergence quality, not identical curves:
+        # the sign-compressed momentum takes a different (here slightly
+        # steeper) trajectory, exactly like the reference's published
+        # curves track but don't overlay fp32 Adam
+        assert ob[-1] <= ref[-1] * 1.3, (ref[-1], ob[-1])
+        # steadily decreasing after the freeze transition
+        assert ob[20] > ob[35] > ob[-1]
+
+    def test_error_feedback_accumulates(self, eight_devices):
+        """Past freeze_step the per-shard error buffers must be nonzero
+        (compression is really happening) and differ across shards."""
+        engine, _ = _train("OneBitAdam", steps=12, freeze_step=3)
+        errs = [np.asarray(e) for e in
+                __import__("jax").tree_util.tree_leaves(
+                    engine.state.opt_state.error)
+                if e.ndim > 1]
+        assert any(np.abs(e).max() > 0 for e in errs)
+        big = next(e for e in errs if np.abs(e).max() > 0)
+        assert big.shape[0] == 8               # one slice per shard
+        # shards hold different residuals (local grads differ)
+        assert np.abs(big[0] - big[1]).max() > 0
+
+    def test_wire_payload_is_one_bit(self, eight_devices):
+        """The compiled step must move packed uint8 sign words over the
+        wire (not fp32 momentum)."""
+        import jax
+        engine, _ = _train("OneBitAdam", steps=1, freeze_step=1)
+        ids = np.zeros((engine.train_batch_size(), 16), np.int32)
+        b = engine._split_microbatches({"input_ids": ids, "labels": ids})
+        b = engine._shard_batch(b, leading_gas=True)
+        txt = engine._jit_train_step.lower(
+            engine.state, b, jax.random.PRNGKey(0)).compile().as_text()
+        u8 = [l for l in txt.splitlines()
+              if "all-gather" in l and "u8[" in l]
+        assert u8, "no uint8 all-gather in the compiled onebit step"
+
+    def test_guards(self, eight_devices):
+        """fp16 and ZeRO>=1 are rejected with actionable errors."""
+        mesh_manager.reset()
+        mesh_manager.init(MeshConfig(data=-1))
+        model = GPT2LMHeadModel(GPT2Config.tiny())
+        with pytest.raises(ValueError, match="stage 0"):
+            deepspeed_tpu.initialize(model=model, config={
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "OneBitAdam", "params": {}},
+                "zero_optimization": {"stage": 1}})
