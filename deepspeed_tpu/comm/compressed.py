@@ -154,13 +154,15 @@ def onebit_allreduce(x, err, axis_name: str):
 
     Returns (mean of compressed contributions, new error)."""
     shape = x.shape
-    flat_in = (x + err).astype(jnp.float32).reshape(-1)
-    n = flat_in.shape[0]
-    pad = (-n) % 8
-    _, new_err = onebit_compress(x.reshape(-1), err.reshape(-1))
+    compressed, new_err = onebit_compress(x.reshape(-1), err.reshape(-1))
     new_err = new_err.reshape(shape)
-    scale = jnp.mean(jnp.abs(flat_in))
-    sign = flat_in >= 0
+    n = compressed.shape[0]
+    pad = (-n) % 8
+    # derive the wire encoding FROM the compressor output so the sign/
+    # scale convention cannot drift from onebit_compress: every element
+    # is exactly +-scale
+    scale = jnp.abs(compressed[0])
+    sign = compressed >= 0
     if pad:
         sign = jnp.concatenate([sign, jnp.zeros((pad,), bool)])
     packed = _pack_signs(sign)

@@ -56,6 +56,8 @@ class RaggedSpec:
     pos_offset: int = 0        # OPT's +2
     act: str = "silu_gate"     # "silu_gate" | "gelu" | "gelu_tanh" | "relu"
     parallel_residual: bool = False
+    shared_ln: bool = False    # Falcon/Phi/GPT-J: MLP reads ln1's output
+    rope_interleaved: bool = False  # GPT-J rotate-every-two convention
     embed_ln: bool = False     # BLOOM word_embeddings_layernorm
     window: int = 0            # sliding window (Mistral), 0 = off
     n_experts: int = 0         # MoE expert count (Mixtral), 0 = dense
@@ -97,7 +99,7 @@ def _adapt_llama(p, cfg):
     layers = []
     for i in range(cfg.num_hidden_layers):
         lp = p[f"layers_{i}"]
-        layers.append({
+        layer = {
             "ln1_scale": lp["input_layernorm"]["weight"],
             "wq": lp["self_attn"]["q_proj"]["kernel"],
             "wk": lp["self_attn"]["k_proj"]["kernel"],
@@ -107,7 +109,12 @@ def _adapt_llama(p, cfg):
             "w_gate": lp["mlp"]["gate_proj"]["kernel"],
             "w_up": lp["mlp"]["up_proj"]["kernel"],
             "w_down": lp["mlp"]["down_proj"]["kernel"],
-        })
+        }
+        if cfg.attention_bias:   # Qwen2: biased q/k/v projections
+            layer["bq"] = lp["self_attn"]["q_proj"]["bias"]
+            layer["bk"] = lp["self_attn"]["k_proj"]["bias"]
+            layer["bv"] = lp["self_attn"]["v_proj"]["bias"]
+        layers.append(layer)
     head = p["embed_tokens"] if cfg.tie_word_embeddings else p["lm_head"]
     tree = {"embed": p["embed_tokens"], "layers": layers,
             "final_scale": p["norm"]["weight"], "head": head}
@@ -276,13 +283,126 @@ def _adapt_bloom(p, cfg):
     return spec, tree
 
 
+def _adapt_falcon(p, cfg):
+    nh, nkv, hd = (cfg.num_attention_heads, cfg.num_kv_heads,
+                   cfg.head_dim)
+    spec = RaggedSpec(
+        n_layers=cfg.num_hidden_layers, n_heads=nh, n_kv_heads=nkv,
+        head_dim=hd, vocab_size=cfg.vocab_size, norm="ln",
+        eps=cfg.layer_norm_epsilon, pos="rope",
+        rope_theta=cfg.rope_theta, act="gelu",
+        parallel_residual=cfg.parallel_attn,
+        shared_ln=cfg.parallel_attn)
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        lp = p[f"h_{i}"]
+        qkv = lp["self_attention"]["query_key_value"]["kernel"]
+        qkv_b = lp["self_attention"]["query_key_value"].get("bias")
+        layer = {
+            "ln1_scale": lp["input_layernorm"]["scale"],
+            "ln1_bias": lp["input_layernorm"]["bias"],
+            "wq": qkv[:, :nh * hd],
+            "wk": qkv[:, nh * hd:(nh + nkv) * hd],
+            "wv": qkv[:, (nh + nkv) * hd:],
+            "wo": lp["self_attention"]["dense"]["kernel"],
+            "bo": lp["self_attention"]["dense"].get("bias"),
+            "w_in": lp["dense_h_to_4h"]["kernel"],
+            "b_in": lp["dense_h_to_4h"].get("bias"),
+            "w_out": lp["dense_4h_to_h"]["kernel"],
+            "b_out": lp["dense_4h_to_h"].get("bias"),
+        }
+        if qkv_b is not None:   # falcon-rw style bias=True checkpoints
+            layer["bq"] = qkv_b[:nh * hd]
+            layer["bk"] = qkv_b[nh * hd:(nh + nkv) * hd]
+            layer["bv"] = qkv_b[(nh + nkv) * hd:]
+        if not cfg.parallel_attn:
+            layer["ln2_scale"] = lp["post_attention_layernorm"]["scale"]
+            layer["ln2_bias"] = lp["post_attention_layernorm"]["bias"]
+        else:
+            layer["ln2_scale"] = layer["ln1_scale"]  # unused (shared_ln)
+        layers.append(layer)
+    tree = {"embed": p["word_embeddings"], "layers": layers,
+            "final_scale": p["ln_f"]["scale"],
+            "final_bias": p["ln_f"]["bias"],
+            "head": p["word_embeddings"]}
+    return spec, tree
+
+
+def _adapt_phi(p, cfg):
+    nh, hd = cfg.num_attention_heads, cfg.head_dim
+    spec = RaggedSpec(
+        n_layers=cfg.num_hidden_layers, n_heads=nh, n_kv_heads=nh,
+        head_dim=hd, vocab_size=cfg.vocab_size, norm="ln",
+        eps=cfg.layer_norm_eps, pos="rope",
+        rope_theta=cfg.rope_theta, rope_pct=cfg.partial_rotary_factor,
+        act="gelu_tanh", parallel_residual=True, shared_ln=True)
+    layers = []
+    for i in range(cfg.num_hidden_layers):
+        lp = p[f"layers_{i}"]
+        layers.append({
+            "ln1_scale": lp["input_layernorm"]["scale"],
+            "ln1_bias": lp["input_layernorm"]["bias"],
+            "wq": lp["self_attn"]["q_proj"]["kernel"],
+            "bq": lp["self_attn"]["q_proj"]["bias"],
+            "wk": lp["self_attn"]["k_proj"]["kernel"],
+            "bk": lp["self_attn"]["k_proj"]["bias"],
+            "wv": lp["self_attn"]["v_proj"]["kernel"],
+            "bv": lp["self_attn"]["v_proj"]["bias"],
+            "wo": lp["self_attn"]["dense"]["kernel"],
+            "bo": lp["self_attn"]["dense"]["bias"],
+            "ln2_scale": lp["input_layernorm"]["scale"],  # shared_ln
+            "w_in": lp["fc1"]["kernel"], "b_in": lp["fc1"]["bias"],
+            "w_out": lp["fc2"]["kernel"], "b_out": lp["fc2"]["bias"],
+        })
+    tree = {"embed": p["embed_tokens"], "layers": layers,
+            "final_scale": p["final_layernorm"]["scale"],
+            "final_bias": p["final_layernorm"]["bias"],
+            "head": jnp.transpose(p["lm_head"]["kernel"]),
+            "head_bias": p["lm_head"]["bias"]}
+    return spec, tree
+
+
+def _adapt_gptj(p, cfg):
+    nh, hd = cfg.n_head, cfg.head_dim
+    spec = RaggedSpec(
+        n_layers=cfg.n_layer, n_heads=nh, n_kv_heads=nh, head_dim=hd,
+        vocab_size=cfg.vocab_size, norm="ln",
+        eps=cfg.layer_norm_epsilon, pos="rope",
+        rope_pct=cfg.rotary_dim / hd, rope_interleaved=True,
+        act="gelu_tanh", parallel_residual=True, shared_ln=True)
+    layers = []
+    for i in range(cfg.n_layer):
+        lp = p[f"h_{i}"]
+        layers.append({
+            "ln1_scale": lp["ln_1"]["scale"],
+            "ln1_bias": lp["ln_1"]["bias"],
+            "wq": lp["attn"]["q_proj"]["kernel"],
+            "wk": lp["attn"]["k_proj"]["kernel"],
+            "wv": lp["attn"]["v_proj"]["kernel"],
+            "wo": lp["attn"]["out_proj"]["kernel"],
+            "ln2_scale": lp["ln_1"]["scale"],        # shared_ln
+            "w_in": lp["fc_in"]["kernel"], "b_in": lp["fc_in"]["bias"],
+            "w_out": lp["fc_out"]["kernel"],
+            "b_out": lp["fc_out"]["bias"],
+        })
+    tree = {"embed": p["wte"], "layers": layers,
+            "final_scale": p["ln_f"]["scale"],
+            "final_bias": p["ln_f"]["bias"],
+            "head": jnp.transpose(p["lm_head"]["kernel"]),
+            "head_bias": p["lm_head"]["bias"]}
+    return spec, tree
+
+
 _ADAPTERS = {
-    "LlamaConfig": _adapt_llama,       # also Mistral (shared config)
+    "LlamaConfig": _adapt_llama,       # also Mistral/Qwen2 (shared cfg)
     "MixtralConfig": _adapt_mixtral,
     "GPTNeoXConfig": _adapt_gptneox,
     "OPTConfig": _adapt_opt,
     "GPT2Config": _adapt_gpt2,
     "BloomConfig": _adapt_bloom,
+    "FalconConfig": _adapt_falcon,
+    "PhiConfig": _adapt_phi,
+    "GPTJConfig": _adapt_gptj,
 }
 
 
@@ -323,10 +443,16 @@ def _act(h, kind):
     raise ValueError(kind)
 
 
-def _rotate(x, cos, sin, rot):
+def _rotate(x, cos, sin, rot, interleaved=False):
     """Partial rotary on [B, H, D] at per-token angles cos/sin
-    [B, rot//2], via the shared half-split helper (the single source of
-    the rotation convention — same op the v1 models apply)."""
+    [B, rot//2]. Half-split via the shared helper (the single source of
+    that convention — same op the v1 models apply); ``interleaved``
+    selects GPT-J's rotate-every-two pairing instead."""
+    if interleaved:
+        from ...models.gptj import apply_rotary_interleaved
+        # helper expects [B, T, H, D]; packed tokens ride the T axis
+        return apply_rotary_interleaved(x[None], cos[None], sin[None],
+                                        rot)[0]
     xr = apply_rotary_pos_emb(x[..., :rot], cos[:, None, :],
                               sin[:, None, :])
     if rot == x.shape[-1]:
@@ -474,8 +600,8 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
         k = k.reshape(B, nkv, hd)
         v = v.reshape(B, nkv, hd)
         if spec.pos == "rope":
-            q = _rotate(q, cos, sin, rot)
-            k = _rotate(k, cos, sin, rot)
+            q = _rotate(q, cos, sin, rot, spec.rope_interleaved)
+            k = _rotate(k, cos, sin, rot, spec.rope_interleaved)
 
         k_pool = k_pool.at[:, widx].set(
             k.transpose(1, 0, 2).astype(k_pool.dtype))
@@ -490,8 +616,12 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
             attn_out = attn_out + lp["bo"]
 
         mlp_in = x if spec.parallel_residual else x + attn_out
-        h = _norm(mlp_in, lp["ln2_scale"], lp.get("ln2_bias"), spec.norm,
-                  spec.eps)
+        if spec.shared_ln:
+            h2 = h              # Falcon/Phi/GPT-J: ln1's output feeds MLP
+        else:
+            h2 = _norm(mlp_in, lp["ln2_scale"], lp.get("ln2_bias"),
+                       spec.norm, spec.eps)
+        h = h2
         if spec.n_experts:
             mlp_out = moe_mlp_ragged(h, lp["router"], lp["we_gate"],
                                      lp["we_up"], lp["we_down"],
@@ -500,8 +630,12 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
             mlp_out = (jax.nn.silu(h @ lp["w_gate"]) *
                        (h @ lp["w_up"])) @ lp["w_down"]
         else:
-            hh = h @ lp["w_in"] + lp["b_in"]
-            mlp_out = _act(hh, spec.act) @ lp["w_out"] + lp["b_out"]
+            hh = h @ lp["w_in"]
+            if lp.get("b_in") is not None:
+                hh = hh + lp["b_in"]
+            mlp_out = _act(hh, spec.act) @ lp["w_out"]
+            if lp.get("b_out") is not None:
+                mlp_out = mlp_out + lp["b_out"]
         if spec.parallel_residual:
             x = x + attn_out + mlp_out
         else:
@@ -511,4 +645,6 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
               spec.eps)
     last = x[logits_idx]                            # [S, C]
     logits = last @ tree["head"].T
+    if tree.get("head_bias") is not None:
+        logits = logits + tree["head_bias"]
     return logits.astype(jnp.float32), new_pools

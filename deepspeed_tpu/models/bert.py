@@ -1,0 +1,205 @@
+"""BERT model family in flax — the encoder-class entry.
+
+TPU-native model zoo entry (reference: the BERT kernel-injection policy
+deepspeed/module_inject/replace_policy.py HFBertLayerPolicy +
+model_implementations/transformers/ds_bert.py). Post-LN encoder,
+bidirectional attention, learned word+position+token-type embeddings
+with an embedding LayerNorm, tanh-gelu intermediate, and the MLM head
+(transform dense+LN, decoder tied to word embeddings + bias). HF
+``BertForMaskedLM`` weight layout.
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import TENSOR_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    use_remat: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def bert_base():
+        return BertConfig()
+
+    @staticmethod
+    def tiny():
+        return BertConfig(vocab_size=256, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=128,
+                          max_position_embeddings=128)
+
+
+def _dense(cfg, features, name):
+    return nn.Dense(features, name=name, use_bias=True,
+                    kernel_init=nn.initializers.normal(
+                        cfg.initializer_range))
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None):
+        cfg = self.config
+        B, T, C = x.shape
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        q = _dense(cfg, C, "query")(x).reshape(B, T, nh, hd)
+        k = _dense(cfg, C, "key")(x).reshape(B, T, nh, hd)
+        v = _dense(cfg, C, "value")(x).reshape(B, T, nh, hd)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(
+            jnp.float32) / (hd ** 0.5)
+        if attention_mask is not None:   # [B, T] 1 = attend
+            s = jnp.where(attention_mask[:, None, None, :].astype(bool),
+                          s, float("-inf"))
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        y = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, C)
+        return y
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None):
+        cfg = self.config
+        a = BertSelfAttention(cfg, name="self")(x, attention_mask)
+        a = _dense(cfg, cfg.hidden_size, "attn_output")(a)
+        # post-LN: LayerNorm over (residual + sublayer)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="attn_layernorm")(x + a)
+        h = _dense(cfg, cfg.intermediate_size, "intermediate")(x)
+        h = nn.gelu(h, approximate=False)
+        h = _dense(cfg, cfg.hidden_size, "output")(h)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="output_layernorm")(x + h)
+        return x
+
+
+class BertForMaskedLM(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None,
+                 token_type_ids=None, labels=None):
+        cfg = self.config
+        B, T = input_ids.shape
+        word = self.param("word_embeddings",
+                          nn.initializers.normal(cfg.initializer_range),
+                          (cfg.vocab_size, cfg.hidden_size))
+        pos = self.param("position_embeddings",
+                         nn.initializers.normal(cfg.initializer_range),
+                         (cfg.max_position_embeddings, cfg.hidden_size))
+        tok = self.param("token_type_embeddings",
+                         nn.initializers.normal(cfg.initializer_range),
+                         (cfg.type_vocab_size, cfg.hidden_size))
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = word[input_ids] + pos[jnp.arange(T)][None] + \
+            tok[token_type_ids]
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="embeddings_layernorm")(x)
+        layer = BertLayer
+        if cfg.use_remat:
+            layer = nn.remat(BertLayer)
+        for i in range(cfg.num_hidden_layers):
+            x = layer(cfg, name=f"layer_{i}")(x, attention_mask)
+        # MLM head: transform dense + gelu + LN, decoder tied + bias
+        h = _dense(cfg, cfg.hidden_size, "transform")(x)
+        h = nn.gelu(h, approximate=False)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="transform_layernorm")(h)
+        bias = self.param("decoder_bias", nn.initializers.zeros,
+                          (cfg.vocab_size,))
+        logits = h @ word.T + bias
+        if labels is None:
+            return logits
+        # masked-LM loss: UNSHIFTED CE over positions with labels != -100
+        valid = labels != -100
+        safe = jnp.where(valid, labels, 0)
+        lse = jax.scipy.special.logsumexp(
+            logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            logits, safe[..., None], axis=-1)[..., 0]
+        nll = jnp.where(valid, lse - picked.astype(jnp.float32), 0.0)
+        loss = nll.sum() / jnp.maximum(valid.sum(), 1)
+        return loss, logits
+
+
+def bert_tensor_rules(name, shape):
+    col = ("self.query", "self.key", "self.value", "intermediate")
+    row = ("attn_output", ".output.")
+    if any(f"{m}.kernel" in name for m in col):
+        return P(None, TENSOR_AXIS)
+    if any(f"{m}.bias" in name for m in col):
+        return P(TENSOR_AXIS)
+    if "attn_output.kernel" in name or name.endswith("output.kernel"):
+        return P(TENSOR_AXIS, None)
+    return None
+
+
+BertForMaskedLM.tensor_sharding_rules = staticmethod(bert_tensor_rules)
+
+
+def from_hf_state_dict(state_dict, config: BertConfig):
+    """HF ``BertForMaskedLM`` state dict -> this module's params."""
+
+    def g(key, transpose=False):
+        v = state_dict[key]
+        if hasattr(v, "numpy"):
+            v = v.detach().cpu().numpy()
+        v = np.asarray(v)
+        return v.T if transpose else v
+
+    def lin(key):
+        return {"kernel": g(f"{key}.weight", True),
+                "bias": g(f"{key}.bias")}
+
+    def ln(key):
+        return {"scale": g(f"{key}.weight"), "bias": g(f"{key}.bias")}
+
+    e = "bert.embeddings."
+    params = {
+        "word_embeddings": g(f"{e}word_embeddings.weight"),
+        "position_embeddings": g(f"{e}position_embeddings.weight"),
+        "token_type_embeddings": g(f"{e}token_type_embeddings.weight"),
+        "embeddings_layernorm": ln(f"{e}LayerNorm"),
+        "transform": lin("cls.predictions.transform.dense"),
+        "transform_layernorm": ln("cls.predictions.transform.LayerNorm"),
+        "decoder_bias": g("cls.predictions.bias"),
+    }
+    for i in range(config.num_hidden_layers):
+        lp = f"bert.encoder.layer.{i}."
+        params[f"layer_{i}"] = {
+            "self": {
+                "query": lin(f"{lp}attention.self.query"),
+                "key": lin(f"{lp}attention.self.key"),
+                "value": lin(f"{lp}attention.self.value"),
+            },
+            "attn_output": lin(f"{lp}attention.output.dense"),
+            "attn_layernorm": ln(f"{lp}attention.output.LayerNorm"),
+            "intermediate": lin(f"{lp}intermediate.dense"),
+            "output": lin(f"{lp}output.dense"),
+            "output_layernorm": ln(f"{lp}output.LayerNorm"),
+        }
+    return {"params": params}

@@ -1,0 +1,198 @@
+"""GPT-J model family in flax.
+
+TPU-native model zoo entry (reference: the GPTJ kernel-injection policy
+deepspeed/module_inject/replace_policy.py + containers/gptj.py).
+Architecture: parallel attention+MLP residual off ONE LayerNorm,
+partial rotary with the INTERLEAVED (rotate-every-two) GPT-J
+convention — not the half-split Llama/NeoX one — bias-free q/k/v,
+biased fc/out, untied lm_head with bias. HF ``GPTJForCausalLM`` weight
+layout.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.pallas_kernels import flash_attention, rope_cos_sin
+from ..parallel.mesh import TENSOR_AXIS
+from .gpt2 import cross_entropy_loss
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTJConfig:
+    vocab_size: int = 50400
+    n_embd: int = 4096
+    n_layer: int = 28
+    n_head: int = 16
+    rotary_dim: int = 64
+    n_inner: int = 16384
+    max_position_embeddings: int = 2048
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_remat: bool = False
+    use_flash: bool = True
+
+    @property
+    def head_dim(self):
+        return self.n_embd // self.n_head
+
+    @staticmethod
+    def gptj_6b():
+        return GPTJConfig()
+
+    @staticmethod
+    def tiny():
+        return GPTJConfig(vocab_size=256, n_embd=64, n_layer=2,
+                          n_head=4, rotary_dim=8, n_inner=128,
+                          max_position_embeddings=128)
+
+
+def apply_rotary_interleaved(x, cos, sin, rot):
+    """GPT-J rotate-every-two on the first ``rot`` dims of [B, T, H, D]:
+    pairs are (0,1), (2,3), ... — each frequency's sin/cos applies to
+    adjacent elements (HF GPTJAttention's duplicate_interleave)."""
+    xr = x[..., :rot]
+    x1 = xr[..., 0::2]
+    x2 = xr[..., 1::2]
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    r1 = x1 * c - x2 * s
+    r2 = x2 * c + x1 * s
+    rotated = jnp.stack([r1, r2], axis=-1).reshape(xr.shape)
+    return jnp.concatenate([rotated.astype(x.dtype), x[..., rot:]],
+                           axis=-1)
+
+
+class GPTJAttention(nn.Module):
+    config: GPTJConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        B, T, C = x.shape
+        nh, hd = cfg.n_head, cfg.head_dim
+        dense = lambda f, n, b=False: nn.Dense(
+            f, name=n, use_bias=b,
+            kernel_init=nn.initializers.normal(cfg.initializer_range))
+        q = dense(C, "q_proj")(x).reshape(B, T, nh, hd)
+        k = dense(C, "k_proj")(x).reshape(B, T, nh, hd)
+        v = dense(C, "v_proj")(x).reshape(B, T, nh, hd)
+        rot = cfg.rotary_dim
+        cos, sin = rope_cos_sin(positions, rot,
+                                theta=10000.0)  # [B, T, rot/2]
+        q = apply_rotary_interleaved(q, cos, sin, rot)
+        k = apply_rotary_interleaved(k, cos, sin, rot)
+        if cfg.use_flash:
+            y = flash_attention(q, k, v, causal=True).reshape(B, T, C)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                hd).astype(x.dtype)
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
+            p = jax.nn.softmax(s.astype(jnp.float32),
+                               axis=-1).astype(x.dtype)
+            y = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, C)
+        return dense(C, "out_proj")(y)
+
+
+class GPTJBlock(nn.Module):
+    config: GPTJConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_1")(x)
+        attn = GPTJAttention(cfg, name="attn")(h, positions)
+        # parallel residual: mlp reads the SAME ln_1 output
+        m = nn.Dense(cfg.n_inner, name="fc_in",
+                     kernel_init=nn.initializers.normal(
+                         cfg.initializer_range))(h)
+        m = nn.gelu(m, approximate=True)
+        m = nn.Dense(cfg.n_embd, name="fc_out",
+                     kernel_init=nn.initializers.normal(
+                         cfg.initializer_range))(m)
+        return x + attn + m
+
+
+class GPTJForCausalLM(nn.Module):
+    config: GPTJConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None):
+        cfg = self.config
+        B, T = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(
+            cfg.initializer_range), (cfg.vocab_size, cfg.n_embd))
+        x = wte[input_ids]
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        block = GPTJBlock
+        if cfg.use_remat:
+            block = nn.remat(GPTJBlock)
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"h_{i}")(x, positions)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f")(x)
+        head = nn.Dense(cfg.vocab_size, name="lm_head", use_bias=True,
+                        kernel_init=nn.initializers.normal(
+                            cfg.initializer_range))
+        logits = head(x)
+        if labels is None:
+            return logits
+        return cross_entropy_loss(logits, labels), logits
+
+
+def gptj_tensor_rules(name, shape):
+    col = ("q_proj", "k_proj", "v_proj", "fc_in")
+    row = ("out_proj", "fc_out")
+    if any(f"{m}.kernel" in name for m in col):
+        return P(None, TENSOR_AXIS)
+    if "fc_in.bias" in name:
+        return P(TENSOR_AXIS)
+    if any(f"{m}.kernel" in name for m in row):
+        return P(TENSOR_AXIS, None)
+    return None
+
+
+GPTJForCausalLM.tensor_sharding_rules = staticmethod(gptj_tensor_rules)
+
+
+def from_hf_state_dict(state_dict, config: GPTJConfig):
+    """HF ``GPTJForCausalLM`` state dict -> this module's params."""
+
+    def g(key, transpose=False):
+        v = state_dict[key]
+        if hasattr(v, "numpy"):
+            v = v.detach().cpu().numpy()
+        v = np.asarray(v)
+        return v.T if transpose else v
+
+    prefix = "transformer." if "transformer.wte.weight" in state_dict \
+        else ""
+    params = {
+        "wte": g(f"{prefix}wte.weight"),
+        "ln_f": {"scale": g(f"{prefix}ln_f.weight"),
+                 "bias": g(f"{prefix}ln_f.bias")},
+        "lm_head": {"kernel": g("lm_head.weight", transpose=True),
+                    "bias": g("lm_head.bias")},
+    }
+    for i in range(config.n_layer):
+        lp = f"{prefix}h.{i}."
+        params[f"h_{i}"] = {
+            "ln_1": {"scale": g(f"{lp}ln_1.weight"),
+                     "bias": g(f"{lp}ln_1.bias")},
+            "attn": {
+                "q_proj": {"kernel": g(f"{lp}attn.q_proj.weight", True)},
+                "k_proj": {"kernel": g(f"{lp}attn.k_proj.weight", True)},
+                "v_proj": {"kernel": g(f"{lp}attn.v_proj.weight", True)},
+                "out_proj": {"kernel": g(f"{lp}attn.out_proj.weight",
+                                         True)},
+            },
+            "fc_in": {"kernel": g(f"{lp}mlp.fc_in.weight", True),
+                      "bias": g(f"{lp}mlp.fc_in.bias")},
+            "fc_out": {"kernel": g(f"{lp}mlp.fc_out.weight", True),
+                       "bias": g(f"{lp}mlp.fc_out.bias")},
+        }
+    return {"params": params}

@@ -1,0 +1,187 @@
+"""GPT-Neo model family in flax.
+
+TPU-native model zoo entry (reference: the GPTNeo kernel-injection
+policy deepspeed/module_inject/replace_policy.py + containers/gptneo.py).
+Architecture quirks vs GPT-2: UNSCALED attention scores (no 1/sqrt(d) —
+EleutherAI baked the scale into the init), alternating global/local
+(windowed) attention layers, separate bias-free q/k/v projections,
+learned positions, tanh-gelu MLP. HF ``GPTNeoForCausalLM`` layout.
+"""
+
+import dataclasses
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import TENSOR_AXIS
+from .gpt2 import cross_entropy_loss
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 2048
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 8192
+    window_size: int = 256
+    # per-layer attention kind, cycled: ("global", "local")
+    attention_layers: Tuple[str, ...] = ("global", "local")
+    max_position_embeddings: int = 2048
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_remat: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    def layer_kind(self, i: int) -> str:
+        return self.attention_layers[i % len(self.attention_layers)]
+
+    @staticmethod
+    def neo_1_3b():
+        return GPTNeoConfig()
+
+    @staticmethod
+    def tiny():
+        return GPTNeoConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                            num_heads=4, intermediate_size=128,
+                            window_size=8, max_position_embeddings=128)
+
+
+class GPTNeoSelfAttention(nn.Module):
+    config: GPTNeoConfig
+    kind: str  # "global" | "local"
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        B, T, C = x.shape
+        nh, hd = cfg.num_heads, cfg.head_dim
+        dense = lambda f, n, b: nn.Dense(
+            f, name=n, use_bias=b,
+            kernel_init=nn.initializers.normal(cfg.initializer_range))
+        q = dense(C, "q_proj", False)(x).reshape(B, T, nh, hd)
+        k = dense(C, "k_proj", False)(x).reshape(B, T, nh, hd)
+        v = dense(C, "v_proj", False)(x).reshape(B, T, nh, hd)
+        # NO 1/sqrt(d): GPT-Neo computes raw qk scores
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        qpos = jnp.arange(T)[:, None]
+        kpos = jnp.arange(T)[None, :]
+        mask = kpos <= qpos
+        if self.kind == "local":
+            mask &= kpos > qpos - cfg.window_size
+        s = jnp.where(mask[None, None], s, jnp.finfo(jnp.float32).min)
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        y = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, C)
+        return dense(C, "out_proj", True)(y)
+
+
+class GPTNeoBlock(nn.Module):
+    config: GPTNeoConfig
+    kind: str
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_1")(x)
+        x = x + GPTNeoSelfAttention(cfg, self.kind, name="attn")(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_2")(x)
+        h = nn.Dense(cfg.intermediate_size, name="c_fc",
+                     kernel_init=nn.initializers.normal(
+                         cfg.initializer_range))(h)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.hidden_size, name="c_proj",
+                     kernel_init=nn.initializers.normal(
+                         cfg.initializer_range))(h)
+        return x + h
+
+
+class GPTNeoForCausalLM(nn.Module):
+    config: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None):
+        cfg = self.config
+        B, T = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(
+            cfg.initializer_range), (cfg.vocab_size, cfg.hidden_size))
+        wpe = self.param("wpe", nn.initializers.normal(
+            cfg.initializer_range),
+            (cfg.max_position_embeddings, cfg.hidden_size))
+        x = wte[input_ids] + wpe[jnp.arange(T)][None]
+        block = GPTNeoBlock
+        if cfg.use_remat:
+            block = nn.remat(GPTNeoBlock)
+        for i in range(cfg.num_layers):
+            x = block(cfg, cfg.layer_kind(i), name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f")(x)
+        logits = x @ wte.T   # tied
+        if labels is None:
+            return logits
+        return cross_entropy_loss(logits, labels), logits
+
+
+def gptneo_tensor_rules(name, shape):
+    col = ("q_proj", "k_proj", "v_proj", "c_fc")
+    row = ("out_proj", "c_proj")
+    if any(f"{m}.kernel" in name for m in col):
+        return P(None, TENSOR_AXIS)
+    if "c_fc.bias" in name:
+        return P(TENSOR_AXIS)
+    if any(f"{m}.kernel" in name for m in row):
+        return P(TENSOR_AXIS, None)
+    return None
+
+
+GPTNeoForCausalLM.tensor_sharding_rules = staticmethod(gptneo_tensor_rules)
+
+
+def from_hf_state_dict(state_dict, config: GPTNeoConfig):
+    """HF ``GPTNeoForCausalLM`` state dict -> this module's params."""
+
+    def g(key, transpose=False):
+        v = state_dict[key]
+        if hasattr(v, "numpy"):
+            v = v.detach().cpu().numpy()
+        v = np.asarray(v)
+        return v.T if transpose else v
+
+    prefix = "transformer." if "transformer.wte.weight" in state_dict \
+        else ""
+    params = {
+        "wte": g(f"{prefix}wte.weight"),
+        "wpe": g(f"{prefix}wpe.weight"),
+        "ln_f": {"scale": g(f"{prefix}ln_f.weight"),
+                 "bias": g(f"{prefix}ln_f.bias")},
+    }
+    for i in range(config.num_layers):
+        lp = f"{prefix}h.{i}."
+        params[f"h_{i}"] = {
+            "ln_1": {"scale": g(f"{lp}ln_1.weight"),
+                     "bias": g(f"{lp}ln_1.bias")},
+            "ln_2": {"scale": g(f"{lp}ln_2.weight"),
+                     "bias": g(f"{lp}ln_2.bias")},
+            "attn": {
+                "q_proj": {"kernel": g(
+                    f"{lp}attn.attention.q_proj.weight", True)},
+                "k_proj": {"kernel": g(
+                    f"{lp}attn.attention.k_proj.weight", True)},
+                "v_proj": {"kernel": g(
+                    f"{lp}attn.attention.v_proj.weight", True)},
+                "out_proj": {
+                    "kernel": g(f"{lp}attn.attention.out_proj.weight",
+                                True),
+                    "bias": g(f"{lp}attn.attention.out_proj.bias")},
+            },
+            "c_fc": {"kernel": g(f"{lp}mlp.c_fc.weight", True),
+                     "bias": g(f"{lp}mlp.c_fc.bias")},
+            "c_proj": {"kernel": g(f"{lp}mlp.c_proj.weight", True),
+                       "bias": g(f"{lp}mlp.c_proj.bias")},
+        }
+    return {"params": params}

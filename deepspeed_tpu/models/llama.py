@@ -48,6 +48,8 @@ class LlamaConfig:
     # Mistral-style local attention: keys further than this behind the
     # query are masked out (None = full causal)
     sliding_window: Optional[int] = None
+    # Qwen2-style q/k/v projection biases (o_proj stays bias-free)
+    attention_bias: bool = False
 
     @property
     def head_dim(self):
@@ -103,9 +105,13 @@ class LlamaAttention(nn.Module):
         B, T, C = x.shape
         nh, nkv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                        cfg.head_dim)
-        q = _dense(cfg, nh * hd, "q_proj")(x).reshape(B, T, nh, hd)
-        k = _dense(cfg, nkv * hd, "k_proj")(x).reshape(B, T, nkv, hd)
-        v = _dense(cfg, nkv * hd, "v_proj")(x).reshape(B, T, nkv, hd)
+        ab = cfg.attention_bias
+        q = _dense(cfg, nh * hd, "q_proj", use_bias=ab)(x).reshape(
+            B, T, nh, hd)
+        k = _dense(cfg, nkv * hd, "k_proj", use_bias=ab)(x).reshape(
+            B, T, nkv, hd)
+        v = _dense(cfg, nkv * hd, "v_proj", use_bias=ab)(x).reshape(
+            B, T, nkv, hd)
 
         cos, sin = rope_cos_sin(positions, hd, theta=cfg.rope_theta)
         # positions: [B, T] -> tables [B, T, half]; add the head axis
@@ -281,6 +287,8 @@ def llama_tensor_rules(name, shape):
     row = ("o_proj", "down_proj")
     if any(f"{m}.kernel" in name for m in col):
         return P(None, TENSOR_AXIS)
+    if any(f"{m}.bias" in name for m in col):
+        return P(TENSOR_AXIS)
     if any(f"{m}.kernel" in name for m in row):
         return P(TENSOR_AXIS, None)
     if name.endswith("embed_tokens") or name.endswith("lm_head"):
@@ -314,7 +322,12 @@ def from_hf_state_dict(state_dict, config: LlamaConfig):
             "post_attention_layernorm": {
                 "weight": g(f"{lp}post_attention_layernorm.weight")},
             "self_attn": {
-                m: {"kernel": g(f"{lp}self_attn.{m}.weight", transpose=True)}
+                m: ({"kernel": g(f"{lp}self_attn.{m}.weight",
+                                 transpose=True),
+                     "bias": g(f"{lp}self_attn.{m}.bias")}
+                    if config.attention_bias and m != "o_proj" else
+                    {"kernel": g(f"{lp}self_attn.{m}.weight",
+                                 transpose=True)})
                 for m in ("q_proj", "k_proj", "v_proj", "o_proj")},
             "mlp": {
                 m: {"kernel": g(f"{lp}mlp.{m}.weight", transpose=True)}

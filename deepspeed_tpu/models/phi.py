@@ -1,0 +1,194 @@
+"""Phi model family in flax.
+
+TPU-native model zoo entry (reference: the Phi inference-v2
+implementation deepspeed/inference/v2/model_implementations/phi/
+model.py). Phi-1/2 architecture: PARALLEL attention+MLP off one input
+LayerNorm, partial rotary (``partial_rotary_factor``), biased q/k/v/
+dense/fc projections, tanh-gelu MLP, final LayerNorm, biased untied
+lm_head. HF ``PhiForCausalLM`` weight layout.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.pallas_kernels import (apply_rotary_pos_emb, flash_attention,
+                                  rope_cos_sin)
+from ..parallel.mesh import TENSOR_AXIS
+from .gpt2 import cross_entropy_loss
+
+
+@dataclasses.dataclass(frozen=True)
+class PhiConfig:
+    vocab_size: int = 51200
+    hidden_size: int = 2560
+    intermediate_size: int = 10240
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    partial_rotary_factor: float = 0.4
+    rope_theta: float = 10000.0
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    max_position_embeddings: int = 2048
+    use_remat: bool = False
+    use_flash: bool = True
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def rotary_dim(self):
+        return int(self.head_dim * self.partial_rotary_factor)
+
+    @staticmethod
+    def phi_2():
+        return PhiConfig()
+
+    @staticmethod
+    def tiny():
+        return PhiConfig(vocab_size=256, hidden_size=64,
+                         intermediate_size=128, num_hidden_layers=2,
+                         num_attention_heads=4,
+                         partial_rotary_factor=0.5,
+                         max_position_embeddings=128)
+
+
+class PhiAttention(nn.Module):
+    config: PhiConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        B, T, C = x.shape
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        dense = lambda f, n: nn.Dense(
+            f, name=n, use_bias=True,
+            kernel_init=nn.initializers.normal(cfg.initializer_range))
+        q = dense(C, "q_proj")(x).reshape(B, T, nh, hd)
+        k = dense(C, "k_proj")(x).reshape(B, T, nh, hd)
+        v = dense(C, "v_proj")(x).reshape(B, T, nh, hd)
+        rot = cfg.rotary_dim
+        cos, sin = rope_cos_sin(positions, rot, theta=cfg.rope_theta)
+        c4, s4 = cos[:, :, None, :], sin[:, :, None, :]
+        q = jnp.concatenate(
+            [apply_rotary_pos_emb(q[..., :rot], c4, s4), q[..., rot:]],
+            axis=-1)
+        k = jnp.concatenate(
+            [apply_rotary_pos_emb(k[..., :rot], c4, s4), k[..., rot:]],
+            axis=-1)
+        if cfg.use_flash:
+            y = flash_attention(q, k, v, causal=True).reshape(B, T, C)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(
+                jnp.float32) / (hd ** 0.5)
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            s = jnp.where(mask[None, None], s, float("-inf"))
+            p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+            y = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, C)
+        return dense(C, "dense")(y)
+
+
+class PhiDecoderLayer(nn.Module):
+    config: PhiConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="input_layernorm")(x)
+        attn = PhiAttention(cfg, name="self_attn")(h, positions)
+        m = nn.Dense(cfg.intermediate_size, name="fc1",
+                     kernel_init=nn.initializers.normal(
+                         cfg.initializer_range))(h)
+        m = nn.gelu(m, approximate=True)
+        m = nn.Dense(cfg.hidden_size, name="fc2",
+                     kernel_init=nn.initializers.normal(
+                         cfg.initializer_range))(m)
+        return x + attn + m      # parallel residual
+
+
+class PhiForCausalLM(nn.Module):
+    config: PhiConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None):
+        cfg = self.config
+        B, T = input_ids.shape
+        emb = self.param("embed_tokens",
+                         nn.initializers.normal(cfg.initializer_range),
+                         (cfg.vocab_size, cfg.hidden_size))
+        x = emb[input_ids]
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        layer = PhiDecoderLayer
+        if cfg.use_remat:
+            layer = nn.remat(PhiDecoderLayer)
+        for i in range(cfg.num_hidden_layers):
+            x = layer(cfg, name=f"layers_{i}")(x, positions)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                         name="final_layernorm")(x)
+        head = nn.Dense(cfg.vocab_size, name="lm_head", use_bias=True,
+                        kernel_init=nn.initializers.normal(
+                            cfg.initializer_range))
+        logits = head(x)
+        if labels is None:
+            return logits
+        return cross_entropy_loss(logits, labels), logits
+
+
+def phi_tensor_rules(name, shape):
+    col = ("q_proj", "k_proj", "v_proj", "fc1")
+    row = ("self_attn.dense", "fc2")
+    if any(f"{m}.kernel" in name for m in col):
+        return P(None, TENSOR_AXIS)
+    if any(f"{m}.bias" in name for m in col):
+        return P(TENSOR_AXIS)
+    if any(f"{m}.kernel" in name for m in row):
+        return P(TENSOR_AXIS, None)
+    return None
+
+
+PhiForCausalLM.tensor_sharding_rules = staticmethod(phi_tensor_rules)
+
+
+def from_hf_state_dict(state_dict, config: PhiConfig):
+    """HF ``PhiForCausalLM`` state dict -> this module's params."""
+
+    def g(key, transpose=False):
+        v = state_dict[key]
+        if hasattr(v, "numpy"):
+            v = v.detach().cpu().numpy()
+        v = np.asarray(v)
+        return v.T if transpose else v
+
+    prefix = "model." if "model.embed_tokens.weight" in state_dict else ""
+
+    def lin(key):
+        return {"kernel": g(f"{key}.weight", True), "bias": g(f"{key}.bias")}
+
+    params = {
+        "embed_tokens": g(f"{prefix}embed_tokens.weight"),
+        "final_layernorm": {"scale": g(f"{prefix}final_layernorm.weight"),
+                            "bias": g(f"{prefix}final_layernorm.bias")},
+        "lm_head": lin("lm_head"),
+    }
+    for i in range(config.num_hidden_layers):
+        lp = f"{prefix}layers.{i}."
+        params[f"layers_{i}"] = {
+            "input_layernorm": {
+                "scale": g(f"{lp}input_layernorm.weight"),
+                "bias": g(f"{lp}input_layernorm.bias")},
+            "self_attn": {
+                "q_proj": lin(f"{lp}self_attn.q_proj"),
+                "k_proj": lin(f"{lp}self_attn.k_proj"),
+                "v_proj": lin(f"{lp}self_attn.v_proj"),
+                "dense": lin(f"{lp}self_attn.dense"),
+            },
+            "fc1": lin(f"{lp}mlp.fc1"),
+            "fc2": lin(f"{lp}mlp.fc2"),
+        }
+    return {"params": params}

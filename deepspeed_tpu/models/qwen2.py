@@ -1,0 +1,50 @@
+"""Qwen2 model family — Llama architecture + q/k/v projection biases.
+
+TPU-native model zoo entry (reference: the Qwen/Qwen2 inference-v2
+implementations deepspeed/inference/v2/model_implementations/{qwen,
+qwen_v2}/model.py). Architecturally Llama with GQA, RoPE at theta 1e6,
+and biased q/k/v projections; the HF ``Qwen2ForCausalLM`` weight layout
+maps onto the shared Llama module (models/llama.py) with
+``attention_bias=True``.
+"""
+
+import dataclasses
+
+from .llama import (LlamaConfig, LlamaForCausalLM, from_hf_state_dict,
+                    llama_tensor_rules)
+
+Qwen2ForCausalLM = LlamaForCausalLM
+qwen2_tensor_rules = llama_tensor_rules
+
+
+class Qwen2Config:
+    """Factories producing LlamaConfig instances with Qwen2 shapes."""
+
+    @staticmethod
+    def qwen2_7b() -> LlamaConfig:
+        return LlamaConfig(vocab_size=152064, hidden_size=3584,
+                           intermediate_size=18944,
+                           num_hidden_layers=28, num_attention_heads=28,
+                           num_key_value_heads=4,
+                           max_position_embeddings=32768,
+                           rope_theta=1e6, rms_norm_eps=1e-6,
+                           attention_bias=True)
+
+    @staticmethod
+    def qwen2_0_5b() -> LlamaConfig:
+        return LlamaConfig(vocab_size=151936, hidden_size=896,
+                           intermediate_size=4864,
+                           num_hidden_layers=24, num_attention_heads=14,
+                           num_key_value_heads=2,
+                           max_position_embeddings=32768,
+                           rope_theta=1e6, rms_norm_eps=1e-6,
+                           attention_bias=True, tie_word_embeddings=True)
+
+    @staticmethod
+    def tiny() -> LlamaConfig:
+        return dataclasses.replace(LlamaConfig.tiny(),
+                                   attention_bias=True, rope_theta=1e6)
+
+
+__all__ = ["Qwen2Config", "Qwen2ForCausalLM", "from_hf_state_dict",
+           "qwen2_tensor_rules"]

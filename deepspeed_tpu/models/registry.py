@@ -11,7 +11,8 @@ works for every family with no per-model user code.
 import dataclasses
 from typing import Any, Callable, Dict, Optional
 
-from . import bloom, gpt2, gptneox, llama, mistral, opt
+from . import (bert, bloom, falcon, gpt2, gptj, gptneo, gptneox, llama,
+               mistral, mixtral, opt, phi, qwen2)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,7 +53,9 @@ register(ModelPolicy(
     name="bloom", config_cls=bloom.BloomConfig,
     model_cls=bloom.BloomForCausalLM, from_hf=bloom.from_hf_state_dict,
     tensor_rules=bloom.bloom_tensor_rules,
-    hf_keys=("transformer.word_embeddings.weight",)))
+    # the embedding LayerNorm distinguishes BLOOM from Falcon, whose
+    # transformer.* layer names otherwise overlap
+    hf_keys=("transformer.word_embeddings_layernorm.weight",)))
 register(ModelPolicy(
     name="gptneox", config_cls=gptneox.GPTNeoXConfig,
     model_cls=gptneox.GPTNeoXForCausalLM,
@@ -64,6 +67,46 @@ register(ModelPolicy(
     model_cls=opt.OPTForCausalLM, from_hf=opt.from_hf_state_dict,
     tensor_rules=opt.opt_tensor_rules,
     hf_keys=("model.decoder.embed_tokens.weight",)))
+register(ModelPolicy(
+    name="gptj", config_cls=gptj.GPTJConfig,
+    model_cls=gptj.GPTJForCausalLM, from_hf=gptj.from_hf_state_dict,
+    tensor_rules=gptj.gptj_tensor_rules,
+    hf_keys=("transformer.h.0.attn.q_proj.weight",
+             "h.0.attn.q_proj.weight")))
+register(ModelPolicy(
+    name="gptneo", config_cls=gptneo.GPTNeoConfig,
+    model_cls=gptneo.GPTNeoForCausalLM,
+    from_hf=gptneo.from_hf_state_dict,
+    tensor_rules=gptneo.gptneo_tensor_rules,
+    hf_keys=("transformer.h.0.attn.attention.q_proj.weight",)))
+register(ModelPolicy(
+    name="falcon", config_cls=falcon.FalconConfig,
+    model_cls=falcon.FalconForCausalLM,
+    from_hf=falcon.from_hf_state_dict,
+    tensor_rules=falcon.falcon_tensor_rules,
+    hf_keys=("transformer.h.0.self_attention.query_key_value.weight",)))
+register(ModelPolicy(
+    name="phi", config_cls=phi.PhiConfig,
+    model_cls=phi.PhiForCausalLM, from_hf=phi.from_hf_state_dict,
+    tensor_rules=phi.phi_tensor_rules,
+    hf_keys=("model.final_layernorm.weight",)))
+register(ModelPolicy(
+    name="qwen2", config_cls=qwen2.Qwen2Config,
+    model_cls=qwen2.Qwen2ForCausalLM,
+    from_hf=qwen2.from_hf_state_dict,
+    tensor_rules=qwen2.qwen2_tensor_rules,
+    hf_keys=()))
+register(ModelPolicy(
+    name="mixtral", config_cls=mixtral.MixtralConfig,
+    model_cls=mixtral.MixtralForCausalLM,
+    from_hf=mixtral.from_hf_state_dict,
+    tensor_rules=mixtral.mixtral_tensor_rules,
+    hf_keys=("model.layers.0.block_sparse_moe.gate.weight",)))
+register(ModelPolicy(
+    name="bert", config_cls=bert.BertConfig,
+    model_cls=bert.BertForMaskedLM, from_hf=bert.from_hf_state_dict,
+    tensor_rules=bert.bert_tensor_rules,
+    hf_keys=("bert.embeddings.word_embeddings.weight",)))
 
 
 def get_policy(name: str) -> ModelPolicy:
@@ -74,10 +117,21 @@ def get_policy(name: str) -> ModelPolicy:
     return POLICIES[key]
 
 
+# detection order: specific families BEFORE generic layouts — mixtral/
+# phi state dicts also contain llama's model.embed_tokens key, and
+# falcon shares bloom's transformer.* layer names (bloom is told apart
+# by its embedding LayerNorm, checked first)
+_DETECT_ORDER = ("mixtral", "phi", "bloom", "falcon", "gptneo", "gptj",
+                 "gptneox", "bert", "opt", "gpt2", "llama")
+
+
 def detect_policy(state_dict) -> ModelPolicy:
     """Identify the architecture from HF state-dict keys (the
     replace_policy auto-detection analog)."""
-    for policy in POLICIES.values():
+    names = list(_DETECT_ORDER) + [n for n in POLICIES
+                                   if n not in _DETECT_ORDER]
+    for name in names:
+        policy = POLICIES[name]
         if any(k in state_dict for k in policy.hf_keys):
             return policy
     raise KeyError("could not detect model family from state dict; "

@@ -351,16 +351,11 @@ class DeepSpeedEngine:
         if getattr(self, "_onebit_cfg", None) is not None:
             # per-shard error buffers: leading [world] axis sharded over
             # the batch axes (each shard owns its compression residual)
-            ax = tuple(a for a in BATCH_AXES if self.mesh.shape[a] > 1)
-            world = int(np.prod([self.mesh.shape[a] for a in ax])) \
-                if ax else 1
-
-            def err_sh(x):
-                spec = P(ax) if ax and x.shape[0] == world else P()
-                return NamedSharding(self.mesh, spec)
-
+            _, _, err_spec = self._onebit_mesh_info()
             opt_sh = opt_sh._replace(
-                error=jax.tree_util.tree_map(err_sh, opt_state.error))
+                error=jax.tree_util.tree_map(
+                    lambda x: NamedSharding(self.mesh, err_spec(x)),
+                    opt_state.error))
         opt_state = jax.jit(lambda t: t, out_shardings=opt_sh)(opt_state)
         if self._param_offload_host:
             # optimizer state is BUILT from device-resident params first
@@ -680,6 +675,20 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # the compiled train step
     # ------------------------------------------------------------------
+    def _onebit_mesh_info(self):
+        """(batch_axes, world) + the error-buffer spec rule — ONE source
+        for the layout shared by _setup_state's shardings and the onebit
+        step's shard_map specs (they must agree or the first train_batch
+        hits a spec mismatch)."""
+        axes = tuple(a for a in BATCH_AXES if self.mesh.shape[a] > 1)
+        world = int(np.prod([self.mesh.shape[a] for a in axes])) \
+            if axes else 1
+
+        def err_spec(x):
+            return P(axes) if axes and x.shape[0] == world else P()
+
+        return axes, world, err_spec
+
     def _make_micro_step(self, lp, gas, accum_dtype, scale=None,
                          constrain=None):
         """Shared gas-microbatch body + zero accumulator — ONE source
@@ -729,9 +738,7 @@ class DeepSpeedEngine:
         ob = dict(self._onebit_cfg)
         sched_fn = self.lr_scheduler.schedule_fn \
             if self.lr_scheduler is not None else None
-        batch_axes = tuple(a for a in BATCH_AXES if mesh.shape[a] > 1)
-        world = int(np.prod([mesh.shape[a] for a in batch_axes])) \
-            if batch_axes else 1
+        batch_axes, world, err_spec = self._onebit_mesh_info()
         clip = self._config.gradient_clipping
         if clip:
             logger.warning("OneBitAdam: gradient_clipping applies during "
@@ -862,10 +869,6 @@ class DeepSpeedEngine:
                               (None,) * (x.ndim - 2))), batch) \
                 if batch_axes else jax.tree_util.tree_map(
                     lambda x: P(), batch)
-
-            def err_spec(x):
-                return P(batch_axes) if batch_axes and \
-                    x.shape[0] == world else P()
 
             err_specs = jax.tree_util.tree_map(err_spec, opt.error)
             rep_tree = lambda t: jax.tree_util.tree_map(lambda _: rep, t)

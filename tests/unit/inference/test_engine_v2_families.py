@@ -113,6 +113,35 @@ def test_mistral_sliding_window():
     assert out[1] == ref
 
 
+def test_falcon_family():
+    from deepspeed_tpu.models.falcon import (FalconConfig,
+                                             FalconForCausalLM)
+    cfg = FalconConfig.tiny()    # MQA + shared-LN parallel residual
+    model = FalconForCausalLM(cfg)
+    _check_family(model, _init(model), cfg)
+
+
+def test_phi_family():
+    from deepspeed_tpu.models.phi import PhiConfig, PhiForCausalLM
+    cfg = PhiConfig.tiny()       # partial rotary, parallel, biased head
+    model = PhiForCausalLM(cfg)
+    _check_family(model, _init(model), cfg)
+
+
+def test_gptj_family():
+    from deepspeed_tpu.models.gptj import GPTJConfig, GPTJForCausalLM
+    cfg = GPTJConfig.tiny()      # interleaved rotary, parallel residual
+    model = GPTJForCausalLM(cfg)
+    _check_family(model, _init(model), cfg)
+
+
+def test_qwen2_family():
+    from deepspeed_tpu.models.qwen2 import Qwen2Config, Qwen2ForCausalLM
+    cfg = Qwen2Config.tiny()     # llama arch + biased q/k/v
+    model = Qwen2ForCausalLM(cfg)
+    _check_family(model, _init(model), cfg)
+
+
 def test_mixtral_moe_family():
     from deepspeed_tpu.models.mixtral import (MixtralConfig,
                                               MixtralForCausalLM)

@@ -155,8 +155,12 @@ class TestRegistry:
     def test_detect_from_state_dict(self):
         assert registry.detect_policy(
             {"model.decoder.embed_tokens.weight": 0}).name == "opt"
+        # the embedding LayerNorm is BLOOM's distinctive key (falcon
+        # shares the other transformer.* names)
         assert registry.detect_policy(
-            {"transformer.word_embeddings.weight": 0}).name == "bloom"
+            {"transformer.word_embeddings.weight": 0,
+             "transformer.word_embeddings_layernorm.weight": 0,
+             }).name == "bloom"
         assert registry.detect_policy(
             {"model.embed_tokens.weight": 0}).name == "llama"
         with pytest.raises(KeyError):
