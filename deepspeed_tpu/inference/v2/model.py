@@ -318,8 +318,6 @@ def _adapt_falcon(p, cfg):
         if not cfg.parallel_attn:
             layer["ln2_scale"] = lp["post_attention_layernorm"]["scale"]
             layer["ln2_bias"] = lp["post_attention_layernorm"]["bias"]
-        else:
-            layer["ln2_scale"] = layer["ln1_scale"]  # unused (shared_ln)
         layers.append(layer)
     tree = {"embed": p["word_embeddings"], "layers": layers,
             "final_scale": p["ln_f"]["scale"],
@@ -350,7 +348,6 @@ def _adapt_phi(p, cfg):
             "bv": lp["self_attn"]["v_proj"]["bias"],
             "wo": lp["self_attn"]["dense"]["kernel"],
             "bo": lp["self_attn"]["dense"]["bias"],
-            "ln2_scale": lp["input_layernorm"]["scale"],  # shared_ln
             "w_in": lp["fc1"]["kernel"], "b_in": lp["fc1"]["bias"],
             "w_out": lp["fc2"]["kernel"], "b_out": lp["fc2"]["bias"],
         })
@@ -380,7 +377,6 @@ def _adapt_gptj(p, cfg):
             "wk": lp["attn"]["k_proj"]["kernel"],
             "wv": lp["attn"]["v_proj"]["kernel"],
             "wo": lp["attn"]["out_proj"]["kernel"],
-            "ln2_scale": lp["ln_1"]["scale"],        # shared_ln
             "w_in": lp["fc_in"]["kernel"], "b_in": lp["fc_in"]["bias"],
             "w_out": lp["fc_out"]["kernel"],
             "b_out": lp["fc_out"]["bias"],
@@ -616,12 +612,9 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
             attn_out = attn_out + lp["bo"]
 
         mlp_in = x if spec.parallel_residual else x + attn_out
-        if spec.shared_ln:
-            h2 = h              # Falcon/Phi/GPT-J: ln1's output feeds MLP
-        else:
-            h2 = _norm(mlp_in, lp["ln2_scale"], lp.get("ln2_bias"),
-                       spec.norm, spec.eps)
-        h = h2
+        if not spec.shared_ln:   # shared_ln: ln1's output (h) feeds MLP
+            h = _norm(mlp_in, lp["ln2_scale"], lp.get("ln2_bias"),
+                      spec.norm, spec.eps)
         if spec.n_experts:
             mlp_out = moe_mlp_ragged(h, lp["router"], lp["we_gate"],
                                      lp["we_up"], lp["we_down"],

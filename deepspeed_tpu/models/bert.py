@@ -148,7 +148,6 @@ class BertForMaskedLM(nn.Module):
 
 def bert_tensor_rules(name, shape):
     col = ("self.query", "self.key", "self.value", "intermediate")
-    row = ("attn_output", ".output.")
     if any(f"{m}.kernel" in name for m in col):
         return P(None, TENSOR_AXIS)
     if any(f"{m}.bias" in name for m in col):

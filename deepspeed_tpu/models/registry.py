@@ -55,7 +55,8 @@ register(ModelPolicy(
     tensor_rules=bloom.bloom_tensor_rules,
     # the embedding LayerNorm distinguishes BLOOM from Falcon, whose
     # transformer.* layer names otherwise overlap
-    hf_keys=("transformer.word_embeddings_layernorm.weight",)))
+    hf_keys=("transformer.word_embeddings_layernorm.weight",
+             "word_embeddings_layernorm.weight")))
 register(ModelPolicy(
     name="gptneox", config_cls=gptneox.GPTNeoXConfig,
     model_cls=gptneox.GPTNeoXForCausalLM,
@@ -78,18 +79,21 @@ register(ModelPolicy(
     model_cls=gptneo.GPTNeoForCausalLM,
     from_hf=gptneo.from_hf_state_dict,
     tensor_rules=gptneo.gptneo_tensor_rules,
-    hf_keys=("transformer.h.0.attn.attention.q_proj.weight",)))
+    hf_keys=("transformer.h.0.attn.attention.q_proj.weight",
+             "h.0.attn.attention.q_proj.weight")))
 register(ModelPolicy(
     name="falcon", config_cls=falcon.FalconConfig,
     model_cls=falcon.FalconForCausalLM,
     from_hf=falcon.from_hf_state_dict,
     tensor_rules=falcon.falcon_tensor_rules,
-    hf_keys=("transformer.h.0.self_attention.query_key_value.weight",)))
+    hf_keys=("transformer.h.0.self_attention.query_key_value.weight",
+             "h.0.self_attention.query_key_value.weight")))
 register(ModelPolicy(
     name="phi", config_cls=phi.PhiConfig,
     model_cls=phi.PhiForCausalLM, from_hf=phi.from_hf_state_dict,
     tensor_rules=phi.phi_tensor_rules,
-    hf_keys=("model.final_layernorm.weight",)))
+    hf_keys=("model.final_layernorm.weight",
+             "final_layernorm.weight")))
 register(ModelPolicy(
     name="qwen2", config_cls=qwen2.Qwen2Config,
     model_cls=qwen2.Qwen2ForCausalLM,

@@ -50,18 +50,29 @@ class DeepSpeedCPUAdam:
                              f"{len(self.master)} params")
         self.step_count += 1
         lr = self.lr if lr is None else lr
-        b1, b2 = self.betas
         for p, g, m, v in zip(self.master, grads, self.m, self.v):
-            g = np.ascontiguousarray(np.asarray(g), dtype=np.float32)
-            if self._lib is not None:
-                self._lib.ds_adam_step(
-                    p.reshape(-1), g.reshape(-1), m.reshape(-1),
-                    v.reshape(-1), p.size, lr, b1, b2, self.eps,
-                    self.weight_decay, self.step_count,
-                    int(self.adamw_mode))
-            else:
-                self._numpy_step(p, g, m, v, lr)
+            self.step_arrays(p, g, m, v, lr, self.step_count)
         return self.master
+
+    def step_arrays(self, p, g, m, v, lr=None, step_count=None):
+        """One leaf's Adam update in place — the shared per-leaf kernel
+        used by step() and the NVMe swapper's read->step->write loop."""
+        lr = self.lr if lr is None else lr
+        step_count = self.step_count if step_count is None else step_count
+        g = np.ascontiguousarray(np.asarray(g), dtype=np.float32)
+        if self._lib is not None:
+            b1, b2 = self.betas
+            self._lib.ds_adam_step(
+                p.reshape(-1), g.reshape(-1), m.reshape(-1),
+                v.reshape(-1), p.size, lr, b1, b2, self.eps,
+                self.weight_decay, step_count, int(self.adamw_mode))
+        else:
+            prev = self.step_count
+            self.step_count = step_count
+            try:
+                self._numpy_step(p, g, m, v, lr)
+            finally:
+                self.step_count = prev
 
     def _numpy_step(self, p, g, m, v, lr):
         b1, b2 = self.betas
@@ -78,16 +89,21 @@ class DeepSpeedCPUAdam:
             upd = upd + self.weight_decay * p
         p -= lr * upd
 
+    def to_bf16(self, p: np.ndarray):
+        """fp32 array -> bf16-rounded payload (native kernel or
+        ml_dtypes)."""
+        import ml_dtypes
+        if self._lib is not None:
+            out = np.empty(p.shape, dtype=np.uint16)
+            self._lib.ds_f32_to_bf16(p.reshape(-1), out.reshape(-1),
+                                     p.size)
+            return out.view(ml_dtypes.bfloat16)
+        return p.astype(ml_dtypes.bfloat16)
+
     def master_bf16(self, i: int):
         """Leaf i as bf16-rounded uint16 buffer (native) or ml_dtypes
         view — the push-back payload for device compute params."""
-        import ml_dtypes
-        p = self.master[i]
-        if self._lib is not None:
-            out = np.empty(p.shape, dtype=np.uint16)
-            self._lib.ds_f32_to_bf16(p.reshape(-1), out.reshape(-1), p.size)
-            return out.view(ml_dtypes.bfloat16)
-        return p.astype(ml_dtypes.bfloat16)
+        return self.to_bf16(self.master[i])
 
     def state_dict(self):
         return {"step": self.step_count, "master": self.master,

@@ -1,0 +1,3 @@
+from .async_io import AsyncIOHandle, NVMeStateStore
+
+__all__ = ["AsyncIOHandle", "NVMeStateStore"]

@@ -1,0 +1,153 @@
+"""Async file I/O — the AsyncIOBuilder front end + NVMe state store.
+
+Reference: deepspeed/ops/aio (AsyncIOBuilder over csrc/aio's libaio
+thread pool) and runtime/swap_tensor/partitioned_optimizer_swapper.py
+(tensor <-> NVMe round trips around the optimizer step).
+
+``AsyncIOHandle`` wraps the C++ pool (csrc/aio/aio_pool.cpp) through
+ctypes; ``NVMeStateStore`` lays a list of fp32 arrays out in one file
+and swaps them in/out asynchronously — the ZeRO-Infinity optimizer-
+state tier behind ``offload_optimizer.device="nvme"``.
+"""
+
+import ctypes
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..op_builder.builder import OpBuilder
+
+
+class AsyncIOBuilder(OpBuilder):
+    NAME = "aio_pool"
+
+    def sources(self):
+        return ["csrc/aio/aio_pool.cpp"]
+
+    def extra_flags(self):
+        return ["-pthread"]
+
+    def _configure(self, lib):
+        lib.aio_open.restype = ctypes.c_void_p
+        lib.aio_open.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                 ctypes.c_int]
+        lib.aio_submit_write.restype = ctypes.c_int64
+        lib.aio_submit_write.argtypes = [ctypes.c_void_p,
+                                         ctypes.c_void_p,
+                                         ctypes.c_int64, ctypes.c_int64]
+        lib.aio_submit_read.restype = ctypes.c_int64
+        lib.aio_submit_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_int64, ctypes.c_int64]
+        lib.aio_wait_all.restype = ctypes.c_int
+        lib.aio_wait_all.argtypes = [ctypes.c_void_p]
+        lib.aio_pending.restype = ctypes.c_int64
+        lib.aio_pending.argtypes = [ctypes.c_void_p]
+        lib.aio_fsync.argtypes = [ctypes.c_void_p]
+        lib.aio_close.argtypes = [ctypes.c_void_p]
+
+
+class AsyncIOHandle:
+    """One open file + its IO thread pool (reference: py_aio_handle).
+
+    Buffers passed to pread/pwrite must stay alive until ``wait()``.
+    """
+
+    def __init__(self, path: str, nbytes: int = 0, n_threads: int = 4):
+        self._lib = AsyncIOBuilder().load()
+        self._h = self._lib.aio_open(
+            os.fsencode(path), ctypes.c_int64(nbytes), n_threads)
+        if not self._h:
+            raise OSError(f"aio_open failed for {path}")
+        self.path = path
+
+    def pwrite(self, arr: np.ndarray, offset: int):
+        arr = np.ascontiguousarray(arr)
+        self._lib.aio_submit_write(
+            self._h, arr.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int64(arr.nbytes), ctypes.c_int64(offset))
+        return arr  # caller keeps it alive until wait()
+
+    def pread(self, arr: np.ndarray, offset: int):
+        assert arr.flags["C_CONTIGUOUS"]
+        self._lib.aio_submit_read(
+            self._h, arr.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int64(arr.nbytes), ctypes.c_int64(offset))
+        return arr
+
+    def pending(self) -> int:
+        return int(self._lib.aio_pending(self._h))
+
+    def wait(self):
+        err = self._lib.aio_wait_all(self._h)
+        if err:
+            raise OSError(-err, f"async IO failed on {self.path}: "
+                                f"{os.strerror(-err)}")
+
+    def fsync(self):
+        self._lib.aio_fsync(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.aio_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NVMeStateStore:
+    """File-backed storage for a list of fp32 arrays (optimizer-state
+    tier). Layout: arrays are packed back to back, 4096-aligned (the
+    O_DIRECT-friendly layout of the reference swapper's aligned
+    buffers). ``read_all``/``write_all`` overlap across the IO pool and
+    drain on ``wait``."""
+
+    ALIGN = 4096
+
+    def __init__(self, path: str, arrays: Sequence[np.ndarray],
+                 n_threads: int = 4):
+        self.offsets: List[int] = []
+        off = 0
+        for a in arrays:
+            self.offsets.append(off)
+            off += (a.nbytes + self.ALIGN - 1) // self.ALIGN * self.ALIGN
+        self.nbytes = off
+        self.handle = AsyncIOHandle(path, nbytes=off, n_threads=n_threads)
+        # initial population so the first read returns the init values.
+        # The converted buffers MUST stay referenced until wait() — the
+        # pool threads read them asynchronously.
+        keep = [self.handle.pwrite(np.asarray(a, np.float32), o)
+                for a, o in zip(arrays, self.offsets)]
+        self.handle.wait()
+        del keep
+
+    def submit_write(self, idx: int, arr: np.ndarray):
+        """Async write of region ``idx``; caller keeps ``arr`` alive
+        until the next wait()."""
+        return self.handle.pwrite(arr, self.offsets[idx])
+
+    def submit_read(self, idx: int, arr: np.ndarray):
+        return self.handle.pread(arr, self.offsets[idx])
+
+    def wait(self):
+        self.handle.wait()
+
+    def write_all(self, arrays: Sequence[np.ndarray]):
+        keep = [self.handle.pwrite(np.asarray(a, np.float32), o)
+                for a, o in zip(arrays, self.offsets)]
+        self.handle.wait()
+        return keep
+
+    def read_all(self, arrays: Sequence[np.ndarray]):
+        """Fill the given preallocated fp32 arrays in place."""
+        for a, o in zip(arrays, self.offsets):
+            self.handle.pread(a, o)
+        self.handle.wait()
+        return arrays
+
+    def close(self):
+        self.handle.close()

@@ -155,13 +155,18 @@ class DeepSpeedEngine:
         # partial ratio = ZeRO-Offload++ engine.py:725)
         self._offload = None
         self._offload_cfg = None
-        if zc.offload_optimizer.device == "cpu":
+        if zc.offload_optimizer.device in ("cpu", "nvme"):
             self._offload_cfg = zc.offload_optimizer
+            if zc.offload_optimizer.device == "nvme" and \
+                    not zc.offload_optimizer.nvme_path:
+                raise ValueError(
+                    "offload_optimizer.device='nvme' needs nvme_path")
         elif zc.offload_optimizer.device not in ("none", None):
             raise ValueError(
                 f"offload_optimizer.device="
                 f"{zc.offload_optimizer.device!r} unsupported; TPU-VM "
-                f"offload targets host DRAM ('cpu')")
+                f"offload targets host DRAM ('cpu') or a local NVMe "
+                f"path ('nvme')")
         # ZeRO-Infinity parameter offload: master fp32 params (and
         # optimizer state) live in HOST memory (pinned_host memory kind);
         # the jitted step streams them to device for the compute view and
@@ -426,7 +431,9 @@ class DeepSpeedEngine:
         self._offload = OffloadCoordinator(
             master, mask, opt_cfg=opt_params,
             compute_dtype=self.compute_dtype,
-            adamw_mode=adamw_mode)
+            adamw_mode=adamw_mode,
+            nvme_path=self._offload_cfg.nvme_path
+            if self._offload_cfg.device == "nvme" else None)
         master = self._offload.initial_device_leaves(master)
         flat, treedef = jax.tree_util.tree_flatten(master)
         device_mask = jax.tree_util.tree_unflatten(
@@ -1641,10 +1648,11 @@ class DeepSpeedEngine:
         master = self.state.master_params
         if self._offload is not None:
             # offloaded leaves live on device only in compute dtype; the
-            # true fp32 master is host-side
+            # true fp32 master is host-side (or NVMe-resident)
+            masters = self._offload.master_arrays()
             flat, treedef = jax.tree_util.tree_flatten(master)
             for slot, i in enumerate(self._offload.off_idx):
-                flat[i] = jnp.asarray(self._offload.host_adam.master[slot])
+                flat[i] = jnp.asarray(masters[slot])
             master = jax.tree_util.tree_unflatten(treedef, flat)
         replicated = NamedSharding(self.mesh, P())
         full = jax.jit(

@@ -46,10 +46,17 @@ def select_offload_mask(params, ratio: float) -> List[bool]:
 
 
 class OffloadCoordinator:
-    """Owns host optimizer state for the offloaded leaves."""
+    """Owns host optimizer state for the offloaded leaves.
+
+    ``nvme_path``: ZeRO-Infinity tier — the fp32 master + Adam moments
+    live in a file on the NVMe path between steps and round-trip
+    through the async IO pool (csrc/aio) around each host Adam step
+    (reference: swap_tensor/partitioned_optimizer_swapper.py). DRAM
+    holds only the reusable step buffers."""
 
     def __init__(self, master_params, mask: List[bool], opt_cfg: dict,
-                 compute_dtype, adamw_mode: bool = True):
+                 compute_dtype, adamw_mode: bool = True,
+                 nvme_path: Optional[str] = None):
         self.mask = mask
         self.compute_dtype = compute_dtype
         flat, self.treedef = jax.tree_util.tree_flatten(master_params)
@@ -65,11 +72,47 @@ class OffloadCoordinator:
             eps=p.get("eps", 1e-8),
             weight_decay=p.get("weight_decay", 0.0),
             adamw_mode=adamw_mode)
+        self.store = None
+        if nvme_path is not None and not self.off_idx:
+            log_dist("ZeRO-Offload: nvme tier requested but the ratio "
+                     "selected no leaves; nothing to swap", ranks=[0])
+            nvme_path = None
+        if nvme_path is not None:
+            import os
+            from ...ops.aio import NVMeStateStore
+            os.makedirs(nvme_path, exist_ok=True)
+            ha = self.host_adam
+            self._shapes = [a.shape for a in ha.master]
+            self.store = NVMeStateStore(
+                os.path.join(nvme_path, "zero_offload_state.bin"),
+                list(ha.master) + list(ha.m) + list(ha.v))
+            # DRAM is bounded by the swap buffers, not the state: after
+            # seeding the file, the full-size master/m/v arrays are
+            # RELEASED and every step streams leaf-by-leaf through a
+            # double-buffered scratch pair (reference:
+            # swap_tensor/pipelined_optimizer_swapper.py)
+            ha.master = ha.m = ha.v = None
+            max_n = max(int(np.prod(s)) for s in self._shapes)
+            self._scratch = [
+                {k: np.empty(max_n, np.float32) for k in "pmv"}
+                for _ in range(2)]
         n_off = sum(int(np.prod(a.shape)) for a in off_params)
         log_dist(f"ZeRO-Offload: {len(self.off_idx)} leaves "
-                 f"({n_off/1e6:.2f}M params) host-resident "
+                 f"({n_off/1e6:.2f}M params) "
+                 f"{'NVMe' if self.store else 'host'}-resident "
                  f"(native={'yes' if self.host_adam.native else 'numpy'})",
                  ranks=[0])
+
+    def master_arrays(self) -> List[np.ndarray]:
+        """Current fp32 masters per offloaded slot — from DRAM, or read
+        back through the store in the NVMe tier (transient copies)."""
+        if self.store is not None:
+            masters = [np.empty(s, np.float32) for s in self._shapes]
+            for slot, a in enumerate(masters):
+                self.store.submit_read(slot, a.reshape(-1))
+            self.store.wait()
+            return masters
+        return list(self.host_adam.master)
 
     def initial_device_leaves(self, master_params):
         """Replace offloaded leaves of the device master tree with
@@ -91,6 +134,8 @@ class OffloadCoordinator:
             return None
         host = jax.device_get(list(off_grads))
         np_grads = [np.asarray(g, dtype=np.float32) for g in host]
+        if self.store is not None:
+            return self._nvme_step(np_grads, lr, shardings)
         self.host_adam.step(np_grads, lr=lr)
         leaves = []
         for slot in range(len(self.off_idx)):
@@ -100,6 +145,53 @@ class OffloadCoordinator:
                 payload = self.host_adam.master[slot].astype(
                     np.dtype(self.compute_dtype))
             leaves.append(jax.device_put(payload, shardings[slot]))
+        return leaves
+
+    def _nvme_slot_views(self, buf, slot):
+        n = int(np.prod(self._shapes[slot]))
+        return (buf["p"][:n].reshape(self._shapes[slot]),
+                buf["m"][:n].reshape(self._shapes[slot]),
+                buf["v"][:n].reshape(self._shapes[slot]))
+
+    def _nvme_submit_reads(self, buf, slot):
+        n_slots = len(self._shapes)
+        p, m, v = self._nvme_slot_views(buf, slot)
+        self.store.submit_read(slot, p.reshape(-1))
+        self.store.submit_read(n_slots + slot, m.reshape(-1))
+        self.store.submit_read(2 * n_slots + slot, v.reshape(-1))
+
+    def _nvme_step(self, np_grads, lr, shardings):
+        """Per-leaf pipelined swap: leaf i+1's reads are prefetched
+        while leaf i computes; leaf i's writes drain together with that
+        prefetch at the next wait-all (they sit before leaf i+1's
+        compute, not under it — a third scratch set would be needed to
+        push writes fully off the critical path). DRAM holds two
+        scratch sets of the LARGEST leaf, never the full state
+        (reference: pipelined_optimizer_swapper.py)."""
+        ha = self.host_adam
+        n_slots = len(self._shapes)
+        step_count = ha.step_count + 1
+        self._nvme_submit_reads(self._scratch[0], 0)
+        leaves = []
+        for slot in range(n_slots):
+            # drain this slot's reads (and the previous slot's writes,
+            # whose buffer is about to be reused for the prefetch)
+            self.store.wait()
+            if slot + 1 < n_slots:
+                self._nvme_submit_reads(self._scratch[(slot + 1) % 2],
+                                        slot + 1)
+            p, m, v = self._nvme_slot_views(self._scratch[slot % 2], slot)
+            ha.step_arrays(p, np_grads[slot], m, v, lr, step_count)
+            if self.compute_dtype == jnp.bfloat16:
+                payload = ha.to_bf16(p)
+            else:
+                payload = p.astype(np.dtype(self.compute_dtype))
+            leaves.append(jax.device_put(payload, shardings[slot]))
+            self.store.submit_write(slot, p.reshape(-1))
+            self.store.submit_write(n_slots + slot, m.reshape(-1))
+            self.store.submit_write(2 * n_slots + slot, v.reshape(-1))
+        self.store.wait()
+        ha.step_count = step_count
         return leaves
 
     def merge(self, state_master, leaves: Optional[list]):
@@ -143,6 +235,15 @@ class OffloadCoordinator:
 
     # -- checkpoint --------------------------------------------------------
     def state_dict(self):
+        if self.store is not None:
+            # transient full read for the checkpoint payload only
+            arrays = [np.empty(s, np.float32)
+                      for _ in range(3) for s in self._shapes]
+            self.store.read_all(arrays)
+            n = len(self._shapes)
+            return {"step": self.host_adam.step_count,
+                    "master": arrays[:n], "m": arrays[n:2 * n],
+                    "v": arrays[2 * n:], "off_idx": list(self.off_idx)}
         sd = self.host_adam.state_dict()
         return {"step": sd["step"],
                 "master": [np.asarray(a) for a in sd["master"]],
@@ -153,4 +254,9 @@ class OffloadCoordinator:
     def load_state_dict(self, sd):
         if list(sd["off_idx"]) != list(self.off_idx):
             raise ValueError("offload leaf layout mismatch on restore")
+        if self.store is not None:
+            self.host_adam.step_count = int(sd["step"])
+            self.store.write_all(list(sd["master"]) + list(sd["m"]) +
+                                 list(sd["v"]))
+            return
         self.host_adam.load_state_dict(sd)
