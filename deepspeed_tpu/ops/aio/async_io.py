@@ -60,16 +60,22 @@ class AsyncIOHandle:
         if not self._h:
             raise OSError(f"aio_open failed for {path}")
         self.path = path
+        # buffers for in-flight requests: the pool threads read/write
+        # them asynchronously, so the handle itself retains a reference
+        # (incl. any contiguity copy pwrite made) until wait()
+        self._pending_bufs: list = []
 
     def pwrite(self, arr: np.ndarray, offset: int):
         arr = np.ascontiguousarray(arr)
+        self._pending_bufs.append(arr)
         self._lib.aio_submit_write(
             self._h, arr.ctypes.data_as(ctypes.c_void_p),
             ctypes.c_int64(arr.nbytes), ctypes.c_int64(offset))
-        return arr  # caller keeps it alive until wait()
+        return arr
 
     def pread(self, arr: np.ndarray, offset: int):
         assert arr.flags["C_CONTIGUOUS"]
+        self._pending_bufs.append(arr)
         self._lib.aio_submit_read(
             self._h, arr.ctypes.data_as(ctypes.c_void_p),
             ctypes.c_int64(arr.nbytes), ctypes.c_int64(offset))
@@ -80,6 +86,7 @@ class AsyncIOHandle:
 
     def wait(self):
         err = self._lib.aio_wait_all(self._h)
+        self._pending_bufs.clear()
         if err:
             raise OSError(-err, f"async IO failed on {self.path}: "
                                 f"{os.strerror(-err)}")

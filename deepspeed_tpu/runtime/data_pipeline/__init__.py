@@ -1,3 +1,5 @@
 from .curriculum_scheduler import CurriculumScheduler
+from .data_analyzer import (DataAnalyzer, DifficultyBasedSampler,
+                            DifficultyIndex, seqlen_metric)
 from .data_sampling import CurriculumDataSampler, truncate_to_difficulty
 from .random_ltd import RandomLTDScheduler, random_ltd_layer

@@ -1,0 +1,176 @@
+"""Data-efficiency analysis — offline difficulty indexing + sampling.
+
+Reference: deepspeed/runtime/data_pipeline/data_sampling/
+data_analyzer.py:21 ``DataAnalyzer`` (map: per-worker metric passes
+over the dataset into mmap index files; reduce: merge workers into
+sample_to_metric / metric_to_sample indexes) and data_sampler.py's
+``DeepSpeedDataSampler`` (curriculum consumption: draw batches only
+from samples whose difficulty is within the scheduler's current
+threshold).
+
+TPU-native form: the analysis is host-side numpy (no torch dataloaders,
+no mmap builders — npz shards per worker, one merged npz index), and
+the sampler is a plain iterator over indices, composable with
+DeepSpeedDataLoader. Metric functions map a SAMPLE -> scalar (e.g.
+token count = the canonical seqlen curriculum metric).
+"""
+
+import glob
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...utils.logging import logger
+
+
+def seqlen_metric(sample) -> int:
+    """Canonical difficulty metric: number of non-padding tokens
+    (reference: data_analyzer's seqlen metric used by the curriculum
+    tutorial). Accepts dict samples with 'input_ids' or raw arrays."""
+    ids = sample["input_ids"] if isinstance(sample, dict) else sample
+    ids = np.asarray(ids)
+    return int(np.count_nonzero(ids)) if ids.ndim else 1
+
+
+class DataAnalyzer:
+    """Map-reduce difficulty indexing over a dataset.
+
+    map: each worker walks its contiguous shard of ``dataset`` and
+    writes ``<save_path>/<metric>/worker<id>.npz`` with (indices,
+    values). reduce: merge every worker shard into
+    ``<save_path>/<metric>/index.npz`` holding
+
+      sample_to_metric: [N] metric value per sample index
+      metric_values:    sorted unique metric values
+      metric_to_sample_*: per unique value, the sample indices
+                          (a ragged index stored as offsets + concat)
+    """
+
+    def __init__(self, dataset: Sequence, num_workers: int = 1,
+                 worker_id: int = 0,
+                 metric_names: Optional[List[str]] = None,
+                 metric_functions: Optional[List[Callable]] = None,
+                 save_path: str = "./data_analysis",
+                 batch_size: int = 0):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.metric_names = metric_names or ["seqlen"]
+        self.metric_functions = metric_functions or [seqlen_metric]
+        if len(self.metric_names) != len(self.metric_functions):
+            raise ValueError("metric_names and metric_functions must "
+                             "pair up")
+        self.save_path = save_path
+
+    def _shard_range(self):
+        n = len(self.dataset)
+        per = -(-n // self.num_workers)
+        lo = self.worker_id * per
+        return lo, min(n, lo + per)
+
+    def run_map(self) -> Dict[str, str]:
+        """Compute this worker's metrics; returns {metric: shard path}."""
+        lo, hi = self._shard_range()
+        idx = np.arange(lo, hi)
+        out = {}
+        for name, fn in zip(self.metric_names, self.metric_functions):
+            values = np.asarray([fn(self.dataset[i]) for i in idx])
+            d = os.path.join(self.save_path, name)
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"worker{self.worker_id}.npz")
+            np.savez(path, indices=idx, values=values)
+            out[name] = path
+        logger.info(f"DataAnalyzer map: worker {self.worker_id} wrote "
+                    f"samples [{lo}, {hi}) for {self.metric_names}")
+        return out
+
+    def run_reduce(self) -> Dict[str, str]:
+        """Merge every worker's shards into one index per metric."""
+        out = {}
+        for name in self.metric_names:
+            d = os.path.join(self.save_path, name)
+            shards = sorted(glob.glob(os.path.join(d, "worker*.npz")))
+            if not shards:
+                raise FileNotFoundError(
+                    f"no map shards under {d}; run run_map first")
+            idx_parts, val_parts = [], []
+            for s in shards:
+                z = np.load(s)
+                idx_parts.append(z["indices"])
+                val_parts.append(z["values"])
+            indices = np.concatenate(idx_parts)
+            values = np.concatenate(val_parts)
+            n = int(indices.max()) + 1 if indices.size else 0
+            sample_to_metric = np.zeros((n,), values.dtype)
+            sample_to_metric[indices] = values
+            order = np.argsort(sample_to_metric, kind="stable")
+            uniq, starts = np.unique(sample_to_metric[order],
+                                     return_index=True)
+            path = os.path.join(d, "index.npz")
+            np.savez(path, sample_to_metric=sample_to_metric,
+                     metric_values=uniq,
+                     sorted_samples=order,
+                     value_offsets=np.append(starts, n))
+            out[name] = path
+        return out
+
+    def run_map_reduce(self) -> Dict[str, str]:
+        self.run_map()
+        return self.run_reduce()
+
+
+class DifficultyIndex:
+    """Loaded reduce output; answers 'which samples are <= difficulty'."""
+
+    def __init__(self, path: str):
+        z = np.load(path)
+        self.sample_to_metric = z["sample_to_metric"]
+        self.metric_values = z["metric_values"]
+        self.sorted_samples = z["sorted_samples"]
+        self.value_offsets = z["value_offsets"]
+
+    def samples_within(self, difficulty) -> np.ndarray:
+        """Sample indices whose metric <= difficulty (sorted by metric,
+        O(log V) — no rescan of the whole table)."""
+        pos = np.searchsorted(self.metric_values, difficulty,
+                              side="right")
+        return self.sorted_samples[: self.value_offsets[pos]]
+
+
+class DifficultyBasedSampler:
+    """Curriculum batch sampler (reference: data_sampling/
+    data_sampler.py DeepSpeedDataSampler): draws shuffled batches only
+    from samples within the CurriculumScheduler's current difficulty;
+    ``step()`` advances the schedule (the engine calls it per global
+    step, same contract as CurriculumDataSampler)."""
+
+    def __init__(self, index: DifficultyIndex, scheduler, batch_size: int,
+                 seed: int = 0, drop_last: bool = True):
+        self.index = index
+        self.scheduler = scheduler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._rng = np.random.default_rng(seed)
+        self.global_steps = 0
+
+    @property
+    def current_difficulty(self):
+        return self.scheduler.current_difficulty
+
+    def step(self):
+        self.global_steps += 1
+        return self.scheduler.update_difficulty(self.global_steps)
+
+    def __iter__(self):
+        while True:
+            pool = self.index.samples_within(
+                self.scheduler.current_difficulty)
+            if len(pool) < self.batch_size and self.drop_last:
+                raise ValueError(
+                    f"only {len(pool)} samples within difficulty "
+                    f"{self.scheduler.current_difficulty} but "
+                    f"batch_size={self.batch_size}; lower "
+                    "minimum_difficulty or disable drop_last")
+            take = min(self.batch_size, len(pool))
+            yield self._rng.choice(pool, size=take, replace=False)

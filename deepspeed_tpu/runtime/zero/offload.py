@@ -79,12 +79,18 @@ class OffloadCoordinator:
             nvme_path = None
         if nvme_path is not None:
             import os
+            import uuid
             from ...ops.aio import NVMeStateStore
             os.makedirs(nvme_path, exist_ok=True)
             ha = self.host_adam
             self._shapes = [a.shape for a in ha.master]
+            # unique per-coordinator file: a fixed name would let a
+            # second engine pointed at the same nvme_path clobber a live
+            # engine's optimizer state at store init
+            fname = f"zero_offload_state_{os.getpid()}_" \
+                    f"{uuid.uuid4().hex[:8]}.bin"
             self.store = NVMeStateStore(
-                os.path.join(nvme_path, "zero_offload_state.bin"),
+                os.path.join(nvme_path, fname),
                 list(ha.master) + list(ha.m) + list(ha.v))
             # DRAM is bounded by the swap buffers, not the state: after
             # seeding the file, the full-size master/m/v arrays are
@@ -137,15 +143,18 @@ class OffloadCoordinator:
         if self.store is not None:
             return self._nvme_step(np_grads, lr, shardings)
         self.host_adam.step(np_grads, lr=lr)
-        leaves = []
-        for slot in range(len(self.off_idx)):
-            if self.compute_dtype == jnp.bfloat16:
-                payload = self.host_adam.master_bf16(slot)
-            else:
-                payload = self.host_adam.master[slot].astype(
-                    np.dtype(self.compute_dtype))
-            leaves.append(jax.device_put(payload, shardings[slot]))
-        return leaves
+        return [self._device_payload(self.host_adam.master[slot],
+                                     shardings[slot])
+                for slot in range(len(self.off_idx))]
+
+    def _device_payload(self, p: np.ndarray, sharding):
+        """fp32 master -> compute-dtype device leaf (one rounding path
+        shared by the DRAM and NVMe tiers)."""
+        if self.compute_dtype == jnp.bfloat16:
+            payload = self.host_adam.to_bf16(p)
+        else:
+            payload = p.astype(np.dtype(self.compute_dtype))
+        return jax.device_put(payload, sharding)
 
     def _nvme_slot_views(self, buf, slot):
         n = int(np.prod(self._shapes[slot]))
@@ -182,11 +191,7 @@ class OffloadCoordinator:
                                         slot + 1)
             p, m, v = self._nvme_slot_views(self._scratch[slot % 2], slot)
             ha.step_arrays(p, np_grads[slot], m, v, lr, step_count)
-            if self.compute_dtype == jnp.bfloat16:
-                payload = ha.to_bf16(p)
-            else:
-                payload = p.astype(np.dtype(self.compute_dtype))
-            leaves.append(jax.device_put(payload, shardings[slot]))
+            leaves.append(self._device_payload(p, shardings[slot]))
             self.store.submit_write(slot, p.reshape(-1))
             self.store.submit_write(n_slots + slot, m.reshape(-1))
             self.store.submit_write(2 * n_slots + slot, v.reshape(-1))

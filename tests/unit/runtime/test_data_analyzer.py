@@ -1,0 +1,100 @@
+"""DataAnalyzer map-reduce indexing + difficulty-based curriculum
+sampling (reference: data_sampling/data_analyzer.py + data_sampler.py).
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                 DataAnalyzer,
+                                                 DifficultyBasedSampler,
+                                                 DifficultyIndex,
+                                                 seqlen_metric)
+
+
+def _dataset(n=64, seed=0):
+    """Variable-length samples padded to 32: difficulty = token count."""
+    rng = np.random.default_rng(seed)
+    data = []
+    for i in range(n):
+        ln = int(rng.integers(4, 33))
+        ids = np.zeros(32, np.int32)
+        ids[:ln] = rng.integers(1, 100, ln)
+        data.append({"input_ids": ids})
+    return data
+
+
+class TestDataAnalyzer:
+
+    def test_map_reduce_single_worker(self, tmp_path):
+        data = _dataset()
+        an = DataAnalyzer(data, save_path=str(tmp_path))
+        paths = an.run_map_reduce()
+        idx = DifficultyIndex(paths["seqlen"])
+        expect = np.asarray([seqlen_metric(s) for s in data])
+        np.testing.assert_array_equal(idx.sample_to_metric, expect)
+        # metric_to_sample: every sample within the max difficulty
+        assert len(idx.samples_within(32)) == len(data)
+        within8 = idx.samples_within(8)
+        assert set(within8) == {i for i, v in enumerate(expect) if v <= 8}
+
+    def test_map_reduce_multi_worker_matches_single(self, tmp_path):
+        data = _dataset()
+        for w in range(4):
+            DataAnalyzer(data, num_workers=4, worker_id=w,
+                         save_path=str(tmp_path / "multi")).run_map()
+        paths = DataAnalyzer(data, num_workers=4,
+                             save_path=str(tmp_path / "multi")).run_reduce()
+        single = DataAnalyzer(data,
+                              save_path=str(tmp_path / "single"))
+        spaths = single.run_map_reduce()
+        a = DifficultyIndex(paths["seqlen"])
+        b = DifficultyIndex(spaths["seqlen"])
+        np.testing.assert_array_equal(a.sample_to_metric,
+                                      b.sample_to_metric)
+
+    def test_reduce_without_map_fails_clean(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="map shards"):
+            DataAnalyzer(_dataset(),
+                         save_path=str(tmp_path)).run_reduce()
+
+
+class TestDifficultySampler:
+
+    def test_sampler_respects_and_expands_difficulty(self, tmp_path):
+        data = _dataset()
+        paths = DataAnalyzer(data, save_path=str(tmp_path)).run_map_reduce()
+        idx = DifficultyIndex(paths["seqlen"])
+        sched = CurriculumScheduler({
+            "minimum_difficulty": 8, "maximum_difficulty": 32,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 4}})
+        sampler = DifficultyBasedSampler(idx, sched, batch_size=4)
+        metric = idx.sample_to_metric
+        it = iter(sampler)
+        batch = next(it)
+        assert (metric[batch] <= 8).all()
+        for step in range(1, 11):
+            sampler.step()
+        assert sched.current_difficulty == 32
+        seen = set()
+        for _ in range(30):
+            b = next(it)
+            assert (metric[b] <= 32).all()
+            seen.update(int(x) for x in b)
+        # the expanded pool is actually drawn from (hard samples appear)
+        assert max(metric[list(seen)]) > 8
+
+    def test_sampler_errors_when_pool_too_small(self, tmp_path):
+        data = _dataset()
+        paths = DataAnalyzer(data, save_path=str(tmp_path)).run_map_reduce()
+        idx = DifficultyIndex(paths["seqlen"])
+        sched = CurriculumScheduler({
+            "minimum_difficulty": 1, "maximum_difficulty": 32,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 1}})
+        sampler = DifficultyBasedSampler(idx, sched, batch_size=64)
+        with pytest.raises(ValueError, match="within difficulty"):
+            next(iter(sampler))
