@@ -112,8 +112,8 @@ class TestNVMeOffloadTraining:
         assert engine._offload.store is not None
         np.testing.assert_allclose(nvme_losses, cpu_losses, rtol=1e-6)
         # state file actually exists and holds the right number of bytes
-        path = os.path.join(str(tmp_path / "nvme"),
-                            "zero_offload_state.bin")
+        path = engine._offload.store.handle.path
+        assert os.path.dirname(path) == str(tmp_path / "nvme")
         assert os.path.getsize(path) >= engine._offload.store.nbytes
 
     def test_nvme_checkpoint_roundtrip(self, eight_devices, tmp_path):
