@@ -78,7 +78,7 @@ def bench_config1():
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
 
     seq = 512
-    # measured (tmp/r3_sweep*.py, BASELINE.md): at GPT-2-small shapes
+    # measured (tools/perf/r3_*.py, BASELINE.md): at GPT-2-small shapes
     # (head_dim 64, seq 512) XLA's fused attention beats the Pallas
     # flash kernel, and micro=8 x gas=128 is the best micro/accum split
     # (0.78 -> 1.06 vs_baseline on the same chip/session)
@@ -104,7 +104,7 @@ def bench_config2():
 
     seq = 512
     # same finding as config 1: XLA attention + small micro wins at
-    # head_dim 64 (0.86 -> 1.11 vs_baseline, tmp/r3_sweep4.py)
+    # head_dim 64 (0.86 -> 1.11 vs_baseline, tools/perf/r3_config23_sweep.py)
     cfg = GPT2Config(vocab_size=50304, n_positions=1024, n_embd=1024,
                      n_layer=24, n_head=16, dropout=0.0, use_flash=False)
     config = {

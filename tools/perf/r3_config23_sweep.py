@@ -1,0 +1,94 @@
+"""Config-2 (GPT-2-medium ZeRO-2) and config-3 (Llama-7B-shape ZeRO-3)
+tuning probes: flash on/off x micro split."""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+CONFIGS = {
+    # name: (which, micro, gas, flash)
+    "c2_base":   ("c2", 16, 32, True),
+    "c2_nf_m8":  ("c2", 8, 64, False),
+    "c2_nf_m16": ("c2", 16, 32, False),
+    "c2_nf_m4":  ("c2", 4, 128, False),
+    "c3_base":   ("c3", 2, 8, True),
+    "c3_nf":     ("c3", 2, 8, False),
+    "c3_m1":     ("c3", 1, 16, True),
+}
+
+
+def run_one(name):
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.profiling.flops_profiler import peak_tflops
+
+    which, micro, gas, flash = CONFIGS[name]
+    if which == "c2":
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+        seq = 512
+        cfg = GPT2Config(vocab_size=50304, n_positions=1024, n_embd=1024,
+                         n_layer=24, n_head=16, dropout=0.0,
+                         use_flash=flash)
+        model = GPT2LMHeadModel(cfg)
+        stage = 2
+        vocab = cfg.vocab_size
+    else:
+        import dataclasses
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        seq = 2048
+        cfg = dataclasses.replace(LlamaConfig.llama2_7b(),
+                                  num_hidden_layers=2, use_remat=True,
+                                  max_position_embeddings=seq)
+        model = LlamaForCausalLM(cfg)
+        stage = 3
+        vocab = cfg.vocab_size
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": stage},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    gb = engine.train_batch_size()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, size=(gb, seq), dtype=np.int32)
+    b = {"input_ids": ids, "labels": ids.copy()}
+    float(engine.train_batch(batch=b))
+    float(engine.train_batch(batch=b))
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        float(engine.train_batch(batch=b))
+        times.append(time.time() - t0)
+    per_step = sorted(times)[len(times) // 2]
+    tps = gb * seq / per_step
+    prof = engine.get_flops_profile()
+    fpt = prof["flops"] / (micro * seq)
+    mfu = tps * fpt / 1e12 / peak_tflops()
+    print(f"RESULT {name}: {tps:,.0f} tok/s  mfu={mfu:.3f} "
+          f"vs54={mfu / 0.54:.3f} step={per_step * 1e3:.0f}ms", flush=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1:
+        run_one(sys.argv[1])
+    else:
+        for n in CONFIGS:
+            env = dict(os.environ)
+            repo = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            env["PYTHONPATH"] = repo + ":" + env.get("PYTHONPATH", "")
+            r = subprocess.run([sys.executable, __file__, n], env=env,
+                               capture_output=True, text=True,
+                               timeout=1800)
+            out = [l for l in r.stdout.splitlines()
+                   if l.startswith("RESULT")]
+            print(out[0] if out else
+                  f"{n} FAILED rc={r.returncode}: "
+                  + (r.stderr.strip().splitlines()[-1][:300]
+                     if r.stderr else ""), flush=True)

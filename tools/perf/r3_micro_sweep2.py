@@ -1,0 +1,84 @@
+"""Round-3 perf ablation: where does the missing ~50% of peak go?
+
+Each config runs in a subprocess (fresh XLA) on the real chip and prints
+one RESULT line with tokens/s and MFU from XLA's own post-fusion flop
+count (same math as bench.py).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+CONFIGS = {
+    # name: (micro, gas, seq, flash, loss_chunk, vocab)
+    "nf_m4":    (4, 256, 512, False, 0, 50304),
+    "nf_m8_g64":(8, 64, 512, False, 0, 50304),
+    "nf_m8_s1k":(8, 128, 1024, False, 0, 50304),
+}
+
+
+def run_one(name):
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.profiling.flops_profiler import peak_tflops
+
+    micro, gas, seq, flash, chunk, vocab = CONFIGS[name]
+    cfg = GPT2Config(vocab_size=vocab, n_positions=1024, n_embd=768,
+                     n_layer=12, n_head=12, dropout=0.0, use_flash=flash,
+                     loss_chunk=chunk)
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg), config=config)
+    gb = engine.train_batch_size()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, size=(gb, seq), dtype=np.int32)
+    b = {"input_ids": ids, "labels": ids.copy()}
+
+    float(engine.train_batch(batch=b))
+    float(engine.train_batch(batch=b))
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        float(engine.train_batch(batch=b))
+        times.append(time.time() - t0)
+    per_step = sorted(times)[len(times) // 2]
+    tps = gb * seq / per_step
+
+    prof = engine.get_flops_profile()
+    micro_tokens = micro * seq
+    fpt = prof["flops"] / micro_tokens
+    mfu = tps * fpt / 1e12 / peak_tflops()
+    print(f"RESULT {name}: {tps:,.0f} tok/s  mfu={mfu:.3f} "
+          f"vs54={mfu / 0.54:.3f} step={per_step * 1e3:.0f}ms "
+          f"fpt={fpt / 1e6:.0f}MF", flush=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1:
+        run_one(sys.argv[1])
+    else:
+        names = list(CONFIGS)
+        for n in names:
+            env = dict(os.environ)
+            repo = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            env["PYTHONPATH"] = repo + ":" + env.get("PYTHONPATH", "")
+            r = subprocess.run([sys.executable, __file__, n], env=env,
+                               capture_output=True, text=True, timeout=1200)
+            out = [l for l in r.stdout.splitlines() if l.startswith("RESULT")]
+            print(out[0] if out else
+                  f"{n} FAILED rc={r.returncode}: "
+                  + (r.stderr.strip().splitlines()[-1][:300] if r.stderr else ""),
+                  flush=True)
