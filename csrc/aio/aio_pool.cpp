@@ -15,6 +15,7 @@
 //   aio_close(handle)
 
 #include <atomic>
+#include <cerrno>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>

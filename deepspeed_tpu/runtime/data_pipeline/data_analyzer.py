@@ -166,11 +166,17 @@ class DifficultyBasedSampler:
         while True:
             pool = self.index.samples_within(
                 self.scheduler.current_difficulty)
+            if len(pool) == 0:
+                raise ValueError(
+                    "no samples with metric <= difficulty "
+                    f"{self.scheduler.current_difficulty}; raise "
+                    "minimum_difficulty so the starting pool is "
+                    "non-empty")
             if len(pool) < self.batch_size and self.drop_last:
                 raise ValueError(
                     f"only {len(pool)} samples within difficulty "
                     f"{self.scheduler.current_difficulty} but "
-                    f"batch_size={self.batch_size}; lower "
+                    f"batch_size={self.batch_size}; raise "
                     "minimum_difficulty or disable drop_last")
             take = min(self.batch_size, len(pool))
             yield self._rng.choice(pool, size=take, replace=False)

@@ -1229,11 +1229,24 @@ class DeepSpeedEngine:
         return the static (comp_bits, prune_on) for the jitted step."""
         if self.compression_scheduler is None:
             return (), False
-        active = self.compression_scheduler.step(self.global_steps)
-        comp_bits = ()
         if self._moq is not None:
             factors = self._eigenvalue_factors(device_batch)
             self._moq.advance(self.global_steps, factors)
+        return self._compression_eval_args()
+
+    def _compression_eval_args(self):
+        """Current (comp_bits, prune_on) derived from the scheduler/MoQ
+        state WITHOUT advancing the schedule — eval/forward must see the
+        QAT target even before the first train step and right after a
+        checkpoint resume (MoQ bits restore with the checkpoint, so the
+        derived args are always current). ``CompressionScheduler.step`` is
+        a pure recompute from ``global_steps``, so calling it here does
+        not mutate schedule progress; MoQ ``advance`` is NOT called."""
+        if self.compression_scheduler is None:
+            return (), False
+        active = self.compression_scheduler.step(self.global_steps)
+        comp_bits = ()
+        if self._moq is not None:
             comp_bits = self._moq.bits_tuple(
                 active.get("weight_quantization", False))
         prune_on = bool(active.get("sparse_pruning")
@@ -1350,7 +1363,6 @@ class DeepSpeedEngine:
                                                sharding=x.sharding),
                 device_batch)
         comp_bits, prune_on = self._compression_step_args(device_batch)
-        self._last_comp_args = (comp_bits, prune_on)
         self._swap_state_in()
         self.state, metrics, off_grads = self._jit_train_step(
             self.state, device_batch, self._next_rng(), comp_bits,
@@ -1451,7 +1463,7 @@ class DeepSpeedEngine:
         self._swap_state_in()
         loss, _ = self._jit_eval_step(
             self.state.master_params, device_batch,
-            *getattr(self, "_last_comp_args", ((), False)))
+            *self._compression_eval_args())
         self._swap_state_out()
         return loss
 
@@ -1479,7 +1491,7 @@ class DeepSpeedEngine:
         self._swap_state_in()
         loss, aux = self._jit_eval_step(
             self.state.master_params, device_batch,
-            *getattr(self, "_last_comp_args", ((), False)))
+            *self._compression_eval_args())
         self._swap_state_out()
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         self._last_fwd_batch = device_batch
@@ -1832,7 +1844,7 @@ class DeepSpeedEngine:
         # profile the program training actually runs: with compression
         # active, the default static args would lower an unquantized
         # variant and miss the quant/prune ops
-        comp_bits, prune_on = getattr(self, "_last_comp_args", ((), False))
+        comp_bits, prune_on = self._compression_eval_args()
         lowered = self._jit_train_step.lower(
             self.state, self._profile_batch_struct, self._rng,
             comp_bits, prune_on)

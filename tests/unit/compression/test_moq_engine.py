@@ -131,6 +131,39 @@ class TestMoQEngineLoop:
         assert g2["period"] == g["period"]
         assert g2["next_drop"] == g["next_drop"]
 
+    def test_eval_sees_qat_target_after_resume_without_training(self, tmp_path):
+        """eval_batch must derive (comp_bits, prune_on) from the
+        scheduler/MoQ state, not from the last train step's cached
+        args — after a checkpoint resume (MoQ bits restored) eval runs
+        the quantized master even before any train_batch."""
+        cfg = _cfg(schedule_offset=1, start_bits=8, target_bits=4,
+                   quantize_period=1)
+        engine, _, _ = _run(cfg, steps=5)
+        g = engine._moq.groups[0]
+        assert g["bits"] < 8
+        ids = np.random.default_rng(1).integers(
+            0, 256, size=(engine.train_batch_size(), 16), dtype=np.int32)
+        batch = {"input_ids": ids, "labels": ids.copy()}
+        ref_eval = float(engine.eval_batch(batch=batch))
+        engine.save_checkpoint(str(tmp_path))
+
+        # fresh engine: restore the checkpoint and eval WITHOUT any
+        # train_batch — the quantized-master eval must match the
+        # original engine's (a stale/empty cached-args path would run
+        # the raw unquantized master instead)
+        mesh_manager.reset()
+        mesh_manager.init(MeshConfig(data=-1))
+        model = GPT2LMHeadModel(GPT2Config.tiny())
+        engine2, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                                    config=cfg)
+        engine2.init_params({"input_ids": np.zeros_like(ids),
+                             "labels": np.zeros_like(ids)})
+        engine2.load_checkpoint(str(tmp_path))
+        bits2, _ = engine2._compression_eval_args()
+        assert bits2 == (g["bits"],)
+        resumed_eval = float(engine2.eval_batch(batch=batch))
+        np.testing.assert_allclose(resumed_eval, ref_eval, rtol=1e-5)
+
     def test_moq_controller_period_math(self):
         """Unit check of the reference schedule arithmetic."""
         cc = CompressionConfig({"compression_training": {

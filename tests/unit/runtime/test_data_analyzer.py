@@ -96,5 +96,11 @@ class TestDifficultySampler:
             "schedule_config": {"total_curriculum_step": 10,
                                 "difficulty_step": 1}})
         sampler = DifficultyBasedSampler(idx, sched, batch_size=64)
-        with pytest.raises(ValueError, match="within difficulty"):
+        with pytest.raises(ValueError, match="difficulty"):
             next(iter(sampler))
+        # with drop_last=False an empty pool must still raise (not spin
+        # yielding zero-size batches forever)
+        sampler_nodrop = DifficultyBasedSampler(idx, sched, batch_size=64,
+                                                drop_last=False)
+        with pytest.raises(ValueError, match="raise minimum_difficulty"):
+            next(iter(sampler_nodrop))
