@@ -77,20 +77,101 @@ def load_checkpoint(load_dir, tag, template_state):
             os.path.exists(os.path.join(state_dir, "leaves.pkl")):
         try:
             ckptr = ocp.PyTreeCheckpointer()
-            raw = ckptr.restore(os.path.abspath(state_dir))
-            state = _match_into_template(raw, template_state)
+            try:
+                # sharded restore: explicit per-leaf target shardings
+                # from the template, so orbax re-shards directly into
+                # the CURRENT topology (this is the cross-topology
+                # path — restoring a dp2xfsdp2xtp2 save onto fsdp8
+                # places each shard without ever gathering the full
+                # tree on one host, and without orbax's "unsafe when
+                # restoring on a different topology" fallback).
+                # Single-device-sharded leaves (eagerly-created scalars
+                # like the loss scale) restore UNCOMMITTED — forcing
+                # them onto device 0 would poison the next jit call
+                # with a committed-placement conflict.
+                from jax.sharding import SingleDeviceSharding
+
+                def _rarg(x):
+                    if hasattr(x, "sharding") and not isinstance(
+                            x.sharding, SingleDeviceSharding):
+                        return ocp.ArrayRestoreArgs(
+                            sharding=x.sharding, dtype=x.dtype)
+                    return ocp.RestoreArgs()
+
+                restore_args = jax.tree_util.tree_map(
+                    _rarg, template_state)
+                state = ckptr.restore(os.path.abspath(state_dir),
+                                      item=template_state,
+                                      restore_args=restore_args)
+                state = _decommit_single_device(state, template_state)
+            except Exception as e2:
+                logger.info("sharded orbax restore unavailable "
+                            f"({type(e2).__name__}: {str(e2)[:160]}); "
+                            "using the gather-and-replace path")
+                raw = ckptr.restore(os.path.abspath(state_dir))
+                state = _match_into_template(raw, template_state)
         except Exception as e:
             logger.warning(f"orbax restore failed ({e}); trying npz")
     if state is None:
         state = _npz_load(state_dir, template_state)
 
-    client_path = os.path.join(ckpt_dir, "client_state.json")
-    client_state = {}
-    if os.path.exists(client_path):
-        with open(client_path) as f:
-            client_state = json.load(f)
+    client_state = _read_client_state(ckpt_dir)
     logger.info(f"Loaded checkpoint {tag} from {load_dir}")
     return state, client_state
+
+
+def _decommit_single_device(state, template_state):
+    """Leaves whose template sharding is single-device (eager scalars)
+    come back as uncommitted jax arrays with the template dtype, so
+    downstream jit calls are free to place them with the rest of the
+    sharded arguments."""
+    import jax.numpy as jnp
+    from jax.sharding import SingleDeviceSharding
+
+    def fix(x, tmpl):
+        if hasattr(tmpl, "sharding") and isinstance(
+                tmpl.sharding, SingleDeviceSharding):
+            return jnp.asarray(np.asarray(x),
+                               dtype=getattr(tmpl, "dtype", None))
+        return x
+
+    return jax.tree_util.tree_map(fix, state, template_state)
+
+
+def _read_client_state(ckpt_dir):
+    client_path = os.path.join(ckpt_dir, "client_state.json")
+    if os.path.exists(client_path):
+        with open(client_path) as f:
+            return json.load(f)
+    return {}
+
+
+def load_raw_named(load_dir, tag):
+    """{dot.name: np.array} of every saved leaf + client_state, with NO
+    template — the cross-structure loader (e.g. pipeline re-staging,
+    where the target's leaf SHAPES differ from the saved ones and a
+    template restore would reject the mismatch)."""
+    tag = resolve_tag(load_dir, tag)
+    ckpt_dir = os.path.join(load_dir, str(tag))
+    state_dir = os.path.join(ckpt_dir, "state")
+    raw_map = None
+    is_npz = os.path.exists(os.path.join(state_dir, "leaves.pkl"))
+    ocp = _try_orbax()
+    if ocp is not None and os.path.isdir(state_dir) and not is_npz:
+        raw = ocp.PyTreeCheckpointer().restore(
+            os.path.abspath(state_dir))
+        names, leaves, _ = flatten_with_names(raw)
+        raw_map = {n: np.asarray(l) for n, l in zip(names, leaves)}
+    elif is_npz:
+        data = np.load(os.path.join(state_dir, "leaves.npz"))
+        with open(os.path.join(state_dir, "leaves.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        raw_map = {n: data[f"leaf_{i}"]
+                   for i, n in enumerate(meta["names"])}
+    else:
+        raise FileNotFoundError(
+            f"no orbax state or leaves.npz under {state_dir}")
+    return raw_map, _read_client_state(ckpt_dir)
 
 
 def _match_into_template(raw, template_state):
@@ -108,7 +189,8 @@ def _match_into_template(raw, template_state):
         if hasattr(tmpl, "sharding"):
             arr = jax.device_put(arr.astype(tmpl.dtype), tmpl.sharding)
         new_leaves.append(arr)
-    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+    out = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return _decommit_single_device(out, template_state)
 
 
 def _npz_save(state_dir, state):

@@ -128,6 +128,30 @@ def load_universal_params(universal_dir: str) -> Dict[str, np.ndarray]:
     return out
 
 
+def restack_block_leaf(arr: np.ndarray, src_counts, tgt_counts,
+                       tgt_max_k: int) -> np.ndarray:
+    """Re-stage one pipeline-stacked leaf (the reference's PP reshape,
+    checkpoint/reshape_meg_2d.py): [S_src, K_src, ...] laid out with
+    ``src_counts[s]`` real layers per stage (rest zero padding) ->
+    [S_tgt, tgt_max_k, ...] for ``tgt_counts``. The layer ORDER is the
+    pipeline order, which both layouts share — re-staging is pure
+    index arithmetic per leaf, no cross-leaf state."""
+    layers = [arr[s, l] for s, c in enumerate(src_counts)
+              for l in range(int(c))]
+    if sum(int(c) for c in tgt_counts) != len(layers):
+        raise ValueError(
+            f"restack: checkpoint has {len(layers)} layers, target "
+            f"topology wants {sum(int(c) for c in tgt_counts)}")
+    zero = np.zeros_like(layers[0])
+    it = iter(layers)
+    stages = []
+    for c in tgt_counts:
+        sp = [next(it) for _ in range(int(c))]
+        sp += [zero] * (tgt_max_k - int(c))
+        stages.append(np.stack(sp))
+    return np.stack(stages)
+
+
 def zero_to_fp32(ckpt_dir: str, output_file: str, tag: Optional[str] = None,
                  template_state=None) -> Dict[str, np.ndarray]:
     """Merge a checkpoint into ONE fp32 state dict file (reference:

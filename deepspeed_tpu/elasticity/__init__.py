@@ -1,4 +1,6 @@
-from .elasticity import (compute_elastic_config, ensure_immutable_elastic_config,
+from .elasticity import (compute_elastic_config, elasticity_enabled,
+                         ensure_immutable_elastic_config,
                          get_compatible_gpus)
 from .config import ElasticityConfig, ElasticityError, ElasticityConfigError, \
     ElasticityIncompatibleWorldSize
+from .elastic_agent import DSElasticAgent, resume_latest

@@ -1,0 +1,140 @@
+"""Elastic training supervisor — worker-failure / slice-resize
+recovery.
+
+Reference: deepspeed/elasticity/elastic_agent.py:32 ``DSElasticAgent``
+extends torchelastic's LocalElasticAgent: on worker failure the
+rendezvous re-forms (possibly with a different world size) and workers
+restart from their latest checkpoint; launcher hook
+deepspeed/launcher/runner.py:375 (``--elastic_training``).
+
+TPU-native reading: ``jax.distributed`` cannot re-form inside a live
+process (the coordinator binds once), and on TPU pods preemption kills
+the whole worker process anyway — so the elastic unit IS the process.
+The agent supervises the training process; on a non-zero exit it
+re-probes the available chips (slice resize / preemption shrink),
+recomputes the (batch, chips) plan with the v0.1/v0.2 elasticity math
+(elasticity.py — the same math the reference uses), and respawns with
+the new plan in env. The worker resumes from the newest COMMITTED
+checkpoint via ``resume_latest`` (async saves write the ``latest`` tag
+only at commit, checkpoint/checkpoint_engine.py — a kill mid-save can
+never be resumed into).
+
+Worker contract (env, all optional for non-elastic scripts):
+    DSTPU_ELASTIC_WORLD         chips this incarnation may use
+    DSTPU_ELASTIC_BATCH         planned global batch
+    DSTPU_ELASTIC_MICRO_BATCH   planned micro batch per chip
+    DSTPU_ELASTIC_CKPT_DIR      checkpoint dir to resume from / save to
+    DSTPU_ELASTIC_RESTART       restart ordinal (0 = first launch)
+"""
+
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Optional, Sequence
+
+from ..utils.logging import logger
+from .elasticity import compute_elastic_config, elasticity_enabled
+
+
+def resume_latest(engine, ckpt_dir: Optional[str] = None) -> bool:
+    """Load the newest committed checkpoint if one exists; returns
+    whether a resume happened. The worker-side half of the elastic
+    contract (call before the training loop)."""
+    ckpt_dir = ckpt_dir or os.environ.get("DSTPU_ELASTIC_CKPT_DIR")
+    if not ckpt_dir or not os.path.exists(
+            os.path.join(ckpt_dir, "latest")):
+        return False
+    engine.load_checkpoint(ckpt_dir)
+    logger.info(f"elastic resume: restored step {engine.global_steps} "
+                f"from {ckpt_dir}")
+    return True
+
+
+def default_device_probe() -> int:
+    """Count currently-reachable chips WITHOUT initializing jax in the
+    agent process (a crashed TPU runtime would wedge it): honor the
+    simulated-mesh env first, else ask a short-lived subprocess."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    marker = "--xla_force_host_platform_device_count="
+    if marker in flags:
+        return int(flags.split(marker)[1].split()[0])
+    code = "import jax; print(len(jax.devices()))"
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=120)
+        return int(out.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        logger.warning(f"device probe failed ({e}); assuming 1")
+        return 1
+
+
+class DSElasticAgent:
+    """Process supervisor with elastic replan + checkpoint resume.
+
+    ``device_probe()`` is injectable so tests (and custom schedulers)
+    can simulate slice resizes; the default probes the live platform.
+    """
+
+    def __init__(self, script: str, script_args: Sequence[str] = (),
+                 ds_config: Optional[dict] = None,
+                 ckpt_dir: str = "elastic_ckpt",
+                 max_restarts: int = 100,
+                 backoff_seconds: float = 1.0,
+                 device_probe: Optional[Callable[[], int]] = None,
+                 env: Optional[dict] = None):
+        self.script = script
+        self.script_args = list(script_args)
+        self.ds_config = ds_config or {}
+        self.ckpt_dir = ckpt_dir
+        self.max_restarts = max_restarts
+        self.backoff_seconds = backoff_seconds
+        self.device_probe = device_probe or default_device_probe
+        self.env = dict(env) if env else dict(os.environ)
+        self.restart_count = 0
+
+    def _plan(self, world: int):
+        """(batch, micro) for ``world`` chips via the elasticity math;
+        (None, None) when the config has no elasticity section (the
+        worker then keeps its own batch config)."""
+        if not elasticity_enabled(self.ds_config):
+            return None, None
+        batch, _, micro = compute_elastic_config(
+            self.ds_config, world_size=world)
+        return batch, micro
+
+    def _spawn(self, world: int):
+        env = dict(self.env)
+        batch, micro = self._plan(world)
+        env["DSTPU_ELASTIC_WORLD"] = str(world)
+        env["DSTPU_ELASTIC_CKPT_DIR"] = self.ckpt_dir
+        env["DSTPU_ELASTIC_RESTART"] = str(self.restart_count)
+        if batch is not None:
+            env["DSTPU_ELASTIC_BATCH"] = str(batch)
+            env["DSTPU_ELASTIC_MICRO_BATCH"] = str(micro)
+        cmd = [sys.executable, self.script] + self.script_args
+        logger.info(
+            f"elastic agent: launch #{self.restart_count} world={world}"
+            + (f" batch={batch} micro={micro}" if batch else ""))
+        return subprocess.Popen(cmd, env=env)
+
+    def run(self) -> int:
+        """Supervise until clean exit or restart budget exhausted."""
+        while True:
+            world = max(1, int(self.device_probe()))
+            proc = self._spawn(world)
+            rc = proc.wait()
+            if rc == 0:
+                logger.info("elastic agent: training completed")
+                return 0
+            if self.restart_count >= self.max_restarts:
+                logger.error(
+                    f"elastic agent: worker failed rc={rc} and restart "
+                    f"budget ({self.max_restarts}) is exhausted")
+                return rc
+            self.restart_count += 1
+            logger.warning(
+                f"elastic agent: worker failed rc={rc}; re-probing "
+                f"devices and restarting "
+                f"({self.restart_count}/{self.max_restarts})")
+            time.sleep(self.backoff_seconds)

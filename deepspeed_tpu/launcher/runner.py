@@ -70,17 +70,38 @@ def parse_args(args=None):
 
 def _elastic_main(argv):
     """``dstpu elastic`` — elastic batch planning from a config file
-    (reference: bin/ds_elastic)."""
+    (reference: bin/ds_elastic), or, with ``--run``, the elastic agent:
+    supervise a training script, restart on worker failure with a
+    recomputed (batch, chips) plan and checkpoint resume (reference:
+    elasticity/elastic_agent.py:32 + runner.py:375 --elastic_training)."""
     import argparse
     import json
 
     from ..elasticity import compute_elastic_config
 
     p = argparse.ArgumentParser(prog="dstpu elastic")
-    p.add_argument("-c", "--config", required=True,
+    p.add_argument("-c", "--config", default="",
                    help="DeepSpeed config json with an elasticity section")
     p.add_argument("-w", "--world-size", type=int, default=0)
+    p.add_argument("--run", default="",
+                   help="training script: run under the elastic agent")
+    p.add_argument("--ckpt-dir", default="elastic_ckpt")
+    p.add_argument("--max-restarts", type=int, default=100)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
+    if args.run:
+        from ..elasticity import DSElasticAgent
+        ds_config = {}
+        if args.config:
+            with open(args.config) as f:
+                ds_config = json.load(f)
+        agent = DSElasticAgent(args.run, args.script_args,
+                               ds_config=ds_config,
+                               ckpt_dir=args.ckpt_dir,
+                               max_restarts=args.max_restarts)
+        return agent.run()
+    if not args.config:
+        p.error("-c/--config is required without --run")
     with open(args.config) as f:
         ds_config = json.load(f)
     print(json.dumps({"elasticity": ds_config.get("elasticity")}, indent=2))

@@ -361,6 +361,13 @@ class DeepSpeedEngine:
                 error=jax.tree_util.tree_map(
                     lambda x: NamedSharding(self.mesh, err_spec(x)),
                     opt_state.error))
+            if self._onebit_cfg.get("shard_v"):
+                # stage-1 OneBitAdam: the chunked variance shards the
+                # same way (each device stores its [1, chunk] row)
+                opt_sh = opt_sh._replace(
+                    v=jax.tree_util.tree_map(
+                        lambda x: NamedSharding(self.mesh, err_spec(x)),
+                        opt_state.v))
         opt_state = jax.jit(lambda t: t, out_shardings=opt_sh)(opt_state)
         if self._param_offload_host:
             # optimizer state is BUILT from device-resident params first
@@ -504,54 +511,92 @@ class DeepSpeedEngine:
             return
         oc = self._config.optimizer_config
         schedule = self.lr_scheduler if self.lr_scheduler is not None else None
-        if oc is not None and (oc.type or "").lower() == "onebitadam":
-            # real error-feedback 1-bit Adam: the engine's train step
-            # runs the compressed momentum exchange inside shard_map
-            # (reference: runtime/fp16/onebit/adam.py). The engine owns
-            # the whole optimizer; opt_transform only provides init().
-            # (ZeroOneAdam is NOT routed here — its interval-based
-            # variance-freeze algorithm differs; it takes the factory's
-            # documented uncompressed fallback.)
+        onebit_types = {"onebitadam": "adam", "onebitlamb": "lamb",
+                        "zerooneadam": "zoadam"}
+        if oc is not None and (oc.type or "").lower() in onebit_types:
+            # real error-feedback 1-bit family: the engine's train step
+            # runs the compressed exchange inside shard_map (reference:
+            # runtime/fp16/onebit/{adam,lamb,zoadam}.py). The engine
+            # owns the whole optimizer; opt_transform only provides
+            # init().
+            algo = onebit_types[(oc.type or "").lower()]
+            name = oc.type
             p = dict(oc.params)
             betas = p.get("betas", (0.9, 0.999))
             self._onebit_cfg = {
+                "algo": algo,
                 "lr": p.get("lr", 1e-3),
                 "b1": float(betas[0]), "b2": float(betas[1]),
                 "eps": p.get("eps", 1e-8),
                 "weight_decay": p.get("weight_decay", 0.0),
                 "freeze_step": int(p.get("freeze_step", 100000)),
             }
+            if algo == "lamb":
+                self._onebit_cfg.update(
+                    max_coeff=float(p.get("max_coeff", 10.0)),
+                    min_coeff=float(p.get("min_coeff", 0.01)),
+                    coeff_beta=float(p.get("coeff_beta", 0.9)),
+                    factor_max=float(p.get("factor_max", 4.0)),
+                    factor_min=float(p.get("factor_min", 0.5)),
+                    factor_threshold=float(p.get("factor_threshold",
+                                                 0.1)))
+            if algo == "zoadam":
+                self._onebit_cfg.update(
+                    var_freeze_step=int(p.get("var_freeze_step",
+                                              100000)),
+                    var_update_scaler=int(p.get("var_update_scaler",
+                                                16)),
+                    local_step_scaler=int(p.get("local_step_scaler",
+                                                32678)),
+                    local_step_clipper=int(p.get("local_step_clipper",
+                                                 16)))
             if self.fp16_enabled:
-                raise ValueError("OneBitAdam: use bf16/fp32 (the frozen-"
+                raise ValueError(f"{name}: use bf16/fp32 (the frozen-"
                                  "variance stage has no loss-scale "
                                  "rollback path)")
-            if self.zero_stage != 0:
+            # the reference restricts the whole family to ZeRO stage 0
+            # (engine.py:1334 "1bit-Adam is not compatible with ZeRO");
+            # OneBitAdam here additionally supports stage 1 by sharding
+            # the frozen variance over the batch axes (gathered in-step)
+            allowed = (0, 1) if algo == "adam" else (0,)
+            if self.zero_stage not in allowed:
                 raise ValueError(
-                    "OneBitAdam requires ZeRO stage 0 (replicated "
-                    f"moments; got stage {self.zero_stage}) — the "
-                    "compressed exchange owns the gradient reduction")
+                    f"{name} requires ZeRO stage "
+                    f"{' or '.join(map(str, allowed))} (got stage "
+                    f"{self.zero_stage}) — the compressed exchange owns "
+                    "the gradient reduction")
+            self._onebit_cfg["shard_v"] = (algo == "adam"
+                                           and self.zero_stage == 1)
             if any(self.mesh.shape[a] > 1 for a in
                    (TENSOR_AXIS, SEQUENCE_AXIS, PIPE_AXIS, EXPERT_AXIS)):
                 raise ValueError(
-                    "OneBitAdam runs the step inside shard_map with "
+                    f"{name} runs the step inside shard_map with "
                     "replicated params and supports batch-parallel "
                     "meshes only; got "
                     f"{dict(zip(self.mesh.axis_names, self.mesh.devices.shape))}")
             if self._config._param_dict.get("compression_training"):
                 raise ValueError(
-                    "OneBitAdam and compression_training cannot be "
+                    f"{name} and compression_training cannot be "
                     "combined (the onebit step does not apply the "
                     "quantization/pruning transform)")
-            from .optimizers import onebit_adam_state_factory
             world = int(np.prod([self.mesh.shape[a] for a in BATCH_AXES
                                  if a in self.mesh.shape]))
-            init_fn = onebit_adam_state_factory(max(1, world))
+            if algo == "adam":
+                from .optimizers import onebit_adam_state_factory
+                init_fn = onebit_adam_state_factory(
+                    max(1, world), shard_v=self._onebit_cfg["shard_v"])
+            elif algo == "lamb":
+                from .fp16.onebit import onebit_lamb_state_factory
+                init_fn = onebit_lamb_state_factory(max(1, world))
+            else:
+                from .fp16.onebit import zero_one_adam_state_factory
+                init_fn = zero_one_adam_state_factory(max(1, world))
             self.opt_transform = type(
                 "OnebitInit", (),
                 {"init": staticmethod(init_fn),
                  "update": staticmethod(lambda *a, **k: (_ for _ in ()
                                         ).throw(RuntimeError(
-                                            "OneBitAdam updates run "
+                                            f"{name} updates run "
                                             "inside the engine step")))})()
             self.optimizer = self.opt_transform
             return
@@ -727,16 +772,20 @@ class DeepSpeedEngine:
         return micro_step, zero
 
     def _compile_onebit_train_step(self):
-        """1-bit Adam fused step (reference: runtime/fp16/onebit/adam.py
-        OnebitAdam + the compressed allreduce backend nccl.py:52).
+        """Fused step for the 1-bit optimizer family (reference:
+        runtime/fp16/onebit/{adam,lamb,zoadam}.py + the compressed
+        allreduce backend nccl.py:52; the update math lives in
+        runtime/fp16/onebit.py here).
 
-        Stage 0 / pure batch parallelism: the gas scan runs per batch
-        shard inside shard_map; during warmup (count < freeze_step) the
-        gradient is psum-averaged and standard Adam runs; afterwards the
-        variance freezes and each shard's locally-updated momentum is
-        exchanged through the error-feedback 1-bit compressed allreduce
-        — one bit per element (packed uint8) plus a scalar on the wire.
-        """
+        Pure batch parallelism: the gas scan runs per batch shard
+        inside shard_map; warmup/full steps psum-average the gradient,
+        compressed steps exchange the momentum (or gradient / local-
+        update accumulator, per algorithm) through the error-feedback
+        1-bit allreduce — one bit per element (packed uint8) plus a
+        scalar on the wire. OneBitAdam at ZeRO stage 1 additionally
+        stores the frozen variance chunked over the batch axes and
+        all-gathers it in-step (memory for wire on the read-only
+        buffer)."""
         gas = self.gradient_accumulation_steps()
         compute_dtype = self.compute_dtype
         accum_dtype = self.grad_accum_dtype
@@ -748,20 +797,167 @@ class DeepSpeedEngine:
         batch_axes, world, err_spec = self._onebit_mesh_info()
         clip = self._config.gradient_clipping
         if clip:
-            logger.warning("OneBitAdam: gradient_clipping applies during "
-                           "warmup only (clipping the compressed local "
-                           "momentum would break error feedback)")
+            logger.warning(
+                "1-bit optimizer: gradient_clipping applies during the "
+                "warmup/full-precision steps only (clipping the "
+                "compressed local quantities would break error "
+                "feedback; ZeroOneAdam ignores it entirely, like the "
+                "reference)")
         from jax import shard_map
-        from ..comm.compressed import onebit_allreduce
+        from .fp16.onebit import (CommCtx, onebit_adam_update,
+                                  onebit_lamb_update,
+                                  zero_one_adam_update)
 
-        b1, b2, eps = ob["b1"], ob["b2"], ob["eps"]
-        wd = ob["weight_decay"]
-        freeze = ob["freeze_step"]
+        algo = ob["algo"]
+        shard_v = ob.get("shard_v", False)
 
         def lr_at(count):
             if sched_fn is not None:
                 return sched_fn(count)
             return ob["lr"]
+
+        hp = dict(ob, lr_at=lr_at)
+        ctx = CommCtx(batch_axes, max(1, world))
+
+        def inner(lp, master, opt, local_batch, r):
+            idx = jnp.int32(0)
+            for a in batch_axes:
+                idx = idx * mesh.shape[a] + jax.lax.axis_index(a)
+            rngs = jax.random.split(jax.random.fold_in(r, idx), gas)
+            micro_step, zero = self._make_micro_step(lp, gas,
+                                                     accum_dtype)
+            g_local, losses = jax.lax.scan(micro_step, zero,
+                                           (local_batch, rngs))
+
+            gfl, tdef = jax.tree_util.tree_flatten(g_local)
+            mfl = jax.tree_util.tree_leaves(master)
+            fi = [i for i, pp in enumerate(mfl)
+                  if jnp.issubdtype(pp.dtype, jnp.floating)]
+            unf = jax.tree_util.tree_unflatten
+
+            def pick(tree, strip_row=False):
+                fl = jax.tree_util.tree_leaves(tree)
+                return fl, [fl[i][0] if strip_row else fl[i]
+                            for i in fi]
+
+            def put_back(fl, new_vals, add_row=False):
+                out = list(fl)
+                for slot, i in enumerate(fi):
+                    out[i] = new_vals[slot][None] if add_row \
+                        else new_vals[slot]
+                return unf(tdef, out)
+
+            g_f = [gfl[i].astype(jnp.float32) for i in fi]
+            p_f = [mfl[i].astype(jnp.float32) for i in fi]
+            e_fl, e_f = pick(opt.error, strip_row=True)
+            count = opt.count
+
+            if algo == "adam":
+                m_fl, m_f = pick(opt.m)
+                v_fl, v_raw = pick(opt.v)
+                if shard_v:
+                    # stage-1 layout: the [1, chunk] variance block is
+                    # gathered to full size for the elementwise update,
+                    # and the new variance is re-chunked on the way out
+                    v_f = []
+                    for vb, pp in zip(v_raw, p_f):
+                        if batch_axes:
+                            full = jax.lax.all_gather(
+                                vb, batch_axes, tiled=True)
+                        else:
+                            full = vb
+                        v_f.append(full.reshape(-1)[:pp.size]
+                                   .reshape(pp.shape))
+                else:
+                    v_f = v_raw
+                new_p, m_n, v_n, e_n, gnorm = onebit_adam_update(
+                    g_f, p_f, m_f, v_f, e_f, count, ctx, hp, clip)
+                if shard_v:
+                    chunked = []
+                    for vv, vb in zip(v_n, v_raw):
+                        chunk = vb.shape[-1]
+                        flat = vv.reshape(-1)
+                        pad = chunk * max(1, world) - flat.shape[0]
+                        if pad:
+                            flat = jnp.concatenate(
+                                [flat, jnp.zeros((pad,), flat.dtype)])
+                        chunked.append(jax.lax.dynamic_slice(
+                            flat, (idx * chunk,), (chunk,))[None])
+                    new_opt = opt._replace(
+                        count=count + 1,
+                        m=put_back(m_fl, m_n),
+                        v=put_back(v_fl, chunked,
+                                   add_row=False),
+                        error=put_back(e_fl, e_n, add_row=True))
+                else:
+                    new_opt = opt._replace(
+                        count=count + 1, m=put_back(m_fl, m_n),
+                        v=put_back(v_fl, v_n),
+                        error=put_back(e_fl, e_n, add_row=True))
+            elif algo == "lamb":
+                m_fl, m_f = pick(opt.m)
+                v_fl, v_f = pick(opt.v)
+                vf_fl, vf_f = pick(opt.v_fresh)
+                cf_fl, cf_f = pick(opt.coeff_freeze)
+                lf_fl, lf_f = pick(opt.last_factor)
+                sc_fl, sc_f = pick(opt.scaling)
+                st = {"m": m_f, "v": v_f, "v_fresh": vf_f, "e": e_f,
+                      "coeff": cf_f, "last_factor": lf_f,
+                      "scaling": sc_f}
+                new_p, st_n, gnorm = onebit_lamb_update(
+                    g_f, p_f, st, count, ctx, hp, clip)
+                new_opt = opt._replace(
+                    count=count + 1,
+                    m=put_back(m_fl, st_n["m"]),
+                    v=put_back(v_fl, st_n["v"]),
+                    v_fresh=put_back(vf_fl, st_n["v_fresh"]),
+                    error=put_back(e_fl, st_n["e"], add_row=True),
+                    coeff_freeze=put_back(cf_fl, st_n["coeff"]),
+                    last_factor=put_back(lf_fl, st_n["last_factor"]),
+                    scaling=put_back(sc_fl, st_n["scaling"]))
+            else:
+                m_fl, m_f = pick(opt.m)
+                v_fl, v_f = pick(opt.v)
+                u_fl, u_f = pick(opt.u)
+                st = {"m": m_f, "v": v_f, "u": u_f, "e": e_f,
+                      "var_interval": opt.var_interval,
+                      "var_counter": opt.var_counter,
+                      "local_interval": opt.local_interval,
+                      "local_counter": opt.local_counter,
+                      "lrs": opt.lrs}
+                new_p, st_n, gnorm = zero_one_adam_update(
+                    g_f, p_f, st, count, ctx, hp, clip)
+                new_opt = opt._replace(
+                    count=count + 1,
+                    m=put_back(m_fl, st_n["m"]),
+                    v=put_back(v_fl, st_n["v"]),
+                    u=put_back(u_fl, st_n["u"]),
+                    error=put_back(e_fl, st_n["e"], add_row=True),
+                    var_interval=st_n["var_interval"],
+                    var_counter=st_n["var_counter"],
+                    local_interval=st_n["local_interval"],
+                    local_counter=st_n["local_counter"],
+                    lrs=st_n["lrs"])
+
+            new_mfl = list(mfl)
+            for slot, i in enumerate(fi):
+                new_mfl[i] = new_p[slot].astype(mfl[i].dtype)
+            new_master = unf(tdef, new_mfl)
+            loss_sum = jnp.sum(losses)
+            if batch_axes:
+                loss_sum = jax.lax.psum(loss_sum, batch_axes) / world
+            return new_master, new_opt, loss_sum, gnorm
+
+        def opt_specs(opt):
+            """Replicated everywhere except the per-shard error rows
+            (and, in stage-1 adam, the chunked variance)."""
+            specs = jax.tree_util.tree_map(lambda _: P(), opt)
+            err_specs = jax.tree_util.tree_map(err_spec, opt.error)
+            specs = specs._replace(error=err_specs)
+            if shard_v:
+                specs = specs._replace(
+                    v=jax.tree_util.tree_map(err_spec, opt.v))
+            return specs
 
         def train_step(state: TrainState, batch, rng, comp_bits=(),
                        prune_on=False):
@@ -771,138 +967,31 @@ class DeepSpeedEngine:
                 if jnp.issubdtype(x.dtype, jnp.floating) else x,
                 state.master_params)
 
-            def inner(lp, master, m, v, err, count, local_batch, r):
-                idx = jnp.int32(0)
-                for a in batch_axes:
-                    idx = idx * mesh.shape[a] + jax.lax.axis_index(a)
-                rngs = jax.random.split(jax.random.fold_in(r, idx), gas)
-                micro_step, zero = self._make_micro_step(lp, gas,
-                                                         accum_dtype)
-                g_local, losses = jax.lax.scan(micro_step, zero,
-                                               (local_batch, rngs))
-                c1 = 1.0 - b1 ** (count + 1).astype(jnp.float32)
-                c2 = 1.0 - b2 ** (count + 1).astype(jnp.float32)
-
-                gfl, tdef = jax.tree_util.tree_flatten(g_local)
-                mfl = jax.tree_util.tree_leaves(master)
-                m_fl = jax.tree_util.tree_leaves(m)
-                v_fl = jax.tree_util.tree_leaves(v)
-                e_fl = jax.tree_util.tree_leaves(err)
-                fi = [i for i, p in enumerate(mfl)
-                      if jnp.issubdtype(p.dtype, jnp.floating)]
-                g_f = [gfl[i].astype(jnp.float32) for i in fi]
-                m_f = [m_fl[i] for i in fi]
-                v_f = [v_fl[i] for i in fi]
-                e_f = [e_fl[i][0] for i in fi]
-
-                # lax.cond so ONLY the active stage's collectives run:
-                # warmup pays the fp32 psum, the compressed stage pays
-                # the 1-bit all_gather — never both (count is replicated
-                # so every device takes the same branch).
-                def warmup(op):
-                    g_l, m_l, v_l, e_l = op
-                    if batch_axes:
-                        g_avg = [jax.lax.psum(g, batch_axes) / world
-                                 for g in g_l]
-                    else:
-                        g_avg = g_l
-                    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
-                                         for g in g_avg))
-                    if clip:
-                        # reference OnebitAdam clips during warmup
-                        factor = jnp.minimum(1.0,
-                                             clip / (gnorm + 1e-6))
-                        g_avg = [g * factor for g in g_avg]
-                    m_n = [b1 * mm + (1 - b1) * g
-                           for mm, g in zip(m_l, g_avg)]
-                    v_n = [b2 * vv + (1 - b2) * jnp.square(g)
-                           for vv, g in zip(v_l, g_avg)]
-                    return m_n, v_n, e_l, gnorm
-
-                def frozen(op):
-                    g_l, m_l, v_l, e_l = op
-                    m_w = [b1 * mm + (1 - b1) * g
-                           for mm, g in zip(m_l, g_l)]
-                    m_n, e_n = [], []
-                    for mw, e in zip(m_w, e_l):
-                        if batch_axes:
-                            mc, en = onebit_allreduce(mw, e, batch_axes)
-                        else:
-                            from ..comm.compressed import onebit_compress
-                            mc, en = onebit_compress(mw, e)
-                            mc = mc.reshape(mw.shape)
-                            en = en.reshape(mw.shape)
-                        m_n.append(mc)
-                        e_n.append(en)
-                    # post-freeze "grad_norm" reports the norm of the
-                    # exchanged momentum — the quantity driving updates
-                    # (the true global grad norm would need the psum
-                    # the compressed stage exists to avoid)
-                    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(mm))
-                                         for mm in m_n))
-                    return m_n, v_l, e_n, gnorm
-
-                m_n, v_n, e_n, gnorm = jax.lax.cond(
-                    count < freeze, warmup, frozen, (g_f, m_f, v_f, e_f))
-
-                lr = lr_at(count)
-                new_mfl = list(mfl)
-                new_m_fl = list(m_fl)
-                new_v_fl = list(v_fl)
-                new_e_fl = list(e_fl)
-                for slot, i in enumerate(fi):
-                    upd = (m_n[slot] / c1) / \
-                        (jnp.sqrt(v_n[slot] / c2) + eps)
-                    pf = mfl[i].astype(jnp.float32)
-                    if wd:
-                        upd = upd + wd * pf
-                    new_mfl[i] = (pf - lr * upd).astype(mfl[i].dtype)
-                    new_m_fl[i] = m_n[slot]
-                    new_v_fl[i] = v_n[slot]
-                    new_e_fl[i] = e_n[slot][None]
-                unf = jax.tree_util.tree_unflatten
-                new_master = unf(tdef, new_mfl)
-                new_m = unf(tdef, new_m_fl)
-                new_v = unf(tdef, new_v_fl)
-                new_e = unf(tdef, new_e_fl)
-                loss_sum = jnp.sum(losses)
-                if batch_axes:
-                    loss_sum = jax.lax.psum(loss_sum, batch_axes) / world
-                return new_master, new_m, new_v, new_e, loss_sum, gnorm
-
             rep = P()
             batch_specs = jax.tree_util.tree_map(
                 lambda x: P(*((None, batch_axes) +
                               (None,) * (x.ndim - 2))), batch) \
                 if batch_axes else jax.tree_util.tree_map(
                     lambda x: P(), batch)
-
-            err_specs = jax.tree_util.tree_map(err_spec, opt.error)
             rep_tree = lambda t: jax.tree_util.tree_map(lambda _: rep, t)
             if batch_axes:
                 outs = shard_map(
                     inner, mesh=mesh,
                     in_specs=(rep_tree(lp_params),
                               rep_tree(state.master_params),
-                              rep_tree(opt.m), rep_tree(opt.v),
-                              err_specs, rep, batch_specs, rep),
+                              opt_specs(opt), batch_specs, rep),
                     out_specs=(rep_tree(state.master_params),
-                               rep_tree(opt.m), rep_tree(opt.v),
-                               err_specs, rep, rep),
+                               opt_specs(opt), rep, rep),
                     check_vma=False)(
-                    lp_params, state.master_params, opt.m, opt.v,
-                    opt.error, opt.count, batch, rng)
+                    lp_params, state.master_params, opt, batch, rng)
             else:
-                outs = inner(
-                    lp_params, state.master_params, opt.m, opt.v,
-                    opt.error, opt.count, batch, rng)
-            new_master, new_m, new_v, new_e, loss_sum, gnorm = outs
+                outs = inner(lp_params, state.master_params, opt,
+                             batch, rng)
+            new_master, new_opt, loss_sum, gnorm = outs
 
-            from .optimizers import OnebitAdamState
             new_state = TrainState(
                 master_params=new_master,
-                opt_state=OnebitAdamState(count=opt.count + 1,
-                                          m=new_m, v=new_v, error=new_e),
+                opt_state=new_opt,
                 loss_scale=state.loss_scale,
                 global_step=state.global_step + 1,
                 skipped_steps=state.skipped_steps)

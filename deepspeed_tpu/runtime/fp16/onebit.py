@@ -1,0 +1,444 @@
+"""The 1-bit optimizer family: error-feedback compressed training.
+
+Reference algorithms (re-derived for SPMD execution, not ported):
+- OnebitAdam   — deepspeed/runtime/fp16/onebit/adam.py: full-precision
+  Adam warmup, then freeze the variance and exchange the momentum
+  through an error-compensated 1-bit allreduce.
+- OnebitLamb   — deepspeed/runtime/fp16/onebit/lamb.py: LAMB warmup
+  with an EMA of the trust ratio (``coeff_beta``); in the compressed
+  stage the momentum is rescaled per-tensor (``scaling_coeff``), sign-
+  exchanged, and the trust ratio is the frozen EMA times a bounded
+  ``factor`` tracking how the fresh variance drifts from the frozen one
+  (``factor_max/min/threshold``, lamb.py:290-360).
+- ZeroOneAdam  — deepspeed/runtime/fp16/onebit/zoadam.py (0/1 Adam,
+  arxiv 2202.06009): variance updates at exponentially-growing
+  intervals (``var_update_scaler``); between variance updates the
+  gradient itself is 1-bit exchanged; after ``var_freeze_step`` the
+  optimizer takes *local steps* and only synchronizes the accumulated
+  update every ``local_step_interval`` steps (interval doubling up to
+  ``local_step_clipper``), which removes communication from most steps.
+
+Execution model (vs the reference's NCCL backend): every algorithm runs
+inside the engine's shard_map train step over the batch axes of ONE
+mesh. The wire is `comm.compressed.onebit_allreduce` — packed uint8
+sign words + one scalar per shard. Each device keeps its own
+compression residual (the ``error`` leaves carry a leading [world] axis
+sharded over the batch axes). The reference's engine-level toggling of
+``enable_backward_allreduce`` (zoadam.py:270-280) collapses here into
+`lax.cond` branches: the gradient psum only exists in the branch that
+needs it, so non-sync steps really do skip the full-precision
+collective.
+
+The stage boundaries (warmup/frozen, variance/local-step intervals) are
+carried as replicated int32 scalars in the optimizer state, so every
+device takes the same `lax.cond` branch and checkpoints resume with the
+schedule intact (the reference instead resets errors on load and keeps
+counters in per-param host state).
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ...comm.compressed import onebit_allreduce, onebit_compress
+
+
+class CommCtx:
+    """Collective context for one algorithm step: the batch axes the
+    exchange runs over (empty = single shard, compression still applied
+    so the math is identical at any world size)."""
+
+    def __init__(self, axes, world):
+        self.axes = tuple(axes)
+        self.world = int(world)
+
+    def psum_avg(self, xs):
+        if self.axes:
+            return [jax.lax.psum(x, self.axes) / self.world for x in xs]
+        return xs
+
+    def psum_avg1(self, x):
+        if self.axes:
+            return jax.lax.psum(x, self.axes) / self.world
+        return x
+
+    def onebit(self, x, err):
+        """Error-feedback 1-bit mean-allreduce of one tensor."""
+        if self.axes:
+            return onebit_allreduce(x, err, self.axes)
+        c, e = onebit_compress(x.reshape(-1), err.reshape(-1))
+        return c.reshape(x.shape), e.reshape(x.shape)
+
+
+def _l2(x):
+    return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+
+
+# ---------------------------------------------------------------------------
+# 1-bit Adam (reference: onebit/adam.py). State lives in
+# runtime/optimizers.py:OnebitAdamState; the update math is here so all
+# three family members share one home.
+# ---------------------------------------------------------------------------
+
+def onebit_adam_update(g_f, p_f, m_f, v_f, e_f, count, ctx, hp, clip):
+    """One fused step over the float leaves. Returns
+    (new_p, new_m, new_v, new_e, gnorm)."""
+    b1, b2, eps = hp["b1"], hp["b2"], hp["eps"]
+    wd, freeze = hp["weight_decay"], hp["freeze_step"]
+    c1 = 1.0 - b1 ** (count + 1).astype(jnp.float32)
+    c2 = 1.0 - b2 ** (count + 1).astype(jnp.float32)
+
+    def warmup(op):
+        g_l, m_l, v_l, e_l = op
+        g_avg = ctx.psum_avg(g_l)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in g_avg))
+        if clip:
+            # reference OnebitAdam clips during warmup only
+            factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+            g_avg = [g * factor for g in g_avg]
+        m_n = [b1 * mm + (1 - b1) * g for mm, g in zip(m_l, g_avg)]
+        v_n = [b2 * vv + (1 - b2) * jnp.square(g)
+               for vv, g in zip(v_l, g_avg)]
+        return m_n, v_n, e_l, gnorm
+
+    def frozen(op):
+        g_l, m_l, v_l, e_l = op
+        m_w = [b1 * mm + (1 - b1) * g for mm, g in zip(m_l, g_l)]
+        m_n, e_n = [], []
+        for mw, e in zip(m_w, e_l):
+            mc, en = ctx.onebit(mw, e)
+            m_n.append(mc)
+            e_n.append(en)
+        # post-freeze "grad_norm" reports the norm of the exchanged
+        # momentum — the quantity driving updates (the true global grad
+        # norm would need the psum the compressed stage exists to avoid)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(mm)) for mm in m_n))
+        return m_n, v_l, e_n, gnorm
+
+    m_n, v_n, e_n, gnorm = jax.lax.cond(
+        count < freeze, warmup, frozen, (g_f, m_f, v_f, e_f))
+
+    lr = hp["lr_at"](count)
+    new_p = []
+    for p, mm, vv in zip(p_f, m_n, v_n):
+        upd = (mm / c1) / (jnp.sqrt(vv / c2) + eps)
+        if wd:
+            upd = upd + wd * p
+        new_p.append(p - lr * upd)
+    return new_p, m_n, v_n, e_n, gnorm
+
+
+# ---------------------------------------------------------------------------
+# 1-bit LAMB (reference: onebit/lamb.py)
+# ---------------------------------------------------------------------------
+
+class OnebitLambState(NamedTuple):
+    """Per-leaf: moments, the *fresh* variance tracked from
+    reconstructed gradients in the compressed stage (lamb.py:334), the
+    compression residual, and three scalars — the frozen trust-ratio
+    EMA (``coeff_freeze``), the previous step's variance-drift factor
+    (``last_factor``), and the per-tensor momentum rescale computed at
+    the freeze transition (``scaling``, lamb.py:171-182)."""
+    count: jnp.ndarray
+    m: Any
+    v: Any
+    v_fresh: Any
+    error: Any
+    coeff_freeze: Any
+    last_factor: Any
+    scaling: Any
+
+
+def onebit_lamb_state_factory(world: int):
+    def init(params):
+        def zf(x):
+            return jnp.zeros(x.shape, jnp.float32) \
+                if jnp.issubdtype(x.dtype, jnp.floating) else \
+                jnp.zeros(x.shape, x.dtype)
+
+        def scalar(fill):
+            def make(x):
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    return jnp.float32(fill)
+                return jnp.float32(0.0)
+            return make
+
+        tm = jax.tree_util.tree_map
+        err = tm(lambda x: jnp.zeros((world,) + x.shape, jnp.float32)
+                 if jnp.issubdtype(x.dtype, jnp.floating)
+                 else jnp.zeros((1,), jnp.float32), params)
+        return OnebitLambState(
+            count=jnp.int32(0), m=tm(zf, params), v=tm(zf, params),
+            v_fresh=tm(zf, params), error=err,
+            coeff_freeze=tm(scalar(0.0), params),
+            last_factor=tm(scalar(1.0), params),
+            scaling=tm(scalar(1.0), params))
+
+    return init
+
+
+def onebit_lamb_update(g_f, p_f, st, count, ctx, hp, clip):
+    """st: dict of per-float-leaf lists (m, v, v_fresh, e, coeff,
+    last_factor, scaling). Returns (new_p, new_st, gnorm)."""
+    b1, b2, eps = hp["b1"], hp["b2"], hp["eps"]
+    wd, freeze = hp["weight_decay"], hp["freeze_step"]
+    max_c, min_c = hp["max_coeff"], hp["min_coeff"]
+    coeff_beta = hp["coeff_beta"]
+    f_max, f_min, f_thr = (hp["factor_max"], hp["factor_min"],
+                           hp["factor_threshold"])
+    step = count + 1    # reference state['step'] is 1-based
+    lr = hp["lr_at"](count)
+
+    def warmup(op):
+        g_l, m_l, v_l, vf_l, e_l, cf_l, lf_l, sc_l = op
+        g_avg = ctx.psum_avg(g_l)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in g_avg))
+        if clip:
+            factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+            g_avg = [g * factor for g in g_avg]
+        new_p, m_n, v_n, vf_n, cf_n = [], [], [], [], []
+        for p, g, mm, vv, vf, cf in zip(p_f, g_avg, m_l, v_l, vf_l,
+                                        cf_l):
+            mm = b1 * mm + (1 - b1) * g
+            vv = b2 * vv + (1 - b2) * jnp.square(g)
+            # the frozen variance starts from the warmup's endpoint
+            # (lamb.py:226 exp_avg_sq_fresh cloned at step==freeze_step)
+            vf = jnp.where(step == freeze, vv, vf)
+            # reference LAMB update carries NO bias correction
+            upd = mm / (jnp.sqrt(vv) + eps)
+            if wd:
+                upd = upd + wd * p
+            wn, un = _l2(p), _l2(upd)
+            coeff = jnp.where((wn > 0) & (un > 0),
+                              jnp.clip(wn / un, min_c, max_c), 1.0)
+            cf = jnp.where((wn > 0) & (un > 0),
+                           coeff_beta * cf + (1 - coeff_beta) * coeff,
+                           cf)
+            new_p.append(p - lr * coeff * upd)
+            m_n.append(mm)
+            v_n.append(vv)
+            vf_n.append(vf)
+            cf_n.append(cf)
+        return new_p, m_n, v_n, vf_n, e_l, cf_n, lf_l, sc_l, gnorm
+
+    def frozen(op):
+        g_l, m_l, v_l, vf_l, e_l, cf_l, lf_l, sc_l = op
+        # per-tensor momentum rescale, computed ONCE at the transition
+        # step from the end-of-warmup momenta: united mean scale over
+        # all tensors divided by this tensor's RMS-norm scale
+        # (lamb.py:171-182) — equalizes magnitudes so one shared sign
+        # scale per tensor compresses every layer acceptably
+        leaf_scales = [_l2(mm) / jnp.sqrt(jnp.float32(mm.size))
+                       for mm in m_l]
+        united = sum(leaf_scales) / len(leaf_scales)
+        sc_n = [jnp.where(step == freeze + 1,
+                          jnp.where(s > 0, united / s, 1.0), sc)
+                for s, sc in zip(leaf_scales, sc_l)]
+
+        new_p, m_n, vf_n, e_n, lf_n = [], [], [], [], []
+        gnorm_sq = jnp.float32(0.0)
+        for p, g, m_prev, vv, vf, e, cf, lf, sc in zip(
+                p_f, g_l, m_l, v_l, vf_l, e_l, cf_l, lf_l, sc_n):
+            m_w = (b1 * m_prev + (1 - b1) * g) * sc
+            mc, en = ctx.onebit(m_w, e)
+            mm = mc / sc
+            # reconstruct the implied average gradient to keep a fresh
+            # variance estimate alongside the frozen one (lamb.py:333)
+            g_rec = (mm - m_prev * b1) / (1 - b1)
+            vf = b2 * vf + (1 - b2) * jnp.square(g_rec)
+            denom = jnp.sqrt(vv) + eps
+            denom_real = jnp.sqrt(vf) + eps
+            upd_prelim = mm / denom
+            upd = upd_prelim + wd * p if wd else upd_prelim
+            factor = jnp.max(denom / denom_real)
+            if wd:
+                ur = jnp.minimum(1.0, _l2(upd_prelim) /
+                                 jnp.maximum(_l2(upd), 1e-12))
+                factor = factor * ur + (1.0 - ur)
+            factor = jnp.clip(factor, f_min, f_max)
+            factor = jnp.clip(factor, lf * (1.0 - f_thr),
+                              lf * (1.0 + f_thr))
+            coeff = cf * factor
+            new_p.append(p - lr * coeff * upd)
+            m_n.append(mm)
+            vf_n.append(vf)
+            e_n.append(en)
+            lf_n.append(factor)
+            gnorm_sq = gnorm_sq + jnp.sum(jnp.square(mm))
+        return (new_p, m_n, v_l, vf_n, e_n, cf_l, lf_n, sc_n,
+                jnp.sqrt(gnorm_sq))
+
+    outs = jax.lax.cond(
+        count < freeze, warmup, frozen,
+        (g_f, st["m"], st["v"], st["v_fresh"], st["e"], st["coeff"],
+         st["last_factor"], st["scaling"]))
+    new_p, m_n, v_n, vf_n, e_n, cf_n, lf_n, sc_n, gnorm = outs
+    new_st = {"m": m_n, "v": v_n, "v_fresh": vf_n, "e": e_n,
+              "coeff": cf_n, "last_factor": lf_n, "scaling": sc_n}
+    return new_p, new_st, gnorm
+
+
+# ---------------------------------------------------------------------------
+# 0/1 Adam (reference: onebit/zoadam.py)
+# ---------------------------------------------------------------------------
+
+class ZeroOneAdamState(NamedTuple):
+    """``u`` is the momentum/update accumulator (the paper's local-step
+    buffer, zoadam.py:192 momentum_accumulator); the five scalars carry
+    the variance-interval and local-step policies so a checkpoint
+    resumes mid-schedule."""
+    count: jnp.ndarray
+    m: Any
+    v: Any
+    u: Any
+    error: Any
+    var_interval: jnp.ndarray
+    var_counter: jnp.ndarray
+    local_interval: jnp.ndarray
+    local_counter: jnp.ndarray
+    lrs: jnp.ndarray
+
+
+def zero_one_adam_state_factory(world: int):
+    def init(params):
+        def zf(x):
+            return jnp.zeros(x.shape, jnp.float32) \
+                if jnp.issubdtype(x.dtype, jnp.floating) else \
+                jnp.zeros(x.shape, x.dtype)
+
+        tm = jax.tree_util.tree_map
+        err = tm(lambda x: jnp.zeros((world,) + x.shape, jnp.float32)
+                 if jnp.issubdtype(x.dtype, jnp.floating)
+                 else jnp.zeros((1,), jnp.float32), params)
+        return ZeroOneAdamState(
+            count=jnp.int32(0), m=tm(zf, params), v=tm(zf, params),
+            u=tm(zf, params), error=err,
+            var_interval=jnp.int32(1), var_counter=jnp.int32(0),
+            local_interval=jnp.int32(1), local_counter=jnp.int32(0),
+            lrs=jnp.float32(0.0))
+
+    return init
+
+
+def zero_one_adam_update(g_f, p_f, st, count, ctx, hp, clip):
+    """Returns (new_p, new_st, gnorm). st keys: m, v, u, e + the five
+    policy scalars."""
+    b1, b2, eps = hp["b1"], hp["b2"], hp["eps"]
+    wd = hp["weight_decay"]
+    var_freeze = hp["var_freeze_step"]
+    var_scaler = hp["var_update_scaler"]
+    ls_scaler = hp["local_step_scaler"]
+    ls_clipper = hp["local_step_clipper"]
+    step = count + 1
+    lr = hp["lr_at"](count)
+    m_l, v_l, u_l, e_l = st["m"], st["v"], st["u"], st["e"]
+    var_interval, var_counter = st["var_interval"], st["var_counter"]
+    local_interval = st["local_interval"]
+    local_counter, lrs = st["local_counter"], st["lrs"]
+    frozen = step > var_freeze
+
+    # ---- phase 1: variance-interval policy (zoadam.py:205-219) ----
+    def variance_phase(op):
+        m_in, v_in, u_in, e_in = op
+        full_step = (step % var_interval) == 0
+
+        def full_branch(op2):
+            m2, v2, e2 = op2
+            g_avg = ctx.psum_avg(g_f)
+            m_n = [b1 * mm + (1 - b1) * g for mm, g in zip(m2, g_avg)]
+            v_n = [b2 * vv + (1 - b2) * jnp.square(g)
+                   for vv, g in zip(v2, g_avg)]
+            return m_n, v_n, e2
+
+        def onebit_branch(op2):
+            m2, v2, e2 = op2
+            m_n, e_n = [], []
+            for mm, g, e in zip(m2, g_f, e2):
+                g1, en = ctx.onebit(g, e)
+                m_n.append(b1 * mm + (1 - b1) * g1)
+                e_n.append(en)
+            return m_n, v2, e_n
+
+        m_n, v_n, e_n = jax.lax.cond(full_step, full_branch,
+                                     onebit_branch, (m_in, v_in, e_in))
+        new_p, u_n = [], []
+        for p, mm, vv, uu in zip(p_f, m_n, v_n, u_in):
+            upd = mm / (jnp.sqrt(vv) + eps)
+            if wd:
+                upd = upd + wd * p
+            new_p.append(p - lr * upd)
+            u_n.append(uu)
+        # exponential variance-interval growth
+        vc = jnp.where(full_step, var_counter + 1, var_counter)
+        grow = vc == var_scaler
+        vi_n = jnp.where(grow, var_interval * 2, var_interval)
+        vc_n = jnp.where(grow, 0, vc)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(mm)) for mm in m_n))
+        return (new_p, m_n, v_n, u_n, e_n, vi_n, vc_n, local_interval,
+                local_counter, lrs, gnorm)
+
+    # ---- phase 2: local steps + interval sync (zoadam.py:236-263) ----
+    def local_phase(op):
+        m_in, v_in, u_in, e_in = op
+        # the phase-1 residuals live at GRADIENT scale; phase 2
+        # exchanges lr-scaled update accumulators and divides the
+        # result by ``lrs`` — a stale gradient-scale residual would be
+        # amplified ~1/lr-fold into the momentum and diverge the run.
+        # Error feedback restarts cleanly at the transition (the
+        # reference's checkpoint-load path resets errors for the same
+        # reason, docs/_tutorials/onebit-adam.md:115).
+        e_in = [jnp.where(step == var_freeze + 1,
+                          jnp.zeros_like(e), e) for e in e_in]
+        m_loc = [b1 * mm + (1 - b1) * g for mm, g in zip(m_in, g_f)]
+        lrs_n = lrs + lr
+        p_after, u_after = [], []
+        for p, mm, vv, uu in zip(p_f, m_loc, v_in, u_in):
+            upd = mm / (jnp.sqrt(vv) + eps)
+            if wd:
+                upd = upd + wd * p
+            p_after.append(p - lr * upd)
+            u_after.append(uu - lr * upd)
+        sync = (step % local_interval) == 0
+
+        def do_sync(op2):
+            ps, us, ms, es = op2
+            p_n, u_n, m_n, e_n = [], [], [], []
+            for p, uu, mm, vv, e in zip(ps, us, ms, v_in, es):
+                denom = jnp.sqrt(vv) + eps
+                p_undone = p - uu          # roll back the local updates
+                wire = uu * denom          # momentum-scale for exchange
+                w_avg, en = ctx.onebit(wire, e)
+                m_new = -w_avg / jnp.maximum(lrs_n, 1e-12)
+                p_n.append(p_undone + w_avg / denom)
+                u_n.append(jnp.zeros_like(uu))
+                m_n.append(m_new)
+                e_n.append(en)
+            return p_n, u_n, m_n, e_n, jnp.float32(0.0)
+
+        def no_sync(op2):
+            ps, us, ms, es = op2
+            return ps, us, ms, es, lrs_n
+
+        p_n, u_n, m_n, e_n, lrs_out = jax.lax.cond(
+            sync, do_sync, no_sync, (p_after, u_after, m_loc, e_in))
+        # local-step interval growth, capped by the clipper
+        lc = local_counter + 1
+        grow = lc == ls_scaler
+        li_n = jnp.where(grow,
+                         jnp.minimum(ls_clipper, local_interval * 2),
+                         local_interval)
+        lc_n = jnp.where(grow, 0, lc)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(mm)) for mm in m_n))
+        return (p_n, m_n, v_in, u_n, e_n, var_interval, var_counter,
+                li_n, lc_n, lrs_out, gnorm)
+
+    outs = jax.lax.cond(frozen, local_phase, variance_phase,
+                        (m_l, v_l, u_l, e_l))
+    (new_p, m_n, v_n, u_n, e_n, vi_n, vc_n, li_n, lc_n, lrs_n,
+     gnorm) = outs
+    new_st = {"m": m_n, "v": v_n, "u": u_n, "e": e_n,
+              "var_interval": vi_n, "var_counter": vc_n,
+              "local_interval": li_n, "local_counter": lc_n,
+              "lrs": lrs_n}
+    return new_p, new_st, gnorm

@@ -15,16 +15,19 @@ eval/train flips (reference ``eval()``/``train()`` module walks) are a
 no-op — there is no module state.
 """
 
+import os
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..inference.config import DeepSpeedInferenceConfig
 from ..inference.engine import InferenceEngine
 from ..utils.logging import logger
 from ..utils.tree import tree_dtype_cast
 from .engine import DeepSpeedEngine
+from .lora import LoraConfig, fuse_lora, init_lora_params, merge_lora
 
 
 class DeepSpeedHybridEngine(DeepSpeedEngine):
@@ -36,15 +39,116 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         tokens = engine.generate(prompts, max_new_tokens=...)  # rollout
         engine.train_batch(batch=...)                          # PPO step
         tokens = engine.generate(...)   # sees the updated weights
-    """
+
+    With ``lora={"r": 8, "alpha": 16, ...}`` the engine trains ONLY the
+    adapter tree (base weights frozen in compute dtype): the train step
+    fuses ``W + a@b*(alpha/r)`` functionally, and each inference
+    refresh pushes the fused weights (the reference's LoRA
+    fuse-before-rollout, hybrid_engine.py:132-146; unfuse is structural
+    — the base tree is never written, see runtime/lora.py)."""
 
     def __init__(self, model, inference_config: Optional[dict] = None,
-                 **kwargs):
+                 lora: Optional[dict] = None, **kwargs):
+        self._lora_cfg = LoraConfig(**lora) if lora else None
+        self._lora_base = None
+        self._lora_base_digest = None
         super().__init__(model=model, **kwargs)
         self._inf_config = DeepSpeedInferenceConfig.from_kwargs(
             **(inference_config or {"dtype": "bfloat16"}))
         self._inf_engine: Optional[InferenceEngine] = None
         self._inf_params_step = -1
+
+    # -- LoRA: train the adapter tree over a frozen base ---------------
+    def _setup_state(self, params):
+        if self._lora_cfg is None or self._lora_base is not None:
+            return super()._setup_state(params)
+        base = tree_dtype_cast(params, self.compute_dtype)
+        base_sh = self.sharding_rules.param_shardings(base)
+        self._lora_base = jax.jit(lambda t: t,
+                                  out_shardings=base_sh)(base)
+        # fixed fold constant: str hash is salted per process, which
+        # would give each SPMD host different adapter init
+        rng = jax.random.fold_in(jax.random.PRNGKey(0), 0x10AA)
+        adapters = init_lora_params(rng, params, self._lora_cfg)
+        n_base = sum(x.size for x in jax.tree_util.tree_leaves(base))
+        n_ad = sum(x.size for x in
+                   jax.tree_util.tree_leaves(adapters))
+        logger.info(f"LoRA: training {n_ad:,} adapter params over "
+                    f"{n_base:,} frozen base params "
+                    f"(r={self._lora_cfg.r}, alpha={self._lora_cfg.alpha})")
+        return super()._setup_state(adapters)
+
+    def _loss_fn(self, compute_params, batch, rng):
+        if self._lora_cfg is not None and self._lora_base is not None:
+            fused = fuse_lora(self._lora_base, compute_params,
+                              self._lora_cfg)
+            return super()._loss_fn(fused, batch, rng)
+        return super()._loss_fn(compute_params, batch, rng)
+
+    def merged_params(self):
+        """The deploy-time fused tree (base + adapters); without LoRA,
+        the live master params."""
+        if self._lora_cfg is not None:
+            return merge_lora(self._lora_base, self.state.master_params,
+                              self._lora_cfg)
+        return self.state.master_params
+
+    def _base_digest(self):
+        import hashlib
+
+        from ..utils.tree import flatten_with_names
+        names, leaves, _ = flatten_with_names(self._lora_base)
+        h = hashlib.sha256()
+        for n, l in zip(names, leaves):
+            h.update(n.encode())
+            h.update(np.asarray(l).tobytes())
+        return h.hexdigest()
+
+    def save_checkpoint(self, save_dir, tag=None, **kwargs):
+        out = super().save_checkpoint(save_dir, tag=tag, **kwargs)
+        if self._lora_cfg is not None:
+            from ..utils.tree import flatten_with_names
+            # the frozen base is written once per directory — the
+            # engine checkpoint carries only the (small) adapter tree.
+            # A digest guards against pairing this run's adapters with
+            # a STALE base left in a reused save_dir.
+            path = os.path.join(save_dir, "lora_base.npz")
+            digest = self._base_digest()
+            if os.path.exists(path):
+                z = np.load(path, allow_pickle=False)
+                if str(z.get("__digest__")) != digest:
+                    raise ValueError(
+                        f"{path} holds a DIFFERENT frozen base than "
+                        "this engine's (digest mismatch) — refusing to "
+                        "mix adapter checkpoints across bases; use a "
+                        "fresh save_dir")
+            else:
+                names, leaves, _ = flatten_with_names(self._lora_base)
+                payload = {n: np.asarray(l)
+                           for n, l in zip(names, leaves)}
+                payload["__digest__"] = np.asarray(digest)
+                np.savez(path, **payload)
+        return out
+
+    def load_checkpoint(self, load_dir, *args, **kwargs):
+        if self._lora_cfg is not None and self._lora_base is not None:
+            from ..utils.tree import flatten_with_names
+            path = os.path.join(load_dir, "lora_base.npz")
+            if os.path.exists(path):
+                z = np.load(path, allow_pickle=False)
+                names, leaves, tdef = flatten_with_names(
+                    self._lora_base)
+                self._lora_base = jax.tree_util.tree_unflatten(
+                    tdef, [jnp.asarray(z[n]).astype(l.dtype)
+                           for n, l in zip(names, leaves)])
+                # the compiled steps captured the OLD base as a jit
+                # constant — training against it while inference fuses
+                # the new one would silently optimize a different model
+                self._jit_train_step = None
+                self._jit_eval_step = None
+                self._jit_grad_step = None
+                self._inf_params_step = -1
+        return super().load_checkpoint(load_dir, *args, **kwargs)
 
     # -- mode flips (reference: eval()/train() container walks) --------
     def eval(self):
@@ -63,7 +167,13 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         if self._inf_params_step == self.global_steps and \
                 self._inf_engine.params is not None:
             return
-        self._inf_engine.set_params(self.state.master_params)
+        if self._lora_cfg is not None:
+            # the LoRA fuse step: rollouts run on W + a@b*(alpha/r)
+            push = fuse_lora(self._lora_base, self.state.master_params,
+                             self._lora_cfg)
+        else:
+            push = self.state.master_params
+        self._inf_engine.set_params(push)
         self._inf_params_step = self.global_steps
 
     def generate(self, input_ids, **kwargs):

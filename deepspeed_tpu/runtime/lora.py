@@ -1,0 +1,115 @@
+"""LoRA adapter trees for the hybrid (RLHF) engine.
+
+Reference: deepspeed/runtime/hybrid_engine.py:132-146 — before a
+rollout the engine *fuses* every LoRA pair into its base weight
+(``weight += lora_B @ lora_A * scaling``) so the injected inference
+kernels see one dense matrix, and *unfuses* afterwards so training
+resumes on the separate adapters. DeepSpeed-Chat creates those adapter
+pairs by rewriting Linear modules in place.
+
+TPU-native reading: the base weights are FROZEN during LoRA training,
+so nothing needs to be mutated or undone. The adapters live in their
+own pytree (the engine's master/optimizer state is just that small
+tree); the fused weights ``W + a @ b * (alpha/r)`` are computed
+functionally — inside the jitted train step for training forward
+passes, and once per refresh when pushing weights to the inference
+engine. "Unfuse" is therefore structural: the base tree was never
+written. The reference must unfuse because its adapters and base share
+module storage; here the separation is the design.
+"""
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.tree import flatten_with_names
+
+# DeepSpeed-Chat's default: adapt every linear projection. Matches both
+# our flax naming (kernel) and common proj names.
+_DEFAULT_TARGETS = [r"\bkernel\b"]
+
+
+@dataclass
+class LoraConfig:
+    """``target_modules`` are regex fragments matched against the
+    dot-joined param path; only 2-D floating leaves are adapted."""
+    r: int = 8
+    alpha: float = 16.0
+    target_modules: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_TARGETS))
+    # embedding/unembedding matrices are excluded by default (the
+    # DeepSpeed-Chat recipe adapts attention/MLP linears)
+    exclude: List[str] = field(
+        default_factory=lambda: [r"embed", r"wte", r"wpe", r"lm_head"])
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / float(self.r)
+
+    def matches(self, name: str) -> bool:
+        if any(re.search(p, name) for p in self.exclude):
+            return False
+        return any(re.search(p, name) for p in self.target_modules)
+
+
+def lora_target_names(params, cfg: LoraConfig) -> List[str]:
+    names, leaves, _ = flatten_with_names(params)
+    out = []
+    for n, l in zip(names, leaves):
+        if getattr(l, "ndim", 0) == 2 and \
+                jnp.issubdtype(l.dtype, jnp.floating) and cfg.matches(n):
+            out.append(n)
+    return out
+
+
+def init_lora_params(rng, params, cfg: LoraConfig) -> Dict[str, Any]:
+    """Adapter tree {name: {"a": [in, r], "b": [r, out]}} for every
+    matched 2-D leaf. ``a`` is gaussian, ``b`` zero — the fused delta
+    starts at exactly 0, so step 0 reproduces the base model (the
+    standard LoRA init)."""
+    names, leaves, _ = flatten_with_names(params)
+    by_name = dict(zip(names, leaves))
+    targets = lora_target_names(params, cfg)
+    if not targets:
+        raise ValueError(
+            f"LoRA: no 2-D params match target_modules="
+            f"{cfg.target_modules} (exclude={cfg.exclude}); "
+            f"param names: {names[:8]}...")
+    tree = {}
+    for i, n in enumerate(targets):
+        w = by_name[n]
+        d_in, d_out = w.shape
+        k = jax.random.fold_in(rng, i)
+        tree[n] = {
+            "a": (jax.random.normal(k, (d_in, cfg.r), jnp.float32)
+                  * (1.0 / jnp.sqrt(jnp.float32(cfg.r)))),
+            "b": jnp.zeros((cfg.r, d_out), jnp.float32),
+        }
+    return tree
+
+
+def fuse_lora(base, lora: Dict[str, Any], cfg: LoraConfig):
+    """W + a @ b * (alpha/r) for every adapted leaf (the reference's
+    fuse step, hybrid_engine.py:132). ``base`` is left untouched —
+    returns a new tree in base's dtypes."""
+    names, leaves, treedef = flatten_with_names(base)
+    scale = cfg.scaling
+    out = []
+    for n, w in zip(names, leaves):
+        ab = lora.get(n)
+        if ab is None:
+            out.append(w)
+        else:
+            delta = (ab["a"].astype(jnp.float32)
+                     @ ab["b"].astype(jnp.float32)) * scale
+            out.append((w.astype(jnp.float32) + delta).astype(w.dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def merge_lora(base, lora: Dict[str, Any], cfg: LoraConfig):
+    """Export helper: permanently fused tree (deploy-time equivalent of
+    the reference's fused state)."""
+    return fuse_lora(base, lora, cfg)

@@ -127,9 +127,17 @@ class OnebitAdamState(NamedTuple):
     error: any
 
 
-def onebit_adam_state_factory(world: int):
+def onebit_adam_state_factory(world: int, shard_v: bool = False):
     """init(params) -> OnebitAdamState with fp32 moments and per-shard
-    error buffers (the engine's shard_map step owns the update math)."""
+    error buffers (the engine's shard_map step owns the update math).
+
+    ``shard_v`` (ZeRO stage 1 mode): the variance is stored chunked
+    [world, ceil(n/world)] with the leading axis sharded over the batch
+    axes — after ``freeze_step`` it is read-only, so each device keeps
+    1/world of it and the step all-gathers the chunks. The momentum
+    cannot shard the same way: the compressed exchange replicates it by
+    construction (every shard reconstructs the averaged momentum from
+    the gathered sign words)."""
 
     def init(params):
         def zf(x):
@@ -137,8 +145,14 @@ def onebit_adam_state_factory(world: int):
                 if jnp.issubdtype(x.dtype, jnp.floating) else \
                 jnp.zeros(x.shape, x.dtype)
 
+        def vchunk(x):
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                return jnp.zeros((1,), jnp.float32)
+            chunk = -(-x.size // world)
+            return jnp.zeros((world, chunk), jnp.float32)
+
         m = jax.tree_util.tree_map(zf, params)
-        v = jax.tree_util.tree_map(zf, params)
+        v = jax.tree_util.tree_map(vchunk if shard_v else zf, params)
         err = jax.tree_util.tree_map(
             lambda x: jnp.zeros((world,) + x.shape, jnp.float32)
             if jnp.issubdtype(x.dtype, jnp.floating)

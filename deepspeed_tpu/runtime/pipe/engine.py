@@ -482,3 +482,95 @@ class PipelineEngine(DeepSpeedEngine):
 
     def is_last_stage(self):
         return True
+
+    # -- cross-PP checkpoint reshape (reference: ds_to_universal.py
+    #    merge/regroup + reshape_meg_2d.py) ---------------------------
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        **kwargs):
+        client_state = dict(client_state or {})
+        # record the block layout so a different pipeline topology can
+        # re-stage the [stages, max_k] stacked leaves on load
+        client_state["pipe_stage_block_counts"] = [
+            int(c) for c in self.module.stage_block_counts]
+        return super().save_checkpoint(save_dir, tag=tag,
+                                       client_state=client_state,
+                                       **kwargs)
+
+    def load_checkpoint(self, load_dir, tag=None,
+                        load_optimizer_states=True,
+                        load_lr_scheduler_states=True,
+                        load_module_only=False, **kwargs):
+        import json as _json
+        import os as _os
+
+        from ...checkpoint.engine import load_raw_named, resolve_tag
+        rtag = resolve_tag(load_dir, tag)
+        cs_path = _os.path.join(load_dir, str(rtag),
+                                "client_state.json")
+        src_counts = None
+        if _os.path.exists(cs_path):
+            with open(cs_path) as f:
+                src_counts = _json.load(f).get(
+                    "pipe_stage_block_counts")
+        tgt_counts = [int(c) for c in self.module.stage_block_counts]
+        if src_counts is None or list(src_counts) == tgt_counts:
+            return super().load_checkpoint(
+                load_dir, tag=tag,
+                load_optimizer_states=load_optimizer_states,
+                load_lr_scheduler_states=load_lr_scheduler_states,
+                load_module_only=load_module_only, **kwargs)
+
+        # topology changed: re-stage every blocks-stacked leaf (master
+        # params AND optimizer moments share the [S, K, ...] layout and
+        # the same dotted names), then place into this engine's
+        # shardings
+        from ...checkpoint.universal import restack_block_leaf
+        from ...utils.tree import flatten_with_names
+        log_dist(
+            f"pipeline checkpoint reshape: stages {src_counts} -> "
+            f"{tgt_counts}", ranks=[0])
+        raw_map, client_state = load_raw_named(load_dir, tag)
+        src_s = len(src_counts)
+        tgt_k = int(self.module.max_layers_per_stage)
+        t_names, t_leaves, tdef = flatten_with_names(self.state)
+        new_leaves = []
+        for name, tmpl in zip(t_names, t_leaves):
+            skip = (load_module_only and not
+                    name.startswith("master_params")) or \
+                (not load_optimizer_states and
+                 name.startswith("opt_state"))
+            if skip or name not in raw_map:
+                if not skip and name not in raw_map:
+                    raise KeyError(f"checkpoint missing leaf {name}")
+                new_leaves.append(tmpl)
+                continue
+            arr = raw_map[name]
+            if ".blocks." in f".{name}." and arr.ndim >= 2 and \
+                    arr.shape[0] == src_s:
+                arr = restack_block_leaf(arr, src_counts, tgt_counts,
+                                         tgt_k)
+            if hasattr(tmpl, "sharding"):
+                if tuple(arr.shape) != tuple(tmpl.shape):
+                    raise ValueError(
+                        f"leaf {name}: checkpoint shape {arr.shape} != "
+                        f"target {tmpl.shape} after re-staging")
+                from jax.sharding import SingleDeviceSharding
+                if isinstance(tmpl.sharding, SingleDeviceSharding):
+                    # eager scalars stay uncommitted (placement freedom)
+                    arr = jnp.asarray(np.asarray(arr), dtype=tmpl.dtype)
+                else:
+                    arr = jax.device_put(
+                        np.asarray(arr).astype(tmpl.dtype),
+                        tmpl.sharding)
+            new_leaves.append(arr)
+        self.state = jax.tree_util.tree_unflatten(tdef, new_leaves)
+        if client_state and not load_module_only:
+            self.global_steps = client_state.get("global_steps", 0)
+            self.global_samples = client_state.get("global_samples", 0)
+            self.micro_steps = client_state.get("micro_steps", 0)
+            if load_lr_scheduler_states and \
+                    self.lr_scheduler is not None and \
+                    client_state.get("lr_scheduler"):
+                self.lr_scheduler.load_state_dict(
+                    client_state["lr_scheduler"])
+        return load_dir, client_state

@@ -1,0 +1,157 @@
+"""Elastic agent: a training run killed mid-step is restarted by the
+supervisor and CONTINUES from the newest committed checkpoint —
+loss-curve continuation, not a restart from step 0 (reference:
+elasticity/elastic_agent.py:32 worker-group restarts + checkpoint
+resume)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+# The worker: tiny GPT-2 training that logs (step, loss) per step,
+# saves a checkpoint every step, resumes via the elastic contract, and
+# on its FIRST incarnation kills itself (simulated preemption) at step
+# 3 — AFTER committing step 2's checkpoint, BEFORE committing step 3's.
+WORKER = textwrap.dedent("""
+    import json, os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.elasticity import resume_latest
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
+
+    log_path = sys.argv[1]
+    total_steps = int(sys.argv[2])
+    ckpt = os.environ["DSTPU_ELASTIC_CKPT_DIR"]
+    incarnation = int(os.environ.get("DSTPU_ELASTIC_RESTART", "0"))
+    world = int(os.environ.get("DSTPU_ELASTIC_WORLD", "1"))
+
+    mesh_manager.init(MeshConfig(data=-1))
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 0,
+    }
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                               config=config)
+    ids = np.random.default_rng(0).integers(
+        0, 256, size=(engine.train_batch_size(), 16), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    engine.init_params(batch)
+    resumed = resume_latest(engine, ckpt)
+    with open(log_path, "a") as f:
+        f.write(json.dumps({"event": "start",
+                            "incarnation": incarnation,
+                            "world": world,
+                            "resumed": resumed,
+                            "resume_step": engine.global_steps}) + "\\n")
+    while engine.global_steps < total_steps:
+        loss = float(engine.train_batch(batch=batch))
+        step = engine.global_steps
+        if incarnation == 0 and step == 3:
+            # preemption: die before committing this step's checkpoint
+            os._exit(9)
+        engine.save_checkpoint(ckpt)
+        with open(log_path, "a") as f:
+            f.write(json.dumps({"event": "step", "step": step,
+                                "loss": loss}) + "\\n")
+    sys.exit(0)
+""")
+
+
+@pytest.mark.parametrize("via_cli", [False, True],
+                         ids=["api", "dstpu-elastic"])
+def test_agent_survives_injected_failure(tmp_path, via_cli):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    log = tmp_path / "log.jsonl"
+    ckpt = tmp_path / "ckpt"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DS_ACCELERATOR"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=2")
+
+    if via_cli:
+        rc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "dstpu"),
+             "elastic", "--run", str(script), "--ckpt-dir", str(ckpt),
+             "--max-restarts", "2", str(log), "6"],
+            env=env, timeout=900).returncode
+    else:
+        from deepspeed_tpu.elasticity import DSElasticAgent
+        agent = DSElasticAgent(str(script), [str(log), "6"],
+                               ckpt_dir=str(ckpt), max_restarts=2,
+                               backoff_seconds=0.1, env=env)
+        rc = agent.run()
+    assert rc == 0
+
+    events = [json.loads(l) for l in log.read_text().splitlines()]
+    starts = [e for e in events if e["event"] == "start"]
+    steps = [e for e in events if e["event"] == "step"]
+    # two incarnations: the original and one restart
+    assert [s["incarnation"] for s in starts] == [0, 1]
+    assert starts[0]["resumed"] is False
+    # restart resumed from the newest COMMITTED checkpoint (step 2 —
+    # the step-3 kill happened before that step's save)
+    assert starts[1]["resumed"] is True
+    assert starts[1]["resume_step"] == 2
+    # loss-curve continuation: step 3 re-runs after resume, then 4..6;
+    # no restart from step 0, losses keep decreasing end-to-end
+    seq = [s["step"] for s in steps]
+    assert seq == [1, 2, 3, 4, 5, 6], seq
+    losses = [s["loss"] for s in steps]
+    assert losses[-1] < losses[0]
+    assert losses[3] < losses[1]     # post-resume continues the curve
+
+
+def test_plan_recomputed_on_shrink(tmp_path):
+    """On restart the agent re-probes devices and recomputes the
+    (batch, chips) plan with the elasticity math."""
+    from deepspeed_tpu.elasticity import DSElasticAgent
+
+    script = tmp_path / "probe_worker.py"
+    script.write_text(textwrap.dedent("""
+        import json, os, sys
+        with open(sys.argv[1], "a") as f:
+            f.write(json.dumps({
+                "world": os.environ["DSTPU_ELASTIC_WORLD"],
+                "batch": os.environ.get("DSTPU_ELASTIC_BATCH"),
+                "micro": os.environ.get("DSTPU_ELASTIC_MICRO_BATCH"),
+            }) + "\\n")
+        # first incarnation "is preempted"; the restart exits cleanly
+        sys.exit(5 if os.environ["DSTPU_ELASTIC_RESTART"] == "0"
+                 else 0)
+    """))
+    log = tmp_path / "plans.jsonl"
+    worlds = iter([8, 2])
+    ds_config = {"elasticity": {
+        "enabled": True, "max_train_batch_size": 64,
+        "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 8,
+        "version": 0.2, "ignore_non_elastic_batch_info": True}}
+    agent = DSElasticAgent(str(script), [str(log)],
+                           ds_config=ds_config,
+                           ckpt_dir=str(tmp_path / "c"),
+                           max_restarts=3, backoff_seconds=0.0,
+                           device_probe=lambda: next(worlds))
+    assert agent.run() == 0
+    plans = [json.loads(l) for l in log.read_text().splitlines()]
+    assert [p["world"] for p in plans] == ["8", "2"]
+    # the plan shrank with the slice: fewer chips -> smaller or equal
+    # global batch, micro batch still from the allowed ladder
+    assert int(plans[1]["batch"]) <= int(plans[0]["batch"])
+    assert int(plans[1]["micro"]) in (2, 4)

@@ -90,12 +90,14 @@ class TestOnebitAdam:
         assert u8, "no uint8 all-gather in the compiled onebit step"
 
     def test_guards(self, eight_devices):
-        """fp16 and ZeRO>=1 are rejected with actionable errors."""
+        """fp16 and ZeRO>=2 are rejected with actionable errors."""
         mesh_manager.reset()
         mesh_manager.init(MeshConfig(data=-1))
         model = GPT2LMHeadModel(GPT2Config.tiny())
-        with pytest.raises(ValueError, match="stage 0"):
+        # stage 1 is supported (chunk-sharded frozen variance,
+        # test_onebit_family.py); stage 2+ still rejected
+        with pytest.raises(ValueError, match="stage 0 or 1"):
             deepspeed_tpu.initialize(model=model, config={
                 "train_micro_batch_size_per_gpu": 2,
                 "optimizer": {"type": "OneBitAdam", "params": {}},
-                "zero_optimization": {"stage": 1}})
+                "zero_optimization": {"stage": 2}})
