@@ -1,2 +1,2 @@
-from .autotuner import Autotuner, TrialResult
+from .autotuner import Autotuner, LaunchedAutotuner, TrialResult
 from .config import AutotuningConfig

@@ -179,3 +179,136 @@ class Autotuner:
             json.dump([dataclasses.asdict(r) for r in self.results], f,
                       indent=2)
         return path
+
+    # -- profiler feed ------------------------------------------------
+    @staticmethod
+    def model_info_from_engine(engine, seq: int,
+                               hbm_bytes: Optional[int] = None,
+                               world_size: int = 1) -> Dict[str, Any]:
+        """Derive the memory model's inputs from the engine's
+        per-module profile (engine.get_module_profile) instead of
+        hand-entered numbers — the reference feeds its flops profiler
+        into autotuning the same way (autotuner model_info)."""
+        import re
+
+        from ..profiling.flops_profiler import module_params_breakdown
+        params = module_params_breakdown(engine.state.master_params,
+                                         depth=1)
+        n_params = sum(params.values())
+        # transformer blocks show up as indexed siblings (h_0, h_1 /
+        # layers_0 ...): count the distinct indices of the largest
+        # indexed family
+        families: Dict[str, set] = {}
+        for key in params:
+            m = re.match(r"(.+?)[._](\d+)$", key.split("/")[0])
+            if m:
+                families.setdefault(m.group(1), set()).add(
+                    int(m.group(2)))
+        num_layers = max((len(v) for v in families.values()),
+                         default=1)
+        # hidden: every 2-D weight in the families we ship has the
+        # residual width as its SMALLER dim (embedding [V,H], mlp
+        # [H,4H]); the max of those minima is the model width
+        hidden = 0
+        for leaf in __import__("jax").tree_util.tree_leaves(
+                engine.state.master_params):
+            shape = getattr(leaf, "shape", ())
+            if len(shape) == 2:
+                hidden = max(hidden, min(int(shape[0]),
+                                         int(shape[1])))
+        return {
+            "num_params": int(n_params),
+            "num_layers": int(num_layers),
+            "hidden_size": int(hidden) or 1024,
+            "seq": seq,
+            "world_size": world_size,
+            **({"hbm_bytes": hbm_bytes} if hbm_bytes else {}),
+        }
+
+
+class LaunchedAutotuner(Autotuner):
+    """Experiment-launching tuner (reference:
+    launcher/runner.py:361 ``run_autotuning`` — the autotuner re-runs
+    the USER'S training command per candidate config).
+
+    Each trial runs the training script in a FRESH process via the
+    ``dstpu`` launcher, so candidates can change things an in-process
+    trial cannot — mesh shape, device simulation width, XLA flags —
+    and an OOM/crash kills only the trial.
+
+    Trial contract: the script receives ``--ds-config <json>`` (the
+    merged candidate config) and ``--result <json>`` and must write
+    ``{"tokens_per_sec": ..., "step_time_ms": ...}`` on success.
+    ``launcher_args`` are forwarded to dstpu (e.g.
+    ``["--cpu_sim_devices", "8"]``)."""
+
+    def __init__(self, base_config: dict, trial_script: str,
+                 script_args=(), launcher_args=(),
+                 tuning: Optional[AutotuningConfig] = None,
+                 model_info: Optional[Dict[str, Any]] = None,
+                 env: Optional[dict] = None,
+                 trial_timeout: float = 900.0):
+        super().__init__(base_config, engine_factory=None,
+                         batch_factory=None, tuning=tuning,
+                         model_info=model_info)
+        self.trial_script = trial_script
+        self.script_args = list(script_args)
+        self.launcher_args = list(launcher_args)
+        self.env = dict(env) if env is not None else dict(os.environ)
+        self.trial_timeout = trial_timeout
+        self._exp = 0
+
+    def _merged(self, overrides: Dict[str, Any]) -> Dict[str, Any]:
+        cfg = json.loads(json.dumps(self.base_config))
+        for k, v in overrides.items():
+            if isinstance(v, dict) and isinstance(cfg.get(k), dict):
+                cfg[k].update(v)
+            else:
+                cfg[k] = v
+        return cfg
+
+    def run_trial(self, overrides: Dict[str, Any]) -> TrialResult:
+        import subprocess
+        import sys
+
+        self._exp += 1
+        exp_dir = os.path.join(self.tuning.results_dir,
+                               f"exp_{self._exp}")
+        os.makedirs(exp_dir, exist_ok=True)
+        cfg_path = os.path.join(exp_dir, "ds_config.json")
+        result_path = os.path.join(exp_dir, "result.json")
+        with open(cfg_path, "w") as f:
+            json.dump(self._merged(overrides), f, indent=2)
+        if os.path.exists(result_path):
+            os.remove(result_path)   # never score a stale result
+        dstpu = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "bin", "dstpu")
+        if not os.path.exists(dstpu):
+            import shutil
+            dstpu = shutil.which("dstpu") or dstpu
+        cmd = ([sys.executable, dstpu] + self.launcher_args +
+               [self.trial_script] + self.script_args +
+               ["--ds-config", cfg_path, "--result", result_path])
+        try:
+            proc = subprocess.run(cmd, env=self.env,
+                                  capture_output=True, text=True,
+                                  timeout=self.trial_timeout)
+            if proc.returncode != 0 or not os.path.exists(result_path):
+                tail = (proc.stderr or proc.stdout or "")[-500:]
+                kind = "oom" if "RESOURCE_EXHAUSTED" in tail else "error"
+                return TrialResult(config=overrides, feasible=False,
+                                   metric=self.tuning.metric,
+                                   error=f"{kind}: rc="
+                                         f"{proc.returncode} {tail}")
+            with open(result_path) as f:
+                res = json.load(f)
+            return TrialResult(
+                config=overrides, feasible=True,
+                tokens_per_sec=float(res.get("tokens_per_sec", 0.0)),
+                step_time_ms=float(res.get("step_time_ms", 0.0)),
+                metric=self.tuning.metric)
+        except subprocess.TimeoutExpired:
+            return TrialResult(config=overrides, feasible=False,
+                               metric=self.tuning.metric,
+                               error="timeout")

@@ -120,6 +120,7 @@ class DeepSpeedEngine:
         self.skipped_steps = 0
         self._step_metrics = {}
         self._flops_profile = None
+        self._module_flops_profile = None
         self._profile_batch_struct = None
         self.curriculum_scheduler = None
         self.curriculum_sampler = None
@@ -1939,6 +1940,44 @@ class DeepSpeedEngine:
             comp_bits, prune_on)
         self._flops_profile = cost_analysis_of(lowered.compile())
         return self._flops_profile
+
+    def get_module_profile(self, depth: int = 2):
+        """Per-module FLOPs/params breakdown of the train step
+        (reference: profiling/flops_profiler/profiler.py:507-760
+        per-module MACs/params/latency). The lowering's location table
+        attributes every dot_general to its flax module scope; params
+        come from the tree paths. Feed to
+        ``profiling.flops_profiler.format_module_tree`` to print the
+        reference-style top-k table."""
+        if self._jit_train_step is None or \
+                self._profile_batch_struct is None:
+            raise RuntimeError(
+                "get_module_profile: run at least one train_batch first")
+        from ..profiling.flops_profiler import (aggregate_to_depth,
+                                                module_flops_breakdown,
+                                                module_params_breakdown)
+        # memoize the full-depth breakdown like get_flops_profile does:
+        # a re-lower + text parse of the whole step costs seconds on a
+        # real model, and only the aggregation depth varies per call
+        if getattr(self, "_module_flops_profile", None) is None:
+            comp_bits, prune_on = self._compression_eval_args()
+            lowered = self._jit_train_step.lower(
+                self.state, self._profile_batch_struct, self._rng,
+                comp_bits, prune_on)
+            try:
+                txt = lowered.as_text(debug_info=True)
+            except TypeError:       # older jax: no debug_info kwarg
+                txt = lowered.as_text()
+            gas = self.gradient_accumulation_steps()
+            self._module_flops_profile = {
+                k: v * gas
+                for k, v in module_flops_breakdown(txt).items()}
+        return {
+            "flops": aggregate_to_depth(self._module_flops_profile,
+                                        depth),
+            "params": module_params_breakdown(
+                self.state.master_params, depth),
+        }
 
     def set_data_iterator(self, it):
         self.data_iterator = it

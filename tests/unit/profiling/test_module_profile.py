@@ -1,0 +1,91 @@
+"""Per-module FLOPS breakdown (reference:
+profiling/flops_profiler/profiler.py:507-760 — per-module MACs/params/
+latency table feeding autotuning)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
+from deepspeed_tpu.profiling.flops_profiler import (FlopsProfiler,
+                                                    format_module_tree,
+                                                    module_flops_breakdown)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    mesh_manager.reset()
+    mesh_manager.init(MeshConfig(data=-1))
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 0,
+    })
+    ids = np.random.default_rng(0).integers(
+        0, 256, size=(eng.train_batch_size(), 16), dtype=np.int32)
+    eng.train_batch(batch={"input_ids": ids, "labels": ids.copy()})
+    return eng
+
+
+def test_breakdown_attributes_blocks_and_params(engine, eight_devices):
+    prof = engine.get_module_profile(depth=2)
+    flops, params = prof["flops"], prof["params"]
+    # each transformer block's attn/mlp attributed separately
+    for key in ("h_0/attn", "h_0/mlp", "h_1/attn", "h_1/mlp"):
+        assert key in flops and flops[key] > 0, (key, flops)
+        assert key in params and params[key] > 0
+    # mlp is the FLOPs-heavy block at GPT-2 shapes (4x expansion)
+    assert flops["h_0/mlp"] > flops["h_0/attn"]
+    # the unembedding dot lands under (root)
+    assert flops.get("(root)", 0) > 0
+    # total tracks the whole-step XLA count within the pre- vs
+    # post-fusion difference (dots dominate; elementwise is the rest)
+    total = sum(flops.values())
+    xla = engine.get_flops_profile()["flops"] * \
+        engine.gradient_accumulation_steps()
+    import jax
+    assert total > 0.3 * xla / len(jax.devices()) or xla == 0
+
+
+def test_tree_format_and_detailed_print(engine, eight_devices):
+    prof = FlopsProfiler(engine)
+    prof.start_profile()
+    ids = np.zeros((engine.train_batch_size(), 16), np.int32)
+    engine.train_batch(batch={"input_ids": ids, "labels": ids})
+    prof.stop_profile()
+    text = prof.print_model_profile(detailed=True, module_depth=2,
+                                    top_modules=5)
+    assert "GFLOPs" in text and "share" in text
+    assert "mlp" in text
+    # top-k honored: at most 5 module rows after the header
+    tree = format_module_tree(engine.get_module_profile()["flops"],
+                              top=3)
+    assert len(tree.splitlines()) == 1 + 3
+
+
+def test_breakdown_parser_math():
+    txt = '''
+    #loc7 = loc("jit(f)/Model/h_0/attn/c_attn/dot_general"(#loc2))
+    %1 = stablehlo.dot_general %a, %b, contracting_dims = [2] x [0], precision = [DEFAULT, DEFAULT] : (tensor<2x16x64xf32>, tensor<64x192xf32>) -> tensor<2x16x192xf32> loc(#loc7)
+    '''
+    out = module_flops_breakdown(txt)
+    assert out == {"h_0/attn/c_attn": 2.0 * (2 * 16 * 192) * 64}
+
+
+def test_feeds_autotuner_memory_model(engine, eight_devices):
+    from deepspeed_tpu.autotuning import Autotuner
+    mi = Autotuner.model_info_from_engine(engine, seq=16,
+                                          hbm_bytes=16 << 30)
+    from deepspeed_tpu.utils.tree import tree_parameter_count
+    assert mi["num_params"] == tree_parameter_count(
+        engine.state.master_params)
+    assert mi["num_layers"] == 2          # GPT2Config.tiny
+    assert mi["hidden_size"] == 64
+    est = Autotuner.estimate_bytes(
+        mi["num_params"], 1, 2 * 16, mi["hidden_size"],
+        mi["num_layers"], world=8)
+    assert 0 < est < 16 << 30
