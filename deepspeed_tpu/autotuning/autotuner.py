@@ -301,13 +301,20 @@ class LaunchedAutotuner(Autotuner):
                                    metric=self.tuning.metric,
                                    error=f"{kind}: rc="
                                          f"{proc.returncode} {tail}")
-            with open(result_path) as f:
-                res = json.load(f)
-            return TrialResult(
-                config=overrides, feasible=True,
-                tokens_per_sec=float(res.get("tokens_per_sec", 0.0)),
-                step_time_ms=float(res.get("step_time_ms", 0.0)),
-                metric=self.tuning.metric)
+            try:
+                with open(result_path) as f:
+                    res = json.load(f)
+                return TrialResult(
+                    config=overrides, feasible=True,
+                    tokens_per_sec=float(res.get("tokens_per_sec")
+                                         or 0.0),
+                    step_time_ms=float(res.get("step_time_ms") or 0.0),
+                    metric=self.tuning.metric)
+            except (ValueError, TypeError) as e:
+                # a malformed result kills only its own trial
+                return TrialResult(config=overrides, feasible=False,
+                                   metric=self.tuning.metric,
+                                   error=f"bad result.json: {e}")
         except subprocess.TimeoutExpired:
             return TrialResult(config=overrides, feasible=False,
                                metric=self.tuning.metric,

@@ -17,7 +17,9 @@ class DeepSpeedTPConfig(DeepSpeedConfigModel):
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     tensor_parallel: DeepSpeedTPConfig = dataclasses.field(
         default_factory=DeepSpeedTPConfig)
-    dtype: str = "bfloat16"
+    dtype: str = "bfloat16"     # "int8"/"int4" -> weight-only quant
+    quantization_group_size: int = 128
+    quantization_min_size: int = 1 << 14   # smaller tensors stay dense
     max_out_tokens: int = 1024
     min_out_tokens: int = 1
     replace_with_kernel_inject: bool = False  # [compat] kernels auto-select

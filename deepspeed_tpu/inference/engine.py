@@ -82,6 +82,26 @@ class InferenceEngine:
         else:
             raise ValueError(f"Unsupported model type: {type(model)}")
 
+        # WOQ serving: dtype "int8"/"int4" keeps activations/caches in
+        # bf16 but stores the projection weights quantized; the dequant
+        # runs inside every jitted forward (fused by XLA), so the same
+        # engine/decode machinery serves the packed tree unchanged
+        # (reference: inference/quantization + GroupQuantizer int8)
+        self._woq_bits = None
+        from .quantization import (dequantize_param_tree,
+                                   woq_bits_from_dtype)
+        bits = woq_bits_from_dtype(self._config.dtype)
+        if bits is not None:
+            self._woq_bits = bits
+            inner_apply = self._apply_fn
+            act_dtype = self.dtype
+
+            def woq_apply(params, *a, **kw):
+                return inner_apply(
+                    dequantize_param_tree(params, act_dtype), *a, **kw)
+
+            self._apply_fn = woq_apply
+
         tensor_rules = getattr(model, "tensor_sharding_rules", None)
         self._rules = ZeroShardingRules(mesh=self.mesh, stage=0,
                                         tensor_rules=tensor_rules)
@@ -112,6 +132,49 @@ class InferenceEngine:
             self._rules.tensor_rules = compose_tensor_rules(
                 moe_tensor_rules, infer_tensor_sharding_rules(cast, tp))
         sh = self._rules.param_shardings(cast)
+        if self._woq_bits is not None:
+            from ..utils.tree import named_leaves as _named
+            from .quantization import (is_woq_leaf, quantize_param_tree,
+                                       tree_hbm_bytes)
+            dense_bytes = tree_hbm_bytes(cast)
+            qtree = quantize_param_tree(
+                cast, num_bits=self._woq_bits,
+                group_size=self._config.quantization_group_size,
+                min_size=self._config.quantization_min_size)
+            # storage shardings: q follows the dense leaf's TP spec
+            # when the (possibly nibble-packed) last dim still divides;
+            # scales replicate (tiny). GSPMD repartitions in-step
+            # regardless — this only sets the HBM-resident layout.
+            names_sh = dict(zip(
+                (n for n, _ in _named(cast)), jax.tree_util.tree_leaves(sh)))
+
+            def place(node, path=""):
+                if is_woq_leaf(node):
+                    dense = names_sh.get(path)
+                    q = node["woq_q"]
+                    try:
+                        qp = jax.device_put(q, dense)
+                    except Exception:
+                        qp = q
+                    return {"woq_q": qp, "woq_scales": node["woq_scales"]}
+                if isinstance(node, dict):
+                    return {k: place(v, f"{path}.{k}" if path else k)
+                            for k, v in node.items()}
+                if isinstance(node, (list, tuple)):
+                    out = [place(v, f"{path}.{i}" if path else str(i))
+                           for i, v in enumerate(node)]
+                    return type(node)(out) if isinstance(node, tuple) \
+                        else out
+                return jax.device_put(node, names_sh.get(path)) \
+                    if names_sh.get(path) is not None else node
+
+            self.params = place(qtree)
+            woq_bytes = tree_hbm_bytes(self.params)
+            logger.info(
+                f"WOQ int{self._woq_bits}: weights "
+                f"{dense_bytes / 1e9:.2f} GB -> {woq_bytes / 1e9:.2f} GB "
+                f"({dense_bytes / max(woq_bytes, 1):.2f}x smaller)")
+            return
         self.params = jax.jit(lambda t: t, out_shardings=sh)(cast)
 
     def _compile(self):

@@ -36,6 +36,9 @@ class RaggedInferenceEngineConfig:
     kv_block_size: int = 128
     max_blocks_per_seq: int = 16
     kv_dtype: str = "bfloat16"
+    weight_dtype: str = "bfloat16"   # "int8"/"int4" -> weight-only quant
+    quantization_group_size: int = 128
+    quantization_min_size: int = 1 << 14
     tp_size: int = 1                 # tensor-parallel degree
 
 
@@ -51,6 +54,23 @@ class InferenceEngineV2:
         # v2/model_implementations/layer_container_base.py
         self.spec, self.tree = normalize_params(
             jax.tree_util.tree_map(jnp.asarray, params), config)
+        self._woq_bits = None
+        from ..quantization import woq_bits_from_dtype
+        bits = woq_bits_from_dtype(ec.weight_dtype)
+        if bits is not None:
+            # WOQ serving (reference: fp6_linear.cu's role — packed
+            # weights in HBM, dequant fused into the ragged matmuls)
+            from ..quantization import (quantize_param_tree,
+                                        tree_hbm_bytes)
+            self._woq_bits = bits
+            dense = tree_hbm_bytes(self.tree)
+            self.tree = quantize_param_tree(
+                self.tree, num_bits=bits,
+                group_size=ec.quantization_group_size,
+                min_size=ec.quantization_min_size)
+            logger.info(
+                f"WOQ int{bits}: v2 weights {dense / 1e9:.2f} GB -> "
+                f"{tree_hbm_bytes(self.tree) / 1e9:.2f} GB")
         self._state_manager = DSStateManager(
             max_tracked_sequences=ec.max_tracked_sequences,
             max_ragged_sequence_count=ec.max_ragged_sequence_count,
@@ -66,11 +86,21 @@ class InferenceEngineV2:
         if ec.tp_size > 1 and self.spec.n_kv_heads % ec.tp_size == 0:
             from ...parallel.mesh import TENSOR_AXIS
             tp_axis = TENSOR_AXIS
-        self._jit_forward = jax.jit(
-            lambda tree, pools, *args: ragged_forward(
-                tree, spec, pools, *args,
-                block_size=ec.kv_block_size, tp_axis=tp_axis),
-            donate_argnums=(1,))
+        woq_bits = self._woq_bits
+        if woq_bits is not None:
+            from ..quantization import dequantize_param_tree
+
+            def fwd(tree, pools, *args):
+                return ragged_forward(
+                    dequantize_param_tree(tree, jnp.bfloat16), spec,
+                    pools, *args, block_size=ec.kv_block_size,
+                    tp_axis=tp_axis)
+        else:
+            def fwd(tree, pools, *args):
+                return ragged_forward(
+                    tree, spec, pools, *args,
+                    block_size=ec.kv_block_size, tp_axis=tp_axis)
+        self._jit_forward = jax.jit(fwd, donate_argnums=(1,))
 
     def _apply_tp_sharding(self, tp: int):
         """Shard the normalized tree with generic TP rules (column-split
@@ -103,14 +133,34 @@ class InferenceEngineV2:
                 return P(None, TENSOR_AXIS, None)
             return P()
 
+        from ..quantization import is_woq_leaf
+
+        def place_leaf(lk, lv):
+            if lv is None:
+                return None
+            if is_woq_leaf(lv):
+                # packed q follows the dense spec when the (possibly
+                # halved) last dim still divides; scales replicate.
+                # GSPMD repartitions in-step either way — this sets the
+                # HBM-resident layout only.
+                sp = spec_for(lk, lv["woq_q"])
+                try:
+                    q = jax.device_put(lv["woq_q"],
+                                       NamedSharding(mesh, sp))
+                except Exception:
+                    q = lv["woq_q"]
+                return {"woq_q": q,
+                        "woq_scales": jax.device_put(
+                            lv["woq_scales"], NamedSharding(mesh, P()))}
+            return jax.device_put(lv, NamedSharding(mesh,
+                                                    spec_for(lk, lv)))
+
         def shard_tree(tree):
             out = {}
             for k, v in tree.items():
                 if k == "layers":
                     out[k] = [
-                        {lk: jax.device_put(
-                            lv, NamedSharding(mesh, spec_for(lk, lv)))
-                         if lv is not None else None
+                        {lk: place_leaf(lk, lv)
                          for lk, lv in layer.items()}
                         for layer in v]
                 else:

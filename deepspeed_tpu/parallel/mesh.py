@@ -38,16 +38,59 @@ BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
-    """Sizes per named axis; -1 on ``data`` means "absorb remaining devices"."""
+    """Sizes per named axis; -1 on ``data`` means "absorb remaining
+    devices".
+
+    Multi-slice (reference seam: SURVEY §2.3 DCN note +
+    deepspeed/utils/groups.py:572 intra/inter-node group split,
+    generalized): ``num_slices`` > 1 declares the devices as that many
+    ICI islands joined by DCN; ``dcn_axes`` names the mesh axes that
+    stride ACROSS slices (dict {axis: slice_factor} or a single-axis
+    tuple carrying all slices). Every other axis stays inside one
+    slice, so its collectives ride ICI. The canonical v5e multi-slice
+    recipe is dcn_axes=("data",): per-layer tensor/fsdp collectives
+    stay on-slice and only the gradient reduction crosses DCN."""
     pipe: int = 1
     data: int = -1
     expert: int = 1
     fsdp: int = 1
     sequence: int = 1
     tensor: int = 1
+    num_slices: int = 1
+    dcn_axes: tuple = ()
+
+    def dcn_factors(self) -> dict:
+        """{axis: slice_factor} with product == num_slices."""
+        if self.num_slices <= 1:
+            return {}
+        if isinstance(self.dcn_axes, dict):
+            f = dict(self.dcn_axes)
+        elif len(self.dcn_axes) == 1:
+            f = {self.dcn_axes[0]: self.num_slices}
+        elif len(self.dcn_axes) == 0:
+            f = {DATA_AXIS: self.num_slices}
+        else:
+            raise ValueError(
+                "multiple dcn_axes need explicit factors: pass a dict "
+                "{axis: slice_factor}")
+        prod = math.prod(f.values())
+        if prod != self.num_slices:
+            raise ValueError(
+                f"dcn factors {f} multiply to {prod}, expected "
+                f"num_slices={self.num_slices}")
+        for ax, fac in f.items():
+            if ax not in MESH_AXES:
+                raise ValueError(f"unknown dcn axis {ax}")
+            size = getattr(self, ax)
+            if size != -1 and size % fac:
+                raise ValueError(
+                    f"axis {ax} size {size} not divisible by its DCN "
+                    f"slice factor {fac}")
+        return f
 
     def resolved(self, n_devices: int) -> "MeshConfig":
         sizes = dataclasses.asdict(self)
+        sizes.pop("num_slices"), sizes.pop("dcn_axes")
         fixed = math.prod(v for v in sizes.values() if v != -1)
         n_auto = sum(1 for v in sizes.values() if v == -1)
         if n_auto > 1:
@@ -62,7 +105,8 @@ class MeshConfig:
         if total != n_devices:
             raise ValueError(
                 f"mesh {sizes} needs {total} devices but {n_devices} are available")
-        return MeshConfig(**sizes)
+        return MeshConfig(**sizes, num_slices=self.num_slices,
+                          dcn_axes=self.dcn_axes)
 
     @property
     def shape(self):
@@ -85,12 +129,51 @@ def build_mesh(config: Optional[MeshConfig] = None,
     n = len(devices)
     config = (config or MeshConfig()).resolved(n)
     shape = config.shape
+    if config.num_slices > 1:
+        return Mesh(_hybrid_device_array(config, devices), MESH_AXES)
     try:
         from jax.experimental import mesh_utils
         dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
     except Exception:
         dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, MESH_AXES)
+
+
+def _hybrid_device_array(config: MeshConfig, devices) -> np.ndarray:
+    """Device array for a multi-slice (ICI x DCN) topology: every
+    non-DCN axis lies within one slice; DCN axes stride across slices
+    slice-major (so slice boundaries are crossed as rarely as the
+    sharding allows).
+
+    Prefers ``mesh_utils.create_hybrid_device_mesh`` (which reads each
+    device's ``slice_index`` and optimizes ICI torus placement); falls
+    back to contiguous grouping for virtual/CPU devices, where slice i
+    is devices[i*per_slice:(i+1)*per_slice]."""
+    factors = config.dcn_factors()
+    shape = config.shape
+    ici_shape = tuple(s // factors.get(ax, 1)
+                      for s, ax in zip(shape, MESH_AXES))
+    dcn_shape = tuple(factors.get(ax, 1) for ax in MESH_AXES)
+    try:
+        from jax.experimental import mesh_utils
+        return mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+    except Exception:
+        pass
+    n = len(devices)
+    per_slice = n // config.num_slices
+    by_slice = np.asarray(devices).reshape(config.num_slices, per_slice)
+    # [slice, *ici_shape] -> split the slice dim into the per-axis DCN
+    # factors (outermost-axis-major), interleave each factor in front
+    # of its ICI axis, then merge
+    arr = by_slice.reshape(tuple(dcn_shape) + ici_shape)
+    ndim = len(MESH_AXES)
+    # interleave: move dcn dim i next to ici dim (ndim + i), merging
+    order = []
+    for i in range(ndim):
+        order += [i, ndim + i]
+    arr = np.transpose(arr, order)
+    return arr.reshape(shape)
 
 
 def single_device_mesh(device=None) -> Mesh:
@@ -167,6 +250,19 @@ class MeshManager:
 
     def pipe_parallel_world_size(self) -> int:
         return self.axis_size(PIPE_AXIS)
+
+    # -------- multi-slice queries --------
+    def dcn_axis_names(self) -> tuple:
+        """Axes that stride across slices (empty on single-slice)."""
+        return tuple(self.config.dcn_factors().keys())
+
+    def is_dcn_axis(self, axis) -> bool:
+        """Do collectives over ``axis`` cross the DCN? Drives the
+        compressed-collective auto-selection (ZeRO++ knobs set to
+        "auto" compress exactly the DCN-crossing exchanges)."""
+        if isinstance(axis, (tuple, list)):
+            return any(self.is_dcn_axis(a) for a in axis)
+        return axis in self.dcn_axis_names()
 
     def sharding(self, *spec) -> NamedSharding:
         return NamedSharding(self.mesh, PartitionSpec(*spec))

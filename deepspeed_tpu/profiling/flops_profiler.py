@@ -17,6 +17,8 @@ Surface (reference parity where it makes sense):
 """
 
 import dataclasses
+import math
+import re as _re
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -98,8 +100,6 @@ def _count_params(args) -> int:
     return total
 
 
-import re as _re
-
 _DOT_RE = _re.compile(
     r"stablehlo\.dot_general .*?"
     r"contracting_dims = \[([\d, ]*)\] x \[[\d, ]*\].*?"
@@ -155,7 +155,7 @@ def module_flops_breakdown(lowered_text: str) -> Dict[str, float]:
         k = 1
         for d in lhs_cdims:
             k *= lhs_shape[d]
-        flops = 2.0 * float(np_prod_list(res_shape)) * k
+        flops = 2.0 * float(math.prod(res_shape)) * k
         raw = locs.get(m.group(4))
         # fused/missing locations (not in the simple loc table) go to
         # "(other)" — NOT through canon, which would misfile them as
@@ -163,13 +163,6 @@ def module_flops_breakdown(lowered_text: str) -> Dict[str, float]:
         path = canon(raw) if raw is not None else "(other)"
         out[path] = out.get(path, 0.0) + flops
     return out
-
-
-def np_prod_list(xs) -> int:
-    p = 1
-    for x in xs:
-        p *= int(x)
-    return p
 
 
 def aggregate_to_depth(per_module: Dict[str, float],

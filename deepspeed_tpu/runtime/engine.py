@@ -1029,9 +1029,24 @@ class DeepSpeedEngine:
         mesh = self.mesh
         fsdp_size = mesh.shape[FSDP_AXIS]
         data_size = mesh.shape[DATA_AXIS]
-        qwz = bool(zc.zero_quantized_weights) and self.zero_stage >= 3 \
+
+        def quant_knob(val, axis):
+            """"auto" -> compress exactly when the exchange crosses the
+            DCN (multi-slice mesh); ICI bandwidth rarely warrants the
+            int8 rounding."""
+            if isinstance(val, str):
+                if val.lower() == "auto":
+                    return mesh_manager.is_dcn_axis(axis)
+                raise ValueError(
+                    f"zero_quantized_* must be true/false/\"auto\", "
+                    f"got {val!r}")
+            return bool(val)
+
+        want_qwz = quant_knob(zc.zero_quantized_weights, FSDP_AXIS)
+        want_qgz = quant_knob(zc.zero_quantized_gradients, FSDP_AXIS)
+        qwz = want_qwz and self.zero_stage >= 3 \
             and fsdp_size > 1
-        if zc.zero_quantized_weights and not qwz:
+        if want_qwz and not qwz:
             logger.warning(
                 "zero_quantized_weights ignored: needs stage>=3 and an "
                 f"fsdp axis > 1 (stage={self.zero_stage}, "
@@ -1042,9 +1057,9 @@ class DeepSpeedEngine:
         # reduce-scatter, so without an fsdp-sharded opt layout every
         # grad would take the plain-psum branch and the knob would be a
         # silent no-op
-        qgz = bool(zc.zero_quantized_gradients) \
+        qgz = want_qgz \
             and 1 <= self.zero_stage <= 2 and fsdp_size > 1 and mp_free
-        if zc.zero_quantized_gradients and not qgz:
+        if want_qgz and not qgz:
             logger.warning(
                 "zero_quantized_gradients ignored: the explicit int8 "
                 "grad reduce-scatter runs the microbatch loop per batch "

@@ -89,9 +89,11 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     legacy_stage1: bool = False
     round_robin_gradients: bool = False     # [compat]
     zero_hpz_partition_size: int = 1        # ZeRO++ hpZ secondary shard size
-    zero_quantized_weights: bool = False    # ZeRO++ qwZ
+    # ZeRO++ qwZ/qgZ: True/False, or "auto" = compress exactly when the
+    # carrying axis (fsdp) crosses the DCN in a multi-slice mesh
+    zero_quantized_weights: bool = False    # ZeRO++ qwZ ("auto" ok)
     zero_quantized_nontrainable_weights: bool = False
-    zero_quantized_gradients: bool = False  # ZeRO++ qgZ
+    zero_quantized_gradients: bool = False  # ZeRO++ qgZ ("auto" ok)
     mics_shard_size: int = -1               # MiCS sub-group shard size
     mics_hierarchical_params_gather: bool = False
     memory_efficient_linear: bool = True    # [compat]

@@ -1,0 +1,156 @@
+"""Weight-only-quantized serving (reference:
+inference/quantization/quantization.py ZeroQuant PTQ serving,
+module_inject/replace_module.py:43 GroupQuantizer int8, the FP6 WOQ
+GEMM's role fp6_linear.cu) — int8/int4 weights consumed by BOTH
+engines with bf16-tolerance logits parity and measured HBM savings."""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.quantization import (dequantize_weight,
+                                                  quantize_param_tree,
+                                                  quantize_weight,
+                                                  tree_hbm_bytes)
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))
+    return cfg, model, params
+
+
+class TestQuantMath:
+
+    @pytest.mark.parametrize("bits,tol", [(8, 0.01), (4, 0.10)])
+    def test_roundtrip_error_bound(self, bits, tol):
+        rng = np.random.default_rng(0)
+        w = rng.standard_normal((64, 256)).astype(np.float32)
+        leaf = quantize_weight(jax.numpy.asarray(w), num_bits=bits,
+                               group_size=128)
+        back = np.asarray(dequantize_weight(leaf, jax.numpy.float32))
+        err = np.abs(back - w).max() / np.abs(w).max()
+        assert err < tol, err
+
+    def test_int4_packs_two_per_byte(self):
+        w = jax.numpy.ones((16, 64))
+        leaf = quantize_weight(w, num_bits=4)
+        assert leaf["woq_q"].dtype == jax.numpy.uint8
+        assert leaf["woq_q"].shape == (16, 32)
+
+    def test_tree_quantization_skips_embeddings_and_small(self,
+                                                          tiny_llama):
+        _, _, params = tiny_llama
+        q = quantize_param_tree(params, num_bits=8, min_size=1)
+        from deepspeed_tpu.inference.quantization import is_woq_leaf
+        from deepspeed_tpu.utils.tree import named_leaves
+        names = [n for n, _ in named_leaves(params)]
+        assert any("embed" in n for n in names)  # fixture sanity
+
+        def find(node, path=""):
+            if is_woq_leaf(node):
+                yield path
+            elif isinstance(node, dict):
+                for k, v in node.items():
+                    yield from find(v, f"{path}.{k}")
+        woq_paths = list(find(q))
+        assert woq_paths, "nothing quantized"
+        assert not any("embed" in p for p in woq_paths)
+        # projections got quantized
+        assert any("proj" in p or "q_proj" in p for p in woq_paths)
+
+
+class TestV1WOQ:
+
+    @pytest.mark.parametrize("dtype,rtol", [("int8", 0.03),
+                                            ("int4", 0.25)])
+    def test_logits_parity_and_hbm_savings(self, tiny_llama,
+                                           eight_devices, dtype, rtol):
+        cfg, model, params = tiny_llama
+        mesh_manager.reset()
+        mesh_manager.init(MeshConfig(data=-1))
+        ref_eng = deepspeed_tpu.init_inference(model, tp_size=1,
+                                               dtype="float32")
+        ref_eng.set_params(params)
+        ids = np.array([[5, 6, 7, 8, 9]], np.int32)
+        ref = np.asarray(ref_eng.forward(ids), np.float32)
+
+        qeng = deepspeed_tpu.init_inference(model, tp_size=1,
+                                            dtype=dtype,
+                                            quantization_min_size=1)
+        qeng.set_params(params)
+        got = np.asarray(qeng.forward(ids), np.float32)
+        # parity at quantization tolerance on the logits scale
+        denom = np.abs(ref).max()
+        assert np.abs(got - ref).max() / denom < rtol
+        # HBM: quantized tree strictly smaller than the bf16 tree
+        bf16_bytes = sum(
+            x.size * 2 for x in jax.tree_util.tree_leaves(params)
+            if np.issubdtype(np.asarray(x).dtype, np.floating))
+        assert tree_hbm_bytes(qeng.params) < bf16_bytes
+
+    def test_cached_generate_int8(self, tiny_llama, eight_devices):
+        """The prefill + scanned-decode path serves the packed tree."""
+        _, model, params = tiny_llama
+        mesh_manager.reset()
+        mesh_manager.init(MeshConfig(data=-1))
+        ref_eng = deepspeed_tpu.init_inference(model, tp_size=1,
+                                               dtype="float32")
+        ref_eng.set_params(params)
+        qeng = deepspeed_tpu.init_inference(model, tp_size=1,
+                                            dtype="int8",
+                                            quantization_min_size=1)
+        qeng.set_params(params)
+        prompt = np.array([[1, 2, 3]], np.int32)
+        out = qeng.generate(prompt, max_new_tokens=5)
+        assert out.shape == (1, 8)
+        # int8 greedy decode usually matches fp32 on a tiny model; at
+        # minimum it is deterministic and finite
+        out2 = qeng.generate(prompt, max_new_tokens=5)
+        np.testing.assert_array_equal(out, out2)
+
+    def test_tp2_int8(self, tiny_llama, eight_devices):
+        cfg, model, params = tiny_llama
+        mesh_manager.reset()
+        mesh_manager.init(MeshConfig(data=-1, tensor=2))
+        qeng = deepspeed_tpu.init_inference(model, tp_size=2,
+                                            dtype="int8",
+                                            quantization_min_size=1)
+        qeng.set_params(params)
+        ids = np.array([[5, 6, 7, 8]], np.int32)
+        logits = np.asarray(qeng.forward(ids))
+        assert np.isfinite(logits).all()
+
+
+class TestV2WOQ:
+
+    def test_ragged_decode_int8_matches_bf16_engine(self, tiny_llama):
+        from deepspeed_tpu.inference.v2 import InferenceEngineV2
+        from deepspeed_tpu.inference.v2.engine_v2 import \
+            RaggedInferenceEngineConfig
+
+        cfg, model, params = tiny_llama
+        mesh_manager.reset()
+        mesh_manager.init(MeshConfig(data=-1))
+        kw = dict(token_budget=32, max_ragged_sequence_count=4,
+                  n_kv_blocks=16, kv_block_size=8, max_blocks_per_seq=8,
+                  kv_dtype="float32")
+        ref = InferenceEngineV2(params, cfg,
+                                RaggedInferenceEngineConfig(**kw))
+        q = InferenceEngineV2(
+            params, cfg,
+            RaggedInferenceEngineConfig(weight_dtype="int8",
+                                        quantization_min_size=1, **kw))
+        assert q._woq_bits == 8
+        prompts = {1: [3, 1, 4, 1, 5], 2: [2, 7, 1]}
+        out_ref = ref.generate_batch(dict(prompts), max_new_tokens=4)
+        out_q = q.generate_batch(dict(prompts), max_new_tokens=4)
+        # greedy decode over a tiny model: int8 tracks the dense path
+        # (token-for-token on this fixture)
+        assert out_q == out_ref

@@ -36,3 +36,92 @@ def test_mesh_manager_queries(eight_devices):
     assert mesh_manager.model_parallel_world_size() == 1
     sh = mesh_manager.sharding("data")
     assert sh.mesh.size == 8
+
+
+class TestMultiSlice:
+    """ICI x DCN hybrid mesh (reference seam: SURVEY §2.3 DCN note +
+    groups.py:572 intra/inter-node split, generalized to slices)."""
+
+    def test_dcn_axis_strides_across_slices(self, eight_devices):
+        """2 slices of 4 virtual chips, data across DCN: every non-data
+        axis neighbourhood stays within one slice; moving along data
+        crosses slices."""
+        from deepspeed_tpu.parallel.mesh import (MeshConfig,
+                                                 mesh_manager)
+        mesh_manager.reset()
+        mesh = mesh_manager.init(
+            MeshConfig(data=2, fsdp=2, tensor=2, num_slices=2,
+                       dcn_axes=("data",)),
+            devices=eight_devices)
+        assert mesh_manager.dcn_axis_names() == ("data",)
+        assert mesh_manager.is_dcn_axis("data")
+        assert not mesh_manager.is_dcn_axis("fsdp")
+        assert mesh_manager.is_dcn_axis(("data", "fsdp"))
+
+        # virtual fallback: slice i = devices[i*4:(i+1)*4]
+        slice_of = {id(d): i // 4 for i, d in enumerate(eight_devices)}
+        arr = mesh.devices  # [pipe,data,expert,fsdp,seq,tensor]
+        squeezed = arr.reshape(2, 2, 2)  # data, fsdp, tensor
+        for di in range(2):
+            slices = {slice_of[id(d)]
+                      for d in squeezed[di].reshape(-1)}
+            assert len(slices) == 1, \
+                f"fsdp/tensor block at data={di} spans slices {slices}"
+        # the two data rows live on different slices
+        s0 = slice_of[id(squeezed[0, 0, 0])]
+        s1 = slice_of[id(squeezed[1, 0, 0])]
+        assert s0 != s1
+
+    def test_dcn_factor_validation(self):
+        from deepspeed_tpu.parallel.mesh import MeshConfig
+        import pytest as _pytest
+        cfg = MeshConfig(data=3, fsdp=1, num_slices=2,
+                         dcn_axes=("data",))
+        with _pytest.raises(ValueError, match="divisible"):
+            cfg.dcn_factors()
+        cfg2 = MeshConfig(data=2, fsdp=2, num_slices=4,
+                          dcn_axes=("data", "fsdp"))
+        with _pytest.raises(ValueError, match="explicit factors"):
+            cfg2.dcn_factors()
+        cfg3 = MeshConfig(data=2, fsdp=2, num_slices=4,
+                          dcn_axes={"data": 2, "fsdp": 2})
+        assert cfg3.dcn_factors() == {"data": 2, "fsdp": 2}
+
+    def test_auto_quantized_gradients_on_dcn_fsdp(self, eight_devices):
+        """zero_quantized_gradients="auto": the int8 grad exchange is
+        selected exactly when the fsdp axis crosses the DCN."""
+        import jax
+        import numpy as np
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+        from deepspeed_tpu.parallel.mesh import (MeshConfig,
+                                                 mesh_manager)
+
+        def compiled_has_s8(num_slices, dcn_axes):
+            mesh_manager.reset()
+            mesh_manager.init(MeshConfig(data=1, fsdp=8,
+                                         num_slices=num_slices,
+                                         dcn_axes=dcn_axes),
+                              devices=eight_devices)
+            model = GPT2LMHeadModel(GPT2Config.tiny())
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=model, config={
+                    "train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {
+                        "stage": 2, "zero_quantized_gradients": "auto"},
+                    "steps_per_print": 0})
+            ids = np.zeros((engine.train_batch_size(), 8), np.int32)
+            b = {"input_ids": ids, "labels": ids.copy()}
+            engine.init_params(b)
+            engine._compile_train_step()
+            db = engine._shard_batch(engine._split_microbatches(b),
+                                     leading_gas=True)
+            txt = engine._jit_train_step.lower(
+                engine.state, db, jax.random.PRNGKey(0),
+                (), False).compile().as_text()
+            return any("s8[" in l for l in txt.splitlines()
+                       if "all-to-all" in l or "all-gather" in l)
+
+        assert compiled_has_s8(2, ("fsdp",)) is True
+        assert compiled_has_s8(1, ()) is False
