@@ -154,13 +154,28 @@ def _hybrid_device_array(config: MeshConfig, devices) -> np.ndarray:
     ici_shape = tuple(s // factors.get(ax, 1)
                       for s, ax in zip(shape, MESH_AXES))
     dcn_shape = tuple(factors.get(ax, 1) for ax in MESH_AXES)
+    has_slice_index = any(hasattr(d, "slice_index") for d in devices)
     try:
         from jax.experimental import mesh_utils
         return mesh_utils.create_hybrid_device_mesh(
             ici_shape, dcn_shape, devices=devices)
-    except Exception:
-        pass
+    except Exception as e:
+        if has_slice_index:
+            # real multi-slice hardware: the contiguous fallback would
+            # GUESS slice membership from jax.devices() order and could
+            # silently route "intra-slice" collectives over DCN
+            raise ValueError(
+                f"create_hybrid_device_mesh failed on real multi-slice "
+                f"devices (ici={ici_shape}, dcn={dcn_shape}): {e}")                 from e
+        from ..utils.logging import logger
+        logger.info(
+            f"hybrid mesh: no slice_index on these devices "
+            f"({type(e).__name__}); using contiguous virtual-slice "
+            "grouping (slice i = devices[i*per_slice:(i+1)*per_slice])")
     n = len(devices)
+    if n % config.num_slices:
+        raise ValueError(
+            f"{n} devices not divisible into {config.num_slices} slices")
     per_slice = n // config.num_slices
     by_slice = np.asarray(devices).reshape(config.num_slices, per_slice)
     # [slice, *ici_shape] -> split the slice dim into the per-axis DCN

@@ -55,6 +55,14 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     # of step N overlaps the device compute of step N+1 (the DPU scheme
     # of the ZeRO-Offload paper); offloaded leaves are one step stale
     delayed_update: bool = False
+    # wire dtype for the device->host grad stream: "bf16" (default;
+    # same exponent range as fp32, halves volume) or "int8" (block-
+    # quantized on device, quarter volume — for slow host links)
+    grad_dtype: str = "bf16"
+    # wire dtype for the host->device param refresh: "bf16" (default)
+    # or "int8_delta" (block-int8 delta vs a device mirror with error
+    # feedback — 1.25 B/param on the wire; DRAM tier only)
+    upload_dtype: str = "bf16"
 
 
 @dataclasses.dataclass

@@ -17,6 +17,7 @@ largest leaves until ``ratio`` of total elements are host-resident.
 """
 
 import concurrent.futures
+import time
 from typing import Any, List, Optional
 
 import jax
@@ -25,6 +26,26 @@ import numpy as np
 
 from ...ops.adam.cpu_adam import DeepSpeedCPUAdam
 from ...utils.logging import log_dist
+
+
+def sharding_replicated(sharding):
+    """Wire-payload placement: single-device shardings pass through
+    (the payload rides to that chip); mesh shardings replicate — the
+    packed (q, scales) grid does not divide like the dense leaf, and
+    at 1.25 B/param replication is cheap. GSPMD repartitions inside
+    the apply-delta jit regardless."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    if isinstance(sharding, NamedSharding):
+        return NamedSharding(sharding.mesh, PartitionSpec())
+    return sharding
+
+
+@jax.jit
+def _apply_delta(leaf, q, scales):
+    deq = (q.astype(jnp.float32) * scales[:, None]).reshape(-1)
+    n = leaf.size
+    upd = deq[:n].reshape(leaf.shape)
+    return (leaf.astype(jnp.float32) + upd).astype(leaf.dtype)
 
 
 def select_offload_mask(params, ratio: float) -> List[bool]:
@@ -56,13 +77,18 @@ class OffloadCoordinator:
 
     def __init__(self, master_params, mask: List[bool], opt_cfg: dict,
                  compute_dtype, adamw_mode: bool = True,
-                 nvme_path: Optional[str] = None):
+                 nvme_path: Optional[str] = None,
+                 int8_grads: bool = False,
+                 int8_delta_upload: bool = False):
         self.mask = mask
         self.compute_dtype = compute_dtype
+        self._int8_grads = bool(int8_grads)
+        self._delta_upload = bool(int8_delta_upload)
         flat, self.treedef = jax.tree_util.tree_flatten(master_params)
         self.off_idx = [i for i, m in enumerate(mask) if m]
         off_params = [np.asarray(flat[i], dtype=np.float32)
                       for i in self.off_idx]
+        self._off_shapes = [a.shape for a in off_params]
         p = dict(opt_cfg or {})
         betas = p.get("betas", (p.get("beta1", 0.9), p.get("beta2", 0.999)))
         self.host_adam = DeepSpeedCPUAdam(
@@ -102,6 +128,27 @@ class OffloadCoordinator:
             self._scratch = [
                 {k: np.empty(max_n, np.float32) for k in "pmv"}
                 for _ in range(2)]
+        # step decomposition (grad D2H / host Adam / param H2D) — the
+        # audited breakdown bench.py config 4 reports; the engine adds
+        # the overlap residue (time the main thread actually stalled)
+        self.last_breakdown = {}
+        if self._delta_upload and self.store is not None:
+            log_dist("ZeRO-Offload: int8_delta upload disabled on the "
+                     "NVMe tier (the device mirror would re-grow DRAM)",
+                     ranks=[0])
+            self._delta_upload = False
+        if self._delta_upload:
+            # fp32 mirror of what the DEVICE holds for each offloaded
+            # leaf: uploads send block-int8 DELTAS against it (error
+            # feedback — the quantization residual of step N is part of
+            # step N+1's delta, so device params track the master to
+            # within one rounding, 1.25 B/param on the wire instead of
+            # 2). The mirror applies the same compute-dtype rounding
+            # the device does (ml_dtypes == XLA's cast; the native
+            # kernel's tie-breaks can differ by one ULP), so host and
+            # device states stay bit-EQUAL.
+            self._mirror = [self._round_compute(
+                np.asarray(a, np.float32)) for a in off_params]
         n_off = sum(int(np.prod(a.shape)) for a in off_params)
         log_dist(f"ZeRO-Offload: {len(self.off_idx)} leaves "
                  f"({n_off/1e6:.2f}M params) "
@@ -138,14 +185,86 @@ class OffloadCoordinator:
         delayed-update mode the main thread never blocks on it."""
         if skip is not None and bool(skip):
             return None
+        t0 = time.perf_counter()
         host = jax.device_get(list(off_grads))
-        np_grads = [np.asarray(g, dtype=np.float32) for g in host]
+        np_grads = self._decode_grads(host)
+        t1 = time.perf_counter()
         if self.store is not None:
-            return self._nvme_step(np_grads, lr, shardings)
-        self.host_adam.step(np_grads, lr=lr)
-        return [self._device_payload(self.host_adam.master[slot],
-                                     shardings[slot])
-                for slot in range(len(self.off_idx))]
+            leaves = self._nvme_step(np_grads, lr, shardings)
+            t2 = t3 = time.perf_counter()   # nvme path times internally
+        else:
+            self.host_adam.step(np_grads, lr=lr)
+            t2 = time.perf_counter()
+            if self._delta_upload:
+                leaves = [self._delta_payload(slot, shardings[slot])
+                          for slot in range(len(self.off_idx))]
+            else:
+                leaves = [self._device_payload(
+                    self.host_adam.master[slot], shardings[slot])
+                    for slot in range(len(self.off_idx))]
+            jax.block_until_ready(jax.tree_util.tree_leaves(leaves))
+            t3 = time.perf_counter()
+        self.last_breakdown = {
+            "grad_d2h_ms": (t1 - t0) * 1e3,
+            "host_adam_ms": (t2 - t1) * 1e3,
+            "param_h2d_ms": (t3 - t2) * 1e3,
+        }
+        return leaves
+
+    def _decode_grads(self, host) -> List[np.ndarray]:
+        """Wire grads -> fp32 arrays. bf16 wire: plain cast. int8 wire:
+        each entry is a (q [n_blocks, 256] int8, scales [n_blocks])
+        pair — dequantize (vectorized) and strip the padding."""
+        if not self._int8_grads:
+            return [np.asarray(g, dtype=np.float32) for g in host]
+        out = []
+        for slot, (q, scales) in enumerate(zip(host[0::2], host[1::2])):
+            deq = (np.asarray(q, np.float32)
+                   * np.asarray(scales, np.float32)[:, None]).reshape(-1)
+            shape = self._off_shapes[slot]
+            out.append(deq[:int(np.prod(shape))].reshape(shape))
+        return out
+
+    def _round_compute(self, x: np.ndarray) -> np.ndarray:
+        """Round an fp32 array through the COMPUTE dtype exactly like
+        the device will (ml_dtypes matches XLA's cast semantics) —
+        the mirror invariant holds for bf16 AND fp16 compute."""
+        import ml_dtypes
+        np_dtype = {jnp.bfloat16: ml_dtypes.bfloat16,
+                    jnp.float16: np.float16}.get(self.compute_dtype)
+        if np_dtype is None:
+            return x
+        return x.astype(np_dtype).astype(np.float32)
+
+    def _delta_payload(self, slot: int, sharding):
+        """Block-int8 delta vs the device mirror + scales; the merge
+        applies it on device and the mirror advances through the same
+        compute-dtype rounding, keeping host and device bit-equal."""
+        from ...comm.compressed import BLOCK
+        master = self.host_adam.master[slot]
+        mirror = self._mirror[slot]
+        delta = (master - mirror.reshape(master.shape)).reshape(-1)
+        n = delta.shape[0]
+        pad = (-n) % BLOCK
+        if pad:
+            delta = np.concatenate(
+                [delta, np.zeros(pad, np.float32)])
+        # numpy twin of comm.compressed._block_quantize: this runs on
+        # the offload background thread and must not touch the device
+        # (the jnp version would contend with the in-flight step)
+        g = delta.reshape(-1, BLOCK)
+        amax = np.abs(g).max(axis=1, keepdims=True)
+        scale = np.where(amax == 0, 1.0, amax / 127.0).astype(np.float32)
+        q = np.clip(np.rint(g / scale), -128, 127).astype(np.int8)
+        # advance the mirror exactly as the device will: dequant, add,
+        # round through compute dtype (ml_dtypes == XLA's cast; the
+        # native kernel's tie-breaks can differ by one ULP)
+        deq = (q.astype(np.float32) * scale).reshape(-1)[:n]
+        self._mirror[slot] = self._round_compute(
+            mirror + deq.reshape(mirror.shape))
+        return {"q": jax.device_put(q, sharding_replicated(sharding)),
+                "scales": jax.device_put(scale[:, 0],
+                                         sharding_replicated(sharding))}
 
     def _device_payload(self, p: np.ndarray, sharding):
         """fp32 master -> compute-dtype device leaf (one rounding path
@@ -201,12 +320,19 @@ class OffloadCoordinator:
 
     def merge(self, state_master, leaves: Optional[list]):
         """Replace the offloaded leaves of ``state_master`` with the
-        host-updated device payloads (pure tree surgery)."""
+        host-updated device payloads. In delta mode each payload is
+        {q, scales}: the add + dequant runs in one small jit per leaf
+        shape (cached by XLA), so the wire carried 1.25 B/param."""
         if leaves is None:
             return state_master
         flat, treedef = jax.tree_util.tree_flatten(state_master)
         for slot, i in enumerate(self.off_idx):
-            flat[i] = leaves[slot]
+            leaf = leaves[slot]
+            if isinstance(leaf, dict):
+                flat[i] = _apply_delta(flat[i], leaf["q"],
+                                       leaf["scales"])
+            else:
+                flat[i] = leaf
         return jax.tree_util.tree_unflatten(treedef, flat)
 
     def _leaf_shardings(self, state_master):

@@ -210,3 +210,65 @@ class TestParamOffloadHost:
                  for x in jax.tree_util.tree_leaves(
                      engine.state.master_params)}
         assert kinds == {"pinned_host"}
+
+
+class TestCompressedWire:
+    """Round-4 link-volume attack (VERDICT item 1): int8 gradient
+    stream down, block-int8 DELTA param refresh up (error-feedback
+    mirror), and the audited step decomposition."""
+
+    def _cfg(self, grad_dtype="bf16", upload_dtype="bf16"):
+        cfg = _config(offload=True, stage=2)
+        cfg["zero_optimization"]["offload_optimizer"].update(
+            grad_dtype=grad_dtype, upload_dtype=upload_dtype)
+        return cfg
+
+    def test_int8_grads_and_delta_upload_parity(self, eight_devices):
+        """The compressed wire tracks the bf16 wire to rounding noise
+        over 10 steps (the delta's error feedback keeps device params
+        equal to the host master within one int8 rounding)."""
+        _, ref = _train(self._cfg(), steps=10)
+        _, got = _train(self._cfg(grad_dtype="int8",
+                                  upload_dtype="int8_delta"), steps=10)
+        np.testing.assert_allclose(got, ref, atol=5e-3)
+
+    def test_mirror_tracks_device_leaves(self, eight_devices):
+        """After delta uploads the host mirror tracks the device
+        leaves to within ONE bf16 ULP (XLA's fused add+cast can break
+        a rounding tie differently than the host once in ~1e5 element-
+        steps; the error feedback folds that ULP into the next delta,
+        so it never compounds — drift beyond 1 ULP would)."""
+        cfg = self._cfg(grad_dtype="int8", upload_dtype="int8_delta")
+        engine, _ = _train(cfg, steps=6)
+        off = engine._offload
+        flat = jax.tree_util.tree_leaves(engine.state.master_params)
+        one_ulp = 2.0 ** -7          # bf16 max relative spacing
+        for slot, i in enumerate(off.off_idx):
+            dev = np.asarray(flat[i], dtype=np.float32)
+            mir = off._mirror[slot].reshape(dev.shape)
+            diff = np.abs(dev - mir)
+            denom = np.maximum(np.abs(dev), 1e-30)
+            assert float((diff / denom).max()) <= one_ulp, \
+                (slot, float(diff.max()))
+            # overwhelmingly bitwise-equal (ties are rare)
+            assert (diff == 0).mean() > 0.999
+
+    def test_breakdown_reported(self, eight_devices):
+        engine, _ = _train(self._cfg(), steps=3)
+        bd = engine.get_offload_breakdown()
+        for k in ("grad_d2h_ms", "host_adam_ms", "param_h2d_ms",
+                  "overlap_residue_ms"):
+            assert k in bd and bd[k] >= 0.0, bd
+
+    def test_bad_dtypes_rejected(self, eight_devices):
+        from deepspeed_tpu.parallel.mesh import mesh_manager
+        for key, val in (("grad_dtype", "fp8"),
+                         ("upload_dtype", "int4")):
+            mesh_manager.reset()
+            model = GPT2LMHeadModel(GPT2Config.tiny())
+            cfg = self._cfg(**{key: val})
+            with pytest.raises(ValueError, match=key):
+                eng, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                                        config=cfg)
+                ids = np.zeros((eng.train_batch_size(), 16), np.int32)
+                eng.init_params({"input_ids": ids, "labels": ids})

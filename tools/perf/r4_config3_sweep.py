@@ -1,0 +1,84 @@
+"""Round-4 config-3 headroom sweep (VERDICT item 10): remat policy x
+micro split x depth at Llama-7B geometry on one chip.
+
+Round-3 recorded 0.923 with full remat, micro 2 x gas 8. Full remat
+recomputes every block forward (+~1/3 FLOPs); at 2 layers / micro 2 the
+activations are small enough that no-remat or a dots-saveable policy
+may fit and buy the missing MFU.
+
+Usage: python tools/perf/r4_config3_sweep.py
+"""
+
+import dataclasses
+import itertools
+import json
+import time
+
+import numpy as np
+
+
+def run(micro, gas, remat, layers=2, seq=2048, steps=3):
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.parallel.mesh import mesh_manager
+    from deepspeed_tpu.profiling.flops_profiler import peak_tflops
+
+    mesh_manager.reset()
+    cfg = dataclasses.replace(LlamaConfig.llama2_7b(),
+                              num_hidden_layers=layers,
+                              use_remat=remat,
+                              max_position_embeddings=seq)
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }
+    model = LlamaForCausalLM(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    gb = engine.train_batch_size()
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(gb, seq), dtype=np.int32)
+    b = {"input_ids": ids, "labels": ids.copy()}
+    float(engine.train_batch(batch=b))
+    float(engine.train_batch(batch=b))
+    times = []
+    for _ in range(steps):
+        t0 = time.time()
+        float(engine.train_batch(batch=b))
+        times.append(time.time() - t0)
+    per_step = sorted(times)[len(times) // 2]
+    tps = gb * seq / per_step
+    prof = engine.get_flops_profile()
+    fpt = prof["flops"] / (micro * seq)
+    mfu = (tps * fpt / 1e12) / peak_tflops()
+    return {"micro": micro, "gas": gas, "remat": remat,
+            "layers": layers, "tokens_per_sec": round(tps, 0),
+            "mfu": round(mfu, 4), "vs_baseline": round(mfu / 0.54, 4)}
+
+
+def main():
+    results = []
+    for micro, gas, remat in [(2, 8, True), (2, 8, False),
+                              (4, 4, False), (1, 16, False),
+                              (4, 4, True)]:
+        try:
+            r = run(micro, gas, remat)
+        except Exception as e:
+            r = {"micro": micro, "gas": gas, "remat": remat,
+                 "error": f"{type(e).__name__}: {str(e)[:200]}"}
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    ok = [r for r in results if "mfu" in r]
+    if ok:
+        best = max(ok, key=lambda r: r["mfu"])
+        print("BEST:", json.dumps(best))
+
+
+if __name__ == "__main__":
+    main()
