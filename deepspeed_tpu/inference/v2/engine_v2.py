@@ -64,16 +64,16 @@ class InferenceEngineV2:
                                         tree_hbm_bytes)
             self._woq_bits = bits
             dense = tree_hbm_bytes(self.tree)
-            # normalized-tree keys "embed"/"head" are the (un)embedding
-            # matrices — excluded like v1's lm_head/embed_tokens (for
-            # tied models "head" aliases "embed"; quantizing it would
-            # ADD a second copy instead of shrinking HBM)
+            # the normalized-tree "head" key is the unembedding —
+            # excluded like v1's lm_head (for tied models it aliases
+            # "embed"; quantizing it would ADD a second copy instead of
+            # shrinking HBM). "embed" is already rejected by the shared
+            # _EMBED_NAMES filter.
             self.tree = quantize_param_tree(
                 self.tree, num_bits=bits,
                 group_size=ec.quantization_group_size,
                 min_size=ec.quantization_min_size,
-                predicate=lambda path, x: not any(
-                    str(seg) in ("embed", "head") for seg in path))
+                predicate=lambda path, x: "head" not in map(str, path))
             logger.info(
                 f"WOQ int{bits}: v2 weights {dense / 1e9:.2f} GB -> "
                 f"{tree_hbm_bytes(self.tree) / 1e9:.2f} GB")

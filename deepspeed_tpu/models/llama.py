@@ -45,6 +45,11 @@ class LlamaConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
     use_remat: bool = False
+    # remat policy: "full" recomputes the whole block in backward;
+    # "dots" saves matmul outputs and recomputes only elementwise ops
+    # (jax.checkpoint_policies.checkpoint_dots) — ~1/3 less backward
+    # recompute for a modest activation-memory increase
+    remat_policy: str = "full"
     # Mistral-style local attention: keys further than this behind the
     # query are masked out (None = full causal)
     sliding_window: Optional[int] = None
@@ -249,7 +254,12 @@ class LlamaForCausalLM(nn.Module):
             positions = jnp.broadcast_to(start + jnp.arange(T)[None, :], (B, T))
         block = LlamaBlock
         if cfg.use_remat:
-            block = nn.remat(LlamaBlock, static_argnums=())
+            if cfg.remat_policy == "dots":
+                block = nn.remat(
+                    LlamaBlock, static_argnums=(),
+                    policy=jax.checkpoint_policies.checkpoint_dots)
+            else:
+                block = nn.remat(LlamaBlock, static_argnums=())
         new_caches = [] if cache is not None else None
         for i in range(cfg.num_hidden_layers):
             if cache is not None:

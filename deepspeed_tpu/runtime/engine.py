@@ -1895,6 +1895,7 @@ class DeepSpeedEngine:
                 "master": [z[f"master_{i}"] for i in range(n)],
                 "m": [z[f"m_{i}"] for i in range(n)],
                 "v": [z[f"v_{i}"] for i in range(n)]})
+            self._offload.resync_mirror(self.state.master_params)
         if client_state:
             self.global_steps = client_state.get("global_steps", 0)
             self.global_samples = client_state.get("global_samples", 0)

@@ -88,7 +88,7 @@ class OffloadCoordinator:
         self.off_idx = [i for i, m in enumerate(mask) if m]
         off_params = [np.asarray(flat[i], dtype=np.float32)
                       for i in self.off_idx]
-        self._off_shapes = [a.shape for a in off_params]
+        self._shapes = [a.shape for a in off_params]
         p = dict(opt_cfg or {})
         betas = p.get("betas", (p.get("beta1", 0.9), p.get("beta2", 0.999)))
         self.host_adam = DeepSpeedCPUAdam(
@@ -109,7 +109,6 @@ class OffloadCoordinator:
             from ...ops.aio import NVMeStateStore
             os.makedirs(nvme_path, exist_ok=True)
             ha = self.host_adam
-            self._shapes = [a.shape for a in ha.master]
             # unique per-coordinator file: a fixed name would let a
             # second engine pointed at the same nvme_path clobber a live
             # engine's optimizer state at store init
@@ -221,7 +220,7 @@ class OffloadCoordinator:
         for slot, (q, scales) in enumerate(zip(host[0::2], host[1::2])):
             deq = (np.asarray(q, np.float32)
                    * np.asarray(scales, np.float32)[:, None]).reshape(-1)
-            shape = self._off_shapes[slot]
+            shape = self._shapes[slot]
             out.append(deq[:int(np.prod(shape))].reshape(shape))
         return out
 
@@ -381,6 +380,19 @@ class OffloadCoordinator:
                 "m": [np.asarray(a) for a in sd["m"]],
                 "v": [np.asarray(a) for a in sd["v"]],
                 "off_idx": list(self.off_idx)}
+
+    def resync_mirror(self, state_master):
+        """Rebuild the delta-upload mirror from the RESTORED device
+        leaves (checkpoint load): the mirror's contract is to equal
+        what the device holds, and after a restore that is the
+        checkpointed compute leaf — computing deltas against the
+        pre-restore mirror would silently shift every offloaded param
+        by (restored - stale)."""
+        if not self._delta_upload:
+            return
+        flat = jax.tree_util.tree_leaves(state_master)
+        self._mirror = [np.asarray(flat[i], dtype=np.float32)
+                        for i in self.off_idx]
 
     def load_state_dict(self, sd):
         if list(sd["off_idx"]) != list(self.off_idx):

@@ -1,0 +1,34 @@
+"""Selective remat policy (round-4 perf knob): "dots" saves matmul
+outputs and recomputes only elementwise ops — measured 3.7% faster in
+tokens/s at Llama shapes (tools/perf/r4_config3_sweep.py)."""
+
+import dataclasses
+
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
+
+
+def test_dots_policy_trains_and_matches_full_remat(eight_devices):
+    losses = {}
+    for policy in ("full", "dots"):
+        mesh_manager.reset()
+        mesh_manager.init(MeshConfig(data=-1))
+        cfg = dataclasses.replace(LlamaConfig.tiny(), use_remat=True,
+                                  remat_policy=policy)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=LlamaForCausalLM(cfg), config={
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1},
+                "steps_per_print": 0})
+        ids = np.random.default_rng(0).integers(
+            0, 256, size=(engine.train_batch_size(), 16), dtype=np.int32)
+        b = {"input_ids": ids, "labels": ids.copy()}
+        losses[policy] = [float(engine.train_batch(batch=b))
+                          for _ in range(4)]
+    # remat changes scheduling, not math
+    np.testing.assert_allclose(losses["dots"], losses["full"],
+                               rtol=1e-5)

@@ -260,6 +260,29 @@ class TestCompressedWire:
                   "overlap_residue_ms"):
             assert k in bd and bd[k] >= 0.0, bd
 
+    def test_mirror_resynced_after_checkpoint_restore(
+            self, eight_devices, tmp_path):
+        """After load_checkpoint the mirror must equal the RESTORED
+        device leaves — deltas against the pre-restore mirror would
+        silently shift every offloaded param (review finding)."""
+        cfg = self._cfg(grad_dtype="int8", upload_dtype="int8_delta")
+        engine, _ = _train(cfg, steps=4)
+        engine.save_checkpoint(str(tmp_path))
+        # keep training so the live mirror moves past the checkpoint
+        ids = np.zeros((engine.train_batch_size(), 16), np.int32)
+        engine.train_batch(batch={"input_ids": ids, "labels": ids})
+        engine.load_checkpoint(str(tmp_path))
+        off = engine._offload
+        flat = jax.tree_util.tree_leaves(engine.state.master_params)
+        for slot, i in enumerate(off.off_idx):
+            dev = np.asarray(flat[i], dtype=np.float32)
+            np.testing.assert_array_equal(
+                dev, off._mirror[slot].reshape(dev.shape))
+        # and training continues without divergence
+        b = {"input_ids": ids, "labels": ids}
+        losses = [float(engine.train_batch(batch=b)) for _ in range(3)]
+        assert np.isfinite(losses).all()
+
     def test_bad_dtypes_rejected(self, eight_devices):
         from deepspeed_tpu.parallel.mesh import mesh_manager
         for key, val in (("grad_dtype", "fp8"),

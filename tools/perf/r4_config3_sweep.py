@@ -17,7 +17,8 @@ import time
 import numpy as np
 
 
-def run(micro, gas, remat, layers=2, seq=2048, steps=3):
+def run(micro, gas, remat, layers=2, seq=2048, steps=3,
+        remat_policy="full"):
     import jax
 
     import deepspeed_tpu
@@ -29,6 +30,7 @@ def run(micro, gas, remat, layers=2, seq=2048, steps=3):
     cfg = dataclasses.replace(LlamaConfig.llama2_7b(),
                               num_hidden_layers=layers,
                               use_remat=remat,
+                              remat_policy=remat_policy,
                               max_position_embeddings=seq)
     config = {
         "train_micro_batch_size_per_gpu": micro,
@@ -58,19 +60,27 @@ def run(micro, gas, remat, layers=2, seq=2048, steps=3):
     fpt = prof["flops"] / (micro * seq)
     mfu = (tps * fpt / 1e12) / peak_tflops()
     return {"micro": micro, "gas": gas, "remat": remat,
-            "layers": layers, "tokens_per_sec": round(tps, 0),
+            "remat_policy": remat_policy, "layers": layers,
+            "tokens_per_sec": round(tps, 0),
             "mfu": round(mfu, 4), "vs_baseline": round(mfu / 0.54, 4)}
 
 
 def main():
+    import sys
+    combos = [(2, 8, True, "full"), (2, 8, False, "full"),
+              (4, 4, False, "full"), (1, 16, False, "full"),
+              (4, 4, True, "full"),
+              (2, 8, True, "dots"), (4, 4, True, "dots")]
+    if len(sys.argv) > 1:      # e.g. "0,1" selects a subset
+        keep = [int(i) for i in sys.argv[1].split(",")]
+        combos = [combos[i] for i in keep]
     results = []
-    for micro, gas, remat in [(2, 8, True), (2, 8, False),
-                              (4, 4, False), (1, 16, False),
-                              (4, 4, True)]:
+    for micro, gas, remat, policy in combos:
         try:
-            r = run(micro, gas, remat)
+            r = run(micro, gas, remat, remat_policy=policy)
         except Exception as e:
             r = {"micro": micro, "gas": gas, "remat": remat,
+                 "remat_policy": policy,
                  "error": f"{type(e).__name__}: {str(e)[:200]}"}
         print(json.dumps(r), flush=True)
         results.append(r)
