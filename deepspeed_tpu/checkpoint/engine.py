@@ -46,13 +46,26 @@ def save_checkpoint(save_dir, tag, state, client_state=None, save_latest=True):
     if not saved:
         _npz_save(state_dir, state)
 
-    with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
-        json.dump(_jsonable(client_state or {}), f)
+    _atomic_write(os.path.join(ckpt_dir, "client_state.json"),
+                  json.dumps(_jsonable(client_state or {})))
     if save_latest:
-        with open(os.path.join(save_dir, "latest"), "w") as f:
-            f.write(str(tag))
+        # ``latest`` is the COMMIT POINT: it must only ever name a
+        # fully-written checkpoint, and a kill mid-update must never
+        # leave it empty/truncated — hence write-then-rename (atomic on
+        # POSIX). Crash-recovery contract: if ``latest`` exists, the
+        # checkpoint it names is loadable.
+        _atomic_write(os.path.join(save_dir, "latest"), str(tag))
     logger.info(f"Saved checkpoint {tag} to {save_dir}")
     return ckpt_dir
+
+
+def _atomic_write(path: str, text: str):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def resolve_tag(load_dir, tag):

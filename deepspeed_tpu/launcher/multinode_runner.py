@@ -138,7 +138,8 @@ class GcloudTPURunner(SSHRunner):
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", self.tpu_name,
                "--worker=all", f"--command={remote}"]
         if self.zone:
-            cmd.insert(5, f"--zone={self.zone}")
+            # canonical flag order: NAME --zone=... --worker=all ...
+            cmd.insert(6, f"--zone={self.zone}")
         return [cmd]
 
 

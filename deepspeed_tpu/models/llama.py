@@ -258,8 +258,12 @@ class LlamaForCausalLM(nn.Module):
                 block = nn.remat(
                     LlamaBlock, static_argnums=(),
                     policy=jax.checkpoint_policies.checkpoint_dots)
-            else:
+            elif cfg.remat_policy == "full":
                 block = nn.remat(LlamaBlock, static_argnums=())
+            else:
+                raise ValueError(
+                    f"remat_policy must be 'full' or 'dots', got "
+                    f"{cfg.remat_policy!r}")
         new_caches = [] if cache is not None else None
         for i in range(cfg.num_hidden_layers):
             if cache is not None:

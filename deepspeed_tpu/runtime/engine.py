@@ -1854,10 +1854,11 @@ class DeepSpeedEngine:
                 {"bits": g["bits"], "period": g["period"],
                  "next_drop": g["next_drop"]} for g in self._moq.groups]
         self.checkpoint_engine.create(tag)
-        self.checkpoint_engine.save(self.state, save_dir, tag,
-                                    client_state=client_state,
-                                    save_latest=save_latest)
         if self._offload is not None:
+            # the offload host state must be durable BEFORE the engine
+            # save commits the ``latest`` pointer — latest is the crash-
+            # recovery commit point and must only name checkpoints whose
+            # EVERY piece is loadable (checkpoint/engine.py contract)
             sd = self._offload.state_dict()
             payload = {"step": np.int64(sd["step"]),
                        "off_idx": np.asarray(sd["off_idx"])}
@@ -1865,9 +1866,14 @@ class DeepSpeedEngine:
                 payload[f"master_{i}"] = sd["master"][i]
                 payload[f"m_{i}"] = sd["m"][i]
                 payload[f"v_{i}"] = sd["v"][i]
-            self.checkpoint_engine.commit(tag)  # dir must exist first
-            np.savez(os.path.join(save_dir, str(tag),
-                                  "zero_offload_host_state.npz"), **payload)
+            tag_dir = os.path.join(save_dir, str(tag))
+            os.makedirs(tag_dir, exist_ok=True)
+            np.savez(os.path.join(tag_dir,
+                                  "zero_offload_host_state.npz"),
+                     **payload)
+        self.checkpoint_engine.save(self.state, save_dir, tag,
+                                    client_state=client_state,
+                                    save_latest=save_latest)
         # async engine: join + surface background errors; one future per
         # tag would otherwise leak (and swallow exceptions) forever
         self.checkpoint_engine.commit(tag)
@@ -1895,6 +1901,9 @@ class DeepSpeedEngine:
                 "master": [z[f"master_{i}"] for i in range(n)],
                 "m": [z[f"m_{i}"] for i in range(n)],
                 "v": [z[f"v_{i}"] for i in range(n)]})
+        if self._offload is not None:
+            # the mirror tracks the DEVICE leaves; it must follow every
+            # state replacement, not just optimizer-state reloads
             self._offload.resync_mirror(self.state.master_params)
         if client_state:
             self.global_steps = client_state.get("global_steps", 0)

@@ -80,6 +80,46 @@ def test_gcloud_runner_cmd():
     assert "--worker=all" in cmd
 
 
+def test_gcloud_runner_real_pod_topology():
+    """v5e-16 pod shape: 4 hosts x 1 proc; the composed command must
+    carry the full rendezvous (nnodes/nproc/master addr+port), a per-
+    worker node_rank derivation, zone placement, quoted user args, and
+    the env exports — the things a real `gcloud ... ssh --worker=all`
+    launch needs to come up as one jax.distributed world."""
+    import shlex
+    args = parse_args(["--master_port", "29512", "train.py",
+                       "--ds-config", "cfg with space.json"])
+    args.master_addr = "t1v-n-abc-w-0"
+    args.user_script = "train.py"
+    args.user_args = ["--ds-config", "cfg with space.json"]
+    pool = {f"w{i}": 1 for i in range(4)}
+    r = GcloudTPURunner(args, pool, tpu_name="v5e-pod",
+                        zone="us-west4-a")
+    (cmd,) = r.get_cmd({"PYTHONPATH": "/repo",
+                        "TPU_NAME": "v5e-pod"}, None)
+    # gcloud surface: target + worker fan-out + zone before the command
+    assert cmd[5] == "v5e-pod"
+    zi = cmd.index("--zone=us-west4-a")
+    ci = next(i for i, c in enumerate(cmd)
+              if c.startswith("--command="))
+    assert zi < ci
+    remote = cmd[ci][len("--command="):]
+    # per-worker rank derivation (hostname suffix -> node_rank)
+    assert "--node_rank=$(hostname" in remote
+    assert "--nnodes=4" in remote
+    assert "--nproc_per_node=1" in remote
+    assert "--master_addr=t1v-n-abc-w-0" in remote
+    assert "--master_port=29512" in remote
+    # env rides along, user args stay quoted through the remote shell
+    assert "export PYTHONPATH=/repo;" in remote
+    assert "export TPU_NAME=v5e-pod;" in remote
+    assert shlex.quote("cfg with space.json") in remote
+    # the remote shell parses back to a well-formed invocation
+    toks = shlex.split(remote.replace(
+        "$(hostname | grep -o '[0-9]*$')", "3"))
+    assert "train.py" in toks and "cfg with space.json" in toks
+
+
 WORKER = """
 import os, sys
 import jax
