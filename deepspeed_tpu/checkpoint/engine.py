@@ -60,7 +60,9 @@ def save_checkpoint(save_dir, tag, state, client_state=None, save_latest=True):
 
 
 def _atomic_write(path: str, text: str):
-    tmp = path + ".tmp"
+    # unique tmp per writer: on a SHARED checkpoint dir (multi-host
+    # collective save) concurrent writers must not race on one tmp name
+    tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         f.write(text)
         f.flush()

@@ -1,5 +1,6 @@
 from .compress import (apply_compression, init_compression,
                        redundancy_clean)
+from .layer_reduction import apply_layer_reduction, student_initialization
 from .config import CompressionConfig
 from .quantizers import (asym_quantize, binary_quantize, ptq_dequantize,
                          ptq_quantize, sym_quantize, ternary_quantize)

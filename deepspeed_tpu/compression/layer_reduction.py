@@ -1,0 +1,99 @@
+"""Layer reduction — depth compression by teacher-layer selection.
+
+Reference: deepspeed/compression/compress.py:206-231
+``student_initialization``: the student keeps ``keep_number_layer``
+layers, each initialized from the teacher layer named in
+``teacher_layer`` (student layer i <- teacher layer teacher_layer[i]),
+addressed under ``module_name_prefix``. The reference mutates module
+attributes; here the same selection is pure tree surgery on the param
+pytree — the student is the SAME flax module constructed at the reduced
+depth, fed the re-indexed teacher weights.
+
+Config (reference schema)::
+
+    "compression_training": {
+      "layer_reduction": {
+        "enabled": true,
+        "keep_number_layer": 5,
+        "module_name_prefix": "h",          # h_0, h_1, ... families
+        "teacher_layer": [2, 4, 6, 8, 10],
+        "other_module_name": [...]          # [compat] copied as-is
+      }
+    }
+"""
+
+import re
+from typing import Any, Dict, List
+
+from ..utils.logging import logger
+
+
+def _layer_key(prefix: str, name_parts: List[str]):
+    """If this path addresses ``<prefix>_<i>`` (or ``<prefix>.<i>``),
+    return (index, tail position); else None."""
+    for pos, seg in enumerate(name_parts):
+        m = re.fullmatch(re.escape(prefix) + r"_(\d+)", seg)
+        if m:
+            return int(m.group(1)), pos
+        if seg == prefix and pos + 1 < len(name_parts) and \
+                name_parts[pos + 1].isdigit():
+            return int(name_parts[pos + 1]), pos + 1
+    return None
+
+
+def apply_layer_reduction(teacher_params, lr_cfg: Dict[str, Any]):
+    """Teacher param tree -> student tree with the selected layers
+    renumbered 0..k-1. Non-layer params pass through unchanged."""
+    from ..utils.tree import flatten_with_name_parts
+
+    teacher_layers = [int(i) for i in lr_cfg["teacher_layer"]]
+    keep = int(lr_cfg.get("keep_number_layer", len(teacher_layers)))
+    if keep != len(teacher_layers):
+        raise ValueError(
+            f"keep_number_layer={keep} but teacher_layer lists "
+            f"{len(teacher_layers)} layers (reference asserts equality)")
+    prefix = lr_cfg.get("module_name_prefix", "h")
+    remap = {t: s for s, t in enumerate(teacher_layers)}
+
+    parts_list, leaves, _ = flatten_with_name_parts(teacher_params)
+    out: Dict[str, Any] = {}
+    kept = dropped = 0
+    for parts, leaf in zip(parts_list, leaves):
+        hit = _layer_key(prefix, parts)
+        if hit is not None:
+            idx, pos = hit
+            if idx not in remap:
+                dropped += 1
+                continue
+            parts = list(parts)
+            if parts[pos].isdigit():
+                parts[pos] = str(remap[idx])
+            else:
+                parts[pos] = f"{prefix}_{remap[idx]}"
+            kept += 1
+        node = out
+        for seg in parts[:-1]:
+            node = node.setdefault(seg, {})
+        node[parts[-1]] = leaf
+    logger.info(f"layer_reduction: kept {kept} leaves across "
+                f"{len(teacher_layers)} layers (teacher order "
+                f"{teacher_layers}), dropped {dropped}")
+    if kept == 0:
+        raise ValueError(
+            f"layer_reduction matched no '{prefix}_<i>' leaves — check "
+            "module_name_prefix against the param tree")
+    return out
+
+
+def student_initialization(teacher_params, ds_config: Dict[str, Any]):
+    """Reference-parity entry (compress.py ``student_initialization``):
+    applies layer reduction when the config enables it; QAT/pruning are
+    engine-integrated (runtime/engine.py compression transform) and
+    need no model surgery here. Returns the (possibly reduced) params —
+    construct the student module at keep_number_layer depth and feed it
+    this tree."""
+    section = (ds_config or {}).get("compression_training", {})
+    lr_cfg = section.get("layer_reduction", {"enabled": False})
+    if lr_cfg.get("enabled"):
+        return apply_layer_reduction(teacher_params, lr_cfg)
+    return teacher_params

@@ -60,6 +60,8 @@ def _masked_crc(data: bytes) -> int:
 # minimal protobuf wire encoding (varint + tagged fields)
 # ---------------------------------------------------------------------------
 def _varint(n: int) -> bytes:
+    if n < 0:
+        raise ValueError(f"varint requires n >= 0, got {n}")
     out = bytearray()
     while True:
         b = n & 0x7F
@@ -127,6 +129,8 @@ class EventFileWriter:
         self._f.write(struct.pack("<I", _masked_crc(data)))
 
     def add_scalar(self, tag: str, value, step: int):
+        if int(step) < 0:
+            raise ValueError(f"step must be >= 0, got {step}")
         self._write_record(_scalar_event(tag, float(value), int(step),
                                          time.time()))
 

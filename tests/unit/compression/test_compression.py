@@ -150,3 +150,61 @@ class TestConfigDriven:
         cleaned, masks = redundancy_clean(params, cfg)
         assert cleaned["mlp"]["kernel"].shape == (8, 8)
         assert len(masks) == 1
+
+
+class TestLayerReduction:
+    """Depth compression (reference: compress.py:206-231
+    student_initialization — student layer i <- teacher_layer[i])."""
+
+    def test_student_init_from_selected_teacher_layers(self):
+        import dataclasses
+        import jax
+        import numpy as np
+        from deepspeed_tpu.compression import student_initialization
+        from deepspeed_tpu.models.gpt2 import (GPT2Config,
+                                               GPT2LMHeadModel)
+
+        tcfg = dataclasses.replace(GPT2Config.tiny(), n_layer=4)
+        teacher = GPT2LMHeadModel(tcfg)
+        tparams = teacher.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 8), np.int32))
+        ds_config = {"compression_training": {"layer_reduction": {
+            "enabled": True, "keep_number_layer": 2,
+            "module_name_prefix": "h", "teacher_layer": [1, 3]}}}
+        sparams = student_initialization(tparams, ds_config)
+        # student layer 0 == teacher layer 1, student 1 == teacher 3
+        t = tparams["params"]
+        sp = sparams["params"]
+        assert set(k for k in sp if k.startswith("h_")) == \
+            {"h_0", "h_1"}
+        np.testing.assert_array_equal(
+            np.asarray(sp["h_0"]["attn"]["c_attn"]["kernel"]),
+            np.asarray(t["h_1"]["attn"]["c_attn"]["kernel"]))
+        np.testing.assert_array_equal(
+            np.asarray(sp["h_1"]["mlp"]["c_fc"]["kernel"]),
+            np.asarray(t["h_3"]["mlp"]["c_fc"]["kernel"]))
+        # embeddings pass through
+        np.testing.assert_array_equal(np.asarray(sp["wte"]),
+                                      np.asarray(t["wte"]))
+        # the 2-layer student MODULE runs on the reduced tree
+        scfg = dataclasses.replace(tcfg, n_layer=2)
+        student = GPT2LMHeadModel(scfg)
+        logits = student.apply(sparams, np.array([[1, 2, 3]], np.int32))
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_mismatched_keep_count_rejected(self):
+        import pytest as _pytest
+        from deepspeed_tpu.compression import apply_layer_reduction
+        with _pytest.raises(ValueError, match="keep_number_layer"):
+            apply_layer_reduction({}, {"keep_number_layer": 3,
+                                       "teacher_layer": [0, 1]})
+
+    def test_bad_prefix_rejected(self):
+        import jax.numpy as jnp
+        import pytest as _pytest
+        from deepspeed_tpu.compression import apply_layer_reduction
+        params = {"params": {"h_0": {"w": jnp.zeros((2, 2))}}}
+        with _pytest.raises(ValueError, match="module_name_prefix"):
+            apply_layer_reduction(params, {
+                "keep_number_layer": 1, "module_name_prefix": "layers",
+                "teacher_layer": [0]})
