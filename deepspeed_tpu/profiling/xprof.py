@@ -1,0 +1,59 @@
+"""xprof trace capture — the TPU profiler integration.
+
+Reference analog (SURVEY §5 tracing): the reference leans on NVTX +
+Nsight and torch profilers; the TPU-native story is jax.profiler —
+device traces (XLA op timelines, HBM usage) written in the TensorBoard
+profile-plugin format. This module wraps it behind the engine so two
+method calls capture a trace window:
+
+    engine.start_profiler_trace("gs://bucket/traces")   # or local dir
+    engine.train_batch(...)                             # N steps
+    engine.stop_profiler_trace()
+    # -> `tensorboard --logdir <dir>`, Profile tab
+
+or scoped::
+
+    with profiler_trace("traces/step100"):
+        engine.train_batch(batch=b)
+"""
+
+import contextlib
+import os
+
+import jax
+
+from ..utils.logging import logger
+
+
+def start_trace(log_dir: str):
+    if "://" not in log_dir:        # remote (gs://...) dirs are jax's
+        os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    logger.info(f"xprof trace started -> {log_dir}")
+
+
+def stop_trace():
+    jax.profiler.stop_trace()
+    logger.info("xprof trace stopped")
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str):
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()
+
+
+def trace_dir_has_profile(log_dir: str) -> bool:
+    """Did a capture actually land? (plugins/profile/<run>/ with at
+    least one .trace/.pb/.json.gz artifact)."""
+    root = os.path.join(log_dir, "plugins", "profile")
+    if not os.path.isdir(root):
+        return False
+    for dirpath, _, files in os.walk(root):
+        if any(f.endswith((".trace.json.gz", ".pb", ".trace"))
+               for f in files):
+            return True
+    return False

@@ -2044,6 +2044,17 @@ class DeepSpeedEngine:
                 self.state.master_params, depth),
         }
 
+    def start_profiler_trace(self, log_dir: str):
+        """Capture an xprof/TensorBoard-profile trace window (the
+        reference's Nsight/NVTX role; SURVEY §5 tracing). Stop with
+        ``stop_profiler_trace``; view under TensorBoard's Profile tab."""
+        from ..profiling.xprof import start_trace
+        start_trace(log_dir)
+
+    def stop_profiler_trace(self):
+        from ..profiling.xprof import stop_trace
+        stop_trace()
+
     def set_data_iterator(self, it):
         self.data_iterator = it
 

@@ -1,0 +1,56 @@
+"""Profiler range annotations — the NVTX analog.
+
+Reference: deepspeed/utils/nvtx.py ``instrument_w_nvtx`` (wraps
+functions in ``nvtx.range`` so Nsight attributes GPU time) and
+``accelerator.range_push/pop`` (abstract_accelerator.py:189-193).
+
+TPU-native: ``jax.profiler.TraceAnnotation`` puts named ranges into
+xprof/perfetto traces, and ``jax.named_scope`` tags the ops traced
+UNDER the range so XLA op names carry the label (that is what the
+per-module FLOPS breakdown reads). Both are no-ops outside an active
+trace — safe to leave on in production, like nvtx.
+"""
+
+import functools
+import threading
+
+import jax
+
+# per-thread range stack: trace annotations are per-thread in jax/TSL,
+# and the background threads this runtime runs (async checkpoint saves,
+# offload DPU) must not pop the training thread's ranges
+_LOCAL = threading.local()
+
+
+def _stack():
+    if not hasattr(_LOCAL, "ranges"):
+        _LOCAL.ranges = []
+    return _LOCAL.ranges
+
+
+def range_push(name: str):
+    """Eager range begin (accelerator.range_push analog)."""
+    ann = jax.profiler.TraceAnnotation(name)
+    ann.__enter__()
+    _stack().append(ann)
+    return ann
+
+
+def range_pop():
+    stack = _stack()
+    if stack:
+        stack.pop().__exit__(None, None, None)
+
+
+def instrument_w_nvtx(func):
+    """Decorator: run ``func`` inside a named profiler range AND a
+    jax.named_scope, so both the host timeline and the lowered op
+    names carry ``func.__qualname__`` (reference: utils/nvtx.py)."""
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        name = func.__qualname__
+        with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+            return func(*args, **kwargs)
+
+    return wrapped

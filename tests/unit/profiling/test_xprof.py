@@ -1,0 +1,65 @@
+"""xprof trace capture + profiler range annotations (SURVEY §5
+tracing — the NVTX/Nsight role done the TPU way)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
+from deepspeed_tpu.profiling.xprof import (profiler_trace,
+                                           trace_dir_has_profile)
+
+
+def test_engine_trace_window_produces_profile(tmp_path, eight_devices):
+    mesh_manager.reset()
+    mesh_manager.init(MeshConfig(data=-1))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(GPT2Config.tiny()), config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 0})
+    ids = np.zeros((engine.train_batch_size(), 16), np.int32)
+    b = {"input_ids": ids, "labels": ids}
+    engine.train_batch(batch=b)          # compile outside the window
+    engine.start_profiler_trace(str(tmp_path))
+    engine.train_batch(batch=b)
+    engine.stop_profiler_trace()
+    assert trace_dir_has_profile(str(tmp_path)), \
+        "no profile artifacts captured"
+
+
+def test_scoped_trace_and_ranges(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.utils.nvtx import (instrument_w_nvtx, range_pop,
+                                          range_push)
+
+    @instrument_w_nvtx
+    def work(x):
+        return jnp.sum(x * 2)
+
+    with profiler_trace(str(tmp_path)):
+        range_push("outer")
+        float(jax.jit(work)(jnp.arange(8.0)))
+        range_pop()
+    assert trace_dir_has_profile(str(tmp_path))
+
+
+def test_instrument_tags_lowered_ops():
+    """The decorator's named_scope lands in the lowering's location
+    table — the same names the per-module FLOPS breakdown reads."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.utils.nvtx import instrument_w_nvtx
+
+    @instrument_w_nvtx
+    def projection(x, w):
+        return x @ w
+
+    txt = jax.jit(projection).lower(
+        jnp.zeros((4, 8)), jnp.zeros((8, 8))).as_text(debug_info=True)
+    assert "projection" in txt
