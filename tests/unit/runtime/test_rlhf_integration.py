@@ -1,0 +1,128 @@
+"""DeepSpeed-Chat-shaped RLHF integration: the actor loop the hybrid
+engine exists for (reference: blogs/deepspeed-chat — actor generates
+rollouts through the inference path, a reward scores them, the policy
+updates, the NEXT rollout reflects the update; hybrid_engine.py:30).
+
+This is the integration seam test: hybrid engine + LoRA adapters +
+TP mesh + reward-weighted policy step in ONE loop. The "PPO-lite"
+objective (reward-weighted log-likelihood on self-generated tokens) is
+deliberately simple — the framework seams, not RL math, are under
+test."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.llama import LlamaConfig
+from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
+from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+
+class _ActorLM:
+    """Llama wrapped with a weighted-CE loss head: batches carry
+    per-sequence reward weights (the PPO-lite objective)."""
+
+    def __init__(self, cfg):
+        from deepspeed_tpu.models.llama import LlamaForCausalLM
+        self.inner = LlamaForCausalLM(cfg)
+        self.config = cfg
+        # forward the native TP rules so tp2 exercises the same
+        # sharding path a real Llama actor uses (not the AutoTP
+        # fallback the wrapper would otherwise trigger)
+        rules = getattr(self.inner, "tensor_sharding_rules", None)
+        if rules is not None:
+            self.tensor_sharding_rules = rules
+
+    def init(self, rng, input_ids, labels=None, weights=None, **kw):
+        return self.inner.init(rng, np.asarray(input_ids))
+
+    def apply(self, params, input_ids, labels=None, weights=None,
+              rngs=None, **kw):
+        if labels is None:
+            return self.inner.apply(params, input_ids, **kw)
+        logits = self.inner.apply(params, input_ids, **kw)
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32),
+                                  axis=-1)
+        tgt = labels[:, 1:]
+        ll = jnp.take_along_axis(logp, tgt[..., None],
+                                 axis=-1)[..., 0]
+        w = weights if weights is not None else \
+            jnp.ones((input_ids.shape[0],), jnp.float32)
+        # reward-weighted likelihood: positive reward pushes the
+        # policy toward its own rollout, negative away
+        return -jnp.mean(w[:, None] * ll)
+
+    def init_cache(self, *a, **kw):
+        return self.inner.init_cache(*a, **kw)
+
+
+def _toy_reward(tokens: np.ndarray, target_token: int) -> np.ndarray:
+    """Reward: sequences containing the target id are pushed up, the
+    rest mildly down — a verifiable training signal."""
+    frac = (tokens == target_token).mean(axis=1)
+    return np.where(frac > 0, 1.0 + 4.0 * frac, -0.1).astype(np.float32)
+
+
+@pytest.mark.parametrize("tensor", [1, 2], ids=["tp1", "tp2"])
+def test_generate_score_update_loop(eight_devices, tensor):
+    mesh_manager.reset()
+    mesh_manager.init(MeshConfig(data=-1, tensor=tensor))
+    cfg = dataclasses.replace(LlamaConfig.tiny(), vocab_size=64)
+    actor = _ActorLM(cfg)
+    engine = DeepSpeedHybridEngine(
+        model=actor,
+        config={
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "AdamW", "params": {"lr": 5e-2}},
+            "zero_optimization": {"stage": 0},
+            "steps_per_print": 0,
+        },
+        inference_config={"dtype": "float32", "tp_size": tensor},
+        lora={"r": 4, "alpha": 8.0})
+    B = engine.train_batch_size()
+    prompts = np.tile(np.array([[1, 2, 3]], np.int32), (B, 1))
+    engine.init_params({"input_ids": prompts, "labels": prompts})
+
+    target = 7
+
+    def p_target():
+        """Policy probability of the rewarded token after the prompt —
+        measured through the INFERENCE path (so it also asserts each
+        rollout engine refresh saw the newest adapters)."""
+        logits = np.asarray(engine.infer_forward(prompts[:1]),
+                            np.float32)[0, -1]
+        return float(jax.nn.softmax(jnp.asarray(logits))[target])
+
+    p0 = p_target()
+    probs = [p0]
+    for it in range(8):
+        # rollout through the inference path (fused LoRA weights),
+        # sampled so the policy can explore
+        out = engine.generate(prompts, max_new_tokens=8,
+                              temperature=1.0,
+                              rng=jax.random.PRNGKey(it))
+        gen = np.asarray(out)[:, prompts.shape[1]:]
+        rewards = _toy_reward(gen, target)
+        # policy step on the rollout, reward-weighted
+        batch = {"input_ids": np.asarray(out, np.int32),
+                 "labels": np.asarray(out, np.int32),
+                 "weights": rewards}
+        engine.train_batch(batch=batch)
+        probs.append(p_target())
+    # the policy's probability of the rewarded token rose, and every
+    # refresh exposed the newest adapters to the rollout engine (the
+    # weight-sharing contract) — sampled-token fractions are too noisy
+    # at this scale, the probability is the low-variance readout
+    assert probs[-1] > p0 * 1.2, probs
+
+    # only the (small) adapter tree trained — the frozen-base VALUE
+    # invariant is pinned by test_hybrid_engine.py TestLora; here we
+    # assert the state size shows LoRA economics
+    n_adapter = sum(x.size for x in jax.tree_util.tree_leaves(
+        engine.state.master_params))
+    n_base = sum(x.size for x in jax.tree_util.tree_leaves(
+        engine._lora_base))
+    assert n_adapter < n_base / 5
