@@ -278,6 +278,10 @@ def _reset_mesh():
 
 
 def main():
+    # the driver contract is ONE JSON line on stdout; the engine's
+    # rank-0 INFO logging would interleave with it
+    import logging
+    logging.getLogger("DeepSpeedTPU").setLevel(logging.WARNING)
     p = argparse.ArgumentParser()
     p.add_argument("--config", type=int, default=0,
                    choices=[0, 1, 2, 3, 4, 5],
