@@ -143,5 +143,34 @@ class GcloudTPURunner(SSHRunner):
         return [cmd]
 
 
+class SlurmRunner(MultiNodeRunner):
+    """srun fan-out (reference: SlurmRunner multinode_runner.py:242) —
+    one srun launches the per-host launcher on every allocated node;
+    node_rank comes from SLURM_NODEID in the task env."""
+    name = "slurm"
+
+    def backend_exists(self):
+        from shutil import which
+        return which("srun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        nnodes = len(self.resource_pool)
+        slots = next(iter(self.resource_pool.values()))
+        exports = " ".join(f"export {k}={shlex.quote(str(v))};"
+                           for k, v in environment.items())
+        remote = (f"{exports} cd {shlex.quote(os.getcwd())}; "
+                  f"{sys.executable} -m deepspeed_tpu.launcher.launch "
+                  f"--node_rank=$SLURM_NODEID --nnodes={nnodes} "
+                  f"--nproc_per_node={slots} "
+                  f"--master_addr={self.args.master_addr} "
+                  f"--master_port={self.args.master_port} "
+                  + " ".join(map(shlex.quote,
+                                 [self.args.user_script] +
+                                 self.args.user_args)))
+        return [["srun", f"--nodes={nnodes}", "--ntasks-per-node=1",
+                 "--nodelist=" + ",".join(self.resource_pool.keys()),
+                 "bash", "-c", remote]]
+
+
 RUNNERS = {c.name: c for c in (LocalRunner, SSHRunner, PDSHRunner,
-                               GcloudTPURunner)}
+                               GcloudTPURunner, SlurmRunner)}

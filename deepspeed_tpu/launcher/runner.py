@@ -57,7 +57,8 @@ def parse_args(args=None):
     p.add_argument("--master_addr", default="")
     p.add_argument("--master_port", type=int, default=29500)
     p.add_argument("--launcher", default="",
-                   choices=["", "local", "ssh", "pdsh", "gcloud"])
+                   choices=["", "local", "ssh", "pdsh", "gcloud",
+                            "slurm"])
     p.add_argument("--tpu_name", default="", help="gcloud launcher TPU name")
     p.add_argument("--zone", default="", help="gcloud launcher zone")
     p.add_argument("--cpu_sim_devices", type=int, default=0,

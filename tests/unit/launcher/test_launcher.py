@@ -177,3 +177,25 @@ def test_multiprocess_cpu_launch(tmp_path):
     n, total, bcast_ok = out.read_text().split()
     assert n == "4" and float(total) == 6.0
     assert bcast_ok == "1", "broadcast_object_list multi-host failed"
+
+
+def test_slurm_runner_cmd():
+    """srun composes one fan-out with per-task rank derivation
+    (reference: SlurmRunner multinode_runner.py:242)."""
+    import shlex
+    from deepspeed_tpu.launcher.multinode_runner import SlurmRunner
+    args = parse_args(["--master_port", "29513", "train.py", "--x"])
+    args.master_addr = "n0"
+    args.user_script = "train.py"
+    args.user_args = ["--x"]
+    r = SlurmRunner(args, {"n0": 1, "n1": 1, "n2": 1})
+    (cmd,) = r.get_cmd({"PYTHONPATH": "/repo"}, None)
+    assert cmd[0] == "srun"
+    assert "--nodes=3" in cmd and "--ntasks-per-node=1" in cmd
+    assert "--nodelist=n0,n1,n2" in cmd
+    remote = cmd[-1]
+    assert "--node_rank=$SLURM_NODEID" in remote
+    assert "--nnodes=3" in remote and "--master_port=29513" in remote
+    assert "export PYTHONPATH=/repo;" in remote
+    toks = shlex.split(remote.replace("$SLURM_NODEID", "1"))
+    assert "train.py" in toks and "--x" in toks
