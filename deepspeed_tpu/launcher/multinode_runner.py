@@ -32,6 +32,40 @@ class MultiNodeRunner(ABC):
     def backend_exists(self) -> bool:
         return True
 
+    def _remote_shell_cmd(self, environment: Dict[str, str],
+                          node_rank_expr: str, slots: int,
+                          master_addr_expr: str = None) -> str:
+        """The one remote invocation all fan-out runners share:
+        env exports + cd + `python -m launcher.launch ...` with the
+        user script/args shlex-quoted. ``node_rank_expr`` (and the
+        optional master override) are shell EXPRESSIONS evaluated on
+        the remote side, deliberately unquoted."""
+        a = self.args
+        exports = " ".join(f"export {k}={shlex.quote(str(v))};"
+                           for k, v in environment.items())
+        flags = (f"--node_rank={node_rank_expr} "
+                 f"--nnodes={len(self.resource_pool)} "
+                 f"--nproc_per_node={slots} "
+                 f"--master_addr={master_addr_expr or a.master_addr} "
+                 f"--master_port={a.master_port}")
+        if getattr(a, "cpu_sim_devices", 0):
+            flags += f" --cpu_sim_devices={a.cpu_sim_devices}"
+        return (f"{exports} cd {shlex.quote(os.getcwd())}; "
+                f"{sys.executable} -m deepspeed_tpu.launcher.launch "
+                f"{flags} "
+                + " ".join(map(shlex.quote,
+                               [a.user_script] + a.user_args)))
+
+    def _uniform_slots(self) -> int:
+        slots = set(self.resource_pool.values())
+        first = next(iter(self.resource_pool.values()))
+        if len(slots) > 1:
+            logger.warning(
+                f"{self.name} runner launches a UNIFORM processes-per-"
+                f"node count; hostfile slots differ ({sorted(slots)}) "
+                f"— using {first} for every node")
+        return first
+
     def _launch_args(self, node_rank: int, slots: int) -> List[str]:
         a = self.args
         return [
@@ -91,19 +125,9 @@ class PDSHRunner(SSHRunner):
 
     def get_cmd(self, environment, active_resources):
         hosts = ",".join(self.resource_pool.keys())
-        exports = " ".join(f"export {k}={shlex.quote(str(v))};"
-                           for k, v in environment.items())
         # %n expands to the pdsh node index -> node_rank
-        slots = next(iter(self.resource_pool.values()))
-        remote = (f"{exports} cd {shlex.quote(os.getcwd())}; "
-                  f"{sys.executable} -m deepspeed_tpu.launcher.launch "
-                  f"--node_rank=%n --nnodes={len(self.resource_pool)} "
-                  f"--nproc_per_node={slots} "
-                  f"--master_addr={self.args.master_addr} "
-                  f"--master_port={self.args.master_port} "
-                  + " ".join(map(shlex.quote,
-                                 [self.args.user_script] +
-                                 self.args.user_args)))
+        remote = self._remote_shell_cmd(environment, "%n",
+                                        self._uniform_slots())
         return [["pdsh", "-f", "1024", "-w", hosts, remote]]
 
 
@@ -122,19 +146,9 @@ class GcloudTPURunner(SSHRunner):
         return which("gcloud") is not None
 
     def get_cmd(self, environment, active_resources):
-        exports = " ".join(f"export {k}={shlex.quote(str(v))};"
-                           for k, v in environment.items())
-        slots = next(iter(self.resource_pool.values()))
-        remote = (f"{exports} cd {shlex.quote(os.getcwd())}; "
-                  f"{sys.executable} -m deepspeed_tpu.launcher.launch "
-                  f"--node_rank=$(hostname | grep -o '[0-9]*$') "
-                  f"--nnodes={len(self.resource_pool)} "
-                  f"--nproc_per_node={slots} "
-                  f"--master_addr={self.args.master_addr} "
-                  f"--master_port={self.args.master_port} "
-                  + " ".join(map(shlex.quote,
-                                 [self.args.user_script] +
-                                 self.args.user_args)))
+        remote = self._remote_shell_cmd(
+            environment, "$(hostname | grep -o '[0-9]*$')",
+            self._uniform_slots())
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", self.tpu_name,
                "--worker=all", f"--command={remote}"]
         if self.zone:
@@ -155,18 +169,15 @@ class SlurmRunner(MultiNodeRunner):
 
     def get_cmd(self, environment, active_resources):
         nnodes = len(self.resource_pool)
-        slots = next(iter(self.resource_pool.values()))
-        exports = " ".join(f"export {k}={shlex.quote(str(v))};"
-                           for k, v in environment.items())
-        remote = (f"{exports} cd {shlex.quote(os.getcwd())}; "
-                  f"{sys.executable} -m deepspeed_tpu.launcher.launch "
-                  f"--node_rank=$SLURM_NODEID --nnodes={nnodes} "
-                  f"--nproc_per_node={slots} "
-                  f"--master_addr={self.args.master_addr} "
-                  f"--master_port={self.args.master_port} "
-                  + " ".join(map(shlex.quote,
-                                 [self.args.user_script] +
-                                 self.args.user_args)))
+        # SLURM may normalize/reorder the nodelist, so BOTH the rank
+        # (SLURM_NODEID) and the coordinator address derive from
+        # slurm's own job ordering — rank 0 and master_addr can never
+        # disagree, regardless of hostfile order
+        master = ("$(scontrol show hostnames $SLURM_JOB_NODELIST "
+                  "| head -n1)")
+        remote = self._remote_shell_cmd(environment, "$SLURM_NODEID",
+                                        self._uniform_slots(),
+                                        master_addr_expr=master)
         return [["srun", f"--nodes={nnodes}", "--ntasks-per-node=1",
                  "--nodelist=" + ",".join(self.resource_pool.keys()),
                  "bash", "-c", remote]]

@@ -196,6 +196,31 @@ def test_slurm_runner_cmd():
     remote = cmd[-1]
     assert "--node_rank=$SLURM_NODEID" in remote
     assert "--nnodes=3" in remote and "--master_port=29513" in remote
+    # coordinator derives from slurm's own nodelist ordering so it can
+    # never disagree with SLURM_NODEID==0
+    assert "scontrol show hostnames" in remote
     assert "export PYTHONPATH=/repo;" in remote
-    toks = shlex.split(remote.replace("$SLURM_NODEID", "1"))
+    toks = shlex.split(remote
+                       .replace("$SLURM_NODEID", "1")
+                       .replace("$(scontrol show hostnames "
+                                "$SLURM_JOB_NODELIST | head -n1)", "n0"))
     assert "train.py" in toks and "--x" in toks
+
+
+def test_fanout_runners_forward_cpu_sim_devices():
+    """--cpu_sim_devices must survive every fan-out runner's remote
+    command (review finding: slurm dropped it)."""
+    from deepspeed_tpu.launcher.multinode_runner import (GcloudTPURunner,
+                                                         PDSHRunner,
+                                                         SlurmRunner)
+    args = parse_args(["--cpu_sim_devices", "4", "train.py"])
+    args.master_addr = "n0"
+    args.user_script = "train.py"
+    args.user_args = []
+    pool = {"n0": 1, "n1": 1}
+    for cls, kw in ((PDSHRunner, {}), (SlurmRunner, {}),
+                    (GcloudTPURunner, {"tpu_name": "pod"})):
+        r = cls(args, pool, **kw)
+        (cmd,) = r.get_cmd({}, None)
+        remote = cmd[-1]
+        assert "--cpu_sim_devices=4" in remote, cls.name
