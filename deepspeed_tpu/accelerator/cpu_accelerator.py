@@ -92,7 +92,7 @@ class CPU_Accelerator(DeepSpeedAccelerator):
         return np.asarray(array)
 
     def op_builder_dir(self):
-        return "deepspeed_tpu.ops.reference"
+        return "deepspeed_tpu.ops.op_builder"
 
     def supports_pallas(self):
         # Pallas TPU kernels run on CPU only in interpret mode.

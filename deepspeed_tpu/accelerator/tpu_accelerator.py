@@ -102,7 +102,7 @@ class TPU_Accelerator(DeepSpeedAccelerator):
 
     # ---------------- Kernel namespace ----------------
     def op_builder_dir(self):
-        return "deepspeed_tpu.ops.pallas"
+        return "deepspeed_tpu.ops.pallas_kernels"
 
     def supports_pallas(self):
         return True

@@ -1,1 +1,2 @@
 from .engine import load_checkpoint, save_checkpoint  # noqa: F401
+from .universal import load_16bit_state  # noqa: F401

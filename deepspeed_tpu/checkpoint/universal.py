@@ -152,6 +152,25 @@ def restack_block_leaf(arr: np.ndarray, src_counts, tgt_counts,
     return np.stack(stages)
 
 
+def load_16bit_state(path: str) -> Dict[str, np.ndarray]:
+    """Load a file written by ``engine.save_16bit_model`` (reference
+    consumers load the save_16bit_model state dict the same way).
+
+    Reverses the uint16 encoding of bf16 leaves using the ``__dtypes__``
+    manifest; returns {dot.joined.path: ndarray} in the saved dtypes.
+    """
+    import ml_dtypes
+    with np.load(path) as data:
+        dtypes = json.loads(bytes(data["__dtypes__"]).decode())
+        out = {}
+        for name, dt in dtypes.items():
+            arr = data[name]
+            if dt == "bfloat16":
+                arr = arr.view(ml_dtypes.bfloat16)
+            out[name] = arr
+    return out
+
+
 def zero_to_fp32(ckpt_dir: str, output_file: str, tag: Optional[str] = None,
                  template_state=None) -> Dict[str, np.ndarray]:
     """Merge a checkpoint into ONE fp32 state dict file (reference:

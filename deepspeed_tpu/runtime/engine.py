@@ -53,7 +53,7 @@ from .fp16.loss_scaler import (LossScaleState, dynamic_loss_scale_state,
 from .lr_schedules import LRScheduler, get_lr_schedule
 from .optimizers import build_optimizer
 from ..moe.experts import moe_tensor_rules
-from .utils import clip_grad_norm_, global_norm
+from .utils import clip_grad_norm_, ensure_directory_exists, global_norm
 from .zero.partition import ZeroShardingRules, compose_tensor_rules
 
 
@@ -681,6 +681,12 @@ class DeepSpeedEngine:
     @property
     def loss_scale(self):
         if self.state is None:
+            # state is built lazily at the first step; report the
+            # configured starting scale rather than a placeholder
+            if self.fp16_enabled:
+                fc = self._config.fp16_config
+                return 2.0**fc.initial_scale_power if fc.dynamic \
+                    else float(fc.loss_scale)
             return 1.0
         return float(self.state.loss_scale.loss_scale)
 
@@ -1821,6 +1827,64 @@ class DeepSpeedEngine:
                 lambda x: x.astype(dtype)
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, full)
         return full
+
+    def save_16bit_model(self, save_dir, save_filename="model_16bit.npz",
+                         exclude_frozen_parameters=False):
+        """Consolidate the (possibly ZeRO-3 sharded) weights and write
+        one compute-dtype state file (reference: engine.py
+        save_16bit_model — gathers stage-3 partitions to one state dict;
+        gated on zero.gather_16bit_weights_on_model_save).
+
+        The file is a flat ``.npz`` keyed by dot-joined param paths
+        (torch-free). npz cannot carry ml_dtypes descriptors, so bf16
+        leaves are stored as uint16 bit patterns alongside a
+        ``__dtypes__`` manifest; ``checkpoint.load_16bit_state``
+        reverses the encoding.
+        """
+        import json as _json
+        if exclude_frozen_parameters:
+            # the master tree holds trainable params only (frozen LoRA
+            # bases live outside it, runtime/hybrid_engine.py), so there
+            # is nothing to exclude — reject rather than silently differ
+            # from the reference's requires_grad filter
+            raise NotImplementedError(
+                "exclude_frozen_parameters: the engine's master tree is "
+                "trainable-only; frozen bases are never in this file")
+        if self.state is None:
+            raise ValueError(
+                "save_16bit_model before parameters exist — run a step "
+                "or call init_params(example_batch) first")
+        zc = self._config.zero_config
+        if self.zero_stage == 3 and not zc.gather_16bit_weights_on_model_save:
+            logger.warning(
+                "save_16bit_model skipped: ZeRO-3 requires "
+                "zero_optimization.gather_16bit_weights_on_model_save=true "
+                "(reference gates identically)")
+            return False
+        full = self.get_params(dtype=self.compute_dtype)
+        arrays, dtypes = {}, {}
+        for name, leaf in named_leaves(full):
+            if not hasattr(leaf, "dtype"):
+                continue
+            arr = np.asarray(leaf)
+            dtypes[name] = str(arr.dtype)
+            if arr.dtype == jnp.bfloat16:
+                arr = arr.view(np.uint16)   # lossless bit pattern
+            arrays[name] = arr
+        arrays["__dtypes__"] = np.frombuffer(
+            _json.dumps(dtypes).encode(), dtype=np.uint8)
+        path = os.path.join(save_dir, save_filename)
+        ensure_directory_exists(path)
+        # unique tmp per writer + fsync before publish (the
+        # checkpoint_engine._atomic_write contract: shared save dirs see
+        # either the old file or the complete new one)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return True
 
     @property
     def checkpoint_engine(self):

@@ -1,0 +1,1 @@
+from .tensor_logger import TensorLogger  # noqa: F401

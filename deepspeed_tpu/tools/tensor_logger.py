@@ -1,0 +1,168 @@
+"""Record model activations / gradients / inputs for debugging
+(reference: deepspeed/tools/tensor_logger/tensor_logger.py:16
+``TensorLogger`` — nn.Module forward/backward hooks recording
+``fwd_act`` / ``bwd_grad`` / ``model_inputs`` per iteration, saved with
+torch.save).
+
+TPU-native re-design: the compiled train step cannot be hooked, and
+debugging doesn't need it to be — this tool runs an EAGER capture pass
+on the same params/batch:
+
+* activations via flax's ``capture_intermediates=True`` (every
+  submodule's outputs, the analog of forward hooks);
+* gradients via ``jax.grad`` of the model loss w.r.t. the variables
+  (per-parameter grads — JAX's autodiff replaces backward hooks);
+* inputs recorded verbatim.
+
+The capture pass recomputes forward/backward once outside jit, so use
+it inside the [start_iteration, end_iteration] window only (the same
+windowing contract as the reference: ``end_iteration=0`` disables,
+iteration numbers start at 1).
+"""
+
+import collections
+import contextlib
+from os import makedirs
+from os.path import dirname, join
+
+import jax
+import numpy as np
+
+FWD_ACT = "fwd_act"
+BWD_GRAD = "bwd_grad"
+MODEL_INPUTS = "model_inputs"
+
+
+def _iter_data():
+    return {FWD_ACT: collections.defaultdict(list),
+            BWD_GRAD: collections.defaultdict(list),
+            MODEL_INPUTS: collections.defaultdict(list)}
+
+
+class TensorLogger:
+    """Windowed activation/gradient recorder.
+
+    Usage (mirrors the reference docstring)::
+
+        tl = TensorLogger(model, start_iteration=2, end_iteration=2,
+                          log_activations_enabled=True)
+        for i, batch in enumerate(loader, start=1):
+            with tl.log_iteration(i):
+                tl.capture(engine.get_params(), batch)
+            engine.train_batch(batch=batch)
+        tl.save("debug/tensors.npz")
+    """
+
+    def __init__(self, model, start_iteration=0, end_iteration=0,
+                 log_activations_enabled=False, log_grads_enabled=False,
+                 log_inputs_enabled=False, prefix=None):
+        self.model = model
+        self.start_iteration = start_iteration
+        self.end_iteration = end_iteration
+        self.log_activations_enabled = log_activations_enabled
+        self.log_grads_enabled = log_grads_enabled
+        self.log_inputs_enabled = log_inputs_enabled
+        self.prefix = "model" if prefix is None else prefix
+        self.data = collections.defaultdict(_iter_data)
+        self.active = False
+        self.current_iteration = 0
+
+    # ---------------- iteration windowing ----------------
+    def set_iteration(self, i):
+        self.current_iteration = i
+
+    def _in_window(self):
+        if self.end_iteration == 0:
+            return False
+        return self.start_iteration <= self.current_iteration \
+            <= self.end_iteration
+
+    @contextlib.contextmanager
+    def log_iteration(self, i):
+        self.set_iteration(i)
+        self.active = True
+        try:
+            yield self
+        finally:
+            self.active = False
+
+    def __enter__(self):
+        self.active = True
+        return self
+
+    def __exit__(self, *exc):
+        self.active = False
+        return False
+
+    # ---------------- capture ----------------
+    def _fqn(self, *parts):
+        segs = [self.prefix] + [str(p) for p in parts if str(p)]
+        return ".".join(segs)
+
+    def capture(self, variables, batch, loss_fn=None):
+        """Run one eager capture pass; no-op outside the window.
+
+        ``variables``: the model's variable tree (what engine.get_params
+        returns). ``batch``: kwargs for the model (must yield a scalar
+        loss for gradient capture, e.g. contain labels). ``loss_fn``:
+        optional override mapping (variables, batch) -> scalar loss.
+        """
+        if not (self.active and self._in_window()):
+            return
+        it = self.data[self.current_iteration]
+
+        if self.log_inputs_enabled:
+            for name, value in batch.items():
+                it[MODEL_INPUTS][self._fqn(name)].append(np.asarray(value))
+
+        if self.log_activations_enabled:
+            _, state = self.model.apply(variables, **batch,
+                                        capture_intermediates=True)
+            interms = state.get("intermediates", {})
+            for path, leaf in jax.tree_util.tree_leaves_with_path(interms):
+                from ..utils.tree import _path_str
+                it[FWD_ACT][self._fqn(_path_str(path))].append(
+                    np.asarray(leaf))
+
+        if self.log_grads_enabled:
+            if loss_fn is None:
+                def loss_fn(v, b):
+                    out = self.model.apply(v, **b)
+                    return out[0] if isinstance(out, tuple) else out
+            grads = jax.grad(lambda v: loss_fn(v, batch))(variables)
+            from ..utils.tree import named_leaves
+            for name, leaf in named_leaves(grads):
+                it[BWD_GRAD][self._fqn(name)].append(np.asarray(leaf))
+
+    # ---------------- persistence ----------------
+    def clear(self):
+        self.data.clear()
+
+    def save(self, filename):
+        """One flat ``.npz``: keys ``it<N>|<kind>|<name>|<idx>``
+        (the reference saves a nested dict with torch.save; the flat
+        key encoding carries the same hierarchy torch-free)."""
+        arrays = {}
+        for it, kinds in self.data.items():
+            for kind, named in kinds.items():
+                for name, tensors in named.items():
+                    for idx, t in enumerate(tensors):
+                        arrays[f"it{it}|{kind}|{name}|{idx}"] = t
+        d = dirname(filename)
+        if d:
+            makedirs(d, exist_ok=True)
+        with open(filename, "wb") as f:
+            np.savez(f, **arrays)
+        self.clear()
+        return filename
+
+
+def load_tensor_log(filename):
+    """Load a TensorLogger file back into the nested
+    {iteration: {kind: {name: [arrays]}}} hierarchy."""
+    out = collections.defaultdict(_iter_data)
+    with np.load(filename) as data:
+        for key in data.files:
+            it, kind, name, idx = key.split("|")
+            out[int(it[2:])][kind][name].append(data[key])
+    return dict(out)

@@ -1,0 +1,109 @@
+"""Accelerator-abstraction contract tests (reference pattern:
+tests/accelerator/ + tests/unit/accelerator/ — every backend must satisfy
+the abstract surface and the autodetector must honor DS_ACCELERATOR)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+from deepspeed_tpu.accelerator.cpu_accelerator import CPU_Accelerator
+from deepspeed_tpu.accelerator.real_accelerator import (
+    SUPPORTED_ACCELERATOR_LIST, _validate_accelerator, set_accelerator)
+from deepspeed_tpu.accelerator.tpu_accelerator import TPU_Accelerator
+
+
+def test_singleton_honors_ds_accelerator_env():
+    # conftest sets DS_ACCELERATOR=cpu before anything imports jax
+    accel = get_accelerator()
+    assert accel.device_name() == "cpu"
+    assert get_accelerator() is accel  # singleton
+
+
+def test_validate_rejects_unknown_backend():
+    with pytest.raises(ValueError):
+        _validate_accelerator("cuda")
+    for name in SUPPORTED_ACCELERATOR_LIST:
+        assert _validate_accelerator(name) == name
+
+
+def test_set_accelerator_override_roundtrip():
+    prev = get_accelerator()
+    try:
+        other = CPU_Accelerator()
+        set_accelerator(other)
+        assert get_accelerator() is other
+    finally:
+        set_accelerator(prev)
+
+
+@pytest.mark.parametrize("accel_cls", [CPU_Accelerator, TPU_Accelerator])
+def test_backend_satisfies_abstract_surface(accel_cls):
+    """Every abstract method must be overridden — instantiating fails
+    otherwise, and each concrete class must be a DeepSpeedAccelerator."""
+    accel = accel_cls()
+    assert isinstance(accel, DeepSpeedAccelerator)
+    abstract = {m for m in dir(DeepSpeedAccelerator)
+                if getattr(getattr(DeepSpeedAccelerator, m), "__isabstractmethod__", False)}
+    for name in abstract:
+        assert getattr(type(accel), name) is not getattr(DeepSpeedAccelerator, name), \
+            f"{accel_cls.__name__} inherits abstract {name}"
+
+
+def test_cpu_device_enumeration(eight_devices):
+    accel = CPU_Accelerator()
+    assert accel.device_count() >= 8
+    assert accel.global_device_count() == jax.device_count()
+    assert accel.device(0).platform == "cpu"
+    assert accel.device_name() == "cpu"
+    assert accel.device_name(3) == "cpu:3"
+    assert accel.is_synchronized_device()
+
+
+def test_cpu_memory_stats_shape():
+    accel = CPU_Accelerator()
+    stats = accel.memory_stats()
+    assert stats["bytes_in_use"] > 0
+    assert stats["bytes_limit"] >= stats["bytes_in_use"]
+    assert accel.total_memory() == stats["bytes_limit"]
+    assert accel.available_memory() == stats["bytes_limit"] - stats["bytes_in_use"]
+
+
+def test_dtype_support_and_default():
+    accel = CPU_Accelerator()
+    assert accel.is_bf16_supported() and accel.is_fp16_supported()
+    assert jnp.bfloat16 in accel.supported_dtypes()
+    assert accel.default_dtype() in accel.supported_dtypes()
+
+
+def test_device_put_and_host_put_roundtrip(eight_devices):
+    accel = CPU_Accelerator()
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    on_dev = accel.device_put(x, 1)
+    assert accel.on_accelerator(on_dev)
+    assert list(on_dev.devices())[0] == accel.device(1)
+    back = accel.host_put(on_dev)
+    np.testing.assert_array_equal(back, x)
+
+
+def test_rng_seed_is_functional():
+    accel = CPU_Accelerator()
+    k1, k2 = accel.initial_seed(7), accel.initial_seed(7)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
+    k3 = accel.initial_seed(8)
+    assert not np.array_equal(np.asarray(k1), np.asarray(k3))
+
+
+def test_op_builder_namespace_importable():
+    import importlib
+    for accel in (CPU_Accelerator(), TPU_Accelerator()):
+        pkg = accel.op_builder_dir()
+        assert importlib.import_module(pkg) is not None
+
+
+def test_comm_backend_names_differ_by_platform():
+    assert CPU_Accelerator().communication_backend_name() == "xla-host"
+    assert TPU_Accelerator().communication_backend_name() == "xla-ici"
+    assert not CPU_Accelerator().supports_pallas()

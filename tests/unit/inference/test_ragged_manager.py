@@ -1,0 +1,133 @@
+"""Paged-KV host bookkeeping: allocator, sequence descriptors, state
+manager (reference pattern: tests/unit/inference/v2/ragged/
+test_blocked_allocator.py + test_manager_get/flush — allocation math,
+exhaustion, uid lifecycle, block reuse after release)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.ragged_manager import (
+    BlockedAllocator, BlockedKVCacheManager, DSStateManager,
+    SchedulingError, SchedulingResult, SequenceDescriptor)
+
+
+def test_allocator_hands_out_distinct_blocks():
+    a = BlockedAllocator(16)
+    got = a.allocate(10)
+    assert len(set(got)) == 10
+    assert a.free_blocks == 6
+    assert all(0 <= b < 16 for b in got)
+
+
+def test_allocator_exhaustion_is_typed_error():
+    a = BlockedAllocator(4)
+    a.allocate(3)
+    with pytest.raises(SchedulingError) as ei:
+        a.allocate(2)
+    assert ei.value.result == SchedulingResult.OutOfKVBlocks
+    # the failed allocate must not leak blocks
+    assert a.free_blocks == 1
+
+
+def test_allocator_reuses_freed_blocks():
+    a = BlockedAllocator(8)
+    first = a.allocate(8)
+    assert a.free_blocks == 0
+    a.free(first[:5])
+    again = a.allocate(5)
+    assert sorted(again) == sorted(first[:5])
+    assert a.free_blocks == 0
+
+
+@pytest.mark.parametrize("seen,inflight,new,block,expected", [
+    (0, 0, 1, 128, 1),      # first token needs the first block
+    (0, 0, 128, 128, 1),    # exactly one block
+    (0, 0, 129, 128, 2),    # one past the boundary
+    (127, 0, 1, 128, 0),    # fits in the already-allocated block
+    (100, 28, 1, 128, 1),   # in-flight tokens count toward the total
+    (128, 0, 0, 128, 0),    # zero new tokens never allocates
+])
+def test_kv_blocks_needed_ceiling_math(seen, inflight, new, block, expected):
+    seq = SequenceDescriptor(uid=0, seen_tokens=seen,
+                             in_flight_tokens=inflight)
+    # blocks already allocated cover the seen+inflight prefix
+    seq.blocks = list(range(-(-(seen + inflight) // block)))
+    assert seq.kv_blocks_needed(new, block) == expected
+
+
+def test_descriptor_forward_lifecycle():
+    seq = SequenceDescriptor(uid=1)
+    seq.pre_forward(100)
+    assert seq.in_flight_tokens == 100 and seq.seen_tokens == 0
+    seq.post_forward()
+    assert seq.seen_tokens == 100 and seq.in_flight_tokens == 0
+    seq.pre_forward(1)   # decode step
+    seq.post_forward()
+    assert seq.seen_tokens == 101
+
+
+def test_kv_manager_allocates_lazily_and_releases_all():
+    m = BlockedKVCacheManager(n_blocks=8, block_size=4)
+    seq = SequenceDescriptor(uid=0)
+    m.maybe_allocate(seq, 4)     # exactly one block
+    assert seq.cur_allocated_blocks == 1 and m.free_blocks == 7
+    seq.pre_forward(4); seq.post_forward()
+    m.maybe_allocate(seq, 1)     # crosses into block 2
+    assert seq.cur_allocated_blocks == 2
+    m.maybe_allocate(seq, 0)     # no growth for zero tokens
+    assert seq.cur_allocated_blocks == 2
+    m.release(seq)
+    assert m.free_blocks == 8 and seq.blocks == []
+
+
+def test_state_manager_uid_lifecycle_and_capacity():
+    sm = DSStateManager(max_tracked_sequences=3, n_blocks=16, block_size=4)
+    s0 = sm.get_or_create_sequence(10)
+    assert sm.get_or_create_sequence(10) is s0   # idempotent by uid
+    sm.get_or_create_sequence(11)
+    sm.get_or_create_sequence(12)
+    with pytest.raises(SchedulingError) as ei:
+        sm.get_or_create_sequence(13)
+    assert ei.value.result == SchedulingResult.EngineFull
+    sm.flush_sequence(11)
+    assert sm.n_tracked_sequences == 2
+    sm.get_or_create_sequence(13)    # slot freed
+    sm.flush_sequence(99)            # unknown uid is a no-op
+    assert sm.get_sequence(99) is None
+
+
+def test_state_manager_churn_returns_every_block():
+    """Many sequences growing and dying must leave the pool exactly
+    full again — the leak check that matters for a long-lived server."""
+    rng = np.random.default_rng(0)
+    sm = DSStateManager(max_tracked_sequences=64, n_blocks=64, block_size=4)
+    live = []
+    for step in range(200):
+        if live and rng.random() < 0.4:
+            uid = live.pop(rng.integers(len(live)))
+            sm.flush_sequence(uid)
+        else:
+            uid = int(step)
+            seq = sm.get_or_create_sequence(uid)
+            n = int(rng.integers(1, 9))
+            try:
+                sm.kv.maybe_allocate(seq, n)
+            except SchedulingError:
+                sm.flush_sequence(uid)
+                continue
+            seq.pre_forward(n); seq.post_forward()
+            live.append(uid)
+    for uid in live:
+        sm.flush_sequence(uid)
+    assert sm.free_blocks == 64
+    assert sm.n_tracked_sequences == 0
+
+
+def test_block_table_is_fixed_shape_and_padded():
+    sm = DSStateManager(n_blocks=16, block_size=4)
+    seq = sm.get_or_create_sequence(0)
+    sm.kv.maybe_allocate(seq, 9)   # 3 blocks
+    t = sm.block_table(seq, max_blocks=8)
+    assert t.shape == (8,) and t.dtype == np.int32
+    np.testing.assert_array_equal(t[:3], seq.blocks)
+    np.testing.assert_array_equal(t[3:], 0)

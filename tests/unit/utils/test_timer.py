@@ -1,0 +1,106 @@
+"""Timer utilities (reference pattern: tests/unit/utils/ timer coverage —
+accumulate/reset semantics, throughput accounting excluding warmup
+steps, trim_mean outlier rejection)."""
+
+import time
+
+import pytest
+
+from deepspeed_tpu.utils.timer import (NoopTimer, SynchronizedWallClockTimer,
+                                       ThroughputTimer, trim_mean)
+
+
+def test_timer_accumulates_across_start_stop():
+    timers = SynchronizedWallClockTimer()
+    t = timers("fwd")
+    t.start(); time.sleep(0.02); t.stop()
+    first = t.elapsed_
+    assert first >= 0.015
+    t.start(); time.sleep(0.02); t.stop()
+    assert t.elapsed_ > first          # accumulates, not overwrites
+
+
+def test_timer_reset_on_stop_and_records():
+    timers = SynchronizedWallClockTimer()
+    t = timers("bwd")
+    t.start(); time.sleep(0.01); t.stop(reset=True, record=True)
+    t.start(); time.sleep(0.01); t.stop(reset=True, record=True)
+    assert len(t.records) == 2
+    assert t.mean() == pytest.approx(sum(t.records) / 2)
+
+
+def test_timer_double_start_asserts():
+    t = SynchronizedWallClockTimer()("x")
+    t.start()
+    with pytest.raises(AssertionError):
+        t.start()
+    t.stop()
+    with pytest.raises(AssertionError):
+        t.stop()
+
+
+def test_elapsed_preserves_running_state():
+    t = SynchronizedWallClockTimer()("y")
+    t.start(); time.sleep(0.01)
+    e = t.elapsed(reset=True)
+    assert e > 0
+    assert t.started_                   # restarted transparently
+    t.stop()
+
+
+def test_timer_registry_is_stable():
+    timers = SynchronizedWallClockTimer()
+    a = timers("same")
+    assert timers("same") is a
+    assert set(timers.get_timers()) == {"same"}
+
+
+def test_noop_timer_is_callable_everywhere():
+    timers = NoopTimer()
+    t = timers("anything")
+    t.start(sync=True); t.stop(record=True)
+    assert t.elapsed(reset=True) == 0 and t.mean() == 0
+    timers.log(["anything"])            # must not raise
+    assert timers.get_timers() == {}
+
+
+def test_throughput_timer_skips_warmup_steps():
+    tt = ThroughputTimer(config=None, batch_size=32, start_step=2)
+    # warmup: no timing accumulated
+    for _ in range(2):
+        tt.start(); tt.stop(global_step=True)
+    assert tt.total_elapsed_time == 0
+    assert tt.avg_samples_per_sec() == float("-inf")
+    for _ in range(3):
+        tt.start(); time.sleep(0.01); tt.stop(global_step=True)
+    assert tt.global_step_count == 5
+    sps = tt.avg_samples_per_sec()
+    # 32 samples in ~10ms per step
+    assert 32 / 0.05 < sps < 32 / 0.005
+
+
+def test_throughput_timer_periodic_report():
+    lines = []
+    tt = ThroughputTimer(config=None, batch_size=8, start_step=0,
+                         steps_per_output=2, logging_fn=lines.append)
+    for _ in range(4):
+        tt.start(); tt.stop(global_step=True)
+    assert len(lines) == 2
+    assert "SamplesPerSec" in lines[0]
+
+
+def test_throughput_timer_disabled_config():
+    class Cfg:
+        enabled = False
+    tt = ThroughputTimer(config=Cfg(), batch_size=8)
+    tt.start(); tt.stop(global_step=True)
+    assert tt.global_step_count == 0 and tt.total_elapsed_time == 0
+
+
+def test_trim_mean_rejects_outliers():
+    data = [1.0] * 8 + [100.0, 0.0]
+    assert trim_mean(data, 0.1) == pytest.approx(1.0)
+    assert trim_mean([], 0.1) == 0.0
+    assert trim_mean([5.0], 0.5) == 5.0     # over-trim falls back to all
+    with pytest.raises(AssertionError):
+        trim_mean([1.0], 1.5)

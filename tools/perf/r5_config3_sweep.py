@@ -1,0 +1,134 @@
+"""Config-3 headroom sweep, continued: flash-kernel block sizes x
+micro split x attention impl at Llama-7B geometry on one chip.
+
+Round-4 recorded 0.88-0.96 (session drift) with flash 256/256, full
+remat, micro 4 x gas 4. The flash kernel's cost is pure time under the
+recorded metric (Pallas custom-call FLOPs are invisible to XLA cost
+analysis), so shaving attention wall-clock converts 1:1 into MFU.
+
+Usage: python tools/perf/r5_config3_sweep.py [idx,idx,...]
+"""
+
+import dataclasses
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _xla_attention(q, k, v, causal=True):
+    """Dense einsum attention with the flash_attention signature — the
+    XLA-fused alternative (its s^2 matmuls ARE visible to cost analysis,
+    unlike the Pallas custom call)."""
+    import jax
+    import jax.numpy as jnp
+    B, Tq, Hq, D = q.shape
+    Tk, Hkv = k.shape[1], k.shape[2]
+    rep = Hq // Hkv
+    qg = q.reshape(B, Tq, Hkv, rep, D)
+    scores = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k).astype(jnp.float32)
+    scores = scores / (D ** 0.5)
+    if causal:
+        qpos = (Tk - Tq + jnp.arange(Tq))[:, None]
+        mask = jnp.arange(Tk)[None, :] <= qpos
+        scores = jnp.where(mask[None, None, None], scores, float("-inf"))
+    p = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhrqk,bkhd->bqhrd", p, v)
+    return out.reshape(B, Tq, Hq, D).astype(q.dtype)
+
+
+def run(micro, gas, *, use_flash=True, block_q=256, block_k=256,
+        layers=2, seq=2048, steps=5):
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama as llama_mod
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.ops.pallas_kernels import flash_attention as real_flash
+    from deepspeed_tpu.parallel.mesh import mesh_manager
+    from deepspeed_tpu.profiling.flops_profiler import peak_tflops
+
+    mesh_manager.reset()
+    # route the model's attention calls through the chosen variant
+    if use_flash:
+        llama_mod.flash_attention = functools.partial(
+            real_flash, block_q=block_q, block_k=block_k)
+    else:
+        llama_mod.flash_attention = _xla_attention
+    try:
+        cfg = dataclasses.replace(LlamaConfig.llama2_7b(),
+                                  num_hidden_layers=layers,
+                                  use_remat=True,
+                                  max_position_embeddings=seq)
+        config = {
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 3},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 0,
+        }
+        model = LlamaForCausalLM(cfg)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+        gb = engine.train_batch_size()
+        ids = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, size=(gb, seq), dtype=np.int32)
+        b = {"input_ids": ids, "labels": ids.copy()}
+        float(engine.train_batch(batch=b))
+        float(engine.train_batch(batch=b))
+        times = []
+        for _ in range(steps):
+            t0 = time.time()
+            float(engine.train_batch(batch=b))
+            times.append(time.time() - t0)
+        per_step = sorted(times)[len(times) // 2]
+        tps = gb * seq / per_step
+        prof = engine.get_flops_profile()
+        fpt = prof["flops"] / (micro * seq)
+        mfu = (tps * fpt / 1e12) / peak_tflops()
+        return {"micro": micro, "gas": gas, "flash": use_flash,
+                "bq": block_q, "bk": block_k,
+                "tokens_per_sec": round(tps, 0), "mfu": round(mfu, 4),
+                "vs_baseline": round(mfu / 0.54, 4),
+                "variance": round((max(times) - min(times)) / per_step, 3)}
+    finally:
+        llama_mod.flash_attention = real_flash
+
+
+def main():
+    import sys
+    combos = [
+        dict(micro=4, gas=4),                                # recorded baseline
+        dict(micro=4, gas=4, block_q=512, block_k=512),
+        dict(micro=4, gas=4, block_q=128, block_k=128),
+        dict(micro=4, gas=4, block_q=512, block_k=1024),
+        dict(micro=4, gas=4, block_q=1024, block_k=512),
+        dict(micro=8, gas=2),
+        dict(micro=8, gas=2, block_q=512, block_k=512),
+        dict(micro=4, gas=4, use_flash=False),               # XLA attention
+        dict(micro=8, gas=2, use_flash=False),
+    ]
+    if len(sys.argv) > 1:
+        keep = [int(i) for i in sys.argv[1].split(",")]
+        combos = [combos[i] for i in keep]
+    results = []
+    for kw in combos:
+        try:
+            r = run(**kw)
+        except Exception as e:
+            r = dict(kw, error=f"{type(e).__name__}: {str(e)[:200]}")
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    ok = [r for r in results if "mfu" in r]
+    if ok:
+        print("BEST:", json.dumps(max(ok, key=lambda r: r["mfu"])))
+
+
+if __name__ == "__main__":
+    main()
