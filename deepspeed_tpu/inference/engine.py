@@ -32,21 +32,7 @@ from ..utils.logging import logger
 from .config import DeepSpeedInferenceConfig
 
 
-def make_sampler(temperature: float, top_k: Optional[int]):
-    """Token sampler usable under jit. Greedy when temperature == 0."""
-
-    def sample(logits, rng):
-        logits = logits.astype(jnp.float32)
-        if temperature and temperature > 0:
-            logits = logits / temperature
-            if top_k:
-                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-                logits = jnp.where(logits < kth,
-                                   jnp.finfo(logits.dtype).min, logits)
-            return jax.random.categorical(rng, logits, axis=-1)
-        return jnp.argmax(logits, axis=-1)
-
-    return sample
+from .sampling import make_sampler  # noqa: F401  (re-export: public name)
 
 
 def _truncate_at_eos(full, prompt_len, eos_token_id):
@@ -196,7 +182,8 @@ class InferenceEngine:
     __call__ = forward
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_k: Optional[int] = None, rng=None, eos_token_id=None):
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 rng=None, eos_token_id=None):
         """Autoregressive decode. Greedy when temperature==0.
 
         Models exposing ``init_cache`` (model ``__call__`` accepting
@@ -211,17 +198,18 @@ class InferenceEngine:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         if hasattr(self.module, "init_cache"):
             return self._generate_cached(ids, max_new_tokens, temperature,
-                                         top_k, rng, eos_token_id)
+                                         top_k, top_p, rng, eos_token_id)
         return self._generate_recompute(ids, max_new_tokens, temperature,
-                                        top_k, rng, eos_token_id)
+                                        top_k, top_p, rng, eos_token_id)
 
     # -- KV-cache path ------------------------------------------------
-    def _get_decode_fns(self, B, T0, max_new, temperature, top_k):
-        key = (B, T0, max_new, float(temperature or 0.0), top_k)
+    def _get_decode_fns(self, B, T0, max_new, temperature, top_k,
+                        top_p=None):
+        key = (B, T0, max_new, float(temperature or 0.0), top_k, top_p)
         if key in self._decode_fns:
             return self._decode_fns[key]
         apply_fn = self._apply_fn
-        sample = make_sampler(temperature, top_k)
+        sample = make_sampler(temperature, top_k, top_p)
 
         def prefill(params, ids, cache, rng):
             # cache_index=0 is static: the model takes the flash-kernel
@@ -248,13 +236,13 @@ class InferenceEngine:
         self._decode_fns[key] = fns
         return fns
 
-    def _generate_cached(self, ids, max_new, temperature, top_k, rng,
+    def _generate_cached(self, ids, max_new, temperature, top_k, top_p, rng,
                          eos_token_id):
         B, T0 = ids.shape
         total = T0 + max_new
         cache = self.module.init_cache(B, total, dtype=self.dtype)
         prefill, decode = self._get_decode_fns(B, T0, max_new, temperature,
-                                               top_k)
+                                               top_k, top_p=top_p)
         rng, r1, r2 = jax.random.split(rng, 3)
         first, cache = prefill(self.params, jnp.asarray(ids), cache, r1)
         if max_new > 1:
@@ -270,7 +258,7 @@ class InferenceEngine:
 
     # -- no-cache fallback --------------------------------------------
     def _generate_recompute(self, ids, max_new_tokens, temperature, top_k,
-                            rng, eos_token_id):
+                            top_p, rng, eos_token_id):
         """Fixed-size buffer + full forward per token: with causal
         attention, logits at position t ignore padding after t, so the
         buffer is oversized and sliced at the live position (the
@@ -278,7 +266,7 @@ class InferenceEngine:
         blogs/deepspeed-fastgen/README.md:90-103)."""
         B, T0 = ids.shape
         total = T0 + max_new_tokens
-        sample = make_sampler(temperature, top_k)
+        sample = make_sampler(temperature, top_k, top_p)
         buf = np.zeros((B, total), dtype=ids.dtype)
         buf[:, :T0] = ids
         cur = T0

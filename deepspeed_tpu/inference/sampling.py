@@ -1,0 +1,96 @@
+"""Token sampling for the serving loops (reference note: generation/
+sampling lives in DeepSpeed-MII, not deepspeed itself —
+SURVEY.md §2.7 "Sampling/serving"; shipped here so both engines are
+usable end-to-end without an external serving layer).
+
+Two shapes of the same math:
+
+* ``make_sampler`` — a jit-traceable sampler for the v1 engine's
+  compiled decode loop (temperature / top-k; greedy at temperature 0).
+* ``sample_token`` — a host-side numpy sampler for the v2 ragged
+  engine's continuous-batching loop, adding nucleus (top-p) filtering;
+  per-row, one token at a time (the loop is host-driven by design —
+  scheduling is host-side bookkeeping, see inference/v2/engine_v2.py).
+"""
+
+from typing import Optional
+
+import numpy as np
+
+
+def make_sampler(temperature: float, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None):
+    """jit-traceable sampler: greedy when temperature == 0."""
+    import jax
+    import jax.numpy as jnp
+
+    def sample(logits, rng):
+        logits = logits.astype(jnp.float32)
+        if temperature and temperature > 0:
+            logits = logits / temperature
+            if top_k:
+                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                logits = jnp.where(logits < kth,
+                                   jnp.finfo(logits.dtype).min, logits)
+            if top_p is not None and top_p < 1.0:
+                sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(sorted_logits, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                # keep the smallest prefix with mass >= top_p (the
+                # first token is always kept)
+                keep = jnp.roll(cum < top_p, 1, axis=-1).at[:, 0].set(True)
+                cutoff = jnp.min(jnp.where(
+                    keep, sorted_logits, jnp.inf), axis=-1)[:, None]
+                logits = jnp.where(logits < cutoff,
+                                   jnp.finfo(logits.dtype).min, logits)
+            return jax.random.categorical(rng, logits, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    return sample
+
+
+def sample_token(logits: np.ndarray, rng: np.random.Generator,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None) -> int:
+    """Sample one token id from a single row of logits (host-side)."""
+    logits = np.asarray(logits, np.float32).reshape(-1)
+    if not temperature or temperature <= 0:
+        return int(np.argmax(logits))
+    logits = logits / temperature
+    if top_k:
+        top_k = min(top_k, len(logits))   # jit path clamps identically
+        kth = np.partition(logits, -top_k)[-top_k]
+        logits = np.where(logits < kth, -np.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        order = np.argsort(logits)[::-1]
+        sorted_logits = logits[order]
+        shifted = sorted_logits - sorted_logits[0]
+        probs = np.exp(shifted) / np.exp(shifted).sum()
+        cum = np.cumsum(probs)
+        keep = np.roll(cum < top_p, 1)
+        keep[0] = True                      # never drop the top token
+        cutoff = sorted_logits[keep].min()
+        logits = np.where(logits < cutoff, -np.inf, logits)
+    shifted = logits - logits.max()
+    probs = np.exp(shifted)
+    probs = probs / probs.sum()
+    return int(rng.choice(len(probs), p=probs))
+
+
+class SamplingParams:
+    """Per-request knobs for the v2 serving loop (the MII analog)."""
+
+    def __init__(self, temperature: float = 0.0,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 seed: Optional[int] = None):
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None and not 0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.seed = seed

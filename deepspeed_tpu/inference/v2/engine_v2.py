@@ -331,10 +331,15 @@ class InferenceEngineV2:
 
     def generate_batch(self, prompts: Dict[int, Iterable[int]],
                        max_new_tokens: int = 32,
-                       eos_token_id: Optional[int] = None
-                       ) -> Dict[int, List[int]]:
-        """Greedy continuous-batching serving loop (the MII-side loop the
-        reference leaves out of deepspeed; here for tests/benchmarks)."""
+                       eos_token_id: Optional[int] = None,
+                       sampling=None) -> Dict[int, List[int]]:
+        """Continuous-batching serving loop (the MII-side loop the
+        reference leaves out of deepspeed; here for tests/benchmarks).
+        Greedy by default; pass ``sampling=SamplingParams(...)`` for
+        temperature / top-k / nucleus sampling."""
+        from ..sampling import SamplingParams, sample_token
+        sampling = sampling or SamplingParams()
+        sample_rng = np.random.default_rng(sampling.seed)
         pending = {uid: np.asarray(p, np.int32).reshape(-1)
                    for uid, p in prompts.items()}
         done: Dict[int, List[int]] = {uid: [] for uid in prompts}
@@ -355,7 +360,10 @@ class InferenceEngineV2:
                         pending[uid] = rest
                         continue  # mid-prompt: logits not sampled
                     del pending[uid]
-                nxt = int(np.argmax(logits[row]))
+                nxt = sample_token(logits[row], sample_rng,
+                                   temperature=sampling.temperature,
+                                   top_k=sampling.top_k,
+                                   top_p=sampling.top_p)
                 done[uid].append(nxt)
                 remaining[uid] -= 1
                 finished = remaining[uid] <= 0 or (

@@ -5,6 +5,8 @@ Subcommands:
     dstpu [launch] script.py args...   pod/multi-host launch
     dstpu report                       environment report (ds_report analog)
     dstpu bench                        collective microbenchmarks (ds_bench)
+    dstpu elastic                      batch planning / elastic agent (ds_elastic)
+    dstpu ssh -f hostfile cmd...       run cmd on every host (ds_ssh)
 
 Hostfile format (reference parity, runner.py:202 fetch_hostfile):
     hostname1 slots=4
@@ -119,6 +121,57 @@ def _elastic_main(argv):
     return 0
 
 
+def _ssh_main(argv):
+    """``dstpu ssh`` — run one command on every hostfile host
+    (reference: bin/ds_ssh, a pdsh fan-out). ssh is used directly so no
+    pdsh install is needed on TPU-VM images."""
+    import argparse
+    import shlex
+
+    p = argparse.ArgumentParser(prog="dstpu ssh")
+    p.add_argument("-f", "--hostfile", default="/job/hostfile",
+                   help="host slots=N file (reference default path)")
+    p.add_argument("--include", default="",
+                   help="host filter, e.g. host1@host2")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the per-host commands without running")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to run on every host")
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    pool = fetch_hostfile(args.hostfile)
+    if not pool:
+        logger.error(f"hostfile not found or empty: {args.hostfile}")
+        return 2
+    hosts = list(pool)
+    if args.include:
+        keep = set(args.include.split("@"))
+        hosts = [h for h in hosts if h in keep]
+        if not hosts:
+            # a typo'd --include must not report fleet-wide success
+            logger.error(f"--include {args.include!r} matches no host in "
+                         f"{args.hostfile} ({', '.join(pool)})")
+            return 2
+    remote = " ".join(args.command)
+    cmds = [["ssh", "-o", "StrictHostKeyChecking=no", h, remote]
+            for h in hosts]
+    if args.dry_run:
+        for c in cmds:
+            print(shlex.join(c))
+        return 0
+    procs = [(h, subprocess.Popen(c, stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True))
+             for h, c in zip(hosts, cmds)]
+    rc = 0
+    for h, proc in procs:
+        out, _ = proc.communicate()
+        for line in (out or "").splitlines():
+            print(f"{h}: {line}")
+        rc = rc or proc.returncode
+    return rc
+
+
 def main(args=None):
     argv = sys.argv[1:] if args is None else list(args)
     if argv and argv[0] == "report":
@@ -129,6 +182,8 @@ def main(args=None):
         return bench_main(argv[1:])
     if argv and argv[0] == "elastic":
         return _elastic_main(argv[1:])
+    if argv and argv[0] == "ssh":
+        return _ssh_main(argv[1:])
     if argv and argv[0] == "launch":
         argv = argv[1:]
     args = parse_args(argv)

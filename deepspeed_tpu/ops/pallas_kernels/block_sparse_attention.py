@@ -125,18 +125,20 @@ def _fwd_kernel(qt_ref, qcnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                 sm_scale, causal, block_k, max_active):
     qi = pl.program_id(2)
     block_q, d = q_ref.shape[2], q_ref.shape[3]
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+    # native-dtype dot inputs: bf16 x bf16 -> f32 is the MXU full-rate
+    # path (flash_attention.py carries the same convention); the
+    # softmax statistics stay f32
+    q = q_ref[0, 0]
     count = qcnt_ref[qi]
 
     def body(j, carry):
         acc, m_prev, l_prev = carry
         ki = qt_ref[qi, j]
-        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(
-            jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(
-            jnp.float32)
+        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * sm_scale
         if causal:
             s = _causal_mask(s, qi * block_q, ki * block_k,
                              block_q, block_k)
@@ -149,7 +151,7 @@ def _fwd_kernel(qt_ref, qcnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                                   _NEG_INF) - shift)
         l_new = alpha * l_prev + jnp.sum(p, axis=1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
@@ -169,20 +171,20 @@ def _bwd_dq_kernel(qt_ref, qcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    max_active):
     qi = pl.program_id(2)
     block_q = q_ref.shape[2]
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
-    do = do_ref[0, 0].astype(jnp.float32)
+    # native-dtype dot inputs (see _fwd_kernel note)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
     lse = lse_ref[0, 0, :, 0]
     delta = delta_ref[0, 0, :, 0]
     count = qcnt_ref[qi]
 
     def body(j, dq):
         ki = qt_ref[qi, j]
-        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(
-            jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(
-            jnp.float32)
+        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * sm_scale
         if causal:
             s = _causal_mask(s, qi * block_q, ki * block_k,
                              block_q, block_k)
@@ -193,7 +195,8 @@ def _bwd_dq_kernel(qt_ref, qcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+        return dq + jax.lax.dot_general(ds.astype(k_blk.dtype), k_blk,
+                                        (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
     dq0 = jnp.zeros((block_q, q_ref.shape[3]), jnp.float32)
@@ -206,21 +209,21 @@ def _bwd_dkv_kernel(kt_ref, kcnt_ref, q_ref, k_ref, v_ref, do_ref,
                     causal, block_q, max_active):
     ki = pl.program_id(1)
     block_k = k_ref.shape[2]
-    k_blk = k_ref[0, 0].astype(jnp.float32)
-    v_blk = v_ref[0, 0].astype(jnp.float32)
+    # native-dtype dot inputs (see _fwd_kernel note)
+    k_blk = k_ref[0, 0]
+    v_blk = v_ref[0, 0]
     count = kcnt_ref[ki]
 
     def body(j, carry):
         dk, dv = carry
         qi = kt_ref[ki, j]
-        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(
-            jnp.float32) * sm_scale
-        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(
-            jnp.float32)
+        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
         delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * sm_scale
         if causal:
             s = _causal_mask(s, qi * block_q, ki * block_k,
                              block_q, block_k)
@@ -228,12 +231,14 @@ def _bwd_dkv_kernel(kt_ref, kcnt_ref, q_ref, k_ref, v_ref, do_ref,
         lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
         p = jnp.exp(s - lse_safe[:, None])
         p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -241,7 +246,8 @@ def _bwd_dkv_kernel(kt_ref, kcnt_ref, q_ref, k_ref, v_ref, do_ref,
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(0, max_active, body, (dk0, dv0))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    # q entered the dk dot unscaled; fold sm_scale in once here
+    dk_ref[0, 0] = (dk * sm_scale).astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 

@@ -78,7 +78,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     qi = pl.program_id(2)
     block_q = q_ref.shape[2]
     d = q_ref.shape[3]
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [BQ, D]
+    # keep the dot inputs in their native dtype: bf16 x bf16 -> f32 is
+    # the MXU's full-rate path (an f32 upcast before the dot would halve
+    # matmul throughput without adding information — the operands were
+    # already rounded to bf16). sm_scale is applied to the f32 scores.
+    q = q_ref[0, 0]  # [BQ, D]
 
     num_k_blocks = kv_len // block_k
     if causal:
@@ -88,10 +92,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
     def body(ki, carry):
         acc, m_prev, l_prev = carry
-        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [BQ, BK]
+        s = s * sm_scale
         if causal:
             s = _causal_mask(s, qi * block_q, ki * block_k, offset,
                              block_q, block_k)
@@ -102,8 +107,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         p = jnp.exp(s - shift[:, None])
         alpha = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev, _NEG_INF) - shift)
         l_new = alpha * l_prev + jnp.sum(p, axis=1)
+        # PV matmul in the value dtype (standard flash practice): the
+        # f32 row-max/l statistics above keep the softmax exact
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
@@ -159,8 +166,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                    sm_scale, causal, block_k, kv_len, offset):
     qi = pl.program_id(2)
     block_q = q_ref.shape[2]
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
-    do = do_ref[0, 0].astype(jnp.float32)
+    # native-dtype dot inputs (MXU full-rate, see _fwd_kernel note);
+    # scores/probabilities/statistics stay f32
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
     lse = lse_ref[0, 0, :, 0]
     delta = delta_ref[0, 0, :, 0]
 
@@ -170,10 +179,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         num_k_blocks = jnp.clip(last_k // block_k + 1, 0, num_k_blocks)
 
     def body(ki, dq):
-        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        s = s * sm_scale
         if causal:
             s = _causal_mask(s, qi * block_q, ki * block_k, offset,
                              block_q, block_k)
@@ -183,7 +193,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        dq = dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+        dq = dq + jax.lax.dot_general(ds.astype(k_blk.dtype), k_blk,
+                                      (((1,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dq
 
@@ -201,8 +212,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     ki = pl.program_id(1)
     h = pl.program_id(2)
     block_k = k_ref.shape[2]
-    k_blk = k_ref[0, 0].astype(jnp.float32)
-    v_blk = v_ref[0, 0].astype(jnp.float32)
+    # native-dtype dot inputs (MXU full-rate, see _fwd_kernel note)
+    k_blk = k_ref[0, 0]
+    v_blk = v_ref[0, 0]
 
     num_q_blocks = q_len // block_q
     if causal:
@@ -213,25 +225,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(qi, carry):
         dk, dv = carry
-        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) \
-            * sm_scale
-        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
         delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [BQ,BK]
+        s = s * sm_scale
         if causal:
             s = _causal_mask(s, qi * block_q, ki * block_k, offset,
                              block_q, block_k)
         lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
         p = jnp.exp(s - lse_safe[:, None])
         p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv = dv + jax.lax.dot_general(p.astype(do.dtype), do,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -239,6 +253,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(first_q_block, num_q_blocks, body, (dk0, dv0))
+    # q was used unscaled in the dk dot; fold sm_scale in once here
+    dk = dk * sm_scale
 
     @pl.when(h % rep == 0)
     def _init():

@@ -130,6 +130,20 @@ class DataTypesConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class CompileCacheConfig(DeepSpeedConfigModel):
+    """Persistent XLA compilation cache across processes/restarts — the
+    TPU-native counterpart of the reference's CUDA-graph capture +
+    kernel-JIT caching (inference/engine.py:518 graph replay,
+    op_builder/builder.py jit_load): the expensive artifact here is the
+    XLA executable, and jax's persistent cache makes recompiles
+    (restarts, elastic respawns, autotuner trials) near-free."""
+    enabled: bool = False
+    dir: str = "~/.cache/deepspeed_tpu/xla_cache"
+    # only cache programs that took at least this long to compile
+    min_compile_time_secs: float = 1.0
+
+
+@dataclasses.dataclass
 class PipelineConfig(DeepSpeedConfigModel):
     """Pipeline engine knobs (reference: pipe engine config usage)."""
     stages: str = "auto"
@@ -188,6 +202,8 @@ class DeepSpeedConfig:
             d.get("flops_profiler", {}))
         self.checkpoint_config = CheckpointConfig.from_dict(d.get(CHECKPOINT, {}))
         self.data_types_config = DataTypesConfig.from_dict(d.get(DATA_TYPES, {}))
+        self.compile_cache_config = CompileCacheConfig.from_dict(
+            d.get("compile_cache", {}))
         self.pipeline_config = PipelineConfig.from_dict(d.get(PIPELINE, {}))
         # curriculum learning: legacy top-level section or nested under
         # data_efficiency.data_sampling (reference: data_pipeline/config.py)

@@ -69,6 +69,22 @@ def _put_with_fallback(tree, shardings):
         return jax.device_put(host, shardings)
 
 
+def _apply_compile_cache(cc):
+    """Enable jax's persistent compilation cache when configured
+    (config section ``compile_cache``; see CompileCacheConfig for the
+    reference mapping). jax.config is process-global, and enabling is
+    sticky: a later engine without the section leaves the cache on
+    (disabling per-engine would silently flip earlier engines too)."""
+    if not cc.enabled:
+        return
+    path = os.path.abspath(os.path.expanduser(cc.dir))
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(cc.min_compile_time_secs))
+    log_dist(f"XLA compilation cache enabled at {path}", ranks=[0])
+
+
 class TrainState(NamedTuple):
     """All device-resident training state, donated through the jit step."""
     master_params: Any          # fp32, sharded per ZeRO opt rules
@@ -95,6 +111,7 @@ class DeepSpeedEngine:
         self.accelerator = get_accelerator()
         self._config = config if isinstance(config, DeepSpeedConfig) \
             else DeepSpeedConfig(config)
+        _apply_compile_cache(self._config.compile_cache_config)
 
         # ---- mesh / distributed bring-up (reference: engine.py:1102
         # _configure_distributed_model + groups wiring) ----

@@ -80,7 +80,7 @@ class TestKVCacheDecode:
         out_cached = engine.generate(prompt, max_new_tokens=6)
 
         out_recompute = engine._generate_recompute(
-            prompt, 6, 0.0, None, jax.random.PRNGKey(0), None)
+            prompt, 6, 0.0, None, None, jax.random.PRNGKey(0), None)
         np.testing.assert_array_equal(out_cached, np.asarray(out_recompute))
 
     def test_cached_decode_is_O_total(self, llama, eight_devices):

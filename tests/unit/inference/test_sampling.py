@@ -1,0 +1,132 @@
+"""Sampling layer for the serving loops (SURVEY §2.7: generation lives
+in DeepSpeed-MII in the reference; this framework ships it so both
+engines serve end-to-end). Distribution-shape checks for temperature /
+top-k / top-p, plus the v2 continuous-batching integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.sampling import (SamplingParams, make_sampler,
+                                              sample_token)
+
+
+def _logits(vals):
+    return np.asarray(vals, np.float32)
+
+
+def test_temperature_zero_is_greedy_both_paths():
+    logits = _logits([0.1, 3.0, -1.0, 2.0])
+    assert sample_token(logits, np.random.default_rng(0)) == 1
+    jit_sample = make_sampler(0.0)
+    out = jit_sample(jnp.asarray(logits)[None], jax.random.PRNGKey(0))
+    assert int(out[0]) == 1
+
+
+def test_top_k_one_is_greedy_despite_temperature():
+    logits = _logits([0.1, 3.0, -1.0, 2.0])
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        assert sample_token(logits, rng, temperature=2.0, top_k=1) == 1
+
+
+def test_top_k_restricts_support():
+    logits = _logits([5.0, 4.0, 3.0, -50.0])
+    rng = np.random.default_rng(0)
+    seen = {sample_token(logits, rng, temperature=5.0, top_k=2)
+            for _ in range(200)}
+    assert seen <= {0, 1}
+    assert len(seen) == 2       # high temperature reaches both
+
+
+def test_top_p_keeps_smallest_nucleus():
+    # probs ~ [0.97, 0.01, 0.01, ...]: p=0.5 nucleus is the top token
+    logits = _logits([10.0, 5.0, 5.0, 5.0])
+    rng = np.random.default_rng(0)
+    seen = {sample_token(logits, rng, temperature=1.0, top_p=0.5)
+            for _ in range(100)}
+    assert seen == {0}
+
+
+def test_top_p_one_keeps_everything():
+    logits = _logits([1.0, 1.0, 1.0, 1.0])
+    rng = np.random.default_rng(0)
+    seen = {sample_token(logits, rng, temperature=1.0, top_p=1.0)
+            for _ in range(300)}
+    assert seen == {0, 1, 2, 3}
+
+
+def test_top_k_larger_than_vocab_clamps():
+    logits = _logits([1.0, 5.0, 2.0])
+    rng = np.random.default_rng(0)
+    # must not raise (jit path clamps via index clipping; host path
+    # clamps explicitly)
+    for _ in range(10):
+        assert 0 <= sample_token(logits, rng, temperature=1.0,
+                                 top_k=100) < 3
+
+
+def test_sampling_is_seed_deterministic():
+    logits = _logits(np.linspace(0, 2, 32))
+    a = [sample_token(logits, np.random.default_rng(7), temperature=1.0)
+         for _ in range(5)]
+    b = [sample_token(logits, np.random.default_rng(7), temperature=1.0)
+         for _ in range(5)]
+    assert a == b
+
+
+def test_jit_sampler_top_p_matches_support():
+    logits = jnp.asarray([[10.0, 5.0, 5.0, 5.0]], jnp.float32)
+    sample = make_sampler(1.0, top_p=0.5)
+    toks = {int(sample(logits, jax.random.PRNGKey(i))[0])
+            for i in range(50)}
+    assert toks == {0}
+
+
+def test_sampling_params_validation():
+    with pytest.raises(ValueError):
+        SamplingParams(temperature=-1.0)
+    with pytest.raises(ValueError):
+        SamplingParams(top_k=0)
+    with pytest.raises(ValueError):
+        SamplingParams(top_p=0.0)
+    with pytest.raises(ValueError):
+        SamplingParams(top_p=1.5)
+    p = SamplingParams(temperature=0.7, top_k=50, top_p=0.9, seed=3)
+    assert (p.temperature, p.top_k, p.top_p, p.seed) == (0.7, 50, 0.9, 3)
+
+
+def test_v2_generate_batch_sampled(eight_devices):
+    """The ragged serving loop must accept SamplingParams: sampled runs
+    are reproducible by seed."""
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.v2.engine_v2 import \
+        RaggedInferenceEngineConfig
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    eng = InferenceEngineV2(
+        params, cfg,
+        RaggedInferenceEngineConfig(token_budget=32,
+                                    max_ragged_sequence_count=4,
+                                    n_kv_blocks=16, kv_block_size=8,
+                                    max_blocks_per_seq=8,
+                                    kv_dtype="float32"))
+    prompts = {1: [5, 6, 7], 2: [9, 10]}
+
+    greedy = eng.generate_batch(dict(prompts), max_new_tokens=6)
+    for uid in prompts:
+        eng.flush(uid)
+    s1 = eng.generate_batch(dict(prompts), max_new_tokens=6,
+                            sampling=SamplingParams(temperature=1.5, seed=11))
+    for uid in prompts:
+        eng.flush(uid)
+    s2 = eng.generate_batch(dict(prompts), max_new_tokens=6,
+                            sampling=SamplingParams(temperature=1.5, seed=11))
+    assert s1 == s2                       # seed-reproducible
+    assert all(len(v) == 6 for v in s1.values())
+    assert all(0 <= t < cfg.vocab_size for v in s1.values() for t in v)
+    assert all(len(v) == 6 for v in greedy.values())

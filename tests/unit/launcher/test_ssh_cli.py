@@ -1,0 +1,49 @@
+"""``dstpu ssh`` fan-out CLI (reference: bin/ds_ssh — run one command
+on every hostfile host)."""
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import main as runner_main
+
+
+@pytest.fixture
+def hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text("node1 slots=4\nnode2 slots=4\nnode3 slots=8\n")
+    return str(p)
+
+
+def test_dry_run_builds_one_ssh_per_host(hostfile, capsys):
+    rc = runner_main(["ssh", "-f", hostfile, "--dry-run",
+                      "hostname", "-f"])
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 3
+    assert lines[0].startswith("ssh -o StrictHostKeyChecking=no node1")
+    assert all("hostname -f" in l for l in lines)
+
+
+def test_include_filters_hosts(hostfile, capsys):
+    rc = runner_main(["ssh", "-f", hostfile, "--include", "node2",
+                      "--dry-run", "uptime"])
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1 and "node2" in lines[0]
+
+
+def test_include_matching_nothing_errors(hostfile):
+    # a typo'd --include must not silently report success
+    rc = runner_main(["ssh", "-f", hostfile, "--include", "nodeX",
+                      "--dry-run", "pkill -f train"])
+    assert rc == 2
+
+
+def test_missing_hostfile_errors(tmp_path):
+    rc = runner_main(["ssh", "-f", str(tmp_path / "nope"),
+                      "--dry-run", "uptime"])
+    assert rc == 2
+
+
+def test_no_command_errors(hostfile):
+    with pytest.raises(SystemExit):
+        runner_main(["ssh", "-f", hostfile, "--dry-run"])

@@ -1,0 +1,76 @@
+"""Compile-on-use op builder (reference: op_builder/builder.py —
+OpBuilder.load()/jit_load(): hash-keyed build cache, compatibility
+probing, graceful absence)."""
+
+import ctypes
+import os
+
+import pytest
+
+from deepspeed_tpu.ops.op_builder.builder import OpBuilder, _cache_dir
+
+
+class _TinyBuilder(OpBuilder):
+    """Builds a one-function C library into the shared cache."""
+    NAME = "tiny_test_op"
+
+    def __init__(self, src_dir):
+        super().__init__()
+        self._src = os.path.join(src_dir, "tiny.c")
+        with open(self._src, "w") as f:
+            f.write("int ds_tiny_add(int a, int b) { return a + b; }\n")
+
+    def sources(self):
+        return [self._src]
+
+    def compiler(self):
+        return "cc"
+
+
+class _BrokenBuilder(_TinyBuilder):
+    NAME = "broken_test_op"
+
+    def __init__(self, src_dir):
+        super().__init__(src_dir)
+        with open(self._src, "w") as f:
+            f.write("this is not C\n")
+
+
+def test_build_load_and_call(tmp_path):
+    b = _TinyBuilder(str(tmp_path))
+    lib = b.load()
+    assert isinstance(lib, ctypes.CDLL)
+    assert lib.ds_tiny_add(20, 22) == 42
+
+
+def test_build_is_cached_by_source_hash(tmp_path):
+    b = _TinyBuilder(str(tmp_path))
+    p1 = b.build()
+    mtime = os.path.getmtime(p1)
+    p2 = _TinyBuilder(str(tmp_path)).build()   # same source -> same artifact
+    assert p1 == p2 and os.path.getmtime(p2) == mtime
+    # changing the source changes the artifact path (hash-keyed)
+    with open(b._src, "a") as f:
+        f.write("int ds_tiny_sub(int a, int b) { return a - b; }\n")
+    b2 = _TinyBuilder.__new__(_TinyBuilder)
+    OpBuilder.__init__(b2)
+    b2._src = b._src
+    p3 = b2.build()
+    assert p3 != p1
+    assert b2.load().ds_tiny_sub(50, 8) == 42
+
+
+def test_try_load_swallows_compile_failure(tmp_path):
+    b = _BrokenBuilder(str(tmp_path))
+    assert b.try_load() is None
+    with pytest.raises(Exception):
+        b.load()
+
+
+def test_cache_dir_exists_and_is_writable():
+    d = _cache_dir()
+    assert os.path.isdir(d)
+    probe = os.path.join(d, ".probe")
+    with open(probe, "w") as f:
+        f.write("x")
+    os.remove(probe)

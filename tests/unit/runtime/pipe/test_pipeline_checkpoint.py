@@ -1,0 +1,93 @@
+"""Pipeline-engine checkpoint continuity (reference pattern:
+tests/unit/checkpoint/test_pipeline.py — save mid-training, resume in a
+fresh engine, losses continue identically)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.mesh import mesh_manager
+from deepspeed_tpu.runtime.pipe import LayerSpec, PipelineModule
+
+HIDDEN = 16
+VOCAB = 64
+
+
+class EmbedLayer(nn.Module):
+    @nn.compact
+    def __call__(self, ids):
+        e = self.param("embedding", nn.initializers.normal(0.02),
+                       (VOCAB, HIDDEN))
+        return e[ids]
+
+
+class Block(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(HIDDEN * 2)(x)
+        return x + nn.Dense(HIDDEN)(nn.relu(h))
+
+
+class Head(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(VOCAB)(x)
+
+
+def ce_loss(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def _engine(seed):
+    pm = PipelineModule(
+        [LayerSpec(EmbedLayer)] + [LayerSpec(Block) for _ in range(4)] +
+        [LayerSpec(Head)], num_stages=4, loss_fn=ce_loss)
+    config = {"train_micro_batch_size_per_gpu": 2,
+              "gradient_accumulation_steps": 4,
+              "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+              "zero_optimization": {"stage": 1},
+              "steps_per_print": 0}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=pm, config=config, rng=jax.random.PRNGKey(seed))
+    return engine
+
+
+def test_pipeline_checkpoint_resume_continues_loss_curve(
+        tmp_path, rng, eight_devices):
+    engine = _engine(seed=1)
+    gbs = engine.train_batch_size()
+    ids = rng.integers(0, VOCAB, size=(gbs, 8), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+
+    for _ in range(3):
+        engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path), tag="pipe3")
+    expect = [float(engine.train_batch(batch=batch)) for _ in range(3)]
+
+    mesh_manager.reset()
+    engine2 = _engine(seed=99)           # different init
+    engine2.train_batch(batch=batch)     # materialize params
+    engine2.load_checkpoint(str(tmp_path), tag="pipe3")
+    assert engine2.global_steps == 3
+    got = [float(engine2.train_batch(batch=batch)) for _ in range(3)]
+    np.testing.assert_allclose(got, expect, rtol=1e-4)
+
+
+def test_pipeline_checkpoint_latest_pointer(tmp_path, rng, eight_devices):
+    engine = _engine(seed=2)
+    gbs = engine.train_batch_size()
+    ids = rng.integers(0, VOCAB, size=(gbs, 8), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path))      # default tag
+    # tag=None load resolves through `latest`
+    mesh_manager.reset()
+    engine2 = _engine(seed=3)
+    engine2.train_batch(batch=batch)
+    engine2.load_checkpoint(str(tmp_path))
+    assert engine2.global_steps == 1

@@ -1,0 +1,52 @@
+"""Persistent XLA compilation cache config (the reference's
+CUDA-graph/kernel-JIT caching analog — see CompileCacheConfig)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.runtime.config import CompileCacheConfig, DeepSpeedConfig
+
+
+def test_config_defaults_disabled():
+    cfg = DeepSpeedConfig({"train_batch_size": 8})
+    assert cfg.compile_cache_config.enabled is False
+
+
+def test_config_parses_section():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "compile_cache": {"enabled": True,
+                                             "dir": "/tmp/x",
+                                             "min_compile_time_secs": 0}})
+    cc = cfg.compile_cache_config
+    assert cc.enabled and cc.dir == "/tmp/x"
+    assert cc.min_compile_time_secs == 0
+
+
+def test_engine_populates_cache_dir(tmp_path, rng, eight_devices):
+    cache_dir = tmp_path / "xla_cache"
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2LMHeadModel(GPT2Config.tiny()),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "compile_cache": {"enabled": True,
+                                      "dir": str(cache_dir),
+                                      "min_compile_time_secs": 0},
+                    "steps_per_print": 0})
+        assert jax.config.jax_compilation_cache_dir == str(cache_dir)
+        assert cache_dir.is_dir()
+        ids = rng.integers(0, 256, size=(8, 16), dtype=np.int32)
+        engine.train_batch(batch={"input_ids": ids, "labels": ids.copy()})
+        # the compiled train step must have been persisted
+        assert len(os.listdir(cache_dir)) > 0
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_min)
