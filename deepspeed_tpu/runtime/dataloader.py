@@ -51,6 +51,10 @@ class DeepSpeedDataLoader:
         self.seed = seed
         self.drop_last = drop_last
         self.data_sampler = data_sampler
+        # applied to each collated batch before it is yielded
+        # (reference: dataloader post_process_func set via
+        # engine.set_data_post_process_func, engine.py:452)
+        self.post_process_func = None
         self.epoch = 0
         self.len = len(dataset) // batch_size if drop_last else \
             -(-len(dataset) // batch_size)
@@ -75,7 +79,18 @@ class DeepSpeedDataLoader:
             chunk = indices[start:start + self.batch_size]
             if not chunk:
                 return
-            yield self.collate_fn([self.dataset[i] for i in chunk])
+            batch = self.collate_fn([self.dataset[i] for i in chunk])
+            if self.post_process_func is not None:
+                # reference contract (dataloader.py:121): second arg is
+                # the sampler state. When the engine wires curriculum it
+                # wraps the hook so this arg carries the curriculum
+                # scheduler's state_dict (engine.set_data_post_process_func);
+                # the branch below serves direct data_sampler users.
+                sampler_state = self.data_sampler.state_dict() \
+                    if hasattr(self.data_sampler, "state_dict") else \
+                    {"epoch": self.epoch}
+                batch = self.post_process_func(batch, sampler_state)
+            yield batch
 
 
 def _default_collate(samples):

@@ -142,6 +142,7 @@ class DeepSpeedEngine:
         self._profile_batch_struct = None
         self.curriculum_scheduler = None
         self.curriculum_sampler = None
+        self._pending_curriculum_fn = None
 
         # precision
         self.compute_dtype = self._config.precision_dtype
@@ -268,6 +269,7 @@ class DeepSpeedEngine:
             self._setup_state(model_parameters)
 
         # dataloader (reference: engine.py:1729 deepspeed_io)
+        self._training_data = training_data
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data)
             self.data_iterator = iter(RepeatingLoader(self.training_dataloader))
@@ -668,7 +670,16 @@ class DeepSpeedEngine:
             # + data_pipeline curriculum sampler)
             from .data_pipeline import (CurriculumDataSampler,
                                         CurriculumScheduler)
-            self.curriculum_scheduler = CurriculumScheduler(cc)
+            if self.curriculum_scheduler is None:
+                # reuse across dataloader rebuilds: the scheduler carries
+                # runtime state (custom difficulty fn, current difficulty)
+                self.curriculum_scheduler = CurriculumScheduler(cc)
+                pending = getattr(self, "_pending_curriculum_fn", None)
+                if pending is not None:
+                    # schedule registered before the scheduler existed
+                    self.curriculum_scheduler.set_custom_get_difficulty(
+                        pending)
+                    self._pending_curriculum_fn = None
             self.curriculum_sampler = CurriculumDataSampler(
                 loader, self.curriculum_scheduler)
             return self.curriculum_sampler
@@ -685,6 +696,65 @@ class DeepSpeedEngine:
 
     def gradient_accumulation_steps(self):
         return self._config.gradient_accumulation_steps
+
+    def set_train_batch_size(self, train_batch_size):
+        """Adjust the global batch by changing the number of
+        micro-batches (gas); micro size is unchanged (reference:
+        engine.py:423 set_train_batch_size, same divisibility error).
+        The fused train step scans gas statically, so a change
+        invalidates the compiled step (one recompile on next use)."""
+        micro = self.train_micro_batch_size_per_gpu()
+        if train_batch_size % (micro * self.dp_world_size) != 0:
+            raise ValueError(
+                "Train batch size must be divisible by micro-batch * "
+                f"data parallelism ({micro} * {self.dp_world_size})")
+        new_gas = train_batch_size // (micro * self.dp_world_size)
+        if new_gas != self._config.gradient_accumulation_steps:
+            self._config.gradient_accumulation_steps = new_gas
+            self._jit_train_step = None
+        self._config.train_batch_size = train_batch_size
+        self._invalidate_batch_shape_caches()
+        self._rebuild_dataloader()
+
+    def set_train_micro_batch_size(self, micro_batch_size):
+        """Adjust the micro batch, keeping gas fixed (reference:
+        engine.py:441). Batch shapes change, so the jitted step
+        recompiles on next use (shape-keyed by jax)."""
+        gas = self._config.gradient_accumulation_steps
+        self._config.train_micro_batch_size_per_gpu = micro_batch_size
+        self._config.train_batch_size = \
+            micro_batch_size * gas * self.dp_world_size
+        self._invalidate_batch_shape_caches()
+        self._rebuild_dataloader()
+
+    def _invalidate_batch_shape_caches(self):
+        """Profiling lowerings are keyed on the old batch shapes; a
+        stale struct would silently misreport FLOPs/MFU after a
+        batch-size change."""
+        self._profile_batch_struct = None
+        self._flops_profile = None
+        self._module_flops_profile = None
+
+    def _rebuild_dataloader(self):
+        """The engine's own loader yields GLOBAL batches, so a batch-size
+        change must rebuild it (the reference's per-GPU-micro loader is
+        insensitive to gas changes; ours is not). Preserves the
+        post-process hook and the curriculum step counter; the fresh
+        iterator starts a new pass."""
+        if self._training_data is None:
+            return
+        prev_hook = getattr(self.training_dataloader, "post_process_func",
+                            None)
+        prev_sampler = self.curriculum_sampler
+        self.training_dataloader = self.deepspeed_io(self._training_data)
+        if prev_sampler is not None and self.curriculum_sampler is not None:
+            # a step-dependent schedule must not replay its warm-up
+            self.curriculum_sampler.global_steps = prev_sampler.global_steps
+        if prev_hook is not None:
+            loader = getattr(self.training_dataloader, "loader",
+                             self.training_dataloader)
+            loader.post_process_func = prev_hook
+        self.data_iterator = iter(RepeatingLoader(self.training_dataloader))
 
     def gradient_clipping(self):
         return self._config.gradient_clipping
@@ -1902,6 +1972,59 @@ class DeepSpeedEngine:
             os.fsync(f.fileno())
         os.replace(tmp, path)
         return True
+
+    def set_data_post_process_func(self, post_process_func):
+        """Install a batch post-processor on the engine's dataloader
+        (reference: engine.py:452); called as fn(batch, sampler_state).
+        With curriculum enabled, sampler_state is the curriculum
+        scheduler's state_dict (difficulty etc.), matching the
+        reference's data_sampler.state_dict() contract."""
+        dl = self.training_dataloader
+        if dl is None:
+            return
+        # unwrap the curriculum sampler: its __getattr__ delegates READS
+        # to the loader, so assigning on the wrapper would shadow the
+        # loader's attribute without ever being called
+        loader = getattr(dl, "loader", dl)
+        sched = self.curriculum_scheduler
+        if sched is not None:
+            def hook(batch, _state, _fn=post_process_func, _s=sched):
+                return _fn(batch, _s.state_dict())
+            loader.post_process_func = hook
+        else:
+            loader.post_process_func = post_process_func
+
+    def set_custom_curriculum_learning_schedule(self, schedule_func_dict):
+        """Route a custom difficulty schedule to the curriculum
+        scheduler (reference: engine.py:456; the reference passes a
+        dict of callables keyed like {'get_difficulty': fn} — a bare
+        callable is accepted too). If the scheduler does not exist yet
+        (dataloader built later via deepspeed_io), the schedule is held
+        and applied at creation."""
+        fn = schedule_func_dict.get("get_difficulty") \
+            if isinstance(schedule_func_dict, dict) else schedule_func_dict
+        if fn is None:
+            raise ValueError(
+                "schedule_func_dict needs a 'get_difficulty' callable")
+        if self.curriculum_scheduler is None:
+            self._pending_curriculum_fn = fn
+            return
+        self.curriculum_scheduler.set_custom_get_difficulty(fn)
+
+    def save_fp16_model(self, save_dir, save_filename="model_16bit.npz",
+                        exclude_frozen_parameters=False):
+        """Deprecated alias kept for reference API parity
+        (reference: engine.py:3590 save_fp16_model -> save_16bit_model)."""
+        logger.warning("save_fp16_model is deprecated; use save_16bit_model")
+        return self.save_16bit_model(save_dir, save_filename,
+                                     exclude_frozen_parameters)
+
+    def get_batch_info(self):
+        """(train_batch_size, micro_batch_per_gpu, gas) — reference:
+        engine.py:407."""
+        return (self.train_batch_size(),
+                self.train_micro_batch_size_per_gpu(),
+                self.gradient_accumulation_steps())
 
     @property
     def checkpoint_engine(self):

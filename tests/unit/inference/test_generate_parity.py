@@ -1,0 +1,90 @@
+"""End-to-end GREEDY GENERATION parity against HF transformers —
+stronger than logits parity: conversion + KV-cache decode + sampling
+glue must all agree token-for-token (reference evidence tier:
+tests/unit/inference/test_inference.py query/response checks)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+
+
+@pytest.fixture(scope="module")
+def hf_and_ours():
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    from deepspeed_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                            from_hf_state_dict)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=128,
+        attention_dropout=0.0, rope_theta=10000.0)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = LlamaConfig.tiny()
+    params = from_hf_state_dict(hf.state_dict(), cfg)
+    model = LlamaForCausalLM(cfg)
+    return hf, model, params
+
+
+def test_greedy_generate_matches_hf(hf_and_ours, eight_devices):
+    import torch
+    hf, model, params = hf_and_ours
+    prompt = np.array([[11, 45, 3, 200, 7, 9]], np.int32)
+
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(prompt, dtype=torch.long),
+                          max_new_tokens=8, do_sample=False,
+                          pad_token_id=0).numpy()
+
+    engine = deepspeed_tpu.init_inference(model, tp_size=1, dtype="float32")
+    engine.set_params(params)
+    ours = engine.generate(prompt, max_new_tokens=8, temperature=0.0)
+
+    np.testing.assert_array_equal(ours, ref)
+
+
+def test_greedy_generate_matches_hf_batched(hf_and_ours, eight_devices):
+    """Batched prompts decode independently and still match HF."""
+    import torch
+    hf, model, params = hf_and_ours
+    prompts = np.array([[11, 45, 3, 200], [90, 2, 150, 6]], np.int32)
+
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(prompts, dtype=torch.long),
+                          max_new_tokens=6, do_sample=False,
+                          pad_token_id=0).numpy()
+
+    engine = deepspeed_tpu.init_inference(model, tp_size=1, dtype="float32")
+    engine.set_params(params)
+    ours = engine.generate(prompts, max_new_tokens=6, temperature=0.0)
+    np.testing.assert_array_equal(ours, ref)
+
+
+def test_v2_ragged_greedy_matches_hf(hf_and_ours, eight_devices):
+    """The ragged paged-KV engine's continuous-batching loop produces
+    the same greedy tokens as HF generate — FastGen-path end-to-end."""
+    import torch
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.v2.engine_v2 import \
+        RaggedInferenceEngineConfig
+    hf, model, params = hf_and_ours
+    prompt = [11, 45, 3, 200, 7, 9]
+
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor([prompt], dtype=torch.long),
+                          max_new_tokens=8, do_sample=False,
+                          pad_token_id=0).numpy()[0, len(prompt):]
+
+    eng = InferenceEngineV2(
+        params, model.config,
+        RaggedInferenceEngineConfig(token_budget=64,
+                                    max_ragged_sequence_count=4,
+                                    n_kv_blocks=32, kv_block_size=8,
+                                    max_blocks_per_seq=16,
+                                    kv_dtype="float32"))
+    out = eng.generate_batch({1: prompt}, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out[1]), ref)

@@ -1,0 +1,94 @@
+"""Runtime batch-size mutation (reference: engine.py:423
+set_train_batch_size — gas changes, micro stays; :441
+set_train_micro_batch_size — micro changes, gas stays)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+
+def _engine():
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(GPT2Config.tiny()),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1},
+                "steps_per_print": 0})
+    return engine
+
+
+def _batch(rng, n, seq=16):
+    ids = rng.integers(0, 256, size=(n, seq), dtype=np.int32)
+    return {"input_ids": ids, "labels": ids.copy()}
+
+
+def test_set_train_batch_size_changes_gas(rng, eight_devices):
+    engine = _engine()
+    assert engine.train_batch_size() == 16      # 1 micro * 2 gas * 8 dp
+    loss0 = float(engine.train_batch(batch=_batch(rng, 16)))
+
+    engine.set_train_batch_size(32)             # gas 2 -> 4
+    assert engine.gradient_accumulation_steps() == 4
+    assert engine.train_micro_batch_size_per_gpu() == 1
+    assert engine.train_batch_size() == 32
+    # training continues at the new accumulation depth
+    loss1 = float(engine.train_batch(batch=_batch(rng, 32)))
+    assert np.isfinite(loss0) and np.isfinite(loss1)
+    assert engine.global_steps == 2
+
+
+def test_set_train_batch_size_divisibility(rng, eight_devices):
+    engine = _engine()
+    with pytest.raises(ValueError, match="divisible"):
+        engine.set_train_batch_size(20)         # not divisible by 1*8
+
+
+def test_set_train_batch_size_rebuilds_engine_loader(rng, eight_devices):
+    """With the engine-owned dataloader (train_batch() without batch=),
+    a batch-size change must rebuild the loader to the new GLOBAL size
+    and keep the curriculum scheduler's runtime state."""
+    class DS:
+        def __init__(self):
+            r = np.random.default_rng(0)
+            self.ids = r.integers(0, 256, size=(128, 16), dtype=np.int32)
+
+        def __len__(self):
+            return len(self.ids)
+
+        def __getitem__(self, i):
+            return {"input_ids": self.ids[i], "labels": self.ids[i]}
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(GPT2Config.tiny()),
+        training_data=DS(),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "curriculum_learning": {
+                    "enabled": True, "curriculum_type": "seqlen",
+                    "minimum_difficulty": 4, "maximum_difficulty": 16,
+                    "schedule_type": "custom", "schedule_config": {}},
+                "steps_per_print": 0})
+    engine.set_custom_curriculum_learning_schedule(lambda step: 8)
+    float(engine.train_batch())
+    steps_before = engine.curriculum_sampler.global_steps
+    engine.set_train_batch_size(32)
+    # the rebuilt sampler must NOT replay the schedule warm-up
+    assert engine.curriculum_sampler.global_steps == steps_before
+    loss = float(engine.train_batch())          # loader now yields 32
+    assert np.isfinite(loss)
+    # the custom schedule survived the dataloader rebuild
+    assert engine.curriculum_scheduler.get_difficulty(99) == 8
+
+
+def test_set_train_micro_batch_size_keeps_gas(rng, eight_devices):
+    engine = _engine()
+    engine.train_batch(batch=_batch(rng, 16))
+    engine.set_train_micro_batch_size(2)
+    assert engine.gradient_accumulation_steps() == 2
+    assert engine.train_batch_size() == 32      # 2 * 2 * 8
+    loss = float(engine.train_batch(batch=_batch(rng, 32)))
+    assert np.isfinite(loss)
