@@ -8,6 +8,7 @@ a simulated CPU mesh (correctness/CI) and on real chips (numbers).
 """
 
 import argparse
+import os
 import sys
 import time
 
@@ -98,6 +99,66 @@ def bench_collectives(axis="fsdp", sizes=None, trials=5, dtype="float32"):
     return results
 
 
+def bench_aio(path: str, size_mb: int = 64, trials: int = 3,
+              n_threads: int = 4, block_mb: int = 4):
+    """Async-IO read/write throughput sweep (reference:
+    csrc/aio/py_test/aio_bench_perf_sweep.py — the ds_io benchmark's
+    role). Writes then reads ``size_mb`` through the aio thread pool in
+    ``block_mb`` chunks; reports GB/s per direction."""
+    import numpy as np
+
+    from ..ops.aio.async_io import AsyncIOHandle
+    nbytes = size_mb << 20
+    block = block_mb << 20
+    data = np.random.default_rng(0).integers(
+        0, 255, size=nbytes, dtype=np.uint8)
+    out = np.empty_like(data)
+    rows = []
+    handle = AsyncIOHandle(path, nbytes=nbytes, n_threads=n_threads)
+
+    def _drop_page_cache():
+        # the file was just written by this process; without eviction the
+        # read pass measures RAM, not the device (the reference bench
+        # uses O_DIRECT for the same reason). fsync first makes the
+        # pages clean so DONTNEED can discard them.
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+        except (AttributeError, OSError):
+            pass  # non-Linux: read numbers may include page cache
+        finally:
+            os.close(fd)
+
+    try:
+        for direction in ("write", "read"):
+            times = []
+            for _ in range(trials):
+                if direction == "read":
+                    _drop_page_cache()
+                t0 = time.perf_counter()
+                for off in range(0, nbytes, block):
+                    chunk = slice(off, off + block)
+                    if direction == "write":
+                        handle.pwrite(data[chunk], off)
+                    else:
+                        handle.pread(out[chunk], off)
+                handle.wait()
+                if direction == "write":
+                    handle.fsync()
+                times.append(time.perf_counter() - t0)
+            t = sorted(times)[len(times) // 2]
+            rows.append({"op": direction, "size_mb": size_mb,
+                         "threads": n_threads, "block_mb": block_mb,
+                         "time_ms": t * 1e3, "GBps": nbytes / t / 1e9})
+        if not np.array_equal(data, out):
+            raise RuntimeError("aio bench read back corrupted data")
+    finally:
+        handle.close()
+        if os.path.exists(path):
+            os.remove(path)
+    return rows
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="dstpu bench")
     p.add_argument("--axis", default="fsdp")
@@ -105,7 +166,26 @@ def main(argv=None):
     p.add_argument("--dtype", default="float32")
     p.add_argument("--maxsize", type=int, default=26,
                    help="max message size as log2(elements)")
+    p.add_argument("--aio", default="",
+                   help="benchmark async file IO instead of collectives; "
+                        "value = scratch file path (ds_io analog)")
+    p.add_argument("--size-mb", type=int, default=64)
+    p.add_argument("--threads", type=int, default=4)
+    p.add_argument("--block-mb", type=int, default=4,
+                   help="aio transfer block size (sweepable, ds_io-style)")
     args = p.parse_args(argv)
+    if args.aio:
+        rows = bench_aio(args.aio, size_mb=args.size_mb,
+                         trials=args.trials, n_threads=args.threads,
+                         block_mb=args.block_mb)
+        hdr = f"{'op':8s} {'size':>8s} {'threads':>7s} " \
+              f"{'time(ms)':>10s} {'GB/s':>8s}"
+        print(hdr)
+        print("-" * len(hdr))
+        for r in rows:
+            print(f"{r['op']:8s} {r['size_mb']:>6d}MB {r['threads']:>7d} "
+                  f"{r['time_ms']:>10.2f} {r['GBps']:>8.2f}")
+        return 0
     sizes = [2 ** q for q in range(16, args.maxsize + 1, 2)]
     rows = bench_collectives(axis=args.axis, sizes=sizes,
                              trials=args.trials, dtype=args.dtype)

@@ -143,6 +143,7 @@ class DeepSpeedEngine:
         self.curriculum_scheduler = None
         self.curriculum_sampler = None
         self._pending_curriculum_fn = None
+        self._pending_post_process_fn = None
 
         # precision
         self.compute_dtype = self._config.precision_dtype
@@ -682,8 +683,15 @@ class DeepSpeedEngine:
                     self._pending_curriculum_fn = None
             self.curriculum_sampler = CurriculumDataSampler(
                 loader, self.curriculum_scheduler)
-            return self.curriculum_sampler
-        return loader
+            result = self.curriculum_sampler
+        else:
+            result = loader
+        pending = getattr(self, "_pending_post_process_fn", None)
+        if pending is not None and route == "train":
+            # hook registered before any dataloader existed
+            self._install_post_process(result, pending)
+            self._pending_post_process_fn = None
+        return result
 
     # ------------------------------------------------------------------
     # config accessors (reference: engine.py scalar accessors)
@@ -1981,18 +1989,24 @@ class DeepSpeedEngine:
         reference's data_sampler.state_dict() contract."""
         dl = self.training_dataloader
         if dl is None:
+            # same ordering hazard as the curriculum schedule: hold the
+            # hook and install it when deepspeed_io builds the loader
+            self._pending_post_process_fn = post_process_func
             return
+        self._install_post_process(dl, post_process_func)
+
+    def _install_post_process(self, loader_like, fn):
         # unwrap the curriculum sampler: its __getattr__ delegates READS
         # to the loader, so assigning on the wrapper would shadow the
         # loader's attribute without ever being called
-        loader = getattr(dl, "loader", dl)
+        loader = getattr(loader_like, "loader", loader_like)
         sched = self.curriculum_scheduler
         if sched is not None:
-            def hook(batch, _state, _fn=post_process_func, _s=sched):
+            def hook(batch, _state, _fn=fn, _s=sched):
                 return _fn(batch, _s.state_dict())
             loader.post_process_func = hook
         else:
-            loader.post_process_func = post_process_func
+            loader.post_process_func = fn
 
     def set_custom_curriculum_learning_schedule(self, schedule_func_dict):
         """Route a custom difficulty schedule to the curriculum

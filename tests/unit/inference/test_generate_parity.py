@@ -16,14 +16,19 @@ def hf_and_ours():
 
     from deepspeed_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
                                             from_hf_state_dict)
+    cfg = LlamaConfig.tiny()
+    # derive the HF twin from OUR config so a tiny() change can't
+    # silently skew the conversion under test
     hf_cfg = transformers.LlamaConfig(
-        vocab_size=256, hidden_size=64, intermediate_size=128,
-        num_hidden_layers=2, num_attention_heads=4,
-        num_key_value_heads=2, max_position_embeddings=128,
-        attention_dropout=0.0, rope_theta=10000.0)
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_key_value_heads,
+        max_position_embeddings=cfg.max_position_embeddings,
+        attention_dropout=0.0, rope_theta=cfg.rope_theta)
     torch.manual_seed(0)
     hf = transformers.LlamaForCausalLM(hf_cfg).eval()
-    cfg = LlamaConfig.tiny()
     params = from_hf_state_dict(hf.state_dict(), cfg)
     model = LlamaForCausalLM(cfg)
     return hf, model, params

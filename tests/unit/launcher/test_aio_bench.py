@@ -1,0 +1,25 @@
+"""``dstpu bench --aio`` storage microbenchmark (reference:
+csrc/aio/py_test/aio_bench_perf_sweep.py — the ds_io role)."""
+
+import pytest
+
+from deepspeed_tpu.launcher.comm_bench import bench_aio, main
+
+
+def test_bench_aio_measures_both_directions(tmp_path):
+    rows = bench_aio(str(tmp_path / "scratch.bin"), size_mb=2, trials=2,
+                     n_threads=2, block_mb=1)
+    ops = [r["op"] for r in rows]
+    assert ops == ["write", "read"]
+    for r in rows:
+        assert r["GBps"] > 0 and r["time_ms"] > 0
+    # scratch file cleaned up
+    assert not (tmp_path / "scratch.bin").exists()
+
+
+def test_cli_routes_aio_mode(tmp_path, capsys):
+    rc = main(["--aio", str(tmp_path / "s.bin"), "--size-mb", "2",
+               "--trials", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "write" in out and "read" in out and "GB/s" in out

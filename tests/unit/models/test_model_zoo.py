@@ -219,7 +219,7 @@ def test_mistral_cached_decode_respects_window(rng):
     eng.set_params(params)
     out_cached = eng.generate(prompt, max_new_tokens=6)
     out_recompute = eng._generate_recompute(
-        prompt, 6, 0.0, None, jax.random.PRNGKey(0), None)
+        prompt, 6, 0.0, None, None, jax.random.PRNGKey(0), None)
     np.testing.assert_array_equal(np.asarray(out_cached),
                                   np.asarray(out_recompute))
 

@@ -114,6 +114,20 @@ def test_post_process_hook_gets_curriculum_state(eight_devices):
     assert "current_difficulty" in states[0]
 
 
+def test_post_process_hook_before_dataloader_is_held(eight_devices):
+    """A hook registered before any dataloader exists must apply when
+    deepspeed_io builds one (same ordering contract as the curriculum
+    schedule)."""
+    engine, _ = _engine()
+    seen = []
+    engine.set_data_post_process_func(
+        lambda batch, state: (seen.append(state), batch)[1])
+    engine.training_dataloader = engine.deepspeed_io(_DS())
+    for batch in engine.training_dataloader:
+        break
+    assert seen, "held post-process hook never installed"
+
+
 def test_save_fp16_model_forwards_exclude_frozen(tmp_path, eight_devices):
     engine, _ = _engine()
     with pytest.raises(NotImplementedError):
