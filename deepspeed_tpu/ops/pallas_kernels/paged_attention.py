@@ -122,11 +122,14 @@ def _paged_kernel(tables_ref, slens_ref, qcnt_ref, q_ref, k_ref, v_ref,
 
     @pl.when(active)
     def _step():
-        q = q_ref[0, 0].astype(jnp.float32).reshape(rows, hd) * sm_scale
-        k_blk = k_ref[0, 0].astype(jnp.float32)   # [bs, D]
-        v_blk = v_ref[0, 0].astype(jnp.float32)
+        # native-dtype dot inputs (flash_attention.py convention: bf16
+        # operands at MXU full rate, f32 scores/statistics)
+        q = q_ref[0, 0].reshape(rows, hd)
+        k_blk = k_ref[0, 0]   # [bs, D]
+        v_blk = v_ref[0, 0]
         x = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        x = x * sm_scale
         # row r -> query index j = qi*q_block + r//rep, abs pos start+j
         j = qi * q_block + \
             jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 0) // rep
@@ -156,7 +159,7 @@ def _paged_kernel(tables_ref, slens_ref, qcnt_ref, q_ref, k_ref, v_ref,
         l_ref[:, 0] = alpha * l_prev + jnp.sum(p, axis=1)
         m_ref[:, 0] = m_new
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(bi == n_bi - 1)
