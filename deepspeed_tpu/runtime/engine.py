@@ -182,6 +182,16 @@ class DeepSpeedEngine:
                     not zc.offload_optimizer.nvme_path:
                 raise ValueError(
                     "offload_optimizer.device='nvme' needs nvme_path")
+            # validate the wire dtypes at construction, not first step
+            gd = (self._offload_cfg.grad_dtype or "bf16").lower()
+            if gd not in ("bf16", "bfloat16", "int8"):
+                raise ValueError(f"offload_optimizer.grad_dtype must be "
+                                 f"bf16 or int8, got {gd!r}")
+            ud = (self._offload_cfg.upload_dtype or "bf16").lower()
+            if ud not in ("bf16", "bfloat16", "int8_delta", "int4_delta"):
+                raise ValueError(
+                    f"offload_optimizer.upload_dtype must be bf16, "
+                    f"int8_delta or int4_delta, got {ud!r}")
         elif zc.offload_optimizer.device not in ("none", None):
             raise ValueError(
                 f"offload_optimizer.device="
@@ -457,14 +467,10 @@ class DeepSpeedEngine:
         adamw_mode = opt_params.get("adam_w_mode", True) or \
             opt_type == "adamw"
         mask = select_offload_mask(master, self._offload_cfg.ratio)
+        # wire dtypes were validated at construction (_init: the
+        # offload_optimizer branch) — only normalize here
         gd = (self._offload_cfg.grad_dtype or "bf16").lower()
-        if gd not in ("bf16", "bfloat16", "int8"):
-            raise ValueError(f"offload_optimizer.grad_dtype must be "
-                             f"bf16 or int8, got {gd!r}")
         ud = (self._offload_cfg.upload_dtype or "bf16").lower()
-        if ud not in ("bf16", "bfloat16", "int8_delta"):
-            raise ValueError(f"offload_optimizer.upload_dtype must be "
-                             f"bf16 or int8_delta, got {ud!r}")
         self._offload = OffloadCoordinator(
             master, mask, opt_cfg=opt_params,
             compute_dtype=self.compute_dtype,
@@ -472,7 +478,8 @@ class DeepSpeedEngine:
             nvme_path=self._offload_cfg.nvme_path
             if self._offload_cfg.device == "nvme" else None,
             int8_grads=(gd == "int8"),
-            int8_delta_upload=(ud == "int8_delta"))
+            int8_delta_upload=ud.endswith("_delta"),
+            delta_bits=4 if ud == "int4_delta" else 8)
         master = self._offload.initial_device_leaves(master)
         flat, treedef = jax.tree_util.tree_flatten(master)
         device_mask = jax.tree_util.tree_unflatten(

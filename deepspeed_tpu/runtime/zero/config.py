@@ -59,9 +59,11 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     # same exponent range as fp32, halves volume) or "int8" (block-
     # quantized on device, quarter volume — for slow host links)
     grad_dtype: str = "bf16"
-    # wire dtype for the host->device param refresh: "bf16" (default)
-    # or "int8_delta" (block-int8 delta vs a device mirror with error
-    # feedback — 1.25 B/param on the wire; DRAM tier only)
+    # wire dtype for the host->device param refresh: "bf16" (default),
+    # "int8_delta" (block-int8 delta vs a device mirror with error
+    # feedback — 1.25 B/param on the wire; DRAM tier only) or
+    # "int4_delta" (two signed nibbles per byte, 0.625 B/param — the
+    # mirror's error feedback absorbs the coarser rounding)
     upload_dtype: str = "bf16"
 
 

@@ -32,8 +32,8 @@ def sharding_replicated(sharding):
     """Wire-payload placement: single-device shardings pass through
     (the payload rides to that chip); mesh shardings replicate — the
     packed (q, scales) grid does not divide like the dense leaf, and
-    at 1.25 B/param replication is cheap. GSPMD repartitions inside
-    the apply-delta jit regardless."""
+    at 1.25 (int8) / 0.625 (int4) B/param replication is cheap. GSPMD
+    repartitions inside the apply-delta jit regardless."""
     from jax.sharding import NamedSharding, PartitionSpec
     if isinstance(sharding, NamedSharding):
         return NamedSharding(sharding.mesh, PartitionSpec())
@@ -43,6 +43,21 @@ def sharding_replicated(sharding):
 @jax.jit
 def _apply_delta(leaf, q, scales):
     deq = (q.astype(jnp.float32) * scales[:, None]).reshape(-1)
+    n = leaf.size
+    upd = deq[:n].reshape(leaf.shape)
+    return (leaf.astype(jnp.float32) + upd).astype(leaf.dtype)
+
+
+@jax.jit
+def _apply_delta4(leaf, q4, scales):
+    """int4 variant: ``q4`` packs two signed nibbles per uint8
+    (element 2k in the low nibble, 2k+1 in the high)."""
+    low = (q4 & 0xF).astype(jnp.int32)
+    high = (q4 >> 4).astype(jnp.int32)
+    low = jnp.where(low > 7, low - 16, low)
+    high = jnp.where(high > 7, high - 16, high)
+    vals = jnp.stack([low, high], axis=-1).reshape(q4.shape[0], -1)
+    deq = (vals.astype(jnp.float32) * scales[:, None]).reshape(-1)
     n = leaf.size
     upd = deq[:n].reshape(leaf.shape)
     return (leaf.astype(jnp.float32) + upd).astype(leaf.dtype)
@@ -79,11 +94,15 @@ class OffloadCoordinator:
                  compute_dtype, adamw_mode: bool = True,
                  nvme_path: Optional[str] = None,
                  int8_grads: bool = False,
-                 int8_delta_upload: bool = False):
+                 int8_delta_upload: bool = False,
+                 delta_bits: int = 8):
         self.mask = mask
         self.compute_dtype = compute_dtype
         self._int8_grads = bool(int8_grads)
         self._delta_upload = bool(int8_delta_upload)
+        if delta_bits not in (4, 8):
+            raise ValueError(f"delta_bits must be 4 or 8, got {delta_bits}")
+        self._delta_bits = int(delta_bits)
         flat, self.treedef = jax.tree_util.tree_flatten(master_params)
         self.off_idx = [i for i, m in enumerate(mask) if m]
         off_params = [np.asarray(flat[i], dtype=np.float32)
@@ -236,9 +255,13 @@ class OffloadCoordinator:
         return x.astype(np_dtype).astype(np.float32)
 
     def _delta_payload(self, slot: int, sharding):
-        """Block-int8 delta vs the device mirror + scales; the merge
-        applies it on device and the mirror advances through the same
-        compute-dtype rounding, keeping host and device bit-equal."""
+        """Block-quantized delta vs the device mirror + scales; the
+        merge applies it on device and the mirror advances through the
+        same compute-dtype rounding, keeping host and device bit-equal.
+        ``delta_bits=8``: 1.25 B/param on the wire. ``delta_bits=4``:
+        two signed nibbles per byte, 0.625 B/param — the mirror's error
+        feedback absorbs the coarser per-step rounding exactly as for
+        int8 (the residual is simply larger per step)."""
         from ...comm.compressed import BLOCK
         master = self.host_adam.master[slot]
         mirror = self._mirror[slot]
@@ -253,17 +276,24 @@ class OffloadCoordinator:
         # (the jnp version would contend with the in-flight step)
         g = delta.reshape(-1, BLOCK)
         amax = np.abs(g).max(axis=1, keepdims=True)
-        scale = np.where(amax == 0, 1.0, amax / 127.0).astype(np.float32)
-        q = np.clip(np.rint(g / scale), -128, 127).astype(np.int8)
+        qmax = 127.0 if self._delta_bits == 8 else 7.0
+        scale = np.where(amax == 0, 1.0, amax / qmax).astype(np.float32)
+        q = np.clip(np.rint(g / scale), -qmax - 1, qmax).astype(np.int8)
         # advance the mirror exactly as the device will: dequant, add,
         # round through compute dtype (ml_dtypes == XLA's cast; the
         # native kernel's tie-breaks can differ by one ULP)
         deq = (q.astype(np.float32) * scale).reshape(-1)[:n]
         self._mirror[slot] = self._round_compute(
             mirror + deq.reshape(mirror.shape))
-        return {"q": jax.device_put(q, sharding_replicated(sharding)),
-                "scales": jax.device_put(scale[:, 0],
-                                         sharding_replicated(sharding))}
+        rep = sharding_replicated(sharding)
+        if self._delta_bits == 4:
+            # pack signed nibbles: element 2k low, 2k+1 high
+            u = (q.astype(np.int16) & 0xF).astype(np.uint8)
+            packed = (u[:, 0::2] | (u[:, 1::2] << 4)).astype(np.uint8)
+            return {"q4": jax.device_put(packed, rep),
+                    "scales": jax.device_put(scale[:, 0], rep)}
+        return {"q": jax.device_put(q, rep),
+                "scales": jax.device_put(scale[:, 0], rep)}
 
     def _device_payload(self, p: np.ndarray, sharding):
         """fp32 master -> compute-dtype device leaf (one rounding path
@@ -320,16 +350,21 @@ class OffloadCoordinator:
     def merge(self, state_master, leaves: Optional[list]):
         """Replace the offloaded leaves of ``state_master`` with the
         host-updated device payloads. In delta mode each payload is
-        {q, scales}: the add + dequant runs in one small jit per leaf
-        shape (cached by XLA), so the wire carried 1.25 B/param."""
+        {q, scales} (int8, 1.25 B/param on the wire) or {q4, scales}
+        (packed int4, 0.625 B/param): the add + dequant runs in one
+        small jit per leaf shape (cached by XLA)."""
         if leaves is None:
             return state_master
         flat, treedef = jax.tree_util.tree_flatten(state_master)
         for slot, i in enumerate(self.off_idx):
             leaf = leaves[slot]
             if isinstance(leaf, dict):
-                flat[i] = _apply_delta(flat[i], leaf["q"],
-                                       leaf["scales"])
+                if "q4" in leaf:
+                    flat[i] = _apply_delta4(flat[i], leaf["q4"],
+                                            leaf["scales"])
+                else:
+                    flat[i] = _apply_delta(flat[i], leaf["q"],
+                                           leaf["scales"])
             else:
                 flat[i] = leaf
         return jax.tree_util.tree_unflatten(treedef, flat)
