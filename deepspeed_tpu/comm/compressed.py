@@ -44,6 +44,40 @@ def _block_dequantize(q, scale, n, dtype) -> jnp.ndarray:
     return g.reshape(-1)[:n].astype(dtype)
 
 
+def _block_quantize4(x, block: int = BLOCK) -> Tuple[jnp.ndarray,
+                                                     jnp.ndarray]:
+    """Symmetric signed-int4 block quantization of a flat array:
+    returns (packed uint8 [nb, block//2] — element 2k in the low
+    nibble, 2k+1 in the high, the repo-wide nibble convention of
+    runtime/zero/offload.py — and fp32 scales per block). Half the
+    int8 wire volume; pair with error feedback for the coarser
+    rounding."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    pad = (-n) % block
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    g = flat.reshape(-1, block)
+    amax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+    scale = jnp.where(amax == 0, 1.0, amax / 7.0)
+    # int8->uint8 keeps the two's-complement bit pattern, so & 0xF is
+    # the signed nibble
+    q = jnp.clip(jnp.round(g / scale), -8, 7).astype(jnp.int8)
+    u = q.astype(jnp.uint8) & 0xF
+    packed = u[:, 0::2] | (u[:, 1::2] << 4)
+    return packed, scale[:, 0]
+
+
+def _block_dequantize4(q4, scale, n, dtype) -> jnp.ndarray:
+    low = (q4 & 0xF).astype(jnp.int32)
+    high = (q4 >> 4).astype(jnp.int32)
+    low = jnp.where(low > 7, low - 16, low)
+    high = jnp.where(high > 7, high - 16, high)
+    vals = jnp.stack([low, high], axis=-1).reshape(q4.shape[0], -1)
+    g = vals.astype(jnp.float32) * scale[:, None]
+    return g.reshape(-1)[:n].astype(dtype)
+
+
 def quantized_all_gather(x, axis_name: str, block: int = BLOCK,
                          dim: int = 0):
     """qwZ analog: all-gather with int8 payload (half the bf16 volume).

@@ -228,8 +228,15 @@ class InferenceEngine:
                 return (cache, nxt, idx + 1, rng), nxt
 
             init = (cache, first_tok, jnp.int32(T0), rng)
-            _, toks = jax.lax.scan(step, init, None, length=max_new - 1)
-            return toks.T  # [B, max_new-1]
+            carry, toks = jax.lax.scan(step, init, None,
+                                       length=max_new - 1)
+            # the final cache is returned ONLY so the donated input has
+            # an output to alias with: without it XLA cannot reuse the
+            # cache buffers (jax warns "donated buffers were not
+            # usable") and copies the full cache — ~600 MB at the
+            # config-5 bench shape — on every decode entry. The caller
+            # drops it.
+            return toks.T, carry[0]  # [B, max_new-1], final cache
 
         fns = (jax.jit(prefill, donate_argnums=(2,)),
                jax.jit(decode, donate_argnums=(1,)))
@@ -246,7 +253,7 @@ class InferenceEngine:
         rng, r1, r2 = jax.random.split(rng, 3)
         first, cache = prefill(self.params, jnp.asarray(ids), cache, r1)
         if max_new > 1:
-            rest = decode(self.params, cache, first, r2)
+            rest, cache = decode(self.params, cache, first, r2)
             out = jnp.concatenate([first[:, None], rest], axis=1)
         else:
             out = first[:, None]

@@ -184,9 +184,9 @@ class DeepSpeedEngine:
                     "offload_optimizer.device='nvme' needs nvme_path")
             # validate the wire dtypes at construction, not first step
             gd = (self._offload_cfg.grad_dtype or "bf16").lower()
-            if gd not in ("bf16", "bfloat16", "int8"):
+            if gd not in ("bf16", "bfloat16", "int8", "int4"):
                 raise ValueError(f"offload_optimizer.grad_dtype must be "
-                                 f"bf16 or int8, got {gd!r}")
+                                 f"bf16, int8 or int4, got {gd!r}")
             ud = (self._offload_cfg.upload_dtype or "bf16").lower()
             if ud not in ("bf16", "bfloat16", "int8_delta", "int4_delta"):
                 raise ValueError(
@@ -298,6 +298,10 @@ class DeepSpeedEngine:
         self._accum_count = 0
         self._last_loss = None
         self._offload_future = None  # in-flight DPU host update
+        # int4 grad-wire error-feedback buffers (device-resident, one
+        # fp32 leaf per offloaded param); () until the step compiles
+        self._offload_grad_residual = ()
+        self._pending_grad_residual = None  # checkpoint staging
 
         log_dist(
             f"DeepSpeedEngine: zero_stage={self.zero_stage} dtype={self.compute_dtype.__name__} "
@@ -477,7 +481,8 @@ class DeepSpeedEngine:
             adamw_mode=adamw_mode,
             nvme_path=self._offload_cfg.nvme_path
             if self._offload_cfg.device == "nvme" else None,
-            int8_grads=(gd == "int8"),
+            int8_grads=(gd in ("int8", "int4")),
+            grad_bits=4 if gd == "int4" else 8,
             int8_delta_upload=ud.endswith("_delta"),
             delta_bits=4 if ud == "int4_delta" else 8)
         master = self._offload.initial_device_leaves(master)
@@ -488,6 +493,26 @@ class DeepSpeedEngine:
         self.optimizer = self.opt_transform
         self._offload_device_mask = device_mask
         return master
+
+    def _ensure_grad_residual(self, opt_param_sh):
+        """Device-resident error-feedback buffers for the int4 grad
+        wire: one fp32 leaf per offloaded param, laid out like the
+        grads at the export point (optimizer layout). Created once —
+        zeros, or a checkpoint staging copy — and preserved across step
+        recompiles (batch mutation), since param shapes don't change."""
+        if self._offload_grad_residual:
+            return
+        flat_p = jax.tree_util.tree_leaves(self.state.master_params)
+        flat_sh = jax.tree_util.tree_leaves(opt_param_sh)
+        pending = self._pending_grad_residual
+        res = []
+        for slot, i in enumerate(self._offload.off_idx):
+            arr = np.asarray(pending[slot], np.float32) \
+                if pending is not None \
+                else np.zeros(flat_p[i].shape, np.float32)
+            res.append(jax.device_put(arr, flat_sh[i]))
+        self._offload_grad_residual = tuple(res)
+        self._pending_grad_residual = None
 
     def init_params(self, example_batch, rng=None):
         """Initialize parameters from an example batch (flax) —
@@ -1080,7 +1105,7 @@ class DeepSpeedEngine:
             return specs
 
         def train_step(state: TrainState, batch, rng, comp_bits=(),
-                       prune_on=False):
+                       prune_on=False, grad_residual=()):
             opt = state.opt_state
             lp_params = jax.tree_util.tree_map(
                 lambda x: x.astype(compute_dtype)
@@ -1119,7 +1144,7 @@ class DeepSpeedEngine:
                        "grad_norm": gnorm.astype(jnp.float32),
                        "overflow": jnp.bool_(False),
                        "loss_scale": state.loss_scale.loss_scale}
-            return new_state, metrics, ()
+            return new_state, metrics, (), ()
 
         self._jit_train_step = jax.jit(train_step, donate_argnums=(0,),
                                        static_argnums=(3, 4))
@@ -1139,10 +1164,13 @@ class DeepSpeedEngine:
         off_mask = self._offload.mask if self._offload is not None else None
         off_int8 = self._offload._int8_grads \
             if self._offload is not None else False
+        off_bits = self._offload._grad_bits if off_int8 else None
 
         param_sh = rules.param_shardings(self.state.master_params)
         grad_sh = rules.grad_shardings(self.state.master_params)
         opt_param_sh = rules.opt_shardings(self.state.master_params)
+        if off_bits == 4:
+            self._ensure_grad_residual(opt_param_sh)
 
         # ---- ZeRO++ knobs (reference: zero/config.py zero_quantized_*,
         # partition_parameters.py:989 qwZ, coalesced_collectives qgZ) ----
@@ -1295,7 +1323,7 @@ class DeepSpeedEngine:
             return jax.tree_util.tree_unflatten(pdef, list(gflat)), loss_sum
 
         def train_step(state: TrainState, batch, rng, comp_bits=(),
-                       prune_on=False):
+                       prune_on=False, grad_residual=()):
             lp_params = compute_view(state.master_params)
             if comp_transform is not None:
                 lp_params = comp_transform(lp_params, comp_bits, prune_on)
@@ -1338,6 +1366,7 @@ class DeepSpeedEngine:
             updates, new_opt_state = opt.update(grads, state.opt_state,
                                                 state.master_params)
             off_grads = ()
+            new_grad_residual = ()
             if off_mask is not None:
                 # export the offloaded leaves' (unscaled, clipped) grads
                 # for the host Adam; their device "updates" (passed
@@ -1349,7 +1378,42 @@ class DeepSpeedEngine:
                 # could manufacture inf AFTER the overflow check and
                 # poison the host master with no skip.
                 gflat, gdef = jax.tree_util.tree_flatten(grads)
-                if off_int8:
+                if off_bits == 4:
+                    # packed-nibble wire (~0.52 B/param with scales,
+                    # half the int8 volume) against a DEVICE-resident
+                    # error-feedback residual: the step quantizes
+                    # grad+residual and keeps the rounding error on
+                    # device, so the dequantized host stream telescopes
+                    # to the true grad sum — the same error-feedback
+                    # scheme as the int4 param upload (offload.py
+                    # _delta_payload), run in the download direction
+                    # (reference role: pipelined_optimizer_swapper +
+                    # OffloadPP's reduced host wire)
+                    from ..comm.compressed import (_block_dequantize4,
+                                                   _block_quantize4)
+                    qs = []
+                    new_grad_residual = []
+                    ridx = 0
+                    for g, m in zip(gflat, off_mask):
+                        if not m:
+                            continue
+                        r = grad_residual[ridx]
+                        ridx += 1
+                        c = g.astype(jnp.float32) + r
+                        q4, sc = _block_quantize4(c)
+                        deq = _block_dequantize4(
+                            q4, sc, c.size, jnp.float32).reshape(c.shape)
+                        nr = c - deq
+                        if fp16:
+                            # overflow: the host skips this payload, and
+                            # the residual must not absorb the inf/nan
+                            # wavefront — carry the old residual forward
+                            nr = jnp.where(overflow, r, nr)
+                        new_grad_residual.append(nr)
+                        qs.extend((q4, sc))
+                    off_grads = tuple(qs)
+                    new_grad_residual = tuple(new_grad_residual)
+                elif off_int8:
                     # block-int8 wire: quarter of fp32 volume — the
                     # scales ride alongside (one fp32 per 256 block)
                     from ..comm.compressed import _block_quantize
@@ -1405,9 +1469,13 @@ class DeepSpeedEngine:
                        "grad_norm": grad_norm.astype(jnp.float32),
                        "overflow": overflow,
                        "loss_scale": new_ls.loss_scale}
-            return new_state, metrics, off_grads
+            return new_state, metrics, off_grads, new_grad_residual
 
-        self._jit_train_step = jax.jit(train_step, donate_argnums=(0,),
+        # the int4-grad residual rides as arg 5 and is donated: its
+        # buffers are rewritten every step and the caller replaces its
+        # handle with the returned tuple
+        donate = (0, 5) if off_bits == 4 else (0,)
+        self._jit_train_step = jax.jit(train_step, donate_argnums=donate,
                                        static_argnums=(3, 4))
 
     def _build_compression_transform(self):
@@ -1601,9 +1669,10 @@ class DeepSpeedEngine:
                 device_batch)
         comp_bits, prune_on = self._compression_step_args(device_batch)
         self._swap_state_in()
-        self.state, metrics, off_grads = self._jit_train_step(
-            self.state, device_batch, self._next_rng(), comp_bits,
-            prune_on)
+        self.state, metrics, off_grads, self._offload_grad_residual = \
+            self._jit_train_step(
+                self.state, device_batch, self._next_rng(), comp_bits,
+                prune_on, self._offload_grad_residual)
         self._swap_state_out()
         if self._offload is not None:
             skip = metrics["overflow"] if self.fp16_enabled else False
@@ -2091,6 +2160,11 @@ class DeepSpeedEngine:
                 payload[f"master_{i}"] = sd["master"][i]
                 payload[f"m_{i}"] = sd["m"][i]
                 payload[f"v_{i}"] = sd["v"][i]
+            # int4 grad-wire error feedback is part of the optimizer
+            # state: dropping it on resume would replay (or lose) one
+            # step's quantization residual per offloaded leaf
+            for i, r in enumerate(self._offload_grad_residual):
+                payload[f"gres_{i}"] = np.asarray(r)
             tag_dir = os.path.join(save_dir, str(tag))
             os.makedirs(tag_dir, exist_ok=True)
             np.savez(os.path.join(tag_dir,
@@ -2126,6 +2200,25 @@ class DeepSpeedEngine:
                 "master": [z[f"master_{i}"] for i in range(n)],
                 "m": [z[f"m_{i}"] for i in range(n)],
                 "v": [z[f"v_{i}"] for i in range(n)]})
+            if f"gres_{0}" in z.files and n and \
+                    self._offload._grad_bits == 4 and \
+                    self._offload._int8_grads:
+                res = [z[f"gres_{i}"] for i in range(n)]
+                if self._offload_grad_residual:
+                    self._offload_grad_residual = tuple(
+                        jax.device_put(np.asarray(a, np.float32),
+                                       r.sharding)
+                        for a, r in zip(res,
+                                        self._offload_grad_residual))
+                else:
+                    self._pending_grad_residual = res
+            elif self._offload_grad_residual:
+                # checkpoint predates the residual (or was saved with a
+                # different grad wire): stale error feedback would shift
+                # the restored masters — reset to zero
+                self._offload_grad_residual = tuple(
+                    jnp.zeros_like(r)
+                    for r in self._offload_grad_residual)
         if self._offload is not None:
             # the mirror tracks the DEVICE leaves; it must follow every
             # state replacement, not just optimizer-state reloads
@@ -2227,7 +2320,7 @@ class DeepSpeedEngine:
         comp_bits, prune_on = self._compression_eval_args()
         lowered = self._jit_train_step.lower(
             self.state, self._profile_batch_struct, self._rng,
-            comp_bits, prune_on)
+            comp_bits, prune_on, self._offload_grad_residual)
         self._flops_profile = cost_analysis_of(lowered.compile())
         return self._flops_profile
 
@@ -2253,7 +2346,7 @@ class DeepSpeedEngine:
             comp_bits, prune_on = self._compression_eval_args()
             lowered = self._jit_train_step.lower(
                 self.state, self._profile_batch_struct, self._rng,
-                comp_bits, prune_on)
+                comp_bits, prune_on, self._offload_grad_residual)
             try:
                 txt = lowered.as_text(debug_info=True)
             except TypeError:       # older jax: no debug_info kwarg

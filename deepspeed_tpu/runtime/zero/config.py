@@ -56,8 +56,11 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     # of the ZeRO-Offload paper); offloaded leaves are one step stale
     delayed_update: bool = False
     # wire dtype for the device->host grad stream: "bf16" (default;
-    # same exponent range as fp32, halves volume) or "int8" (block-
-    # quantized on device, quarter volume — for slow host links)
+    # same exponent range as fp32, halves volume), "int8" (block-
+    # quantized on device, quarter volume — for slow host links) or
+    # "int4" (two signed nibbles per byte, ~0.52 B/param with scales,
+    # quantized against a DEVICE-resident error-feedback residual so
+    # the host stream telescopes to the true grad sum)
     grad_dtype: str = "bf16"
     # wire dtype for the host->device param refresh: "bf16" (default),
     # "int8_delta" (block-int8 delta vs a device mirror with error

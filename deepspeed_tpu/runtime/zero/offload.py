@@ -94,11 +94,15 @@ class OffloadCoordinator:
                  compute_dtype, adamw_mode: bool = True,
                  nvme_path: Optional[str] = None,
                  int8_grads: bool = False,
+                 grad_bits: int = 8,
                  int8_delta_upload: bool = False,
                  delta_bits: int = 8):
         self.mask = mask
         self.compute_dtype = compute_dtype
         self._int8_grads = bool(int8_grads)
+        if grad_bits not in (4, 8):
+            raise ValueError(f"grad_bits must be 4 or 8, got {grad_bits}")
+        self._grad_bits = int(grad_bits)
         self._delta_upload = bool(int8_delta_upload)
         if delta_bits not in (4, 8):
             raise ValueError(f"delta_bits must be 4 or 8, got {delta_bits}")
@@ -232,13 +236,28 @@ class OffloadCoordinator:
     def _decode_grads(self, host) -> List[np.ndarray]:
         """Wire grads -> fp32 arrays. bf16 wire: plain cast. int8 wire:
         each entry is a (q [n_blocks, 256] int8, scales [n_blocks])
-        pair — dequantize (vectorized) and strip the padding."""
+        pair — dequantize (vectorized) and strip the padding. int4
+        wire: q packs two signed nibbles per uint8 (element 2k low,
+        2k+1 high — the device quantized grad+residual against an
+        on-device error-feedback buffer, so the stream telescopes to
+        the true grad sum over steps)."""
         if not self._int8_grads:
             return [np.asarray(g, dtype=np.float32) for g in host]
         out = []
         for slot, (q, scales) in enumerate(zip(host[0::2], host[1::2])):
-            deq = (np.asarray(q, np.float32)
-                   * np.asarray(scales, np.float32)[:, None]).reshape(-1)
+            q = np.asarray(q)
+            scales = np.asarray(scales, np.float32)
+            if self._grad_bits == 4:
+                low = (q & 0xF).astype(np.int16)
+                high = (q >> 4).astype(np.int16)
+                low = np.where(low > 7, low - 16, low)
+                high = np.where(high > 7, high - 16, high)
+                vals = np.empty((q.shape[0], q.shape[1] * 2), np.float32)
+                vals[:, 0::2] = low
+                vals[:, 1::2] = high
+            else:
+                vals = q.astype(np.float32)
+            deq = (vals * scales[:, None]).reshape(-1)
             shape = self._shapes[slot]
             out.append(deq[:int(np.prod(shape))].reshape(shape))
         return out

@@ -1,0 +1,181 @@
+"""int4 gradient DOWNLOAD wire for ZeRO-Offload (round-5 link-volume
+step: ~0.52 B/param device->host, half the int8 wire) with a
+DEVICE-resident error-feedback residual — the upload leg's telescoping
+trick (offload.py _delta_payload) run in the download direction.
+
+Reference roles: swap_tensor/pipelined_optimizer_swapper.py grad
+streaming + the OffloadPP reduced host wire (blogs/deepspeed-offloadpp).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.compressed import (_block_dequantize4,
+                                           _block_quantize4)
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.parallel.mesh import mesh_manager
+
+
+def _config(grad_dtype="bf16", **offload_extra):
+    cfg = {"train_micro_batch_size_per_gpu": 4,
+           "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "AdamW",
+                         "params": {"lr": 1e-3, "weight_decay": 0.01}},
+           "bf16": {"enabled": True},
+           "zero_optimization": {
+               "stage": 2,
+               "offload_optimizer": {"device": "cpu",
+                                     "grad_dtype": grad_dtype,
+                                     **offload_extra}},
+           "gradient_clipping": 1.0,
+           "steps_per_print": 0}
+    return cfg
+
+
+def _train(config, steps=10, seed=0):
+    mesh_manager.reset()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(GPT2Config.tiny()), config=config)
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 256, size=(engine.train_batch_size(), 16),
+                       dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    return engine, [float(engine.train_batch(batch=batch))
+                    for _ in range(steps)]
+
+
+def test_quantize4_roundtrip_matches_host_decode(rng):
+    """The device pack and the coordinator's host unpack are exact
+    inverses of the same nibble convention (element 2k low, 2k+1 high)."""
+    from deepspeed_tpu.runtime.zero.offload import OffloadCoordinator
+    x = rng.standard_normal(1000).astype(np.float32)
+    q4, sc = _block_quantize4(jnp.asarray(x))
+    assert np.asarray(q4).dtype == np.uint8
+    assert q4.shape == (4, 128)          # 1000 -> 4 blocks, packed half
+    dev = np.asarray(_block_dequantize4(q4, sc, 1000, jnp.float32))
+
+    co = OffloadCoordinator.__new__(OffloadCoordinator)
+    co._int8_grads = True
+    co._grad_bits = 4
+    co._shapes = [(1000,)]
+    host = co._decode_grads([np.asarray(q4), np.asarray(sc)])
+    np.testing.assert_array_equal(host[0], dev)
+    # quantization error bounded by half a step (per-block amax / 7)
+    g = np.pad(x, (0, 24)).reshape(4, 256)
+    amax = np.abs(g).max(axis=1, keepdims=True)
+    bound = (amax / 7.0) * 0.5 + 1e-7
+    err = np.abs(np.pad(dev, (0, 24)).reshape(4, 256) - g)
+    assert (err <= bound).all()
+
+
+def test_error_feedback_telescopes(rng):
+    """sum of dequantized payloads == sum of true grads - final
+    residual: the host stream loses NOTHING over steps except the one
+    in-flight residual (the invariant that makes a 4-bit wire safe)."""
+    g_sum = np.zeros(777, np.float32)
+    deq_sum = np.zeros(777, np.float32)
+    r = jnp.zeros(777, jnp.float32)
+    for _ in range(12):
+        g = rng.standard_normal(777).astype(np.float32) * 1e-2
+        c = jnp.asarray(g) + r
+        q4, sc = _block_quantize4(c)
+        deq = _block_dequantize4(q4, sc, 777, jnp.float32)
+        r = c - deq
+        g_sum += g
+        deq_sum += np.asarray(deq)
+    np.testing.assert_allclose(deq_sum, g_sum - np.asarray(r),
+                               atol=1e-5)
+    # the residual itself stays bounded by one quantization step
+    assert float(jnp.abs(r).max()) < 0.05
+
+
+def test_int4_grads_parity_with_bf16_wire(eight_devices):
+    """Error feedback keeps the int4 grad wire's trajectory on the
+    uncompressed wire's curve to rounding noise."""
+    _, ref = _train(_config("bf16"), steps=10)
+    _, got = _train(_config("int4"), steps=10)
+    # coarser than the int8 wire's 5e-3: the EF stream preserves the
+    # grad SUM exactly, but Adam is nonlinear in the per-step grads,
+    # so 4-bit rounding shows up as a small trajectory wobble
+    np.testing.assert_allclose(got, ref, atol=2e-2)
+    assert got[-1] < got[0]
+
+
+def test_wire_payload_is_packed_nibbles(eight_devices):
+    """The device->host stream actually carries uint8 nibble pairs of
+    ~half the int8 volume (plus one fp32 scale per 256-block)."""
+    engine, _ = _train(_config("int4"), steps=1)
+    captured = {}
+    orig = engine._offload.apply_grads
+
+    def spy(state_master, off_grads, lr, skip=False):
+        captured["wire"] = [np.asarray(x) for x in off_grads]
+        return orig(state_master, off_grads, lr=lr, skip=skip)
+
+    engine._offload.apply_grads = spy
+    ids = np.zeros((engine.train_batch_size(), 16), np.int32)
+    engine.train_batch(batch={"input_ids": ids, "labels": ids})
+    wire = captured["wire"]
+    assert wire and len(wire) == 2 * len(engine._offload.off_idx)
+    total_bytes = sum(a.nbytes for a in wire)
+    n_off = sum(int(np.prod(s)) for s in engine._offload._shapes)
+    for q4, sc in zip(wire[0::2], wire[1::2]):
+        assert q4.dtype == np.uint8
+        assert sc.dtype == np.float32
+        assert q4.shape[1] == 128        # 256-block packed in half
+    # ~0.52 B/param incl. scales; block padding adds a little
+    assert total_bytes < 0.6 * n_off
+
+
+def test_residual_lives_on_device_and_moves(eight_devices):
+    engine, _ = _train(_config("int4"), steps=3)
+    res = engine._offload_grad_residual
+    assert len(res) == len(engine._offload.off_idx)
+    flat = jax.tree_util.tree_leaves(engine.state.master_params)
+    for r, i in zip(res, engine._offload.off_idx):
+        assert isinstance(r, jax.Array)
+        assert r.shape == flat[i].shape and r.dtype == jnp.float32
+    # after real steps the residual carries live rounding error
+    assert any(float(jnp.abs(r).max()) > 0 for r in res)
+
+
+def test_checkpoint_roundtrips_residual(eight_devices, tmp_path):
+    """The residual is optimizer state: a resume must restore it
+    bit-for-bit, or the stream would replay/lose one step's rounding."""
+    engine, _ = _train(_config("int4"), steps=4)
+    saved = [np.asarray(r) for r in engine._offload_grad_residual]
+    engine.save_checkpoint(str(tmp_path))
+    # keep training so the live residual moves past the checkpoint
+    ids = np.zeros((engine.train_batch_size(), 16), np.int32)
+    b = {"input_ids": ids, "labels": ids}
+    engine.train_batch(batch=b)
+    assert any(not np.array_equal(np.asarray(r), s) for r, s in
+               zip(engine._offload_grad_residual, saved))
+    engine.load_checkpoint(str(tmp_path))
+    for r, s in zip(engine._offload_grad_residual, saved):
+        np.testing.assert_array_equal(np.asarray(r), s)
+    losses = [float(engine.train_batch(batch=b)) for _ in range(3)]
+    assert np.isfinite(losses).all()
+
+
+def test_int4_composes_with_delta_upload_and_dpu(eight_devices):
+    """The full config-4 wire: int4 grads down + int4 deltas up +
+    delayed update still converges on the bf16 trajectory."""
+    _, ref = _train(_config("bf16"), steps=10)
+    _, got = _train(_config("int4", upload_dtype="int4_delta",
+                            delayed_update=True), steps=10)
+    # DPU trails one step; compare the settled tail loosely
+    np.testing.assert_allclose(got[3:], ref[3:], rtol=0.15)
+    assert got[-1] < got[0]
+
+
+def test_unknown_grad_dtype_rejected(eight_devices):
+    mesh_manager.reset()
+    with pytest.raises(ValueError, match="grad_dtype"):
+        deepspeed_tpu.initialize(
+            model=GPT2LMHeadModel(GPT2Config.tiny()),
+            config=_config("int2"))

@@ -1,0 +1,86 @@
+"""Decode-shape WOQ matmul A/B on the real chip: dense bf16 vs
+XLA dequant-in-jit (status quo) vs the Pallas woq_matmul kernel.
+
+Shapes mimic the config-5 bench: Llama-7B geometry, B=16 decode.
+Each variant runs a scan of DEPTH chained matmuls (like a decode step
+walking the layer stack) so weight reads dominate, timed over ITERS
+dispatches.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.quantization import quantize_weight
+from deepspeed_tpu.ops.pallas_kernels.woq_matmul import (
+    woq_matmul, woq_matmul_reference)
+
+B, K, N, DEPTH, ITERS = 16, 4096, 11008, 8, 20
+
+
+def time_it(fn, *args):
+    np.asarray(fn(*args))       # compile + settle; HARD barrier
+    ts = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))   # device->host copy forces completion
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def main():
+    rng = np.random.default_rng(0)
+    ws = [jnp.asarray(rng.standard_normal((K, N)), jnp.bfloat16) * 0.02
+          for _ in range(DEPTH)]
+    # chain shape-compatible: use W then W.T alternately via two dots
+    x = jnp.asarray(rng.standard_normal((B, K)), jnp.bfloat16)
+    leaves = [quantize_weight(w, 8, 128) for w in ws]
+    qs = [l["woq_q"] for l in leaves]
+    ss = [l["woq_scales"] for l in leaves]
+
+    @jax.jit
+    def dense(x, ws):
+        def step(c, w):
+            y = jax.lax.dot_general(c, w, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            return y[:, :K].astype(jnp.bfloat16), ()
+        c, _ = jax.lax.scan(step, x, jnp.stack(ws))
+        return c
+
+    @jax.jit
+    def xla_deq(x, qs, ss):
+        def step(c, qw):
+            q, s = qw
+            y = woq_matmul_reference(c, q, s, jnp.bfloat16)
+            return y[:, :K], ()
+        c, _ = jax.lax.scan(step, x, (jnp.stack(qs), jnp.stack(ss)))
+        return c
+
+    @jax.jit
+    def pallas(x, qs, ss):
+        def step(c, qw):
+            q, s = qw
+            y = woq_matmul(c, q, s, jnp.bfloat16)
+            return y[:, :K], ()
+        c, _ = jax.lax.scan(step, x, (jnp.stack(qs), jnp.stack(ss)))
+        return c
+
+    bytes_bf16 = DEPTH * K * N * 2
+    bytes_int8 = DEPTH * K * N * 1
+    for name, fn, args, byt in [
+            ("dense_bf16", dense, (x, ws), bytes_bf16),
+            ("xla_dequant", xla_deq, (x, qs, ss), bytes_int8),
+            ("pallas_woq", pallas, (x, qs, ss), bytes_int8)]:
+        t = time_it(fn, *args)
+        print(f"{name:12s} {t*1e3:8.3f} ms  "
+              f"{byt/t/1e9:7.1f} GB/s effective-weight-read")
+
+
+if __name__ == "__main__":
+    main()
