@@ -79,14 +79,21 @@ class InferenceEngine:
         bits = woq_bits_from_dtype(self._config.dtype)
         if bits is not None:
             self._woq_bits = bits
-            inner_apply = self._apply_fn
-            act_dtype = self.dtype
+            if not getattr(model, "woq_native", False):
+                # fallback for models without WOQ-aware denses: whole-
+                # tree dequant inside the jit. NOTE this reads MORE HBM
+                # than dense bf16 at decode (XLA materializes the bf16
+                # copy); woq_native models consume the packed tree
+                # through the fused Pallas matmul instead.
+                inner_apply = self._apply_fn
+                act_dtype = self.dtype
 
-            def woq_apply(params, *a, **kw):
-                return inner_apply(
-                    dequantize_param_tree(params, act_dtype), *a, **kw)
+                def woq_apply(params, *a, **kw):
+                    return inner_apply(
+                        dequantize_param_tree(params, act_dtype),
+                        *a, **kw)
 
-            self._apply_fn = woq_apply
+                self._apply_fn = woq_apply
 
         tensor_rules = getattr(model, "tensor_sharding_rules", None)
         self._rules = ZeroShardingRules(mesh=self.mesh, stage=0,

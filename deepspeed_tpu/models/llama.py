@@ -97,7 +97,11 @@ class RMSNorm(nn.Module):
 
 
 def _dense(cfg, features, name, use_bias=False):
-    return nn.Dense(features, use_bias=use_bias, name=name,
+    # WOQ-aware: identical to nn.Dense for dense kernels; a quantized
+    # param tree (int8/int4 serving) routes through the fused Pallas
+    # weight-only matmul (ops/pallas_kernels/woq_matmul.py)
+    from .woq_dense import WOQDense
+    return WOQDense(features, use_bias=use_bias, name=name,
                     kernel_init=nn.initializers.normal(cfg.initializer_range))
 
 
@@ -239,6 +243,10 @@ class LlamaBlock(nn.Module):
 
 class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
+    # every projection runs through the WOQ-aware dense: the inference
+    # engine can hand this model a quantized param tree directly and
+    # skip the whole-tree dequant wrapper
+    woq_native = True
 
     @nn.compact
     def __call__(self, input_ids, labels=None, positions=None,

@@ -1,0 +1,62 @@
+"""WOQ-aware Dense — serves quantized weights through the fused
+Pallas matmul.
+
+Reference role: module_inject's quantized linear containers
+(module_inject/replace_module.py:43 GroupQuantizer consumed by the
+injected DeepSpeedTransformer layers) and the weight-only GEMMs
+(inference/v2/kernels/core_ops/cuda_linear/fp6_linear.cu:1).
+
+The param tree decides the path: a dense ``kernel`` array behaves
+exactly like flax ``nn.Dense`` (training, init, and unquantized
+serving are bit-identical); a ``kernel`` slot holding a WOQ leaf
+({"woq_q", "woq_scales"}, produced by
+inference.quantization.quantize_param_tree) routes through
+``woq_matmul`` — decode-shape calls hit the Pallas kernel and read
+int8 HBM, large-M calls take the dequantize-then-dot path."""
+
+from collections.abc import Mapping
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.pallas_kernels.woq_matmul import woq_matmul
+
+
+class WOQDense(nn.Module):
+    features: int
+    use_bias: bool = True
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, inputs):
+        woq = None
+        if not self.is_initializing() and \
+                self.has_variable("params", "kernel"):
+            v = self.get_variable("params", "kernel")
+            # Mapping (not dict): flax.core.freeze trees are FrozenDict
+            if isinstance(v, Mapping) and "woq_q" in v:
+                woq = v
+        if woq is not None:
+            y = woq_matmul(inputs, woq["woq_q"], woq["woq_scales"],
+                           out_dtype=inputs.dtype)
+            if self.use_bias:
+                b = self.get_variable("params", "bias")
+                y = y + jnp.asarray(b, y.dtype)
+            return y
+        # dense path: nn.Dense's exact formulation so training and
+        # unquantized serving lower to the same HLO as before
+        kernel = self.param("kernel", self.kernel_init,
+                            (jnp.shape(inputs)[-1], self.features))
+        bias = self.param("bias", self.bias_init, (self.features,)) \
+            if self.use_bias else None
+        inputs, kernel, bias = nn.dtypes.promote_dtype(
+            inputs, kernel, bias, dtype=self.dtype)
+        y = jax.lax.dot_general(
+            inputs, kernel, (((inputs.ndim - 1,), (0,)), ((), ())))
+        if bias is not None:
+            y = y + jnp.reshape(bias, (1,) * (y.ndim - 1) + (-1,))
+        return y

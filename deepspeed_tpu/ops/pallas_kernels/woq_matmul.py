@@ -44,6 +44,11 @@ def woq_matmul_reference(x, q, scales, out_dtype=None):
 
 
 def _kernel(s_ref, x_ref, q_ref, o_ref, acc_ref, *, n_kblocks):
+    # grid is (n, k) with the k reduction INNERMOST: an output block's
+    # scratch accumulator is only valid across CONSECUTIVE grid steps,
+    # so the reduction must complete before the n index moves on (a
+    # k-outer ordering accumulates into stale/flushed blocks on real
+    # hardware — caught on-chip, invisible to interpret mode)
     k = pl.program_id(1)
 
     @pl.when(k == 0)
@@ -124,7 +129,7 @@ def woq_matmul(x, q, scales, out_dtype=None, force_pallas=False,
     kdim, n = int(q.shape[0]), int(q.shape[1])
     groups = int(scales.shape[-1])
     gs = n // groups
-    bk = _pick_block(kdim, (512, 256, 128))
+    bk = _pick_block(kdim, (1024, 512, 256, 128))
     bn_cands = [c for c in (512, 256, 128) if gs % c == 0 or gs == n]
     bn = next((c for c in bn_cands if n % c == 0), None)
     if bk is None or bn is None:

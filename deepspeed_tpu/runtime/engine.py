@@ -2212,13 +2212,16 @@ class DeepSpeedEngine:
                                         self._offload_grad_residual))
                 else:
                     self._pending_grad_residual = res
-            elif self._offload_grad_residual:
+            else:
                 # checkpoint predates the residual (or was saved with a
-                # different grad wire): stale error feedback would shift
-                # the restored masters — reset to zero
-                self._offload_grad_residual = tuple(
-                    jnp.zeros_like(r)
-                    for r in self._offload_grad_residual)
+                # different grad wire): stale error feedback — live OR
+                # staged by an earlier load — would shift the restored
+                # masters; reset to zero
+                self._pending_grad_residual = None
+                if self._offload_grad_residual:
+                    self._offload_grad_residual = tuple(
+                        jnp.zeros_like(r)
+                        for r in self._offload_grad_residual)
         if self._offload is not None:
             # the mirror tracks the DEVICE leaves; it must follow every
             # state replacement, not just optimizer-state reloads

@@ -6,16 +6,25 @@ runtime/pipe/schedule.py:189) with explicit p2p send/recv between stage
 processes (pipe/p2p.py:50-165) and hand-written forward/backward passes
 per microbatch.
 
-TPU-native re-design: ONE SPMD program. The schedule is a
-``lax.scan`` over M + P - 1 ticks; at each tick every stage applies its
-block stack to the activation it holds and ``ppermute``s the result to
-the next stage (a nearest-neighbour ICI hop — the wire pattern the
-reference's p2p.send implements with NCCL). Reverse-mode AD through the
-scan + ppermute yields the mirrored backward schedule automatically — no
-instruction map, no _exec_* methods, no grad buffers. Activation memory
-is bounded via ``jax.checkpoint`` around the per-tick stage body
-(rematerialize in backward), giving the 1F1B memory profile with the
-GPipe wire schedule.
+TPU-native re-design: ONE SPMD program, two selectable schedules
+(``PipelineModule(schedule=...)``):
+
+- ``"1f1b"`` (default — TrainSchedule parity): a ``lax.scan`` over
+  M + 2(P-1) ticks where EVERY tick runs a forward slot (microbatch
+  ``t - s``, activation ppermutes +1) AND a backward slot (microbatch
+  ``t - 2(P-1) + s``, input-cotangent ppermutes -1). The backward slot
+  recomputes its stage from the saved stage INPUT via ``jax.vjp``
+  inside the tick, and gradients accumulate in fp32 across ticks —
+  at most 2(P-s)-1 activations are live per stage (O(P), independent
+  of M). The schedule's grads reach the engine's autodiff through a
+  ``jax.custom_vjp``, so ZeRO/fp16/clipping compose unchanged. See
+  ``_apply_1f1b``.
+- ``"gpipe"``: a ``lax.scan`` over M + P - 1 forward ticks;
+  reverse-mode AD through the scan + ppermute yields the mirrored
+  backward schedule automatically — no instruction map, no _exec_*
+  methods, no grad buffers. Activation memory is bounded via
+  ``jax.checkpoint`` around the per-tick stage body (O(M) scan
+  carries remain; remat removes the within-stage internals).
 
 Stage composition rule: the pipelined layer run must be homogeneous
 (identical LayerSpec typename/arguments) so all stages execute one
@@ -122,6 +131,7 @@ class _PipelinedLM:
         self.num_stages = num_stages
         self.num_microbatches = num_microbatches
         self.remat = remat
+        self.schedule = getattr(module, "schedule", "1f1b")
         self.loss_fn = module.loss_fn
         self._split_roles()
         self._assign_stage_counts()
@@ -280,6 +290,9 @@ class _PipelinedLM:
         else:
             y = jnp.zeros((1,), jnp.int32)  # placeholder arg (unused)
 
+        if labels is not None and self.schedule == "1f1b":
+            return self._apply_1f1b(params, toks, y)
+
         block_mod = self.block_mod
         pre = list(zip(self.pre_specs, self.pre_mods))
         post = list(zip(self.post_specs, self.post_mods))
@@ -392,12 +405,203 @@ class _PipelinedLM:
         return jax.jit(fn)(params["blocks"], toks, y,
                            *pre_params, *post_params)
 
+    # -- 1F1B training schedule ------------------------------------------
+    def _apply_1f1b(self, params, toks, y):
+        """TrainSchedule semantics (reference runtime/pipe/schedule.py:189)
+        as ONE SPMD program: every tick has a FORWARD slot and a
+        BACKWARD slot. At tick t, stage s runs the forward of microbatch
+        ``mf = t - s`` and the backward of ``mb = t - 2(P-1) + s`` (when
+        in range); forward activations hop +1 over the pipe axis, input
+        cotangents hop -1. The backward recomputes the stage from its
+        SAVED INPUT via ``jax.vjp`` inside the tick — so at most
+        ``2(P-s)-1`` activations are ever live per stage (O(P), vs the
+        GPipe path's O(M) scan carries), which is 1F1B's memory claim.
+        Gradients accumulate across ticks in fp32 and leave the
+        schedule directly — the engine's autodiff picks them up through
+        a ``jax.custom_vjp`` wrapper, so ZeRO/fp16/clipping machinery
+        is unchanged."""
+        M = self.num_microbatches
+        mesh = mesh_manager.mesh
+        block_mod = self.block_mod
+        pre = list(zip(self.pre_specs, self.pre_mods))
+        post = list(zip(self.post_specs, self.post_mods))
+        pre_params = tuple(params[k] for k in self.pre_keys)
+        post_params = tuple(params[k] for k in self.post_keys)
+        k_counts = np.asarray(self.stage_block_counts, np.int32)
+        max_k = self.max_layers_per_stage
+        apply_layer = self._apply_layer
+        loss_fn = self.loss_fn
+
+        def inject(tok, pre_ps):
+            h = tok
+            for (spec, m), pp in zip(pre, pre_ps):
+                h = apply_layer(spec, m, pp, h)
+            return h
+
+        def collect(act, post_ps):
+            o = act
+            for (spec, m), pp in zip(post, post_ps):
+                o = apply_layer(spec, m, pp, o)
+            return o
+
+        def body(block_params, toks, y, pre_ps, post_ps):
+            bp = jax.tree_util.tree_map(lambda v: v[0], block_params)
+            nstages = jax.lax.axis_size(PIPE_AXIS)
+            stage = jax.lax.axis_index(PIPE_AXIS)
+            k_s = jnp.asarray(k_counts)[stage]
+            fwd_perm = [(i, i + 1) for i in range(nstages - 1)]
+            bwd_perm = [(i, i - 1) for i in range(1, nstages)]
+            P_ = nstages
+            T = M + 2 * (P_ - 1)
+            S = 2 * P_ - 1          # saved-input ring depth
+
+            def run_blocks(bp_, a):
+                def one_layer(h, xs):
+                    lp, li = xs
+                    new = block_mod.apply({"params": lp}, h)
+                    return jnp.where(li < k_s, new, h), None
+                out, _ = jax.lax.scan(one_layer, a,
+                                      (bp_, jnp.arange(max_k)))
+                return out
+
+            def stage_forward(bp_, pre_, post_, a_raw, tok, yv):
+                a1 = jax.lax.cond(
+                    stage == 0,
+                    lambda: inject(tok, pre_).astype(a_raw.dtype),
+                    lambda: a_raw)
+                o = run_blocks(bp_, a1)
+                l = jax.lax.cond(
+                    stage == nstages - 1,
+                    lambda: loss_fn(collect(o, post_),
+                                    yv).astype(jnp.float32),
+                    lambda: jnp.float32(0.0))
+                return o, l
+
+            act_sd = jax.eval_shape(
+                lambda t: inject(t, pre_ps), toks[0])
+            zero_act = jnp.zeros(act_sd.shape, act_sd.dtype)
+            f32z = lambda t: jax.tree_util.tree_map(
+                lambda v: jnp.zeros(v.shape, jnp.float32), t)
+            carry0 = (zero_act,                       # fwd message
+                      zero_act,                       # bwd message (cot)
+                      jnp.zeros((S,) + act_sd.shape, act_sd.dtype),
+                      f32z(bp), f32z(pre_ps), f32z(post_ps),
+                      jnp.float32(0.0))
+
+            def tick(carry, t):
+                fwd_in, bwd_in, buf, gb, gpre, gpost, loss = carry
+                # ---- forward slot: microbatch mf = t - s ----
+                mf = t - stage
+                f_valid = (mf >= 0) & (mf < M)
+                mf_c = jnp.clip(mf, 0, M - 1)
+                tok_f = jax.lax.dynamic_index_in_dim(
+                    toks, mf_c, 0, keepdims=False)
+                y_f = jax.lax.dynamic_index_in_dim(
+                    y, mf_c, 0, keepdims=False)
+                o_f, l_f = stage_forward(bp, pre_ps, post_ps,
+                                         fwd_in, tok_f, y_f)
+                buf = jax.lax.dynamic_update_index_in_dim(
+                    buf, fwd_in, jnp.mod(t, S), 0)
+                loss = loss + jnp.where(f_valid, l_f, 0.0)
+                fwd_out = jax.lax.ppermute(o_f, PIPE_AXIS, fwd_perm)
+
+                # ---- backward slot: mb = t - 2(P-1) + s ----
+                mb = t - 2 * (P_ - 1) + stage
+                b_valid = (mb >= 0) & (mb < M)
+                mb_c = jnp.clip(mb, 0, M - 1)
+                tok_b = jax.lax.dynamic_index_in_dim(
+                    toks, mb_c, 0, keepdims=False)
+                y_b = jax.lax.dynamic_index_in_dim(
+                    y, mb_c, 0, keepdims=False)
+                # the input saved by mb's forward (tick mb + s)
+                a_saved = jax.lax.dynamic_index_in_dim(
+                    buf, jnp.mod(mb_c + stage, S), 0, keepdims=False)
+                _, vjp_fn = jax.vjp(
+                    lambda bp_, pre_, post_, a_: stage_forward(
+                        bp_, pre_, post_, a_, tok_b, y_b),
+                    bp, pre_ps, post_ps, a_saved)
+                # output cotangent: from the next stage's backward,
+                # except the last stage, whose gradient source is its
+                # own loss term (d total/d l_m = 1/M rides the l output)
+                ct_o = jnp.where(stage == nstages - 1,
+                                 jnp.zeros_like(zero_act), bwd_in)
+                dbp, dpre, dpost, da = vjp_fn(
+                    (ct_o, jnp.float32(1.0 / M)))
+                acc = lambda G, D: jax.tree_util.tree_map(
+                    lambda g, d: g + jnp.where(b_valid,
+                                               d.astype(g.dtype), 0.0),
+                    G, D)
+                gb, gpre, gpost = acc(gb, dbp), acc(gpre, dpre), \
+                    acc(gpost, dpost)
+                bwd_out = jax.lax.ppermute(da.astype(zero_act.dtype),
+                                           PIPE_AXIS, bwd_perm)
+                return (fwd_out, bwd_out, buf, gb, gpre, gpost,
+                        loss), None
+
+            (_, _, _, gb, gpre, gpost, loss), _ = jax.lax.scan(
+                tick, carry0, jnp.arange(T))
+            loss_mean = _last_stage_scalar(loss / M)
+            # pre/post params entered replicated: their grads sum over
+            # the pipe axis (this is also the tied-weight allreduce —
+            # a TiedLayerSpec's embed grad on stage 0 meets its head
+            # grad on the last stage here)
+            gpre = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, PIPE_AXIS), gpre)
+            gpost = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, PIPE_AXIS), gpost)
+            gb = jax.tree_util.tree_map(lambda g: g[None], gb)
+            return loss_mean, gb, gpre, gpost
+
+        in_specs = (P(PIPE_AXIS), P(), P(), P(), P())
+        out_specs = (P(), P(PIPE_AXIS), P(), P())
+        fn = shard_map(body, mesh=mesh, axis_names={PIPE_AXIS},
+                       in_specs=in_specs, out_specs=out_specs,
+                       check_vma=False)
+
+        blocks_p = params["blocks"]
+        toks_shape, y_shape = toks.shape, y.shape
+        # primal dtypes are static at trace time; the bwd rule must
+        # return cotangents in exactly these dtypes
+        dtypes = tuple(jax.tree_util.tree_map(lambda v: v.dtype, t)
+                       for t in (blocks_p, pre_params, post_params))
+
+        @jax.custom_vjp
+        def pipelined_loss(blocks_p, pre_ps, post_ps, toks, y):
+            loss, _, _, _ = jax.jit(fn)(blocks_p, toks, y,
+                                        pre_ps, post_ps)
+            return loss
+
+        def fwd_rule(blocks_p, pre_ps, post_ps, toks, y):
+            loss, gbl, gpre, gpost = jax.jit(fn)(
+                blocks_p, toks, y, pre_ps, post_ps)
+            return loss, (gbl, gpre, gpost)
+
+        def bwd_rule(res, ct):
+            gbl, gpre, gpost = res
+            mul = lambda G, D: jax.tree_util.tree_map(
+                lambda g, dt: (g * ct).astype(dt), G, D)
+            # toks/y are integer primals -> float0 cotangents
+            f0 = lambda shape: np.zeros(shape, jax.dtypes.float0)
+            return (mul(gbl, dtypes[0]), mul(gpre, dtypes[1]),
+                    mul(gpost, dtypes[2]), f0(toks_shape), f0(y_shape))
+
+        pipelined_loss.defvjp(fwd_rule, bwd_rule)
+        return pipelined_loss(blocks_p, pre_params, post_params,
+                              toks, y)
+
     def tensor_sharding_rules(self, name, shape):
         # Match only the wrapper's own top-level "blocks" collection
         # (leaf paths look like "params.blocks.<module>.<leaf>"); a user
         # submodule that happens to be named blocks (params.post_0.blocks
         # ...) must NOT be pipe-sharded.
         if name.startswith("blocks.") or name.startswith("params.blocks."):
+            tr = getattr(self.module, "tensor_rules", None)
+            if tr is not None and len(shape) > 2:
+                # leaf is [stages, layers, *per-layer]; the user rule
+                # sees the per-layer view and we prepend the pipe dims
+                sub = tr(name.split("blocks.", 1)[1], tuple(shape[2:]))
+                if sub is not None:
+                    return P(PIPE_AXIS, None, *tuple(sub))
             return P(PIPE_AXIS)
         return None
 

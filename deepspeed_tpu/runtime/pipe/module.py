@@ -48,13 +48,32 @@ class PipelineModule:
                  loss_fn: Optional[Callable] = None,
                  partition_method: str = "parameters",
                  activation_checkpoint_interval: int = 0,
-                 layer_weights: Optional[List[int]] = None):
+                 layer_weights: Optional[List[int]] = None,
+                 schedule: str = "1f1b",
+                 tensor_rules: Optional[Callable] = None):
         self.layer_specs = list(layers)
         self.num_stages = num_stages or 1
         self.loss_fn = loss_fn
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
         self._layer_weights = layer_weights
+        # training schedule (reference runtime/pipe/schedule.py): "1f1b"
+        # (TrainSchedule semantics — backward interleaved one tick after
+        # the forward drains, O(stages) in-flight activations) or
+        # "gpipe" (all forwards then AD-mirrored backwards, activation
+        # memory bounded by remat instead)
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"schedule must be '1f1b' or 'gpipe', "
+                             f"got {schedule!r}")
+        self.schedule = schedule
+        # optional TP layout for BLOCK-layer leaves: (per-layer leaf
+        # name, per-layer shape) -> PartitionSpec over model axes; the
+        # engine prepends the [stage, layer] pipe dims. Inside the pipe
+        # shard_map only the pipe axis is manual — tensor stays auto,
+        # so GSPMD runs the block matmuls tensor-parallel and inserts
+        # the collectives (the reference composes PP x TP the same way
+        # structurally, runtime/pipe/topology.py:244 ProcessTopology)
+        self.tensor_rules = tensor_rules
         self.parts = self._partition_layers()
 
     def _partition_layers(self):

@@ -198,38 +198,74 @@ class OffloadCoordinator:
         return jax.tree_util.tree_unflatten(treedef, flat)
 
     def _host_step(self, off_grads, lr, skip, shardings) -> Optional[list]:
-        """Blocking host path: one batched device->host fetch of the
-        step's grads (ONE sync instead of a per-leaf np.asarray chain),
-        SIMD Adam, compute-dtype payloads back to device. Returns the
-        device leaves to merge, or None when skipped.
+        """Host path: grads device->host, host Adam, compute-dtype
+        payloads back to device. Returns the device leaves to merge, or
+        None when skipped.
+
+        DRAM tier: PER-LEAF pipelined (reference:
+        swap_tensor/pipelined_optimizer_swapper.py) — all D2H copies
+        start streaming up front, then each leaf's wait -> Adam ->
+        upload runs while later leaves' downloads (and earlier leaves'
+        uploads) are still in flight, so the wall clock approaches the
+        slower DIRECTION of the wire rather than the sum of both plus
+        the Adam.
 
         ``skip`` may be a device boolean — it is forced here, so in the
         delayed-update mode the main thread never blocks on it."""
         if skip is not None and bool(skip):
             return None
-        t0 = time.perf_counter()
-        host = jax.device_get(list(off_grads))
-        np_grads = self._decode_grads(host)
-        t1 = time.perf_counter()
         if self.store is not None:
+            t0 = time.perf_counter()
+            host = jax.device_get(list(off_grads))
+            np_grads = self._decode_grads(host)
+            t1 = time.perf_counter()
             leaves = self._nvme_step(np_grads, lr, shardings)
-            t2 = t3 = time.perf_counter()   # nvme path times internally
-        else:
-            self.host_adam.step(np_grads, lr=lr)
+            self.last_breakdown = {
+                "grad_d2h_ms": (t1 - t0) * 1e3,
+                "host_adam_ms": (time.perf_counter() - t1) * 1e3,
+                "param_h2d_ms": 0.0,    # nvme path paces its own IO
+            }
+            return leaves
+        ha = self.host_adam
+        n = len(self.off_idx)
+        per_leaf = 2 if self._int8_grads else 1
+        for e in off_grads:             # start every D2H copy streaming
+            try:
+                e.copy_to_host_async()
+            except Exception:           # platform without async copies
+                pass
+        step_count = ha.step_count + 1
+        t_d2h = t_adam = t_h2d = 0.0
+        leaves = []
+        for slot in range(n):
+            t0 = time.perf_counter()
+            entry = [np.asarray(x) for x in
+                     off_grads[slot * per_leaf:(slot + 1) * per_leaf]]
+            g = self._decode_entry(slot, entry)
+            t1 = time.perf_counter()
+            ha.step_arrays(ha.master[slot], g, ha.m[slot], ha.v[slot],
+                           lr, step_count)
             t2 = time.perf_counter()
             if self._delta_upload:
-                leaves = [self._delta_payload(slot, shardings[slot])
-                          for slot in range(len(self.off_idx))]
+                leaves.append(self._delta_payload(slot, shardings[slot]))
             else:
-                leaves = [self._device_payload(
-                    self.host_adam.master[slot], shardings[slot])
-                    for slot in range(len(self.off_idx))]
-            jax.block_until_ready(jax.tree_util.tree_leaves(leaves))
+                leaves.append(self._device_payload(ha.master[slot],
+                                                   shardings[slot]))
             t3 = time.perf_counter()
+            t_d2h += t1 - t0
+            t_adam += t2 - t1
+            t_h2d += t3 - t2
+        ha.step_count = step_count
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.tree_util.tree_leaves(leaves))
+        t_h2d += time.perf_counter() - t0
+        # legs overlap now: each bucket is the time the host THREAD
+        # spent in that phase (waits included), so the sum still equals
+        # the host path's wall clock
         self.last_breakdown = {
-            "grad_d2h_ms": (t1 - t0) * 1e3,
-            "host_adam_ms": (t2 - t1) * 1e3,
-            "param_h2d_ms": (t3 - t2) * 1e3,
+            "grad_d2h_ms": t_d2h * 1e3,
+            "host_adam_ms": t_adam * 1e3,
+            "param_h2d_ms": t_h2d * 1e3,
         }
         return leaves
 
@@ -243,24 +279,29 @@ class OffloadCoordinator:
         the true grad sum over steps)."""
         if not self._int8_grads:
             return [np.asarray(g, dtype=np.float32) for g in host]
-        out = []
-        for slot, (q, scales) in enumerate(zip(host[0::2], host[1::2])):
-            q = np.asarray(q)
-            scales = np.asarray(scales, np.float32)
-            if self._grad_bits == 4:
-                low = (q & 0xF).astype(np.int16)
-                high = (q >> 4).astype(np.int16)
-                low = np.where(low > 7, low - 16, low)
-                high = np.where(high > 7, high - 16, high)
-                vals = np.empty((q.shape[0], q.shape[1] * 2), np.float32)
-                vals[:, 0::2] = low
-                vals[:, 1::2] = high
-            else:
-                vals = q.astype(np.float32)
-            deq = (vals * scales[:, None]).reshape(-1)
-            shape = self._shapes[slot]
-            out.append(deq[:int(np.prod(shape))].reshape(shape))
-        return out
+        return [self._decode_entry(slot, [q, s]) for slot, (q, s)
+                in enumerate(zip(host[0::2], host[1::2]))]
+
+    def _decode_entry(self, slot: int, entry) -> np.ndarray:
+        """One leaf's wire entry -> fp32 grad array (see _decode_grads
+        for the wire formats)."""
+        if not self._int8_grads:
+            return np.asarray(entry[0], dtype=np.float32)
+        q = np.asarray(entry[0])
+        scales = np.asarray(entry[1], np.float32)
+        if self._grad_bits == 4:
+            low = (q & 0xF).astype(np.int16)
+            high = (q >> 4).astype(np.int16)
+            low = np.where(low > 7, low - 16, low)
+            high = np.where(high > 7, high - 16, high)
+            vals = np.empty((q.shape[0], q.shape[1] * 2), np.float32)
+            vals[:, 0::2] = low
+            vals[:, 1::2] = high
+        else:
+            vals = q.astype(np.float32)
+        deq = (vals * scales[:, None]).reshape(-1)
+        shape = self._shapes[slot]
+        return deq[:int(np.prod(shape))].reshape(shape)
 
     def _round_compute(self, x: np.ndarray) -> np.ndarray:
         """Round an fp32 array through the COMPUTE dtype exactly like

@@ -46,11 +46,12 @@ def ce_loss(logits, labels):
     return -jnp.mean(ll)
 
 
-def _pipeline_module(n_blocks=4, num_stages=4):
+def _pipeline_module(n_blocks=4, num_stages=4, **kw):
     specs = ([LayerSpec(EmbedLayer)] +
              [LayerSpec(Block) for _ in range(n_blocks)] +
              [LayerSpec(Head)])
-    return PipelineModule(specs, num_stages=num_stages, loss_fn=ce_loss)
+    return PipelineModule(specs, num_stages=num_stages, loss_fn=ce_loss,
+                          **kw)
 
 
 def test_gpipe_spmd_matches_sequential(eight_devices, rng):
@@ -256,10 +257,10 @@ def test_non_uniform_weighted_parts(eight_devices, rng):
 
 
 def test_pipeline_remat_bounds_saved_activations(eight_devices, rng):
-    """Memory-profile evidence for the schedule: with remat on (the
-    default), the backward saves only the per-tick carry chain instead
-    of every layer's internals — saved residuals shrink vs remat off
-    (VERDICT round-1 asked for memory evidence of the 1F1B-class bound)."""
+    """Memory-profile evidence for the GPIPE schedule: with remat on,
+    the backward saves only the per-tick carry chain instead of every
+    layer's internals — saved residuals shrink vs remat off. (The 1f1b
+    schedule manages its own activations; see test_pipeline_1f1b.py.)"""
     from jax._src.ad_checkpoint import saved_residuals
     from deepspeed_tpu.runtime.pipe.engine import _PipelinedLM
 
@@ -268,7 +269,8 @@ def test_pipeline_remat_bounds_saved_activations(eight_devices, rng):
     ids = rng.integers(0, VOCAB, size=(8, 8), dtype=np.int32)
 
     def build(remat):
-        pm = _pipeline_module(n_blocks=4, num_stages=4)
+        pm = _pipeline_module(n_blocks=4, num_stages=4,
+                              schedule="gpipe")
         w = _PipelinedLM(pm, num_stages=4, num_microbatches=4, remat=remat)
         params = w.init(jax.random.PRNGKey(0), ids)
 

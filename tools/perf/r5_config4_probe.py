@@ -1,0 +1,62 @@
+"""Where does config 4's wall clock go? Same model/shape as
+bench_config4, with offload on/off — run ONE variant per process
+(HBM not reclaimed across engines in-process).
+
+usage: python tools/perf/r5_config4_probe.py {off,on,dpu}
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def main(variant):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    seq = 1024
+    cfg = GPT2Config(vocab_size=50304, n_positions=seq, n_embd=768,
+                     n_layer=12, n_head=12, dropout=0.0, use_flash=True)
+    config = {
+        "train_micro_batch_size_per_gpu": 16,
+        "gradient_accumulation_steps": 128,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }
+    if variant != "off":
+        config["zero_optimization"]["offload_optimizer"] = {
+            "device": "cpu",
+            "delayed_update": variant == "dpu",
+            "grad_dtype": "int4",
+            "upload_dtype": "int4_delta"}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg), config=config)
+    gb = engine.train_batch_size()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 50304, size=(gb, seq), dtype=np.int32)
+    b = {"input_ids": ids, "labels": ids.copy()}
+    float(engine.train_batch(batch=b))
+    float(engine.train_batch(batch=b))
+    ts = []
+    for _ in range(4):
+        t0 = time.time()
+        float(engine.train_batch(batch=b))
+        ts.append(time.time() - t0)
+    per = sorted(ts)[len(ts) // 2]
+    out = {"variant": variant, "per_step_s": round(per, 3),
+           "tok_s": round(gb * seq / per, 1)}
+    if engine._offload is not None:
+        out["breakdown"] = {k: round(v / 1e3, 2) for k, v in
+                            engine.get_offload_breakdown().items()}
+    print(out)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])
