@@ -290,33 +290,15 @@ class _PipelinedLM:
         else:
             y = jnp.zeros((1,), jnp.int32)  # placeholder arg (unused)
 
-        if labels is not None and self.schedule == "1f1b":
-            return self._apply_1f1b(params, toks, y)
-
         block_mod = self.block_mod
-        pre = list(zip(self.pre_specs, self.pre_mods))
-        post = list(zip(self.post_specs, self.post_mods))
         n_pre = len(self.pre_keys)
-        pre_params = [params[k] for k in self.pre_keys]
-        post_params = [params[k] for k in self.post_keys]
+        inject, collect, pre_params, post_params = \
+            self._exec_closures(params)
         k_counts = np.asarray(self.stage_block_counts, np.int32)
         max_k = self.max_layers_per_stage
-        apply_layer = self._apply_layer
         loss_fn = self.loss_fn
         remat = self.remat
         train = labels is not None
-
-        def inject(tok, pre_ps):
-            h = tok
-            for (spec, m), pp in zip(pre, pre_ps):
-                h = apply_layer(spec, m, pp, h)
-            return h
-
-        def collect(act, post_ps):
-            o = act
-            for (spec, m), pp in zip(post, post_ps):
-                o = apply_layer(spec, m, pp, o)
-            return o
 
         def pipe_body(block_params, toks, y, *rest):
             pre_ps, post_ps = rest[:n_pre], rest[n_pre:]
@@ -400,13 +382,47 @@ class _PipelinedLM:
             (P(),) * (len(pre_params) + len(post_params))
         fn = shard_map(pipe_body, mesh=mesh, axis_names={PIPE_AXIS},
                        in_specs=in_specs, out_specs=P(), check_vma=False)
+
         # jit wrapper: inlines under an enclosing trace; eagerly it works
         # around partial-manual shard_map rejecting unmentioned auto axes
-        return jax.jit(fn)(params["blocks"], toks, y,
-                           *pre_params, *post_params)
+        def run_gpipe():
+            return jax.jit(fn)(params["blocks"], toks, y,
+                               *pre_params, *post_params)
+
+        if train and self.schedule == "1f1b":
+            # the gpipe program doubles as the 1f1b primal: a
+            # NON-differentiated call (eval_batch) then runs the
+            # forward-only schedule instead of computing-and-discarding
+            # the interleaved backward's gradients
+            return self._apply_1f1b(params, toks, y,
+                                    primal=run_gpipe)
+        return run_gpipe()
+
+    def _exec_closures(self, params):
+        """Shared pre/post-layer machinery for both schedules: the
+        (inject, collect) closures and their param lists."""
+        pre = list(zip(self.pre_specs, self.pre_mods))
+        post = list(zip(self.post_specs, self.post_mods))
+        pre_params = tuple(params[k] for k in self.pre_keys)
+        post_params = tuple(params[k] for k in self.post_keys)
+        apply_layer = self._apply_layer
+
+        def inject(tok, pre_ps):
+            h = tok
+            for (spec, m), pp in zip(pre, pre_ps):
+                h = apply_layer(spec, m, pp, h)
+            return h
+
+        def collect(act, post_ps):
+            o = act
+            for (spec, m), pp in zip(post, post_ps):
+                o = apply_layer(spec, m, pp, o)
+            return o
+
+        return inject, collect, pre_params, post_params
 
     # -- 1F1B training schedule ------------------------------------------
-    def _apply_1f1b(self, params, toks, y):
+    def _apply_1f1b(self, params, toks, y, primal=None):
         """TrainSchedule semantics (reference runtime/pipe/schedule.py:189)
         as ONE SPMD program: every tick has a FORWARD slot and a
         BACKWARD slot. At tick t, stage s runs the forward of microbatch
@@ -423,26 +439,11 @@ class _PipelinedLM:
         M = self.num_microbatches
         mesh = mesh_manager.mesh
         block_mod = self.block_mod
-        pre = list(zip(self.pre_specs, self.pre_mods))
-        post = list(zip(self.post_specs, self.post_mods))
-        pre_params = tuple(params[k] for k in self.pre_keys)
-        post_params = tuple(params[k] for k in self.post_keys)
+        inject, collect, pre_params, post_params = \
+            self._exec_closures(params)
         k_counts = np.asarray(self.stage_block_counts, np.int32)
         max_k = self.max_layers_per_stage
-        apply_layer = self._apply_layer
         loss_fn = self.loss_fn
-
-        def inject(tok, pre_ps):
-            h = tok
-            for (spec, m), pp in zip(pre, pre_ps):
-                h = apply_layer(spec, m, pp, h)
-            return h
-
-        def collect(act, post_ps):
-            o = act
-            for (spec, m), pp in zip(post, post_ps):
-                o = apply_layer(spec, m, pp, o)
-            return o
 
         def body(block_params, toks, y, pre_ps, post_ps):
             bp = jax.tree_util.tree_map(lambda v: v[0], block_params)
@@ -567,6 +568,10 @@ class _PipelinedLM:
 
         @jax.custom_vjp
         def pipelined_loss(blocks_p, pre_ps, post_ps, toks, y):
+            # non-differentiated call (eval): the forward-only gpipe
+            # program — same loss, none of the grad machinery
+            if primal is not None:
+                return primal()
             loss, _, _, _ = jax.jit(fn)(blocks_p, toks, y,
                                         pre_ps, post_ps)
             return loss

@@ -1,0 +1,78 @@
+"""WOQDense routing: the dense branch must be bit-identical to
+flax nn.Dense (training / unquantized serving), and a quantized param
+tree must take the woq_matmul branch — for plain dicts AND FrozenDict
+trees (flax.core.freeze)."""
+
+import numpy as np
+
+import flax
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.quantization import quantize_weight
+from deepspeed_tpu.models.woq_dense import WOQDense
+from deepspeed_tpu.ops.pallas_kernels.woq_matmul import woq_matmul_reference
+
+
+def _trees(rng, use_bias=True):
+    x = jnp.asarray(rng.standard_normal((4, 64)), jnp.float32)
+    dense = nn.Dense(128, use_bias=use_bias)
+    woq = WOQDense(128, use_bias=use_bias)
+    params = dense.init(jax.random.PRNGKey(0), x)
+    return x, dense, woq, params
+
+
+def test_dense_branch_bit_identical_to_nn_dense(rng):
+    for use_bias in (True, False):
+        x, dense, woq, params = _trees(rng, use_bias)
+        ref = dense.apply(params, x)
+        got = woq.apply(params, x)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_init_structure_matches_nn_dense(rng):
+    x, dense, woq, _ = _trees(rng)
+    pd = dense.init(jax.random.PRNGKey(1), x)["params"]
+    pw = woq.init(jax.random.PRNGKey(1), x)["params"]
+    assert set(pd) == set(pw) == {"kernel", "bias"}
+    for k in pd:
+        assert pd[k].shape == pw[k].shape
+
+
+def test_quantized_tree_routes_to_woq_matmul(rng):
+    x, dense, woq, params = _trees(rng)
+    kernel = params["params"]["kernel"]
+    leaf = quantize_weight(kernel, 8, 64)
+    qparams = {"params": {"kernel": leaf,
+                          "bias": params["params"]["bias"]}}
+    got = woq.apply(qparams, x.astype(jnp.bfloat16))
+    expect = woq_matmul_reference(
+        x.astype(jnp.bfloat16), leaf["woq_q"], leaf["woq_scales"]) \
+        + params["params"]["bias"].astype(jnp.bfloat16)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(expect, np.float32),
+                               atol=3e-2, rtol=3e-2)
+    # and the quantized output differs from dense only by quant noise
+    ref = dense.apply(params, x)
+    err = np.abs(np.asarray(got, np.float32) - np.asarray(ref))
+    assert 0 < err.max() < 0.2
+
+
+def test_frozen_dict_tree_also_routes(rng):
+    """flax.core.freeze trees are Mappings, not dicts — the woq branch
+    must still fire (a dict-only isinstance check silently falls into
+    the dense path and crashes on the subtree)."""
+    x, dense, woq, params = _trees(rng)
+    leaf = quantize_weight(params["params"]["kernel"], 8, 64)
+    qparams = flax.core.freeze(
+        {"params": {"kernel": jax.tree_util.tree_map(lambda a: a, leaf),
+                    "bias": params["params"]["bias"]}})
+    got = woq.apply(qparams, x.astype(jnp.bfloat16))
+    assert got.shape == (4, 128)
+    assert np.isfinite(np.asarray(got, np.float32)).all()
+
+
+def test_llama_is_woq_native(rng):
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+    assert getattr(LlamaForCausalLM, "woq_native", False)

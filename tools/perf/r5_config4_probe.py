@@ -15,12 +15,13 @@ import numpy as np
 
 
 def main(variant):
+    use_flash = "noflash" not in variant
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
 
     seq = 1024
     cfg = GPT2Config(vocab_size=50304, n_positions=seq, n_embd=768,
-                     n_layer=12, n_head=12, dropout=0.0, use_flash=True)
+                     n_layer=12, n_head=12, dropout=0.0, use_flash=use_flash)
     config = {
         "train_micro_batch_size_per_gpu": 16,
         "gradient_accumulation_steps": 128,
@@ -30,10 +31,10 @@ def main(variant):
         "gradient_clipping": 1.0,
         "steps_per_print": 0,
     }
-    if variant != "off":
+    if variant.split("-")[0] != "off":
         config["zero_optimization"]["offload_optimizer"] = {
             "device": "cpu",
-            "delayed_update": variant == "dpu",
+            "delayed_update": variant.startswith("dpu"),
             "grad_dtype": "int4",
             "upload_dtype": "int4_delta"}
     engine, _, _, _ = deepspeed_tpu.initialize(
