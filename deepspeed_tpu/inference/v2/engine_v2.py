@@ -40,6 +40,7 @@ class RaggedInferenceEngineConfig:
     quantization_group_size: int = 128
     quantization_min_size: int = 1 << 14
     tp_size: int = 1                 # tensor-parallel degree
+    ep_size: int = 1                 # expert-parallel degree (MoE)
 
 
 class InferenceEngineV2:
@@ -85,13 +86,27 @@ class InferenceEngineV2:
         self.pools = init_kv_pools(self.spec, ec.n_kv_blocks,
                                    ec.kv_block_size,
                                    dtype=jnp.dtype(ec.kv_dtype))
+        if ec.ep_size > 1 and not (self.spec.n_experts and
+                                   self.spec.n_experts % ec.ep_size == 0):
+            raise ValueError(
+                f"ep_size={ec.ep_size} needs a MoE model whose expert "
+                f"count is divisible by it "
+                f"(n_experts={self.spec.n_experts})")
+        if ec.tp_size > 1 or ec.ep_size > 1:
+            self._init_mesh(ec.tp_size, ec.ep_size)
         if ec.tp_size > 1:
             self._apply_tp_sharding(ec.tp_size)
+        if ec.ep_size > 1:
+            self._apply_ep_sharding(ec.ep_size)
         spec = self.spec
         tp_axis = None
         if ec.tp_size > 1 and self.spec.n_kv_heads % ec.tp_size == 0:
             from ...parallel.mesh import TENSOR_AXIS
             tp_axis = TENSOR_AXIS
+        ep_axis = None
+        if ec.ep_size > 1:
+            from ...parallel.mesh import EXPERT_AXIS
+            ep_axis = EXPERT_AXIS
         woq_bits = self._woq_bits
         if woq_bits is not None:
             from ..quantization import dequantize_param_tree
@@ -100,13 +115,79 @@ class InferenceEngineV2:
                 return ragged_forward(
                     dequantize_param_tree(tree, jnp.bfloat16), spec,
                     pools, *args, block_size=ec.kv_block_size,
-                    tp_axis=tp_axis)
+                    tp_axis=tp_axis, ep_axis=ep_axis)
         else:
             def fwd(tree, pools, *args):
                 return ragged_forward(
                     tree, spec, pools, *args,
-                    block_size=ec.kv_block_size, tp_axis=tp_axis)
+                    block_size=ec.kv_block_size, tp_axis=tp_axis,
+                    ep_axis=ep_axis)
         self._jit_forward = jax.jit(fwd, donate_argnums=(1,))
+
+    def _init_mesh(self, tp: int, ep: int):
+        from ...parallel.mesh import (EXPERT_AXIS, MeshConfig,
+                                      mesh_manager)
+        if not mesh_manager.initialized:
+            mesh_manager.init(MeshConfig(data=-1, tensor=tp, expert=ep))
+        elif ep > 1 and \
+                dict(mesh_manager.mesh.shape).get(EXPERT_AXIS, 1) != ep:
+            # a pre-existing mesh with a different expert axis would
+            # silently replicate the bank (shard_map over a size-1 axis
+            # is the identity) — the one thing ep_size exists to avoid
+            raise ValueError(
+                f"ep_size={ep} but the initialized mesh has expert="
+                f"{dict(mesh_manager.mesh.shape).get(EXPERT_AXIS, 1)}; "
+                f"reset the mesh or match the sizes")
+
+    def _apply_ep_sharding(self, ep: int):
+        """Place each MoE layer's stacked expert bank over the expert
+        axis — E/ep experts resident per shard (the reference shards
+        the CUTLASS MoE GEMM's bank the same way,
+        v2/model_implementations/sharding/). Composes with TP: the
+        ffn dim keeps its tensor split."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ...parallel.mesh import (EXPERT_AXIS, TENSOR_AXIS,
+                                      mesh_manager)
+        from ..quantization import is_woq_leaf
+
+        mesh = mesh_manager.mesh
+        tp = dict(mesh.shape).get(TENSOR_AXIS, 1)
+
+        def spec_for(key):
+            t = TENSOR_AXIS if tp > 1 else None
+            if key in ("we_gate", "we_up"):
+                return P(EXPERT_AXIS, None, t)
+            if key == "we_down":
+                return P(EXPERT_AXIS, t, None)
+            return None
+
+        def place(lk, lv):
+            sp = spec_for(lk)
+            if sp is None or lv is None:
+                return lv
+            if is_woq_leaf(lv):
+                try:
+                    q = jax.device_put(lv["woq_q"],
+                                       NamedSharding(mesh, sp))
+                except Exception:
+                    # e.g. nibble-packed last dim not divisible by the
+                    # tensor axis: keep the EXPERT split (dim-0
+                    # divisibility is already validated) — dropping it
+                    # would forfeit the E/ep HBM saving ep_size is for
+                    logger.warning(
+                        f"ep sharding: {lk} does not take {sp}; "
+                        f"falling back to expert-only placement")
+                    q = jax.device_put(
+                        lv["woq_q"],
+                        NamedSharding(mesh, P(EXPERT_AXIS)))
+                return {"woq_q": q, "woq_scales": jax.device_put(
+                    lv["woq_scales"], NamedSharding(mesh, P()))}
+            return jax.device_put(lv, NamedSharding(mesh, sp))
+
+        self.tree = {
+            k: ([{lk: place(lk, lv) for lk, lv in layer.items()}
+                 for layer in v] if k == "layers" else v)
+            for k, v in self.tree.items()}
 
     def _apply_tp_sharding(self, tp: int):
         """Shard the normalized tree with generic TP rules (column-split

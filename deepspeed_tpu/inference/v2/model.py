@@ -461,7 +461,8 @@ def _alibi_slopes(n_heads: int) -> np.ndarray:
     return alibi_slopes(n_heads)
 
 
-def moe_mlp_ragged(x, router, we_gate, we_up, we_down, top_k):
+def moe_mlp_ragged(x, router, we_gate, we_up, we_down, top_k,
+                   ep_axis: Optional[str] = None):
     """Grouped-GEMM MoE MLP over packed tokens [B, C].
 
     TPU-native moe_scatter/moe_gemm/moe_gather: route -> sort tokens by
@@ -469,26 +470,73 @@ def moe_mlp_ragged(x, router, we_gate, we_up, we_down, top_k):
     unsort -> weighted combine. One compilation, no per-expert loop.
     Reference: deepspeed/inference/v2/kernels/ragged_ops/{moe_scatter,
     moe_gather,top_k_gating} + cutlass_ops/moe_gemm.
+
+    ``ep_axis``: mesh axis the EXPERT bank is sharded over (reference:
+    v2/kernels/cutlass_ops/moe_gemm sharded across ranks +
+    model_implementations/sharding/). Each shard holds E/ep experts,
+    routes the (replicated) packed tokens, runs its local bank against
+    the tokens owned by its experts — non-local rows land in a
+    zero-weight overflow bucket — and the exact output assembles with
+    one psum (every (token, k) choice is local to exactly one shard).
+    This shards the bank's HBM E/ep-fold with no token dropping; the
+    capacity-bound all-to-all dispatch (the FLOP-sharding variant)
+    lives on the training path, moe/sharded_moe.py.
     """
+    if ep_axis is not None:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        from ...parallel.mesh import mesh_manager
+
+        def local_body(xl, r, g, u, d):
+            e0 = jax.lax.axis_index(ep_axis) * g.shape[0]
+            return _moe_body(xl, r, g, u, d, top_k, e0=e0,
+                             axis=ep_axis)
+
+        return shard_map(
+            local_body,
+            mesh=mesh_manager.mesh, axis_names={ep_axis},
+            in_specs=(P(), P(), P(ep_axis), P(ep_axis), P(ep_axis)),
+            out_specs=P(), check_vma=False)(
+            x, router, we_gate, we_up, we_down)
+    return _moe_body(x, router, we_gate, we_up, we_down, top_k)
+
+
+def _moe_body(x, router, g_b, u_b, d_b, top_k, e0=None, axis=None):
+    """One grouped-GEMM MoE pass over bank [E_l, ...]. ``e0`` (the
+    shard's first global expert) selects the expert-parallel variant:
+    rows routed to non-local experts ride the LAST local expert's
+    group — their combine weight is zeroed, so the psum over ``axis``
+    assembles the exact output with no appended zero expert (and no
+    per-step bank copy)."""
     from ...models.mixtral import moe_route
 
     B, C = x.shape
-    E = router.shape[1]
+    E_l = g_b.shape[0]
     w, idx = moe_route(x @ router, top_k)           # [B, k]
 
     flat_e = idx.reshape(-1)                        # [B*k]
-    order = jnp.argsort(flat_e, stable=True)
+    if e0 is None:
+        le, local = flat_e, None
+    else:
+        local = (flat_e >= e0) & (flat_e < e0 + E_l)
+        le = jnp.where(local, flat_e - e0, E_l - 1)
+    order = jnp.argsort(le, stable=True)
     xs = jnp.repeat(x, top_k, axis=0)[order]        # sorted by expert
-    group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+    group_sizes = jnp.bincount(le, length=E_l).astype(jnp.int32)
 
-    g = jax.lax.ragged_dot(xs, we_gate.astype(xs.dtype), group_sizes)
-    u = jax.lax.ragged_dot(xs, we_up.astype(xs.dtype), group_sizes)
+    g = jax.lax.ragged_dot(xs, g_b.astype(xs.dtype), group_sizes)
+    u = jax.lax.ragged_dot(xs, u_b.astype(xs.dtype), group_sizes)
     h = jax.nn.silu(g) * u
-    o = jax.lax.ragged_dot(h, we_down.astype(h.dtype), group_sizes)
+    o = jax.lax.ragged_dot(h, d_b.astype(h.dtype), group_sizes)
 
     inv = jnp.argsort(order)
     o = o[inv].reshape(B, top_k, C)
-    return jnp.sum(o * w[..., None].astype(o.dtype), axis=1)
+    if local is not None:
+        w = jnp.where(local.reshape(B, top_k), w, 0.0)
+    out = jnp.sum(o * w[..., None].astype(o.dtype), axis=1)
+    if axis is not None:
+        out = jax.lax.psum(out, axis)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -497,7 +545,8 @@ def moe_mlp_ragged(x, router, we_gate, we_up, we_down, top_k):
 def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
                    token_pos, token_qidx, seq_lens, q_counts,
                    block_tables, logits_idx, block_size: int,
-                   interpret: bool = False, tp_axis: Optional[str] = None):
+                   interpret: bool = False, tp_axis: Optional[str] = None,
+                   ep_axis: Optional[str] = None):
     """One ragged forward over the paged KV pools.
 
     token_* arrays: [budget]; seq_lens/q_counts/logits_idx: [S];
@@ -618,7 +667,7 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
         if spec.n_experts:
             mlp_out = moe_mlp_ragged(h, lp["router"], lp["we_gate"],
                                      lp["we_up"], lp["we_down"],
-                                     spec.top_k)
+                                     spec.top_k, ep_axis=ep_axis)
         elif "w_gate" in lp:
             mlp_out = (jax.nn.silu(h @ lp["w_gate"]) *
                        (h @ lp["w_up"])) @ lp["w_down"]
