@@ -41,6 +41,12 @@ class RaggedInferenceEngineConfig:
     quantization_min_size: int = 1 << 14
     tp_size: int = 1                 # tensor-parallel degree
     ep_size: int = 1                 # expert-parallel degree (MoE)
+    # module-implementation selection (reference v2/modules/
+    # heuristics.py:186): "auto" picks per hardware/config; explicit
+    # names pin an implementation and fail loudly when incompatible
+    attn_impl: str = "auto"          # auto / pallas / reference
+    linear_impl: str = "auto"        # auto / woq_kernel / dense
+    moe_impl: str = "auto"           # auto / expert_parallel / replicated
 
 
 class InferenceEngineV2:
@@ -103,25 +109,39 @@ class InferenceEngineV2:
         if ec.tp_size > 1 and self.spec.n_kv_heads % ec.tp_size == 0:
             from ...parallel.mesh import TENSOR_AXIS
             tp_axis = TENSOR_AXIS
+        # implementation selection (heuristics.py — the reference's
+        # config->implementation seam)
+        from .heuristics import (instantiate_attention,
+                                 instantiate_linear, instantiate_moe)
+        attn_kwargs = instantiate_attention(ec.attn_impl)
+        self.linear_impl = instantiate_linear(
+            ec.linear_impl, quantized=self._woq_bits is not None,
+            tp_size=ec.tp_size)
+        self.moe_impl = instantiate_moe(ec.moe_impl, ep_size=ec.ep_size)
         ep_axis = None
-        if ec.ep_size > 1:
+        if self.moe_impl == "expert_parallel":
             from ...parallel.mesh import EXPERT_AXIS
             ep_axis = EXPERT_AXIS
         woq_bits = self._woq_bits
-        if woq_bits is not None:
+        if woq_bits is not None and self.linear_impl != "woq_kernel":
             from ..quantization import dequantize_param_tree
 
             def fwd(tree, pools, *args):
                 return ragged_forward(
                     dequantize_param_tree(tree, jnp.bfloat16), spec,
                     pools, *args, block_size=ec.kv_block_size,
-                    tp_axis=tp_axis, ep_axis=ep_axis)
+                    tp_axis=tp_axis, ep_axis=ep_axis,
+                    attn_kwargs=attn_kwargs)
         else:
+            # dense tree, or linear_impl == "woq_kernel": the forward's
+            # _linear consumes WOQ leaves through the fused Pallas
+            # matmul (decode reads quantized HBM); MoE banks dequantize
+            # inline at their ragged_dot
             def fwd(tree, pools, *args):
                 return ragged_forward(
                     tree, spec, pools, *args,
                     block_size=ec.kv_block_size, tp_axis=tp_axis,
-                    ep_axis=ep_axis)
+                    ep_axis=ep_axis, attn_kwargs=attn_kwargs)
         self._jit_forward = jax.jit(fwd, donate_argnums=(1,))
 
     def _init_mesh(self, tp: int, ep: int):

@@ -1,0 +1,82 @@
+"""Config-driven module-implementation selection for the v2 engine.
+
+Reference: deepspeed/inference/v2/modules/heuristics.py:186
+``instantiate_attention / instantiate_linear / instantiate_moe`` — the
+seam that picks a concrete kernel implementation per op from config +
+hardware. The TPU port has far fewer implementations per op (XLA fuses
+most of what the reference's registry arbitrates between), but the
+SELECTION LOGIC is a real surface: serving configs and tests pin
+implementations through it instead of monkey-patching.
+
+Selectable today:
+- attention:  "auto" (Pallas paged kernel on TPU when the shape tiles,
+              XLA-gather reference otherwise) / "pallas" / "reference"
+- linear:     "auto" (fused WOQ matmul for quantized trees at decode
+              widths, plain dot for dense) / "woq_kernel" / "dense"
+- moe:        "auto" (expert-parallel when ep_size > 1) /
+              "expert_parallel" / "replicated"
+
+Each ``instantiate_*`` returns the IMPLEMENTATION TAG consumed by the
+call sites (model.ragged_forward / engine wiring), raising on unknown
+names so config typos fail loudly.
+"""
+
+from typing import Optional
+
+import jax
+
+_ATTN = ("auto", "pallas", "reference")
+_LINEAR = ("auto", "woq_kernel", "dense")
+_MOE = ("auto", "expert_parallel", "replicated")
+
+
+def _check(name: str, value: str, known) -> str:
+    v = (value or "auto").lower()
+    if v not in known:
+        raise ValueError(f"{name} implementation must be one of "
+                         f"{known}, got {value!r}")
+    return v
+
+
+def instantiate_attention(impl: str = "auto") -> dict:
+    """-> kwargs for the paged-attention call site
+    (force_pallas/interpret map onto ops/pallas_kernels/paged_attention
+    dispatch)."""
+    v = _check("attention", impl, _ATTN)
+    if v == "pallas":
+        return {"force_pallas": True}
+    if v == "reference":
+        # the reference implementation runs everywhere; on TPU it is
+        # the fallback for shapes the kernel cannot tile
+        return {"force_reference": True}
+    return {}
+
+
+def instantiate_linear(impl: str = "auto", quantized: bool = False,
+                       tp_size: int = 1) -> str:
+    v = _check("linear", impl, _LINEAR)
+    if v == "auto":
+        # the fused kernel is a pallas_call — GSPMD cannot
+        # auto-partition it, so under TP the projections stay on the
+        # dequantize path (attention's shard_map covers its own kernel)
+        return "woq_kernel" if quantized and tp_size == 1 and \
+            jax.default_backend() == "tpu" else "dense"
+    if v == "woq_kernel" and not quantized:
+        raise ValueError("linear='woq_kernel' needs a quantized tree "
+                         "(weight_dtype int8/int4)")
+    if v == "woq_kernel" and tp_size > 1:
+        raise ValueError("linear='woq_kernel' does not compose with "
+                         "tp_size>1 (pallas under GSPMD); use 'dense'")
+    return v
+
+
+def instantiate_moe(impl: str = "auto", ep_size: int = 1) -> str:
+    v = _check("moe", impl, _MOE)
+    if v == "auto":
+        return "expert_parallel" if ep_size > 1 else "replicated"
+    if v == "expert_parallel" and ep_size <= 1:
+        raise ValueError("moe='expert_parallel' needs ep_size > 1")
+    if v == "replicated" and ep_size > 1:
+        raise ValueError("moe='replicated' conflicts with "
+                         f"ep_size={ep_size} (the bank is sharded)")
+    return v

@@ -461,6 +461,28 @@ def _alibi_slopes(n_heads: int) -> np.ndarray:
     return alibi_slopes(n_heads)
 
 
+
+def _dense_leaf(w, dtype=jnp.bfloat16):
+    """WOQ leaf -> dense array (3D expert banks etc. feed ops that
+    consume arrays, not leaves); pass-through for plain arrays."""
+    if isinstance(w, dict) and "woq_q" in w:
+        from ..quantization import dequantize_weight
+        return dequantize_weight(w, dtype)
+    return w
+
+
+def _linear(h, w):
+    """Projection matmul that consumes dense OR WOQ leaves: a
+    {"woq_q","woq_scales"} dict routes through the fused Pallas
+    weight-only matmul (decode reads quantized HBM — the linear_impl
+    "woq_kernel" selection, heuristics.py); a plain array is one dot."""
+    if isinstance(w, dict) and "woq_q" in w:
+        from ...ops.pallas_kernels.woq_matmul import woq_matmul
+        return woq_matmul(h, w["woq_q"], w["woq_scales"],
+                          out_dtype=h.dtype)
+    return h @ w
+
+
 def moe_mlp_ragged(x, router, we_gate, we_up, we_down, top_k,
                    ep_axis: Optional[str] = None):
     """Grouped-GEMM MoE MLP over packed tokens [B, C].
@@ -546,7 +568,8 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
                    token_pos, token_qidx, seq_lens, q_counts,
                    block_tables, logits_idx, block_size: int,
                    interpret: bool = False, tp_axis: Optional[str] = None,
-                   ep_axis: Optional[str] = None):
+                   ep_axis: Optional[str] = None,
+                   attn_kwargs: Optional[dict] = None):
     """One ragged forward over the paged KV pools.
 
     token_* arrays: [budget]; seq_lens/q_counts/logits_idx: [S];
@@ -579,12 +602,14 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
         cos, sin = cos[0], sin[0]                   # [B, rot/2]
     slopes = _alibi_slopes(nh) if spec.pos == "alibi" else None
 
+    attn_kwargs = attn_kwargs or {}
+
     def attend(q, k_pool, v_pool, slopes_arr):
         return paged_attention(
             q, k_pool, v_pool, block_tables, seq_lens, q_counts,
             token_seq, token_qidx, block_size=bs,
             alibi_slopes=slopes_arr, window=spec.window,
-            interpret=interpret)
+            interpret=interpret, **attn_kwargs)
 
     if tp_axis is not None:
         # head-sharded attention under shard_map (see docstring)
@@ -607,7 +632,8 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
                 return paged_attention(
                     q_l, kp_l, vp_l, bt, sl, qc, ts, tq, block_size=bs,
                     alibi_slopes=s_l[0] if s_l else None,
-                    window=spec.window, interpret=interpret)
+                    window=spec.window, interpret=interpret,
+                    **attn_kwargs)
 
             args = (q, k_pool, v_pool, block_tables, seq_lens, q_counts,
                     token_seq, token_qidx)
@@ -636,9 +662,9 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
 
         h = _norm(x, lp["ln1_scale"], lp.get("ln1_bias"), spec.norm,
                   spec.eps)
-        q = h @ lp["wq"]
-        k = h @ lp["wk"]
-        v = h @ lp["wv"]
+        q = _linear(h, lp["wq"])
+        k = _linear(h, lp["wk"])
+        v = _linear(h, lp["wv"])
         if lp.get("bq") is not None:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(B, nh, hd)
@@ -656,7 +682,7 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
 
         attn = attend(q, k_pool, v_pool, slopes)
         attn = attn.reshape(B, nh * hd).astype(x.dtype)
-        attn_out = attn @ lp["wo"]
+        attn_out = _linear(attn, lp["wo"])
         if lp.get("bo") is not None:
             attn_out = attn_out + lp["bo"]
 
@@ -665,17 +691,21 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
             h = _norm(mlp_in, lp["ln2_scale"], lp.get("ln2_bias"),
                       spec.norm, spec.eps)
         if spec.n_experts:
-            mlp_out = moe_mlp_ragged(h, lp["router"], lp["we_gate"],
-                                     lp["we_up"], lp["we_down"],
-                                     spec.top_k, ep_axis=ep_axis)
+            mlp_out = moe_mlp_ragged(
+                h, _dense_leaf(lp["router"], h.dtype),
+                _dense_leaf(lp["we_gate"], h.dtype),
+                _dense_leaf(lp["we_up"], h.dtype),
+                _dense_leaf(lp["we_down"], h.dtype),
+                spec.top_k, ep_axis=ep_axis)
         elif "w_gate" in lp:
-            mlp_out = (jax.nn.silu(h @ lp["w_gate"]) *
-                       (h @ lp["w_up"])) @ lp["w_down"]
+            mlp_out = _linear(
+                jax.nn.silu(_linear(h, lp["w_gate"])) *
+                _linear(h, lp["w_up"]), lp["w_down"])
         else:
-            hh = h @ lp["w_in"]
+            hh = _linear(h, lp["w_in"])
             if lp.get("b_in") is not None:
                 hh = hh + lp["b_in"]
-            mlp_out = _act(hh, spec.act) @ lp["w_out"]
+            mlp_out = _linear(_act(hh, spec.act), lp["w_out"])
             if lp.get("b_out") is not None:
                 mlp_out = mlp_out + lp["b_out"]
         if spec.parallel_residual:

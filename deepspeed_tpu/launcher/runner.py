@@ -153,7 +153,9 @@ def _ssh_main(argv):
             logger.error(f"--include {args.include!r} matches no host in "
                          f"{args.hostfile} ({', '.join(pool)})")
             return 2
-    remote = " ".join(args.command)
+    # shlex.join: an argument with spaces/metacharacters must reach the
+    # remote shell as ONE argument, not be re-split (e.g. bash -c 'a b')
+    remote = shlex.join(args.command)
     cmds = [["ssh", "-o", "StrictHostKeyChecking=no", h, remote]
             for h in hosts]
     if args.dry_run:

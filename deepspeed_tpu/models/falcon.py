@@ -30,6 +30,9 @@ class FalconConfig:
     num_hidden_layers: int = 32
     num_attention_heads: int = 71
     num_kv_heads: int = 1          # multi-query
+    # falcon-40b/180b layout: grouped KV (interleaved fused qkv) +
+    # separate ln_attn/ln_mlp feeding the parallel branches
+    new_decoder_architecture: bool = False
     parallel_attn: bool = True
     bias: bool = False
     rope_theta: float = 10000.0
@@ -95,6 +98,24 @@ class FalconDecoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.config
+        if cfg.new_decoder_architecture:
+            # falcon-40b: two norms feed the parallel branches
+            h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                             name="ln_attn")(x)
+            m_in = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                                name="ln_mlp")(x)
+            attn = FalconAttention(cfg, name="self_attention")(
+                h, positions)
+            m = nn.Dense(4 * cfg.hidden_size, name="dense_h_to_4h",
+                         use_bias=cfg.bias,
+                         kernel_init=nn.initializers.normal(
+                             cfg.initializer_range))(m_in)
+            m = nn.gelu(m, approximate=False)
+            m = nn.Dense(cfg.hidden_size, name="dense_4h_to_h",
+                         use_bias=cfg.bias,
+                         kernel_init=nn.initializers.normal(
+                             cfg.initializer_range))(m)
+            return x + attn + m
         h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
                          name="input_layernorm")(x)
         attn = FalconAttention(cfg, name="self_attention")(h, positions)
@@ -166,15 +187,43 @@ def from_hf_state_dict(state_dict, config: FalconConfig):
         v = np.asarray(v)
         return v.T if transpose else v
 
-    if config.num_kv_heads != 1:
-        # falcon-40b's new_decoder_architecture interleaves the fused
-        # qkv per kv group; the flat [q|k|v] split below would read
-        # garbage — fail loudly instead
+    nh, nkv, hd = (config.num_attention_heads, config.num_kv_heads,
+                   config.head_dim)
+    if config.new_decoder_architecture:
+        if nh % nkv:
+            raise ValueError(f"num_attention_heads ({nh}) not "
+                             f"divisible by num_kv_heads ({nkv})")
+    elif nkv not in (1, nh):
+        # old-architecture checkpoints are multi-query (nkv=1) or full
+        # MHA (nkv=nh) — anything else isn't an HF falcon layout
         raise NotImplementedError(
-            "falcon converter supports the multi-query (num_kv_heads=1) "
-            "fused-qkv layout; grouped-KV (new_decoder_architecture) "
-            f"checkpoints need a group-interleaved split "
-            f"(num_kv_heads={config.num_kv_heads})")
+            "falcon converter: without new_decoder_architecture the "
+            "fused qkv is flat multi-query (num_kv_heads=1) or full "
+            f"MHA; got num_kv_heads={config.num_kv_heads}")
+    rep = nh // nkv
+    # HF stores the old-arch full-MHA fused qkv per-head interleaved
+    # (view(.., nh, 3, hd)) — exactly the grouped layout with nkv=nh,
+    # rep=1 — while multi-query (nkv=1) is flat [Q | k | v]
+    degroup = config.new_decoder_architecture or (nkv == nh and nh > 1)
+
+    def ungroup_qkv_kernel(w):
+        """new_decoder_architecture stores the fused qkv interleaved
+        per KV group — [.., (q_g0..q_g(rep-1), k_g, v_g) x nkv] — while
+        this module (and the old layout) reads flat [Q | K | V]
+        (reference role: the grouped split replace_module's falcon
+        container performs)."""
+        h_in = w.shape[0]
+        g = w.reshape(h_in, nkv, rep + 2, hd)
+        q = g[:, :, :rep, :].reshape(h_in, nh * hd)
+        k = g[:, :, rep, :].reshape(h_in, nkv * hd)
+        v = g[:, :, rep + 1, :].reshape(h_in, nkv * hd)
+        return np.concatenate([q, k, v], axis=1)
+
+    def ungroup_qkv_bias(b):
+        g = b.reshape(nkv, rep + 2, hd)
+        return np.concatenate(
+            [g[:, :rep, :].reshape(nh * hd), g[:, rep, :].reshape(-1),
+             g[:, rep + 1, :].reshape(-1)])
     prefix = "transformer." if \
         "transformer.word_embeddings.weight" in state_dict else ""
     params = {
@@ -184,13 +233,13 @@ def from_hf_state_dict(state_dict, config: FalconConfig):
     }
     for i in range(config.num_hidden_layers):
         lp = f"{prefix}h.{i}."
+        qkv_kernel = g(f"{lp}self_attention.query_key_value.weight",
+                       True)
+        if degroup:
+            qkv_kernel = ungroup_qkv_kernel(qkv_kernel)
         layer = {
-            "input_layernorm": {
-                "scale": g(f"{lp}input_layernorm.weight"),
-                "bias": g(f"{lp}input_layernorm.bias")},
             "self_attention": {
-                "query_key_value": {"kernel": g(
-                    f"{lp}self_attention.query_key_value.weight", True)},
+                "query_key_value": {"kernel": qkv_kernel},
                 "dense": {"kernel": g(
                     f"{lp}self_attention.dense.weight", True)},
             },
@@ -199,13 +248,26 @@ def from_hf_state_dict(state_dict, config: FalconConfig):
             "dense_4h_to_h": {"kernel": g(
                 f"{lp}mlp.dense_4h_to_h.weight", True)},
         }
-        if not config.parallel_attn:
+        if config.new_decoder_architecture:
+            layer["ln_attn"] = {"scale": g(f"{lp}ln_attn.weight"),
+                                "bias": g(f"{lp}ln_attn.bias")}
+            layer["ln_mlp"] = {"scale": g(f"{lp}ln_mlp.weight"),
+                               "bias": g(f"{lp}ln_mlp.bias")}
+        else:
+            layer["input_layernorm"] = {
+                "scale": g(f"{lp}input_layernorm.weight"),
+                "bias": g(f"{lp}input_layernorm.bias")}
+        if not config.parallel_attn and \
+                not config.new_decoder_architecture:
             layer["post_attention_layernorm"] = {
                 "scale": g(f"{lp}post_attention_layernorm.weight"),
                 "bias": g(f"{lp}post_attention_layernorm.bias")}
         if config.bias:
+            qkv_bias = g(f"{lp}self_attention.query_key_value.bias")
+            if degroup:
+                qkv_bias = ungroup_qkv_bias(qkv_bias)
             layer["self_attention"]["query_key_value"]["bias"] = \
-                g(f"{lp}self_attention.query_key_value.bias")
+                qkv_bias
             layer["self_attention"]["dense"]["bias"] = \
                 g(f"{lp}self_attention.dense.bias")
             layer["dense_h_to_4h"]["bias"] = \

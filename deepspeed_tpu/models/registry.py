@@ -11,8 +11,8 @@ works for every family with no per-model user code.
 import dataclasses
 from typing import Any, Callable, Dict, Optional
 
-from . import (bert, bloom, falcon, gpt2, gptj, gptneo, gptneox, llama,
-               mistral, mixtral, opt, phi, qwen2)
+from . import (bert, bloom, clip, falcon, gpt2, gptj, gptneo, gptneox,
+               llama, mistral, mixtral, opt, phi, qwen2)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,6 +111,12 @@ register(ModelPolicy(
     model_cls=bert.BertForMaskedLM, from_hf=bert.from_hf_state_dict,
     tensor_rules=bert.bert_tensor_rules,
     hf_keys=("bert.embeddings.word_embeddings.weight",)))
+register(ModelPolicy(
+    name="clip", config_cls=clip.CLIPTextConfig,
+    model_cls=clip.CLIPTextModel, from_hf=clip.from_hf_state_dict,
+    tensor_rules=clip.clip_tensor_rules,
+    hf_keys=("text_model.embeddings.token_embedding.weight",
+             "embeddings.token_embedding.weight")))
 
 
 def get_policy(name: str) -> ModelPolicy:
@@ -151,3 +157,13 @@ def from_pretrained_state_dict(state_dict, config,
     model = policy.model_cls(config)
     params = policy.from_hf(state_dict, config)
     return model, params
+
+
+def from_sharded_checkpoint(path, config, model_type: str = "gpt2"):
+    """(model, params) from a Megatron TP-sharded checkpoint — a
+    directory of ``mp_rank_XX`` files, an SDLoaderFactory-style JSON
+    descriptor, or an explicit file list (reference:
+    runtime/state_dict_factory.py:21,190 SDLoaderFactory /
+    MegatronSDLoader)."""
+    from .sharded_checkpoint import load_megatron_checkpoint
+    return load_megatron_checkpoint(path, config, model_type)

@@ -1,0 +1,202 @@
+"""Megatron-/TP-sharded checkpoint interop.
+
+Reference: deepspeed/runtime/state_dict_factory.py:21 ``SDLoaderFactory``
+(JSON descriptor {"type": "Megatron", "checkpoints": [...], "version"})
+and :190 ``MegatronSDLoader`` — merge mp-sharded state dicts back into
+one model: fused QKV merged version-aware, column-parallel weights
+concatenated on the output dim, row-parallel on the input dim,
+everything else replicated. Also module_inject/load_checkpoint.py:1
+(parallel checkpoint loading into injected modules).
+
+TPU-native shape: the merge produces ONE full state dict on host
+(numpy), converts Megatron-GPT names to the HF layout, and hands the
+result to the family converter in ``models/registry.py`` — sharding
+back out onto the device mesh is then the engines' normal job (GSPMD),
+so no per-rank device loading machinery is needed.
+"""
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# Megatron key suffixes by parallel layout (MegatronSDLoader's table,
+# state_dict_factory.py:193-218). Weights are torch-layout [out, in].
+_QKV = ("attention.query_key_value.weight",
+        "attention.query_key_value.bias")
+_CAT_DIM0 = ("word_embeddings.weight",
+             "mlp.dense_h_to_4h.weight", "mlp.dense_h_to_4h.bias")
+_CAT_DIM1 = ("attention.dense.weight", "mlp.dense_4h_to_h.weight")
+
+
+def _np(v):
+    if hasattr(v, "detach"):
+        v = v.detach().cpu().numpy()
+    return np.asarray(v)
+
+
+def _load_shard(path):
+    import torch
+    sd = torch.load(path, map_location="cpu", weights_only=False)
+    # Megatron checkpoints nest the model under 'model' or 'module'
+    for k in ("module", "model"):
+        if isinstance(sd, dict) and k in sd and isinstance(sd[k], dict):
+            sd = sd[k]
+    return sd
+
+
+def resolve_checkpoint_list(path) -> tuple:
+    """(ckpt_files, version): from a JSON descriptor (the
+    SDLoaderFactory contract), a directory of ``mp_rank_XX_*`` files,
+    or an explicit list."""
+    if isinstance(path, (list, tuple)):
+        return list(path), 0
+    if os.path.isfile(path) and path.endswith(".json"):
+        with open(path) as f:
+            data = json.load(f)
+        base = data.get("base_dir", os.path.dirname(path))
+        ckpts = data["checkpoints"]
+        if isinstance(ckpts, dict):        # {"tp": [...]} nested form
+            ckpts = ckpts.get("tp") or next(iter(ckpts.values()))
+        files = [c if os.path.isabs(c) else os.path.join(base, c)
+                 for c in ckpts]
+        return files, float(data.get("version", 0))
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "mp_rank_*")))
+        if not files:
+            files = sorted(glob.glob(os.path.join(path, "*.pt")))
+        if not files:
+            raise FileNotFoundError(
+                f"no mp_rank_* or *.pt shards under {path}")
+        return files, 0
+    raise FileNotFoundError(path)
+
+
+def _merge_qkv(parts: List[np.ndarray], version: float) -> np.ndarray:
+    """Version-aware fused-QKV merge (MegatronSDLoader.merge_query_key_value,
+    state_dict_factory.py:221): v0 stores [3*np*hn, h] per shard (split
+    each into its q/k/v thirds, concatenate per component); v1/v2 store
+    head-interleaved [np*…*3…, h] and concatenate directly."""
+    if version == 0:
+        if parts[0].shape[0] % 3:
+            raise ValueError(f"v0 fused QKV dim {parts[0].shape[0]} "
+                             "not divisible by 3")
+        comps = []
+        for c in range(3):
+            comps.append(np.concatenate(
+                [p[c * (p.shape[0] // 3):(c + 1) * (p.shape[0] // 3)]
+                 for p in parts], axis=0))
+        return np.concatenate(comps, axis=0)
+    if version in (1.0, 2.0):
+        return np.concatenate(parts, axis=0)
+    raise ValueError(f"unsupported Megatron checkpoint version "
+                     f"{version}")
+
+
+def merge_tp_shards(shards: List[Dict], version: float = 0
+                    ) -> Dict[str, np.ndarray]:
+    """List of per-mp-rank state dicts -> one full state dict."""
+    keys = list(shards[0].keys())
+    for sd in shards[1:]:
+        if list(sd.keys()) != keys:
+            raise ValueError("mp shards disagree on parameter names")
+    out = {}
+    for key in keys:
+        parts = [_np(sd[key]) for sd in shards]
+        if key.endswith(_QKV):
+            out[key] = _merge_qkv(parts, version)
+        elif key.endswith(_CAT_DIM0):
+            out[key] = np.concatenate(parts, axis=0)
+        elif key.endswith(_CAT_DIM1):
+            out[key] = np.concatenate(parts, axis=1)
+        else:
+            # replicated (norms, row-parallel biases, positions):
+            # verify the ranks actually agree before taking rank 0
+            for i, p in enumerate(parts[1:], 1):
+                if p.shape != parts[0].shape or not np.allclose(
+                        p, parts[0], atol=1e-6):
+                    raise ValueError(
+                        f"{key}: expected replicated across mp ranks "
+                        f"but rank {i} differs")
+            out[key] = parts[0]
+    return out
+
+
+def megatron_gpt2_to_hf(sd: Dict[str, np.ndarray],
+                        vocab_size: Optional[int] = None
+                        ) -> Dict[str, np.ndarray]:
+    """Merged Megatron-GPT names/layout -> HF GPT-2 layout, so the
+    existing family converter (gpt2.from_hf_state_dict) finishes the
+    job. Linear weights transpose ([out,in] -> Conv1D's [in,out]);
+    the padded word-embedding rows are trimmed to ``vocab_size``."""
+    out = {}
+
+    def put(dst, v, transpose=False):
+        out[dst] = v.T if transpose else v
+
+    for key, v in sd.items():
+        k = key
+        # tolerate both bare and 'transformer.'/'language_model.' roots
+        for root in ("language_model.", "transformer.", "encoder."):
+            if k.startswith(root):
+                k = k[len(root):]
+        if k.endswith("word_embeddings.weight"):
+            if vocab_size is not None:
+                v = v[:vocab_size]
+            put("wte.weight", v)
+        elif k.endswith("position_embeddings.weight"):
+            put("wpe.weight", v)
+        elif k == "final_layernorm.weight":
+            put("ln_f.weight", v)
+        elif k == "final_layernorm.bias":
+            put("ln_f.bias", v)
+        elif k.startswith("layers."):
+            _, i, rest = k.split(".", 2)
+            base = f"h.{i}."
+            table = {
+                "input_layernorm.weight": ("ln_1.weight", False),
+                "input_layernorm.bias": ("ln_1.bias", False),
+                "post_attention_layernorm.weight": ("ln_2.weight",
+                                                    False),
+                "post_attention_layernorm.bias": ("ln_2.bias", False),
+                "attention.query_key_value.weight": ("attn.c_attn.weight",
+                                                     True),
+                "attention.query_key_value.bias": ("attn.c_attn.bias",
+                                                   False),
+                "attention.dense.weight": ("attn.c_proj.weight", True),
+                "attention.dense.bias": ("attn.c_proj.bias", False),
+                "mlp.dense_h_to_4h.weight": ("mlp.c_fc.weight", True),
+                "mlp.dense_h_to_4h.bias": ("mlp.c_fc.bias", False),
+                "mlp.dense_4h_to_h.weight": ("mlp.c_proj.weight", True),
+                "mlp.dense_4h_to_h.bias": ("mlp.c_proj.bias", False),
+            }
+            if rest not in table:
+                raise KeyError(f"unmapped Megatron layer key: {key}")
+            dst, tr = table[rest]
+            put(base + dst, v, tr)
+        else:
+            raise KeyError(f"unmapped Megatron key: {key}")
+    return out
+
+
+def load_megatron_checkpoint(path, config, model_type: str = "gpt2"):
+    """(model, params) from a TP-sharded Megatron checkpoint dir /
+    JSON descriptor / file list — registry entry point."""
+    from .registry import from_pretrained_state_dict
+
+    files, version = resolve_checkpoint_list(path)
+    merged = merge_tp_shards([_load_shard(f) for f in files], version)
+    if model_type != "gpt2":
+        raise NotImplementedError(
+            f"Megatron-sharded loading is implemented for the "
+            f"Megatron-GPT layout (model_type='gpt2'); got "
+            f"{model_type!r}. Other families' sharded checkpoints "
+            f"ship in per-family HF shards, which the normal "
+            f"from_pretrained path already consumes.")
+    hf_sd = megatron_gpt2_to_hf(merged,
+                                vocab_size=getattr(config, "vocab_size",
+                                                   None))
+    return from_pretrained_state_dict(hf_sd, config,
+                                      model_type=model_type)

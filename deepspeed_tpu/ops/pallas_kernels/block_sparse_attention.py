@@ -33,20 +33,55 @@ _NEG_INF = float("-inf")
 # ---------------------------------------------------------------------------
 def make_layout(pattern: str, n_q_blocks: int, n_k_blocks: int,
                 num_local_blocks: int = 4, num_global_blocks: int = 1,
-                num_random_blocks: int = 0, seed: int = 0) -> np.ndarray:
-    """[n_q_blocks, n_k_blocks] bool block mask."""
+                num_random_blocks: int = 0, seed: int = 0,
+                local_window_blocks=None,
+                global_block_indices=None) -> np.ndarray:
+    """[n_q_blocks, n_k_blocks] bool block mask.
+
+    Patterns mirror the reference's SparsityConfig family
+    (ops/sparse_attention/sparsity_config.py: Dense/Fixed/Variable/
+    BigBird/BSLongformer):
+
+    - "dense": every block active (DenseSparsityConfig — the debugging
+      baseline).
+    - "fixed"/"longformer"/"bigbird": sliding local window +
+      leading global rows/columns (+ random blocks for bigbird).
+    - "variable": block-diagonal local GROUPS of varying width
+      (``local_window_blocks`` — successive groups take successive
+      sizes, the last repeats, VariableSparsityConfig semantics),
+      global rows/columns at explicit ``global_block_indices``, plus
+      optional random blocks.
+    """
     L = np.zeros((n_q_blocks, n_k_blocks), bool)
     q = np.arange(n_q_blocks)[:, None]
     k = np.arange(n_k_blocks)[None, :]
+    if pattern == "dense":
+        L[:] = True
+        return L
     if pattern in ("fixed", "longformer", "bigbird"):
         # sliding window of local blocks
         L |= (np.abs(q - k) < num_local_blocks)
         # global columns (and rows) at the start
         L[:, :num_global_blocks] = True
         L[:num_global_blocks, :] = True
+    elif pattern == "variable":
+        windows = list(local_window_blocks or [num_local_blocks])
+        start, wi = 0, 0
+        while start < n_q_blocks:
+            w = max(1, int(windows[min(wi, len(windows) - 1)]))
+            end = min(start + w, n_q_blocks)
+            L[start:end, start:min(end, n_k_blocks)] = True
+            start, wi = end, wi + 1
+        for gi in (global_block_indices
+                   if global_block_indices is not None
+                   else range(num_global_blocks)):
+            if gi < n_k_blocks:
+                L[:, gi] = True
+            if gi < n_q_blocks:
+                L[gi, :] = True
     else:
         raise ValueError(f"unknown sparsity pattern {pattern!r}")
-    if pattern == "bigbird" and num_random_blocks:
+    if pattern in ("bigbird", "variable") and num_random_blocks:
         rng = np.random.default_rng(seed)
         for i in range(n_q_blocks):
             L[i, rng.choice(n_k_blocks, size=num_random_blocks,

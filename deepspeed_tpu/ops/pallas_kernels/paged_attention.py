@@ -227,7 +227,8 @@ def _paged_call(q4, kp4, vp4, tables, slens, qcnts, *, sm_scale,
 def paged_attention(q, k_pool, v_pool, block_tables, seq_lens, q_counts,
                     token_seq, token_qidx, *, block_size, sm_scale=None,
                     alibi_slopes=None, window=0, q_block=128,
-                    force_pallas=False, interpret=False):
+                    force_pallas=False, force_reference=False,
+                    interpret=False):
     """Attention of packed ragged tokens over a paged KV pool.
 
     q: [B, Hq, D] packed; k_pool/v_pool: [Hkv, (n_blocks+1)*block, D];
@@ -248,9 +249,18 @@ def paged_attention(q, k_pool, v_pool, block_tables, seq_lens, q_counts,
     q_block = int(min(q_block, -(-max(B, 1) // 8) * 8))
     tileable = (hd % 64 == 0 and block_size % 128 == 0
                 and (rep * hd) % 128 == 0 and q_block % 8 == 0)
-    use_pallas = force_pallas or interpret or \
-        (tileable and jax.default_backend() == "tpu")
+    if force_reference and force_pallas:
+        raise ValueError("force_reference and force_pallas conflict")
+    use_pallas = not force_reference and (
+        force_pallas or interpret or
+        (tileable and jax.default_backend() == "tpu"))
     if not use_pallas:
+        if force_reference:
+            return paged_attention_reference(
+                q, k_pool, v_pool, block_tables, seq_lens, q_counts,
+                token_seq, token_qidx, block_size=block_size,
+                sm_scale=sm_scale, alibi_slopes=alibi_slopes,
+                window=window)
         if jax.default_backend() == "tpu" and not tileable:
             logger.warning(
                 f"paged_attention falling back to the XLA gather path on "

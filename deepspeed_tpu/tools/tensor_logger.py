@@ -163,6 +163,9 @@ def load_tensor_log(filename):
     out = collections.defaultdict(_iter_data)
     with np.load(filename) as data:
         for key in data.files:
-            it, kind, name, idx = key.split("|")
+            # split from both ends: a module/param NAME containing '|'
+            # must not break the 4-field unpack
+            it, kind, rest = key.split("|", 2)
+            name, idx = rest.rsplit("|", 1)
             out[int(it[2:])][kind][name].append(data[key])
     return dict(out)

@@ -1,0 +1,73 @@
+"""CLIP text encoder (the SD conditioning model; reference
+module_inject/containers/clip.py HFCLIPLayerPolicy): hidden-state AND
+pooled-output parity vs HF transformers, registry detection, TP rules.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from deepspeed_tpu.models.clip import (CLIPTextConfig, CLIPTextModel,
+                                       from_hf_state_dict)
+
+
+def _pair():
+    hf_cfg = transformers.CLIPTextConfig(
+        vocab_size=256, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=32, hidden_act="quick_gelu",
+        eos_token_id=255, bos_token_id=254, attention_dropout=0.0)
+    torch.manual_seed(0)
+    hf = transformers.CLIPTextModel(hf_cfg).eval()
+    cfg = CLIPTextConfig.tiny()
+    return hf, cfg
+
+
+def test_hidden_and_pooled_match_hf(rng):
+    hf, cfg = _pair()
+    params = from_hf_state_dict(hf.state_dict(), cfg)
+    ids = rng.integers(0, 250, (2, 16)).astype(np.int32)
+    ids[:, -1] = 255                      # EOS terminates each row
+    with torch.no_grad():
+        out = hf(input_ids=torch.tensor(ids, dtype=torch.long))
+    hidden, pooled = CLIPTextModel(cfg).apply(params, ids)
+    np.testing.assert_allclose(np.asarray(hidden),
+                               out.last_hidden_state.numpy(),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(pooled),
+                               out.pooler_output.numpy(),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_registry_detects_clip():
+    from deepspeed_tpu.models.registry import detect_policy
+    sd = {"text_model.embeddings.token_embedding.weight": None}
+    assert detect_policy(sd).name == "clip"
+
+
+def test_tp_rules_cover_projections():
+    from deepspeed_tpu.models.clip import clip_tensor_rules
+    assert clip_tensor_rules("layers_0.self_attn.q_proj.kernel",
+                             (32, 32)) is not None
+    assert clip_tensor_rules("layers_0.fc2.kernel", (64, 32)) is not None
+    assert clip_tensor_rules("final_layer_norm.scale", (32,)) is None
+
+
+def test_serves_through_v1_engine(rng):
+    """The encoder runs under the inference engine's jit forward (the
+    SD text-conditioning serving path)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.parallel.mesh import mesh_manager
+
+    hf, cfg = _pair()
+    params = from_hf_state_dict(hf.state_dict(), cfg)
+    mesh_manager.reset()
+    engine = deepspeed_tpu.init_inference(CLIPTextModel(cfg), tp_size=1,
+                                          dtype="float32")
+    engine.set_params(params)
+    ids = rng.integers(0, 250, (2, 16)).astype(np.int32)
+    hidden, pooled = engine.forward(ids)
+    assert hidden.shape == (2, 16, 32) and pooled.shape == (2, 32)
+    assert np.isfinite(np.asarray(hidden)).all()

@@ -1,0 +1,181 @@
+"""Megatron TP-sharded checkpoint interop (reference
+runtime/state_dict_factory.py:190 MegatronSDLoader): a synthetic
+2-way-sharded GPT-2 checkpoint must merge back to EXACTLY the params
+the unsharded HF state dict converts to — qkv per version, column/row
+concat axes, replication checks, name/layout mapping."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, from_hf_state_dict
+from deepspeed_tpu.models.registry import from_sharded_checkpoint
+from deepspeed_tpu.models.sharded_checkpoint import (
+    megatron_gpt2_to_hf, merge_tp_shards, resolve_checkpoint_list)
+
+H, L, V, POS = 16, 2, 64, 32
+
+
+def _hf_sd(rng):
+    """Random HF-layout GPT-2 state dict (Conv1D weights [in, out])."""
+    sd = {"transformer.wte.weight": rng.normal(size=(V, H)),
+          "transformer.wpe.weight": rng.normal(size=(POS, H)),
+          "transformer.ln_f.weight": rng.normal(size=(H,)),
+          "transformer.ln_f.bias": rng.normal(size=(H,))}
+    for i in range(L):
+        p = f"transformer.h.{i}."
+        sd[p + "ln_1.weight"] = rng.normal(size=(H,))
+        sd[p + "ln_1.bias"] = rng.normal(size=(H,))
+        sd[p + "ln_2.weight"] = rng.normal(size=(H,))
+        sd[p + "ln_2.bias"] = rng.normal(size=(H,))
+        sd[p + "attn.c_attn.weight"] = rng.normal(size=(H, 3 * H))
+        sd[p + "attn.c_attn.bias"] = rng.normal(size=(3 * H,))
+        sd[p + "attn.c_proj.weight"] = rng.normal(size=(H, H))
+        sd[p + "attn.c_proj.bias"] = rng.normal(size=(H,))
+        sd[p + "mlp.c_fc.weight"] = rng.normal(size=(H, 4 * H))
+        sd[p + "mlp.c_fc.bias"] = rng.normal(size=(4 * H,))
+        sd[p + "mlp.c_proj.weight"] = rng.normal(size=(4 * H, H))
+        sd[p + "mlp.c_proj.bias"] = rng.normal(size=(H,))
+    return {k: v.astype(np.float32) for k, v in sd.items()}
+
+
+def _megatron_shards(hf, tp=2, version=2.0):
+    """HF dict -> ``tp`` Megatron mp-rank state dicts (torch layout
+    [out, in]; fused qkv; column/row splits per MegatronSDLoader's
+    table)."""
+    shards = [{} for _ in range(tp)]
+
+    def split0(v):
+        return np.split(v, tp, axis=0)
+
+    def split1(v):
+        return np.split(v, tp, axis=1)
+
+    def rep(v):
+        return [v] * tp
+
+    def qkv_w(w_hf):                       # [H, 3H] -> fused [3H, H]
+        full = w_hf.T                      # [3H, H]: q;k;v blocks
+        q, k, vv = np.split(full, 3, axis=0)
+        if version == 0:
+            # per shard: [q_i; k_i; v_i] stacked
+            qs, ks, vs = (np.split(a, tp, axis=0) for a in (q, k, vv))
+            return [np.concatenate([qs[i], ks[i], vs[i]], axis=0)
+                    for i in range(tp)]
+        return split0(full)                # v1/v2: plain dim-0 split
+
+    def qkv_b(b_hf):
+        full = b_hf                        # [3H]
+        q, k, vv = np.split(full, 3, axis=0)
+        if version == 0:
+            qs, ks, vs = (np.split(a, tp, axis=0) for a in (q, k, vv))
+            return [np.concatenate([qs[i], ks[i], vs[i]], axis=0)
+                    for i in range(tp)]
+        return split0(full)
+
+    def put(key, parts):
+        for i in range(tp):
+            shards[i][key] = parts[i]
+
+    put("word_embeddings.weight", split0(hf["transformer.wte.weight"]))
+    put("position_embeddings.weight",
+        rep(hf["transformer.wpe.weight"]))
+    put("final_layernorm.weight", rep(hf["transformer.ln_f.weight"]))
+    put("final_layernorm.bias", rep(hf["transformer.ln_f.bias"]))
+    for i in range(L):
+        p = f"transformer.h.{i}."
+        m = f"layers.{i}."
+        put(m + "input_layernorm.weight", rep(hf[p + "ln_1.weight"]))
+        put(m + "input_layernorm.bias", rep(hf[p + "ln_1.bias"]))
+        put(m + "post_attention_layernorm.weight",
+            rep(hf[p + "ln_2.weight"]))
+        put(m + "post_attention_layernorm.bias",
+            rep(hf[p + "ln_2.bias"]))
+        put(m + "attention.query_key_value.weight",
+            qkv_w(hf[p + "attn.c_attn.weight"]))
+        put(m + "attention.query_key_value.bias",
+            qkv_b(hf[p + "attn.c_attn.bias"]))
+        put(m + "attention.dense.weight",
+            split1(hf[p + "attn.c_proj.weight"].T))
+        put(m + "attention.dense.bias", rep(hf[p + "attn.c_proj.bias"]))
+        put(m + "mlp.dense_h_to_4h.weight",
+            split0(hf[p + "mlp.c_fc.weight"].T))
+        put(m + "mlp.dense_h_to_4h.bias", split0(hf[p + "mlp.c_fc.bias"]))
+        put(m + "mlp.dense_4h_to_h.weight",
+            split1(hf[p + "mlp.c_proj.weight"].T))
+        put(m + "mlp.dense_4h_to_h.bias", rep(hf[p + "mlp.c_proj.bias"]))
+    return shards
+
+
+def _assert_tree_equal(a, b, path=""):
+    if isinstance(a, dict):
+        assert set(a) == set(b), (path, set(a) ^ set(b))
+        for k in a:
+            _assert_tree_equal(a[k], b[k], f"{path}.{k}")
+    else:
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, err_msg=path)
+
+
+@pytest.mark.parametrize("version", [0, 2.0])
+def test_merge_roundtrips_to_unsharded_params(rng, version):
+    hf = _hf_sd(rng)
+    cfg = GPT2Config(vocab_size=V, n_positions=POS, n_embd=H,
+                     n_layer=L, n_head=4)
+    ref = from_hf_state_dict(hf, cfg)
+
+    merged = merge_tp_shards(_megatron_shards(hf, 2, version), version)
+    got = from_hf_state_dict(megatron_gpt2_to_hf(merged, V), cfg)
+    _assert_tree_equal(got, ref)
+
+
+def test_registry_accepts_sharded_dir(rng, tmp_path):
+    import torch
+    hf = _hf_sd(rng)
+    cfg = GPT2Config(vocab_size=V, n_positions=POS, n_embd=H,
+                     n_layer=L, n_head=4)
+    for i, sd in enumerate(_megatron_shards(hf, 2, 2.0)):
+        torch.save({"module": {k: torch.from_numpy(np.ascontiguousarray(v))
+                               for k, v in sd.items()}},
+                   tmp_path / f"mp_rank_{i:02d}_model_states.pt")
+    # descriptor JSON drives versioning (SDLoaderFactory contract)
+    desc = tmp_path / "ds_model_config.json"
+    desc.write_text(json.dumps({
+        "type": "Megatron", "version": 2.0, "parallelization": "tp",
+        "checkpoints": [f"mp_rank_{i:02d}_model_states.pt"
+                        for i in range(2)]}))
+
+    model, params = from_sharded_checkpoint(str(desc), cfg)
+    ref = from_hf_state_dict(hf, cfg)
+    _assert_tree_equal(params, ref)
+
+    # the bare directory works too (glob of mp_rank_*, version 0 is
+    # wrong for this fixture's qkv — only structure is checked here)
+    files, ver = resolve_checkpoint_list(str(tmp_path))
+    assert len(files) == 2 and ver == 0
+
+    # and the params actually serve: logits finite through the engine
+    import deepspeed_tpu
+    from deepspeed_tpu.parallel.mesh import mesh_manager
+    mesh_manager.reset()
+    engine = deepspeed_tpu.init_inference(model, tp_size=1,
+                                          dtype="float32")
+    engine.set_params(params)
+    logits = engine.forward(np.zeros((1, 8), np.int32))
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_replication_mismatch_rejected(rng):
+    hf = _hf_sd(rng)
+    shards = _megatron_shards(hf, 2, 2.0)
+    shards[1]["final_layernorm.weight"] = \
+        shards[1]["final_layernorm.weight"] + 1.0
+    with pytest.raises(ValueError, match="replicated"):
+        merge_tp_shards(shards, 2.0)
+
+
+def test_unknown_key_rejected(rng):
+    with pytest.raises(KeyError, match="unmapped"):
+        megatron_gpt2_to_hf({"mystery.weight": np.zeros((2, 2))})

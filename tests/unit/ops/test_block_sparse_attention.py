@@ -40,6 +40,24 @@ class TestLayouts:
                                      num_local_blocks=1,
                                      num_global_blocks=1).sum()
 
+    def test_dense_layout(self):
+        assert make_layout("dense", 5, 7).all()
+
+    def test_variable_layout(self):
+        """VariableSparsityConfig semantics: block-diagonal local
+        groups of declared widths (last width repeats), globals at
+        explicit indices."""
+        L = make_layout("variable", 8, 8,
+                        local_window_blocks=[1, 2],
+                        global_block_indices=[3])
+        assert L[0, 0] and not L[0, 1]       # width-1 group
+        assert L[1, 1] and L[1, 2] and L[2, 1]   # width-2 group
+        assert L[4, 3] and L[3, 6]           # global col + row at 3
+        # the last width (2) repeats for the remaining groups
+        assert L[5, 6] and L[6, 5] and not L[5, 7]
+        with pytest.raises(ValueError, match="unknown"):
+            make_layout("mystery", 4, 4)
+
 
 class TestKernel:
 

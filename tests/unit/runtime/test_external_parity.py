@@ -78,3 +78,59 @@ def test_gpt2_loss_curve_matches_torch(tiny_hf_gpt2):
     np.testing.assert_allclose(ours[0], ref_losses[0], rtol=1e-3)
     np.testing.assert_allclose(ours, ref_losses, rtol=2e-2)
     assert ours[-1] < ours[0]
+
+
+def test_gpt2_long_horizon_bf16_zero3_tracks_torch(tiny_hf_gpt2):
+    """The north-star recipe over a LONG horizon: 100 steps of bf16
+    compute + sharded fp32 master under ZeRO-3 must stay inside the
+    torch fp32 loss-curve envelope — bf16 rounding wobbles per step
+    but must not drift (the reference's Megatron_GPT2
+    run_sanity_check.py convergence pattern)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2LMHeadModel,
+                                           from_hf_state_dict)
+    from deepspeed_tpu.parallel.mesh import mesh_manager
+
+    hf_cfg, hf_model = tiny_hf_gpt2
+    lr, steps = 1e-3, 100
+    rng = np.random.default_rng(1)
+    B = 8
+    ids = rng.integers(0, 256, size=(B, 32), dtype=np.int32)
+
+    init_sd = {k: v.detach().clone()
+               for k, v in hf_model.state_dict().items()}
+    ref_losses = _torch_losses(hf_model, ids, lr, steps)
+
+    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=32,
+                     n_layer=2, n_head=4, dropout=0.0)
+    params = from_hf_state_dict(init_sd, cfg)
+    mesh_manager.reset()
+    config = {
+        "train_micro_batch_size_per_gpu": max(1, B // 8),
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": lr, "betas": (0.9, 0.999),
+                                 "eps": 1e-8, "weight_decay": 0.0}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3},
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(cfg), config=config,
+        model_parameters=params)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    ours = [float(engine.train_batch(batch=batch))
+            for _ in range(steps)]
+
+    ours = np.asarray(ours)
+    ref = np.asarray(ref_losses)
+    # start matched to bf16 forward rounding
+    np.testing.assert_allclose(ours[0], ref[0], rtol=2e-2)
+    # envelope: windowed means track torch over the whole horizon
+    w = 10
+    ours_w = ours.reshape(-1, w).mean(axis=1)
+    ref_w = ref.reshape(-1, w).mean(axis=1)
+    np.testing.assert_allclose(ours_w, ref_w, rtol=6e-2)
+    # same endpoint, real convergence
+    np.testing.assert_allclose(ours[-10:].mean(), ref[-10:].mean(),
+                               rtol=0.1)
+    assert ours[-10:].mean() < 0.5 * ours[:5].mean()
