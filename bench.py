@@ -289,48 +289,58 @@ def bench_config5(weight_dtype="bfloat16"):
     }
 
 
-def _reset_mesh():
-    from deepspeed_tpu.parallel.mesh import mesh_manager
-    mesh_manager.reset()
-
-
 def main():
     # the driver contract is ONE JSON line on stdout; the engine's
     # rank-0 INFO logging would interleave with it
     import logging
     logging.getLogger("DeepSpeedTPU").setLevel(logging.WARNING)
     p = argparse.ArgumentParser()
-    p.add_argument("--config", type=int, default=0,
-                   choices=[0, 1, 2, 3, 4, 5],
-                   help="0 (default) = ALL tracked configs in one run")
+    p.add_argument("--config", type=str, default="0",
+                   choices=["0", "1", "2", "3", "4", "5", "5_int8"],
+                   help="0 (default) = ALL tracked configs")
     args = p.parse_args()
-    fns = {1: bench_config1, 2: bench_config2, 3: bench_config3,
-           4: bench_config4, 5: bench_config5}
-    if args.config:
+    fns = {"1": bench_config1, "2": bench_config2, "3": bench_config3,
+           "4": bench_config4, "5": bench_config5,
+           "5_int8": lambda: bench_config5(weight_dtype="int8")}
+    if args.config != "0":
         print(json.dumps(fns[args.config]()))
         return
 
-    # Default: the full tracked table (VERDICT round 3 item 2 — the
-    # driver artifact carries configs 1-5, median-of-5 each with a
-    # variance field, plus config 4's decomposition and config 5's
-    # int8 weight-only serving row). Scored config 1 runs FIRST and a
-    # wall-clock budget (DSTPU_BENCH_BUDGET seconds, default 2400)
-    # skips the tail instead of letting a driver timeout lose
-    # everything.
+    # Default: the full tracked table — EACH ROW IN ITS OWN SUBPROCESS.
+    # A 7B-shape engine's HBM is not reliably reclaimed when the next
+    # engine is built in the same process/tunnel session (measured:
+    # rows 2-5 die RESOURCE_EXHAUSTED after row 1 in-process), so the
+    # per-row isolation the perf sweeps already use applies here too.
+    # Scored config 1 runs FIRST; a wall-clock budget
+    # (DSTPU_BENCH_BUDGET seconds, default 2400) skips the tail
+    # instead of letting a driver timeout lose everything.
     import os
+    import subprocess
+    import sys
     budget = float(os.environ.get("DSTPU_BENCH_BUDGET", "2400"))
     t_start = time.time()
     configs = {}
-    for key, fn in [("1", bench_config1), ("3", bench_config3),
-                    ("4", bench_config4), ("2", bench_config2),
-                    ("5", bench_config5),
-                    ("5_int8", lambda: bench_config5(weight_dtype="int8"))]:
+    for key in ("1", "3", "4", "2", "5", "5_int8"):
         if key != "1" and time.time() - t_start > budget * 0.8:
             configs[key] = {"skipped": "bench time budget"}
             continue
-        _reset_mesh()
         try:
-            configs[key] = fn()
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--config", key],
+                capture_output=True, text=True,
+                timeout=max(120.0, budget - (time.time() - t_start)),
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            line = next((ln for ln in
+                         reversed(proc.stdout.strip().splitlines())
+                         if ln.startswith("{")), None)
+            if proc.returncode == 0 and line:
+                configs[key] = json.loads(line)
+            else:
+                configs[key] = {"error": (proc.stderr or
+                                          proc.stdout or "")[-300:]}
+        except subprocess.TimeoutExpired:
+            configs[key] = {"error": "row timeout"}
         except Exception as e:  # one config must not hide the others
             configs[key] = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
     head = dict(configs.get("1") or {})

@@ -56,14 +56,24 @@ class InferenceEngineV2:
         self._config = engine_config or RaggedInferenceEngineConfig()
         ec = self._config
         self.model_config = config
+        # implementation selection FIRST (heuristics.py — the
+        # reference's config->implementation seam): a typo'd impl name
+        # must fail before the tree is quantized or pools allocated
+        from ..quantization import woq_bits_from_dtype
+        from .heuristics import (instantiate_attention,
+                                 instantiate_linear, instantiate_moe)
+        bits = woq_bits_from_dtype(ec.weight_dtype)
+        attn_kwargs = instantiate_attention(ec.attn_impl)
+        self.linear_impl = instantiate_linear(
+            ec.linear_impl, quantized=bits is not None,
+            tp_size=ec.tp_size)
+        self.moe_impl = instantiate_moe(ec.moe_impl, ep_size=ec.ep_size)
         # one-time policy/LayerContainer mapping: family params ->
         # (static arch spec, normalized tree) — reference analog:
         # v2/model_implementations/layer_container_base.py
         self.spec, self.tree = normalize_params(
             jax.tree_util.tree_map(jnp.asarray, params), config)
         self._woq_bits = None
-        from ..quantization import woq_bits_from_dtype
-        bits = woq_bits_from_dtype(ec.weight_dtype)
         if bits is not None:
             # WOQ serving (reference: fp6_linear.cu's role — packed
             # weights in HBM, dequant fused into the ragged matmuls)
@@ -109,15 +119,6 @@ class InferenceEngineV2:
         if ec.tp_size > 1 and self.spec.n_kv_heads % ec.tp_size == 0:
             from ...parallel.mesh import TENSOR_AXIS
             tp_axis = TENSOR_AXIS
-        # implementation selection (heuristics.py — the reference's
-        # config->implementation seam)
-        from .heuristics import (instantiate_attention,
-                                 instantiate_linear, instantiate_moe)
-        attn_kwargs = instantiate_attention(ec.attn_impl)
-        self.linear_impl = instantiate_linear(
-            ec.linear_impl, quantized=self._woq_bits is not None,
-            tp_size=ec.tp_size)
-        self.moe_impl = instantiate_moe(ec.moe_impl, ep_size=ec.ep_size)
         ep_axis = None
         if self.moe_impl == "expert_parallel":
             from ...parallel.mesh import EXPERT_AXIS

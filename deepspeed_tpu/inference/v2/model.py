@@ -286,21 +286,28 @@ def _adapt_bloom(p, cfg):
 def _adapt_falcon(p, cfg):
     nh, nkv, hd = (cfg.num_attention_heads, cfg.num_kv_heads,
                    cfg.head_dim)
+    # falcon-40b's new_decoder_architecture: parallel branches fed by
+    # TWO norms (ln_attn for attention, ln_mlp for the MLP) — exactly
+    # parallel_residual without shared_ln in the generic forward
+    new_arch = getattr(cfg, "new_decoder_architecture", False)
     spec = RaggedSpec(
         n_layers=cfg.num_hidden_layers, n_heads=nh, n_kv_heads=nkv,
         head_dim=hd, vocab_size=cfg.vocab_size, norm="ln",
         eps=cfg.layer_norm_epsilon, pos="rope",
         rope_theta=cfg.rope_theta, act="gelu",
-        parallel_residual=cfg.parallel_attn,
-        shared_ln=cfg.parallel_attn)
+        # new_decoder_architecture is ALWAYS parallel (HF ignores
+        # parallel_attn when it is set)
+        parallel_residual=cfg.parallel_attn or new_arch,
+        shared_ln=cfg.parallel_attn and not new_arch)
     layers = []
     for i in range(cfg.num_hidden_layers):
         lp = p[f"h_{i}"]
         qkv = lp["self_attention"]["query_key_value"]["kernel"]
         qkv_b = lp["self_attention"]["query_key_value"].get("bias")
+        ln1 = lp["ln_attn"] if new_arch else lp["input_layernorm"]
         layer = {
-            "ln1_scale": lp["input_layernorm"]["scale"],
-            "ln1_bias": lp["input_layernorm"]["bias"],
+            "ln1_scale": ln1["scale"],
+            "ln1_bias": ln1["bias"],
             "wq": qkv[:, :nh * hd],
             "wk": qkv[:, nh * hd:(nh + nkv) * hd],
             "wv": qkv[:, (nh + nkv) * hd:],
@@ -315,7 +322,10 @@ def _adapt_falcon(p, cfg):
             layer["bq"] = qkv_b[:nh * hd]
             layer["bk"] = qkv_b[nh * hd:(nh + nkv) * hd]
             layer["bv"] = qkv_b[(nh + nkv) * hd:]
-        if not cfg.parallel_attn:
+        if new_arch:
+            layer["ln2_scale"] = lp["ln_mlp"]["scale"]
+            layer["ln2_bias"] = lp["ln_mlp"]["bias"]
+        elif not cfg.parallel_attn:
             layer["ln2_scale"] = lp["post_attention_layernorm"]["scale"]
             layer["ln2_bias"] = lp["post_attention_layernorm"]["bias"]
         layers.append(layer)

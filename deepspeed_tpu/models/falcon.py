@@ -99,32 +99,26 @@ class FalconDecoderLayer(nn.Module):
     def __call__(self, x, positions):
         cfg = self.config
         if cfg.new_decoder_architecture:
-            # falcon-40b: two norms feed the parallel branches
+            # falcon-40b: two norms feed the (always parallel) branches
             h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
                              name="ln_attn")(x)
             m_in = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
                                 name="ln_mlp")(x)
             attn = FalconAttention(cfg, name="self_attention")(
                 h, positions)
-            m = nn.Dense(4 * cfg.hidden_size, name="dense_h_to_4h",
-                         use_bias=cfg.bias,
-                         kernel_init=nn.initializers.normal(
-                             cfg.initializer_range))(m_in)
-            m = nn.gelu(m, approximate=False)
-            m = nn.Dense(cfg.hidden_size, name="dense_4h_to_h",
-                         use_bias=cfg.bias,
-                         kernel_init=nn.initializers.normal(
-                             cfg.initializer_range))(m)
-            return x + attn + m
-        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
-                         name="input_layernorm")(x)
-        attn = FalconAttention(cfg, name="self_attention")(h, positions)
-        if cfg.parallel_attn:
-            m_in = h                      # shared LN (falcon-7b)
+            parallel = True
         else:
-            x = x + attn
-            m_in = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
-                                name="post_attention_layernorm")(x)
+            h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                             name="input_layernorm")(x)
+            attn = FalconAttention(cfg, name="self_attention")(
+                h, positions)
+            parallel = cfg.parallel_attn
+            if parallel:
+                m_in = h                  # shared LN (falcon-7b)
+            else:
+                x = x + attn
+                m_in = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                                    name="post_attention_layernorm")(x)
         m = nn.Dense(4 * cfg.hidden_size, name="dense_h_to_4h",
                      use_bias=cfg.bias,
                      kernel_init=nn.initializers.normal(
@@ -134,7 +128,7 @@ class FalconDecoderLayer(nn.Module):
                      use_bias=cfg.bias,
                      kernel_init=nn.initializers.normal(
                          cfg.initializer_range))(m)
-        if cfg.parallel_attn:
+        if parallel:
             return x + attn + m
         return x + m
 

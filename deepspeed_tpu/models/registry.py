@@ -159,11 +159,14 @@ def from_pretrained_state_dict(state_dict, config,
     return model, params
 
 
-def from_sharded_checkpoint(path, config, model_type: str = "gpt2"):
+def from_sharded_checkpoint(path, config, model_type: str = "gpt2",
+                            version=None):
     """(model, params) from a Megatron TP-sharded checkpoint — a
     directory of ``mp_rank_XX`` files, an SDLoaderFactory-style JSON
     descriptor, or an explicit file list (reference:
     runtime/state_dict_factory.py:21,190 SDLoaderFactory /
-    MegatronSDLoader)."""
+    MegatronSDLoader). ``version`` supplies the qkv-merge layout when
+    the source carries none."""
     from .sharded_checkpoint import load_megatron_checkpoint
-    return load_megatron_checkpoint(path, config, model_type)
+    return load_megatron_checkpoint(path, config, model_type,
+                                    version=version)

@@ -48,11 +48,13 @@ def _load_shard(path):
 
 
 def resolve_checkpoint_list(path) -> tuple:
-    """(ckpt_files, version): from a JSON descriptor (the
+    """(ckpt_files, version-or-None): from a JSON descriptor (the
     SDLoaderFactory contract), a directory of ``mp_rank_XX_*`` files,
-    or an explicit list."""
+    or an explicit list. ``None`` means the source carried NO version
+    info — the caller must supply one (the qkv merge layout differs
+    per version, so defaulting silently mis-merges)."""
     if isinstance(path, (list, tuple)):
-        return list(path), 0
+        return list(path), None
     if os.path.isfile(path) and path.endswith(".json"):
         with open(path) as f:
             data = json.load(f)
@@ -64,13 +66,18 @@ def resolve_checkpoint_list(path) -> tuple:
                  for c in ckpts]
         return files, float(data.get("version", 0))
     if os.path.isdir(path):
+        # a descriptor inside the dir wins (carries the version)
+        for name in ("ds_model_config.json", "checkpoints.json"):
+            desc = os.path.join(path, name)
+            if os.path.exists(desc):
+                return resolve_checkpoint_list(desc)
         files = sorted(glob.glob(os.path.join(path, "mp_rank_*")))
         if not files:
             files = sorted(glob.glob(os.path.join(path, "*.pt")))
         if not files:
             raise FileNotFoundError(
                 f"no mp_rank_* or *.pt shards under {path}")
-        return files, 0
+        return files, None
     raise FileNotFoundError(path)
 
 
@@ -181,12 +188,22 @@ def megatron_gpt2_to_hf(sd: Dict[str, np.ndarray],
     return out
 
 
-def load_megatron_checkpoint(path, config, model_type: str = "gpt2"):
+def load_megatron_checkpoint(path, config, model_type: str = "gpt2",
+                             version: Optional[float] = None):
     """(model, params) from a TP-sharded Megatron checkpoint dir /
-    JSON descriptor / file list — registry entry point."""
+    JSON descriptor / file list — registry entry point. ``version``
+    overrides (or supplies, for bare dirs/lists that carry none) the
+    qkv-merge layout version."""
     from .registry import from_pretrained_state_dict
 
-    files, version = resolve_checkpoint_list(path)
+    files, src_version = resolve_checkpoint_list(path)
+    version = src_version if version is None else float(version)
+    if version is None:
+        raise ValueError(
+            "Megatron checkpoint version unknown (bare dir / file list "
+            "carries none) — pass version= (0, 1.0 or 2.0; the fused "
+            "QKV layout differs per version, so guessing would "
+            "silently mis-merge)")
     merged = merge_tp_shards([_load_shard(f) for f in files], version)
     if model_type != "gpt2":
         raise NotImplementedError(

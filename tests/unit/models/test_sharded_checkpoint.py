@@ -151,10 +151,21 @@ def test_registry_accepts_sharded_dir(rng, tmp_path):
     ref = from_hf_state_dict(hf, cfg)
     _assert_tree_equal(params, ref)
 
-    # the bare directory works too (glob of mp_rank_*, version 0 is
-    # wrong for this fixture's qkv — only structure is checked here)
+    # the directory resolves through its embedded descriptor (version
+    # carried); a descriptor-less dir yields version None and the
+    # loader REFUSES to guess the qkv layout
     files, ver = resolve_checkpoint_list(str(tmp_path))
-    assert len(files) == 2 and ver == 0
+    assert len(files) == 2 and ver == 2.0
+    os.unlink(desc)
+    files, ver = resolve_checkpoint_list(str(tmp_path))
+    assert len(files) == 2 and ver is None
+    from deepspeed_tpu.models.sharded_checkpoint import \
+        load_megatron_checkpoint
+    with pytest.raises(ValueError, match="version"):
+        load_megatron_checkpoint(str(tmp_path), cfg)
+    # explicit version unblocks the bare dir
+    _, p2 = load_megatron_checkpoint(str(tmp_path), cfg, version=2.0)
+    _assert_tree_equal(p2, ref)
 
     # and the params actually serve: logits finite through the engine
     import deepspeed_tpu

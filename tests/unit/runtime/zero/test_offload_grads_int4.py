@@ -173,6 +173,42 @@ def test_int4_composes_with_delta_upload_and_dpu(eight_devices):
     assert got[-1] < got[0]
 
 
+def test_fp16_overflow_protects_residual(eight_devices):
+    """On an fp16 overflow the host skips the payload AND the device
+    residual must carry the OLD value forward — absorbing the inf/nan
+    wavefront would poison every later step's error feedback."""
+    mesh_manager.reset()
+    cfg = {"train_micro_batch_size_per_gpu": 4,
+           "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           # huge initial scale -> guaranteed overflow on step 1
+           "fp16": {"enabled": True, "initial_scale_power": 18,
+                    "loss_scale_window": 2},
+           "zero_optimization": {
+               "stage": 2,
+               "offload_optimizer": {"device": "cpu",
+                                     "grad_dtype": "int4"}},
+           "steps_per_print": 0}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2LMHeadModel(GPT2Config.tiny()), config=cfg)
+    ids = np.zeros((engine.train_batch_size(), 16), np.int32)
+    b = {"input_ids": ids, "labels": ids}
+    engine.train_batch(batch=b)
+    assert engine.skipped_steps >= 1          # the overflow happened
+    assert engine._offload.host_adam.step_count == 0   # host skipped
+    for r in engine._offload_grad_residual:
+        arr = np.asarray(r)
+        assert np.isfinite(arr).all()
+        np.testing.assert_array_equal(arr, 0.0)   # old (zero) carried
+    # once the scale backs off, training proceeds and the residual
+    # starts carrying real rounding error
+    for _ in range(8):
+        engine.train_batch(batch=b)
+    assert engine._offload.host_adam.step_count >= 1
+    assert all(np.isfinite(np.asarray(r)).all()
+               for r in engine._offload_grad_residual)
+
+
 def test_unknown_grad_dtype_rejected(eight_devices):
     mesh_manager.reset()
     with pytest.raises(ValueError, match="grad_dtype"):

@@ -1,0 +1,85 @@
+"""csrc benchmark evidence (round-4 verdict weak #5): the native C++
+cpu_adam vs the numpy fallback, and the AIO pool vs buffered reads.
+
+Prints one JSON line per measurement; numbers land in BASELINE.md's
+notes so the 'thin but honest' csrc claim carries data.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def bench_cpu_adam(n=25_000_000, iters=5):
+    from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+    rng = np.random.default_rng(0)
+    out = {}
+    for native in (True, False):
+        p = [rng.standard_normal(n).astype(np.float32)]
+        g = [rng.standard_normal(n).astype(np.float32) * 1e-3]
+        opt = DeepSpeedCPUAdam(p, lr=1e-3, use_native=native)
+        if native and not opt.native:
+            out["native"] = "unavailable"
+            continue
+        opt.step(g)                     # warm
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            opt.step(g)
+            ts.append(time.perf_counter() - t0)
+        med = sorted(ts)[len(ts) // 2]
+        key = "native_cpp" if native else "numpy"
+        out[key] = {"ms_per_step": round(med * 1e3, 1),
+                    "gb_per_s": round(n * 4 * 4 / med / 1e9, 2)}
+    print(json.dumps({"bench": "cpu_adam", "params": n, **out}))
+
+
+def bench_aio(mb=512):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    n = (mb << 20) // 4
+    data = np.random.default_rng(0).standard_normal(n) \
+        .astype(np.float32)
+    with tempfile.NamedTemporaryFile(dir="/tmp", delete=False) as f:
+        path = f.name
+    try:
+        handle = AsyncIOHandle(path, nbytes=data.nbytes, n_threads=4)
+        handle.pwrite(data, 0)
+        handle.wait()
+        arr = np.empty(n, np.float32)
+        # evict page cache as best we can (fadvise DONTNEED)
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+        finally:
+            os.close(fd)
+        t0 = time.perf_counter()
+        handle.pread(arr, 0)
+        handle.wait()
+        dt_pool = time.perf_counter() - t0
+        handle.close()
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+        finally:
+            os.close(fd)
+        t0 = time.perf_counter()
+        with open(path, "rb") as f:
+            f.readinto(arr)
+        dt_plain = time.perf_counter() - t0
+        print(json.dumps({
+            "bench": "aio_read", "mb": mb,
+            "pool_gb_s": round(mb / 1024 / dt_pool, 2),
+            "plain_read_gb_s": round(mb / 1024 / dt_plain, 2)}))
+    finally:
+        os.unlink(path)
+
+
+if __name__ == "__main__":
+    bench_cpu_adam()
+    bench_aio()
