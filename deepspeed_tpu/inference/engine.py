@@ -130,6 +130,8 @@ class InferenceEngine:
             from .quantization import (is_woq_leaf, quantize_param_tree,
                                        tree_hbm_bytes)
             dense_bytes = tree_hbm_bytes(cast)
+            # int4 leaves pick kernel-legal group sizes per leaf inside
+            # quantize_param_tree (_int4_group_size)
             qtree = quantize_param_tree(
                 cast, num_bits=self._woq_bits,
                 group_size=self._config.quantization_group_size,

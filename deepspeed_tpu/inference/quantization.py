@@ -86,6 +86,23 @@ _EMBED_NAMES = ("embed", "wte", "wpe", "lm_head", "shared",
                 "word_embeddings", "position_embeddings", "unembed")
 
 
+def _int4_group_size(d: int, gs: int) -> int:
+    """Per-leaf group size for int4: the fused serving kernel
+    (ops/pallas_kernels/woq_matmul.py) needs one scale group per
+    INT4_MIN_GROUP-wide output block, so when the leaf width allows it
+    pick the smallest kernel-legal multiple >= the requested size.
+    Widths with no such divisor keep the REQUESTED groups (that leaf
+    serves through the XLA path — never collapse its accuracy to a
+    whole-row group just to chase the kernel)."""
+    from ..ops.pallas_kernels.woq_matmul import INT4_MIN_GROUP as M
+    if d % M:
+        return gs
+    g = max(((max(gs, M) + M - 1) // M) * M, M)
+    while d % g:
+        g -= M
+    return g
+
+
 def quantize_param_tree(tree, num_bits: int = 8, group_size: int = 128,
                         min_size: int = 1 << 14,
                         predicate: Optional[Callable] = None):
@@ -122,7 +139,10 @@ def quantize_param_tree(tree, num_bits: int = 8, group_size: int = 128,
             return tuple(walk(v, path + (i,))
                          for i, v in enumerate(node))
         if node is not None and should(path, node):
-            return quantize_weight(node, num_bits, group_size)
+            gs = group_size
+            if num_bits == 4:
+                gs = _int4_group_size(int(node.shape[-1]), gs)
+            return quantize_weight(node, num_bits, gs)
         return node
 
     return walk(tree, ())

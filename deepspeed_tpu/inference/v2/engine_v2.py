@@ -86,6 +86,8 @@ class InferenceEngineV2:
             # "embed"; quantizing it would ADD a second copy instead of
             # shrinking HBM). "embed" is already rejected by the shared
             # _EMBED_NAMES filter.
+            # int4 leaves pick kernel-legal group sizes per leaf
+            # inside quantize_param_tree (_int4_group_size)
             self.tree = quantize_param_tree(
                 self.tree, num_bits=bits,
                 group_size=ec.quantization_group_size,

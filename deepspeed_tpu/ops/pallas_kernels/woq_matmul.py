@@ -18,6 +18,16 @@ ACTIVATION tile, not the weight tile — out[m,n] = Σ_k (x[m,k]·s[k,g(n)])
 · q[k,n] — so the big [bk,bn] weight tile takes only an int8→bf16
 convert and the multiply runs on the small [bm,bk] x tile. Scales ride
 as [G, 1, K] so their block keeps Mosaic-legal (…,1,bk) tiling.
+
+int4 (nibble-packed uint8) runs a TWO-PLANE variant: the low/high
+nibbles are two half-width weight matrices (all even / all odd output
+columns); each k-tile does two dots, the planes leave the kernel
+separately and interleave once at the XLA level (an in-kernel lane
+interleave fails Mosaic lowering, as do sub-32-bit vector bit ops —
+nibbles widen to i32 lanes before the shifts). Requires one scale
+group per 256-column output block; measured on-chip at the decode
+harness: int4 158 ms vs int8 175 ms vs dense-bf16 155-180 ms — dense
+latency at a QUARTER of the weight HBM.
 """
 
 import functools
@@ -41,6 +51,45 @@ def woq_matmul_reference(x, q, scales, out_dtype=None):
         x.astype(jnp.bfloat16), w,
         (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32).astype(out_dtype)
+
+
+def _kernel4(s_ref, x_ref, q_ref, lo_out_ref, hi_out_ref, lo_ref,
+             hi_ref, *, n_kblocks):
+    # int4 variant: q packs ORIGINAL columns (2j, 2j+1) as the (low,
+    # high) nibbles of byte column j. Unpacking interleaved lanes per
+    # tile would be a relayout per k step — instead run TWO half-width
+    # dots (all the even columns, all the odd columns) against the
+    # nibble planes; the outputs stay as separate planes and the
+    # wrapper interleaves them ONCE at the XLA level. Needs one scale
+    # group per output block (the 2*bn4 original columns), enforced by
+    # the dispatcher.
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        lo_ref[...] = jnp.zeros_like(lo_ref)
+        hi_ref[...] = jnp.zeros_like(hi_ref)
+
+    s = s_ref[0, 0, :]                           # [bk] fp32
+    xs = (x_ref[...].astype(jnp.float32)
+          * s[None, :]).astype(jnp.bfloat16)     # [bm, bk]
+    # widen to i32 lanes before the bit ops — sub-32-bit vector
+    # shifts/xors are not lowerable on all Mosaic targets
+    q = q_ref[...].astype(jnp.int32)             # [bk, bn4]
+    lo32 = q & 0xF
+    hi32 = (q >> 4) & 0xF
+    lo = jnp.where(lo32 > 7, lo32 - 16, lo32).astype(jnp.bfloat16)
+    hi = jnp.where(hi32 > 7, hi32 - 16, hi32).astype(jnp.bfloat16)
+    dot = lambda w: jax.lax.dot_general(
+        xs, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    lo_ref[...] += dot(lo)
+    hi_ref[...] += dot(hi)
+
+    @pl.when(k == n_kblocks - 1)
+    def _done():
+        lo_out_ref[...] = lo_ref[...].astype(lo_out_ref.dtype)
+        hi_out_ref[...] = hi_ref[...].astype(hi_out_ref.dtype)
 
 
 def _kernel(s_ref, x_ref, q_ref, o_ref, acc_ref, *, n_kblocks):
@@ -98,46 +147,86 @@ def _woq_call(x, q, s3, m, n, bk, bn, gs, out_dtype, interpret):
     )(s3, x, q)
 
 
+def _woq_call4(x, q4, s3, m, n, bk, bn4, gs, out_dtype, interpret):
+    """int4 launch: q4 [K, N//2] packed nibbles; the kernel emits the
+    even/odd column PLANES [m, N//2] each, interleaved here at the XLA
+    level (an in-kernel lane interleave fails Mosaic lowering)."""
+    grid = (n // (2 * bn4), x.shape[1] // bk)
+    plane = pl.BlockSpec((m, bn4), lambda ni, ki: (0, ni))
+    lo, hi = pl.pallas_call(
+        functools.partial(_kernel4, n_kblocks=grid[1]),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bk),
+                         lambda ni, ki, _gs=gs, _bn=2 * bn4:
+                         ((ni * _bn) // _gs, 0, ki)),
+            pl.BlockSpec((m, bk), lambda ni, ki: (0, ki)),
+            pl.BlockSpec((bk, bn4), lambda ni, ki: (ki, ni)),
+        ],
+        out_specs=[plane, plane],
+        out_shape=[jax.ShapeDtypeStruct((m, n // 2), out_dtype),
+                   jax.ShapeDtypeStruct((m, n // 2), out_dtype)],
+        scratch_shapes=[pltpu.VMEM((m, bn4), jnp.float32),
+                        pltpu.VMEM((m, bn4), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(s3, x, q4)
+    return jnp.stack([lo, hi], axis=-1).reshape(m, n)
+
+
 # decode M is tiny; above this the matmul turns compute-bound and the
 # dense path (dequant once, big MXU tiles) wins — measured crossover
 # is well above any decode batch
 _DECODE_M_MAX = 128
+
+# the int4 kernel's output block spans 2*bn4 >= 256 original columns
+# and needs ONE scale group across it — quantizers consult this so
+# int4 trees land kernel-servable where the leaf width allows
+INT4_MIN_GROUP = 256
 
 
 def woq_matmul(x, q, scales, out_dtype=None, force_pallas=False,
                interpret=False):
     """x [..., K] @ WOQ(q, scales) -> [..., N].
 
-    q: int8 [K, N] (int4 nibble-packed uint8 falls back to the XLA
-    path — its interleaved unpack is a lane relayout the kernel would
-    pay per tile). scales: fp32 [K, N // group_size]."""
+    q: int8 [K, N], or nibble-packed uint8 [K, N//2] (int4 — served by
+    the two-plane kernel when the scale group covers one 256-multiple
+    output block, else the XLA path). scales: fp32 [K, N // gs]."""
     out_dtype = out_dtype or x.dtype
     shape = x.shape
     m = int(np.prod(shape[:-1]))
     force = force_pallas or interpret
     use_kernel = force or jax.default_backend() == "tpu"
-    if q.dtype != jnp.int8:
-        # nibble-packed int4: the interleaved unpack is a lane relayout
-        # the kernel would pay per tile — XLA path only
-        if force_pallas:
-            raise ValueError("woq_matmul force_pallas: the kernel "
-                             "consumes int8 q only (int4 is packed "
-                             "uint8 and served by the XLA path)")
-        return woq_matmul_reference(x, q, scales, out_dtype)
+    if q.dtype not in (jnp.int8, jnp.uint8):
+        raise ValueError(f"woq_matmul: q must be int8 (dense) or "
+                         f"nibble-packed uint8, got {q.dtype}")
+    packed4 = q.dtype == jnp.uint8
     if not use_kernel or (m > _DECODE_M_MAX and not force):
         return woq_matmul_reference(x, q, scales, out_dtype)
-    kdim, n = int(q.shape[0]), int(q.shape[1])
+    kdim = int(q.shape[0])
+    n = int(q.shape[1]) * (2 if packed4 else 1)
     groups = int(scales.shape[-1])
     gs = n // groups
     bk = _pick_block(kdim, (1024, 512, 256, 128))
-    bn_cands = [c for c in (512, 256, 128) if gs % c == 0 or gs == n]
-    bn = next((c for c in bn_cands if n % c == 0), None)
+    if packed4:
+        # output blocks are 2*bn4 ORIGINAL columns wide and must sit
+        # inside one scale group (the nibble planes interleave within
+        # the block, so per-column scales cannot fold into x)
+        bn4_cands = [c for c in (256, 128) if gs % (2 * c) == 0
+                     or gs == n]
+        bn = next((c for c in bn4_cands if (n // 2) % c == 0), None)
+    else:
+        bn_cands = [c for c in (512, 256, 128)
+                    if gs % c == 0 or gs == n]
+        bn = next((c for c in bn_cands if n % c == 0), None)
     if bk is None or bn is None:
         if force_pallas:
             raise ValueError(
-                f"woq_matmul force_pallas: K={kdim} N={n} gs={gs} do "
-                f"not tile (K needs a 128/256/512 divisor; group size "
-                f"must cover a 128-multiple n-block)")
+                f"woq_matmul force_pallas: K={kdim} N={n} gs={gs} "
+                f"(packed4={packed4}) do not tile — K needs a "
+                f"128/256/512 divisor; the scale group must cover a "
+                f"{'256' if packed4 else '128'}-multiple output block")
         return woq_matmul_reference(x, q, scales, out_dtype)
     x2 = x.reshape(m, kdim)
     # pad rows to the bf16 sublane tile
@@ -145,8 +234,12 @@ def woq_matmul(x, q, scales, out_dtype=None, force_pallas=False,
     if mp != m:
         x2 = jnp.pad(x2, ((0, mp - m), (0, 0)))
     s3 = jnp.transpose(scales.astype(jnp.float32))[:, None, :]
-    out = _woq_call(x2, q, s3, mp, n, bk, bn, gs, out_dtype,
-                    interpret)
+    if packed4:
+        out = _woq_call4(x2, q, s3, mp, n, bk, bn, gs, out_dtype,
+                         interpret)
+    else:
+        out = _woq_call(x2, q, s3, mp, n, bk, bn, gs, out_dtype,
+                        interpret)
     if mp != m:
         out = out[:m]
     return out.reshape(shape[:-1] + (n,))

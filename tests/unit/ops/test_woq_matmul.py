@@ -76,14 +76,69 @@ def test_group_column_mapping(rng):
                                    atol=3e-2, rtol=3e-2, err_msg=str(blk))
 
 
-def test_int4_packed_falls_back_and_force_raises(rng):
-    w, leaf = _leaf(rng, 256, 128, bits=4)
+@pytest.mark.parametrize("N,gs", [
+    (512, 256),     # two output blocks, one group each (bn4=128)
+    (256, 256),     # gs == n single group
+    (1024, 512),    # wide-block leg: bn4=256, scale map _bn=512
+])
+def test_int4_kernel_matches_reference(rng, N, gs):
+    """The two-plane int4 kernel (even/odd nibble dots, interleaved at
+    the end) matches the dequantize oracle when the scale group covers
+    the 256-wide output block."""
+    w, leaf = _leaf(rng, 256, N, bits=4, gs=gs)
     assert leaf["woq_q"].dtype == jnp.uint8
+    x = jnp.asarray(rng.standard_normal((16, 256)), jnp.bfloat16)
+    got = woq_matmul(x, leaf["woq_q"], leaf["woq_scales"],
+                     interpret=True, force_pallas=True)
+    ref = woq_matmul_reference(x, leaf["woq_q"], leaf["woq_scales"])
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
+    dense = np.asarray(x, np.float32) @ w
+    assert float(np.max(np.abs(np.asarray(got, np.float32) - dense))) \
+        < 0.3       # int4 quant noise bound
+
+
+def test_int4_group_size_per_leaf(rng):
+    """Tree quantization picks kernel-legal int4 groups per leaf: a
+    256-divisible width rounds the group UP to a 256-multiple divisor;
+    a width with no 256-divisor keeps the REQUESTED groups (never
+    collapses to one whole-row scale — the review catch)."""
+    from deepspeed_tpu.inference.quantization import (_int4_group_size,
+                                                      quantize_param_tree)
+    assert _int4_group_size(11008, 128) == 256
+    assert _int4_group_size(1024, 320) == 512    # next legal multiple
+    # 512 does not divide 11008 (= 256*43): falls to the largest
+    # 256-multiple divisor
+    assert _int4_group_size(11008, 320) == 256
+    assert _int4_group_size(4480, 128) == 128    # no 256-divisor: keep
+    assert _int4_group_size(256, 128) == 256
+    tree = {"a": jnp.asarray(rng.standard_normal((128, 4480)),
+                             jnp.float32),
+            "b": jnp.asarray(rng.standard_normal((128, 512)),
+                             jnp.float32)}
+    q = quantize_param_tree(tree, num_bits=4, group_size=128,
+                            min_size=16)
+    assert q["a"]["woq_scales"].shape[-1] == 4480 // 128
+    assert q["b"]["woq_scales"].shape[-1] == 512 // 256
+
+
+def test_non_quantized_dtype_rejected(rng):
+    x = jnp.asarray(rng.standard_normal((4, 128)), jnp.bfloat16)
+    with pytest.raises(ValueError, match="int8"):
+        woq_matmul(x, jnp.zeros((128, 128), jnp.float32),
+                   jnp.ones((128, 1)))
+
+
+def test_int4_narrow_group_falls_back_and_force_raises(rng):
+    """gs=128 cannot cover a 256-wide int4 output block: silent
+    fallback to the XLA path; force_pallas fails loudly."""
+    w, leaf = _leaf(rng, 256, 512, bits=4, gs=128)
     x = jnp.asarray(rng.standard_normal((4, 256)), jnp.bfloat16)
     out = woq_matmul(x, leaf["woq_q"], leaf["woq_scales"])
     ref = woq_matmul_reference(x, leaf["woq_q"], leaf["woq_scales"])
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
-    with pytest.raises(ValueError, match="int8"):
+    with pytest.raises(ValueError, match="256"):
         woq_matmul(x, leaf["woq_q"], leaf["woq_scales"],
                    force_pallas=True)
 

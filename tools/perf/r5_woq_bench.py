@@ -45,6 +45,9 @@ def main():
     leaves = [quantize_weight(w, 8, 128) for w in ws]
     qs = [l["woq_q"] for l in leaves]
     ss = [l["woq_scales"] for l in leaves]
+    leaves4 = [quantize_weight(w, 4, 256) for w in ws]
+    qs4 = [l["woq_q"] for l in leaves4]
+    ss4 = [l["woq_scales"] for l in leaves4]
 
     def repeat(layer_scan):
         def body(x, *w):
@@ -83,13 +86,17 @@ def main():
     ws = jnp.stack(ws)
     qs = jnp.stack(qs)
     ss = jnp.stack(ss)
+    qs4 = jnp.stack(qs4)
+    ss4 = jnp.stack(ss4)
 
     bytes_bf16 = REPEATS * DEPTH * K * N * 2
     bytes_int8 = REPEATS * DEPTH * K * N * 1
+    bytes_int4 = REPEATS * DEPTH * K * N // 2
     for name, fn, args, byt in [
             ("dense_bf16", dense, (x, ws), bytes_bf16),
             ("xla_dequant", xla_deq, (x, qs, ss), bytes_int8),
-            ("pallas_woq", pallas, (x, qs, ss), bytes_int8)]:
+            ("pallas_woq", pallas, (x, qs, ss), bytes_int8),
+            ("pallas_woq4", pallas, (x, qs4, ss4), bytes_int4)]:
         t = time_it(fn, *args)
         print(f"{name:12s} {t*1e3:8.3f} ms  "
               f"{byt/t/1e9:7.1f} GB/s effective-weight-read")
