@@ -296,12 +296,14 @@ def main():
     logging.getLogger("DeepSpeedTPU").setLevel(logging.WARNING)
     p = argparse.ArgumentParser()
     p.add_argument("--config", type=str, default="0",
-                   choices=["0", "1", "2", "3", "4", "5", "5_int8"],
+                   choices=["0", "1", "2", "3", "4", "5", "5_int8",
+                            "5_int4"],
                    help="0 (default) = ALL tracked configs")
     args = p.parse_args()
     fns = {"1": bench_config1, "2": bench_config2, "3": bench_config3,
            "4": bench_config4, "5": bench_config5,
-           "5_int8": lambda: bench_config5(weight_dtype="int8")}
+           "5_int8": lambda: bench_config5(weight_dtype="int8"),
+           "5_int4": lambda: bench_config5(weight_dtype="int4")}
     if args.config != "0":
         print(json.dumps(fns[args.config]()))
         return
@@ -320,7 +322,7 @@ def main():
     budget = float(os.environ.get("DSTPU_BENCH_BUDGET", "2400"))
     t_start = time.time()
     configs = {}
-    for key in ("1", "3", "4", "2", "5", "5_int8"):
+    for key in ("1", "3", "4", "2", "5", "5_int8", "5_int4"):
         if key != "1" and time.time() - t_start > budget * 0.8:
             configs[key] = {"skipped": "bench time budget"}
             continue

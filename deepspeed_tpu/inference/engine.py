@@ -79,7 +79,15 @@ class InferenceEngine:
         bits = woq_bits_from_dtype(self._config.dtype)
         if bits is not None:
             self._woq_bits = bits
-            if not getattr(model, "woq_native", False):
+            # native path = the fused Pallas matmul inside the model's
+            # denses; a pallas_call cannot be auto-partitioned by
+            # GSPMD, so under TP serving stays on the dequant wrapper
+            # (the v2 engine's linear heuristics apply the same rule).
+            # Gate on the MESH's tensor axis — param sharding in
+            # set_params is mesh-driven, and the process-global mesh
+            # can differ from this engine's tp_size config
+            mesh_tp = dict(self.mesh.shape).get(TENSOR_AXIS, 1)
+            if not getattr(model, "woq_native", False) or mesh_tp > 1:
                 # fallback for models without WOQ-aware denses: whole-
                 # tree dequant inside the jit. NOTE this reads MORE HBM
                 # than dense bf16 at decode (XLA materializes the bf16
