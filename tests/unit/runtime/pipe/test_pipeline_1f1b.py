@@ -19,7 +19,7 @@ from test_pipeline import (VOCAB, Block, EmbedLayer, Head, ce_loss,
 
 
 def _train(schedule, steps=6, rng_seed=0, stages=4, gas=4,
-           n_blocks=4):
+           n_blocks=4, extra_config=None):
     mesh_manager.reset()
     pm = _pipeline_module(n_blocks=n_blocks, num_stages=stages,
                           schedule=schedule)
@@ -29,6 +29,7 @@ def _train(schedule, steps=6, rng_seed=0, stages=4, gas=4,
               "zero_optimization": {"stage": 1},
               "gradient_clipping": 1.0,
               "steps_per_print": 0}
+    config.update(extra_config or {})
     engine, _, _, _ = deepspeed_tpu.initialize(model=pm, config=config)
     gbs = engine.train_batch_size()
     r = np.random.default_rng(rng_seed)
@@ -100,6 +101,20 @@ def test_1f1b_tied_embedding_head(eight_devices):
     assert losses[-1] < losses[0], losses
     params = engine.get_params()["params"]
     assert "tied_emb" in params
+
+
+def test_1f1b_composes_with_fp16_loss_scaling(eight_devices):
+    """fp16 under the 1F1B schedule: the engine's loss-scale rides the
+    custom_vjp cotangent (grads are linear in it), overflow machinery
+    included — training must converge WITH fp16 actually engaged."""
+    engine, losses = _train(
+        "1f1b", steps=8,
+        extra_config={"fp16": {"enabled": True},
+                      "zero_optimization": {"stage": 0}})
+    assert engine.fp16_enabled
+    assert engine.loss_scale > 0          # scaler live, not fp32 fallback
+    assert losses[-1] < losses[0], losses
+    assert all(np.isfinite(losses))
 
 
 def test_1f1b_saved_activations_independent_of_microbatches(
