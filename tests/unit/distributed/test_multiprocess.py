@@ -159,6 +159,75 @@ print(f"WORKER done at step {engine.global_steps}", flush=True)
 """
 
 
+MULTIWORKER = """
+import os
+import sys
+import numpy as np
+import jax
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+dist.init_distributed()
+assert jax.process_count() == 2
+cfg = {"train_micro_batch_size_per_gpu": 2,
+       "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+       "zero_optimization": {"stage": 2}, "steps_per_print": 0}
+engine, _, _, _ = deepspeed_tpu.initialize(
+    model=GPT2LMHeadModel(GPT2Config.tiny()), config=cfg)
+ids = np.zeros((engine.train_batch_size(), 8), np.int32)
+b = {"input_ids": ids, "labels": ids}
+for step in range(4):
+    engine.train_batch(batch=b)
+    if step == 1 and os.environ.get("DSTPU_ELASTIC_RESTART") == "0" \\
+            and jax.process_index() == 1:
+        print("WORKER injected failure", flush=True)
+        os._exit(17)
+print(f"WORKER done rank={jax.process_index()}", flush=True)
+"""
+
+LAUNCH_WRAPPER = """
+import os
+import sys
+from deepspeed_tpu.launcher import launch
+
+sys.exit(launch.main([
+    "--nnodes", "1", "--nproc_per_node", "2",
+    "--master_addr", "127.0.0.1", "--master_port", os.environ["PORT"],
+    "--cpu_sim_devices", "2", os.environ["WORKER"]]))
+"""
+
+
+def test_elastic_agent_respawns_multiworker_group(tmp_path):
+    """The multi-worker elastic story: the agent supervises a LAUNCHER
+    whose 2 rendezvoused workers train together; rank 1 dies
+    mid-training on the first attempt (the launcher tears down its
+    peer and reports failure), the agent respawns the whole group and
+    the second rendezvous completes cleanly."""
+    from deepspeed_tpu.elasticity import DSElasticAgent
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(MULTIWORKER)
+    wrapper = tmp_path / "wrapper.py"
+    wrapper.write_text(LAUNCH_WRAPPER)
+    env = {"PATH": os.environ.get("PATH", ""),
+           "HOME": os.environ.get("HOME", "/root"),
+           "PYTHONPATH": REPO,
+           "JAX_PLATFORMS": "cpu", "DS_ACCELERATOR": "cpu",
+           "PORT": str(free_port()), "WORKER": str(worker)}
+    agent = DSElasticAgent(str(wrapper), ds_config={},
+                           ckpt_dir=str(tmp_path / "ckpt"),
+                           max_restarts=2, backoff_seconds=0.5,
+                           device_probe=lambda: 2, env=env)
+    # bound the only otherwise-unbounded wait in this file: a wedged
+    # rendezvous must fail the test, not hang the suite
+    import concurrent.futures
+    with concurrent.futures.ThreadPoolExecutor(1) as pool:
+        rc = pool.submit(agent.run).result(timeout=600)
+    assert rc == 0
+    assert agent.restart_count == 1      # exactly one group respawn
+
+
 def test_elastic_agent_kills_and_resumes_real_worker(tmp_path):
     """A REAL engine worker is SIGKILLed mid-training; the agent
     respawns it and the restarted process resumes from the committed

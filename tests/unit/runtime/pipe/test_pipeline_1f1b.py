@@ -112,7 +112,9 @@ def test_1f1b_composes_with_fp16_loss_scaling(eight_devices):
         extra_config={"fp16": {"enabled": True},
                       "zero_optimization": {"stage": 0}})
     assert engine.fp16_enabled
-    assert engine.loss_scale > 0          # scaler live, not fp32 fallback
+    # the dynamic scaler starts at 2**16 and stays >> 1 absent mass
+    # overflows — a silent fp32 fallback (scale pinned to 1) fails here
+    assert engine.loss_scale > 1
     assert losses[-1] < losses[0], losses
     assert all(np.isfinite(losses))
 
