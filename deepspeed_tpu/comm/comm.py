@@ -150,19 +150,46 @@ def _in_trace(x):
     return isinstance(x, jax.core.Tracer)
 
 
-def _eager_wrap(fn, x, group, out_shifted_spec=None):
-    """Run a per-shard collective eagerly via one-shot shard_map.
+def _eager_run(fn, x, group, in_spec, out_spec):
+    """Shared eager-collective runner: one-shot shard_map under jit.
 
-    The input's leading dim is treated as sharded over the group axis.
+    Multi-controller (jax.process_count() > 1): each process passes its
+    PROCESS-LOCAL view of the input (torch collective semantics); the
+    global array is assembled with ``make_array_from_process_local_data``,
+    the same jitted shard_map runs globally, and the caller gets its
+    process-local view back — a plain readable array, matching what
+    torch's eager collectives hand each rank. (Returning the raw
+    global output would hand the caller an array spanning
+    non-addressable devices.) Shards replicated over other mesh axes
+    are DEDUPED by their index before the local concat, so partially
+    sharded / replicated outputs come back at their true size.
     """
     mesh = mesh_lib.get_mesh()
+    wrapped = shard_map(fn, mesh=mesh, in_specs=(in_spec,),
+                        out_specs=out_spec, check_vma=False)
+    if jax.process_count() > 1:
+        x = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, in_spec), np.asarray(x))
+        out = jax.jit(wrapped)(x)
+        seen, parts = set(), []
+        for s in sorted(out.addressable_shards,
+                        key=lambda s: s.index[0].start or 0):
+            key = tuple((sl.start, sl.stop) for sl in s.index)
+            if key in seen:
+                continue
+            seen.add(key)
+            parts.append(np.asarray(s.data))
+        return jnp.asarray(np.concatenate(parts, axis=0))
+    return jax.jit(wrapped)(x)
+
+
+def _eager_wrap(fn, x, group, out_shifted_spec=None):
+    """Eager collective whose input's leading dim is sharded over the
+    group axis (see _eager_run for the multi-controller contract)."""
     names = _axis(group)
     spec = P(names if len(names) > 1 else names[0])
-    in_spec = spec
     out_spec = out_shifted_spec if out_shifted_spec is not None else spec
-    wrapped = shard_map(fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
-                        check_vma=False)
-    return jax.jit(wrapped)(x)
+    return _eager_run(fn, x, group, spec, out_spec)
 
 
 def _timed(name, group, x):
@@ -273,11 +300,8 @@ def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, group: Group = None,
     if _in_trace(tensor):
         return _rs(tensor)
     with _timed("reduce_scatter", group, tensor):
-        mesh = mesh_lib.get_mesh()
         spec_names = names if len(names) > 1 else names[0]
-        wrapped = shard_map(_rs, mesh=mesh, in_specs=(P(),),
-                            out_specs=P(spec_names), check_vma=False)
-        return jax.jit(wrapped)(tensor)
+        return _eager_run(_rs, tensor, group, P(), P(spec_names))
 
 
 reduce_scatter_tensor = reduce_scatter
@@ -373,11 +397,8 @@ def scatter(tensor, src: int = 0, group: Group = None):
 
     if _in_trace(tensor):
         return _scatter(tensor)
-    mesh = mesh_lib.get_mesh()
     spec_names = names if len(names) > 1 else names[0]
-    wrapped = shard_map(_scatter, mesh=mesh, in_specs=(P(),),
-                        out_specs=P(spec_names), check_vma=False)
-    return jax.jit(wrapped)(tensor)
+    return _eager_run(_scatter, tensor, group, P(), P(spec_names))
 
 
 def log_summary(show_straggler=False):

@@ -119,7 +119,7 @@ def _assert_tree_equal(a, b, path=""):
                                    atol=1e-6, err_msg=path)
 
 
-@pytest.mark.parametrize("version", [0, 2.0])
+@pytest.mark.parametrize("version", [0, 1.0, 2.0])
 def test_merge_roundtrips_to_unsharded_params(rng, version):
     hf = _hf_sd(rng)
     cfg = GPT2Config(vocab_size=V, n_positions=POS, n_embd=H,
