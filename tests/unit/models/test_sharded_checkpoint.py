@@ -190,3 +190,16 @@ def test_replication_mismatch_rejected(rng):
 def test_unknown_key_rejected(rng):
     with pytest.raises(KeyError, match="unmapped"):
         megatron_gpt2_to_hf({"mystery.weight": np.zeros((2, 2))})
+
+
+def test_shard_key_mismatch_rejected(rng):
+    hf = _hf_sd(rng)
+    shards = _megatron_shards(hf, 2, 2.0)
+    del shards[1]["final_layernorm.weight"]
+    with pytest.raises(ValueError, match="disagree"):
+        merge_tp_shards(shards, 2.0)
+
+
+def test_empty_dir_rejected(tmp_path):
+    with pytest.raises(FileNotFoundError, match="shards"):
+        resolve_checkpoint_list(str(tmp_path))
