@@ -20,6 +20,7 @@ aslanxie/DeepSpeed v0.14.0), built idiomatically on JAX/XLA/pjit/Pallas:
 import sys as _sys
 
 from . import comm  # noqa: F401
+from . import resilience  # noqa: F401  (fault injection / recovery)
 from . import zero_api as zero  # noqa: F401  (deepspeed.zero parity)
 from .accelerator import get_accelerator  # noqa: F401
 from .zero_api import OnDevice  # noqa: F401  (deepspeed.OnDevice parity)

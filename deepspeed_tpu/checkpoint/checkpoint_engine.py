@@ -25,8 +25,11 @@ from .engine import load_checkpoint, save_checkpoint
 class CheckpointEngine(abc.ABC):
     """Reference-parity surface: create/save/load/commit."""
 
-    def __init__(self, config_params: Optional[dict] = None):
+    def __init__(self, config_params: Optional[dict] = None,
+                 io_retries: int = 3):
         self.config = config_params or {}
+        # bounded-retry budget for shard I/O (resilience.io_retries)
+        self.io_retries = io_retries
 
     def create(self, tag: str):
         """Start a checkpoint under ``tag`` (bookkeeping hook)."""
@@ -52,10 +55,12 @@ class SyncCheckpointEngine(CheckpointEngine):
     def save(self, state, path: str, tag: str, client_state=None,
              save_latest: bool = True):
         return save_checkpoint(path, tag, state, client_state=client_state,
-                               save_latest=save_latest)
+                               save_latest=save_latest,
+                               io_retries=self.io_retries)
 
     def load(self, path: str, tag: Optional[str], template_state=None):
-        return load_checkpoint(path, tag, template_state)
+        return load_checkpoint(path, tag, template_state,
+                               io_retries=self.io_retries)
 
     def commit(self, tag: str) -> bool:
         return True
@@ -67,8 +72,9 @@ class AsyncCheckpointEngine(CheckpointEngine):
     snapshot to host BEFORE returning, so the training loop may donate/
     overwrite device buffers immediately."""
 
-    def __init__(self, config_params: Optional[dict] = None):
-        super().__init__(config_params)
+    def __init__(self, config_params: Optional[dict] = None,
+                 io_retries: int = 3):
+        super().__init__(config_params, io_retries=io_retries)
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="ckpt")
         self._inflight: Dict[str, concurrent.futures.Future] = {}
@@ -93,7 +99,8 @@ class AsyncCheckpointEngine(CheckpointEngine):
         def run():
             return save_checkpoint(path, tag, host_state,
                                    client_state=client_state,
-                                   save_latest=save_latest)
+                                   save_latest=save_latest,
+                                   io_retries=self.io_retries)
 
         with self._lock:
             prev = self._inflight.get(tag)
@@ -105,7 +112,8 @@ class AsyncCheckpointEngine(CheckpointEngine):
 
     def load(self, path: str, tag: Optional[str], template_state=None):
         self.commit_all()
-        return load_checkpoint(path, tag, template_state)
+        return load_checkpoint(path, tag, template_state,
+                               io_retries=self.io_retries)
 
     def commit(self, tag: str) -> bool:
         with self._lock:
@@ -123,10 +131,12 @@ class AsyncCheckpointEngine(CheckpointEngine):
 
 
 def get_checkpoint_engine(config: Optional[dict] = None) -> CheckpointEngine:
-    cfg = (config or {}).get("checkpoint_engine", {})
+    params = config or {}
+    cfg = params.get("checkpoint_engine", {})
+    io_retries = int(params.get("resilience", {}).get("io_retries", 3))
     kind = cfg.get("type", "sync")
     if kind == "async":
-        return AsyncCheckpointEngine(cfg)
+        return AsyncCheckpointEngine(cfg, io_retries=io_retries)
     if kind == "sync":
-        return SyncCheckpointEngine(cfg)
+        return SyncCheckpointEngine(cfg, io_retries=io_retries)
     raise ValueError(f"unknown checkpoint_engine type {kind!r}")

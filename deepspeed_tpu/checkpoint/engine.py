@@ -13,10 +13,17 @@ numpy .npz fallback.  The on-disk layout mirrors the reference:
 import json
 import os
 import pickle
+import zipfile
 
 import jax
 import numpy as np
 
+from ..resilience.errors import (CheckpointCorruptionError,
+                                 CheckpointLoadError)
+from ..resilience.fault_injector import fault_injector
+from ..resilience.integrity import (atomic_write_bytes, atomic_write_text,
+                                    verify_manifest, write_manifest)
+from ..resilience.retry import retry_io
 from ..utils.logging import logger
 from ..utils.tree import flatten_with_names
 
@@ -29,32 +36,60 @@ def _try_orbax():
         return None
 
 
-def save_checkpoint(save_dir, tag, state, client_state=None, save_latest=True):
+def save_checkpoint(save_dir, tag, state, client_state=None, save_latest=True,
+                    io_retries=3):
     ckpt_dir = os.path.join(save_dir, str(tag))
     os.makedirs(ckpt_dir, exist_ok=True)
     state_dir = os.path.join(ckpt_dir, "state")
 
-    ocp = _try_orbax()
-    saved = False
-    if ocp is not None:
-        try:
-            ckptr = ocp.PyTreeCheckpointer()
-            ckptr.save(os.path.abspath(state_dir), state, force=True)
-            saved = True
-        except Exception as e:
-            logger.warning(f"orbax save failed ({e}); falling back to npz")
-    if not saved:
+    def _write_state():
+        fault_injector.fire("checkpoint.save", detail=state_dir)
+        ocp = _try_orbax()
+        if ocp is not None:
+            try:
+                ckptr = ocp.PyTreeCheckpointer()
+                ckptr.save(os.path.abspath(state_dir), state, force=True)
+                return
+            except Exception as e:
+                logger.warning(
+                    f"orbax save failed ({e}); falling back to npz")
         _npz_save(state_dir, state)
 
-    _atomic_write(os.path.join(ckpt_dir, "client_state.json"),
-                  json.dumps(_jsonable(client_state or {})))
+    # transient write failures retry with backoff; each attempt
+    # rebuilds the shard files from scratch (atomic tmp+rename, so a
+    # failed attempt never leaves a half shard under a real name)
+    retry_io(_write_state, retries=io_retries,
+             description=f"checkpoint shard write ({tag})")
+    # integrity commit point for the state payload: per-file sha256
+    # manifest, written only after every payload file is durable —
+    # inside the same retry budget as the payload (its re-read-and-
+    # hash pass is the longest I/O window of the save). Multi-host
+    # collective saves skip it: hosts write their shards into the
+    # SHARED state dir concurrently with no barrier here, so any one
+    # host's hash pass races the others' in-flight renames and a
+    # wrong manifest (spurious corruption on load) is worse than none
+    # (the legacy no-manifest load path still verifies nothing but
+    # loads correctly).
+    if jax.process_count() == 1:
+        retry_io(lambda: write_manifest(state_dir), retries=io_retries,
+                 description=f"checkpoint manifest write ({tag})")
+
+    retry_io(
+        lambda: _atomic_write(os.path.join(ckpt_dir, "client_state.json"),
+                              json.dumps(_jsonable(client_state or {}))),
+        retries=io_retries,
+        description=f"checkpoint client_state write ({tag})")
     if save_latest:
         # ``latest`` is the COMMIT POINT: it must only ever name a
         # fully-written checkpoint, and a kill mid-update must never
         # leave it empty/truncated — hence write-then-rename (atomic on
         # POSIX). Crash-recovery contract: if ``latest`` exists, the
         # checkpoint it names is loadable.
-        _atomic_write(os.path.join(save_dir, "latest"), str(tag))
+        retry_io(
+            lambda: _atomic_write(os.path.join(save_dir, "latest"),
+                                  str(tag)),
+            retries=io_retries,
+            description=f"latest pointer write ({tag})")
     logger.info(f"Saved checkpoint {tag} to {save_dir}")
     return ckpt_dir
 
@@ -62,12 +97,7 @@ def save_checkpoint(save_dir, tag, state, client_state=None, save_latest=True):
 def _atomic_write(path: str, text: str):
     # unique tmp per writer: on a SHARED checkpoint dir (multi-host
     # collective save) concurrent writers must not race on one tmp name
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        f.write(text)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    atomic_write_text(path, text)
 
 
 def resolve_tag(load_dir, tag):
@@ -81,11 +111,116 @@ def resolve_tag(load_dir, tag):
     return tag
 
 
-def load_checkpoint(load_dir, tag, template_state):
+def _fallback_tags(load_dir, exclude):
+    """Other tag dirs under ``load_dir`` that carry a state payload,
+    newest first (by state mtime) — the recovery candidates when the
+    requested tag is corrupt or gone."""
+    cands = []
+    try:
+        names = os.listdir(load_dir)
+    except OSError:
+        return []
+    for name in names:
+        if name == str(exclude):
+            continue
+        state_dir = os.path.join(load_dir, name, "state")
+        if os.path.isdir(state_dir):
+            try:
+                mtime = os.stat(state_dir).st_mtime_ns
+            except OSError:
+                continue
+            cands.append((mtime, name))
+    return [name for _, name in sorted(cands, reverse=True)]
+
+
+def load_checkpoint(load_dir, tag, template_state, io_retries=3):
+    """Verified load with previous-good-tag fallback.
+
+    The ``latest``-resolved tag is tried first; if its shards are
+    PERMANENTLY damaged — integrity verification failure, truncated
+    payload, the tag dir deleted out from under a stale ``latest`` —
+    every other tag with a state payload is tried newest-first.
+    Fallback deliberately does NOT engage when:
+
+    * the caller named an explicit ``tag`` (they asked for specific
+      weights; silently substituting different ones would be worse
+      than failing),
+    * the error is a transient I/O failure that outlived the retry
+      budget (an FS brownout is not corruption — raising lets the
+      caller retry the SAME tag instead of losing progress),
+    * the shapes/leaf-count mismatch (structural, not corruption).
+
+    When no candidate survives, a typed ``CheckpointLoadError`` is
+    raised — never partially-read state."""
+    explicit_tag = tag is not None
     tag = resolve_tag(load_dir, tag)
+    candidates = [str(tag)]
+    if not explicit_tag:
+        candidates += _fallback_tags(load_dir, exclude=tag)
+    failures = []
+    # corruption-class errors only: plain OSError (minus the missing-
+    # tag FileNotFoundError) means transient I/O and must propagate
+    for cand in candidates:
+        try:
+            state, client_state = _load_tag(load_dir, cand,
+                                            template_state, io_retries)
+        except (CheckpointCorruptionError, FileNotFoundError,
+                EOFError, pickle.UnpicklingError,
+                zipfile.BadZipFile) as e:
+            logger.warning(
+                f"checkpoint tag {cand!r} unusable "
+                f"({type(e).__name__}: {str(e)[:200]})"
+                + ("; falling back to the previous good tag"
+                   if cand != candidates[-1] else ""))
+            failures.append(f"{cand}: {type(e).__name__}: {e}")
+            continue
+        # tell the caller which tag ACTUALLY loaded — sibling payloads
+        # (e.g. the offload host state) must read from the same tag,
+        # not the one originally requested
+        client_state = dict(client_state or {})
+        client_state["_loaded_tag"] = str(cand)
+        if cand != str(tag):
+            logger.warning(
+                f"recovered from corrupt/missing tag {tag!r} by "
+                f"loading previous good tag {cand!r}")
+            # repoint ``latest`` at what was actually loaded so the
+            # next resume (and sibling readers like the offload host
+            # state) agree on the good tag; best-effort on read-only
+            # media
+            try:
+                _atomic_write(os.path.join(load_dir, "latest"), cand)
+            except OSError:
+                pass
+        return state, client_state
+    raise CheckpointLoadError(
+        f"no loadable checkpoint under {load_dir}; tried "
+        f"{candidates}: " + " | ".join(failures))
+
+
+def _load_tag(load_dir, tag, template_state, io_retries=3):
     ckpt_dir = os.path.join(load_dir, str(tag))
     state_dir = os.path.join(ckpt_dir, "state")
+    if not os.path.isdir(state_dir):
+        raise FileNotFoundError(f"no state payload under {ckpt_dir}")
 
+    def attempt():
+        fault_injector.fire("checkpoint.load", detail=str(tag))
+        # integrity gate: checksum mismatch/truncation surfaces HERE
+        # as a typed error, before any bytes deserialize into arrays
+        verify_manifest(state_dir)
+        return _read_state(ckpt_dir, state_dir, load_dir, tag,
+                           template_state)
+
+    # transient read errors retry on the SAME tag before the caller
+    # falls back to an older one; corruption (not an OSError) and
+    # missing files (permanent — sleeping on them only delays the
+    # fallback scan) propagate immediately
+    return retry_io(attempt, retries=io_retries,
+                    non_retryable=(FileNotFoundError,),
+                    description=f"checkpoint load ({tag})")
+
+
+def _read_state(ckpt_dir, state_dir, load_dir, tag, template_state):
     state = None
     ocp = _try_orbax()
     if ocp is not None and os.path.isdir(state_dir) and not \
@@ -169,6 +304,8 @@ def load_raw_named(load_dir, tag):
     tag = resolve_tag(load_dir, tag)
     ckpt_dir = os.path.join(load_dir, str(tag))
     state_dir = os.path.join(ckpt_dir, "state")
+    if os.path.isdir(state_dir):
+        verify_manifest(state_dir)
     raw_map = None
     is_npz = os.path.exists(os.path.join(state_dir, "leaves.pkl"))
     ocp = _try_orbax()
@@ -214,9 +351,16 @@ def _npz_save(state_dir, state):
     arrays = {}
     for i, leaf in enumerate(leaves):
         arrays[f"leaf_{i}"] = np.asarray(leaf)
-    np.savez(os.path.join(state_dir, "leaves.npz"), **arrays)
-    with open(os.path.join(state_dir, "leaves.pkl"), "wb") as f:
-        pickle.dump({"names": names, "n": len(leaves)}, f)
+    # both shard files go through tmp+fsync+rename: a process killed at
+    # ANY byte offset leaves either the previous complete shard or none
+    # under the real name — never a truncated payload a later load
+    # could misread as valid (the meta .pkl commits LAST, since its
+    # presence is what marks the npz payload format)
+    atomic_write_bytes(os.path.join(state_dir, "leaves.npz"),
+                       lambda f: np.savez(f, **arrays))
+    atomic_write_bytes(os.path.join(state_dir, "leaves.pkl"),
+                       lambda f: pickle.dump(
+                           {"names": names, "n": len(leaves)}, f))
 
 
 def _npz_load(state_dir, template_state):

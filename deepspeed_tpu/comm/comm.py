@@ -28,9 +28,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 from ..parallel import mesh as mesh_lib
+from ..resilience.fault_injector import fault_injector
+from ..resilience.watchdog import collective_watchdog
 from ..utils.logging import logger
 from .comms_logging import CommsLogger, get_msg_size_from_args
 
@@ -150,7 +152,27 @@ def _in_trace(x):
     return isinstance(x, jax.core.Tracer)
 
 
-def _eager_run(fn, x, group, in_spec, out_spec):
+def _dispatch(name, thunk):
+    """Eager-collective execution seam: the fault-injection site
+    (``collective``) plus, when armed, the watchdog deadline. With the
+    watchdog off this is a passthrough call — no thread hop; when on,
+    the thunk's result is forced (block_until_ready) on the watchdog
+    thread so a wedged collective actually trips the deadline instead
+    of escaping through jax's async dispatch."""
+    def attempt():
+        # the fire lives INSIDE the watched call so an injected hang
+        # lands on the watchdog thread — exactly where a real stuck
+        # collective would sit
+        fault_injector.fire("collective", name)
+        return thunk()
+
+    if not collective_watchdog.enabled:
+        return attempt()
+    return collective_watchdog.run(
+        name, lambda: jax.block_until_ready(attempt()))
+
+
+def _eager_run(fn, x, group, in_spec, out_spec, name="collective"):
     """Shared eager-collective runner: one-shot shard_map under jit.
 
     Multi-controller (jax.process_count() > 1): each process passes its
@@ -170,7 +192,7 @@ def _eager_run(fn, x, group, in_spec, out_spec):
     if jax.process_count() > 1:
         x = jax.make_array_from_process_local_data(
             NamedSharding(mesh, in_spec), np.asarray(x))
-        out = jax.jit(wrapped)(x)
+        out = _dispatch(name, lambda: jax.jit(wrapped)(x))
         seen, parts = set(), []
         for s in sorted(out.addressable_shards,
                         key=lambda s: s.index[0].start or 0):
@@ -180,16 +202,16 @@ def _eager_run(fn, x, group, in_spec, out_spec):
             seen.add(key)
             parts.append(np.asarray(s.data))
         return jnp.asarray(np.concatenate(parts, axis=0))
-    return jax.jit(wrapped)(x)
+    return _dispatch(name, lambda: jax.jit(wrapped)(x))
 
 
-def _eager_wrap(fn, x, group, out_shifted_spec=None):
+def _eager_wrap(fn, x, group, out_shifted_spec=None, name="collective"):
     """Eager collective whose input's leading dim is sharded over the
     group axis (see _eager_run for the multi-controller contract)."""
     names = _axis(group)
     spec = P(names if len(names) > 1 else names[0])
     out_spec = out_shifted_spec if out_shifted_spec is not None else spec
-    return _eager_run(fn, x, group, spec, out_spec)
+    return _eager_run(fn, x, group, spec, out_spec, name=name)
 
 
 def _timed(name, group, x):
@@ -232,7 +254,8 @@ def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: Group = None, **kw):
     if _in_trace(tensor):
         return _all_reduce_traced(tensor, op, names)
     with _timed("all_reduce", group, tensor):
-        return _eager_wrap(lambda t: _all_reduce_traced(t, op, names), tensor, group)
+        return _eager_wrap(lambda t: _all_reduce_traced(t, op, names), tensor,
+                           group, name="all_reduce")
 
 
 def _all_reduce_traced(tensor, op, names):
@@ -280,7 +303,7 @@ def all_gather(tensor, group: Group = None, axis: int = 0, tiled: bool = True):
     with _timed("all_gather", group, tensor):
         return _eager_wrap(
             lambda t: jax.lax.all_gather(t, names, axis=axis, tiled=tiled),
-            tensor, group, out_shifted_spec=P())
+            tensor, group, out_shifted_spec=P(), name="all_gather")
 
 
 # torch.distributed-parity aliases (reference: comm.py:304-399)
@@ -301,7 +324,8 @@ def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, group: Group = None,
         return _rs(tensor)
     with _timed("reduce_scatter", group, tensor):
         spec_names = names if len(names) > 1 else names[0]
-        return _eager_run(_rs, tensor, group, P(), P(spec_names))
+        return _eager_run(_rs, tensor, group, P(), P(spec_names),
+                          name="reduce_scatter")
 
 
 reduce_scatter_tensor = reduce_scatter
@@ -321,7 +345,7 @@ def all_to_all_single(tensor, group: Group = None, split_axis: int = 0,
     if _in_trace(tensor):
         return _a2a(tensor)
     with _timed("all_to_all_single", group, tensor):
-        return _eager_wrap(_a2a, tensor, group)
+        return _eager_wrap(_a2a, tensor, group, name="all_to_all")
 
 
 all_to_all = all_to_all_single
@@ -340,7 +364,7 @@ def broadcast(tensor, src: int = 0, group: Group = None):
     if _in_trace(tensor):
         return _bcast(tensor)
     with _timed("broadcast", group, tensor):
-        return _eager_wrap(_bcast, tensor, group)
+        return _eager_wrap(_bcast, tensor, group, name="broadcast")
 
 
 def ppermute(tensor, perm, group: Group = None):
@@ -350,7 +374,8 @@ def ppermute(tensor, perm, group: Group = None):
     if _in_trace(tensor):
         return jax.lax.ppermute(tensor, names[0], perm)
     with _timed("ppermute", group, tensor):
-        return _eager_wrap(lambda t: jax.lax.ppermute(t, names[0], perm), tensor, group)
+        return _eager_wrap(lambda t: jax.lax.ppermute(t, names[0], perm),
+                           tensor, group, name="ppermute")
 
 
 def send_recv_next(tensor, group: Group = None):
@@ -364,7 +389,7 @@ def send_recv_next(tensor, group: Group = None):
 
     if _in_trace(tensor):
         return _shift(tensor)
-    return _eager_wrap(_shift, tensor, group)
+    return _eager_wrap(_shift, tensor, group, name="send_recv_next")
 
 
 def barrier(group: Group = None):
@@ -375,7 +400,7 @@ def barrier(group: Group = None):
     x = jnp.zeros((mesh.size,), dtype=jnp.float32)
     wrapped = shard_map(lambda t: jax.lax.psum(t, names), mesh=mesh,
                         in_specs=(P(names),), out_specs=P(names), check_vma=False)
-    jax.jit(wrapped)(x).block_until_ready()
+    _dispatch("barrier", lambda: jax.jit(wrapped)(x).block_until_ready())
     return True
 
 
@@ -398,7 +423,8 @@ def scatter(tensor, src: int = 0, group: Group = None):
     if _in_trace(tensor):
         return _scatter(tensor)
     spec_names = names if len(names) > 1 else names[0]
-    return _eager_run(_scatter, tensor, group, P(), P(spec_names))
+    return _eager_run(_scatter, tensor, group, P(), P(spec_names),
+                      name="scatter")
 
 
 def log_summary(show_straggler=False):

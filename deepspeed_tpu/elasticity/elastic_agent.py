@@ -36,6 +36,11 @@ from typing import Callable, Optional, Sequence
 from ..utils.logging import logger
 from .elasticity import compute_elastic_config, elasticity_enabled
 
+# Returned by DSElasticAgent.run when the restart budget is exhausted:
+# a DISTINCT terminal code (BSD EX_TEMPFAIL) so outer schedulers can
+# tell "worker kept failing, agent gave up" apart from any worker rc.
+RESTART_BUDGET_EXHAUSTED = 75
+
 
 def resume_latest(engine, ckpt_dir: Optional[str] = None) -> bool:
     """Load the newest committed checkpoint if one exists; returns
@@ -81,6 +86,10 @@ class DSElasticAgent:
                  ckpt_dir: str = "elastic_ckpt",
                  max_restarts: int = 100,
                  backoff_seconds: float = 1.0,
+                 backoff_factor: float = 2.0,
+                 max_backoff_seconds: float = 60.0,
+                 backoff_jitter: float = 0.25,
+                 terminal_exit_code: int = RESTART_BUDGET_EXHAUSTED,
                  device_probe: Optional[Callable[[], int]] = None,
                  env: Optional[dict] = None):
         self.script = script
@@ -88,7 +97,15 @@ class DSElasticAgent:
         self.ds_config = ds_config or {}
         self.ckpt_dir = ckpt_dir
         self.max_restarts = max_restarts
+        # exponential backoff with jitter: a crash-looping worker (bad
+        # chip, poisoned checkpoint) must not hot-spin the TPU runtime,
+        # and a fleet of agents restarting after a shared outage must
+        # not stampede the rendezvous at the same instant
         self.backoff_seconds = backoff_seconds
+        self.backoff_factor = backoff_factor
+        self.max_backoff_seconds = max_backoff_seconds
+        self.backoff_jitter = backoff_jitter
+        self.terminal_exit_code = terminal_exit_code
         self.device_probe = device_probe or default_device_probe
         self.env = dict(env) if env else dict(os.environ)
         self.restart_count = 0
@@ -130,11 +147,20 @@ class DSElasticAgent:
             if self.restart_count >= self.max_restarts:
                 logger.error(
                     f"elastic agent: worker failed rc={rc} and restart "
-                    f"budget ({self.max_restarts}) is exhausted")
-                return rc
+                    f"budget ({self.max_restarts}) is exhausted; "
+                    f"exiting with terminal code "
+                    f"{self.terminal_exit_code}")
+                return self.terminal_exit_code
             self.restart_count += 1
+            from ..resilience.retry import backoff_delay
+            delay = backoff_delay(self.restart_count - 1,
+                                  base_seconds=self.backoff_seconds,
+                                  factor=self.backoff_factor,
+                                  max_seconds=self.max_backoff_seconds,
+                                  jitter=self.backoff_jitter)
             logger.warning(
                 f"elastic agent: worker failed rc={rc}; re-probing "
                 f"devices and restarting "
-                f"({self.restart_count}/{self.max_restarts})")
-            time.sleep(self.backoff_seconds)
+                f"({self.restart_count}/{self.max_restarts}) "
+                f"in {delay:.2f}s")
+            time.sleep(delay)

@@ -515,7 +515,7 @@ def moe_mlp_ragged(x, router, we_gate, we_up, we_down, top_k,
     lives on the training path, moe/sharded_moe.py.
     """
     if ep_axis is not None:
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
         from ...parallel.mesh import mesh_manager
 
@@ -623,7 +623,7 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
 
     if tp_axis is not None:
         # head-sharded attention under shard_map (see docstring)
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
         from jax.sharding import PartitionSpec as TPSpec
         from ...parallel.mesh import mesh_manager
 

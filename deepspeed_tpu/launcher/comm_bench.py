@@ -53,7 +53,7 @@ def bench_collectives(axis="fsdp", sizes=None, trials=5, dtype="float32"):
         jax.block_until_ready(out)
         return (time.time() - t0) / trials
 
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
 
     for n in sizes:
         n = (n // world) * world or world

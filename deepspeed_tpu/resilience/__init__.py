@@ -1,0 +1,22 @@
+"""deepspeed_tpu.resilience — fault injection, checkpoint integrity,
+collective watchdog, and the train-loop sentinel.
+
+The subsystem's contract: every failure mode is (1) *injectable* on
+CPU via ``fault_injector``, (2) *detectable* via typed errors
+(``errors``), and (3) *recoverable* within a configured budget
+(retry/backoff, previous-good-tag fallback, checkpoint rollback,
+elastic respawn). Config lives under the ``resilience`` block
+(runtime/config.py:ResilienceConfig).
+"""
+
+from .errors import (CheckpointCorruptionError, CheckpointLoadError,  # noqa: F401
+                     CollectiveTimeout, InjectedFault, InjectedIOError,
+                     ResilienceError, TrainingDivergenceError)
+from .fault_injector import (FaultInjector, FaultSpec,  # noqa: F401
+                             KNOWN_SITES, fault_injector)
+from .integrity import (MANIFEST_NAME, atomic_write_bytes,  # noqa: F401
+                        atomic_write_text, file_sha256, verify_manifest,
+                        write_manifest)
+from .retry import backoff_delay, retry_io  # noqa: F401
+from .sentinel import TrainSentinel  # noqa: F401
+from .watchdog import CollectiveWatchdog, collective_watchdog  # noqa: F401

@@ -1,0 +1,52 @@
+"""Typed failure taxonomy for the resilience subsystem.
+
+Every recovery layer (retry, fallback, watchdog, sentinel, elastic
+agent) keys its decisions off these types — broad ``except Exception``
+at a recovery site would swallow programming errors, and bare strings
+cannot be acted on programmatically.
+"""
+
+
+class ResilienceError(RuntimeError):
+    """Base for every fault the resilience subsystem raises."""
+
+
+class CollectiveTimeout(ResilienceError):
+    """An eager collective exceeded the watchdog deadline (stuck peer,
+    wedged runtime). The engine/elastic agent treat this as a worker
+    failure: the process exits non-zero and the agent respawns it."""
+
+    def __init__(self, op: str, timeout_seconds: float):
+        self.op = op
+        self.timeout_seconds = timeout_seconds
+        super().__init__(
+            f"collective '{op}' did not complete within "
+            f"{timeout_seconds:.1f}s (watchdog)")
+
+
+class CheckpointCorruptionError(ResilienceError):
+    """A checkpoint shard failed integrity verification (checksum
+    mismatch, truncation, missing payload). Loaders must fall back to
+    the previous good tag — never return partially-read state."""
+
+
+class CheckpointLoadError(ResilienceError):
+    """No loadable checkpoint remained after exhausting every candidate
+    tag and the retry budget."""
+
+
+class TrainingDivergenceError(ResilienceError):
+    """The train-loop sentinel exhausted its rollback budget (or had no
+    verified checkpoint to roll back to) while losses stayed
+    non-finite/spiking."""
+
+
+class InjectedFault(ResilienceError):
+    """A deliberately injected failure (FaultInjector). Base class so
+    tests can distinguish injected faults from organic ones."""
+
+
+class InjectedIOError(InjectedFault, OSError):
+    """Injected transient I/O failure — an OSError subclass so the
+    standard bounded-retry path exercises exactly the code real disk
+    faults would."""

@@ -1,0 +1,187 @@
+"""Deterministic fault injection at named sites.
+
+Recovery code that only runs during real outages is untested code. The
+``FaultInjector`` lets every failure path be driven on CPU in unit
+tests (and in staging runs) by raising controlled faults at the named
+sites wired through the stack:
+
+    checkpoint.save     shard payload write (checkpoint/engine.py)
+    checkpoint.load     shard read + verify (checkpoint/engine.py)
+    collective          eager collective dispatch (comm/comm.py)
+    offload.d2h         host-offload grad download (runtime/zero/offload.py)
+    offload.h2d         host-offload param upload (runtime/zero/offload.py)
+    data.fetch          dataloader batch assembly (runtime/dataloader.py)
+
+Spec grammar (config ``resilience.fault_injection`` or env
+``DSTPU_FAULT_INJECT``), comma-separated entries::
+
+    <site>:<kind>[@<after>][x<count>][~<arg>]
+
+    kind   ioerror | error | hang
+    after  fire on the Nth call to the site (0-based, default 0)
+    count  how many consecutive calls fault (default 1; 'inf' = forever)
+    arg    kind parameter (hang: seconds to sleep, default 3600)
+
+Examples::
+
+    checkpoint.save:ioerror            first save write raises OSError
+    collective:hang@2~30               3rd eager collective hangs 30s
+    data.fetch:ioerror@0x2             first two fetches raise OSError
+
+Deterministic by construction: firing is keyed on per-site call
+ordinals, never randomness, so a recovery test replays identically.
+"""
+
+import os
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Union
+
+from ..utils.logging import logger
+from .errors import InjectedFault, InjectedIOError
+
+KNOWN_SITES = (
+    "checkpoint.save", "checkpoint.load", "collective",
+    "offload.d2h", "offload.h2d", "data.fetch",
+)
+
+_KINDS = ("ioerror", "error", "hang")
+
+ENV_SPEC = "DSTPU_FAULT_INJECT"
+
+
+class FaultSpec:
+    """One parsed injection rule (see module docstring for grammar)."""
+
+    def __init__(self, site: str, kind: str, after: int = 0,
+                 count: Union[int, float] = 1, arg: float = 3600.0):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; "
+                             f"expected one of {_KINDS}")
+        if site not in KNOWN_SITES:
+            # site classes grow over time; warn instead of failing so a
+            # spec written for a newer build degrades to a no-op
+            logger.warning(f"fault spec names unknown site {site!r} "
+                           f"(known: {KNOWN_SITES})")
+        self.site = site
+        self.kind = kind
+        self.after = int(after)
+        self.count = count
+        self.arg = float(arg)
+
+    @classmethod
+    def parse(cls, entry: str) -> "FaultSpec":
+        entry = entry.strip()
+        site, sep, rest = entry.partition(":")
+        if not sep or not rest:
+            raise ValueError(f"bad fault spec {entry!r}: expected "
+                             "'<site>:<kind>[@after][xcount][~arg]'")
+        m = re.fullmatch(
+            r"(?P<kind>[a-z]+)(?:@(?P<after>\d+))?"
+            r"(?:x(?P<count>\d+|inf))?(?:~(?P<arg>[\d.]+))?", rest)
+        if m is None:
+            raise ValueError(f"bad fault spec {entry!r}: expected "
+                             "'<site>:<kind>[@after][xcount][~arg]'")
+        count: Union[int, float] = 1
+        if m.group("count"):
+            count = float("inf") if m.group("count") == "inf" \
+                else int(m.group("count"))
+        return cls(site, m.group("kind"),
+                   after=int(m.group("after") or 0), count=count,
+                   arg=float(m.group("arg") or 3600.0))
+
+    def __repr__(self):
+        return (f"FaultSpec({self.site}:{self.kind}@{self.after}"
+                f"x{self.count}~{self.arg})")
+
+
+class FaultInjector:
+    """Process-wide injection registry. ``fire(site)`` is called from
+    the instrumented sites; with no configured specs it is a single
+    attribute check, so the production hot path pays nothing."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._specs: List[FaultSpec] = []
+        self._calls: Dict[str, int] = {}
+        self.fired: List[str] = []      # audit log: "<site>:<kind>@<n>"
+        env = os.environ.get(ENV_SPEC)
+        if env:
+            self.configure(env)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._specs)
+
+    def configure(self, spec: Union[str, List[FaultSpec], None]):
+        """Replace the active rules. ``spec`` is the grammar string, a
+        list of FaultSpec, or None/"" to disable."""
+        if spec is None or spec == "":
+            specs: List[FaultSpec] = []
+        elif isinstance(spec, str):
+            specs = [FaultSpec.parse(e) for e in spec.split(",")
+                     if e.strip()]
+        else:
+            specs = list(spec)
+        with self._lock:
+            self._specs = specs
+            self._calls = {}
+            self.fired = []
+        if specs:
+            logger.warning(f"fault injection ARMED: {specs}")
+
+    def reset(self):
+        self.configure(None)
+
+    def call_count(self, site: str) -> int:
+        with self._lock:
+            return self._calls.get(site, 0)
+
+    def fire(self, site: str, detail: str = ""):
+        """Invoked by an instrumented site; raises/sleeps per the
+        matching spec, else returns immediately."""
+        if not self._specs:
+            return
+        with self._lock:
+            n = self._calls.get(site, 0)
+            self._calls[site] = n + 1
+            spec = None
+            for s in self._specs:
+                if s.site == site and s.after <= n < s.after + s.count:
+                    spec = s
+                    break
+            if spec is not None:
+                self.fired.append(f"{site}:{spec.kind}@{n}")
+        if spec is None:
+            return
+        label = f"{site}[{n}]" + (f" ({detail})" if detail else "")
+        logger.warning(f"fault injection: {spec.kind} at {label}")
+        if spec.kind == "hang":
+            time.sleep(spec.arg)
+            return
+        if spec.kind == "ioerror":
+            raise InjectedIOError(f"injected I/O fault at {label}")
+        raise InjectedFault(f"injected fault at {label}")
+
+    class _Scope:
+        def __init__(self, injector, spec):
+            self._injector = injector
+            self._spec = spec
+
+        def __enter__(self):
+            self._injector.configure(self._spec)
+            return self._injector
+
+        def __exit__(self, *exc):
+            self._injector.reset()
+            return False
+
+    def inject(self, spec: Union[str, List[FaultSpec]]) -> "_Scope":
+        """Context manager for tests: arm ``spec`` inside the block,
+        disarm (and clear counters) on exit."""
+        return self._Scope(self, spec)
+
+
+# process-wide singleton every instrumented site fires through
+fault_injector = FaultInjector()

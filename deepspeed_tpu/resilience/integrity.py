@@ -1,0 +1,147 @@
+"""Checkpoint shard integrity: per-file checksums + an atomic manifest.
+
+A checkpoint tag is only as trustworthy as its weakest shard: a
+truncated ``leaves.npz`` or a bit-flipped orbax array file loads into
+garbage state long after the incident. The save path records a
+``manifest.json`` (sha256 + size per payload file, written via
+tmp+fsync+rename LAST, after every payload is durable); the load path
+re-hashes and raises ``CheckpointCorruptionError`` on any mismatch so
+callers fall back to the previous good tag instead of resuming into
+corruption.
+"""
+
+import hashlib
+import json
+import os
+from typing import Dict, Optional
+
+from ..utils.logging import logger
+from .errors import CheckpointCorruptionError
+
+MANIFEST_NAME = "manifest.json"
+_CHUNK = 1 << 20
+
+
+def atomic_write_text(path: str, text: str):
+    """tmp + fsync + rename: readers see the old file or the complete
+    new one, never a partial write (unique tmp per writer — shared
+    multi-host checkpoint dirs must not race on one tmp name)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_bytes(path: str, payload_writer):
+    """Atomic binary write: ``payload_writer(fileobj)`` streams the
+    payload into a tmp file which is fsynced then renamed over
+    ``path``. A kill at ANY point leaves either the old file or no
+    file — never a truncated one under the final name."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            payload_writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # don't leave tmp litter behind on failure; the original
+        # exception is what the caller must see
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_CHUNK)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _payload_files(state_dir: str):
+    """Every regular file under ``state_dir`` except the manifest
+    itself and in-flight tmp files, as sorted relative paths."""
+    out = []
+    for root, _dirs, files in os.walk(state_dir):
+        for name in files:
+            rel = os.path.relpath(os.path.join(root, name), state_dir)
+            if rel == MANIFEST_NAME or ".tmp." in name:
+                continue
+            out.append(rel)
+    return sorted(out)
+
+
+def write_manifest(state_dir: str) -> Dict:
+    """Hash every payload file under ``state_dir`` and commit the
+    manifest atomically. Called AFTER the payload writes are durable —
+    the manifest is the integrity commit point for the tag's state.
+
+    The hash pass re-reads what was just written; tee-hashing the
+    write stream would be cheaper but is incorrect for zip-format
+    payloads (np.savez seeks backward to patch headers), and the orbax
+    writer is opaque — so the save path accepts one extra read."""
+    entries = {}
+    for rel in _payload_files(state_dir):
+        full = os.path.join(state_dir, rel)
+        entries[rel] = {"sha256": file_sha256(full),
+                        "size": os.path.getsize(full)}
+    manifest = {"version": 1, "files": entries}
+    atomic_write_text(os.path.join(state_dir, MANIFEST_NAME),
+                      json.dumps(manifest, indent=1, sort_keys=True))
+    return manifest
+
+
+def verify_manifest(state_dir: str,
+                    strict: bool = False) -> Optional[Dict]:
+    """Re-hash ``state_dir`` against its manifest.
+
+    Returns the manifest dict when verification passes, ``None`` when
+    no manifest exists (pre-integrity checkpoint; ``strict=True``
+    upgrades that to corruption). Raises ``CheckpointCorruptionError``
+    on size/checksum mismatch, missing payload files, or an unreadable
+    manifest."""
+    mpath = os.path.join(state_dir, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        if strict:
+            raise CheckpointCorruptionError(
+                f"no integrity manifest under {state_dir}")
+        return None
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (ValueError, KeyError) as e:
+        # malformed content IS corruption; a transient OSError opening
+        # the file is NOT — it propagates as-is so the caller's retry
+        # runs on the same tag instead of falling back
+        raise CheckpointCorruptionError(
+            f"unreadable manifest {mpath}: {e}") from e
+    bad = []
+    for rel, meta in files.items():
+        full = os.path.join(state_dir, rel)
+        if not os.path.exists(full):
+            bad.append(f"{rel}: missing")
+            continue
+        size = os.path.getsize(full)
+        if size != meta.get("size"):
+            bad.append(f"{rel}: size {size} != {meta.get('size')}")
+            continue
+        digest = file_sha256(full)
+        if digest != meta.get("sha256"):
+            bad.append(f"{rel}: checksum mismatch")
+    if bad:
+        raise CheckpointCorruptionError(
+            f"checkpoint state under {state_dir} failed verification: "
+            + "; ".join(bad))
+    logger.debug(f"checkpoint integrity verified: {state_dir} "
+                 f"({len(files)} files)")
+    return manifest

@@ -1,0 +1,63 @@
+"""Bounded retry with exponential backoff + jitter for transient I/O.
+
+One policy shared by every storage-touching path (checkpoint shard
+writes/reads, offload transfers, dataloader fetches): transient
+``OSError``-family failures retry up to a budget with exponentially
+growing, jittered sleeps; anything else — including corruption, which
+retrying cannot fix — propagates immediately.
+"""
+
+import random
+import time
+from typing import Callable, Tuple, Type
+
+from ..utils.logging import logger
+
+
+def backoff_delay(attempt: int, *, base_seconds: float,
+                  factor: float = 2.0, max_seconds: float = 2.0,
+                  jitter: float = 0.25) -> float:
+    """Exponential backoff delay for the Nth retry (0-based). Jitter
+    rides ON TOP of the clamp (worst case ``max_seconds * (1 +
+    jitter)``) — deliberately: clamping after jitter would make every
+    saturated retrier sleep exactly ``max_seconds`` and re-hit the
+    shared resource in lockstep. One policy for retry_io AND the
+    elastic agent's restart loop."""
+    delay = min(max_seconds, base_seconds * (factor ** attempt))
+    return delay + random.uniform(0.0, jitter * delay)
+
+
+def retry_io(fn: Callable, *, retries: int = 3,
+             backoff_seconds: float = 0.05,
+             max_backoff_seconds: float = 2.0,
+             jitter: float = 0.25,
+             retryable: Tuple[Type[BaseException], ...] = (OSError,),
+             non_retryable: Tuple[Type[BaseException], ...] = (),
+             description: str = "io operation"):
+    """Run ``fn()`` with up to ``retries`` re-attempts on ``retryable``
+    exceptions. ``non_retryable`` carves exceptions back out of the
+    retryable set (e.g. FileNotFoundError out of OSError — a missing
+    file is permanent, sleeping on it only delays the caller's
+    fallback). Returns fn's result; re-raises the last error once the
+    budget is exhausted."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retryable as e:
+            if non_retryable and isinstance(e, non_retryable):
+                raise
+            if attempt >= retries:
+                logger.error(
+                    f"{description}: failed after {attempt + 1} "
+                    f"attempts ({type(e).__name__}: {e})")
+                raise
+            delay = backoff_delay(attempt, base_seconds=backoff_seconds,
+                                  max_seconds=max_backoff_seconds,
+                                  jitter=jitter)
+            logger.warning(
+                f"{description}: transient failure "
+                f"({type(e).__name__}: {e}); retry "
+                f"{attempt + 1}/{retries} in {delay * 1e3:.0f}ms")
+            time.sleep(delay)
+            attempt += 1

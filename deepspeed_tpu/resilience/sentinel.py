@@ -1,0 +1,104 @@
+"""Train-loop sentinel: NaN/Inf + loss-spike detection with a
+consecutive-failure budget and auto-rollback.
+
+The fp16 loss scaler already rolls back overflowed steps *inside* the
+jitted step (runtime/fp16/loss_scaler.py); the sentinel is the host
+layer above it that notices when skipping stops working — losses stay
+non-finite (bf16 has no scaler), or spike far above the running
+average — and, after ``failure_budget`` consecutive bad steps,
+restores the last verified checkpoint through the elastic resume path
+(elasticity/elastic_agent.py:resume_latest). A bounded number of
+rollbacks guards against a deterministically-diverging run looping
+forever: past ``max_rollbacks`` the sentinel escalates with a typed
+``TrainingDivergenceError`` the elastic agent can act on.
+"""
+
+import math
+from typing import Optional
+
+from ..utils.logging import logger
+
+OK = "ok"
+SKIP = "skip"
+ROLLBACK = "rollback"
+
+
+class TrainSentinel:
+    """Pure host-side state machine; the engine calls ``observe`` once
+    per train step and acts on the returned action.
+
+    ``loss_spike_factor=0`` disables spike detection (NaN/Inf and
+    overflow tracking stay on). Spike detection arms only after
+    ``window`` healthy steps so warm-up loss motion is not punished.
+    """
+
+    def __init__(self, loss_spike_factor: float = 0.0,
+                 window: int = 32,
+                 failure_budget: int = 3,
+                 max_rollbacks: int = 2,
+                 ckpt_dir: Optional[str] = None,
+                 count_overflow: bool = False):
+        if failure_budget < 1:
+            raise ValueError("failure_budget must be >= 1")
+        self.loss_spike_factor = float(loss_spike_factor)
+        self.window = int(window)
+        self.failure_budget = int(failure_budget)
+        self.max_rollbacks = int(max_rollbacks)
+        self.ckpt_dir = ckpt_dir
+        self.count_overflow = bool(count_overflow)
+        self._alpha = 2.0 / (self.window + 1.0)
+        self.ema: Optional[float] = None
+        self.healthy_steps = 0
+        self.consecutive_failures = 0
+        self.rollbacks = 0
+
+    def _is_failure(self, loss: float, overflow: bool) -> Optional[str]:
+        if overflow:
+            return "fp16 overflow"
+        if not math.isfinite(loss):
+            return f"non-finite loss ({loss})"
+        if (self.loss_spike_factor > 0 and self.ema is not None
+                and self.healthy_steps >= self.window
+                and loss > self.loss_spike_factor * max(self.ema, 1e-8)):
+            return (f"loss spike ({loss:.4g} > "
+                    f"{self.loss_spike_factor:g} x ema {self.ema:.4g})")
+        return None
+
+    def observe(self, loss: float, overflow: bool = False) -> str:
+        """Returns OK, SKIP (bad step: don't advance schedules), or
+        ROLLBACK (budget exhausted: restore the last good checkpoint,
+        then call ``note_rollback``)."""
+        if overflow and not self.count_overflow:
+            # the in-step scaler already rolled the update back, and a
+            # fresh fp16 run legitimately overflows several steps in a
+            # row while the scale halves down from its initial value —
+            # counting those toward the budget would roll back (or
+            # kill) a healthy warm-up. The overflowed loss value is
+            # garbage, so statistics stay untouched too.
+            return SKIP
+        reason = self._is_failure(loss, overflow)
+        if reason is None:
+            self.consecutive_failures = 0
+            self.healthy_steps += 1
+            self.ema = loss if self.ema is None else \
+                (1.0 - self._alpha) * self.ema + self._alpha * loss
+            return OK
+        self.consecutive_failures += 1
+        logger.warning(
+            f"train sentinel: {reason} — consecutive failure "
+            f"{self.consecutive_failures}/{self.failure_budget}")
+        if self.consecutive_failures >= self.failure_budget:
+            return ROLLBACK
+        return SKIP
+
+    def note_rollback(self):
+        """Record a completed restore and re-arm: statistics restart
+        from scratch (the restored run is a different trajectory)."""
+        self.rollbacks += 1
+        self.consecutive_failures = 0
+        self.healthy_steps = 0
+        self.ema = None
+
+    @property
+    def budget_exhausted(self) -> bool:
+        return self.rollbacks >= self.max_rollbacks

@@ -1,0 +1,83 @@
+"""Collective watchdog: deadline enforcement on eager collectives.
+
+A stuck collective (dead peer, wedged ICI link, livelocked runtime) is
+the worst fleet failure mode: the process neither crashes nor makes
+progress, so the elastic agent never respawns it. The watchdog runs
+eager collective dispatch on a worker thread and raises a typed
+``CollectiveTimeout`` when the deadline passes — the training process
+can then exit non-zero and the agent's restart/replan machinery takes
+over (the torch analog is the NCCL watchdog + torchelastic).
+
+Traced collectives (inside jit/shard_map) cannot be interrupted from
+Python and are NOT watched — only the eager/host-coordination paths in
+``comm/comm.py`` go through here, which is exactly where rendezvous
+and barrier hangs live.
+
+Disabled by default (``timeout=None``): the dispatch is then a direct
+call with zero threading overhead. Enable via the config block
+``resilience.collective_timeout_seconds`` or env
+``DSTPU_COLLECTIVE_TIMEOUT``.
+"""
+
+import os
+import queue
+import threading
+from typing import Callable, Optional
+
+from ..utils.logging import logger
+from .errors import CollectiveTimeout
+
+ENV_TIMEOUT = "DSTPU_COLLECTIVE_TIMEOUT"
+
+
+class CollectiveWatchdog:
+
+    def __init__(self, timeout_seconds: Optional[float] = None):
+        if timeout_seconds is None:
+            env = os.environ.get(ENV_TIMEOUT)
+            timeout_seconds = float(env) if env else None
+        self.timeout_seconds = timeout_seconds
+        self.timeouts = 0          # observability: fired deadlines
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.timeout_seconds and self.timeout_seconds > 0)
+
+    def configure(self, timeout_seconds: Optional[float]):
+        self.timeout_seconds = timeout_seconds
+        if self.enabled:
+            logger.info(f"collective watchdog armed: "
+                        f"{self.timeout_seconds:.1f}s deadline")
+
+    def run(self, op: str, fn: Callable):
+        """Dispatch ``fn`` under the deadline on a DAEMON thread. On
+        timeout the worker keeps running (it cannot be killed — same
+        as a wedged NCCL kernel) but the caller gets a typed,
+        actionable error instead of hanging forever, and because the
+        thread is a daemon (and never joined at interpreter shutdown,
+        unlike ThreadPoolExecutor workers) the process can still EXIT
+        non-zero so the elastic agent respawns it."""
+        if not self.enabled:
+            return fn()
+        out: "queue.Queue" = queue.Queue(maxsize=1)
+
+        def work():
+            try:
+                out.put(("ok", fn()))
+            except BaseException as e:  # routed to the caller below
+                out.put(("err", e))
+
+        threading.Thread(target=work, daemon=True,
+                         name=f"coll-watchdog:{op}").start()
+        try:
+            kind, val = out.get(timeout=self.timeout_seconds)
+        except queue.Empty:
+            self.timeouts += 1
+            raise CollectiveTimeout(op, self.timeout_seconds) from None
+        if kind == "err":
+            raise val
+        return val
+
+
+# process-wide singleton; comm/comm.py dispatches through it
+collective_watchdog = CollectiveWatchdog()

@@ -144,6 +144,40 @@ class CompileCacheConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class SentinelConfig(DeepSpeedConfigModel):
+    """Train-loop sentinel (resilience subsystem): NaN/Inf + loss-spike
+    detection with a consecutive-failure budget, auto-rollback to the
+    last verified checkpoint, and a bounded rollback count (see
+    resilience/sentinel.py)."""
+    enabled: bool = False
+    loss_spike_factor: float = 0.0   # 0 disables spike detection
+    window: int = 32                 # EMA window / spike warm-up steps
+    failure_budget: int = 3          # consecutive bad steps -> rollback
+    max_rollbacks: int = 2           # rollbacks before escalating
+    ckpt_dir: str = None             # default: $DSTPU_ELASTIC_CKPT_DIR
+    # count fp16 overflow skips toward the budget (off: scaler warm-up
+    # overflows are routine and already rolled back in-step)
+    count_overflow: bool = False
+
+
+@dataclasses.dataclass
+class ResilienceConfig(DeepSpeedConfigModel):
+    """Fault-tolerance knobs (TPU extension; resilience/ package):
+    deterministic fault injection, checkpoint shard integrity, the
+    eager-collective watchdog, and the train-loop sentinel."""
+    # FaultInjector spec string, e.g. "checkpoint.save:ioerror" (see
+    # resilience/fault_injector.py for the grammar); also settable via
+    # env DSTPU_FAULT_INJECT
+    fault_injection: str = None
+    # bounded retry budget for checkpoint shard I/O
+    io_retries: int = 3
+    # deadline for eager collectives; 0 disables the watchdog (env:
+    # DSTPU_COLLECTIVE_TIMEOUT)
+    collective_timeout_seconds: float = 0.0
+    sentinel: SentinelConfig = submodel(SentinelConfig)
+
+
+@dataclasses.dataclass
 class PipelineConfig(DeepSpeedConfigModel):
     """Pipeline engine knobs (reference: pipe engine config usage)."""
     stages: str = "auto"
@@ -205,6 +239,8 @@ class DeepSpeedConfig:
         self.compile_cache_config = CompileCacheConfig.from_dict(
             d.get("compile_cache", {}))
         self.pipeline_config = PipelineConfig.from_dict(d.get(PIPELINE, {}))
+        self.resilience_config = ResilienceConfig.from_dict(
+            d.get("resilience", {}))
         # curriculum learning: legacy top-level section or nested under
         # data_efficiency.data_sampling (reference: data_pipeline/config.py)
         self.curriculum_config = d.get("curriculum_learning", None)

@@ -8,6 +8,8 @@ slice (the analog of per-rank DistributedSampler sharding)."""
 import numpy as np
 
 from ..parallel.mesh import BATCH_AXES
+from ..resilience.fault_injector import fault_injector
+from ..resilience.retry import retry_io
 
 
 class RepeatingLoader:
@@ -43,7 +45,8 @@ class DeepSpeedDataLoader:
     runtime/data_pipeline/data_sampling)."""
 
     def __init__(self, dataset, batch_size, collate_fn=None, shuffle=False,
-                 seed=0, drop_last=True, data_sampler=None):
+                 seed=0, drop_last=True, data_sampler=None,
+                 fetch_retries=2):
         self.dataset = dataset
         self.batch_size = batch_size
         self.collate_fn = collate_fn or _default_collate
@@ -51,6 +54,10 @@ class DeepSpeedDataLoader:
         self.seed = seed
         self.drop_last = drop_last
         self.data_sampler = data_sampler
+        # transient-read budget for one batch assembly (remote blob
+        # stores / preempted readers); corruption or a persistent
+        # failure still propagates after the budget
+        self.fetch_retries = fetch_retries
         # applied to each collated batch before it is yielded
         # (reference: dataloader post_process_func set via
         # engine.set_data_post_process_func, engine.py:452)
@@ -79,7 +86,14 @@ class DeepSpeedDataLoader:
             chunk = indices[start:start + self.batch_size]
             if not chunk:
                 return
-            batch = self.collate_fn([self.dataset[i] for i in chunk])
+
+            def _fetch(chunk=chunk):
+                fault_injector.fire("data.fetch")
+                return self.collate_fn([self.dataset[i] for i in chunk])
+
+            batch = retry_io(_fetch, retries=self.fetch_retries,
+                             backoff_seconds=0.01,
+                             description="data batch fetch")
             if self.post_process_func is not None:
                 # reference contract (dataloader.py:121): second arg is
                 # the sampler state. When the engine wires curriculum it

@@ -82,6 +82,8 @@ def _apply_compile_cache(cc):
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       float(cc.min_compile_time_secs))
+    from ..utils.jax_compat import reset_compilation_cache
+    reset_compilation_cache()
     log_dist(f"XLA compilation cache enabled at {path}", ranks=[0])
 
 
@@ -123,6 +125,37 @@ class DeepSpeedEngine:
         self._config.resolve_batch_sizes(self.dp_world_size)
 
         dist.configure(self._config)
+
+        # ---- resilience wiring (resilience/ subsystem): config-driven
+        # fault injection, collective watchdog deadline, train sentinel
+        rcfg = self._config.resilience_config
+        self._sentinel = None
+        from ..resilience.fault_injector import ENV_SPEC, fault_injector
+        from ..resilience.watchdog import (ENV_TIMEOUT,
+                                           collective_watchdog)
+        if rcfg.fault_injection:
+            fault_injector.configure(rcfg.fault_injection)
+        elif fault_injector.enabled and not os.environ.get(ENV_SPEC):
+            # the injector is process-global: a previous engine's
+            # config-armed drill must not leak into this engine's run
+            # (env-armed specs are left alone — the operator owns them)
+            fault_injector.reset()
+        if rcfg.collective_timeout_seconds and \
+                rcfg.collective_timeout_seconds > 0:
+            collective_watchdog.configure(rcfg.collective_timeout_seconds)
+        elif collective_watchdog.enabled and \
+                not os.environ.get(ENV_TIMEOUT):
+            collective_watchdog.configure(None)
+        if rcfg.sentinel.enabled:
+            from ..resilience.sentinel import TrainSentinel
+            self._sentinel = TrainSentinel(
+                loss_spike_factor=rcfg.sentinel.loss_spike_factor,
+                window=rcfg.sentinel.window,
+                failure_budget=rcfg.sentinel.failure_budget,
+                max_rollbacks=rcfg.sentinel.max_rollbacks,
+                ckpt_dir=rcfg.sentinel.ckpt_dir
+                or os.environ.get("DSTPU_ELASTIC_CKPT_DIR"),
+                count_overflow=rcfg.sentinel.count_overflow)
 
         self.module = model
         self.client_optimizer = optimizer
@@ -414,10 +447,12 @@ class DeepSpeedEngine:
             # annotate_device_placement RET_CHECK; remote AOT SIGABRT) —
             # so every compute entry point swaps host->device first and
             # back after (_swap_state_in/_swap_state_out).
+            from ..utils.jax_compat import host_memory_kind
+            hk = host_memory_kind()
             host_m_sh = jax.tree_util.tree_map(
-                lambda s: s.with_memory_kind("pinned_host"), master_sh)
+                lambda s: s.with_memory_kind(hk), master_sh)
             host_o_sh = jax.tree_util.tree_map(
-                lambda s: s.with_memory_kind("pinned_host"), opt_sh)
+                lambda s: s.with_memory_kind(hk), opt_sh)
             master = _put_with_fallback(master, host_m_sh)
             opt_state = _put_with_fallback(opt_state, host_o_sh)
             self._offload_state_sh = (host_m_sh, host_o_sh)
@@ -948,7 +983,7 @@ class DeepSpeedEngine:
                 "compressed local quantities would break error "
                 "feedback; ZeroOneAdam ignores it entirely, like the "
                 "reference)")
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
         from .fp16.onebit import (CommCtx, onebit_adam_update,
                                   onebit_lamb_update,
                                   zero_one_adam_update)
@@ -1241,7 +1276,7 @@ class DeepSpeedEngine:
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, master)
             if not qwz:
                 return jax.lax.with_sharding_constraint(lp, param_sh)
-            from jax import shard_map
+            from deepspeed_tpu.utils.jax_compat import shard_map
             from ..comm.compressed import quantized_all_gather
 
             flat, treedef = jax.tree_util.tree_flatten(lp)
@@ -1278,7 +1313,7 @@ class DeepSpeedEngine:
             quantize->all-to-all->reduce'd over fsdp, then psum'd over
             data on the already-scattered (1/fsdp-sized) shard.
             Returns (fp32 grads in opt layout, sum-of-micro losses)."""
-            from jax import shard_map
+            from deepspeed_tpu.utils.jax_compat import shard_map
             from ..comm.compressed import quantized_psum_scatter
 
             flatp, pdef = jax.tree_util.tree_flatten(lp_params)
@@ -1702,7 +1737,24 @@ class DeepSpeedEngine:
         # (reference: stage_1_and_2.py step overflow path skips the
         # scheduler via _take_model_step).
         overflow = bool(metrics["overflow"]) if self.fp16_enabled else False
-        if overflow:
+        sentinel_skip = False
+        if self._sentinel is not None:
+            from ..resilience.sentinel import ROLLBACK, SKIP
+            action = self._sentinel.observe(float(metrics["loss"]),
+                                            overflow=overflow)
+            if action == ROLLBACK:
+                self._sentinel_rollback()
+                # the restore just rewound global_steps/samples/
+                # micro_steps to the checkpoint — the diverged step's
+                # bookkeeping below must not advance them again, and
+                # its NaN metrics must not reach the monitor under the
+                # restored trajectory. Return the observed (bad) loss
+                # so the caller's loop sees the incident.
+                self.skipped_steps += 1
+                return metrics["loss"]
+            elif action == SKIP:
+                sentinel_skip = True
+        if overflow or sentinel_skip:
             self.skipped_steps += 1
         else:
             self.global_steps += 1
@@ -1727,6 +1779,33 @@ class DeepSpeedEngine:
                 f"grad_norm={float(metrics['grad_norm']):.3f}"
                 f"{self._mfu_suffix()}", ranks=[0])
         return loss
+
+    def _sentinel_rollback(self):
+        """Auto-rollback: after the sentinel's consecutive-failure
+        budget is spent, restore the last VERIFIED checkpoint through
+        the elastic resume path (the fused step already applied the bad
+        update, so host-side skipping alone cannot recover a poisoned
+        state). Escalates with a typed ``TrainingDivergenceError`` once
+        the rollback budget is also exhausted — from there only the
+        elastic agent (fresh process, possibly fresh topology) can
+        help."""
+        from ..resilience.errors import TrainingDivergenceError
+        s = self._sentinel
+        if s.budget_exhausted:
+            raise TrainingDivergenceError(
+                f"training diverged: {s.rollbacks} rollback(s) did not "
+                f"recover (max_rollbacks={s.max_rollbacks})")
+        from ..elasticity.elastic_agent import resume_latest
+        if not s.ckpt_dir or not resume_latest(self, s.ckpt_dir):
+            raise TrainingDivergenceError(
+                "sentinel rollback requested but no committed "
+                f"checkpoint is available (ckpt_dir={s.ckpt_dir!r}); "
+                "save checkpoints periodically or set "
+                "resilience.sentinel.ckpt_dir")
+        s.note_rollback()
+        log_dist(f"sentinel auto-rollback #{s.rollbacks}: restored "
+                 f"step {self.global_steps} from {s.ckpt_dir}",
+                 ranks=[0])
 
     def _mfu_suffix(self) -> str:
         """' mfu=xx.x%' for the periodic log (reference: ThroughputTimer
@@ -2046,15 +2125,10 @@ class DeepSpeedEngine:
             _json.dumps(dtypes).encode(), dtype=np.uint8)
         path = os.path.join(save_dir, save_filename)
         ensure_directory_exists(path)
-        # unique tmp per writer + fsync before publish (the
-        # checkpoint_engine._atomic_write contract: shared save dirs see
-        # either the old file or the complete new one)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            np.savez(f, **arrays)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        # atomic publish (shared save dirs see either the old file or
+        # the complete new one)
+        from ..resilience.integrity import atomic_write_bytes
+        atomic_write_bytes(path, lambda f: np.savez(f, **arrays))
         return True
 
     def set_data_post_process_func(self, post_process_func):
@@ -2167,9 +2241,17 @@ class DeepSpeedEngine:
                 payload[f"gres_{i}"] = np.asarray(r)
             tag_dir = os.path.join(save_dir, str(tag))
             os.makedirs(tag_dir, exist_ok=True)
-            np.savez(os.path.join(tag_dir,
-                                  "zero_offload_host_state.npz"),
-                     **payload)
+            # atomic write + checksum recorded in client_state: the
+            # host payload lives OUTSIDE state/ (the manifest's scope),
+            # so it carries its own integrity through the tag's json
+            from ..resilience.integrity import (atomic_write_bytes,
+                                                file_sha256)
+            host_path = os.path.join(tag_dir,
+                                     "zero_offload_host_state.npz")
+            atomic_write_bytes(host_path,
+                               lambda f: np.savez(f, **payload))
+            client_state["zero_offload_host_sha256"] = \
+                file_sha256(host_path)
         self.checkpoint_engine.save(self.state, save_dir, tag,
                                     client_state=client_state,
                                     save_latest=save_latest)
@@ -2186,13 +2268,32 @@ class DeepSpeedEngine:
                              "(pass model_parameters or run a batch)")
         state, client_state = self.checkpoint_engine.load(
             load_dir, tag, self.state)
-        self.state = state
+        z = None
         if self._offload is not None and load_optimizer_states:
             from ..checkpoint.engine import resolve_tag
-            tag = resolve_tag(load_dir, tag)
+            from ..resilience.errors import CheckpointCorruptionError
+            from ..resilience.integrity import file_sha256
+            # read from the tag that ACTUALLY loaded (the integrity
+            # fallback may have picked an older one) — mixing one
+            # tag's model state with another's host optimizer state
+            # would silently skew training. Verified BEFORE any engine
+            # state is replaced, so a corrupt host payload raises with
+            # the engine untouched instead of half-loaded.
+            tag = (client_state or {}).get("_loaded_tag") or \
+                resolve_tag(load_dir, tag)
             path = os.path.join(load_dir, str(tag),
                                 "zero_offload_host_state.npz")
+            expect = (client_state or {}).get(
+                "zero_offload_host_sha256")
+            if expect and file_sha256(path) != expect:
+                raise CheckpointCorruptionError(
+                    f"zero_offload_host_state.npz under tag {tag} "
+                    "failed checksum verification — the offload host "
+                    "state is corrupt; restore from an older tag "
+                    "explicitly (load_checkpoint(dir, tag=...))")
             z = np.load(path)
+        self.state = state
+        if z is not None:
             n = len(self._offload.off_idx)
             self._offload.load_state_dict({
                 "step": int(z["step"]),
@@ -2350,10 +2451,8 @@ class DeepSpeedEngine:
             lowered = self._jit_train_step.lower(
                 self.state, self._profile_batch_struct, self._rng,
                 comp_bits, prune_on, self._offload_grad_residual)
-            try:
-                txt = lowered.as_text(debug_info=True)
-            except TypeError:       # older jax: no debug_info kwarg
-                txt = lowered.as_text()
+            from ..utils.jax_compat import lowered_text_with_debug_info
+            txt = lowered_text_with_debug_info(lowered)
             gas = self.gradient_accumulation_steps()
             self._module_flops_profile = {
                 k: v * gas

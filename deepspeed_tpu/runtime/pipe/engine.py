@@ -57,7 +57,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from deepspeed_tpu.utils.jax_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...parallel.mesh import BATCH_AXES, PIPE_AXIS, mesh_manager

@@ -25,6 +25,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ops.adam.cpu_adam import DeepSpeedCPUAdam
+from ...resilience.fault_injector import fault_injector
+from ...resilience.retry import retry_io
+from ...utils.jax_compat import TRANSFER_ERRORS
 from ...utils.logging import log_dist
 
 
@@ -216,7 +219,12 @@ class OffloadCoordinator:
             return None
         if self.store is not None:
             t0 = time.perf_counter()
-            host = jax.device_get(list(off_grads))
+            host = retry_io(
+                lambda: (fault_injector.fire("offload.d2h"),
+                         jax.device_get(list(off_grads)))[1],
+                retries=2, backoff_seconds=0.01,
+                retryable=TRANSFER_ERRORS,
+                description="offload grad d2h")
             np_grads = self._decode_grads(host)
             t1 = time.perf_counter()
             leaves = self._nvme_step(np_grads, lr, shardings)
@@ -239,8 +247,17 @@ class OffloadCoordinator:
         leaves = []
         for slot in range(n):
             t0 = time.perf_counter()
-            entry = [np.asarray(x) for x in
-                     off_grads[slot * per_leaf:(slot + 1) * per_leaf]]
+
+            def _d2h(slot=slot):
+                # injectable + retried transfer: a transient PJRT/host
+                # copy failure re-reads the still-live device buffers
+                fault_injector.fire("offload.d2h")
+                return [np.asarray(x) for x in
+                        off_grads[slot * per_leaf:(slot + 1) * per_leaf]]
+
+            entry = retry_io(_d2h, retries=2, backoff_seconds=0.01,
+                             retryable=TRANSFER_ERRORS,
+                             description="offload grad d2h")
             g = self._decode_entry(slot, entry)
             t1 = time.perf_counter()
             ha.step_arrays(ha.master[slot], g, ha.m[slot], ha.v[slot],
@@ -257,7 +274,32 @@ class OffloadCoordinator:
             t_h2d += t3 - t2
         ha.step_count = step_count
         t0 = time.perf_counter()
-        jax.block_until_ready(jax.tree_util.tree_leaves(leaves))
+        attempted = [False]
+
+        def _h2d_drain():
+            if attempted[0]:
+                # re-issue the uploads: the compute-dtype payload is a
+                # PURE function of the host master, so rebuilding it is
+                # safe — merely re-waiting on the poisoned arrays from
+                # the failed attempt would deterministically re-raise
+                leaves[:] = [self._device_payload(ha.master[s],
+                                                  shardings[s])
+                             for s in range(n)]
+            attempted[0] = True
+            fault_injector.fire("offload.h2d")
+            jax.block_until_ready(jax.tree_util.tree_leaves(leaves))
+
+        if self._delta_upload:
+            # delta payloads advance the device mirror (error feedback)
+            # as they are built — re-issuing them is NOT idempotent, so
+            # an h2d failure here is detected (typed) and propagates;
+            # recovery is the elastic layer's respawn + resume
+            fault_injector.fire("offload.h2d")
+            jax.block_until_ready(jax.tree_util.tree_leaves(leaves))
+        else:
+            retry_io(_h2d_drain, retries=2, backoff_seconds=0.01,
+                     retryable=TRANSFER_ERRORS,
+                     description="offload param h2d")
         t_h2d += time.perf_counter() - t0
         # legs overlap now: each bucket is the time the host THREAD
         # spent in that phase (waits included), so the sum still equals

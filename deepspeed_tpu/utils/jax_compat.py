@@ -1,0 +1,129 @@
+"""Version bridge for jax APIs that moved between releases.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to
+``jax.shard_map``, renaming ``check_rep`` -> ``check_vma`` and replacing
+``auto=`` (axes the partitioner may manage) with ``axis_names=`` (axes
+the body manages) on the way. Every in-repo caller uses the NEW surface;
+this wrapper translates for the installed jax.
+"""
+
+import inspect
+import re as _re
+
+import jax
+
+
+def _version_tuple(s):
+    out = []
+    for part in s.split(".")[:2]:
+        m = _re.match(r"\d+", part)
+        out.append(int(m.group()) if m else 0)
+    return tuple(out)
+
+
+# jaxlib 0.4.x ships an XLA that rejects PartitionId in partial-manual
+# shard_map regions (no pipeline schedule), SIGABRTs on the EP-serving
+# program, and has no CPU multiprocess runtime — version gates across
+# tests and the dryrun entry key off this ONE constant.
+OLD_XLA = _version_tuple(jax.__version__) < (0, 5)
+
+if not hasattr(jax.lax, "axis_size"):
+    # jax.lax.axis_size landed after 0.4; psum of a static 1 is the
+    # classic equivalent (constant-folded to a python int in-trace, so
+    # callers may still use it in range()/shape positions)
+    def _axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = _axis_size
+
+try:
+    # the TPU compiler-params dataclass was renamed TPUCompilerParams ->
+    # CompilerParams; kernels use the NEW name, alias it on old jax
+    from jax.experimental.pallas import tpu as _pltpu
+    if not hasattr(_pltpu, "CompilerParams") and \
+            hasattr(_pltpu, "TPUCompilerParams"):
+        _pltpu.CompilerParams = _pltpu.TPUCompilerParams
+except ImportError:  # pragma: no cover - pallas-free installs
+    pass
+
+try:  # jax >= 0.6: top-level export with the new kwarg names
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = set(inspect.signature(_shard_map).parameters)
+
+
+try:
+    from jaxlib.xla_extension import XlaRuntimeError as _XlaRuntimeError
+except ImportError:  # pragma: no cover - jaxlib layout changed
+    _XlaRuntimeError = None
+
+# Exception classes a transient runtime/transfer failure can surface
+# as: PJRT raises XlaRuntimeError (a RuntimeError, NOT an OSError), so
+# retry policies around device<->host copies must include it.
+TRANSFER_ERRORS = tuple(
+    c for c in (OSError, _XlaRuntimeError) if c is not None)
+
+
+def host_memory_kind() -> str:
+    """Preferred host memory space for parameter offload: pinned_host
+    where the backend exposes it (TPU; newer CPU jax), else the CPU
+    backend's unpinned_host — the offload seam is identical, only the
+    page-lock guarantee differs."""
+    try:
+        kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
+    except Exception:
+        return "pinned_host"
+    return "pinned_host" if "pinned_host" in kinds else "unpinned_host"
+
+
+def reset_compilation_cache():
+    """Older jax latches the persistent-cache singleton at the first
+    compile; a cache-dir config change AFTER that is silently ignored
+    until the cache is reset. Newer jax resets through a config hook,
+    making this a no-op."""
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:
+        pass
+
+
+def lowered_text_with_debug_info(lowered) -> str:
+    """``Lowered.as_text(debug_info=True)`` where available; on older
+    jax the same location table comes from printing the MLIR module
+    with debug info enabled (scope attribution — e.g. the per-module
+    FLOPs breakdown — needs the loc() entries either way)."""
+    try:
+        return lowered.as_text(debug_info=True)
+    except TypeError:
+        pass
+    try:
+        return lowered.compiler_ir().operation.get_asm(
+            enable_debug_info=True)
+    except Exception:
+        return lowered.as_text()
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None,
+              axis_names=None, **kwargs):
+    if check_vma is not None:
+        kwargs["check_vma" if "check_vma" in _PARAMS
+               else "check_rep"] = check_vma
+    if axis_names is not None:
+        if "axis_names" in _PARAMS:
+            kwargs["axis_names"] = axis_names
+        else:
+            # old API takes the complement: axes NOT managed by the
+            # body. Size-1 axes are claimed as manual too — semantically
+            # a no-op, but it empties `auto` on single-parallelism
+            # meshes, dodging old XLA's "PartitionId not supported for
+            # SPMD partitioning" on partial-manual regions.
+            shape = dict(zip(mesh.axis_names,
+                             getattr(mesh, "devices", mesh).shape))
+            kwargs["auto"] = frozenset(
+                a for a in mesh.axis_names
+                if a not in set(axis_names) and shape.get(a, 1) > 1)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

@@ -85,6 +85,9 @@ class TestMeshReshape:
         np.testing.assert_allclose(cont, saved["cont"], rtol=5e-3)
 
 
+from tests.conftest import SKIP_OLD_XLA_PIPE as _SPMD_PIPE
+
+
 class TestPipelineReshape:
     """pipe2 x data4 -> pipe4 x data2: the stacked block leaves are
     re-staged and training continues at loss parity."""
@@ -137,6 +140,7 @@ class TestPipelineReshape:
         }
         return PipelineEngine(mod, config=config)
 
+    @_SPMD_PIPE
     def test_pipe2_to_pipe4(self, eight_devices, tmp_path):
         eng = self._pipe_engine(pipe=2, data=4)
         rng = np.random.default_rng(SEED)

@@ -70,7 +70,7 @@ def test_barrier():
 
 
 def test_traced_usage_inside_shard_map():
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
     mesh = mesh_manager.mesh
 

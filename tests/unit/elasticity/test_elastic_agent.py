@@ -119,6 +119,42 @@ def test_agent_survives_injected_failure(tmp_path, via_cli):
     assert losses[3] < losses[1]     # post-resume continues the curve
 
 
+@pytest.mark.fault
+def test_restart_budget_backoff_and_terminal_exit(tmp_path,
+                                                  monkeypatch):
+    """A crash-looping worker exhausts max_restarts through
+    exponentially-backed-off (jittered) restarts, then the agent exits
+    with the DISTINCT terminal code — not the worker's rc."""
+    from deepspeed_tpu.elasticity import DSElasticAgent
+    from deepspeed_tpu.elasticity.elastic_agent import \
+        RESTART_BUDGET_EXHAUSTED
+
+    script = tmp_path / "always_fails.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    sleeps = []
+    monkeypatch.setattr("deepspeed_tpu.elasticity.elastic_agent"
+                        ".time.sleep", sleeps.append)
+    agent = DSElasticAgent(str(script), ckpt_dir=str(tmp_path / "c"),
+                           max_restarts=3, backoff_seconds=0.5,
+                           backoff_factor=2.0, max_backoff_seconds=1.5,
+                           backoff_jitter=0.0,
+                           device_probe=lambda: 1)
+    rc = agent.run()
+    assert rc == RESTART_BUDGET_EXHAUSTED and rc != 3
+    assert agent.restart_count == 3
+    # exponential ramp, capped: 0.5, 1.0, then clamped to 1.5
+    assert sleeps == [0.5, 1.0, 1.5]
+
+    # jitter spreads the fleet: delays stay within [base, base*(1+j)]
+    sleeps.clear()
+    agent = DSElasticAgent(str(script), ckpt_dir=str(tmp_path / "c"),
+                           max_restarts=2, backoff_seconds=1.0,
+                           backoff_factor=1.0, backoff_jitter=0.5,
+                           device_probe=lambda: 1)
+    assert agent.run() == RESTART_BUDGET_EXHAUSTED
+    assert all(1.0 <= s <= 1.5 for s in sleeps), sleeps
+
+
 def test_plan_recomputed_on_shrink(tmp_path):
     """On restart the agent re-probes devices and recomputes the
     (batch, chips) plan with the elasticity math."""

@@ -203,3 +203,88 @@ def test_shard_key_mismatch_rejected(rng):
 def test_empty_dir_rejected(tmp_path):
     with pytest.raises(FileNotFoundError, match="shards"):
         resolve_checkpoint_list(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# Corrupted engine checkpoints (resilience subsystem): every corruption
+# mode must load the previous good tag or raise a typed error — never
+# return garbage state.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def npz_ckpt_dir(tmp_path, monkeypatch):
+    """Two committed npz-format engine checkpoints (t1 then t2)."""
+    import time
+
+    import jax.numpy as jnp
+    import deepspeed_tpu.checkpoint.engine as ce
+    monkeypatch.setattr(ce, "_try_orbax", lambda: None)
+    template = {"w": jnp.arange(16.0), "b": jnp.full((4, 4), 3.0)}
+    ce.save_checkpoint(str(tmp_path), "t1", template,
+                       client_state={"global_steps": 1})
+    time.sleep(0.01)
+    ce.save_checkpoint(str(tmp_path), "t2", template,
+                       client_state={"global_steps": 2})
+    return tmp_path, template
+
+
+@pytest.mark.fault
+def test_truncated_shard_loads_previous_good_tag(npz_ckpt_dir):
+    from deepspeed_tpu.checkpoint.engine import load_checkpoint
+    d, template = npz_ckpt_dir
+    p = d / "t2" / "state" / "leaves.npz"
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 3)
+    state, cs = load_checkpoint(str(d), None, template)
+    assert cs["global_steps"] == 1
+    np.testing.assert_allclose(np.asarray(state["w"]), np.arange(16.0))
+
+
+@pytest.mark.fault
+def test_checksum_mismatch_loads_previous_good_tag(npz_ckpt_dir):
+    """Same-size bit flip: only the manifest checksum can catch it."""
+    from deepspeed_tpu.checkpoint.engine import load_checkpoint
+    d, template = npz_ckpt_dir
+    p = d / "t2" / "state" / "leaves.npz"
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.seek(size - 10)
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    state, cs = load_checkpoint(str(d), None, template)
+    assert cs["global_steps"] == 1
+
+
+@pytest.mark.fault
+def test_missing_manifest_legacy_load_still_works(npz_ckpt_dir):
+    """A manifest-less (pre-integrity) tag with intact shards loads;
+    with a broken shard it falls back instead of returning garbage."""
+    from deepspeed_tpu.checkpoint.engine import load_checkpoint
+    d, template = npz_ckpt_dir
+    os.unlink(d / "t2" / "state" / "manifest.json")
+    state, cs = load_checkpoint(str(d), None, template)
+    assert cs["global_steps"] == 2      # intact shards: loads fine
+    p = d / "t2" / "state" / "leaves.npz"
+    with open(p, "r+b") as f:
+        f.truncate(10)
+    state, cs = load_checkpoint(str(d), None, template)
+    assert cs["global_steps"] == 1      # broken shards: previous tag
+
+
+@pytest.mark.fault
+def test_stale_latest_on_deleted_tag_falls_back(npz_ckpt_dir):
+    import shutil
+
+    from deepspeed_tpu.checkpoint.engine import load_checkpoint
+    from deepspeed_tpu.resilience import CheckpointLoadError
+    d, template = npz_ckpt_dir
+    shutil.rmtree(d / "t2")
+    (d / "latest").write_text("t2")
+    state, cs = load_checkpoint(str(d), None, template)
+    assert cs["global_steps"] == 1
+    # with every tag gone, the failure is typed — not a KeyError/garbage
+    shutil.rmtree(d / "t1")
+    (d / "latest").write_text("t2")
+    with pytest.raises(CheckpointLoadError):
+        load_checkpoint(str(d), None, template)

@@ -31,6 +31,15 @@ def engine():
     return eng
 
 
+from deepspeed_tpu.utils.jax_compat import OLD_XLA
+
+_DEEP_LOCS = pytest.mark.skipif(
+    OLD_XLA,
+    reason="jaxlib 0.4.x collapses scan-body op locations to the body "
+           "callsite, so per-module FLOP attribution is unavailable")
+
+
+@_DEEP_LOCS
 def test_breakdown_attributes_blocks_and_params(engine, eight_devices):
     prof = engine.get_module_profile(depth=2)
     flops, params = prof["flops"], prof["params"]
@@ -51,6 +60,7 @@ def test_breakdown_attributes_blocks_and_params(engine, eight_devices):
     assert total > 0.3 * xla / len(jax.devices()) or xla == 0
 
 
+@_DEEP_LOCS
 def test_tree_format_and_detailed_print(engine, eight_devices):
     prof = FlopsProfiler(engine)
     prof.start_profile()

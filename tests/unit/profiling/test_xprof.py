@@ -60,6 +60,8 @@ def test_instrument_tags_lowered_ops():
     def projection(x, w):
         return x @ w
 
-    txt = jax.jit(projection).lower(
-        jnp.zeros((4, 8)), jnp.zeros((8, 8))).as_text(debug_info=True)
+    from deepspeed_tpu.utils.jax_compat import \
+        lowered_text_with_debug_info
+    txt = lowered_text_with_debug_info(jax.jit(projection).lower(
+        jnp.zeros((4, 8)), jnp.zeros((8, 8))))
     assert "projection" in txt

@@ -1,0 +1,14 @@
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _disarm_resilience():
+    """Fault specs and watchdog deadlines are process-global; every
+    test starts and ends disarmed so injections cannot leak."""
+    from deepspeed_tpu.resilience import (collective_watchdog,
+                                          fault_injector)
+    fault_injector.reset()
+    collective_watchdog.configure(None)
+    yield
+    fault_injector.reset()
+    collective_watchdog.configure(None)

@@ -1,0 +1,74 @@
+"""FaultInjector: spec grammar, deterministic firing, scoping."""
+
+import time
+
+import pytest
+
+from deepspeed_tpu.resilience import (FaultSpec, InjectedFault,
+                                      InjectedIOError, fault_injector)
+
+pytestmark = pytest.mark.fault
+
+
+def test_spec_grammar():
+    s = FaultSpec.parse("checkpoint.save:ioerror")
+    assert (s.site, s.kind, s.after, s.count) == \
+        ("checkpoint.save", "ioerror", 0, 1)
+    s = FaultSpec.parse("collective:hang@2~30")
+    assert (s.site, s.kind, s.after, s.arg) == \
+        ("collective", "hang", 2, 30.0)
+    s = FaultSpec.parse("data.fetch:error@1x3")
+    assert (s.site, s.kind, s.after, s.count) == \
+        ("data.fetch", "error", 1, 3)
+    s = FaultSpec.parse("data.fetch:ioerror@0xinf")
+    assert s.count == float("inf")
+    with pytest.raises(ValueError, match="kind"):
+        FaultSpec.parse("data.fetch:explode")
+    with pytest.raises(ValueError, match="fault spec"):
+        FaultSpec.parse("justasite")
+
+
+def test_fire_is_deterministic_by_ordinal():
+    with fault_injector.inject("data.fetch:ioerror@1x2"):
+        fault_injector.fire("data.fetch")          # call 0: clean
+        with pytest.raises(InjectedIOError):
+            fault_injector.fire("data.fetch")      # call 1: faults
+        with pytest.raises(InjectedIOError):
+            fault_injector.fire("data.fetch")      # call 2: faults
+        fault_injector.fire("data.fetch")          # call 3: clean again
+        assert fault_injector.fired == ["data.fetch:ioerror@1",
+                                        "data.fetch:ioerror@2"]
+    # scope exit disarms and clears counters
+    assert not fault_injector.enabled
+    fault_injector.fire("data.fetch")
+
+
+def test_sites_are_independent():
+    with fault_injector.inject("collective:error"):
+        fault_injector.fire("data.fetch")          # other site: clean
+        with pytest.raises(InjectedFault):
+            fault_injector.fire("collective")
+
+
+def test_injected_ioerror_is_oserror():
+    """Injected transient faults must flow through the same except
+    clauses real disk faults hit."""
+    assert issubclass(InjectedIOError, OSError)
+    assert issubclass(InjectedIOError, InjectedFault)
+
+
+def test_hang_kind_sleeps():
+    with fault_injector.inject("collective:hang~0.2"):
+        t0 = time.monotonic()
+        fault_injector.fire("collective")
+        assert time.monotonic() - t0 >= 0.2
+
+
+def test_env_spec_arms_on_construction(monkeypatch):
+    from deepspeed_tpu.resilience.fault_injector import (ENV_SPEC,
+                                                         FaultInjector)
+    monkeypatch.setenv(ENV_SPEC, "checkpoint.load:ioerror")
+    inj = FaultInjector()
+    assert inj.enabled
+    with pytest.raises(InjectedIOError):
+        inj.fire("checkpoint.load")

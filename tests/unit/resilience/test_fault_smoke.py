@@ -1,0 +1,119 @@
+"""Fast CPU fault-injection smoke: one injected failure per site
+class — checkpoint save, checkpoint load, collective, host-offload
+transfer (d2h and h2d), data fetch — each detected and recovered
+within its configured retry/rollback budget. Runs inside tier-1.
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.resilience import (InjectedFault, fault_injector)
+
+pytestmark = pytest.mark.fault
+
+
+def test_checkpoint_save_site_recovers_via_retry(tmp_path):
+    """Injected transient write fault: the bounded retry absorbs it and
+    the committed tag verifies + loads."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.checkpoint.engine import (load_checkpoint,
+                                                 save_checkpoint)
+    state = {"w": jnp.arange(6.0)}
+    with fault_injector.inject("checkpoint.save:ioerror"):
+        save_checkpoint(str(tmp_path), "t", state)
+        assert fault_injector.fired == ["checkpoint.save:ioerror@0"]
+    loaded, _ = load_checkpoint(str(tmp_path), None, state)
+    np.testing.assert_allclose(np.asarray(loaded["w"]), np.arange(6.0))
+
+
+def test_checkpoint_load_site_recovers_via_retry(tmp_path):
+    import jax.numpy as jnp
+    from deepspeed_tpu.checkpoint.engine import (load_checkpoint,
+                                                 save_checkpoint)
+    state = {"w": jnp.arange(6.0)}
+    save_checkpoint(str(tmp_path), "t", state)
+    with fault_injector.inject("checkpoint.load:ioerror"):
+        loaded, _ = load_checkpoint(str(tmp_path), None, state)
+        assert fault_injector.fired == ["checkpoint.load:ioerror@0"]
+    np.testing.assert_allclose(np.asarray(loaded["w"]), np.arange(6.0))
+
+
+def test_collective_site_fault_is_detected_typed(eight_devices):
+    """Collectives have NO in-place retry (replaying a collective is
+    not generally safe): the contract is typed detection, recovery is
+    the caller's rollback/respawn path."""
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
+    mesh_manager.init(MeshConfig(data=-1))
+    x = np.ones(8, dtype=np.float32)
+    with fault_injector.inject("collective:error"):
+        with pytest.raises(InjectedFault):
+            dist.all_reduce(x, group="data")
+    # the facade is healthy again once the fault passes
+    out = dist.all_reduce(x, group="data")
+    assert float(np.asarray(out)[0]) == 8.0
+
+
+def test_data_fetch_site_recovers_via_retry(eight_devices):
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+    data = [{"x": np.full((4,), i, np.float32)} for i in range(32)]
+    loader = DeepSpeedDataLoader(data, batch_size=8)
+    with fault_injector.inject("data.fetch:ioerror@1"):
+        batches = list(loader)
+        assert fault_injector.fired == ["data.fetch:ioerror@1"]
+    assert len(batches) == 4
+    np.testing.assert_allclose(batches[1]["x"][:, 0],
+                               np.arange(8, 16, dtype=np.float32))
+
+
+@pytest.mark.parametrize("site", ["offload.d2h", "offload.h2d"])
+def test_offload_transfer_site_recovers_via_retry(
+        site, rng, eight_devices):
+    """One train step with ZeRO-Offload while the named transfer leg
+    faults once: the bounded retry recovers and the host Adam update
+    still lands."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {
+            "stage": 2,
+            "offload_optimizer": {"device": "cpu", "ratio": 1.0}},
+        "steps_per_print": 0,
+    })
+    ids = rng.integers(0, 256, size=(8, 16), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    l0 = float(engine.train_batch(batch=batch))     # compiles cleanly
+    with fault_injector.inject(f"{site}:ioerror"):
+        l1 = float(engine.train_batch(batch=batch))
+        assert fault_injector.fired == [f"{site}:ioerror@0"]
+    assert np.isfinite(l1)
+    # the step under injection still optimized (host update applied)
+    l2 = float(engine.train_batch(batch=batch))
+    assert l2 < l0
+
+
+def test_engine_config_arms_injection(rng, eight_devices):
+    """The config block drives injection end to end: an armed
+    data.fetch fault fires during engine-driven batch fetch and the
+    loader's retry budget recovers it."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    data = [{"input_ids": np.zeros(16, np.int32),
+             "labels": np.zeros(16, np.int32)} for _ in range(16)]
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    try:
+        engine, _, _, loader = deepspeed_tpu.initialize(
+            model=model, training_data=data, config={
+                "train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "steps_per_print": 0,
+                "resilience": {"fault_injection": "data.fetch:ioerror"},
+            })
+        assert fault_injector.enabled
+        loss = engine.train_batch()
+        assert np.isfinite(float(loss))
+        assert fault_injector.fired == ["data.fetch:ioerror@0"]
+    finally:
+        fault_injector.reset()

@@ -1,0 +1,329 @@
+"""Checkpoint integrity: manifest verification, atomic shard writes,
+bounded retry, and the previous-good-tag fallback — every corruption
+mode must end in the previous good state or a typed error, never
+garbage."""
+
+import json
+import os
+import pickle
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.checkpoint.engine import (_npz_load, _npz_save,
+                                             load_checkpoint,
+                                             save_checkpoint)
+from deepspeed_tpu.resilience import (CheckpointCorruptionError,
+                                      CheckpointLoadError, retry_io,
+                                      verify_manifest, write_manifest)
+
+pytestmark = pytest.mark.fault
+
+
+def _state():
+    return {"w": jnp.arange(8.0), "b": jnp.ones((3, 2)) * 2.0}
+
+
+def _save_two_tags(d):
+    save_checkpoint(str(d), "t1", _state(), client_state={"global_steps": 1})
+    time.sleep(0.01)  # distinct state mtimes order the fallback scan
+    save_checkpoint(str(d), "t2", _state(), client_state={"global_steps": 2})
+
+
+def _corrupt_largest_payload(state_dir, how="truncate"):
+    man = json.load(open(os.path.join(state_dir, "manifest.json")))
+    rel = max(man["files"], key=lambda r: man["files"][r]["size"])
+    p = os.path.join(state_dir, rel)
+    if how == "truncate":
+        with open(p, "r+b") as f:
+            f.truncate(max(0, os.path.getsize(p) - 7))
+    else:  # same-size bit flip: only the checksum can catch it
+        with open(p, "r+b") as f:
+            f.seek(os.path.getsize(p) // 2)
+            byte = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([byte[0] ^ 0xFF]))
+    return rel
+
+
+def test_manifest_roundtrip(tmp_path):
+    sd = tmp_path / "state"
+    sd.mkdir()
+    (sd / "a.bin").write_bytes(b"payload-a")
+    (sd / "b.bin").write_bytes(b"payload-b" * 100)
+    man = write_manifest(str(sd))
+    assert set(man["files"]) == {"a.bin", "b.bin"}
+    assert verify_manifest(str(sd)) is not None
+
+
+@pytest.mark.parametrize("how", ["truncate", "bitflip"])
+def test_manifest_detects_corruption(tmp_path, how):
+    save_checkpoint(str(tmp_path), "t", _state())
+    sd = os.path.join(str(tmp_path), "t", "state")
+    _corrupt_largest_payload(sd, how)
+    with pytest.raises(CheckpointCorruptionError,
+                       match="mismatch|size"):
+        verify_manifest(sd)
+
+
+def test_manifest_detects_missing_file(tmp_path):
+    save_checkpoint(str(tmp_path), "t", _state())
+    sd = os.path.join(str(tmp_path), "t", "state")
+    man = json.load(open(os.path.join(sd, "manifest.json")))
+    os.unlink(os.path.join(sd, next(iter(man["files"]))))
+    with pytest.raises(CheckpointCorruptionError, match="missing"):
+        verify_manifest(sd)
+
+
+def test_missing_manifest_is_legacy_not_corrupt(tmp_path):
+    """Pre-integrity checkpoints (no manifest) still load; strict mode
+    upgrades the absence to corruption."""
+    save_checkpoint(str(tmp_path), "t", _state())
+    sd = os.path.join(str(tmp_path), "t", "state")
+    os.unlink(os.path.join(sd, "manifest.json"))
+    assert verify_manifest(sd) is None
+    with pytest.raises(CheckpointCorruptionError, match="manifest"):
+        verify_manifest(sd, strict=True)
+    state, _ = load_checkpoint(str(tmp_path), "t", _state())
+    np.testing.assert_allclose(np.asarray(state["w"]),
+                               np.arange(8.0))
+
+
+def test_corrupt_tag_falls_back_to_previous_good(tmp_path):
+    _save_two_tags(tmp_path)
+    _corrupt_largest_payload(
+        os.path.join(str(tmp_path), "t2", "state"))
+    state, cs = load_checkpoint(str(tmp_path), None, _state())
+    assert cs["global_steps"] == 1          # t1, the previous good tag
+    np.testing.assert_allclose(np.asarray(state["w"]), np.arange(8.0))
+    # latest repointed at what was actually loaded
+    assert (tmp_path / "latest").read_text().strip() == "t1"
+
+
+def test_stale_latest_falls_back(tmp_path):
+    """``latest`` naming a deleted tag must recover through the scan,
+    not crash or return garbage."""
+    _save_two_tags(tmp_path)
+    import shutil
+    shutil.rmtree(tmp_path / "t2")
+    (tmp_path / "latest").write_text("t2")
+    state, cs = load_checkpoint(str(tmp_path), None, _state())
+    assert cs["global_steps"] == 1
+
+
+def test_explicit_tag_never_silently_substitutes(tmp_path):
+    """An explicitly requested tag that is corrupt must RAISE — the
+    caller asked for specific weights; handing back a different tag's
+    would be worse than failing."""
+    _save_two_tags(tmp_path)
+    _corrupt_largest_payload(
+        os.path.join(str(tmp_path), "t2", "state"))
+    with pytest.raises(CheckpointLoadError):
+        load_checkpoint(str(tmp_path), "t2", _state())
+    # latest-resolved load still falls back
+    state, cs = load_checkpoint(str(tmp_path), None, _state())
+    assert cs["global_steps"] == 1
+
+
+def test_persistent_transient_io_error_raises_not_falls_back(tmp_path):
+    """An FS brownout that outlives the retry budget is NOT corruption:
+    the same-tag retry runs, then the OSError propagates — falling
+    back (and repointing ``latest``) would permanently discard progress
+    from an intact checkpoint."""
+    from deepspeed_tpu.resilience import fault_injector
+    _save_two_tags(tmp_path)
+    with fault_injector.inject("checkpoint.load:ioerror@0xinf"):
+        with pytest.raises(OSError):
+            load_checkpoint(str(tmp_path), None, _state(),
+                            io_retries=1)
+    # latest still names the newest tag — nothing was repointed
+    assert (tmp_path / "latest").read_text().strip() == "t2"
+
+
+def test_no_good_tag_raises_typed_error(tmp_path):
+    _save_two_tags(tmp_path)
+    for t in ("t1", "t2"):
+        _corrupt_largest_payload(
+            os.path.join(str(tmp_path), t, "state"))
+    with pytest.raises(CheckpointLoadError, match="no loadable"):
+        load_checkpoint(str(tmp_path), None, _state())
+
+
+def test_npz_shard_writes_are_atomic(tmp_path, monkeypatch):
+    """A writer that dies mid-payload must leave either the previous
+    complete shard or no file — never truncated bytes under the real
+    name (satellite: _npz_save through tmp+fsync+rename)."""
+    sd = str(tmp_path / "state")
+    state = _state()
+    _npz_save(sd, state)
+    good = open(os.path.join(sd, "leaves.npz"), "rb").read()
+
+    def dying_savez(f, **arrays):
+        f.write(good[: len(good) // 2])
+        raise OSError("disk died mid-write")
+
+    monkeypatch.setattr(np, "savez", dying_savez)
+    with pytest.raises(OSError, match="disk died"):
+        _npz_save(sd, state)
+    # the previous complete shard survived byte-for-byte; no tmp litter
+    assert open(os.path.join(sd, "leaves.npz"), "rb").read() == good
+    assert not [n for n in os.listdir(sd) if ".tmp." in n]
+    monkeypatch.undo()
+    loaded = _npz_load(sd, state)
+    np.testing.assert_allclose(np.asarray(loaded["w"]), np.arange(8.0))
+
+
+def test_truncated_npz_without_manifest_still_falls_back(tmp_path,
+                                                         monkeypatch):
+    """Defense in depth: even with the manifest gone (legacy dir), a
+    truncated shard must fail the tag — the deserializer error routes
+    to the fallback scan, not to garbage state."""
+    import deepspeed_tpu.checkpoint.engine as ce
+    monkeypatch.setattr(ce, "_try_orbax", lambda: None)  # force npz
+    _save_two_tags(tmp_path)
+    sd = os.path.join(str(tmp_path), "t2", "state")
+    os.unlink(os.path.join(sd, "manifest.json"))
+    p = os.path.join(sd, "leaves.npz")
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+    state, cs = load_checkpoint(str(tmp_path), None, _state())
+    assert cs["global_steps"] == 1
+
+
+KILL_WORKER = """
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import deepspeed_tpu.checkpoint.engine as ce
+ce._try_orbax = lambda: None          # force the npz shard path
+d = sys.argv[1]
+state = {"w": np.arange(40000, dtype=np.float32),
+         "b": np.ones((400, 400), dtype=np.float32)}
+i = 0
+while True:
+    ce.save_checkpoint(d, f"t{i}", state,
+                       client_state={"global_steps": i})
+    i += 1
+"""
+
+
+def test_kill_between_shard_writes_never_leaves_corrupt_tag(tmp_path):
+    """SIGKILL an npz checkpoint writer mid-loop: whatever instant the
+    kill lands (between payload writes, before the manifest, before
+    ``latest``), the tag named by ``latest`` must verify and load."""
+    import signal
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    script = tmp_path / "worker.py"
+    script.write_text(KILL_WORKER)
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen([sys.executable, str(script), str(ckpt)],
+                            env=env)
+    try:
+        deadline = time.monotonic() + 120
+        latest = ckpt / "latest"
+        # let at least one commit land, then kill mid-flight
+        while time.monotonic() < deadline and not latest.exists():
+            time.sleep(0.02)
+        assert latest.exists(), "worker never committed a checkpoint"
+        time.sleep(0.15)
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+
+    tag = latest.read_text().strip()
+    state_dir = os.path.join(str(ckpt), tag, "state")
+    # the committed tag's payload verifies bit-for-bit...
+    assert verify_manifest(state_dir) is not None
+    # ...and no half-written file ever sits under a real shard name
+    assert not [n for n in os.listdir(state_dir) if ".tmp." in n]
+    template = {"w": np.arange(40000, dtype=np.float32),
+                "b": np.ones((400, 400), dtype=np.float32)}
+    state, cs = load_checkpoint(str(ckpt), None, template)
+    np.testing.assert_allclose(np.asarray(state["w"]), template["w"])
+    assert cs["global_steps"] == int(tag[1:])
+
+
+def test_offload_host_state_follows_fallback_tag(eight_devices,
+                                                 tmp_path):
+    """When the integrity fallback picks an older tag, the ZeRO-Offload
+    host optimizer state must load from that SAME tag — never mix one
+    tag's model state with another's Adam moments."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    def build():
+        from deepspeed_tpu.parallel.mesh import mesh_manager
+        mesh_manager.reset()
+        model = GPT2LMHeadModel(GPT2Config.tiny())
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {
+                "stage": 1,
+                "offload_optimizer": {"device": "cpu", "ratio": 1.0}},
+            "steps_per_print": 0})
+        return engine
+
+    engine = build()
+    ids = np.random.default_rng(0).integers(
+        0, 256, size=(engine.train_batch_size(), 16), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path), tag="good")
+    good_masters = [a.copy() for a in engine._offload.host_adam.master]
+    engine.train_batch(batch=batch)
+    time.sleep(0.01)
+    engine.save_checkpoint(str(tmp_path), tag="bad")
+
+    _corrupt_largest_payload(
+        os.path.join(str(tmp_path), "bad", "state"))
+    engine2 = build()
+    engine2.init_params(batch)
+    engine2.load_checkpoint(str(tmp_path))
+    assert engine2.global_steps == 1          # fell back to "good"
+    # the host Adam masters came from "good" too, not from "bad"
+    for a, b in zip(good_masters, engine2._offload.host_adam.master):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_retry_io_bounded_backoff():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    t0 = time.monotonic()
+    assert retry_io(flaky, retries=3, backoff_seconds=0.01,
+                    max_backoff_seconds=0.05) == "ok"
+    assert len(calls) == 3
+    assert time.monotonic() - t0 < 1.0
+
+    calls.clear()
+    with pytest.raises(OSError):
+        retry_io(flaky, retries=1, backoff_seconds=0.001)
+    assert len(calls) == 2          # initial attempt + 1 retry
+
+    # corruption is not retryable by default
+    def corrupt():
+        calls.append(1)
+        raise CheckpointCorruptionError("bad checksum")
+
+    calls.clear()
+    with pytest.raises(CheckpointCorruptionError):
+        retry_io(corrupt, retries=5, backoff_seconds=0.001)
+    assert len(calls) == 1

@@ -1,0 +1,174 @@
+"""Train-loop sentinel: NaN/spike detection state machine, and the
+engine-level auto-rollback — a poisoned state must be restored from the
+last verified checkpoint within the configured budget."""
+
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.resilience import TrainingDivergenceError
+from deepspeed_tpu.resilience.sentinel import (OK, ROLLBACK, SKIP,
+                                               TrainSentinel)
+
+pytestmark = pytest.mark.fault
+
+
+def test_nan_budget_escalates_to_rollback():
+    s = TrainSentinel(failure_budget=3)
+    assert s.observe(1.0) == OK
+    assert s.observe(float("nan")) == SKIP
+    assert s.observe(float("inf")) == SKIP
+    assert s.observe(float("nan")) == ROLLBACK
+    s.note_rollback()
+    assert s.rollbacks == 1
+    assert s.observe(1.0) == OK           # re-armed, counters fresh
+    assert s.consecutive_failures == 0
+
+
+def test_healthy_step_resets_consecutive_count():
+    s = TrainSentinel(failure_budget=2)
+    assert s.observe(float("nan")) == SKIP
+    assert s.observe(0.9) == OK
+    assert s.observe(float("nan")) == SKIP    # count restarted
+    assert s.observe(float("nan")) == ROLLBACK
+
+
+def test_spike_detection_arms_after_warmup():
+    s = TrainSentinel(loss_spike_factor=5.0, window=4,
+                      failure_budget=1)
+    # warm-up: even a big jump is tolerated before `window` good steps
+    assert s.observe(100.0) == OK
+    for _ in range(4):
+        assert s.observe(1.0) == OK
+    assert s.observe(2.0) == OK               # 2x: not a spike
+    assert s.observe(1000.0) == ROLLBACK      # >5x EMA after warm-up
+
+
+def test_overflow_graced_by_default():
+    """Scaler warm-up legitimately overflows several steps in a row
+    (the in-step rollback already handles it): by default that never
+    escalates, and the garbage overflow-step loss never taints the
+    EMA."""
+    s = TrainSentinel(failure_budget=2, loss_spike_factor=5.0,
+                      window=1)
+    for _ in range(10):
+        assert s.observe(float("inf"), overflow=True) == SKIP
+    assert s.consecutive_failures == 0
+    assert s.ema is None
+
+
+def test_overflow_counts_when_opted_in():
+    s = TrainSentinel(failure_budget=2, count_overflow=True)
+    assert s.observe(1.0, overflow=True) == SKIP
+    assert s.observe(1.0, overflow=True) == ROLLBACK
+
+
+def test_spike_detection_off_by_default():
+    s = TrainSentinel(failure_budget=1, window=1)
+    s.observe(1.0)
+    s.observe(1.0)
+    assert s.observe(1e9) == OK               # factor 0 = disabled
+
+
+def _nan_poison(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, jnp.nan)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+def test_engine_auto_rollback_restores_verified_checkpoint(
+        rng, eight_devices, tmp_path):
+    """End to end: train, checkpoint, poison the state to NaN; the
+    sentinel skips through its budget then restores the checkpoint and
+    training resumes with finite losses from the saved step."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    ckpt = str(tmp_path / "ckpt")
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 0,
+        "resilience": {"sentinel": {
+            "enabled": True, "failure_budget": 2, "max_rollbacks": 1,
+            "ckpt_dir": ckpt}},
+    })
+    ids = rng.integers(0, 256, size=(8, 16), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    for _ in range(2):
+        engine.train_batch(batch=batch)
+    engine.save_checkpoint(ckpt)
+    assert engine.global_steps == 2
+
+    # poison: every float leaf of the master params becomes NaN — the
+    # next steps produce NaN losses no skip can fix
+    engine.state = engine.state._replace(
+        master_params=_nan_poison(engine.state.master_params))
+
+    l1 = float(engine.train_batch(batch=batch))   # failure 1: skip
+    assert math.isnan(l1)
+    assert engine.skipped_steps == 1
+    assert engine.global_steps == 2               # schedules frozen
+    engine.train_batch(batch=batch)               # failure 2: rollback
+    assert engine._sentinel.rollbacks == 1
+    assert engine.global_steps == 2               # restored step count
+
+    # recovered: finite loss, steps advance again
+    l = float(engine.train_batch(batch=batch))
+    assert math.isfinite(l)
+    assert engine.global_steps == 3
+
+
+def test_engine_rollback_budget_escalates(rng, eight_devices, tmp_path):
+    """Past max_rollbacks the engine raises the typed divergence error
+    (the elastic agent layer handles it as a worker failure)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    ckpt = str(tmp_path / "ckpt")
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        "resilience": {"sentinel": {
+            "enabled": True, "failure_budget": 1, "max_rollbacks": 0,
+            "ckpt_dir": ckpt}},
+    })
+    ids = rng.integers(0, 256, size=(8, 16), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    engine.train_batch(batch=batch)
+    engine.save_checkpoint(ckpt)
+    engine.state = engine.state._replace(
+        master_params=_nan_poison(engine.state.master_params))
+    with pytest.raises(TrainingDivergenceError, match="diverged"):
+        engine.train_batch(batch=batch)
+
+
+def test_engine_rollback_without_checkpoint_is_typed(
+        rng, eight_devices, tmp_path):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        "resilience": {"sentinel": {
+            "enabled": True, "failure_budget": 1,
+            "ckpt_dir": str(tmp_path / "empty")}},
+    })
+    ids = rng.integers(0, 256, size=(8, 16), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    engine.train_batch(batch=batch)
+    engine.state = engine.state._replace(
+        master_params=_nan_poison(engine.state.master_params))
+    with pytest.raises(TrainingDivergenceError, match="no committed"):
+        engine.train_batch(batch=batch)

@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from deepspeed_tpu.utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 import deepspeed_tpu
@@ -54,6 +54,10 @@ def _pipeline_module(n_blocks=4, num_stages=4, **kw):
                           **kw)
 
 
+from tests.conftest import SKIP_OLD_XLA_PIPE as _SPMD_PIPE
+
+
+@_SPMD_PIPE
 def test_gpipe_spmd_matches_sequential(eight_devices, rng):
     """The raw schedule: y = f_3(f_2(f_1(f_0(x)))) per microbatch."""
     mesh = mesh_manager.init(MeshConfig(pipe=4, data=2),
@@ -84,6 +88,7 @@ def test_gpipe_spmd_matches_sequential(eight_devices, rng):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
 
 
+@_SPMD_PIPE
 def test_pipeline_engine_loss_parity(eight_devices, rng):
     """Pipelined eval loss == sequential (unpipelined) computation."""
     pm = _pipeline_module(n_blocks=4, num_stages=4)
@@ -111,6 +116,7 @@ def test_pipeline_engine_loss_parity(eight_devices, rng):
     np.testing.assert_allclose(pipe_loss, ref_loss, rtol=1e-4)
 
 
+@_SPMD_PIPE
 def test_pipeline_training_converges(eight_devices, rng):
     pm = _pipeline_module(n_blocks=4, num_stages=4)
     config = {"train_micro_batch_size_per_gpu": 2,
@@ -136,6 +142,7 @@ def test_pipeline_module_partitioning():
     assert pm_uniform.parts == [0, 2, 4, 6, 8]
 
 
+@_SPMD_PIPE
 def test_indivisible_blocks_supported(eight_devices, rng):
     """3 blocks over 4 stages: non-uniform masked execution (one stage
     passes activations through) still matches the sequential model."""
@@ -163,6 +170,7 @@ def test_indivisible_blocks_supported(eight_devices, rng):
                                rtol=1e-4)
 
 
+@_SPMD_PIPE
 def test_pipeline_inference_output_shape(eight_devices, rng):
     """forward (no labels) returns [Btot, ...] logits, not microbatched."""
     pm = _pipeline_module(n_blocks=4, num_stages=4)
@@ -193,6 +201,7 @@ def _tied_head_fwd(module, variables, h):
     return h @ variables["params"]["embedding"].T
 
 
+@_SPMD_PIPE
 def test_tied_layer_spec_shares_params(eight_devices, rng):
     from deepspeed_tpu.runtime.pipe import TiedLayerSpec
     specs = ([TiedLayerSpec("embed", TiedEmbed)] +
@@ -217,6 +226,7 @@ def test_tied_layer_spec_shares_params(eight_devices, rng):
     assert engine.micro_steps == 4         # counts pipeline microbatches
 
 
+@_SPMD_PIPE
 def test_non_uniform_weighted_parts(eight_devices, rng):
     """Explicit layer_weights produce non-uniform stages (reference:
     pipe/module.py:387 param-count balancing) that train with loss
@@ -256,6 +266,7 @@ def test_non_uniform_weighted_parts(eight_devices, rng):
     assert np.isfinite(loss)
 
 
+@_SPMD_PIPE
 def test_pipeline_remat_bounds_saved_activations(eight_devices, rng):
     """Memory-profile evidence for the GPIPE schedule: with remat on,
     the backward saves only the per-tick carry chain instead of every

@@ -40,6 +40,10 @@ def _train(schedule, steps=6, rng_seed=0, stages=4, gas=4,
     return engine, losses
 
 
+from tests.conftest import SKIP_OLD_XLA_PIPE as _SPMD_PIPE
+
+
+@_SPMD_PIPE
 def test_1f1b_matches_gpipe_trajectory(eight_devices):
     """Same init/seed/batch: the two schedules are the same math in a
     different execution order — loss curves agree to numeric noise."""
@@ -49,6 +53,7 @@ def test_1f1b_matches_gpipe_trajectory(eight_devices):
     assert got[-1] < got[0]
 
 
+@_SPMD_PIPE
 def test_1f1b_gradients_match_gpipe(eight_devices):
     """One-step gradient comparison, leaf by leaf."""
     e1, _ = _train("gpipe", steps=1)
@@ -61,6 +66,7 @@ def test_1f1b_gradients_match_gpipe(eight_devices):
         np.testing.assert_allclose(a, b, rtol=5e-4, atol=5e-4)
 
 
+@_SPMD_PIPE
 def test_1f1b_nonuniform_and_indivisible_stages(eight_devices):
     """3 blocks over 4 stages: idle slots + the pre/post gating still
     line up with the interleaved backward."""
@@ -68,6 +74,7 @@ def test_1f1b_nonuniform_and_indivisible_stages(eight_devices):
     assert losses[-1] < losses[0], losses
 
 
+@_SPMD_PIPE
 def test_1f1b_deep_microbatches_converge(eight_devices):
     """M >> P exercises the steady 1F1B phase (every tick does one F
     and one B)."""
@@ -75,6 +82,7 @@ def test_1f1b_deep_microbatches_converge(eight_devices):
     assert losses[-1] < losses[0], losses
 
 
+@_SPMD_PIPE
 def test_1f1b_tied_embedding_head(eight_devices):
     """TiedLayerSpec: embed (stage 0) and head (last stage) grads must
     MEET in the pipe-axis psum — the tied-weight allreduce. Beyond the
@@ -103,6 +111,7 @@ def test_1f1b_tied_embedding_head(eight_devices):
     assert "tied_emb" in params
 
 
+@_SPMD_PIPE
 def test_1f1b_composes_with_fp16_loss_scaling(eight_devices):
     """fp16 under the 1F1B schedule: the engine's loss-scale rides the
     custom_vjp cotangent (grads are linear in it), overflow machinery
@@ -119,6 +128,7 @@ def test_1f1b_composes_with_fp16_loss_scaling(eight_devices):
     assert all(np.isfinite(losses))
 
 
+@_SPMD_PIPE
 def test_1f1b_saved_activations_independent_of_microbatches(
         eight_devices):
     """THE 1F1B memory claim: the residuals the outer autodiff stores

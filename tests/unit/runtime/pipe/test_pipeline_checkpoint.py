@@ -57,6 +57,10 @@ def _engine(seed):
     return engine
 
 
+from tests.conftest import SKIP_OLD_XLA_PIPE as _SPMD_PIPE
+
+
+@_SPMD_PIPE
 def test_pipeline_checkpoint_resume_continues_loss_curve(
         tmp_path, rng, eight_devices):
     engine = _engine(seed=1)
@@ -78,6 +82,7 @@ def test_pipeline_checkpoint_resume_continues_loss_curve(
     np.testing.assert_allclose(got, expect, rtol=1e-4)
 
 
+@_SPMD_PIPE
 def test_pipeline_checkpoint_latest_pointer(tmp_path, rng, eight_devices):
     engine = _engine(seed=2)
     gbs = engine.train_batch_size()

@@ -5,6 +5,8 @@ import os
 
 import jax
 import numpy as np
+
+from deepspeed_tpu.utils.jax_compat import host_memory_kind
 import pytest
 
 import deepspeed_tpu
@@ -157,12 +159,12 @@ class TestParamOffloadHost:
                  for leaf in jax.tree_util.tree_leaves(
                      engine.state.master_params)
                  if hasattr(leaf, "sharding")}
-        assert kinds == {"pinned_host"}, kinds
+        assert kinds == {host_memory_kind()}, kinds
         kinds = {leaf.sharding.memory_kind
                  for leaf in jax.tree_util.tree_leaves(
                      engine.state.opt_state)
                  if hasattr(leaf, "sharding")}
-        assert kinds == {"pinned_host"}, kinds
+        assert kinds == {host_memory_kind()}, kinds
 
     def test_loss_parity_vs_device_resident(self):
         import deepspeed_tpu
@@ -209,7 +211,7 @@ class TestParamOffloadHost:
         kinds = {x.sharding.memory_kind
                  for x in jax.tree_util.tree_leaves(
                      engine.state.master_params)}
-        assert kinds == {"pinned_host"}
+        assert kinds == {host_memory_kind()}
 
 
 class TestCompressedWire:

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Repo lint: fail on bare ``except:`` clauses in deepspeed_tpu/.
+
+A bare except swallows KeyboardInterrupt/SystemExit and — worse for the
+resilience subsystem — the typed faults (CollectiveTimeout,
+CheckpointCorruptionError, ...) that recovery layers key on. Every
+handler must name what it can actually recover from.
+
+Usage: python tools/lint_bare_except.py [root_dir]
+Exit code 0 = clean, 1 = violations found.
+"""
+
+import ast
+import os
+import sys
+
+
+def find_bare_excepts(path):
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    hits = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            hits.append((node.lineno, "bare 'except:' clause"))
+    return hits
+
+
+def main(argv):
+    root = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deepspeed_tpu")
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, name)
+            for lineno, msg in find_bare_excepts(full):
+                violations.append(f"{full}:{lineno}: {msg}")
+    if violations:
+        print("\n".join(violations))
+        print(f"\n{len(violations)} bare except clause(s) found — name "
+              "the exceptions the handler can recover from "
+              "(see tools/lint_bare_except.py)")
+        return 1
+    print("lint_bare_except: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
