@@ -1,4 +1,5 @@
 from .comm import (ReduceOp, all_gather, all_gather_into_tensor, all_reduce,  # noqa: F401
+                   all_reduce_coalesced,
                    all_to_all, all_to_all_single, axis_index, barrier,
                    broadcast, broadcast_object_list, comms_logger, configure,
                    get_local_rank, get_rank, get_world_size,

@@ -294,6 +294,87 @@ def inference_all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: Group = Non
     return all_reduce(tensor, op, group)
 
 
+def all_reduce_coalesced(tensors, op: ReduceOp = ReduceOp.SUM,
+                         group: Group = None,
+                         bucket_bytes: int = 64 << 20):
+    """Gradient-coalesced allreduce: fuse many small tensors into
+    fixed-size buckets through the shared bucketizer
+    (runtime/transfer/bucketizer.py) so the EAGER path pays
+    ``ceil(total_bytes/bucket)`` dispatches instead of one per tensor
+    (reference: comm/coalesced_collectives.py + the stage-1/2 ipg
+    bucket allreduce). Elementwise ops only (SUM/AVG/MIN/MAX/PRODUCT),
+    and elementwise-identical to per-tensor ``all_reduce``: each tensor
+    is viewed as its [world, n/world] shard rows, same-dtype rows are
+    concatenated column-wise, and each fused bucket rides ONE
+    collective. Returns the reduced tensors in input order.
+
+    Traced context: one fused collective per dtype (dispatch overhead
+    is an eager problem; under jit XLA schedules the wire itself)."""
+    tensors = list(tensors)
+    if not tensors:
+        return []
+    names = _axis(group)
+    if any(_in_trace(t) for t in tensors):
+        out = [None] * len(tensors)
+        groups = {}
+        for i, t in enumerate(tensors):
+            groups.setdefault(jnp.asarray(t).dtype, []).append(i)
+        for idxs in groups.values():
+            flat = jnp.concatenate(
+                [jnp.asarray(tensors[i]).reshape(-1) for i in idxs])
+            red = _all_reduce_traced(flat, op, names)
+            o = 0
+            for i in idxs:
+                # np.prod(()) == 1, so scalars slice one element and
+                # zero-size tensors slice none (offsets stay aligned)
+                sz = int(np.prod(np.shape(tensors[i])))
+                out[i] = red[o:o + sz].reshape(np.shape(tensors[i]))
+                o += sz
+        return out
+
+    from ..runtime.transfer.bucketizer import BucketPlan
+    world = get_world_size(group)
+    arrs = [np.asarray(t) for t in tensors]
+    for i, a in enumerate(arrs):
+        if a.ndim == 0 or a.shape[0] % world:
+            raise ValueError(
+                f"all_reduce_coalesced: tensor {i} has leading dim "
+                f"{a.shape[0] if a.ndim else '()'} not divisible by "
+                f"group size {world} (eager collectives shard the "
+                "leading dim); pad it like all_reduce requires")
+    # zero-size tensors have nothing on the wire (per-tensor all_reduce
+    # returns them unchanged) and cannot reshape(world, -1)
+    live = [i for i, a in enumerate(arrs) if a.size]
+    rows = {i: arrs[i].reshape(world, -1) for i in live}
+    # bucket over COLUMNS: a bucket's wire payload is world * cols *
+    # itemsize bytes, so the per-column budget divides out world
+    plan = BucketPlan([((rows[i].shape[1],), rows[i].dtype)
+                       for i in live],
+                      max(1, int(bucket_bytes) // max(1, world)))
+    # allocated lazily from the FIRST reduced bucket so the output
+    # dtype is whatever per-tensor all_reduce produces (e.g. int
+    # inputs promote to float under AVG) — np.empty_like(input) would
+    # silently truncate back to the input dtype
+    outs = {}
+    for si, sp in enumerate(plan.streams):
+        for k in range(len(sp.buckets)):
+            segs = sp.segments(k)
+            mat = np.concatenate(
+                [rows[live[sp.indices[m]]][:, s:t] for m, s, t in segs],
+                axis=1)
+            red = np.asarray(all_reduce(mat, op, group))
+            o = 0
+            for m, s, t in segs:
+                i = live[sp.indices[m]]
+                if i not in outs:
+                    outs[i] = np.empty(rows[i].shape, red.dtype)
+                outs[i][:, s:t] = red[:, o:o + (t - s)]
+                o += t - s
+    return [jnp.asarray(outs[i].reshape(a.shape)) if i in outs
+            else jnp.asarray(a)
+            for i, a in enumerate(arrs)]
+
+
 def all_gather(tensor, group: Group = None, axis: int = 0, tiled: bool = True):
     """Gather shards along ``axis``. ``tiled=True`` concatenates (the
     all_gather_into_tensor layout); ``tiled=False`` stacks a new axis."""
@@ -416,6 +497,16 @@ def scatter(tensor, src: int = 0, group: Group = None):
     def _scatter(t):
         # t is the src's full tensor replicated; each shard takes its slice.
         size = _axes_size(names)
+        if t.shape[0] % size:
+            # shapes are static under trace, so this raises at trace
+            # time — the old floor-division silently DROPPED the
+            # trailing rows (t.shape[0] % size elements vanished)
+            raise ValueError(
+                f"scatter: leading dim {t.shape[0]} is not divisible "
+                f"by group size {size} (axis {names}); the trailing "
+                f"{t.shape[0] % size} row(s) would be silently "
+                "dropped — pad the input to a multiple of the group "
+                "size")
         idx = axis_index(names)
         chunk = t.shape[0] // size
         return jax.lax.dynamic_slice_in_dim(t, idx * chunk, chunk, axis=0)

@@ -102,7 +102,9 @@ class AsyncIOHandle:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except (OSError, AttributeError):
+            # interpreter teardown can drop the ctypes lib before us;
+            # a failed close on a dying process has nothing to recover
             pass
 
 

@@ -49,8 +49,8 @@ def peak_tflops(device=None) -> float:
                 return tf
         if "v5 lite" in kind or "v5lite" in kind:
             return _PEAK_TFLOPS["v5e"]
-    except Exception:
-        pass
+    except (RuntimeError, IndexError, AttributeError):
+        pass  # no/odd backend: fall through to the default estimate
     return _DEFAULT_PEAK
 
 

@@ -10,6 +10,11 @@ sites wired through the stack:
     collective          eager collective dispatch (comm/comm.py)
     offload.d2h         host-offload grad download (runtime/zero/offload.py)
     offload.h2d         host-offload param upload (runtime/zero/offload.py)
+    transfer.d2h        bucketed transfer engine: one fire per fused
+                        bucket download (runtime/zero/offload.py via
+                        runtime/transfer/)
+    transfer.h2d        bucketed transfer engine: one fire per fused
+                        bucket upload
     data.fetch          dataloader batch assembly (runtime/dataloader.py)
 
 Spec grammar (config ``resilience.fault_injection`` or env
@@ -43,7 +48,8 @@ from .errors import InjectedFault, InjectedIOError
 
 KNOWN_SITES = (
     "checkpoint.save", "checkpoint.load", "collective",
-    "offload.d2h", "offload.h2d", "data.fetch",
+    "offload.d2h", "offload.h2d", "transfer.d2h", "transfer.h2d",
+    "data.fetch",
 )
 
 _KINDS = ("ioerror", "error", "hang")

@@ -519,7 +519,8 @@ class DeepSpeedEngine:
             int8_grads=(gd in ("int8", "int4")),
             grad_bits=4 if gd == "int4" else 8,
             int8_delta_upload=ud.endswith("_delta"),
-            delta_bits=4 if ud == "int4_delta" else 8)
+            delta_bits=4 if ud == "int4_delta" else 8,
+            transfer=self._offload_cfg.transfer)
         master = self._offload.initial_device_leaves(master)
         flat, treedef = jax.tree_util.tree_flatten(master)
         device_mask = jax.tree_util.tree_unflatten(

@@ -1,0 +1,189 @@
+"""Fixed-size transfer bucket planning.
+
+Reference semantics: the DeepSpeed runtime never moves gradients
+leaf-by-leaf on a hot path — stage 1/2 packs them into flat
+``reduce_bucket_size`` ipg buffers (runtime/zero/stage_1_and_2.py
+``independent_gradient_partition`` buckets) and the swap tensors ride
+fixed-size aligned buffers (runtime/swap_tensor/ ``AsyncTensorSwapper``).
+This module is the planning half of that idea for the TPU port: given
+an ordered list of array specs, lay same-dtype arrays back to back into
+per-dtype *streams* and cut each stream into fixed-size *buckets*, so a
+transfer engine issues ``ceil(stream_bytes / bucket_bytes)`` fused
+copies instead of one per leaf.
+
+Pure numpy — no jax — so the comm facade's gradient-coalescing path can
+plan buckets without importing the runtime engine stack.
+"""
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def bucket_ranges(total_elems: int, bucket_elems: int) -> List[Tuple[int, int]]:
+    """Contiguous [start, stop) chunks covering [0, total): fixed size
+    except a short tail."""
+    return [(s, min(s + bucket_elems, total_elems))
+            for s in range(0, total_elems, bucket_elems)]
+
+
+class StreamPlan:
+    """One dtype's fused stream: member arrays flattened back to back,
+    cut into fixed-size buckets. A member larger than a bucket spans
+    several buckets; small members share one."""
+
+    def __init__(self, dtype, indices: Sequence[int],
+                 shapes: Sequence[tuple], bucket_bytes: int):
+        self.dtype = np.dtype(dtype)
+        self.indices = list(indices)        # original array positions
+        self.shapes = [tuple(s) for s in shapes]
+        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        self.offsets = [0]
+        for sz in self.sizes:
+            self.offsets.append(self.offsets[-1] + sz)
+        self.total = self.offsets[-1]
+        self.bucket_elems = max(1, int(bucket_bytes) // self.dtype.itemsize)
+        self.buckets = bucket_ranges(self.total, self.bucket_elems)
+
+    @property
+    def nbytes(self) -> int:
+        return self.total * self.dtype.itemsize
+
+    def segments(self, k: int) -> List[Tuple[int, int, int]]:
+        """Bucket k's member pieces as [(member_pos, start, stop)] with
+        start/stop relative to that member's own flat layout."""
+        b0, b1 = self.buckets[k]
+        out = []
+        for m, (o, sz) in enumerate(zip(self.offsets, self.sizes)):
+            s, t = max(b0, o), min(b1, o + sz)
+            if s < t:
+                out.append((m, s - o, t - o))
+        return out
+
+    def covering_buckets(self, m: int) -> List[int]:
+        """Ordinals of the buckets member ``m`` spans."""
+        o, sz = self.offsets[m], self.sizes[m]
+        if sz == 0:
+            return []
+        first = o // self.bucket_elems
+        last = (o + sz - 1) // self.bucket_elems
+        return list(range(first, last + 1))
+
+
+class BucketPlan:
+    """Multi-dtype plan over an ordered list of array specs.
+
+    Streams are ordered smallest-bytes first so tiny side channels
+    (e.g. the fp32 quantization scales next to an int8 payload) land
+    before the bulk stream and member completion can release work
+    incrementally as the bulk buckets arrive.
+    """
+
+    def __init__(self, specs: Sequence[Tuple[tuple, "np.dtype"]],
+                 bucket_bytes: int):
+        self.bucket_bytes = int(bucket_bytes)
+        self.n_arrays = len(specs)
+        by_dtype = {}
+        for i, (shape, dtype) in enumerate(specs):
+            by_dtype.setdefault(np.dtype(dtype), []).append((i, tuple(shape)))
+        streams = [StreamPlan(dt, [i for i, _ in members],
+                              [s for _, s in members], bucket_bytes)
+                   for dt, members in by_dtype.items()]
+        self.streams = sorted(streams, key=lambda sp: (sp.nbytes,
+                                                       sp.dtype.str))
+        # original array index -> (stream pos, member pos)
+        self._where = {}
+        for si, sp in enumerate(self.streams):
+            for m, orig in enumerate(sp.indices):
+                self._where[orig] = (si, m)
+
+    @property
+    def n_transfers(self) -> int:
+        """Total fused copies the plan issues — the scheduler bound the
+        perf smoke asserts: ceil(stream_bytes / bucket_bytes) summed
+        over streams (== ceil(total_bytes/bucket) for one dtype)."""
+        return sum(len(sp.buckets) for sp in self.streams)
+
+    def check(self, arrays) -> None:
+        """Assert live arrays still match the plan (leaf layout is
+        stable across steps; a silent mismatch would scramble views)."""
+        if len(arrays) != self.n_arrays:
+            raise ValueError(f"transfer plan covers {self.n_arrays} "
+                             f"arrays, got {len(arrays)}")
+        for i, a in enumerate(arrays):
+            si, m = self._where[i]
+            sp = self.streams[si]
+            if tuple(a.shape) != sp.shapes[m] or \
+                    np.dtype(a.dtype) != sp.dtype:
+                raise ValueError(
+                    f"transfer plan mismatch at array {i}: planned "
+                    f"{sp.shapes[m]}/{sp.dtype}, got "
+                    f"{tuple(a.shape)}/{a.dtype}")
+
+    def alloc_staging(self) -> List[np.ndarray]:
+        """One reusable flat host buffer per stream — the pipeline's
+        staging memory (reused across steps; the caller must drain
+        in-flight transfers before the next step rewrites it)."""
+        return [np.empty(sp.total, sp.dtype) for sp in self.streams]
+
+    def views(self, staging: Sequence[np.ndarray]) -> List[np.ndarray]:
+        """Zero-copy per-array views into the staging buffers, in the
+        ORIGINAL array order."""
+        out = [None] * self.n_arrays
+        for si, sp in enumerate(self.streams):
+            buf = staging[si]
+            for m, orig in enumerate(sp.indices):
+                o, sz = sp.offsets[m], sp.sizes[m]
+                out[orig] = buf[o:o + sz].reshape(sp.shapes[m])
+        return out
+
+    def arrival_tracker(self) -> "ArrivalTracker":
+        return ArrivalTracker(self)
+
+    def fill_tracker(self) -> "FillTracker":
+        return FillTracker(self)
+
+
+class ArrivalTracker:
+    """Download direction: mark buckets as they land; members whose
+    covering buckets have ALL arrived are released for consumption."""
+
+    def __init__(self, plan: BucketPlan):
+        self._plan = plan
+        self._left = [[len(sp.covering_buckets(m))
+                       for m in range(len(sp.indices))]
+                      for sp in plan.streams]
+
+    def mark(self, si: int, k: int) -> List[int]:
+        """Bucket ``k`` of stream ``si`` arrived; returns the ORIGINAL
+        indices of arrays that just became complete."""
+        sp = self._plan.streams[si]
+        done = []
+        for m, _s, _t in sp.segments(k):
+            self._left[si][m] -= 1
+            if self._left[si][m] == 0:
+                done.append(sp.indices[m])
+        return done
+
+
+class FillTracker:
+    """Upload direction: mark members as their staging views are
+    written; buckets whose overlapping members are ALL written are
+    released for transfer."""
+
+    def __init__(self, plan: BucketPlan):
+        self._plan = plan
+        self._left = [[len(sp.segments(k)) for k in range(len(sp.buckets))]
+                      for sp in plan.streams]
+
+    def fill(self, orig_idx: int) -> List[Tuple[int, int]]:
+        """Member (original index) written; returns [(stream, bucket)]
+        ordinals now fully staged and ready to transfer."""
+        si, m = self._plan._where[orig_idx]
+        sp = self._plan.streams[si]
+        ready = []
+        for k in sp.covering_buckets(m):
+            self._left[si][k] -= 1
+            if self._left[si][k] == 0:
+                ready.append((si, k))
+        return ready

@@ -1,0 +1,33 @@
+"""Reusable host staging buffers for the transfer pipeline.
+
+Reference: runtime/swap_tensor/pipelined_optimizer_swapper.py keeps a
+small ring of aligned DRAM buffers and streams the full optimizer state
+through them; DRAM is bounded by the buffers, never by the state. The
+``StagingPair`` here is that ring at depth two — one buffer fills while
+the other drains — shared by the NVMe optimizer-state swapper and the
+transfer engine's upload pack scratch.
+"""
+
+from typing import Dict, Iterable
+
+import numpy as np
+
+
+class StagingPair:
+    """Double-buffered named host scratch: ``pair[i]`` rotates between
+    two buffer sets by parity, so step ``i``'s consumer and step
+    ``i+1``'s producer never touch the same memory."""
+
+    def __init__(self, keys: Iterable[str], n_elems: int,
+                 dtype=np.float32):
+        self.keys = tuple(keys)
+        self._bufs = tuple({k: np.empty(n_elems, dtype)
+                            for k in self.keys} for _ in range(2))
+
+    def __getitem__(self, i: int) -> Dict[str, np.ndarray]:
+        return self._bufs[i % 2]
+
+    @property
+    def nbytes(self) -> int:
+        return sum(b.nbytes for bufs in self._bufs
+                   for b in bufs.values())

@@ -41,6 +41,27 @@ class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class DeepSpeedZeroOffloadTransferConfig(DeepSpeedConfigModel):
+    """Bucketed double-buffered transfer engine (runtime/transfer/):
+    the offloaded leaves' wire tensors are fused on-device into
+    fixed-size buckets so each direction is a few large contiguous
+    copies, pipelined against the host Adam — bit-identical to the
+    per-leaf path (reference role: stage_1_and_2.py ipg buckets +
+    swap_tensor/pipelined_optimizer_swapper.py). ``enabled=False``
+    restores the per-leaf wire (A/B + bisection escape hatch)."""
+    enabled: bool = True
+    # fused bucket size; fractional MB allowed (tests force multi-
+    # bucket schedules on tiny trees with e.g. 0.001)
+    bucket_mb: float = 64.0
+
+    def _validate(self):
+        if not float(self.bucket_mb) > 0:
+            raise ValueError(
+                f"offload_optimizer.transfer.bucket_mb must be "
+                f"positive, got {self.bucket_mb!r}")
+
+
+@dataclasses.dataclass
 class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     """reference: runtime/zero/offload_config.py OffloadOptimizerConfig"""
     device: str = "none"
@@ -68,6 +89,11 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     # "int4_delta" (two signed nibbles per byte, 0.625 B/param — the
     # mirror's error feedback absorbs the coarser rounding)
     upload_dtype: str = "bf16"
+    # bucketed double-buffered wire (on by default; see
+    # DeepSpeedZeroOffloadTransferConfig). from_dict resolves a nested
+    # dict through the submodel machinery (config_utils._resolve_submodel)
+    transfer: DeepSpeedZeroOffloadTransferConfig = submodel(
+        DeepSpeedZeroOffloadTransferConfig)
 
 
 @dataclasses.dataclass

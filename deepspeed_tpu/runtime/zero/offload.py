@@ -29,6 +29,7 @@ from ...resilience.fault_injector import fault_injector
 from ...resilience.retry import retry_io
 from ...utils.jax_compat import TRANSFER_ERRORS
 from ...utils.logging import log_dist
+from ..transfer import StagingPair, TransferEngine, start_host_copy
 
 
 def sharding_replicated(sharding):
@@ -84,6 +85,20 @@ def select_offload_mask(params, ratio: float) -> List[bool]:
     return mask
 
 
+class _PendingUpload:
+    """Bucketed H2D still in flight: the staged buckets were put on the
+    wire by the host-step thread, but the jitted scatter-back (a
+    compiled multi-device program) must run on the MAIN thread at merge
+    time — dispatching compiled programs from two threads at once can
+    deadlock the per-device collective rendezvous (observed on the XLA
+    CPU backend; on TPU the racing per-core enqueue order is the same
+    hazard). Transfers (device_put / np.asarray) are thread-safe; only
+    program dispatch is serialized."""
+
+    def __init__(self, shardings):
+        self.shardings = shardings
+
+
 class OffloadCoordinator:
     """Owns host optimizer state for the offloaded leaves.
 
@@ -99,7 +114,8 @@ class OffloadCoordinator:
                  int8_grads: bool = False,
                  grad_bits: int = 8,
                  int8_delta_upload: bool = False,
-                 delta_bits: int = 8):
+                 delta_bits: int = 8,
+                 transfer=None):
         self.mask = mask
         self.compute_dtype = compute_dtype
         self._int8_grads = bool(int8_grads)
@@ -110,6 +126,18 @@ class OffloadCoordinator:
         if delta_bits not in (4, 8):
             raise ValueError(f"delta_bits must be 4 or 8, got {delta_bits}")
         self._delta_bits = int(delta_bits)
+        # bucketed transfer engine (runtime/transfer/): fuses the wire
+        # tensors into fixed-size buckets so D2H/H2D are a few large
+        # contiguous copies — bit-identical to the per-leaf path (the
+        # engine only regroups bytes). ``transfer=None`` (direct
+        # construction) keeps the per-leaf path.
+        self._transfer = None
+        self._d2h_plan = self._h2d_plan = None
+        self._d2h_stage = self._h2d_stage = None
+        if transfer is not None and getattr(transfer, "enabled", False):
+            bucket_mb = float(getattr(transfer, "bucket_mb", 64))
+            self._transfer = TransferEngine(
+                bucket_bytes=max(1, int(bucket_mb * (1 << 20))))
         flat, self.treedef = jax.tree_util.tree_flatten(master_params)
         self.off_idx = [i for i, m in enumerate(mask) if m]
         off_params = [np.asarray(flat[i], dtype=np.float32)
@@ -150,9 +178,7 @@ class OffloadCoordinator:
             # swap_tensor/pipelined_optimizer_swapper.py)
             ha.master = ha.m = ha.v = None
             max_n = max(int(np.prod(s)) for s in self._shapes)
-            self._scratch = [
-                {k: np.empty(max_n, np.float32) for k in "pmv"}
-                for _ in range(2)]
+            self._scratch = StagingPair("pmv", max_n)
         # step decomposition (grad D2H / host Adam / param H2D) — the
         # audited breakdown bench.py config 4 reports; the engine adds
         # the overlap residue (time the main thread actually stalled)
@@ -175,10 +201,13 @@ class OffloadCoordinator:
             self._mirror = [self._round_compute(
                 np.asarray(a, np.float32)) for a in off_params]
         n_off = sum(int(np.prod(a.shape)) for a in off_params)
+        xfer = f"bucketed {self._transfer.bucket_bytes / (1 << 20):g}MB" \
+            if self._transfer else "per-leaf"
         log_dist(f"ZeRO-Offload: {len(self.off_idx)} leaves "
                  f"({n_off/1e6:.2f}M params) "
                  f"{'NVMe' if self.store else 'host'}-resident "
-                 f"(native={'yes' if self.host_adam.native else 'numpy'})",
+                 f"(native={'yes' if self.host_adam.native else 'numpy'}, "
+                 f"transfer={xfer})",
                  ranks=[0])
 
     def master_arrays(self) -> List[np.ndarray]:
@@ -200,31 +229,37 @@ class OffloadCoordinator:
             flat[i] = jnp.asarray(flat[i], dtype=self.compute_dtype)
         return jax.tree_util.tree_unflatten(treedef, flat)
 
-    def _host_step(self, off_grads, lr, skip, shardings) -> Optional[list]:
+    def _host_step(self, off_grads, lr, skip, shardings,
+                   prepacked=None) -> Optional[list]:
         """Host path: grads device->host, host Adam, compute-dtype
-        payloads back to device. Returns the device leaves to merge, or
-        None when skipped.
+        payloads back to device. Returns the device leaves to merge
+        (or, on the bucketed path, a ``_PendingUpload`` the main-thread
+        ``merge`` finalizes), or None when skipped.
 
-        DRAM tier: PER-LEAF pipelined (reference:
-        swap_tensor/pipelined_optimizer_swapper.py) — all D2H copies
-        start streaming up front, then each leaf's wait -> Adam ->
-        upload runs while later leaves' downloads (and earlier leaves'
-        uploads) are still in flight, so the wall clock approaches the
-        slower DIRECTION of the wire rather than the sum of both plus
-        the Adam.
+        DRAM tier without the transfer engine: PER-LEAF pipelined
+        (reference: swap_tensor/pipelined_optimizer_swapper.py) — all
+        D2H copies start streaming up front, then each leaf's wait ->
+        Adam -> upload runs while later leaves' downloads (and earlier
+        leaves' uploads) are still in flight. With the engine the same
+        pipeline runs over fused buckets (_host_step_bucketed).
 
         ``skip`` may be a device boolean — it is forced here, so in the
-        delayed-update mode the main thread never blocks on it."""
+        delayed-update mode the main thread never blocks on it.
+        ``prepacked`` carries main-thread-packed D2H buckets for the
+        delayed mode (see _pack_d2h)."""
         if skip is not None and bool(skip):
             return None
         if self.store is not None:
             t0 = time.perf_counter()
-            host = retry_io(
-                lambda: (fault_injector.fire("offload.d2h"),
-                         jax.device_get(list(off_grads)))[1],
-                retries=2, backoff_seconds=0.01,
-                retryable=TRANSFER_ERRORS,
-                description="offload grad d2h")
+            if self._transfer is not None and off_grads:
+                host = self._bucketed_device_get(off_grads, prepacked)
+            else:
+                host = retry_io(
+                    lambda: (fault_injector.fire("offload.d2h"),
+                             jax.device_get(list(off_grads)))[1],
+                    retries=2, backoff_seconds=0.01,
+                    retryable=TRANSFER_ERRORS,
+                    description="offload grad d2h")
             np_grads = self._decode_grads(host)
             t1 = time.perf_counter()
             leaves = self._nvme_step(np_grads, lr, shardings)
@@ -233,15 +268,18 @@ class OffloadCoordinator:
                 "host_adam_ms": (time.perf_counter() - t1) * 1e3,
                 "param_h2d_ms": 0.0,    # nvme path paces its own IO
             }
+            if self._transfer is not None and self._d2h_plan is not None:
+                self.last_breakdown["d2h_buckets"] = \
+                    self._d2h_plan.n_transfers
             return leaves
+        if self._transfer is not None and self.off_idx:
+            return self._host_step_bucketed(off_grads, lr, shardings,
+                                            prepacked)
         ha = self.host_adam
         n = len(self.off_idx)
         per_leaf = 2 if self._int8_grads else 1
         for e in off_grads:             # start every D2H copy streaming
-            try:
-                e.copy_to_host_async()
-            except Exception:           # platform without async copies
-                pass
+            start_host_copy(e)          # warns once where unsupported
         step_count = ha.step_count + 1
         t_d2h = t_adam = t_h2d = 0.0
         leaves = []
@@ -311,6 +349,232 @@ class OffloadCoordinator:
         }
         return leaves
 
+    # -- bucketed transfer path (runtime/transfer/) ------------------------
+    def _pack_d2h(self, off_grads):
+        """Device-side pack + async-copy kick. MUST run on the thread
+        that dispatches the jitted train step (see _PendingUpload: the
+        pack is a compiled multi-device program); the delayed mode
+        calls this from apply_grads_async before handing the rest of
+        the host step to the background thread."""
+        if self._d2h_plan is None:
+            self._d2h_plan = self._transfer.plan(off_grads)
+            self._d2h_stage = self._d2h_plan.alloc_staging()
+        bucket_lists = self._transfer.pack(self._d2h_plan, off_grads)
+        self._transfer.start_host_copies(bucket_lists)
+        return bucket_lists
+
+    def _bucketed_device_get(self, off_grads,
+                             prepacked=None) -> List[np.ndarray]:
+        """Fused blocking fetch of the wire tensors (NVMe tier's grad
+        download): pack + a few large copies instead of one device_get
+        per leaf. The retry replays only the WAITS — the device buckets
+        stay live, so re-reading them is idempotent and needs no
+        program dispatch."""
+        bucket_lists = prepacked if prepacked is not None \
+            else self._pack_d2h(off_grads)
+
+        def _fetch():
+            fault_injector.fire("offload.d2h")
+            return self._transfer.device_get(
+                self._d2h_plan, staging=self._d2h_stage,
+                bucket_lists=bucket_lists,
+                on_bucket=lambda si, k: fault_injector.fire(
+                    "transfer.d2h"))
+
+        return retry_io(_fetch, retries=2, backoff_seconds=0.01,
+                        retryable=TRANSFER_ERRORS,
+                        description="offload grad d2h (bucketed)")
+
+    def _upload_specs(self):
+        """(shape, dtype) of each host->device payload array, slot
+        order (delta mode ships (q, scales) per slot). Computable
+        before any payload exists, so the upload plan — and its
+        staging — is built once up front."""
+        if self._delta_upload:
+            from ...comm.compressed import BLOCK
+            specs = []
+            for s in self._shapes:
+                nb = -(-int(np.prod(s)) // BLOCK)
+                if self._delta_bits == 4:
+                    specs.append(((nb, BLOCK // 2), np.uint8))
+                else:
+                    specs.append(((nb, BLOCK), np.int8))
+                specs.append(((nb,), np.float32))
+            return specs
+        if self.compute_dtype == jnp.bfloat16:
+            import ml_dtypes
+            dt = np.dtype(ml_dtypes.bfloat16)
+        elif self.compute_dtype == jnp.float16:
+            dt = np.dtype(np.float16)
+        else:
+            dt = np.dtype(np.float32)
+        return [(s, dt) for s in self._shapes]
+
+    def _payload_np(self, slot: int) -> List[np.ndarray]:
+        """Slot's upload payload as host arrays (the wire bytes the
+        per-leaf path would device_put) — delta mode ADVANCES the
+        mirror, so call exactly once per slot per step."""
+        if self._delta_upload:
+            q, scale = self._delta_quantize(slot)
+            return [q, scale]
+        master = self.host_adam.master[slot]
+        if self.compute_dtype == jnp.bfloat16:
+            return [self.host_adam.to_bf16(master)]
+        return [master.astype(np.dtype(self.compute_dtype))]
+
+    def _unpack_upload(self, shardings):
+        """Uploaded buckets -> the per-leaf device payloads ``merge``
+        consumes: one jitted scatter-back per stream (out-sharded to
+        the leaf layout for dense payloads; delta payloads stay
+        replicated like the per-leaf path's device_put)."""
+        sh = None
+        if not self._delta_upload:
+            sh = [shardings[i] for i in range(len(self.off_idx))]
+        outs = self._transfer.unpack(self._h2d_plan, self._h2d_dev, sh)
+        if not self._delta_upload:
+            return list(outs)
+        key = "q4" if self._delta_bits == 4 else "q"
+        return [{key: outs[2 * slot], "scales": outs[2 * slot + 1]}
+                for slot in range(len(self.off_idx))]
+
+    def _upload_bucket(self, si, k):
+        """Stage slice -> one fused device_put (a transfer, safe from
+        any thread). Retryable in EVERY upload mode — unlike the
+        per-leaf delta wire — because the staged bytes are immutable
+        once written: replaying a failed put never re-advances the
+        error-feedback mirror."""
+        uplan = self._h2d_plan
+        b0, b1 = uplan.streams[si].buckets[k]
+        buf = self._h2d_stage[si][b0:b1]
+
+        def _put():
+            fault_injector.fire("offload.h2d")
+            fault_injector.fire("transfer.h2d")
+            return jax.device_put(buf, self._h2d_rep)
+
+        self._h2d_dev[si][k] = retry_io(
+            _put, retries=2, backoff_seconds=0.01,
+            retryable=TRANSFER_ERRORS,
+            description="offload param h2d (bucket)")
+
+    def _host_step_bucketed(self, off_grads, lr, shardings,
+                            prepacked=None) -> "_PendingUpload":
+        """DRAM-tier host step over fused buckets — the double-buffered
+        pipeline of the tentpole: all grad buckets start streaming D2H
+        up front; as bucket *k* lands, every leaf it completes runs the
+        host Adam and stages its upload payload, and each upload bucket
+        fires H2D the moment its last member is staged — so the wire
+        carries bucket *k+1* down and bucket *k−1*'s params up WHILE
+        the CPU chews bucket *k*. Bit-identical to the per-leaf path
+        (pack/unpack are exact concat/slice; the codec + Adam math is
+        untouched).
+
+        Returns a ``_PendingUpload``: the jitted scatter-back runs at
+        ``merge`` on the main thread (program-dispatch serialization —
+        see _PendingUpload), which in delayed mode is also the LATEST
+        possible join point, after the next step's compute dispatched."""
+        ha = self.host_adam
+        n = len(self.off_idx)
+        per_leaf = 2 if self._int8_grads else 1
+        per_up = 2 if self._delta_upload else 1
+        eng = self._transfer
+        t_d2h = t_adam = t_h2d = 0.0
+
+        t0 = time.perf_counter()
+        dev_buckets = prepacked if prepacked is not None \
+            else self._pack_d2h(off_grads)
+        dplan, dstage = self._d2h_plan, self._d2h_stage
+        views = dplan.views(dstage)
+        arrival = dplan.arrival_tracker()
+        t_d2h += time.perf_counter() - t0
+
+        if self._h2d_plan is None:
+            self._h2d_plan = eng.plan_specs(self._upload_specs())
+            self._h2d_stage = self._h2d_plan.alloc_staging()
+        uplan, ustage = self._h2d_plan, self._h2d_stage
+        uviews = uplan.views(ustage)
+        fill = uplan.fill_tracker()
+        self._h2d_rep = sharding_replicated(shardings[0]) \
+            if shardings else None
+        self._h2d_dev = [[None] * len(sp.buckets)
+                         for sp in uplan.streams]
+
+        slot_left = [per_leaf] * n
+        step_count = ha.step_count + 1
+        for si, k, barr in eng.iter_buckets(dplan, dev_buckets):
+            t0 = time.perf_counter()
+
+            def _wait(barr=barr):
+                fault_injector.fire("offload.d2h")
+                fault_injector.fire("transfer.d2h")
+                return np.asarray(barr)
+
+            h = retry_io(_wait, retries=2, backoff_seconds=0.01,
+                         retryable=TRANSFER_ERRORS,
+                         description="offload grad d2h (bucket)")
+            b0, b1 = dplan.streams[si].buckets[k]
+            dstage[si][b0:b1] = h.reshape(-1)
+            ready = arrival.mark(si, k)
+            t_d2h += time.perf_counter() - t0
+            for idx in ready:
+                slot = idx // per_leaf
+                slot_left[slot] -= 1
+                if slot_left[slot]:
+                    continue
+                t1 = time.perf_counter()
+                g = self._decode_entry(
+                    slot, views[slot * per_leaf:(slot + 1) * per_leaf])
+                ha.step_arrays(ha.master[slot], g, ha.m[slot],
+                               ha.v[slot], lr, step_count)
+                t2 = time.perf_counter()
+                for j, arr in enumerate(self._payload_np(slot)):
+                    m_idx = slot * per_up + j
+                    uviews[m_idx][...] = np.asarray(arr).reshape(
+                        uviews[m_idx].shape)
+                    for si_u, k_u in fill.fill(m_idx):
+                        self._upload_bucket(si_u, k_u)
+                t3 = time.perf_counter()
+                t_adam += t2 - t1
+                t_h2d += t3 - t2
+        ha.step_count = step_count
+        self.last_breakdown = {
+            "grad_d2h_ms": t_d2h * 1e3,
+            "host_adam_ms": t_adam * 1e3,
+            "param_h2d_ms": t_h2d * 1e3,
+            "d2h_buckets": dplan.n_transfers,
+            "h2d_buckets": uplan.n_transfers,
+        }
+        return _PendingUpload(shardings)
+
+    def _finalize_upload(self, pending: "_PendingUpload") -> list:
+        """Main-thread tail of the bucketed upload: jitted scatter-back
+        over the already-in-flight buckets + the drain barrier. The
+        retry replays the puts from the immutable staging (idempotent
+        in every mode — see _upload_bucket)."""
+        t0 = time.perf_counter()
+        attempted = [False]
+
+        def _drain():
+            if attempted[0]:
+                for si, sp in enumerate(self._h2d_plan.streams):
+                    for k in range(len(sp.buckets)):
+                        self._upload_bucket(si, k)
+            attempted[0] = True
+            out = self._unpack_upload(pending.shardings)
+            jax.block_until_ready(jax.tree_util.tree_leaves(out))
+            return out
+
+        leaves = retry_io(_drain, retries=2, backoff_seconds=0.01,
+                          retryable=TRANSFER_ERRORS,
+                          description="offload param h2d (drain)")
+        # the drain belongs to the upload leg of the step being merged
+        # (last_breakdown still describes it: merge runs before the
+        # next host step can start)
+        self.last_breakdown["param_h2d_ms"] = \
+            self.last_breakdown.get("param_h2d_ms", 0.0) + \
+            (time.perf_counter() - t0) * 1e3
+        return leaves
+
     def _decode_grads(self, host) -> List[np.ndarray]:
         """Wire grads -> fp32 arrays. bf16 wire: plain cast. int8 wire:
         each entry is a (q [n_blocks, 256] int8, scales [n_blocks])
@@ -356,14 +620,16 @@ class OffloadCoordinator:
             return x
         return x.astype(np_dtype).astype(np.float32)
 
-    def _delta_payload(self, slot: int, sharding):
-        """Block-quantized delta vs the device mirror + scales; the
-        merge applies it on device and the mirror advances through the
-        same compute-dtype rounding, keeping host and device bit-equal.
-        ``delta_bits=8``: 1.25 B/param on the wire. ``delta_bits=4``:
-        two signed nibbles per byte, 0.625 B/param — the mirror's error
-        feedback absorbs the coarser per-step rounding exactly as for
-        int8 (the residual is simply larger per step)."""
+    def _delta_quantize(self, slot: int):
+        """Block-quantized delta vs the device mirror: returns the
+        host (q-or-packed, scales) wire arrays and ADVANCES the mirror
+        through the same compute-dtype rounding the device will apply,
+        keeping host and device bit-equal. ``delta_bits=8``:
+        1.25 B/param on the wire. ``delta_bits=4``: two signed nibbles
+        per byte, 0.625 B/param — the mirror's error feedback absorbs
+        the coarser per-step rounding exactly as for int8 (the residual
+        is simply larger per step). Shared by the per-leaf device_put
+        path and the bucketed staging path — ONE codec, two wires."""
         from ...comm.compressed import BLOCK
         master = self.host_adam.master[slot]
         mirror = self._mirror[slot]
@@ -387,15 +653,21 @@ class OffloadCoordinator:
         deq = (q.astype(np.float32) * scale).reshape(-1)[:n]
         self._mirror[slot] = self._round_compute(
             mirror + deq.reshape(mirror.shape))
-        rep = sharding_replicated(sharding)
         if self._delta_bits == 4:
             # pack signed nibbles: element 2k low, 2k+1 high
             u = (q.astype(np.int16) & 0xF).astype(np.uint8)
-            packed = (u[:, 0::2] | (u[:, 1::2] << 4)).astype(np.uint8)
-            return {"q4": jax.device_put(packed, rep),
-                    "scales": jax.device_put(scale[:, 0], rep)}
-        return {"q": jax.device_put(q, rep),
-                "scales": jax.device_put(scale[:, 0], rep)}
+            q = (u[:, 0::2] | (u[:, 1::2] << 4)).astype(np.uint8)
+        return q, scale[:, 0]
+
+    def _delta_payload(self, slot: int, sharding):
+        """Per-leaf upload wire: quantize + one device_put per array
+        (the bucketed path stages the same bytes into fused buckets
+        instead — see _host_step_bucketed)."""
+        q, scales = self._delta_quantize(slot)
+        rep = sharding_replicated(sharding)
+        key = "q4" if self._delta_bits == 4 else "q"
+        return {key: jax.device_put(q, rep),
+                "scales": jax.device_put(scales, rep)}
 
     def _device_payload(self, p: np.ndarray, sharding):
         """fp32 master -> compute-dtype device leaf (one rounding path
@@ -454,9 +726,14 @@ class OffloadCoordinator:
         host-updated device payloads. In delta mode each payload is
         {q, scales} (int8, 1.25 B/param on the wire) or {q4, scales}
         (packed int4, 0.625 B/param): the add + dequant runs in one
-        small jit per leaf shape (cached by XLA)."""
+        small jit per leaf shape (cached by XLA). A bucketed host step
+        hands back a ``_PendingUpload`` — its jitted scatter-back runs
+        HERE, on the main thread, serialized with the train-step
+        dispatches."""
         if leaves is None:
             return state_master
+        if isinstance(leaves, _PendingUpload):
+            leaves = self._finalize_upload(leaves)
         flat, treedef = jax.tree_util.tree_flatten(state_master)
         for slot, i in enumerate(self.off_idx):
             leaf = leaves[slot]
@@ -497,8 +774,14 @@ class OffloadCoordinator:
             self._pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="zero-offload")
         shardings = self._leaf_shardings(state_master)
+        prepacked = None
+        if self._transfer is not None and self.off_idx and off_grads:
+            # the compiled pack must be dispatched from THIS thread
+            # (see _PendingUpload); if the step later turns out skipped
+            # the packed buckets are simply dropped
+            prepacked = self._pack_d2h(off_grads)
         return self._pool.submit(self._host_step, off_grads, lr, skip,
-                                 shardings)
+                                 shardings, prepacked)
 
     # -- checkpoint --------------------------------------------------------
     def state_dict(self):

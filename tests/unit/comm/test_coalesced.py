@@ -1,0 +1,111 @@
+"""Eager gradient-coalescing collectives and the scatter divisibility
+guard."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.comm import comm as comm_mod
+from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
+
+
+def _init_data_mesh():
+    mesh_manager.init(MeshConfig(data=-1))
+    return mesh_manager.axis_size("data")
+
+
+def test_all_reduce_coalesced_matches_per_tensor(eight_devices, rng):
+    world = _init_data_mesh()
+    # exactly-representable values -> per-tensor vs fused results must
+    # be EQUAL, not merely close
+    tensors = [rng.integers(-8, 8, size=(world * k, 3)
+                            ).astype(np.float32)
+               for k in (1, 2, 5, 1, 3)]
+    ref = [np.asarray(dist.all_reduce(t, group="data")) for t in tensors]
+    got = dist.all_reduce_coalesced(tensors, group="data")
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(r, np.asarray(g))
+
+
+def test_all_reduce_coalesced_fuses_dispatches(eight_devices, rng,
+                                               monkeypatch):
+    """N small same-dtype tensors ride ceil(total/bucket) collectives,
+    not N — counted at the eager dispatch seam."""
+    world = _init_data_mesh()
+    tensors = [rng.normal(size=(world, 64)).astype(np.float32)
+               for _ in range(8)]
+    calls = []
+    real = comm_mod._dispatch
+    monkeypatch.setattr(comm_mod, "_dispatch",
+                        lambda name, thunk: (calls.append(name),
+                                             real(name, thunk))[1])
+    big = 1 << 20
+    dist.all_reduce_coalesced(tensors, group="data", bucket_bytes=big)
+    assert len(calls) == 1          # everything fits one bucket
+    calls.clear()
+    # per-column budget = bucket_bytes // world; 64 cols of fp32 = 256 B
+    dist.all_reduce_coalesced(tensors, group="data",
+                              bucket_bytes=64 * 4 * world)
+    total_cols = 64 * 8
+    assert len(calls) == -(-total_cols // 64)  # ceil(cols/64) buckets
+    assert len(calls) < 8 * 64                 # and far fewer than leaves
+
+
+def test_all_reduce_coalesced_mixed_dtypes_and_avg(eight_devices, rng):
+    world = _init_data_mesh()
+    a = rng.integers(0, 4, size=(world, 5)).astype(np.float32)
+    b = rng.integers(0, 4, size=(world * 2,)).astype(np.float64)
+    ref = [np.asarray(dist.all_reduce(x, dist.ReduceOp.AVG,
+                                      group="data")) for x in (a, b)]
+    got = dist.all_reduce_coalesced([a, b], dist.ReduceOp.AVG,
+                                    group="data")
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(r, np.asarray(g), rtol=1e-7)
+
+
+def test_all_reduce_coalesced_promotes_like_per_tensor(eight_devices):
+    """int inputs under AVG promote to float exactly like per-tensor
+    all_reduce — writing results back into input-dtype buffers would
+    silently truncate the fractional averages (review finding)."""
+    world = _init_data_mesh()
+    t = np.arange(world * 3, dtype=np.int32).reshape(world, 3)
+    ref = np.asarray(dist.all_reduce(t, dist.ReduceOp.AVG, group="data"))
+    (got,) = dist.all_reduce_coalesced([t], dist.ReduceOp.AVG,
+                                       group="data")
+    got = np.asarray(got)
+    assert got.dtype == ref.dtype
+    np.testing.assert_allclose(ref, got, rtol=1e-7)
+
+
+def test_all_reduce_coalesced_zero_size_passthrough(eight_devices):
+    world = _init_data_mesh()
+    empty = np.zeros((0, 4), np.float32)
+    full = np.ones((world, 2), np.float32)
+    out = dist.all_reduce_coalesced([empty, full], group="data")
+    assert np.asarray(out[0]).shape == (0, 4)
+    np.testing.assert_array_equal(np.asarray(out[1]),
+                                  np.asarray(dist.all_reduce(
+                                      full, group="data")))
+
+
+def test_all_reduce_coalesced_rejects_indivisible(eight_devices):
+    world = _init_data_mesh()
+    bad = np.zeros((world + 1, 2), np.float32)
+    with pytest.raises(ValueError, match="not divisible by"):
+        dist.all_reduce_coalesced([bad], group="data")
+
+
+def test_all_reduce_coalesced_empty_list():
+    assert dist.all_reduce_coalesced([]) == []
+
+
+def test_scatter_rejects_truncating_shapes(eight_devices):
+    """The old chunking used floor division and silently DROPPED the
+    remainder rows; now a non-divisible leading dim is a loud error."""
+    world = _init_data_mesh()
+    ok = np.arange(world * 2 * 3, dtype=np.float32).reshape(world * 2, 3)
+    out = np.asarray(dist.scatter(ok, group="data"))
+    assert out.shape[0] * world == ok.shape[0] * world  # sanity: ran
+    bad = np.zeros((world * 2 + 1, 3), np.float32)
+    with pytest.raises(ValueError, match="silently"):
+        dist.scatter(bad, group="data")

@@ -1,0 +1,47 @@
+"""The bare-except lint: flags bare ``except:`` AND the silent
+``except Exception: pass`` form (the shape the old offload
+``copy_to_host_async`` guard had), and the shipped package is clean."""
+
+import os
+import sys
+
+TOOLS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "..", "..", "..", "tools")
+sys.path.insert(0, os.path.abspath(TOOLS))
+
+from lint_bare_except import find_bare_excepts, main  # noqa: E402
+
+
+def _hits(tmp_path, src):
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    return find_bare_excepts(str(p))
+
+
+def test_flags_bare_except(tmp_path):
+    hits = _hits(tmp_path, "try:\n    x()\nexcept:\n    pass\n")
+    assert len(hits) == 1 and "bare" in hits[0][1]
+
+
+def test_flags_silent_except_exception_pass(tmp_path):
+    src = ("try:\n    x()\nexcept Exception:   # platform quirk\n"
+           "    pass\n")
+    hits = _hits(tmp_path, src)
+    assert len(hits) == 1 and "silent" in hits[0][1]
+
+
+def test_flags_silent_tuple_with_base_exception(tmp_path):
+    src = "try:\n    x()\nexcept (ValueError, BaseException):\n    pass\n"
+    assert len(_hits(tmp_path, src)) == 1
+
+
+def test_allows_narrow_pass_and_handled_broad(tmp_path):
+    src = ("try:\n    x()\nexcept (ImportError, AttributeError):\n"
+           "    pass\n"
+           "try:\n    y()\nexcept Exception as e:\n"
+           "    log(e)\n")
+    assert _hits(tmp_path, src) == []
+
+
+def test_package_is_clean():
+    assert main(["lint_bare_except.py"]) == 0

@@ -1,10 +1,16 @@
 #!/usr/bin/env python
-"""Repo lint: fail on bare ``except:`` clauses in deepspeed_tpu/.
+"""Repo lint: fail on bare ``except:`` clauses — and on silent
+``except Exception: pass`` — in deepspeed_tpu/.
 
 A bare except swallows KeyboardInterrupt/SystemExit and — worse for the
 resilience subsystem — the typed faults (CollectiveTimeout,
-CheckpointCorruptionError, ...) that recovery layers key on. Every
-handler must name what it can actually recover from.
+CheckpointCorruptionError, ...) that recovery layers key on. The
+``except Exception: pass`` form is barely better: it still silently
+eats every typed fault AND every real transfer/runtime error (the
+offload ``copy_to_host_async`` guard did exactly this before the
+transfer-engine PR). Every handler must name what it can actually
+recover from; a broad handler must at least DO something (log,
+re-raise, return a fallback) rather than ``pass``.
 
 Usage: python tools/lint_bare_except.py [root_dir]
 Exit code 0 = clean, 1 = violations found.
@@ -13,6 +19,21 @@ Exit code 0 = clean, 1 = violations found.
 import ast
 import os
 import sys
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _names(type_node):
+    """Exception class names a handler catches (best effort)."""
+    nodes = type_node.elts if isinstance(type_node, ast.Tuple) \
+        else [type_node]
+    out = []
+    for n in nodes:
+        if isinstance(n, ast.Name):
+            out.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.append(n.attr)
+    return out
 
 
 def find_bare_excepts(path):
@@ -24,8 +45,16 @@ def find_bare_excepts(path):
         return [(e.lineno or 0, f"syntax error: {e.msg}")]
     hits = []
     for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
             hits.append((node.lineno, "bare 'except:' clause"))
+            continue
+        body_is_pass = all(isinstance(st, ast.Pass) for st in node.body)
+        if body_is_pass and any(n in _BROAD for n in _names(node.type)):
+            hits.append((node.lineno,
+                         "silent 'except Exception: pass' — narrow the "
+                         "types or handle (log/fallback) the failure"))
     return hits
 
 
