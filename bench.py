@@ -79,6 +79,25 @@ def _run_engine_bench(model, config, seq, steps=5, metric="",
     if breakdown:
         out["decomposition"] = {k: round(v, 2)
                                 for k, v in breakdown.items()}
+    else:
+        # non-offload rows: the compiled-step schedule report
+        # (zero/schedule.py) — collective count, bytes moved, modeled
+        # comm/compute overlap of the train-step executable, plus which
+        # translator options actually applied on this backend
+        sched = engine.get_schedule_report()
+        if sched:
+            out["decomposition"] = {
+                "collective_count": sched["collective_count"],
+                "bytes_moved": round(sched["bytes_moved"], 1),
+                "overlap_estimate": round(sched["overlap_estimate"], 4),
+                "est_compute_ms": round(sched["est_compute_ms"], 3),
+                "est_comm_ms": round(sched["est_comm_ms"], 3),
+                "collectives": {k: {"count": v["count"],
+                                    "bytes": round(v["bytes"], 1)}
+                                for k, v in sched["collectives"].items()},
+                "options_applied": len(sched["options_applied"]),
+                "options_dropped": len(sched["options_dropped"]),
+            }
     return out
 
 

@@ -300,6 +300,54 @@ class LlamaForCausalLM(nn.Module):
         return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                 for _ in range(cfg.num_hidden_layers)]
 
+    def layer_scan_spec(self):
+        """Decomposition for the ZeRO-3 layer-scan step
+        (runtime/zero/schedule.py LayerScanSpec): embed / one LlamaBlock
+        / head, reproducing ``__call__``'s training path (no cache) op
+        for op — tests assert the decomposition is bit-exact against
+        the flat forward/backward."""
+        from ..runtime.zero.schedule import LayerScanSpec
+        cfg = self.config
+        L = cfg.num_hidden_layers
+
+        def split(variables):
+            p = dict(variables["params"])
+            layers = [p.pop(f"layers_{i}") for i in range(L)]
+            rest = dict(variables)
+            rest["params"] = p
+            return rest, layers
+
+        def embed(rest, batch, rng):
+            ids = batch["input_ids"]
+            B, T = ids.shape
+            x = rest["params"]["embed_tokens"][ids]
+            # honor caller-supplied RoPE positions exactly like the
+            # flat path (packed/shifted sequences pass positions=)
+            positions = batch.get("positions") \
+                if isinstance(batch, dict) else None
+            if positions is None:
+                positions = jnp.broadcast_to(jnp.arange(T)[None, :],
+                                             (B, T))
+            return x, positions
+
+        def layer(layer_params, x, positions):
+            return LlamaBlock(cfg).apply({"params": layer_params}, x,
+                                         positions)
+
+        def head(rest, x, batch):
+            p = rest["params"]
+            x = RMSNorm(cfg.rms_norm_eps).apply({"params": p["norm"]}, x)
+            embed_w = p["embed_tokens"]
+            logits = x @ (embed_w.T if cfg.tie_word_embeddings
+                          else p["lm_head"].T)
+            from .gpt2 import cross_entropy_loss
+            return cross_entropy_loss(logits, batch["labels"]), logits
+
+        return LayerScanSpec(
+            num_layers=L, split=split, embed=embed, layer=layer,
+            head=head,
+            remat=cfg.remat_policy if cfg.use_remat else "none")
+
 
 def llama_tensor_rules(name, shape):
     """Tensor-parallel PartitionSpecs (AutoTP analog, reference:

@@ -35,23 +35,32 @@ _PEAK_TFLOPS = {
 _DEFAULT_PEAK = 197.0  # assume v5e when the generation is unknown
 
 
-def peak_tflops(device=None) -> float:
-    """Best-effort bf16 peak TFLOPs for ``device`` (default: device 0)."""
+def tpu_generation(device=None, known=("v6e", "v5p", "v5e", "v4")):
+    """Best-effort TPU generation tag for ``device`` (default: device
+    0): env override ``PALLAS_AXON_TPU_GEN`` first, then device_kind
+    sniffing. Returns one of ``known`` or None — ONE detector shared by
+    the peak-FLOPs and interconnect tables (zero/schedule.py)."""
     import os
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
-    if gen in _PEAK_TFLOPS:
-        return _PEAK_TFLOPS[gen]
+    if gen in known:
+        return gen
     try:
         d = device or jax.devices()[0]
         kind = getattr(d, "device_kind", "").lower()
-        for gen, tf in _PEAK_TFLOPS.items():
+        for gen in known:
             if gen in kind.replace("tpu ", "").replace(" ", ""):
-                return tf
+                return gen
         if "v5 lite" in kind or "v5lite" in kind:
-            return _PEAK_TFLOPS["v5e"]
+            return "v5e"
     except (RuntimeError, IndexError, AttributeError):
-        pass  # no/odd backend: fall through to the default estimate
-    return _DEFAULT_PEAK
+        pass  # no/odd backend: caller falls back to its default
+    return None
+
+
+def peak_tflops(device=None) -> float:
+    """Best-effort bf16 peak TFLOPs for ``device`` (default: device 0)."""
+    gen = tpu_generation(device)
+    return _PEAK_TFLOPS.get(gen, _DEFAULT_PEAK)
 
 
 def cost_analysis_of(compiled) -> Dict[str, float]:
@@ -73,6 +82,58 @@ def cost_analysis_of(compiled) -> Dict[str, float]:
         "bytes_accessed": float(ca.get("bytes accessed",
                                        ca.get("bytes_accessed", 0.0))),
     }
+
+
+# HLO shape like ``bf16[4,64,128]`` (layout suffixes ignored); dtype
+# widths in bytes for the bytes-moved accounting
+_HLO_SHAPE_RE = _re.compile(r"\b([a-z]+\d*(?:e\d+m\d+(?:fn)?)?)\[([0-9,]*)\]")
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s4": 0.5, "u4": 0.5, "s8": 1, "u8": 1,
+    "f8e4m3": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+_COLLECTIVE_RE = _re.compile(
+    r"\b(all-gather|all-reduce|reduce-scatter|all-to-all|"
+    r"collective-permute)(-start|-done)?\(")
+
+
+def _hlo_shape_bytes(dtype: str, dims: str) -> float:
+    width = _HLO_DTYPE_BYTES.get(dtype)
+    if width is None:
+        return 0.0
+    n = 1
+    for d in dims.split(","):
+        if d.strip():
+            n *= int(d)
+    return n * width
+
+
+def collective_stats(hlo_text: str) -> Dict[str, Dict[str, float]]:
+    """Count the collectives in an optimized-HLO text and estimate the
+    bytes each moves: ``{op: {"count": n, "bytes": b}}``.
+
+    Per defining line, bytes = the LARGEST shape on the line — for
+    all-gather that is the gathered result, for reduce-scatter the
+    full operand, for all-reduce either side (equal).  ``-start`` /
+    plain forms count once; ``-done`` lines are skipped (same op).  A
+    ``lax.scan`` / while body appears once in the text, so loop-carried
+    collectives are counted once — same convention as
+    ``cost_analysis_of``.  Feed ``compiled.as_text()``.
+    """
+    out: Dict[str, Dict[str, float]] = {}
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if not m or m.group(2) == "-done":
+            continue
+        op = m.group(1)
+        b = max((_hlo_shape_bytes(d, dims)
+                 for d, dims in _HLO_SHAPE_RE.findall(line)), default=0.0)
+        d = out.setdefault(op, {"count": 0, "bytes": 0.0})
+        d["count"] += 1
+        d["bytes"] += b
+    return out
 
 
 def get_model_profile(fn: Callable, args: tuple = (), kwargs: dict = None,

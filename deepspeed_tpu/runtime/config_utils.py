@@ -71,6 +71,7 @@ class DeepSpeedConfigModel:
                 logger.warning(f"Unknown config key ignored: {cls.__name__}.{key}")
         obj = cls(**kwargs)
         obj._validate()
+        warn_inert_compat_fields(obj)
         return obj
 
     def _validate(self):
@@ -90,6 +91,45 @@ class DeepSpeedConfigModel:
     def __repr__(self):
         body = ", ".join(f"{k}={v!r}" for k, v in self.to_dict().items())
         return f"{type(self).__name__}({body})"
+
+
+# knob audit: one process-wide warning per (section, field) the first
+# time a [compat]-tagged knob is set away from its default
+_COMPAT_WARNED = set()
+
+
+def warn_inert_compat_fields(obj):
+    """Warn-once knob audit for ``[compat]`` config fields.
+
+    A config section lists its accepted-but-inert fields in a
+    ``COMPAT_FIELDS`` class attribute; any such field set to a
+    non-default value logs exactly ONE warning naming the field, so a
+    reference config ported from the CUDA stack says out loud which of
+    its tuning knobs do nothing here (instead of silently "working").
+    """
+    compat = getattr(type(obj), "COMPAT_FIELDS", None)
+    if not compat:
+        return
+    for f in dataclasses.fields(obj):
+        if f.name not in compat:
+            continue
+        if f.default is not dataclasses.MISSING:
+            default = f.default
+        elif f.default_factory is not dataclasses.MISSING:
+            default = f.default_factory()
+        else:
+            continue
+        value = getattr(obj, f.name)
+        if value == default:
+            continue
+        key = (type(obj).__name__, f.name)
+        if key in _COMPAT_WARNED:
+            continue
+        _COMPAT_WARNED.add(key)
+        logger.warning(
+            f"{type(obj).__name__}.{f.name}={value!r} is parsed but "
+            f"inert on TPU (accepted for reference-config "
+            f"compatibility; XLA's SPMD partitioner owns this behavior)")
 
 
 def _resolve_submodel(f: dataclasses.Field):

@@ -205,6 +205,33 @@ class DeepSpeedEngine:
             param_persistence_threshold=zc.param_persistence_threshold,
             tensor_rules=tensor_rules)
 
+        # ---- latency-hiding schedule (runtime/zero/schedule.py):
+        # translate the ZeRO overlap knobs into XLA compiler options
+        # (applied per compiled step by _wrap_step) and, when enabled,
+        # the explicit scan-over-layers ZeRO-3 step variant ----
+        from .zero.schedule import build_layer_scan_loss, xla_compiler_options
+        self._scheduled_steps = {}   # label -> newest ScheduledStep
+        self._step_options = xla_compiler_options(zc)
+        self._layer_scan_fn = None
+        if zc.layer_schedule.enabled:
+            spec_fn = getattr(model, "layer_scan_spec", None)
+            if spec_fn is None:
+                raise ValueError(
+                    "zero_optimization.layer_schedule requires a model "
+                    "that exposes layer_scan_spec() (see "
+                    "runtime/zero/schedule.py LayerScanSpec); "
+                    f"{type(model).__name__} does not")
+            mesh_shape = dict(self.mesh.shape)
+            if any(mesh_shape.get(a, 1) > 1 for a in
+                   (TENSOR_AXIS, SEQUENCE_AXIS, PIPE_AXIS, EXPERT_AXIS)):
+                raise ValueError(
+                    "layer_schedule supports batch/fsdp meshes only "
+                    "(the gathered layout of a model-parallel leaf is "
+                    "not plain-replicated); got "
+                    f"{dict(zip(self.mesh.axis_names, self.mesh.devices.shape))}")
+            self._layer_scan_fn = build_layer_scan_loss(
+                spec_fn(), mesh=self.mesh, zero_cfg=zc)
+
         # ZeRO-Offload (reference: stage_1_and_2.py cpu_offload path;
         # partial ratio = ZeRO-Offload++ engine.py:725)
         self._offload = None
@@ -376,6 +403,10 @@ class DeepSpeedEngine:
         """Call the model; the model returns the scalar loss (optionally
         (loss, aux)) — same contract as the reference where the wrapped
         module's forward returns loss (engine.py:1886)."""
+        if self._layer_scan_fn is not None:
+            # scan-over-layers variant (zero/schedule.py): same math,
+            # explicit per-layer gathers with the prefetch ring
+            return self._layer_scan_fn(compute_params, batch, rng)
         if self._is_flax:
             kwargs = {}
             if rng is not None:
@@ -787,21 +818,37 @@ class DeepSpeedEngine:
         new_gas = train_batch_size // (micro * self.dp_world_size)
         if new_gas != self._config.gradient_accumulation_steps:
             self._config.gradient_accumulation_steps = new_gas
-            self._jit_train_step = None
+            # ALL compiled steps reset together: resetting only the
+            # train step left gas-keyed siblings (and their cached
+            # executables) alive for the old accumulation count
+            self._reset_compiled_steps()
         self._config.train_batch_size = train_batch_size
         self._invalidate_batch_shape_caches()
         self._rebuild_dataloader()
 
     def set_train_micro_batch_size(self, micro_batch_size):
         """Adjust the micro batch, keeping gas fixed (reference:
-        engine.py:441). Batch shapes change, so the jitted step
-        recompiles on next use (shape-keyed by jax)."""
+        engine.py:441). Batch shapes change, so every step is rebuilt
+        (old-shape executables would otherwise pile up in the step
+        cache)."""
         gas = self._config.gradient_accumulation_steps
         self._config.train_micro_batch_size_per_gpu = micro_batch_size
         self._config.train_batch_size = \
             micro_batch_size * gas * self.dp_world_size
+        self._reset_compiled_steps()
         self._invalidate_batch_shape_caches()
         self._rebuild_dataloader()
+
+    def _reset_compiled_steps(self):
+        """Drop every compiled step program (train/eval/grad/apply);
+        each rebuilds lazily on next use with the current config. The
+        schedule-report registry clears too — a report for a discarded
+        executable would describe the OLD gas/shape configuration."""
+        self._jit_train_step = None
+        self._jit_eval_step = None
+        self._jit_grad_step = None
+        self._jit_apply_grads = None
+        self._scheduled_steps.clear()
 
     def _invalidate_batch_shape_caches(self):
         """Profiling lowerings are keyed on the old batch shapes; a
@@ -908,6 +955,29 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # the compiled train step
     # ------------------------------------------------------------------
+    def _wrap_step(self, jitted, label, static_argnums=()):
+        """Route a jitted step through the compiled-step cache
+        (zero/schedule.py ScheduledStep): per-signature AOT compiles
+        carrying the translator's XLA options, with a cache key that
+        folds in the gas count so accumulation changes invalidate
+        exactly the steps they affect."""
+        from .zero.schedule import ScheduledStep
+        step = ScheduledStep(
+            jitted, options=self._step_options, label=label,
+            static_argnums=static_argnums,
+            key_extras=(self.gradient_accumulation_steps(),))
+        self._scheduled_steps[label] = step
+        return step
+
+    def get_schedule_report(self, step="train_step"):
+        """Schedule report of the newest compiled ``step`` program:
+        collective count, bytes moved, and the modeled comm/compute
+        overlap estimate (zero/schedule.py schedule_report; computed
+        lazily from the compiled HLO). Empty dict until that step has
+        compiled (or when the AOT path fell back)."""
+        s = self._scheduled_steps.get(step)
+        return dict(s.schedule_report()) if s is not None else {}
+
     def _onebit_mesh_info(self):
         """(batch_axes, world) + the error-buffer spec rule — ONE source
         for the layout shared by _setup_state's shardings and the onebit
@@ -1182,8 +1252,10 @@ class DeepSpeedEngine:
                        "loss_scale": state.loss_scale.loss_scale}
             return new_state, metrics, (), ()
 
-        self._jit_train_step = jax.jit(train_step, donate_argnums=(0,),
-                                       static_argnums=(3, 4))
+        self._jit_train_step = self._wrap_step(
+            jax.jit(train_step, donate_argnums=(0,),
+                    static_argnums=(3, 4)),
+            "train_step", static_argnums=(3, 4))
 
     def _compile_train_step(self):
         if getattr(self, "_onebit_cfg", None) is not None:
@@ -1511,8 +1583,10 @@ class DeepSpeedEngine:
         # buffers are rewritten every step and the caller replaces its
         # handle with the returned tuple
         donate = (0, 5) if off_bits == 4 else (0,)
-        self._jit_train_step = jax.jit(train_step, donate_argnums=donate,
-                                       static_argnums=(3, 4))
+        self._jit_train_step = self._wrap_step(
+            jax.jit(train_step, donate_argnums=donate,
+                    static_argnums=(3, 4)),
+            "train_step", static_argnums=(3, 4))
 
     def _build_compression_transform(self):
         """(lp_params, bits_tuple, prune_on) -> lp_params. Maps each
@@ -1673,7 +1747,9 @@ class DeepSpeedEngine:
             loss, aux = loss_fn(lp, batch, None)
             return loss, aux
 
-        self._jit_eval_step = jax.jit(eval_step, static_argnums=(2, 3))
+        self._jit_eval_step = self._wrap_step(
+            jax.jit(eval_step, static_argnums=(2, 3)),
+            "eval_step", static_argnums=(2, 3))
 
     # ------------------------------------------------------------------
     # public training API (reference parity)
@@ -1997,7 +2073,8 @@ class DeepSpeedEngine:
             grads = jax.lax.with_sharding_constraint(grads, opt_sh)
             return (loss / scale if fp16 else loss), grads
 
-        self._jit_grad_step = jax.jit(grad_step)
+        self._jit_grad_step = self._wrap_step(jax.jit(grad_step),
+                                              "grad_step")
 
     def _compile_apply_grads(self):
         fp16 = self.fp16_enabled
@@ -2047,7 +2124,8 @@ class DeepSpeedEngine:
                                "loss_scale": new_ls.loss_scale,
                                "loss": jnp.float32(0.0)}
 
-        self._jit_apply_grads = jax.jit(apply_grads, donate_argnums=(0,))
+        self._jit_apply_grads = self._wrap_step(
+            jax.jit(apply_grads, donate_argnums=(0,)), "apply_grads")
 
     # ------------------------------------------------------------------
     # params access / checkpoint

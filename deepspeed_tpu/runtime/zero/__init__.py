@@ -1,5 +1,11 @@
-from .config import (DeepSpeedZeroConfig, DeepSpeedZeroOffloadOptimizerConfig,  # noqa: F401
+from .config import (DeepSpeedZeroConfig,  # noqa: F401
+                     DeepSpeedZeroLayerScheduleConfig,
+                     DeepSpeedZeroOffloadOptimizerConfig,
                      DeepSpeedZeroOffloadParamConfig, OffloadDeviceEnum)
 from .partition import (ZeroShardingRules, zero_param_sharding,  # noqa: F401
                         zero_grad_sharding, zero_opt_sharding)
 from .offload import OffloadCoordinator, select_offload_mask  # noqa: F401
+from .schedule import (LayerScanSpec, ScheduledStep,  # noqa: F401
+                       build_layer_scan_loss, compile_with_options,
+                       derive_prefetch_depth, schedule_report,
+                       xla_compiler_options)

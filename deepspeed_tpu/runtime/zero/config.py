@@ -12,9 +12,15 @@ Stage semantics on TPU (sharding over the combined data/fsdp axes):
   (runtime/zero/partitioned_param_coordinator.py), and the
   scheduler overlaps them with compute (= "overlap_comm" + prefetch).
 
-Bucket sizes / hooks / IPG knobs from the reference are accepted for
-config compatibility but are no-ops under XLA (it fuses and schedules
-collectives itself); they are marked [compat] below.
+Scheduling knobs (``reduce_bucket_size``, ``prefetch_bucket_size``,
+``overlap_comm``, ``max_live_parameters``) are REAL on TPU: the
+latency-hiding layer (runtime/zero/schedule.py) translates them into
+XLA compiler options (collective combiner thresholds, latency-hiding
+scheduler, async collectives) and the layer-scan step's prefetch
+window.  Knobs that remain hook-specific to the reference's eager
+runtime are accepted for config compatibility but inert; they are
+marked [compat] below and audited by ``COMPAT_FIELDS`` (a warn-once
+fires when one is set away from its default).
 """
 
 import dataclasses
@@ -38,6 +44,9 @@ class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
     buffer_size: int = 100_000_000  # [compat]
     max_in_cpu: int = 1_000_000_000  # [compat]
     pin_memory: bool = False
+
+    COMPAT_FIELDS = frozenset({"buffer_count", "buffer_size",
+                               "max_in_cpu"})
 
 
 @dataclasses.dataclass
@@ -95,17 +104,48 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     transfer: DeepSpeedZeroOffloadTransferConfig = submodel(
         DeepSpeedZeroOffloadTransferConfig)
 
+    COMPAT_FIELDS = frozenset({"buffer_count", "pipeline_read",
+                               "pipeline_write", "fast_init"})
+
+
+@dataclasses.dataclass
+class DeepSpeedZeroLayerScheduleConfig(DeepSpeedConfigModel):
+    """Explicit scan-over-layers ZeRO-3 step (runtime/zero/schedule.py
+    build_layer_scan_loss): the gas body runs ``lax.scan`` over the
+    layer stack with a software-pipelined prefetch ring, so the
+    all-gather for layer i+prefetch is issued while layer i computes.
+    Needs a model exposing ``layer_scan_spec()``; the decomposition and
+    the prefetch ring are asserted bit-exact in tests (the scan loop
+    transpose itself reassociates backward-reduction fusion at the
+    float32-ulp level — see schedule.py)."""
+    enabled: bool = False
+    # layers gathered ahead of the one computing; -1 derives the window
+    # from max_live_parameters (reference stage3 prefetch semantics)
+    prefetch: int = -1
+    # "auto" = the model's own remat preference; or "none"/"full"/"dots"
+    remat: str = "auto"
+
+    def _validate(self):
+        if self.remat not in ("auto", "none", "full", "dots"):
+            raise ValueError(
+                f"layer_schedule.remat must be auto/none/full/dots, "
+                f"got {self.remat!r}")
+
 
 @dataclasses.dataclass
 class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     stage: int = 0
     contiguous_gradients: bool = True       # [compat]
     reduce_scatter: bool = True
-    reduce_bucket_size: int = 500_000_000   # [compat]
+    # -> XLA all-reduce / reduce-scatter combiner thresholds
+    # (schedule.xla_compiler_options; reference ipg bucket size)
+    reduce_bucket_size: int = 500_000_000
     use_multi_rank_bucket_allreduce: bool = True  # [compat]
     allgather_partitions: bool = True       # [compat]
     allgather_bucket_size: int = 500_000_000  # [compat]
-    overlap_comm: bool = None               # [compat] XLA always overlaps
+    # None = auto (True): latency-hiding scheduler + async collectives
+    # at compile time (schedule.xla_compiler_options); False disables
+    overlap_comm: bool = None
     load_from_fp32_weights: bool = True
     elastic_checkpoint: bool = False
     offload_param: DeepSpeedZeroOffloadParamConfig = submodel(DeepSpeedZeroOffloadParamConfig)
@@ -115,10 +155,13 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     cpu_offload_param: bool = None          # deprecated
     cpu_offload_use_pin_memory: bool = None  # deprecated
     cpu_offload: bool = None                # deprecated
-    prefetch_bucket_size: int = 50_000_000  # [compat]
+    # -> XLA all-gather combiner threshold (schedule.xla_compiler_options)
+    prefetch_bucket_size: int = 50_000_000
     param_persistence_threshold: int = 100_000  # small params stay replicated
     model_persistence_threshold: int = 2**63 - 1  # [compat]
-    max_live_parameters: int = 1_000_000_000  # remat-block size hint
+    # layer-scan prefetch window: how many layers' params may be live
+    # (gathered) at once (schedule.derive_prefetch_depth)
+    max_live_parameters: int = 1_000_000_000
     max_reuse_distance: int = 1_000_000_000  # [compat]
     gather_16bit_weights_on_model_save: bool = False
     module_granularity_threshold: int = 0   # [compat]
@@ -138,6 +181,24 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     memory_efficient_linear: bool = True    # [compat]
     pipeline_loading_checkpoint: bool = False
     override_module_apply: bool = True      # [compat]
+    # translate the scheduling knobs above into XLA compiler options at
+    # step-compile time (schedule.xla_compiler_options); False = stock
+    # XLA defaults (the pre-schedule behavior, kept as an A/B lever)
+    xla_scheduling: bool = True
+    # explicit scan-over-layers step variant (default off)
+    layer_schedule: DeepSpeedZeroLayerScheduleConfig = submodel(
+        DeepSpeedZeroLayerScheduleConfig)
+
+    # accepted-but-inert knobs audited by config_utils
+    # warn_inert_compat_fields (the [compat] tags above)
+    COMPAT_FIELDS = frozenset({
+        "contiguous_gradients", "use_multi_rank_bucket_allreduce",
+        "allgather_partitions", "allgather_bucket_size",
+        "sub_group_size", "model_persistence_threshold",
+        "max_reuse_distance", "module_granularity_threshold",
+        "use_all_reduce_for_fetch_params", "round_robin_gradients",
+        "memory_efficient_linear", "override_module_apply",
+    })
 
     DEPRECATED = {
         "cpu_offload": "offload_optimizer",
@@ -161,6 +222,10 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
         if isinstance(self.offload_param, dict):
             self.offload_param = DeepSpeedZeroOffloadParamConfig.from_dict(
                 self.offload_param)
+        if isinstance(self.layer_schedule, dict):
+            self.layer_schedule = \
+                DeepSpeedZeroLayerScheduleConfig.from_dict(
+                    self.layer_schedule)
 
     @property
     def offload_optimizer_device(self):

@@ -5,8 +5,12 @@ collective calls (runtime/zero/stage_1_and_2.py:96, stage3.py:75,
 partition_parameters.py:299).  On TPU the same *placement semantics* are
 expressed as sharding rules over the mesh's fsdp axis; the XLA SPMD
 partitioner then inserts exactly the reduce-scatter / all-gather pattern
-ZeRO executes by hand, and overlaps them with compute (the reference's
-``overlap_comm`` + prefetch machinery).
+ZeRO executes by hand.  The *scheduling* of those collectives (overlap
+with compute, combiner bucketing, prefetch distance) is steered
+explicitly by the latency-hiding layer in ``schedule.py`` — the
+reference's ``overlap_comm`` / bucket-size / prefetch machinery mapped
+onto XLA compiler options and the scan-over-layers step variant, not
+left to scheduler defaults.
 
 Hybrid sharding falls out of the mesh shape: with both ``data`` and
 ``fsdp`` axes > 1, states shard over fsdp and replicate over data — the

@@ -92,3 +92,37 @@ def test_set_train_micro_batch_size_keeps_gas(rng, eight_devices):
     assert engine.train_batch_size() == 32      # 2 * 2 * 8
     loss = float(engine.train_batch(batch=_batch(rng, 32)))
     assert np.isfinite(loss)
+
+
+def test_gas_change_resets_all_compiled_steps(rng, eight_devices):
+    """A gas change must reset EVERY compiled step together — the old
+    behavior reset only _jit_train_step, leaving the gas-keyed siblings
+    (and their cached executables) compiled for the old accumulation
+    count (ISSUE 3 satellite)."""
+    engine = _engine()
+    float(engine.train_batch(batch=_batch(rng, 16)))
+    engine.eval_batch(batch=_batch(rng, 8))
+    assert engine._jit_train_step is not None
+    assert engine._jit_eval_step is not None
+
+    engine.set_train_batch_size(32)
+    assert engine._jit_train_step is None
+    assert engine._jit_eval_step is None
+    assert engine._jit_grad_step is None
+    assert engine._jit_apply_grads is None
+
+    # everything rebuilds lazily and trains at the new depth
+    loss = float(engine.train_batch(batch=_batch(rng, 32)))
+    assert np.isfinite(loss)
+
+
+def test_micro_change_resets_compiled_steps(rng, eight_devices):
+    # no training here — the reset + batch math is the contract; the
+    # recompile-and-train path is covered by the gas-change test above
+    engine = _engine()
+    engine._jit_train_step = object()       # stand-in compiled step
+    engine._jit_eval_step = object()
+    engine.set_train_micro_batch_size(2)
+    assert engine._jit_train_step is None
+    assert engine._jit_eval_step is None
+    assert engine.train_batch_size() == 32

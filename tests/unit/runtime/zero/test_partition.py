@@ -113,3 +113,65 @@ class TestShardedAtBirthInit:
                                       ids)
         assert all(isinstance(l, jax.ShapeDtypeStruct)
                    for l in jax.tree_util.tree_leaves(abstract))
+
+
+class TestPersistenceThresholdSpecs:
+    """param_persistence_threshold boundary semantics, asserted on the
+    emitted PartitionSpecs directly (ISSUE 3 satellite): strictly-below
+    stays replicated, at/above shards; hybrid data+fsdp meshes carry
+    states over fsdp only."""
+
+    def test_threshold_boundaries(self, mesh):
+        from deepspeed_tpu.runtime.zero.partition import ZeroShardingRules
+        import jax.numpy as jnp
+        r3 = ZeroShardingRules(mesh=mesh, stage=3,
+                               param_persistence_threshold=4096)
+        below = jnp.zeros((32, 64))     # 2048 < 4096 -> persists
+        at = jnp.zeros((64, 64))        # 4096 == threshold -> sharded
+        above = jnp.zeros((128, 64))    # 8192 > threshold -> sharded
+        assert r3.param_spec("below", below) == P()
+        assert r3.param_spec("at", at) == P("fsdp", None)
+        assert r3.param_spec("above", above) == P("fsdp", None)
+        # persistence gates PARAM placement only: grads/opt states of a
+        # persistent leaf still shard (they are consumed sharded);
+        # the largest dim (64) carries the axis
+        assert r3.grad_spec("below", below) == P(None, "fsdp")
+        assert r3.opt_spec("below", below) == P(None, "fsdp")
+
+    def test_hybrid_data_fsdp_mesh(self, eight_devices):
+        """data=2 x fsdp=4: states shard over fsdp ONLY (replicated
+        across data — the MiCS / hpZ hybrid semantics); divisibility is
+        judged against the fsdp axis size, not the device count."""
+        import jax.numpy as jnp
+        from deepspeed_tpu.runtime.zero.partition import ZeroShardingRules
+        mesh = mesh_manager.init(MeshConfig(data=2, fsdp=4))
+        r3 = ZeroShardingRules(mesh=mesh, stage=3,
+                               param_persistence_threshold=0)
+        # 12 divides by 4 but not 8: only the fsdp axis size matters
+        assert r3.param_spec("w", jnp.zeros((12, 6))) == P("fsdp", None)
+        # largest divisible dim wins; dim 0 indivisible -> dim 1
+        assert r3.param_spec("w2", jnp.zeros((6, 12))) == P(None, "fsdp")
+        # nothing divisible -> replicated, never padded (spec may be
+        # spelled P() or P(None, None); both mean fully replicated)
+        assert all(ax is None
+                   for ax in tuple(r3.param_spec("w3", jnp.zeros((6, 6)))))
+        # 1-d states shard over fsdp alone; DATA_AXIS never appears
+        assert r3.opt_spec("b", jnp.zeros((8,))) == P("fsdp")
+        for spec in (r3.param_spec("w", jnp.zeros((12, 6))),
+                     r3.grad_spec("w", jnp.zeros((12, 6))),
+                     r3.opt_spec("w", jnp.zeros((12, 6)))):
+            assert "data" not in tuple(spec)
+
+    def test_hybrid_mesh_with_tensor_base_spec(self, eight_devices):
+        """A tensor-parallel base spec keeps its axis; fsdp lands on
+        the largest UNSHARDED divisible dim."""
+        import jax.numpy as jnp
+        from deepspeed_tpu.runtime.zero.partition import ZeroShardingRules
+        mesh = mesh_manager.init(MeshConfig(data=2, fsdp=2, tensor=2))
+        rules = ZeroShardingRules(
+            mesh=mesh, stage=3, param_persistence_threshold=0,
+            tensor_rules=lambda name, shape: P(None, "tensor")
+            if name.endswith("kernel") else None)
+        assert rules.param_spec("q.kernel", jnp.zeros((8, 8))) == \
+            P("fsdp", "tensor")
+        assert rules.param_spec("bias", jnp.zeros((8,))) == P("fsdp")
