@@ -246,9 +246,16 @@ def bench_config4():
 def bench_config5(weight_dtype="bfloat16"):
     """TP inference TTFT + decode throughput (BASELINE config 5 shape:
     7B-class TP inference, p50 TTFT). Auto-scaled: Llama-7B geometry at
-    reduced depth on one chip, the v1 cached-decode engine (prefill once
-    + scanned decode). ``weight_dtype="int8"`` benches the WOQ serving
-    path (packed weights in HBM, dequant fused into the matmuls)."""
+    reduced depth on one chip. TTFT is the v1 cached-prefill number
+    (unchanged methodology, comparable to earlier recordings); decode
+    throughput is the v2 ragged engine's ASYNC LOOKAHEAD serving loop —
+    on-device sampling, device-to-device token chaining, zero blocking
+    host syncs per decode step — measured over the steady-state window
+    the serving metrics layer derives (decode-only steps after the last
+    recompile, pinned by the recompile counter), which removes the
+    compile/warmup steps that made the r05 recording swing ~7x
+    run-to-run. ``weight_dtype="int8"`` benches the WOQ serving path
+    (packed weights in HBM, dequant fused into the matmuls)."""
     import dataclasses
 
     import jax
@@ -299,19 +306,34 @@ def bench_config5(weight_dtype="bfloat16"):
         _ = np.asarray(first)   # hard barrier
         ttfts.append(time.time() - t0)
     p50_ttft = sorted(ttfts)[len(ttfts) // 2]
+    # release the v1 decode machinery (cache ~600 MB + executables)
+    # before the ragged engine allocates its pools on the same chip
+    del prefill, cache, first
+    engine._decode_fns.clear()
+    import gc
+    gc.collect()
 
-    # decode throughput: full generate, amortized; median-of-3 after a
-    # compile + settle warmup (one slow outlier must not own the row)
-    for _ in range(2):
-        engine.generate(prompt, max_new_tokens=new)
-    decode_times = []
-    for _ in range(3):
-        t0 = time.time()
-        out = engine.generate(prompt, max_new_tokens=new)
-        assert out.shape[1] == T0 + new
-        decode_times.append(time.time() - t0)
-    dt = sorted(decode_times)[len(decode_times) // 2]
-    decode_tps = B * new / dt
+    # decode throughput: the v2 ragged engine's lookahead serving loop
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    blocks_per_seq = -(-(T0 + new) // 128)
+    v2 = InferenceEngineV2(
+        params, cfg,
+        RaggedInferenceEngineConfig(
+            token_budget=T0, max_ragged_sequence_count=B,
+            max_tracked_sequences=4 * B,
+            n_kv_blocks=B * blocks_per_seq + B,   # one-block slack
+            kv_block_size=128, max_blocks_per_seq=blocks_per_seq,
+            kv_dtype="bfloat16", weight_dtype=weight_dtype))
+    prompts = {uid: prompt[uid % B] for uid in range(B)}
+    # warmup run compiles the (single) fused sampled-forward executable
+    v2.generate_batch({100 + i: prompt[i][:64] for i in range(B)},
+                      max_new_tokens=4, mode="lookahead")
+    out = v2.generate_batch(dict(prompts), max_new_tokens=new,
+                            mode="lookahead")
+    assert all(len(v) == new for v in out.values())
+    rep = v2.get_serving_report()
+    decode_tps = rep["steady_decode_tps"]
 
     # reference point: FastGen's headline p50 TTFT target band is ~1s
     # class for 7B prompts (blogs/deepspeed-fastgen); vs_baseline here
@@ -320,9 +342,28 @@ def bench_config5(weight_dtype="bfloat16"):
     return {
         "metric": f"llama7b_shape_tp_inference_p50_ttft_ms{suffix}",
         "value": round(p50_ttft * 1e3, 1),
-        "unit": f"ms (decode {decode_tps:,.0f} tok/s)",
+        "unit": f"ms (decode {decode_tps:,.0f} tok/s, lookahead)",
         "vs_baseline": round(decode_tps / 1000.0, 4),
         "variance": round((max(ttfts) - min(ttfts)) / p50_ttft, 4),
+        # the serving metrics layer's decomposition: where a decode
+        # step's time goes, and proof the loop is async (steady
+        # blocking syncs must read 0)
+        "decomposition": {
+            "steady_decode_tps": round(decode_tps, 1),
+            "steady_steps": rep["steady_steps"],
+            "steady_blocking_syncs": rep["steady_blocking_syncs"],
+            "recompiles": rep["recompiles"],
+            "cancelled_speculative_steps":
+                rep["cancelled_speculative_steps"],
+            "dispatch_ms_p50": round(
+                rep["dispatch_ms"].get("p50", 0.0), 3),
+            "sync_wait_ms_p50": round(
+                rep["sync_wait_ms"].get("p50", 0.0), 3),
+            "step_ms_p50": round(rep["step_ms"].get("p50", 0.0), 3),
+            "itl_ms_p50": round(rep["itl_ms"].get("p50", 0.0), 3),
+            "ttft_ms_p50": round(rep["ttft_ms"].get("p50", 0.0), 1),
+            "kv_util_max": round(rep["kv_util"].get("max", 0.0), 4),
+        },
     }
 
 

@@ -3,19 +3,88 @@ sampling lives in DeepSpeed-MII, not deepspeed itself —
 SURVEY.md §2.7 "Sampling/serving"; shipped here so both engines are
 usable end-to-end without an external serving layer).
 
-Two shapes of the same math:
+ONE filtering implementation, three consumers (the top-k/top-p math
+used to exist twice — a jnp copy in ``make_sampler`` and a numpy copy
+in ``sample_token`` — and the v2 on-device sampler would have made a
+third):
 
-* ``make_sampler`` — a jit-traceable sampler for the v1 engine's
-  compiled decode loop (temperature / top-k; greedy at temperature 0).
-* ``sample_token`` — a host-side numpy sampler for the v2 ragged
-  engine's continuous-batching loop, adding nucleus (top-p) filtering;
-  per-row, one token at a time (the loop is host-driven by design —
-  scheduling is host-side bookkeeping, see inference/v2/engine_v2.py).
+* ``filter_logits`` — the shared top-k / nucleus mask. Parametrized by
+  the array namespace (``numpy`` or ``jax.numpy``) and accepting static
+  python values OR per-row arrays for k/p, so the same code serves the
+  jit path, the host path, and the fused per-sequence device sampler.
+* ``make_sampler`` — jit-traceable batch sampler for the v1 engine's
+  compiled decode loop (static knobs; greedy at temperature 0).
+* ``sample_token`` — host-side numpy sampler (per-row, one token at a
+  time) for callers driving ``put()`` logits themselves.
+* ``ragged_sample`` — the v2 engine's fused on-device sampler:
+  per-sequence temperature/top-k/top-p arrays and PRNG keys threaded
+  per (uid, position), so a token's draw is reproducible regardless of
+  how the serving loop batched it.
 """
 
 from typing import Optional
 
 import numpy as np
+
+
+def _per_row(val, B, dtype, xp):
+    """Static scalar or [B] array -> [B] array of ``dtype``."""
+    arr = xp.reshape(xp.asarray(val), (-1,)).astype(dtype)
+    return xp.broadcast_to(arr, (B,))
+
+
+def filter_logits(logits, top_k=None, top_p=None, xp=np):
+    """Top-k then top-p masking over ``[B, V]`` logits; filtered entries
+    become -inf. The single source of the selection math for every
+    sampler in the framework.
+
+    ``top_k``/``top_p`` may be static python values (jit path / host
+    path) or per-row arrays (the fused ragged sampler). Array semantics
+    for "off": ``top_k < 1`` and ``top_p >= 1.0`` disable the filter
+    for that row. Ties at the k-th value are kept (strict ``<`` mask),
+    and the top-1 token always survives top-p.
+    """
+    if top_k is None and top_p is None:
+        return logits
+    B, V = logits.shape
+    neg = xp.asarray(-xp.inf, logits.dtype)
+    if xp is np and top_p is None and np.isscalar(top_k):
+        # host fast path (sample_token's per-token call): O(V)
+        # selection instead of a full sort — picks the SAME kth value,
+        # so the mask is bitwise-identical to the sorted path
+        if top_k < 1:
+            return logits      # same "off" semantics as the array path
+        k = int(min(top_k, V))
+        kth = np.partition(logits, V - k, axis=-1)[:, V - k:V - k + 1]
+        return np.where(logits < kth, neg, logits)
+    # ONE descending sort serves both filters: top-k's survivors are a
+    # prefix of it (ties at the k-th value included), so the top-p pass
+    # masks the sorted array in place instead of re-sorting
+    srt = xp.flip(xp.sort(logits, axis=-1), axis=-1)
+    if top_k is not None:
+        karr = _per_row(top_k, B, xp.int32, xp)
+        k = xp.clip(karr, 1, V)
+        kth = xp.take_along_axis(srt, (k - 1)[:, None], axis=-1)
+        kth = xp.where((karr >= 1)[:, None], kth, neg)
+        logits = xp.where(logits < kth, neg, logits)
+        srt = xp.where(srt < kth, neg, srt)
+    if top_p is not None:
+        parr = _per_row(top_p, B, logits.dtype, xp)
+        e = xp.exp(srt - srt[:, :1])
+        probs = e / xp.sum(e, axis=-1, keepdims=True)
+        cum = xp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with mass >= top_p; the top token is
+        # forced in EXPLICITLY so the guarantee survives top_p <= 0
+        # (sample_token/make_sampler are public API with no validation)
+        keep = (cum - probs) < parr[:, None]
+        keep = xp.concatenate(
+            [xp.ones((B, 1), dtype=bool), keep[:, 1:]], axis=-1)
+        cutoff = xp.min(xp.where(keep, srt,
+                                 xp.asarray(xp.inf, logits.dtype)),
+                        axis=-1, keepdims=True)
+        cutoff = xp.where((parr < 1.0)[:, None], cutoff, neg)
+        logits = xp.where(logits < cutoff, neg, logits)
+    return logits
 
 
 def make_sampler(temperature: float, top_k: Optional[int] = None,
@@ -28,21 +97,10 @@ def make_sampler(temperature: float, top_k: Optional[int] = None,
         logits = logits.astype(jnp.float32)
         if temperature and temperature > 0:
             logits = logits / temperature
-            if top_k:
-                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-                logits = jnp.where(logits < kth,
-                                   jnp.finfo(logits.dtype).min, logits)
-            if top_p is not None and top_p < 1.0:
-                sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-                probs = jax.nn.softmax(sorted_logits, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                # keep the smallest prefix with mass >= top_p (the
-                # first token is always kept)
-                keep = jnp.roll(cum < top_p, 1, axis=-1).at[:, 0].set(True)
-                cutoff = jnp.min(jnp.where(
-                    keep, sorted_logits, jnp.inf), axis=-1)[:, None]
-                logits = jnp.where(logits < cutoff,
-                                   jnp.finfo(logits.dtype).min, logits)
+            logits = filter_logits(
+                logits, top_k if top_k else None,
+                top_p if (top_p is not None and top_p < 1.0) else None,
+                xp=jnp)
             return jax.random.categorical(rng, logits, axis=-1)
         return jnp.argmax(logits, axis=-1)
 
@@ -53,28 +111,52 @@ def sample_token(logits: np.ndarray, rng: np.random.Generator,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None) -> int:
     """Sample one token id from a single row of logits (host-side)."""
-    logits = np.asarray(logits, np.float32).reshape(-1)
+    logits = np.asarray(logits, np.float32).reshape(1, -1)
     if not temperature or temperature <= 0:
         return int(np.argmax(logits))
-    logits = logits / temperature
-    if top_k:
-        top_k = min(top_k, len(logits))   # jit path clamps identically
-        kth = np.partition(logits, -top_k)[-top_k]
-        logits = np.where(logits < kth, -np.inf, logits)
-    if top_p is not None and top_p < 1.0:
-        order = np.argsort(logits)[::-1]
-        sorted_logits = logits[order]
-        shifted = sorted_logits - sorted_logits[0]
-        probs = np.exp(shifted) / np.exp(shifted).sum()
-        cum = np.cumsum(probs)
-        keep = np.roll(cum < top_p, 1)
-        keep[0] = True                      # never drop the top token
-        cutoff = sorted_logits[keep].min()
-        logits = np.where(logits < cutoff, -np.inf, logits)
+    logits = logits / np.float32(temperature)
+    logits = filter_logits(
+        logits, top_k if top_k else None,
+        top_p if (top_p is not None and top_p < 1.0) else None,
+        xp=np)[0]
     shifted = logits - logits.max()
     probs = np.exp(shifted)
     probs = probs / probs.sum()
     return int(rng.choice(len(probs), p=probs))
+
+
+def ragged_sample(logits, temperature, top_k, top_p, uids, positions,
+                  base_key):
+    """Fused on-device sampler for the v2 ragged engine ([S, V] logits,
+    per-sequence knobs). jit-traceable with TRACED per-row arrays —
+    changing temperatures/k/p never recompiles the serving step.
+
+    Per-row PRNG keys are threaded as ``fold_in(fold_in(base, uid),
+    position)``: a given (seed, uid, position) always draws the same
+    token, so the sync and lookahead serving loops — and any batch
+    composition — produce identical sampled streams.
+
+    ``temperature <= 0`` rows are greedy (argmax, filters ignored),
+    matching ``sample_token``; ``top_k < 1`` / ``top_p >= 1`` disable
+    those filters per row.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temp = temperature.astype(jnp.float32)
+    scaled = logits / jnp.where(temp > 0, temp, 1.0)[:, None]
+    filtered = filter_logits(scaled, top_k=top_k, top_p=top_p, xp=jnp)
+
+    def row_key(u, p):
+        return jax.random.fold_in(jax.random.fold_in(base_key, u), p)
+
+    keys = jax.vmap(row_key)(uids.astype(jnp.uint32),
+                             positions.astype(jnp.uint32))
+    sampled = jax.vmap(lambda k, row: jax.random.categorical(k, row))(
+        keys, filtered).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)
 
 
 class SamplingParams:

@@ -1,4 +1,5 @@
 from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+from .metrics import ServingMetrics
 from .ragged_manager import (BlockedKVCacheManager, DSStateManager,
                              SchedulingError, SchedulingResult,
                              SequenceDescriptor)

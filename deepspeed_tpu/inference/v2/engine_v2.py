@@ -19,7 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...utils.logging import logger
-from .model import init_kv_pools, normalize_params, ragged_forward
+from .model import (init_kv_pools, normalize_params, ragged_forward,
+                    ragged_forward_sampled)
 from .ragged_manager import (DSStateManager, SchedulingError,
                              SchedulingResult)
 from .ragged_wrapper import RaggedBatchWrapper
@@ -129,23 +130,41 @@ class InferenceEngineV2:
         if woq_bits is not None and self.linear_impl != "woq_kernel":
             from ..quantization import dequantize_param_tree
 
-            def fwd(tree, pools, *args):
-                return ragged_forward(
-                    dequantize_param_tree(tree, jnp.bfloat16), spec,
-                    pools, *args, block_size=ec.kv_block_size,
-                    tp_axis=tp_axis, ep_axis=ep_axis,
-                    attn_kwargs=attn_kwargs)
+            def prep(tree):
+                return dequantize_param_tree(tree, jnp.bfloat16)
         else:
             # dense tree, or linear_impl == "woq_kernel": the forward's
             # _linear consumes WOQ leaves through the fused Pallas
             # matmul (decode reads quantized HBM); MoE banks dequantize
             # inline at their ragged_dot
-            def fwd(tree, pools, *args):
-                return ragged_forward(
-                    tree, spec, pools, *args,
-                    block_size=ec.kv_block_size, tp_axis=tp_axis,
-                    ep_axis=ep_axis, attn_kwargs=attn_kwargs)
+            def prep(tree):
+                return tree
+
+        fwd_kw = dict(block_size=ec.kv_block_size, tp_axis=tp_axis,
+                      ep_axis=ep_axis, attn_kwargs=attn_kwargs)
+
+        def fwd(tree, pools, *args):
+            return ragged_forward(prep(tree), spec, pools, *args,
+                                  **fwd_kw)
+
+        # sampler fused into the logits tail (ragged_forward_sampled):
+        # put_sampled() returns token ids as a DEVICE array, so the
+        # serving loops never pay a per-step [S, vocab] host transfer
+        def fwd_sampled(tree, pools, *args):
+            return ragged_forward_sampled(prep(tree), spec, pools,
+                                          *args, **fwd_kw)
+
         self._jit_forward = jax.jit(fwd, donate_argnums=(1,))
+        self._jit_forward_sampled = jax.jit(fwd_sampled,
+                                            donate_argnums=(1,))
+        # serving-loop state: FCFS aging for block-starved prompts,
+        # dispatch-signature set (the recompile counter — the jit cache
+        # is keyed the same way: treedef + shapes, both fixed here),
+        # and the last serving run's metrics
+        self._defer_age: Dict[int, int] = {}
+        self._seen_signatures = set()
+        self._last_dispatch_was_compile = False
+        self._serving_metrics = None
 
     def _init_mesh(self, tp: int, ep: int):
         from ...parallel.mesh import (EXPERT_AXIS, MeshConfig,
@@ -324,29 +343,27 @@ class InferenceEngineV2:
             return SchedulingResult.OutOfKVBlocks
         return SchedulingResult.Success
 
-    def put(self, batch_uids: Iterable[int], batch_tokens: Iterable,
-            do_checks: bool = True) -> np.ndarray:
-        """One forward over a ragged batch; returns logits
-        [len(batch_uids), vocab] for each sequence's LAST packed token."""
-        batch_uids = list(batch_uids)
-        batch_tokens = [np.asarray(t, np.int32).reshape(-1)
-                        for t in batch_tokens]
-        if do_checks:
-            res = self.can_schedule(batch_uids,
-                                    [len(t) for t in batch_tokens])
-            if res != SchedulingResult.Success:
-                raise SchedulingError(res)
+    def _stage_batch(self, batch_uids: List[int],
+                     batch_tokens: List[np.ndarray],
+                     do_checks: bool = True):
+        """Transactional host staging shared by ``put``/``put_sampled``.
 
+        Returns ``(rb, committed)``: the finalized RaggedBatch plus
+        per-row ``(uid, n_tokens, blocks_before)`` records — enough to
+        roll a COMMITTED step back after post_forward (the lookahead
+        loop's speculative-EOS cancellation,
+        ``DSStateManager.rollback_tokens``).
+
+        Any failure during insertion/finalize (e.g. OutOfKVBlocks with
+        do_checks=False) rolls back the in_flight counts, newly
+        allocated blocks, and newly created sequence entries, so a
+        failed call cannot poison later scheduling.
+        """
         ec = self._config
         wrapper = RaggedBatchWrapper(
             token_budget=ec.token_budget,
             max_seqs=ec.max_ragged_sequence_count,
             max_blocks_per_seq=ec.max_blocks_per_seq)
-        # Host accounting is transactional: any failure during insertion/
-        # finalize (e.g. OutOfKVBlocks with do_checks=False) rolls back the
-        # in_flight counts, newly allocated blocks, and newly created
-        # sequence entries, so a failed put() cannot poison later
-        # scheduling.
         staged = []  # [seq, n_in_flight, blocks_before, created] — the
         # record is staged BEFORE allocation so a maybe_allocate failure
         # still rolls back the just-created sequence entry.
@@ -374,7 +391,35 @@ class InferenceEngineV2:
                         and seq.in_flight_tokens == 0):
                     self._state_manager.tracked_sequences.pop(seq.uid, None)
             raise
+        return rb, [(seq.uid, n, blocks_before)
+                    for seq, n, blocks_before, _ in staged]
 
+    def _note_dispatch(self, kind: str) -> bool:
+        """Recompile counter: True when this dispatch signature is new
+        (mirrors the jit cache key — treedef + shapes, both fixed by the
+        engine config — so a True return IS an XLA compile). The result
+        is also latched on ``_last_dispatch_was_compile`` for callers
+        whose return value is already spoken for (``put``)."""
+        fresh = kind not in self._seen_signatures
+        self._seen_signatures.add(kind)
+        self._last_dispatch_was_compile = fresh
+        return fresh
+
+    def put(self, batch_uids: Iterable[int], batch_tokens: Iterable,
+            do_checks: bool = True) -> np.ndarray:
+        """One forward over a ragged batch; returns logits
+        [len(batch_uids), vocab] for each sequence's LAST packed token."""
+        batch_uids = list(batch_uids)
+        batch_tokens = [np.asarray(t, np.int32).reshape(-1)
+                        for t in batch_tokens]
+        if do_checks:
+            res = self.can_schedule(batch_uids,
+                                    [len(t) for t in batch_tokens])
+            if res != SchedulingResult.Success:
+                raise SchedulingError(res)
+        rb, _ = self._stage_batch(batch_uids, batch_tokens, do_checks)
+
+        self._note_dispatch("logits")
         logits, self.pools = self._jit_forward(
             self.tree, self.pools, rb.token_ids, rb.token_seq,
             rb.token_pos, rb.token_qidx, rb.seq_lens, rb.q_counts,
@@ -384,7 +429,116 @@ class InferenceEngineV2:
             self._state_manager.get_sequence(uid).post_forward()
         return np.asarray(logits[:len(batch_uids)])
 
+    def _samp_arrays(self, batch_uids: List[int], rb, sampling):
+        """Per-slot sampling arrays for the fused device sampler.
+        ``sampling``: one SamplingParams for the whole batch, or a
+        per-uid dict (missing uids sample greedily)."""
+        from ..sampling import SamplingParams
+        S = self._config.max_ragged_sequence_count
+        temp = np.zeros((S,), np.float32)
+        topk = np.zeros((S,), np.int32)           # 0 = off
+        topp = np.ones((S,), np.float32)          # 1.0 = off
+        uid_arr = np.zeros((S,), np.uint32)
+        default = SamplingParams()
+        for slot, uid in enumerate(batch_uids):
+            sp = (sampling.get(uid, default)
+                  if isinstance(sampling, dict) else sampling)
+            temp[slot] = sp.temperature
+            topk[slot] = sp.top_k or 0
+            topp[slot] = 1.0 if sp.top_p is None else sp.top_p
+            # XOR-fold wide uids into the uint32 the PRNG fold_in
+            # takes, so uids equal mod 2^32 still key distinct streams
+            uid_arr[slot] = (uid ^ (uid >> 32)) & 0xFFFFFFFF
+        # the sampled token's absolute position is exactly seq_lens
+        # (tokens 0..L-1 are cached after this step) — the second half
+        # of the per-(uid, position) PRNG key
+        return {"temperature": temp, "top_k": topk, "top_p": topp,
+                "uid": uid_arr, "pos": rb.seq_lens.astype(np.uint32)}
+
+    def put_sampled(self, batch_uids: Iterable[int],
+                    batch_tokens: Iterable, *,
+                    src_slots: Optional[List[int]] = None,
+                    prev_tokens=None, sampling=None, base_key=None,
+                    do_checks: bool = True):
+        """One forward with the sampler fused on device (the serving
+        loops' hot path — ``ragged_forward_sampled``).
+
+        Returns ``(tokens, committed, recompiled)``: ``tokens`` is the
+        [max_seqs] int32 DEVICE array of sampled ids (slot == row
+        order; NO host sync happens here), ``committed`` the per-row
+        rollback records for speculative-EOS cancellation, and
+        ``recompiled`` whether this dispatch signature triggered an XLA
+        compile.
+
+        ``src_slots[i] >= 0`` marks row i's (single) token as
+        device-fed: the jit gathers its value from
+        ``prev_tokens[src_slots[i]]`` — the previous step's on-device
+        output — instead of the host-staged id, so decode steps chain
+        device-to-device. ``sampling=None`` selects the argmax-only
+        (greedy) executable.
+        """
+        batch_uids = list(batch_uids)
+        batch_tokens = [np.asarray(t, np.int32).reshape(-1)
+                        for t in batch_tokens]
+        if do_checks:
+            res = self.can_schedule(batch_uids,
+                                    [len(t) for t in batch_tokens])
+            if res != SchedulingResult.Success:
+                raise SchedulingError(res)
+        if (src_slots is not None and prev_tokens is None
+                and any(s >= 0 for s in src_slots)):
+            # the zeros placeholder would silently feed token id 0
+            # into every device-fed row's KV
+            raise ValueError("src_slots marks device-fed rows but "
+                             "prev_tokens is None")
+        rb, committed = self._stage_batch(batch_uids, batch_tokens,
+                                          do_checks)
+        ec = self._config
+        token_src = np.full((ec.token_budget,), -1, np.int32)
+        if src_slots is not None:
+            cursor = 0
+            for i, toks in enumerate(batch_tokens):
+                if src_slots[i] >= 0:
+                    if len(toks) != 1:
+                        # a multi-token row with one substituted id
+                        # would silently mix device-fed and stale
+                        # host-staged tokens into the KV
+                        raise ValueError(
+                            f"device-fed row {i} must carry exactly "
+                            f"one token, got {len(toks)}")
+                    token_src[cursor] = src_slots[i]
+                cursor += len(toks)
+        if prev_tokens is None:
+            # keep ONE executable across all steps (first step included)
+            prev_tokens = np.zeros((ec.max_ragged_sequence_count,),
+                                   np.int32)
+        samp = None
+        if sampling is not None:
+            samp = self._samp_arrays(batch_uids, rb, sampling)
+            if base_key is None:
+                base_key = jax.random.PRNGKey(0)
+        else:
+            base_key = None
+
+        recompiled = self._note_dispatch(
+            "sampled:greedy" if samp is None else "sampled:samp")
+        tokens, self.pools = self._jit_forward_sampled(
+            self.tree, self.pools, rb.token_ids, token_src, prev_tokens,
+            rb.token_seq, rb.token_pos, rb.token_qidx, rb.seq_lens,
+            rb.q_counts, rb.block_tables, rb.logits_idx, samp, base_key)
+
+        for uid in batch_uids:
+            self._state_manager.get_sequence(uid).post_forward()
+        return tokens, committed, recompiled
+
+    def rollback_step(self, uid: int, n_tokens: int,
+                      blocks_before: int) -> None:
+        """Cancel one committed forward for ``uid`` (host accounting
+        only — see DSStateManager.rollback_tokens)."""
+        self._state_manager.rollback_tokens(uid, n_tokens, blocks_before)
+
     def flush(self, uid: int) -> None:
+        self._defer_age.pop(uid, None)
         self._state_manager.flush_sequence(uid)
 
     # -- Dynamic SplitFuse scheduler + serving loop ---------------------
@@ -399,10 +553,19 @@ class InferenceEngineV2:
                  active_decode: Dict[int, int]
                  ) -> Tuple[List[int], List[np.ndarray]]:
         """Pick this step's work: all decode tokens first, then prompt
-        chunks until the token budget fills (Dynamic SplitFuse). KV-block
-        aware: work that cannot get blocks this step is deferred, not
-        failed — sequences it skips run once others finish and free
-        their blocks."""
+        chunks until the token budget fills (Dynamic SplitFuse).
+        KV-block aware: decode work that cannot get blocks this step is
+        deferred, not failed.
+
+        Prompts are admitted in aged-FCFS order: oldest deferral first,
+        arrival order as the tie-break. When the highest-priority
+        prompt cannot get KV blocks it is AGED and admission stops —
+        younger arrivals may not jump past it, so freed blocks
+        accumulate for the starved prompt instead of being churned
+        through small newcomers forever (the starvation fix: the old
+        skip-and-continue policy could defer a large prompt
+        indefinitely while decode slots recycled its blocks).
+        """
         ec = self._config
         uids, toks = [], []
         budget = ec.token_budget
@@ -419,13 +582,18 @@ class InferenceEngineV2:
             budget -= 1
             slots -= 1
             blocks -= need
-        for uid, prompt in pending.items():
+        order = sorted(
+            enumerate(pending.items()),
+            key=lambda it: (-self._defer_age.get(it[1][0], 0), it[0]))
+        for _, (uid, prompt) in order:
             if budget <= 0 or slots <= 0:
                 break
             chunk = prompt[:budget]
             need = self._blocks_needed(uid, len(chunk))
             if need > blocks:
-                continue
+                self._defer_age[uid] = self._defer_age.get(uid, 0) + 1
+                break  # head-of-line: nobody jumps the starved prompt
+            self._defer_age.pop(uid, None)
             uids.append(uid)
             toks.append(chunk)
             budget -= len(chunk)
@@ -436,45 +604,33 @@ class InferenceEngineV2:
     def generate_batch(self, prompts: Dict[int, Iterable[int]],
                        max_new_tokens: int = 32,
                        eos_token_id: Optional[int] = None,
-                       sampling=None) -> Dict[int, List[int]]:
+                       sampling=None,
+                       mode: str = "lookahead") -> Dict[int, List[int]]:
         """Continuous-batching serving loop (the MII-side loop the
         reference leaves out of deepspeed; here for tests/benchmarks).
-        Greedy by default; pass ``sampling=SamplingParams(...)`` for
-        temperature / top-k / nucleus sampling."""
-        from ..sampling import SamplingParams, sample_token
-        sampling = sampling or SamplingParams()
-        sample_rng = np.random.default_rng(sampling.seed)
-        pending = {uid: np.asarray(p, np.int32).reshape(-1)
-                   for uid, p in prompts.items()}
-        done: Dict[int, List[int]] = {uid: [] for uid in prompts}
-        decode: Dict[int, int] = {}
-        remaining = {uid: max_new_tokens for uid in prompts}
+        Greedy by default; pass ``sampling=SamplingParams(...)`` (or a
+        per-uid dict of them) for temperature / top-k / nucleus
+        sampling.
 
-        while pending or decode:
-            uids, toks = self.schedule(pending, decode)
-            if not uids:
-                # nothing schedulable and nothing in flight that could
-                # free blocks -> genuinely stuck
-                raise SchedulingError(SchedulingResult.OutOfKVBlocks)
-            logits = self.put(uids, toks)
-            for row, (uid, chunk) in enumerate(zip(uids, toks)):
-                if uid in pending:
-                    rest = pending[uid][len(chunk):]
-                    if len(rest):
-                        pending[uid] = rest
-                        continue  # mid-prompt: logits not sampled
-                    del pending[uid]
-                nxt = sample_token(logits[row], sample_rng,
-                                   temperature=sampling.temperature,
-                                   top_k=sampling.top_k,
-                                   top_p=sampling.top_p)
-                done[uid].append(nxt)
-                remaining[uid] -= 1
-                finished = remaining[uid] <= 0 or (
-                    eos_token_id is not None and nxt == eos_token_id)
-                if finished:
-                    decode.pop(uid, None)
-                    self.flush(uid)
-                else:
-                    decode[uid] = nxt
-        return done
+        ``mode``: ``"lookahead"`` (default) is the async loop — step
+        N+1's host work overlaps step N's device compute and sampled
+        tokens chain device-to-device (zero blocking host syncs per
+        decode step in steady state); ``"sync"`` dispatches one step at
+        a time; ``"sync_host"`` additionally samples on the host from
+        ``put()`` logits (the legacy loop). Greedy token streams are
+        bitwise-identical across all three; sampled streams are
+        identical between "lookahead" and "sync" (per-(seed, uid,
+        position) keyed draws). Per-step metrics land in
+        ``get_serving_report()``.
+        """
+        from .serving_loop import run_serving_loop
+        return run_serving_loop(self, prompts,
+                                max_new_tokens=max_new_tokens,
+                                eos_token_id=eos_token_id,
+                                sampling=sampling, mode=mode)
+
+    def get_serving_report(self) -> dict:
+        """Metrics report of the most recent generate_batch run (see
+        inference/v2/metrics.py for the schema); {} before any run."""
+        return (self._serving_metrics.report()
+                if self._serving_metrics is not None else {})

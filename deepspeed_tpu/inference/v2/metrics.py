@@ -1,0 +1,127 @@
+"""Serving metrics for the v2 ragged engine's serving loops.
+
+The decomposition layer bench config 5 publishes: per-step dispatch /
+sync-wait / wall timings, TTFT and inter-token-latency histograms,
+queue depth, KV-pool utilization, a recompile counter, and the
+blocking-host-sync counter that distinguishes the synchronous loop
+(1 blocking sync per decode step) from the lookahead loop (0 in steady
+state — the only sync each iteration waits on a step that overlapped
+the already-dispatched next one).
+
+``report()`` derives the **steady-state decode window**: decode-only
+steps strictly AFTER the last step that triggered an XLA compile
+(pinned by the recompile counter), which is the run-to-run-stable
+region the bench's decode throughput is measured over.
+
+``steady_blocking_syncs`` is an ORDERING INVARIANT indicator, not an
+independent measurement: with the lookahead loop's correct
+dispatch-before-collect structure it is 0 by construction (a blocking
+collect implies no new dispatch, which keeps that step out of the
+decode-only window). Its value is that a regression which restructures
+the loop — collecting a step's tokens before the next dispatch goes
+out — makes the flag fire ON decode steps, so the bench's published 0
+flips nonzero exactly when the async property is lost.
+"""
+
+import time
+from typing import Dict, List, Optional
+
+
+def _stats(xs: List[float], scale: float = 1.0) -> Dict[str, float]:
+    if not xs:
+        return {"count": 0}
+    s = sorted(x * scale for x in xs)
+    n = len(s)
+
+    def pct(q):
+        return s[min(n - 1, int(q * n))]
+
+    return {"count": n, "mean": sum(s) / n, "p50": pct(0.50),
+            "p90": pct(0.90), "p99": pct(0.99), "max": s[-1]}
+
+
+class ServingMetrics:
+
+    def __init__(self, mode: str, n_kv_blocks: int,
+                 clock=time.perf_counter):
+        self.mode = mode
+        self.n_kv_blocks = max(1, n_kv_blocks)
+        self._clock = clock
+        self._t_start = clock()
+        self._steps: List[dict] = []
+        self._ttft_s: List[float] = []
+        self._itl_s: List[float] = []
+        self._last_emit: Dict[int, float] = {}
+        self.cancelled_steps = 0
+
+    def now(self) -> float:
+        return self._clock()
+
+    # -- recording ----------------------------------------------------
+    def record_step(self, *, dispatch_s: float, sync_wait_s: float,
+                    wall_s: float, new_tokens: int, prompt_tokens: int,
+                    n_seqs: int, decode_only: bool, recompiled: bool,
+                    blocking_sync: bool, queue_depth: int,
+                    kv_free: int) -> None:
+        self._steps.append({
+            "dispatch_s": dispatch_s, "sync_wait_s": sync_wait_s,
+            "wall_s": wall_s, "new_tokens": new_tokens,
+            "prompt_tokens": prompt_tokens, "n_seqs": n_seqs,
+            "decode_only": decode_only, "recompiled": recompiled,
+            "blocking_sync": blocking_sync, "queue_depth": queue_depth,
+            "kv_util": 1.0 - kv_free / self.n_kv_blocks,
+        })
+
+    def record_emission(self, uid: int, t: Optional[float] = None,
+                        first: bool = False) -> None:
+        t = self.now() if t is None else t
+        if first:
+            self._ttft_s.append(t - self._t_start)
+        elif uid in self._last_emit:
+            self._itl_s.append(t - self._last_emit[uid])
+        self._last_emit[uid] = t
+
+    def record_cancelled(self, n: int = 1) -> None:
+        self.cancelled_steps += n
+
+    # -- reporting ----------------------------------------------------
+    def _steady_window(self) -> List[dict]:
+        """Decode-only steps after the last compile step."""
+        last_compile = -1
+        for i, s in enumerate(self._steps):
+            if s["recompiled"]:
+                last_compile = i
+        return [s for s in self._steps[last_compile + 1:]
+                if s["decode_only"]]
+
+    def report(self) -> dict:
+        steps = self._steps
+        decode_steps = [s for s in steps if s["decode_only"]]
+        steady = self._steady_window()
+        steady_wall = sum(s["wall_s"] for s in steady)
+        steady_tokens = sum(s["new_tokens"] for s in steady)
+        return {
+            "mode": self.mode,
+            "steps": len(steps),
+            "decode_steps": len(decode_steps),
+            "tokens_emitted": sum(s["new_tokens"] for s in steps),
+            "prompt_tokens": sum(s["prompt_tokens"] for s in steps),
+            "recompiles": sum(1 for s in steps if s["recompiled"]),
+            "blocking_syncs": sum(1 for s in steps
+                                  if s["blocking_sync"]),
+            "steady_steps": len(steady),
+            "steady_blocking_syncs": sum(1 for s in steady
+                                         if s["blocking_sync"]),
+            "steady_decode_tps": (steady_tokens / steady_wall
+                                  if steady_wall > 0 else 0.0),
+            "cancelled_speculative_steps": self.cancelled_steps,
+            "dispatch_ms": _stats([s["dispatch_s"] for s in steps], 1e3),
+            "sync_wait_ms": _stats([s["sync_wait_s"] for s in steps],
+                                   1e3),
+            "step_ms": _stats([s["wall_s"] for s in steps], 1e3),
+            "ttft_ms": _stats(self._ttft_s, 1e3),
+            "itl_ms": _stats(self._itl_s, 1e3),
+            "queue_depth": _stats([float(s["queue_depth"])
+                                   for s in steps]),
+            "kv_util": _stats([s["kv_util"] for s in steps]),
+        }

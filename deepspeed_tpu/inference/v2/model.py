@@ -730,3 +730,46 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
     if tree.get("head_bias") is not None:
         logits = logits + tree["head_bias"]
     return logits.astype(jnp.float32), new_pools
+
+
+def ragged_forward_sampled(tree, spec: RaggedSpec, pools, token_ids,
+                           token_src, prev_tokens, token_seq, token_pos,
+                           token_qidx, seq_lens, q_counts, block_tables,
+                           logits_idx, samp, base_key, block_size: int,
+                           **kw):
+    """Ragged forward with the sampler fused into the logits tail.
+
+    Two additions over ``ragged_forward`` that together remove every
+    per-step host round-trip from the decode hot path:
+
+    * **device-fed tokens** — ``token_src`` ([budget] int32) entries
+      >= 0 replace the host-staged ``token_ids`` value with
+      ``prev_tokens[token_src]``, the previous step's on-device sampled
+      output. The serving loop can therefore dispatch step N+1 before
+      step N's tokens ever reach the host (one-step lookahead).
+    * **fused sampling** — ``samp`` is a dict of per-slot arrays
+      (``temperature``/``top_k``/``top_p``/``uid``/``pos``, each [S])
+      consumed by ``sampling.ragged_sample`` right after the
+      logits-gather tail; ``samp=None`` compiles the pure-greedy tail
+      (argmax only — no sort/categorical work in the executable).
+
+    Returns ``(tokens [S] int32, new_pools)`` — the [S, vocab] logits
+    never leave the device.
+    """
+    if prev_tokens is not None:
+        hi = prev_tokens.shape[0] - 1
+        token_ids = jnp.where(
+            token_src >= 0,
+            prev_tokens[jnp.clip(token_src, 0, hi)], token_ids)
+    logits, new_pools = ragged_forward(
+        tree, spec, pools, token_ids, token_seq, token_pos, token_qidx,
+        seq_lens, q_counts, block_tables, logits_idx,
+        block_size=block_size, **kw)
+    if samp is None:
+        tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        from ..sampling import ragged_sample
+        tokens = ragged_sample(logits, samp["temperature"],
+                               samp["top_k"], samp["top_p"],
+                               samp["uid"], samp["pos"], base_key)
+    return tokens, new_pools

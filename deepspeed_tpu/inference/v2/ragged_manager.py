@@ -153,6 +153,28 @@ class DSStateManager:
         if seq is not None:
             self.kv.release(seq)
 
+    def rollback_tokens(self, uid: int, n_tokens: int,
+                        blocks_before: int) -> None:
+        """Undo one already-committed forward for ``uid``: subtract its
+        ``n_tokens`` from ``seen_tokens`` and free blocks allocated past
+        ``blocks_before``.
+
+        This is the speculative-step rollback for the lookahead serving
+        loop: when step N's host-visible tokens reveal an EOS, the
+        sequence's step-N+1 row (already dispatched) is cancelled by
+        reverting the HOST accounting only — the stale KV the device
+        wrote for that row lives past ``seen_tokens`` (or in blocks
+        returned to the free list), which paged attention masks by
+        ``seq_lens``, so no device-side undo is needed.
+        """
+        seq = self._seqs.get(uid)
+        if seq is None:
+            return
+        seq.seen_tokens = max(0, seq.seen_tokens - n_tokens)
+        if len(seq.blocks) > blocks_before:
+            self.kv.allocator.free(seq.blocks[blocks_before:])
+            del seq.blocks[blocks_before:]
+
     def block_table(self, seq: SequenceDescriptor,
                     max_blocks: int) -> np.ndarray:
         t = np.zeros((max_blocks,), np.int32)

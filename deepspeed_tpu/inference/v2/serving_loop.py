@@ -1,0 +1,327 @@
+"""Serving loops for the v2 ragged engine (the MII-side loop the
+reference keeps out of deepspeed; reference shape:
+DeepSpeed-FastGen/MII's async serving thread over ``put()``).
+
+Three modes, one token-stream contract:
+
+* ``lookahead`` — the async hot path. Step N+1's host work (Dynamic
+  SplitFuse scheduling, KV-block accounting, RaggedBatchWrapper
+  staging) happens while step N computes on device, and step N's
+  on-device sampled tokens feed step N+1's decode rows THROUGH DEVICE
+  MEMORY (``token_src`` gather in ``ragged_forward_sampled``). The host
+  receives tokens asynchronously, one step late, only for EOS checks
+  and detokenization — so a decode step in steady state performs ZERO
+  blocking host syncs (the one ``np.asarray`` per iteration waits on a
+  step that the next one already overlaps). An EOS discovered late
+  cancels at most one speculative step via host-accounting rollback
+  (``DSStateManager.rollback_tokens``); its stale device-side KV is
+  masked by ``seq_lens`` and its blocks return to the free list.
+* ``sync`` — dispatch one step, sync its tokens, repeat (1 blocking
+  sync per step). Same on-device sampler, so greedy AND seeded-sampled
+  streams are bitwise-identical to ``lookahead`` (draws are keyed by
+  (seed, uid, position), never by batch composition).
+* ``sync_host`` — the legacy loop: ``put()`` logits to host, numpy
+  ``sample_token`` per row. Greedy streams still match the device
+  loops bitwise (same fp32 logits, same first-max argmax); sampled
+  streams follow the legacy numpy RNG.
+
+Length-limited sequences never cancel speculative work: the host knows
+``remaining`` counts up front and simply stops scheduling a sequence
+whose in-flight emission is its last. Only EOS is discovered late.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from ..sampling import SamplingParams
+from .metrics import ServingMetrics
+from .ragged_manager import SchedulingError, SchedulingResult
+
+
+# best-effort async D2H kick so the later np.asarray mostly finds the
+# bytes already landed — the SHARED helper (warn-once on unsupported
+# platforms, transient transfer errors deferred to the synchronous
+# wait), not a local re-implementation that would drift from its
+# fault-handling policy
+from ...runtime.transfer.engine import start_host_copy as _start_host_copy
+
+
+class _Ref:
+    """A token that exists on device but not yet on host: row ``slot``
+    of the in-flight step's [S] sampled-token array."""
+    __slots__ = ("step", "slot")
+
+    def __init__(self, step, slot):
+        self.step = step
+        self.slot = slot
+
+
+@dataclasses.dataclass
+class _Step:
+    """Host record of one dispatched forward."""
+    uids: List[int]
+    emit: List[bool]               # row emits (decode / final chunk)
+    tokens: object                 # DEVICE array [S], slot == row
+    slot: Dict[int, int]
+    committed: Dict[int, tuple]    # uid -> (n_tokens, blocks_before)
+    cancelled: Set[int] = dataclasses.field(default_factory=set)
+
+
+def _base_key(sampling):
+    """One PRNG base key per run. A per-uid dict may set seeds too —
+    they must agree (keys are threaded per (seed, uid, position), so a
+    single base key serves every row); conflicting seeds raise rather
+    than silently picking one."""
+    if sampling is None:
+        return None
+    import jax
+    if isinstance(sampling, SamplingParams):
+        seed = sampling.seed
+    else:
+        seeds = {sp.seed for sp in sampling.values()
+                 if sp.seed is not None}
+        if len(seeds) > 1:
+            raise ValueError(
+                f"per-uid SamplingParams carry conflicting seeds "
+                f"{sorted(seeds)}; the serving loop threads ONE base "
+                f"key per run (per-row keys fold in uid/position)")
+        seed = seeds.pop() if seeds else None
+    return jax.random.PRNGKey(0 if seed is None else seed)
+
+
+def run_serving_loop(engine, prompts, *, max_new_tokens: int,
+                     eos_token_id: Optional[int], sampling,
+                     mode: str) -> Dict[int, List[int]]:
+    if mode not in ("lookahead", "sync", "sync_host"):
+        # validate BEFORE touching engine state so a typo'd mode does
+        # not clobber the previous run's metrics report
+        raise ValueError(
+            f"mode must be lookahead/sync/sync_host, got {mode!r}")
+    pending = {uid: np.asarray(p, np.int32).reshape(-1)
+               for uid, p in prompts.items()}
+    for uid, p in pending.items():
+        if len(p) == 0:
+            # an empty prompt has no last token to sample from — the
+            # wrapper's logits_idx would alias another row's tail and
+            # emit garbage
+            raise ValueError(f"empty prompt for uid {uid}")
+    out: Dict[int, List[int]] = {uid: [] for uid in prompts}
+    metrics = ServingMetrics(mode, engine._config.n_kv_blocks)
+    engine._serving_metrics = metrics
+    # defer-ages are per-run scheduling state: an aborted run must not
+    # leak priority (or dict entries) into unrelated later requests
+    engine._defer_age.clear()
+    if mode == "lookahead":
+        _run_lookahead(engine, pending, out, max_new_tokens,
+                       eos_token_id, sampling, metrics)
+    elif mode == "sync":
+        _run_sync(engine, pending, out, max_new_tokens, eos_token_id,
+                  sampling, metrics)
+    else:
+        _run_sync_host(engine, pending, out, max_new_tokens,
+                       eos_token_id, sampling, metrics)
+    return out
+
+
+def _emit(out, metrics, remaining, uid, tok, eos):
+    """THE emission semantics, shared by all three loops (the
+    bitwise-equivalence contract lives here): append, record TTFT/ITL,
+    decrement the budget, and decide finished. Callers only differ in
+    what they do with `finished` (flush now vs cancel a speculative
+    row first)."""
+    out[uid].append(tok)
+    metrics.record_emission(uid, first=(len(out[uid]) == 1))
+    remaining[uid] -= 1
+    return remaining[uid] <= 0 or (eos is not None and tok == eos)
+
+
+def _trim_prompts(pending, uids, toks):
+    """Advance prompt cursors for this step's rows at DISPATCH time.
+    Returns (emit flags, prompt token count)."""
+    emit, n_prompt = [], 0
+    for uid, chunk in zip(uids, toks):
+        if uid in pending:
+            n_prompt += len(chunk)
+            rest = pending[uid][len(chunk):]
+            if len(rest):
+                pending[uid] = rest
+                emit.append(False)     # mid-prompt: nothing to emit
+            else:
+                del pending[uid]
+                emit.append(True)      # final chunk: first token
+        else:
+            emit.append(True)          # decode row
+    return emit, n_prompt
+
+
+def _run_sync(engine, pending, out, max_new, eos, sampling, metrics):
+    base_key = _base_key(sampling)
+    decode: Dict[int, int] = {}
+    remaining = {uid: max_new for uid in out}
+    while pending or decode:
+        t0 = metrics.now()
+        uids, toks = engine.schedule(pending, decode)
+        if not uids:
+            raise SchedulingError(SchedulingResult.OutOfKVBlocks)
+        emit, n_prompt = _trim_prompts(pending, uids, toks)
+        tokens_dev, _, recompiled = engine.put_sampled(
+            uids, toks, sampling=sampling, base_key=base_key)
+        t1 = metrics.now()
+        _start_host_copy(tokens_dev)
+        toks_host = np.asarray(tokens_dev)     # the per-step sync
+        t2 = metrics.now()
+        n_new = 0
+        for row, uid in enumerate(uids):
+            if not emit[row]:
+                continue
+            tok = int(toks_host[row])
+            n_new += 1
+            if _emit(out, metrics, remaining, uid, tok, eos):
+                decode.pop(uid, None)
+                engine.flush(uid)
+            else:
+                decode[uid] = tok
+        metrics.record_step(
+            dispatch_s=t1 - t0, sync_wait_s=t2 - t1,
+            wall_s=metrics.now() - t0, new_tokens=n_new,
+            prompt_tokens=n_prompt, n_seqs=len(uids),
+            decode_only=(n_prompt == 0), recompiled=recompiled,
+            blocking_sync=True, queue_depth=len(pending),
+            kv_free=engine.free_blocks)
+
+
+def _run_lookahead(engine, pending, out, max_new, eos, sampling,
+                   metrics):
+    base_key = _base_key(sampling)
+    decode: Dict[int, object] = {}     # uid -> int | _Ref(inflight)
+    remaining = {uid: max_new for uid in out}
+    inflight: Optional[_Step] = None
+
+    while pending or decode or inflight is not None:
+        t0 = metrics.now()
+        # ---- schedule + dispatch step k+1 before step k's tokens are
+        # host-visible. Sequences whose pending emission is their LAST
+        # (length limit) are excluded — the host knows counts up front,
+        # so only EOS ever cancels speculative work.
+        sched_decode = {}
+        for uid, v in decode.items():
+            if isinstance(v, _Ref):
+                assert v.step is inflight, "stale device-token ref"
+                if remaining[uid] > 1:
+                    sched_decode[uid] = 0          # placeholder id
+            else:
+                sched_decode[uid] = v
+        uids, toks = engine.schedule(pending, sched_decode)
+        step = None
+        n_prompt = 0
+        recompiled = False
+        if uids:
+            srcs = []
+            for uid in uids:
+                v = decode.get(uid)
+                srcs.append(v.slot if isinstance(v, _Ref) else -1)
+            emit, n_prompt = _trim_prompts(pending, uids, toks)
+            tokens_dev, committed, recompiled = engine.put_sampled(
+                uids, toks, src_slots=srcs,
+                prev_tokens=inflight.tokens if inflight else None,
+                sampling=sampling, base_key=base_key)
+            _start_host_copy(tokens_dev)
+            step = _Step(uids=uids, emit=emit, tokens=tokens_dev,
+                         slot={u: i for i, u in enumerate(uids)},
+                         committed={u: (n, b) for u, n, b in committed})
+            # every emitting row's NEXT token now lives in this step's
+            # device output
+            for row, uid in enumerate(uids):
+                if emit[row]:
+                    decode[uid] = _Ref(step, row)
+        elif inflight is None:
+            # nothing schedulable and nothing in flight that could
+            # free blocks -> genuinely stuck
+            raise SchedulingError(SchedulingResult.OutOfKVBlocks)
+        t1 = metrics.now()
+
+        # ---- collect step k while k+1 computes (EOS/detokenization is
+        # the only host consumer of token values)
+        n_new = 0
+        sync_wait = 0.0
+        if inflight is not None:
+            ts = metrics.now()
+            toks_host = np.asarray(inflight.tokens)
+            sync_wait = metrics.now() - ts
+            for row, uid in enumerate(inflight.uids):
+                if not inflight.emit[row] or row in inflight.cancelled:
+                    continue
+                tok = int(toks_host[row])
+                n_new += 1
+                if _emit(out, metrics, remaining, uid, tok, eos):
+                    if step is not None and uid in step.slot:
+                        # EOS discovered one step late: cancel the
+                        # speculative row already dispatched in k+1
+                        # (host accounting only; seq_lens masks the
+                        # stale KV the device wrote)
+                        step.cancelled.add(step.slot[uid])
+                        n_t, blocks_before = step.committed[uid]
+                        engine.rollback_step(uid, n_t, blocks_before)
+                        metrics.record_cancelled()
+                    decode.pop(uid, None)
+                    engine.flush(uid)
+                else:
+                    cur = decode.get(uid)
+                    if isinstance(cur, _Ref) and cur.step is inflight:
+                        decode[uid] = tok      # host-known from here on
+        # blocking = this iteration waited on the most recent dispatch
+        # with nothing overlapping it (drain / deferred-schedule steps)
+        metrics.record_step(
+            dispatch_s=t1 - t0, sync_wait_s=sync_wait,
+            wall_s=metrics.now() - t0, new_tokens=n_new,
+            prompt_tokens=n_prompt, n_seqs=len(uids),
+            decode_only=(bool(uids) and n_prompt == 0),
+            recompiled=recompiled,
+            blocking_sync=(inflight is not None and step is None),
+            queue_depth=len(pending), kv_free=engine.free_blocks)
+        inflight = step
+
+
+def _run_sync_host(engine, pending, out, max_new, eos, sampling,
+                   metrics):
+    """Legacy loop: host logits + numpy per-row sampling (kept as the
+    differential reference for the device-sampled loops)."""
+    from ..sampling import sample_token
+    if sampling is not None and not isinstance(sampling, SamplingParams):
+        raise ValueError("sync_host supports a single SamplingParams")
+    sp = sampling or SamplingParams()
+    rng = np.random.default_rng(sp.seed)
+    decode: Dict[int, int] = {}
+    remaining = {uid: max_new for uid in out}
+    while pending or decode:
+        t0 = metrics.now()
+        uids, toks = engine.schedule(pending, decode)
+        if not uids:
+            raise SchedulingError(SchedulingResult.OutOfKVBlocks)
+        emit, n_prompt = _trim_prompts(pending, uids, toks)
+        t1 = metrics.now()
+        logits = engine.put(uids, toks)        # host round-trip
+        recompiled = engine._last_dispatch_was_compile
+        t2 = metrics.now()
+        n_new = 0
+        for row, uid in enumerate(uids):
+            if not emit[row]:
+                continue
+            tok = sample_token(logits[row], rng,
+                               temperature=sp.temperature,
+                               top_k=sp.top_k, top_p=sp.top_p)
+            n_new += 1
+            if _emit(out, metrics, remaining, uid, tok, eos):
+                decode.pop(uid, None)
+                engine.flush(uid)
+            else:
+                decode[uid] = tok
+        metrics.record_step(
+            dispatch_s=t1 - t0, sync_wait_s=t2 - t1,
+            wall_s=metrics.now() - t0, new_tokens=n_new,
+            prompt_tokens=n_prompt, n_seqs=len(uids),
+            decode_only=(n_prompt == 0), recompiled=recompiled,
+            blocking_sync=True, queue_depth=len(pending),
+            kv_free=engine.free_blocks)

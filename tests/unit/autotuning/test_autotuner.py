@@ -46,6 +46,7 @@ def test_candidate_enumeration():
     assert {c["zero_optimization"]["stage"] for c in cands} == {0, 1}
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_tune_picks_feasible_best(factories, tmp_path):
     ef, bf = factories
     t = AutotuningConfig(enabled=True, micro_batch_sizes=[2, 4],

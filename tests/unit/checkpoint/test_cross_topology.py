@@ -65,10 +65,15 @@ class TestMeshReshape:
         return {"dir": str(tmp), "eval": ref_eval, "cont": ref_cont,
                 "steps": 3}
 
+    # tier-1 diet (PR 5): every reshape rides the slow tier — the
+    # sharded-checkpoint suite keeps the save/restore tier-1 smokes
     @pytest.mark.parametrize("mesh_kwargs", [
-        {"data": 1, "fsdp": 8},
-        {"data": 2, "tensor": 4},
-        {"data": 4, "fsdp": 2},
+        pytest.param({"data": 1, "fsdp": 8},
+                     marks=pytest.mark.slow),
+        pytest.param({"data": 2, "tensor": 4},
+                     marks=pytest.mark.slow),
+        pytest.param({"data": 4, "fsdp": 2},
+                     marks=pytest.mark.slow),
     ], ids=["fsdp8", "tp4xdata2", "data4xfsdp2"])
     def test_restore_on_new_topology(self, saved, mesh_kwargs,
                                      eight_devices):

@@ -67,6 +67,7 @@ WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_kill_mid_save_preserves_latest_integrity(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)

@@ -7,6 +7,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
@@ -57,6 +58,7 @@ def test_zero3_gathers_full_weights(tmp_path, rng, eight_devices):
             assert arr.dtype == jnp.bfloat16, (name, arr.dtype)
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_saved_weights_match_stage0_math(tmp_path, rng, eight_devices):
     """Stage-3 sharded training then save must produce the same 16-bit
     file as replicated training from the same seed — consolidation must

@@ -69,6 +69,7 @@ def test_restack_layer_count_mismatch_raises():
                            tgt_max_k=3)
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_fragment_explode_and_readback(tmp_path, rng, eight_devices):
     """End-to-end: train, save, explode to fragments, read back — every
     master leaf appears once at full shape with Adam moments."""

@@ -61,6 +61,7 @@ def _run(config, steps):
 
 class TestMoQEngineLoop:
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_bits_flip_at_schedule_offset_and_drop_on_period(self):
         """Before schedule_offset the step runs unquantized (bits 0);
         at the offset quantization turns on at start_bits; each period
@@ -75,6 +76,7 @@ class TestMoQEngineLoop:
         assert bits_seen[-1] == (6,)         # clamped at target
         assert all(np.isfinite(losses))
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_quantization_actually_changes_the_training_math(self):
         """Same seed/batch: once bits activate, the loss trajectory must
         diverge from the uncompressed run (the transform is inside the
@@ -88,6 +90,7 @@ class TestMoQEngineLoop:
         np.testing.assert_allclose(base[0], quant[0], rtol=1e-5)  # pre
         assert abs(base[-1] - quant[-1]) > 1e-4, (base, quant)
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_eigenvalue_stretches_period(self):
         """With eigenvalue modulation the post-drop period grows by
         2*factor instead of 2 (reference: period <<= 1; period *=
@@ -108,6 +111,7 @@ class TestMoQEngineLoop:
         assert factor == 5
         assert g["period"] % 10 == 0 and g["period"] >= 10
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_moq_schedule_survives_checkpoint_resume(self, tmp_path):
         """bits/period/next_drop persist through save/load — a resume
         must NOT restart quantization at start_bits."""
@@ -131,6 +135,7 @@ class TestMoQEngineLoop:
         assert g2["period"] == g["period"]
         assert g2["next_drop"] == g["next_drop"]
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_eval_sees_qat_target_after_resume_without_training(self, tmp_path):
         """eval_batch must derive (comp_bits, prune_on) from the
         scheduler/MoQ state, not from the last train step's cached

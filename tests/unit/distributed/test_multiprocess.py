@@ -290,6 +290,7 @@ def test_elastic_agent_respawns_multiworker_group(tmp_path):
     assert "AGENT rc 0 restarts 1" in proc.stdout   # one group respawn
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_elastic_agent_kills_and_resumes_real_worker(tmp_path):
     """A REAL engine worker is SIGKILLed mid-training; the agent
     respawns it and the restarted process resumes from the committed

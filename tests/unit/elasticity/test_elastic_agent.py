@@ -72,8 +72,13 @@ WORKER = textwrap.dedent("""
 """)
 
 
-@pytest.mark.parametrize("via_cli", [False, True],
-                         ids=["api", "dstpu-elastic"])
+# tier-1 diet (PR 5): both e2e kill/resume incarnations ride the slow
+# tier — the cheap elasticity planning/backoff tests below keep the
+# subsystem's tier-1 smoke
+@pytest.mark.parametrize("via_cli", [
+    pytest.param(False, marks=pytest.mark.slow),
+    pytest.param(True, marks=pytest.mark.slow)],
+    ids=["api", "dstpu-elastic"])
 def test_agent_survives_injected_failure(tmp_path, via_cli):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)

@@ -56,6 +56,7 @@ def _init(model, vocab=256):
     return model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_gptneox_family():
     from deepspeed_tpu.models.gptneox import (GPTNeoXConfig,
                                               GPTNeoXForCausalLM)

@@ -8,7 +8,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from deepspeed_tpu.inference.sampling import (SamplingParams, make_sampler,
+from deepspeed_tpu.inference.sampling import (SamplingParams, filter_logits,
+                                              make_sampler, ragged_sample,
                                               sample_token)
 
 
@@ -95,6 +96,99 @@ def test_sampling_params_validation():
         SamplingParams(top_p=1.5)
     p = SamplingParams(temperature=0.7, top_k=50, top_p=0.9, seed=3)
     assert (p.temperature, p.top_k, p.top_p, p.seed) == (0.7, 50, 0.9, 3)
+
+
+class TestSharedFilterParity:
+    """The top-k/top-p math exists ONCE (``filter_logits``) and every
+    sampler — jit, host numpy, fused ragged — must select identically
+    on fixed logits."""
+
+    LOGITS = np.asarray(
+        [[5.0, 4.0, 4.0, 3.0, -1.0, 0.5, 2.0, 2.0],
+         [0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8],
+         [9.0, -9.0, 9.0, 0.0, 1.0, 2.0, 3.0, 4.0]], np.float32)
+
+    @pytest.mark.parametrize("top_k,top_p", [
+        (None, None), (1, None), (2, None), (3, 0.9), (None, 0.5),
+        (100, None), (None, 1.0), (5, 0.25)])
+    def test_host_vs_jit_filter_bitwise(self, top_k, top_p):
+        host = filter_logits(self.LOGITS, top_k, top_p, xp=np)
+        jit = np.asarray(filter_logits(jnp.asarray(self.LOGITS),
+                                       top_k, top_p, xp=jnp))
+        np.testing.assert_array_equal(np.isfinite(host),
+                                      np.isfinite(jit))
+        np.testing.assert_array_equal(host[np.isfinite(host)],
+                                      jit[np.isfinite(jit)])
+
+    @pytest.mark.parametrize("top_k,top_p", [
+        (2, None), (None, 0.5), (3, 0.9)])
+    def test_per_row_arrays_match_static(self, top_k, top_p):
+        """The fused sampler's array-valued k/p (0 / 1.0 = off) selects
+        the same support as the static jit/host paths."""
+        B = self.LOGITS.shape[0]
+        karr = np.full((B,), top_k if top_k else 0, np.int32)
+        parr = np.full((B,), top_p if top_p is not None else 1.0,
+                       np.float32)
+        stat = filter_logits(self.LOGITS, top_k, top_p, xp=np)
+        dyn = np.asarray(filter_logits(jnp.asarray(self.LOGITS),
+                                       karr, parr, xp=jnp))
+        np.testing.assert_array_equal(np.isfinite(stat),
+                                      np.isfinite(dyn))
+
+    def test_top_p_zero_keeps_the_top_token(self):
+        """Degenerate top_p <= 0 (public API, unvalidated) must still
+        keep the argmax token — the old roll-based keep[0]=True
+        guarantee — on host, jit, and per-row-array paths."""
+        logits = self.LOGITS
+        # ties at the max survive together (same as the old roll-based
+        # keep), so "the top token" means any max-valued index
+        top = [set(np.flatnonzero(row == row.max())) for row in logits]
+        got = [sample_token(row, np.random.default_rng(0),
+                            temperature=1.0, top_p=0.0)
+               for row in logits]
+        assert all(g in t for g, t in zip(got, top)), (got, top)
+        jit = np.asarray(make_sampler(1.0, top_p=0.0)(
+            jnp.asarray(logits), jax.random.PRNGKey(0)))
+        assert all(int(g) in t for g, t in zip(jit, top)), (jit, top)
+        masked = filter_logits(logits, None, 0.0, xp=np)
+        np.testing.assert_array_equal(
+            np.isfinite(masked).sum(axis=-1), [len(t) for t in top])
+
+    def test_greedy_parity_three_samplers(self):
+        want = np.argmax(self.LOGITS, axis=-1)
+        host = [sample_token(row, np.random.default_rng(0))
+                for row in self.LOGITS]
+        jit = make_sampler(0.0)(jnp.asarray(self.LOGITS),
+                                jax.random.PRNGKey(0))
+        B = self.LOGITS.shape[0]
+        fused = ragged_sample(
+            jnp.asarray(self.LOGITS), jnp.zeros((B,), jnp.float32),
+            jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
+            jnp.arange(B, dtype=jnp.uint32),
+            jnp.arange(B, dtype=jnp.uint32), jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(host, want)
+        np.testing.assert_array_equal(np.asarray(jit), want)
+        np.testing.assert_array_equal(np.asarray(fused), want)
+
+    def test_ragged_sample_draw_is_batch_invariant(self):
+        """A (seed, uid, position) triple draws the same token no
+        matter which slot the row occupies — the property that makes
+        sync and lookahead sampled streams identical."""
+        row = jnp.asarray(np.linspace(0, 2, 16), jnp.float32)
+        pad = jnp.asarray(np.linspace(-1, 1, 16), jnp.float32)
+        key = jax.random.PRNGKey(5)
+
+        def draw(logits, uids, pos):
+            B = logits.shape[0]
+            return np.asarray(ragged_sample(
+                logits, jnp.full((B,), 0.9, jnp.float32),
+                jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
+                jnp.asarray(uids, jnp.uint32),
+                jnp.asarray(pos, jnp.uint32), key))
+
+        a = draw(jnp.stack([row, pad]), [42, 7], [3, 0])
+        b = draw(jnp.stack([pad, pad, row]), [7, 8, 42], [0, 0, 3])
+        assert a[0] == b[2]
 
 
 def test_v2_generate_batch_sampled(eight_devices):

@@ -43,6 +43,7 @@ class TestBloom:
         assert len(s) == 8 and (np.diff(s) < 0).all()
         assert len(alibi_slopes(12)) == 12  # non-power-of-two path
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_trains(self):
         _train_two_steps(BloomForCausalLM(BloomConfig.tiny()))
 
@@ -110,6 +111,7 @@ class TestBloom:
 
 class TestOPT:
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_trains(self):
         _train_two_steps(OPTForCausalLM(OPTConfig.tiny()))
 
@@ -142,6 +144,7 @@ class TestMistral:
                                    np.asarray(out_f)[0, :16],
                                    rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_trains(self):
         _train_two_steps(LlamaForCausalLM(MistralConfig.tiny()), seq=24)
 

@@ -208,6 +208,7 @@ class TestRegistryRound3:
         assert registry.detect_policy(
             {"bert.embeddings.word_embeddings.weight": 0}).name == "bert"
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_families_train(self, rng):
         """Each new decoder family runs a training step through the
         engine (loss finite and falling)."""

@@ -5,12 +5,14 @@ tokens/s at Llama shapes (tools/perf/r4_config3_sweep.py)."""
 import dataclasses
 
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_dots_policy_trains_and_matches_full_remat(eight_devices):
     losses = {}
     for policy in ("full", "dots"):

@@ -104,6 +104,7 @@ class TestNVMeOffloadTraining:
         return engine, [float(engine.train_batch(batch=b))
                         for _ in range(steps)]
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_nvme_matches_cpu_offload(self, eight_devices, tmp_path):
         """The file round trip is lossless: NVMe-tier training follows
         the host-DRAM tier step for step."""
@@ -116,6 +117,7 @@ class TestNVMeOffloadTraining:
         assert os.path.dirname(path) == str(tmp_path / "nvme")
         assert os.path.getsize(path) >= engine._offload.store.nbytes
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_nvme_checkpoint_roundtrip(self, eight_devices, tmp_path):
         engine, losses = self._train("nvme", tmp_path, steps=3)
         ck = tmp_path / "ck"

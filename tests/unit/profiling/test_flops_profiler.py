@@ -20,6 +20,7 @@ def test_get_model_profile_counts_matmul_flops():
     assert prof["flops"] == pytest.approx(expected, rel=0.01)
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_engine_flops_profile_and_profiler():
     model = GPT2LMHeadModel(GPT2Config.tiny())
     config = {

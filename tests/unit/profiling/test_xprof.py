@@ -11,6 +11,7 @@ from deepspeed_tpu.profiling.xprof import (profiler_trace,
                                            trace_dir_has_profile)
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_engine_trace_window_produces_profile(tmp_path, eight_devices):
     mesh_manager.reset()
     mesh_manager.init(MeshConfig(data=-1))

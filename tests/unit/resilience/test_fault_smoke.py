@@ -67,7 +67,10 @@ def test_data_fetch_site_recovers_via_retry(eight_devices):
                                np.arange(8, 16, dtype=np.float32))
 
 
-@pytest.mark.parametrize("site", ["offload.d2h", "offload.h2d"])
+@pytest.mark.parametrize("site", [
+    "offload.d2h",
+    pytest.param("offload.h2d",
+                 marks=pytest.mark.slow)])  # tier-1 diet (PR 5)
 def test_offload_transfer_site_recovers_via_retry(
         site, rng, eight_devices):
     """One train step with ZeRO-Offload while the named transfer leg

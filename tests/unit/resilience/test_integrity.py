@@ -254,6 +254,7 @@ def test_kill_between_shard_writes_never_leaves_corrupt_tag(tmp_path):
     assert cs["global_steps"] == int(tag[1:])
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_offload_host_state_follows_fallback_tag(eight_devices,
                                                  tmp_path):
     """When the integrity fallback picks an older tag, the ZeRO-Offload

@@ -125,6 +125,7 @@ def test_engine_auto_rollback_restores_verified_checkpoint(
     assert engine.global_steps == 3
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_engine_rollback_budget_escalates(rng, eight_devices, tmp_path):
     """Past max_rollbacks the engine raises the typed divergence error
     (the elastic agent layer handles it as a worker failure)."""
@@ -151,6 +152,7 @@ def test_engine_rollback_budget_escalates(rng, eight_devices, tmp_path):
         engine.train_batch(batch=batch)
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_engine_rollback_without_checkpoint_is_typed(
         rng, eight_devices, tmp_path):
     import deepspeed_tpu

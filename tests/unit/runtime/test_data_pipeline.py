@@ -56,6 +56,7 @@ class TestCurriculumScheduler:
                 "schedule_type": "nope"})
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_engine_curriculum_changes_seqlen():
     """The curriculum schedule changes the fed sequence length over
     steps (VERDICT done-criterion)."""

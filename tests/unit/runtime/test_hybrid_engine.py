@@ -25,6 +25,7 @@ def hybrid():
     return eng, ids
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_generate_then_train_then_generate(hybrid):
     """The rollout -> PPO-step -> rollout loop: generate sees updated
     weights after each train step (the weight-sharing contract,
@@ -113,6 +114,7 @@ class TestLora:
             np.testing.assert_allclose(np.asarray(f), np.asarray(b),
                                        atol=1e-6)
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_lora_under_tp2(self, eight_devices):
         """generate -> train -> generate with a tensor-parallel mesh:
         the fused push and the TP-sharded inference compose."""

@@ -90,6 +90,7 @@ def test_factory_routes_by_dtype():
     assert bf16.loss_scale == 1.0  # bf16 needs no scaling
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_engine_fp16_backs_off_huge_scale(rng, eight_devices):
     """With an absurd initial scale the scaled fp16 grads overflow; the
     engine must skip those steps (params untouched, scale halving) and

@@ -39,6 +39,7 @@ def _train(opt_type, steps, freeze_step=10, lr=1e-3, seed=0):
 
 class TestOnebitAdam:
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_warmup_matches_plain_adam(self, eight_devices):
         """Before freeze_step the math is standard Adam with full-
         precision averaging: trajectories must coincide."""
@@ -46,6 +47,7 @@ class TestOnebitAdam:
         _, ob = _train("OneBitAdam", steps=6, freeze_step=100)
         np.testing.assert_allclose(ob, ref, rtol=1e-4)
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_convergence_parity_over_50_steps(self, eight_devices):
         """The compressed stage (error feedback, 1-bit momentum wire)
         tracks uncompressed Adam over >= 50 steps on the virtual mesh:
@@ -61,6 +63,7 @@ class TestOnebitAdam:
         # steadily decreasing after the freeze transition
         assert ob[20] > ob[35] > ob[-1]
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_error_feedback_accumulates(self, eight_devices):
         """Past freeze_step the per-shard error buffers must be nonzero
         (compression is really happening) and differ across shards."""

@@ -41,6 +41,7 @@ def _train(opt_type, steps, params=None, stage=0, seed=0):
 
 class TestOnebitLamb:
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_warmup_matches_plain_lamb(self, eight_devices):
         """Before freeze_step the math is LAMB with full-precision
         averaging plus the coeff EMA bookkeeping: trajectories
@@ -54,6 +55,7 @@ class TestOnebitLamb:
         assert ob[-1] < ob[0]
         assert ref[-1] < ref[0]
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_convergence_parity_compressed_stage(self, eight_devices):
         """The compressed stage (scaled momentum exchange, frozen
         trust ratio x variance-drift factor) keeps converging over 40
@@ -67,6 +69,7 @@ class TestOnebitLamb:
         assert ob[15] > ob[-1]
         assert min(ob[-5:]) < min(ob[:10])
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_scaling_coeff_set_at_transition(self, eight_devices):
         """scaling_coeff leaves move off their 1.0 init exactly when
         the compressed stage begins (lamb.py:171-182)."""
@@ -97,6 +100,7 @@ class TestOnebitLamb:
 
 class TestZeroOneAdam:
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_variance_phase_tracks_adam(self, eight_devices):
         """With var_interval=1 (every step a full step) phase 1 IS
         Adam without bias correction — close trajectory, and loss
@@ -108,6 +112,7 @@ class TestZeroOneAdam:
         assert zo[-1] < zo[0]
         assert zo[-1] <= ref[-1] * 1.6
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_convergence_with_intervals_and_local_steps(
             self, eight_devices):
         """Full 0/1 schedule: growing variance intervals, then frozen
@@ -169,6 +174,7 @@ class TestZeroOneAdam:
 
 class TestOnebitAdamStage1:
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_stage1_matches_stage0_losses(self, eight_devices):
         """The chunked-variance layout is a storage change, not a math
         change: stage-1 OneBitAdam reproduces stage-0 losses."""

@@ -66,7 +66,9 @@ def _toy_reward(tokens: np.ndarray, target_token: int) -> np.ndarray:
     return np.where(frac > 0, 1.0 + 4.0 * frac, -0.1).astype(np.float32)
 
 
-@pytest.mark.parametrize("tensor", [1, 2], ids=["tp1", "tp2"])
+@pytest.mark.parametrize("tensor", [
+    1, pytest.param(2, marks=pytest.mark.slow)],  # tier-1 diet
+    ids=["tp1", "tp2"])
 def test_generate_score_update_loop(eight_devices, tensor):
     mesh_manager.reset()
     mesh_manager.init(MeshConfig(data=-1, tensor=tensor))

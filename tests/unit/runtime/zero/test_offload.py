@@ -61,6 +61,7 @@ def test_offload_matches_device_training(eight_devices):
     assert off_losses[-1] < off_losses[0]
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_delayed_update_converges_and_flushes(eight_devices, tmp_path):
     """DPU (delayed_update): offloaded leaves trail by one step, so the
     trajectory is NOT bitwise-equal to the synchronous path, but the
@@ -90,6 +91,7 @@ def test_partial_offload_ratio(eight_devices):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_offload_checkpoint_roundtrip(eight_devices, tmp_path):
     engine, losses = _train(_config(offload=True), steps=3)
     engine.save_checkpoint(str(tmp_path))
@@ -166,6 +168,7 @@ class TestParamOffloadHost:
                  if hasattr(leaf, "sharding")}
         assert kinds == {host_memory_kind()}, kinds
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_loss_parity_vs_device_resident(self):
         import deepspeed_tpu
         from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
@@ -225,6 +228,7 @@ class TestCompressedWire:
             grad_dtype=grad_dtype, upload_dtype=upload_dtype)
         return cfg
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_int8_grads_and_delta_upload_parity(self, eight_devices):
         """The compressed wire tracks the bf16 wire to rounding noise
         over 10 steps (the delta's error feedback keeps device params
@@ -262,6 +266,14 @@ class TestCompressedWire:
                   "overlap_residue_ms"):
             assert k in bd and bd[k] >= 0.0, bd
 
+    # tier-1 diet (PR 5) — and the suite's recurring killer: in LONG
+    # single-process runs this test's post-restore train_batch flakily
+    # aborts XLA CPU (or NaNs) right here — reproduced twice in one
+    # session at the same frame (ScheduledStep.__call__), matching the
+    # seed's ~548-dot truncations flagged since PR 3. Passes standalone
+    # and in short runs; needs a root-cause session (offload restore x
+    # AOT executables x process-lifetime resource growth).
+    @pytest.mark.slow
     def test_mirror_resynced_after_checkpoint_restore(
             self, eight_devices, tmp_path):
         """After load_checkpoint the mirror must equal the RESTORED

@@ -55,10 +55,14 @@ def _train(config, steps=2, seed=0):
                     for _ in range(steps)]
 
 
+# tier-1 diet (PR 5): the fp32 wire keeps the bit-identity smoke;
+# the compressed wires ride the slow tier
 @pytest.mark.parametrize("grad_dtype,upload_dtype,bf16", [
     ("bf16", "bf16", False),         # fp32 wire (fp32 compute)
-    ("int8", "int8_delta", True),    # int8 wire + int8 delta upload
-    ("int4", "int4_delta", True),    # int4 wire + int4 delta upload
+    pytest.param("int8", "int8_delta", True,
+                 marks=pytest.mark.slow),
+    pytest.param("int4", "int4_delta", True,
+                 marks=pytest.mark.slow),
 ])
 def test_bucketed_bit_identical_to_per_leaf(eight_devices, grad_dtype,
                                             upload_dtype, bf16):
@@ -110,6 +114,7 @@ def test_bucket_counters_reported_and_bounded(eight_devices):
     assert len(off.off_idx) > bd["d2h_buckets"]
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_delayed_update_bucketed_pipeline(eight_devices, tmp_path):
     """DPU + bucketed wire: the one-step-stale pipeline fill holds, the
     curve falls, and a checkpoint save flushes the in-flight host
@@ -122,6 +127,7 @@ def test_delayed_update_bucketed_pipeline(eight_devices, tmp_path):
     assert engine._offload.host_adam.step_count == 7
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_delayed_update_bucketed_sentinel_rollback(eight_devices, rng,
                                                    tmp_path):
     """Divergence under the bucketed DPU pipeline: the sentinel's
@@ -161,7 +167,10 @@ def test_delayed_update_bucketed_sentinel_rollback(eight_devices, rng,
 
 
 @pytest.mark.fault
-@pytest.mark.parametrize("site", ["transfer.d2h", "transfer.h2d"])
+@pytest.mark.parametrize("site", [
+    "transfer.d2h",
+    pytest.param("transfer.h2d",
+                 marks=pytest.mark.slow)])  # tier-1 diet (PR 5)
 def test_transfer_site_fault_recovers_via_retry(site, rng,
                                                 eight_devices):
     """A transient fault on one fused-bucket transfer is absorbed by
@@ -183,6 +192,7 @@ def test_transfer_site_fault_recovers_via_retry(site, rng,
 
 
 @pytest.mark.fault
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_transfer_h2d_fault_retries_delta_upload(rng, eight_devices):
     """Delta uploads are retryable UNDER BUCKETING (unlike the per-leaf
     wire): the staged q/scales are immutable once written, so replaying

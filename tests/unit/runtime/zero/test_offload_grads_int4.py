@@ -93,6 +93,7 @@ def test_error_feedback_telescopes(rng):
     assert float(jnp.abs(r).max()) < 0.05
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_int4_grads_parity_with_bf16_wire(eight_devices):
     """Error feedback keeps the int4 grad wire's trajectory on the
     uncompressed wire's curve to rounding noise."""
@@ -131,6 +132,7 @@ def test_wire_payload_is_packed_nibbles(eight_devices):
     assert total_bytes < 0.6 * n_off
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_residual_lives_on_device_and_moves(eight_devices):
     engine, _ = _train(_config("int4"), steps=3)
     res = engine._offload_grad_residual
@@ -143,6 +145,7 @@ def test_residual_lives_on_device_and_moves(eight_devices):
     assert any(float(jnp.abs(r).max()) > 0 for r in res)
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_checkpoint_roundtrips_residual(eight_devices, tmp_path):
     """The residual is optimizer state: a resume must restore it
     bit-for-bit, or the stream would replay/lose one step's rounding."""
@@ -162,6 +165,7 @@ def test_checkpoint_roundtrips_residual(eight_devices, tmp_path):
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_int4_composes_with_delta_upload_and_dpu(eight_devices):
     """The full config-4 wire: int4 grads down + int4 deltas up +
     delayed update still converges on the bf16 trajectory."""
@@ -173,6 +177,7 @@ def test_int4_composes_with_delta_upload_and_dpu(eight_devices):
     assert got[-1] < got[0]
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_fp16_overflow_protects_residual(eight_devices):
     """On an fp16 overflow the host skips the payload AND the device
     residual must carry the OLD value forward — absorbing the inf/nan

@@ -52,6 +52,7 @@ def test_nibble_pack_unpack_roundtrip(rng):
     np.testing.assert_array_equal(out, vals.reshape(-1).astype(np.float32))
 
 
+@pytest.mark.slow  # tier-1 diet (PR 5)
 def test_int4_delta_parity_with_bf16_wire(eight_devices):
     """The int4 wire tracks the uncompressed wire to rounding noise —
     the mirror's error feedback carries the coarser residual forward."""

@@ -223,6 +223,7 @@ class TestLayerScan:
         ls = jax.jit(lambda p: fn(p, batch, None)[0])(params)
         assert float(lf) == float(ls)
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_engine_10step_trajectories(self, rng, eight_devices):
         """Fixed-seed 10-step runs through the full engine:
 

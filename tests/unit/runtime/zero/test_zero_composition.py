@@ -51,7 +51,8 @@ def test_gas_split_does_not_change_math(rng, eight_devices):
     np.testing.assert_allclose(losses[1], losses[4], rtol=2e-4)
 
 
-@pytest.mark.parametrize("stage", [1, 2])
+@pytest.mark.parametrize("stage", [
+    pytest.param(1, marks=pytest.mark.slow), 2])  # tier-1 diet
 def test_clipping_parity_across_stages(stage, rng, eight_devices):
     """Sharding must not change the clipped trajectory: stage N with
     clipping == stage 0 with clipping, step for step. A tiny max_norm

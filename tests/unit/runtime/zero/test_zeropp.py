@@ -52,6 +52,7 @@ def _lowered_text(engine):
 
 class TestZeroPlusPlus:
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_qgz_loss_parity_stage2(self, eight_devices):
         """dp2 x fsdp4 ZeRO-2: int8 grad reduce-scatter tracks the
         uncompressed run within int8 tolerance, loss still falls."""
@@ -62,6 +63,7 @@ class TestZeroPlusPlus:
         for a, b in zip(base, qgz):
             assert abs(a - b) / abs(a) < 0.05, (base, qgz)
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_qwz_loss_parity_stage3(self, eight_devices):
         """fsdp8 ZeRO-3: int8 param all-gather tracks the uncompressed
         run within int8 tolerance."""
@@ -82,6 +84,7 @@ class TestZeroPlusPlus:
                            zero_quantized_weights=True)
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_qwz_changes_collective_payload_in_hlo(self, eight_devices):
         """The compiled HLO must actually move int8 over the wire for
         the param gather when qwZ is on, and no s8 collectives when
@@ -103,6 +106,7 @@ class TestZeroPlusPlus:
         assert s8_collectives(txt_on), "qwZ HLO has no int8 all-gather"
         assert not s8_collectives(txt_off)
 
+    @pytest.mark.slow  # tier-1 diet (PR 5)
     def test_qgz_changes_collective_payload_in_hlo(self, eight_devices):
         mesh = MeshConfig(data=2, fsdp=4)
         eng_off, _ = _train(2, mesh, steps=1)
