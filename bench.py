@@ -30,6 +30,23 @@ import time
 import numpy as np
 
 
+def _memory_decomposition(pm):
+    """Compact memory-gauge block for a bench row's decomposition
+    (runtime/lifecycle.py memory_gauges schema)."""
+    if not pm:
+        return {}
+    return {
+        "device_gb_in_use": round(pm.get("device_bytes_in_use", 0)
+                                  / 1e9, 3),
+        "device_gb_peak": round(pm.get("device_peak_bytes", 0) / 1e9, 3),
+        "host_rss_gb": round(pm.get("host_rss_gb", 0.0), 3),
+        "live_executables": pm.get("live_executables", 0),
+        "live_arrays": pm.get("live_arrays", -1),
+        "live_array_gb": round(max(0, pm.get("live_array_bytes", 0))
+                               / 1e9, 3),
+    }
+
+
 def _run_engine_bench(model, config, seq, steps=5, metric="",
                       warmup=2):
     import jax
@@ -79,13 +96,22 @@ def _run_engine_bench(model, config, seq, steps=5, metric="",
     if breakdown:
         out["decomposition"] = {k: round(v, 2)
                                 for k, v in breakdown.items()}
+        # process-lifetime memory gauges (runtime/lifecycle.py): pins
+        # a baseline for config 4's week-long-process story — HBM in
+        # use, host RSS, live arrays, and how many AOT executables
+        # stay live. memory_gauges() directly: the report surfaces
+        # skip the live-array census and would drag the (discarded)
+        # HLO schedule parse along
+        from deepspeed_tpu.runtime.lifecycle import memory_gauges
+        out["decomposition"]["memory"] = _memory_decomposition(
+            memory_gauges())
     else:
         # non-offload rows: the compiled-step schedule report
         # (zero/schedule.py) — collective count, bytes moved, modeled
         # comm/compute overlap of the train-step executable, plus which
         # translator options actually applied on this backend
         sched = engine.get_schedule_report()
-        if sched:
+        if sched.get("collective_count") is not None:
             out["decomposition"] = {
                 "collective_count": sched["collective_count"],
                 "bytes_moved": round(sched["bytes_moved"], 1),
@@ -334,6 +360,7 @@ def bench_config5(weight_dtype="bfloat16"):
     assert all(len(v) == new for v in out.values())
     rep = v2.get_serving_report()
     decode_tps = rep["steady_decode_tps"]
+    from deepspeed_tpu.runtime.lifecycle import memory_gauges
 
     # reference point: FastGen's headline p50 TTFT target band is ~1s
     # class for 7B prompts (blogs/deepspeed-fastgen); vs_baseline here
@@ -363,6 +390,12 @@ def bench_config5(weight_dtype="bfloat16"):
             "itl_ms_p50": round(rep["itl_ms"].get("p50", 0.0), 3),
             "ttft_ms_p50": round(rep["ttft_ms"].get("p50", 0.0), 1),
             "kv_util_max": round(rep["kv_util"].get("max", 0.0), 4),
+            # process-lifetime memory baseline (runtime/lifecycle.py):
+            # makes the v1-prefill -> v2-decode HBM handoff risk (and
+            # any serving-loop leak) a pinned, diffable number. Full
+            # gauges (live-array census included) — the serving report
+            # itself stays census-free for pollability
+            "memory": _memory_decomposition(memory_gauges()),
         },
     }
 

@@ -48,6 +48,24 @@ class RaggedInferenceEngineConfig:
     attn_impl: str = "auto"          # auto / pallas / reference
     linear_impl: str = "auto"        # auto / woq_kernel / dense
     moe_impl: str = "auto"           # auto / expert_parallel / replicated
+    # -- long-run durability / overload robustness (README
+    # "Long-run durability"; runtime/lifecycle.py) --
+    # admission control: max requests outstanding (queued + active)
+    # per serving run; 0 = bounded only by max_tracked_sequences
+    max_queue_depth: int = 0
+    # refuse NEW admissions while KV-pool utilization is at/above this
+    # fraction (decode of already-admitted sequences continues);
+    # 1.0 = off
+    admission_kv_util_threshold: float = 1.0
+    # serving-loop dispatch watchdog deadline: a hung forward raises a
+    # typed CollectiveTimeout instead of wedging the loop; 0 = off.
+    # Auto-disarmed when tp_size/ep_size > 1 (multi-device programs
+    # must dispatch from the main thread — the PR-2 rendezvous rule)
+    dispatch_timeout_seconds: float = 0.0
+    # bound on the dispatch-signature set backing the recompile
+    # counter (an LRU-evicted signature would merely re-count one
+    # compile; the set must never grow without bound)
+    max_dispatch_signatures: int = 64
 
 
 class InferenceEngineV2:
@@ -159,12 +177,40 @@ class InferenceEngineV2:
                                             donate_argnums=(1,))
         # serving-loop state: FCFS aging for block-starved prompts,
         # dispatch-signature set (the recompile counter — the jit cache
-        # is keyed the same way: treedef + shapes, both fixed here),
+        # is keyed the same way: treedef + shapes, both fixed here;
+        # BOUNDED and registered with the lifecycle registry so a
+        # week-long server's signature set cannot grow without limit),
         # and the last serving run's metrics
+        from ...runtime.lifecycle import BoundedCache
         self._defer_age: Dict[int, int] = {}
-        self._seen_signatures = set()
+        self._seen_signatures = BoundedCache(
+            "v2_dispatch_signatures",
+            max_entries=max(1, ec.max_dispatch_signatures))
         self._last_dispatch_was_compile = False
         self._serving_metrics = None
+        # dispatch watchdog (resilience/watchdog.py reused): a hung
+        # ragged-forward dispatch raises CollectiveTimeout instead of
+        # wedging the serving loop. Multi-device programs must dispatch
+        # from the MAIN thread (XLA collective-rendezvous rule learned
+        # in the transfer-engine PR), so tp/ep spans disarm it.
+        from ...resilience.watchdog import CollectiveWatchdog
+        timeout = ec.dispatch_timeout_seconds or None
+        if timeout and (ec.tp_size > 1 or ec.ep_size > 1):
+            logger.warning(
+                "dispatch_timeout_seconds disabled: the watchdog "
+                "dispatches on a worker thread, which deadlocks XLA's "
+                "collective rendezvous for multi-device programs "
+                f"(tp_size={ec.tp_size}, ep_size={ec.ep_size})")
+            timeout = None
+        # timeout_seconds=0 (not None) so the COLLECTIVE watchdog's env
+        # var cannot silently arm the serving dispatch watchdog too
+        self._dispatch_watchdog = CollectiveWatchdog(timeout_seconds=0)
+        if timeout:
+            self._dispatch_watchdog.configure(timeout)
+        # latched by the serving loop when a dispatch blows its
+        # deadline: the abandoned worker may still mutate engine state,
+        # so subsequent runs are refused (see serving_loop._dispatch)
+        self._dispatch_poisoned = False
 
     def _init_mesh(self, tp: int, ep: int):
         from ...parallel.mesh import (EXPERT_AXIS, MeshConfig,
@@ -401,7 +447,7 @@ class InferenceEngineV2:
         is also latched on ``_last_dispatch_was_compile`` for callers
         whose return value is already spoken for (``put``)."""
         fresh = kind not in self._seen_signatures
-        self._seen_signatures.add(kind)
+        self._seen_signatures.put(kind, True)
         self._last_dispatch_was_compile = fresh
         return fresh
 
@@ -541,6 +587,54 @@ class InferenceEngineV2:
         self._defer_age.pop(uid, None)
         self._state_manager.flush_sequence(uid)
 
+    # -- admission control / backpressure -------------------------------
+    @property
+    def kv_utilization(self) -> float:
+        return 1.0 - self.free_blocks / max(1, self._config.n_kv_blocks)
+
+    def admit_requests(self, requests: Dict[int, "np.ndarray"],
+                       active: int = 0
+                       ) -> Tuple[Dict[int, "np.ndarray"], List[int]]:
+        """Admission control for new serving requests: returns
+        ``(admitted, shed_uids)``. Requests are considered in dict
+        order (arrival order); one ``serving.admit`` fault-site fire
+        per considered request. A request is SHED (not failed — the
+        caller decides whether shedding is an error) when:
+
+        * ``max_queue_depth`` > 0 and admitting it would push
+          outstanding work (``active`` in-flight sequences + already
+          admitted) past the bound, or
+        * KV-pool utilization is at/above
+          ``admission_kv_util_threshold`` (new prompts would only deepen
+          an existing overload; decode of admitted sequences continues
+          and frees blocks).
+
+        Shedding never mutates engine state: a shed uid can be
+        resubmitted verbatim once load drains.
+        """
+        from ...resilience.fault_injector import fault_injector
+        ec = self._config
+        admitted: Dict[int, np.ndarray] = {}
+        shed: List[int] = []
+        kv_gate = (ec.admission_kv_util_threshold < 1.0 and
+                   self.kv_utilization >= ec.admission_kv_util_threshold)
+        for uid, toks in requests.items():
+            fault_injector.fire("serving.admit", detail=str(uid))
+            depth_gate = (ec.max_queue_depth > 0 and
+                          active + len(admitted) >= ec.max_queue_depth)
+            if depth_gate or kv_gate:
+                shed.append(uid)
+            else:
+                admitted[uid] = toks
+        if shed:
+            bound = ec.max_queue_depth or "off"
+            logger.warning(
+                f"admission control shed {len(shed)}/{len(requests)} "
+                f"request(s) (queue_depth bound={bound}, "
+                f"kv_util={self.kv_utilization:.3f}, "
+                f"threshold={ec.admission_kv_util_threshold})")
+        return admitted, shed
+
     # -- Dynamic SplitFuse scheduler + serving loop ---------------------
     def _blocks_needed(self, uid: int, n_tokens: int) -> int:
         ec = self._config
@@ -605,7 +699,8 @@ class InferenceEngineV2:
                        max_new_tokens: int = 32,
                        eos_token_id: Optional[int] = None,
                        sampling=None,
-                       mode: str = "lookahead") -> Dict[int, List[int]]:
+                       mode: str = "lookahead",
+                       on_overload: str = "raise") -> Dict[int, List[int]]:
         """Continuous-batching serving loop (the MII-side loop the
         reference leaves out of deepspeed; here for tests/benchmarks).
         Greedy by default; pass ``sampling=SamplingParams(...)`` (or a
@@ -622,15 +717,34 @@ class InferenceEngineV2:
         identical between "lookahead" and "sync" (per-(seed, uid,
         position) keyed draws). Per-step metrics land in
         ``get_serving_report()``.
+
+        ``on_overload`` decides what happens when admission control
+        (``max_queue_depth`` / ``admission_kv_util_threshold``) cannot
+        take every prompt: ``"raise"`` (default) raises a typed
+        ``ServingOverloadError`` before any work; ``"shed"`` serves
+        the admitted subset and reports the shed uids in
+        ``get_serving_report()["admission"]["shed_uids"]`` (shed
+        prompts are absent from the returned dict and can be
+        resubmitted verbatim).
         """
         from .serving_loop import run_serving_loop
         return run_serving_loop(self, prompts,
                                 max_new_tokens=max_new_tokens,
                                 eos_token_id=eos_token_id,
-                                sampling=sampling, mode=mode)
+                                sampling=sampling, mode=mode,
+                                on_overload=on_overload)
 
     def get_serving_report(self) -> dict:
         """Metrics report of the most recent generate_batch run (see
-        inference/v2/metrics.py for the schema); {} before any run."""
-        return (self._serving_metrics.report()
-                if self._serving_metrics is not None else {})
+        inference/v2/metrics.py for the schema); {} before any run —
+        except the process-lifetime memory gauges
+        (runtime/lifecycle.py), which are always attached under
+        ``process_memory``."""
+        from ...runtime.lifecycle import memory_gauges
+        out = (self._serving_metrics.report()
+               if self._serving_metrics is not None else {})
+        # include_arrays=False: a front-end may poll this per request;
+        # the live-buffer census walks every jax buffer in the process
+        # (deep probes call lifecycle.memory_gauges() directly)
+        out["process_memory"] = memory_gauges(include_arrays=False)
+        return out

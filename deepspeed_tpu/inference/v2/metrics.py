@@ -53,6 +53,11 @@ class ServingMetrics:
         self._itl_s: List[float] = []
         self._last_emit: Dict[int, float] = {}
         self.cancelled_steps = 0
+        # admission control (engine.admit_requests): what the run was
+        # asked to serve vs what backpressure let in
+        self.requested = 0
+        self.admitted = 0
+        self.shed_uids: List[int] = []
 
     def now(self) -> float:
         return self._clock()
@@ -83,6 +88,12 @@ class ServingMetrics:
 
     def record_cancelled(self, n: int = 1) -> None:
         self.cancelled_steps += n
+
+    def record_admission(self, requested: int, admitted: int,
+                         shed_uids: List[int]) -> None:
+        self.requested = requested
+        self.admitted = admitted
+        self.shed_uids = list(shed_uids)
 
     # -- reporting ----------------------------------------------------
     def _steady_window(self) -> List[dict]:
@@ -115,6 +126,10 @@ class ServingMetrics:
             "steady_decode_tps": (steady_tokens / steady_wall
                                   if steady_wall > 0 else 0.0),
             "cancelled_speculative_steps": self.cancelled_steps,
+            "admission": {"requested": self.requested,
+                          "admitted": self.admitted,
+                          "shed": len(self.shed_uids),
+                          "shed_uids": list(self.shed_uids)},
             "dispatch_ms": _stats([s["dispatch_s"] for s in steps], 1e3),
             "sync_wait_ms": _stats([s["sync_wait_s"] for s in steps],
                                    1e3),

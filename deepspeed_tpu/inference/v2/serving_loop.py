@@ -35,9 +35,11 @@ from typing import Dict, List, Optional, Set
 
 import numpy as np
 
+from ...resilience.errors import ServingOverloadError
+from ...resilience.fault_injector import fault_injector
 from ..sampling import SamplingParams
 from .metrics import ServingMetrics
-from .ragged_manager import SchedulingError, SchedulingResult
+from .ragged_manager import SchedulingError, SchedulingResult  # noqa: F401 — re-exported for loop callers
 
 
 # best-effort async D2H kick so the later np.asarray mostly finds the
@@ -93,12 +95,25 @@ def _base_key(sampling):
 
 def run_serving_loop(engine, prompts, *, max_new_tokens: int,
                      eos_token_id: Optional[int], sampling,
-                     mode: str) -> Dict[int, List[int]]:
+                     mode: str,
+                     on_overload: str = "raise") -> Dict[int, List[int]]:
     if mode not in ("lookahead", "sync", "sync_host"):
         # validate BEFORE touching engine state so a typo'd mode does
         # not clobber the previous run's metrics report
         raise ValueError(
             f"mode must be lookahead/sync/sync_host, got {mode!r}")
+    if on_overload not in ("raise", "shed"):
+        raise ValueError(
+            f"on_overload must be raise/shed, got {on_overload!r}")
+    if getattr(engine, "_dispatch_poisoned", False):
+        # a previous dispatch blew its watchdog deadline; its worker
+        # thread may still be alive inside the runtime — new runs on
+        # this engine would race it (see _dispatch)
+        raise ServingOverloadError(
+            "engine poisoned by a dispatch watchdog timeout — "
+            "rebuild the engine (or respawn the worker process)",
+            queue_depth=len(prompts), kv_util=engine.kv_utilization,
+            free_blocks=engine.free_blocks)
     pending = {uid: np.asarray(p, np.int32).reshape(-1)
                for uid, p in prompts.items()}
     for uid, p in pending.items():
@@ -107,22 +122,81 @@ def run_serving_loop(engine, prompts, *, max_new_tokens: int,
             # wrapper's logits_idx would alias another row's tail and
             # emit garbage
             raise ValueError(f"empty prompt for uid {uid}")
-    out: Dict[int, List[int]] = {uid: [] for uid in prompts}
+    # admission control / backpressure BEFORE any engine state moves:
+    # a rejected run must leave the engine exactly as it found it
+    admitted, shed = engine.admit_requests(pending)
+    if shed and on_overload == "raise":
+        raise ServingOverloadError(
+            "admission control rejected the request batch",
+            queue_depth=len(pending), kv_util=engine.kv_utilization,
+            free_blocks=engine.free_blocks, shed_uids=shed)
+    pending = admitted
+    out: Dict[int, List[int]] = {uid: [] for uid in pending}
     metrics = ServingMetrics(mode, engine._config.n_kv_blocks)
+    metrics.record_admission(len(prompts), len(admitted), shed)
     engine._serving_metrics = metrics
     # defer-ages are per-run scheduling state: an aborted run must not
     # leak priority (or dict entries) into unrelated later requests
     engine._defer_age.clear()
-    if mode == "lookahead":
-        _run_lookahead(engine, pending, out, max_new_tokens,
-                       eos_token_id, sampling, metrics)
-    elif mode == "sync":
-        _run_sync(engine, pending, out, max_new_tokens, eos_token_id,
-                  sampling, metrics)
-    else:
-        _run_sync_host(engine, pending, out, max_new_tokens,
-                       eos_token_id, sampling, metrics)
+    if not pending:
+        return out
+    try:
+        if mode == "lookahead":
+            _run_lookahead(engine, pending, out, max_new_tokens,
+                           eos_token_id, sampling, metrics)
+        elif mode == "sync":
+            _run_sync(engine, pending, out, max_new_tokens,
+                      eos_token_id, sampling, metrics)
+        else:
+            _run_sync_host(engine, pending, out, max_new_tokens,
+                           eos_token_id, sampling, metrics)
+    except ServingOverloadError:
+        # the run is dead but the ENGINE must stay serviceable: free
+        # this run's sequences and KV blocks, or a front-end that
+        # catches the typed error and keeps serving inherits a pool
+        # pinned at the exhausted level forever
+        for uid in out:
+            engine.flush(uid)
+        raise
     return out
+
+
+def _dispatch(engine, fn):
+    """One serving forward dispatch: through the engine's dispatch
+    watchdog (a hang raises a typed ``CollectiveTimeout`` instead of
+    wedging the loop) with the ``serving.dispatch`` fault site fired
+    INSIDE the watched call — so an injected ``hang`` spec exercises
+    exactly the deadline path a real wedged runtime would.
+
+    A fired deadline POISONS the engine: the abandoned worker thread
+    cannot be killed and may later resume inside ``put_sampled``,
+    mutating sequence/KV accounting concurrently with whatever runs
+    next — so further serving runs on this engine are refused
+    (``run_serving_loop`` raises up front). The watchdog contract is
+    worker replacement: surface the typed error, let the supervisor
+    respawn the process/engine."""
+    from ...resilience.errors import CollectiveTimeout
+
+    def watched():
+        fault_injector.fire("serving.dispatch")
+        return fn()
+
+    try:
+        return engine._dispatch_watchdog.run("serving.dispatch", watched)
+    except CollectiveTimeout:
+        engine._dispatch_poisoned = True
+        raise
+
+
+def _stuck(engine, pending, reason) -> ServingOverloadError:
+    """Typed terminal overload: nothing schedulable, nothing in flight
+    that could free blocks. Carries the saturation numbers a front-end
+    or router needs (the collect-only drain already happened — the
+    loops only land here once every in-flight step has been
+    collected)."""
+    return ServingOverloadError(
+        reason, queue_depth=len(pending),
+        kv_util=engine.kv_utilization, free_blocks=engine.free_blocks)
 
 
 def _emit(out, metrics, remaining, uid, tok, eos):
@@ -164,10 +238,14 @@ def _run_sync(engine, pending, out, max_new, eos, sampling, metrics):
         t0 = metrics.now()
         uids, toks = engine.schedule(pending, decode)
         if not uids:
-            raise SchedulingError(SchedulingResult.OutOfKVBlocks)
+            # the sync loop has nothing in flight: empty schedule with
+            # live sequences is terminal, not drainable
+            raise _stuck(engine, pending,
+                         "no schedulable work (out of KV blocks)")
         emit, n_prompt = _trim_prompts(pending, uids, toks)
-        tokens_dev, _, recompiled = engine.put_sampled(
-            uids, toks, sampling=sampling, base_key=base_key)
+        tokens_dev, _, recompiled = _dispatch(
+            engine, lambda: engine.put_sampled(
+                uids, toks, sampling=sampling, base_key=base_key))
         t1 = metrics.now()
         _start_host_copy(tokens_dev)
         toks_host = np.asarray(tokens_dev)     # the per-step sync
@@ -223,10 +301,11 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
                 v = decode.get(uid)
                 srcs.append(v.slot if isinstance(v, _Ref) else -1)
             emit, n_prompt = _trim_prompts(pending, uids, toks)
-            tokens_dev, committed, recompiled = engine.put_sampled(
-                uids, toks, src_slots=srcs,
-                prev_tokens=inflight.tokens if inflight else None,
-                sampling=sampling, base_key=base_key)
+            tokens_dev, committed, recompiled = _dispatch(
+                engine, lambda: engine.put_sampled(
+                    uids, toks, src_slots=srcs,
+                    prev_tokens=inflight.tokens if inflight else None,
+                    sampling=sampling, base_key=base_key))
             _start_host_copy(tokens_dev)
             step = _Step(uids=uids, emit=emit, tokens=tokens_dev,
                          slot={u: i for i, u in enumerate(uids)},
@@ -238,8 +317,12 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
                     decode[uid] = _Ref(step, row)
         elif inflight is None:
             # nothing schedulable and nothing in flight that could
-            # free blocks -> genuinely stuck
-            raise SchedulingError(SchedulingResult.OutOfKVBlocks)
+            # free blocks -> genuinely stuck. (empty + inflight is the
+            # graceful path: this iteration collects the in-flight
+            # step — a drain — and retries the schedule next loop)
+            raise _stuck(engine, pending,
+                         "no schedulable work and nothing in flight "
+                         "(out of KV blocks)")
         t1 = metrics.now()
 
         # ---- collect step k while k+1 computes (EOS/detokenization is
@@ -299,10 +382,12 @@ def _run_sync_host(engine, pending, out, max_new, eos, sampling,
         t0 = metrics.now()
         uids, toks = engine.schedule(pending, decode)
         if not uids:
-            raise SchedulingError(SchedulingResult.OutOfKVBlocks)
+            raise _stuck(engine, pending,
+                         "no schedulable work (out of KV blocks)")
         emit, n_prompt = _trim_prompts(pending, uids, toks)
         t1 = metrics.now()
-        logits = engine.put(uids, toks)        # host round-trip
+        logits = _dispatch(engine,
+                           lambda: engine.put(uids, toks))  # host round-trip
         recompiled = engine._last_dispatch_was_compile
         t2 = metrics.now()
         n_new = 0

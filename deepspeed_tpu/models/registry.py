@@ -25,7 +25,7 @@ class ModelPolicy:
     hf_keys: tuple          # state-dict key prefixes that identify it
 
 
-POLICIES: Dict[str, ModelPolicy] = {}
+POLICIES: Dict[str, ModelPolicy] = {}  # unbounded-ok: static registry, one entry per model family at import time
 
 
 def register(policy: ModelPolicy):

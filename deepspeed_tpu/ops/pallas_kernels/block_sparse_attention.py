@@ -25,6 +25,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...runtime.lifecycle import BoundedCache
+
 _NEG_INF = float("-inf")
 
 
@@ -346,25 +348,24 @@ class _LayoutTables:
         return isinstance(other, _LayoutTables) and self.key == other.key
 
 
-# interning dict: equal layouts share one handle so repeated calls hit
-# the jit cache. Bounded: regenerating layouts per step (e.g. reseeded
-# bigbird) must not grow host memory forever — eviction only drops the
-# interning entry, never tables a live trace references.
-_LAYOUTS = {}
-_LAYOUTS_MAX = 64
+# interning cache: equal layouts share one handle so repeated calls hit
+# the jit cache. Bounded + registered with the lifecycle registry
+# (runtime/lifecycle.py): regenerating layouts per step (e.g. reseeded
+# bigbird) must not grow host memory forever, and the cache's size/
+# eviction stats surface in the process memory gauges — eviction only
+# drops the interning entry, never tables a live trace references.
+_LAYOUTS = BoundedCache("pallas_layout_tables", max_entries=64)
 
 
 def _register_layout(layout: np.ndarray, causal: bool, block_q: int,
                      block_k: int):
     key = (layout.tobytes(), layout.shape, bool(causal), block_q, block_k)
-    if key not in _LAYOUTS:
-        while len(_LAYOUTS) >= _LAYOUTS_MAX:
-            _LAYOUTS.pop(next(iter(_LAYOUTS)))  # FIFO eviction
-        _LAYOUTS[key] = _LayoutTables(
+    entry = _LAYOUTS.get(key)
+    if entry is None:
+        entry = _LayoutTables(
             key, _tables(layout, causal, block_q, block_k))
-    else:
-        _LAYOUTS[key] = _LAYOUTS.pop(key)  # refresh recency
-    return _LAYOUTS[key]
+        _LAYOUTS.put(key, entry)
+    return entry
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))

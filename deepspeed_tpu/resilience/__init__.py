@@ -11,7 +11,8 @@ elastic respawn). Config lives under the ``resilience`` block
 
 from .errors import (CheckpointCorruptionError, CheckpointLoadError,  # noqa: F401
                      CollectiveTimeout, InjectedFault, InjectedIOError,
-                     ResilienceError, TrainingDivergenceError)
+                     ResilienceError, ServingOverloadError,
+                     TrainingDivergenceError)
 from .fault_injector import (FaultInjector, FaultSpec,  # noqa: F401
                              KNOWN_SITES, fault_injector)
 from .integrity import (MANIFEST_NAME, atomic_write_bytes,  # noqa: F401

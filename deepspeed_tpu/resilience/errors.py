@@ -41,6 +41,30 @@ class TrainingDivergenceError(ResilienceError):
     non-finite/spiking."""
 
 
+class ServingOverloadError(ResilienceError):
+    """The serving engine cannot make progress or accept work within
+    its configured bounds: the request queue is past
+    ``max_queue_depth``, KV utilization crossed the admission
+    threshold, or active sequences are wedged with no schedulable work
+    and nothing in flight to free blocks. Typed (with the saturation
+    numbers attached) so a front-end can answer 429/503 and a router
+    can steer traffic — a raw OutOfKVBlocks string can do neither."""
+
+    def __init__(self, reason: str, *, queue_depth: int = 0,
+                 kv_util: float = 0.0, free_blocks: int = 0,
+                 shed_uids=()):
+        self.reason = reason
+        self.queue_depth = queue_depth
+        self.kv_util = kv_util
+        self.free_blocks = free_blocks
+        self.shed_uids = tuple(shed_uids)
+        super().__init__(
+            f"serving overload: {reason} (queue_depth={queue_depth}, "
+            f"kv_util={kv_util:.3f}, free_blocks={free_blocks}"
+            + (f", shed={len(self.shed_uids)} request(s)"
+               if self.shed_uids else "") + ")")
+
+
 class InjectedFault(ResilienceError):
     """A deliberately injected failure (FaultInjector). Base class so
     tests can distinguish injected faults from organic ones."""

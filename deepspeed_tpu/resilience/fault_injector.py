@@ -16,6 +16,15 @@ sites wired through the stack:
     transfer.h2d        bucketed transfer engine: one fire per fused
                         bucket upload
     data.fetch          dataloader batch assembly (runtime/dataloader.py)
+    lifecycle.evict     bounded-cache LRU eviction (runtime/lifecycle.py;
+                        fires BEFORE state changes, so an injected
+                        fault leaves the cache consistent)
+    serving.admit       serving admission control, one fire per
+                        admitted/considered request
+                        (inference/v2/engine_v2.py admit_requests)
+    serving.dispatch    serving-loop forward dispatch, inside the
+                        dispatch watchdog's deadline (a ``hang`` spec
+                        here is how the watchdog path is tested)
 
 Spec grammar (config ``resilience.fault_injection`` or env
 ``DSTPU_FAULT_INJECT``), comma-separated entries::
@@ -49,7 +58,8 @@ from .errors import InjectedFault, InjectedIOError
 KNOWN_SITES = (
     "checkpoint.save", "checkpoint.load", "collective",
     "offload.d2h", "offload.h2d", "transfer.d2h", "transfer.h2d",
-    "data.fetch",
+    "data.fetch", "lifecycle.evict", "serving.admit",
+    "serving.dispatch",
 )
 
 _KINDS = ("ioerror", "error", "hang")

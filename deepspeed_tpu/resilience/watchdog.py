@@ -17,6 +17,15 @@ Disabled by default (``timeout=None``): the dispatch is then a direct
 call with zero threading overhead. Enable via the config block
 ``resilience.collective_timeout_seconds`` or env
 ``DSTPU_COLLECTIVE_TIMEOUT``.
+
+The class is also reused as the v2 serving loop's DISPATCH watchdog
+(``RaggedInferenceEngineConfig.dispatch_timeout_seconds``): a hung
+ragged-forward dispatch raises ``CollectiveTimeout`` instead of
+wedging the lookahead loop forever. Caveat inherited from the PR-2
+threading rule: compiled MULTI-device programs must dispatch from the
+main thread (worker-thread dispatch concurrent with other device work
+deadlocks XLA's collective rendezvous), so the serving engine disarms
+the dispatch watchdog when tp_size/ep_size > 1.
 """
 
 import os

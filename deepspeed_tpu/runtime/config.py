@@ -144,6 +144,40 @@ class CompileCacheConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class LifecycleConfig(DeepSpeedConfigModel):
+    """Long-run durability knobs (runtime/lifecycle.py): bounds for
+    the process-lifetime caches and lifecycle-boundary invalidation.
+    Defaults are safe for week-long processes; see README
+    "Long-run durability" for the full semantics."""
+    # distinct call signatures each compiled step (train/eval/grad/
+    # apply) may hold AOT executables for before LRU eviction
+    max_step_executables: int = 8
+    # drop every AOT step executable when load_checkpoint replaces the
+    # engine state (post-restore hygiene); turning this off is
+    # strictly a debugging aid
+    invalidate_on_restore: bool = True
+    # copy every restored state leaf through host into FRESH XLA-owned
+    # buffers before any (donating) step runs. The restore stack
+    # (orbax/TensorStore) hands back arrays whose buffers jax does not
+    # exclusively own; donating those into a compiled step is the
+    # post-restore XLA-CPU abort/NaN trigger (see README "Long-run
+    # durability"). Costs one host round trip per restore.
+    rebuffer_on_restore: bool = True
+    # run lifecycle.sweep() (cyclic GC + gauge log) every N global
+    # steps; 0 disables. The engine object graph is cyclic, so
+    # long-running trainers that rebuild engines/steps should sweep
+    sweep_interval_steps: int = 0
+    # offload engines: for N train steps after a restore, verify every
+    # offloaded DEVICE leaf against its host authority (the delta
+    # mirror / compute-rounded master) and repair violations by
+    # re-uploading the host master (offload.verify_and_repair). The
+    # observed long-process failure is the device copy going bad while
+    # host state stays sound; the host master is exact, so so is the
+    # repair. 0 disables.
+    verify_steps_after_restore: int = 3
+
+
+@dataclasses.dataclass
 class SentinelConfig(DeepSpeedConfigModel):
     """Train-loop sentinel (resilience subsystem): NaN/Inf + loss-spike
     detection with a consecutive-failure budget, auto-rollback to the
@@ -241,6 +275,8 @@ class DeepSpeedConfig:
         self.pipeline_config = PipelineConfig.from_dict(d.get(PIPELINE, {}))
         self.resilience_config = ResilienceConfig.from_dict(
             d.get("resilience", {}))
+        self.lifecycle_config = LifecycleConfig.from_dict(
+            d.get("lifecycle", {}))
         # curriculum learning: legacy top-level section or nested under
         # data_efficiency.data_sampling (reference: data_pipeline/config.py)
         self.curriculum_config = d.get("curriculum_learning", None)

@@ -95,7 +95,7 @@ class DeepSpeedConfigModel:
 
 # knob audit: one process-wide warning per (section, field) the first
 # time a [compat]-tagged knob is set away from its default
-_COMPAT_WARNED = set()
+_COMPAT_WARNED = set()  # unbounded-ok: keyed by the finite set of config fields
 
 
 def warn_inert_compat_fields(obj):

@@ -236,6 +236,7 @@ class DeepSpeedEngine:
         # partial ratio = ZeRO-Offload++ engine.py:725)
         self._offload = None
         self._offload_cfg = None
+        self._offload_verify_steps = 0   # armed by load_checkpoint
         if zc.offload_optimizer.device in ("cpu", "nvme"):
             self._offload_cfg = zc.offload_optimizer
             if zc.offload_optimizer.device == "nvme" and \
@@ -843,12 +844,25 @@ class DeepSpeedEngine:
         """Drop every compiled step program (train/eval/grad/apply);
         each rebuilds lazily on next use with the current config. The
         schedule-report registry clears too — a report for a discarded
-        executable would describe the OLD gas/shape configuration."""
+        executable would describe the OLD gas/shape configuration.
+        Each step is invalidated FIRST so its executables release now,
+        not whenever the cyclic GC next visits the dead wrappers."""
+        self._invalidate_compiled_steps("reset")
         self._jit_train_step = None
         self._jit_eval_step = None
         self._jit_grad_step = None
         self._jit_apply_grads = None
         self._scheduled_steps.clear()
+
+    def _invalidate_compiled_steps(self, reason):
+        """Drop the AOT executables of every compiled step while
+        keeping the step wrappers wired (next call re-lowers and
+        re-compiles). ``load_checkpoint`` calls this: re-entering a
+        cached executable that DONATES freshly restored ``device_put``
+        buffers is the post-restore abort's trigger site (see
+        runtime/lifecycle.py and README "Long-run durability")."""
+        for step in self._scheduled_steps.values():
+            step.invalidate(reason)
 
     def _invalidate_batch_shape_caches(self):
         """Profiling lowerings are keyed on the old batch shapes; a
@@ -962,10 +976,14 @@ class DeepSpeedEngine:
         folds in the gas count so accumulation changes invalidate
         exactly the steps they affect."""
         from .zero.schedule import ScheduledStep
+        cap = self._config.lifecycle_config.max_step_executables
         step = ScheduledStep(
             jitted, options=self._step_options, label=label,
             static_argnums=static_argnums,
-            key_extras=(self.gradient_accumulation_steps(),))
+            key_extras=(self.gradient_accumulation_steps(),),
+            # <= 0 means unbounded, matching the sibling lifecycle
+            # knobs' 0-disables convention
+            max_entries=cap if cap and cap > 0 else None)
         self._scheduled_steps[label] = step
         return step
 
@@ -974,9 +992,19 @@ class DeepSpeedEngine:
         collective count, bytes moved, and the modeled comm/compute
         overlap estimate (zero/schedule.py schedule_report; computed
         lazily from the compiled HLO). Empty dict until that step has
-        compiled (or when the AOT path fell back)."""
+        compiled (or when the AOT path fell back). Always carries the
+        process-lifetime memory gauges under ``process_memory``
+        (runtime/lifecycle.py — device HBM, host RSS, live
+        executables, registered cache sizes)."""
+        from .lifecycle import memory_gauges
         s = self._scheduled_steps.get(step)
-        return dict(s.schedule_report()) if s is not None else {}
+        out = dict(s.schedule_report()) if s is not None else {}
+        # include_arrays=False: the live-buffer census is O(all live
+        # arrays) — too heavy for a pollable report surface. Deep
+        # probes (soak harness, bench) call lifecycle.memory_gauges()
+        # directly for the full census.
+        out["process_memory"] = memory_gauges(include_arrays=False)
+        return out
 
     def _onebit_mesh_info(self):
         """(batch_axes, world) + the error-buffer spec rule — ONE source
@@ -1800,12 +1828,16 @@ class DeepSpeedEngine:
                 # jitted step dispatch above is async, so submitting
                 # before any metric read keeps the pipeline full.
                 self._merge_offload_future()
+                # guard point: host thread idle, device merged through
+                # step N-1 — the one coherent instant in DPU mode
+                self._verify_offload_if_armed()
                 self._offload_future = self._offload.apply_grads_async(
                     self.state.master_params, off_grads, lr=lr, skip=skip)
             else:
                 new_master = self._offload.apply_grads(
                     self.state.master_params, off_grads, lr=lr, skip=skip)
                 self.state = self.state._replace(master_params=new_master)
+                self._verify_offload_if_armed()
         self.timers(TRAIN_BATCH_TIMER).stop(sync=True)
         self.tput_timer.stop(global_step=True)
 
@@ -1847,6 +1879,11 @@ class DeepSpeedEngine:
         loss = metrics["loss"]
         self._last_loss = loss
         self._write_monitor(metrics)
+        sweep_every = self._config.lifecycle_config.sweep_interval_steps
+        if sweep_every and self.global_steps and \
+                self.global_steps % sweep_every == 0:
+            from .lifecycle import sweep
+            sweep(f"train step {self.global_steps}")
         if self._config.steps_per_print and \
                 self.global_steps % self._config.steps_per_print == 0:
             log_dist(
@@ -1856,6 +1893,24 @@ class DeepSpeedEngine:
                 f"grad_norm={float(metrics['grad_norm']):.3f}"
                 f"{self._mfu_suffix()}", ranks=[0])
         return loss
+
+    def _verify_offload_if_armed(self):
+        """Post-restore corruption guard (lifecycle config
+        ``verify_steps_after_restore``): for N steps after a restore,
+        the device copies of offloaded leaves are re-checked against
+        the host authority — mirror or compute-rounded master — and
+        repaired in place on violation (offload.verify_and_repair;
+        README "Long-run durability" has the observed failure mode
+        this exists for). Call only at points where the host step is
+        NOT in flight (sync path post-merge; DPU path between the
+        future's merge and the next submission)."""
+        if self._offload_verify_steps <= 0:
+            return
+        self._offload_verify_steps -= 1
+        n_bad, fixed = self._offload.verify_and_repair(
+            self.state.master_params)
+        if n_bad:
+            self.state = self.state._replace(master_params=fixed)
 
     def _sentinel_rollback(self):
         """Auto-rollback: after the sentinel's consecutive-failure
@@ -1954,6 +2009,7 @@ class DeepSpeedEngine:
         out = dict(self._offload.last_breakdown)
         out["overlap_residue_ms"] = getattr(self, "_offload_wait_ms",
                                             0.0)
+        out["post_restore_repairs"] = self._offload.repairs
         return out
 
     def forward(self, batch):
@@ -2339,6 +2395,42 @@ class DeepSpeedEngine:
         self.checkpoint_engine.commit(tag)
         return True
 
+    def _rebuffer_state(self, state):
+        """Copy every restored leaf through host into fresh XLA-owned
+        buffers (values bit-identical; placement preserved, including
+        the uncommitted single-device scalars).
+
+        Why: the restore stack (orbax/TensorStore) builds jax arrays
+        over buffers whose ownership jax does not exclusively control,
+        and the very next train_batch DONATES them into an AOT
+        executable. On a young heap that latent hazard stays invisible
+        — which is why the restore tests pass standalone — but in a
+        long process (hot, fragmented heap) it surfaced as the
+        localized XLA-CPU SIGABRT or NaN losses at this exact site
+        (README "Long-run durability" has the full root-cause
+        writeup). An explicit host round trip severs any foreign
+        ownership before donation can touch it. Restores are rare;
+        the copy is noise next to the shard read itself."""
+        from jax.sharding import SingleDeviceSharding
+
+        def fresh(x):
+            if not isinstance(x, jax.Array):
+                return x
+            if not x.is_fully_addressable:
+                # multi-host: np.array cannot gather a cross-host
+                # array; those restores come through the collective
+                # path, which already owns its buffers
+                return x
+            host = np.array(x)          # blocking D2H, breaks aliasing
+            if isinstance(x.sharding, SingleDeviceSharding):
+                # eager scalars stay UNCOMMITTED (a committed device-0
+                # placement would conflict at the next jit call — same
+                # rule as checkpoint/engine._decommit_single_device)
+                return jnp.asarray(host, dtype=x.dtype)
+            return jax.device_put(host, x.sharding)
+
+        return jax.tree_util.tree_map(fresh, state)
+
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False):
         self._merge_offload_future()
@@ -2347,6 +2439,8 @@ class DeepSpeedEngine:
                              "(pass model_parameters or run a batch)")
         state, client_state = self.checkpoint_engine.load(
             load_dir, tag, self.state)
+        if self._config.lifecycle_config.rebuffer_on_restore:
+            state = self._rebuffer_state(state)
         z = None
         if self._offload is not None and load_optimizer_states:
             from ..checkpoint.engine import resolve_tag
@@ -2406,6 +2500,20 @@ class DeepSpeedEngine:
             # the mirror tracks the DEVICE leaves; it must follow every
             # state replacement, not just optimizer-state reloads
             self._offload.resync_mirror(self.state.master_params)
+        if self._config.lifecycle_config.invalidate_on_restore:
+            # every state leaf was just rebuilt by device_put; the next
+            # step must compile against THOSE buffers instead of
+            # re-entering a cached executable that donates them — the
+            # post-restore XLA-CPU abort's trigger site (root cause in
+            # runtime/lifecycle.py; regression test in
+            # tests/unit/runtime/test_lifecycle.py)
+            self._invalidate_compiled_steps("checkpoint_restore")
+        if self._offload is not None:
+            # arm the post-restore corruption guard: the next N steps
+            # verify device leaves against the host authority and
+            # repair violations (offload.verify_and_repair)
+            self._offload_verify_steps = \
+                self._config.lifecycle_config.verify_steps_after_restore
         if client_state:
             self.global_steps = client_state.get("global_steps", 0)
             self.global_samples = client_state.get("global_samples", 0)
@@ -2419,6 +2527,36 @@ class DeepSpeedEngine:
                     g["period"] = int(saved["period"])
                     g["next_drop"] = saved["next_drop"]
         return load_dir, client_state
+
+    def close(self):
+        """Deterministically release this engine's process-lifetime
+        resources: flush the in-flight offload update, stop the offload
+        worker thread, drop every AOT executable, and release the
+        device state tree. The engine object graph is CYCLIC (engine ->
+        step closures -> engine), so without close() a dropped engine's
+        buffers and executables survive until the cyclic GC happens to
+        run — the process-lifetime growth behind the long-run XLA-CPU
+        aborts (see runtime/lifecycle.py). Idempotent; the engine is
+        unusable for training afterwards (state is gone)."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        self._merge_offload_future()
+        if self._offload is not None:
+            pool = getattr(self._offload, "_pool", None)
+            if pool is not None:
+                pool.shutdown(wait=True)
+            if self._offload.store is not None:
+                # NVMe tier: release the O_DIRECT fd + native IO pool
+                # now, not whenever the cyclic GC reaches __del__
+                self._offload.store.close()
+        self._reset_compiled_steps()
+        self.state = None
+        self._accum_grads = None
+        self._offload_grad_residual = ()
+        self._invalidate_batch_shape_caches()
+        self.data_iterator = None
+        self.training_dataloader = None
 
     # ------------------------------------------------------------------
     # misc parity surface

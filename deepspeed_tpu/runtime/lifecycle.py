@@ -1,0 +1,350 @@
+"""Process-lifetime lifecycle: bounded caches, memory gauges, leak checks.
+
+A server that serves millions of users is a server that runs for weeks,
+and a process that runs for weeks dies by a thousand unbounded caches.
+This module is the one place every process-lifetime cache in the stack
+registers itself, so that
+
+* every cache is **bounded** (LRU eviction at a configured cap) and
+  **explicitly evictable** (``invalidate`` hooks fired at lifecycle
+  boundaries such as checkpoint restore),
+* the process's memory story is **observable** (``memory_gauges()``:
+  device HBM, host RSS, live executables, live arrays, per-cache
+  sizes — published through ``engine.get_schedule_report()`` and
+  ``InferenceEngineV2.get_serving_report()``), and
+* leaks are **testable** (``LeakCheck``: snapshot gauges across N
+  save/restore/train or serve cycles and assert bounded,
+  non-monotonic growth — the soak harness).
+
+Root cause this subsystem exists for (the post-restore XLA-CPU abort,
+quarantined since PR 5 at ``test_offload.py::TestCompressedWire::
+test_mirror_resynced_after_checkpoint_restore``) — two layers:
+
+1. **The hostile heap** (why only long processes): the engine's
+   object graph carries ~2k reference CYCLES (engine <-> closures <->
+   ScheduledStep), so a dead engine — its device buffers, host
+   optimizer state, and AOT executables — is only reclaimed by the
+   *cyclic* GC, which Python runs on allocation-count heuristics
+   blind to the megabytes each cycle pins. A long single-process run
+   (the full test suite; a long-lived server that rebuilds engines)
+   accumulates dead engines between gen-2 passes (measured: ~41
+   leaked device arrays and ~16 MB RSS per engine lifecycle with gc
+   deferred, monotonic), keeping the allocator hot and fragmented —
+   the state in which latent buffer-lifetime bugs stop being latent.
+
+2. **The trigger** (why this site): ``load_checkpoint`` hands the
+   engine state whose buffers the restore stack (orbax/TensorStore)
+   built and whose ownership jax does not exclusively control, and
+   the very next ``train_batch`` DONATES them into an AOT-compiled
+   executable. On a young heap the hazard never fires (the test
+   passes standalone and in short runs); on the hot heap of a
+   ~550-test process it surfaced as a SIGABRT inside the executable —
+   or completed with poisoned reads, the NaN-losses variant —
+   reproducibly at this one test's post-restore step.
+
+The fix is layered to match: ``load_checkpoint`` REBUFFERS restored
+state through host into fresh XLA-owned allocations before any
+donating step can see it (``lifecycle.rebuffer_on_restore``) and
+invalidates the AOT executable caches
+(``lifecycle.invalidate_on_restore``); every process-lifetime cache
+is bounded and registered here; ``engine.close()`` breaks the cycles
+deterministically; and ``sweep()`` gives long-running processes (and
+the test harness, per test module) a deterministic reclamation point
+instead of hoping gen-2 fires.
+"""
+
+import gc
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from ..utils.logging import logger
+
+
+class CacheStats:
+    """Mutable hit/miss/eviction counters for one bounded cache."""
+
+    __slots__ = ("hits", "misses", "evictions", "invalidations")
+
+    def __init__(self):
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations}
+
+
+class BoundedCache:
+    """An LRU-bounded, explicitly evictable mapping.
+
+    The replacement for the module-level ``dict`` cache pattern
+    (flagged by tools/lint_unbounded_caches.py): entries are evicted
+    least-recently-used once ``max_entries`` is reached, ``invalidate``
+    drops everything at a lifecycle boundary, and both paths run the
+    ``on_evict(key, value)`` hook so owners can release non-GC
+    resources. Every instance registers itself (by weakref) with the
+    process registry, so its size shows up in ``memory_gauges()``.
+
+    ``kind`` tags what the entries are ("executable" entries are
+    summed into the ``live_executables`` gauge). Not thread-safe by
+    itself beyond the GIL's dict atomicity — callers that mutate from
+    multiple threads (none today) must lock.
+    """
+
+    def __init__(self, name: str, max_entries: Optional[int] = None,
+                 kind: str = "cache",
+                 on_evict: Optional[Callable[[Any, Any], None]] = None):
+        if max_entries is not None and max_entries < 1:
+            raise ValueError(
+                f"BoundedCache({name!r}) max_entries must be >= 1 or "
+                f"None (unbounded), got {max_entries}")
+        self.name = name
+        self.kind = kind
+        self.max_entries = max_entries
+        self.stats = CacheStats()
+        self._on_evict = on_evict
+        self._data: "OrderedDict[Any, Any]" = OrderedDict()
+        registry.register(self)
+
+    # -- mapping surface ----------------------------------------------
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key) -> bool:
+        return key in self._data
+
+    def get(self, key, default=None):
+        """Lookup with LRU refresh; counts a hit or a miss."""
+        try:
+            val = self._data[key]
+        except KeyError:
+            self.stats.misses += 1
+            return default
+        self._data.move_to_end(key)
+        self.stats.hits += 1
+        return val
+
+    def put(self, key, value) -> None:
+        """Insert/refresh; evicts LRU entries to make room FIRST, so a
+        failed eviction (hook error, injected fault) never leaves the
+        cache above its bound — the new entry simply doesn't land."""
+        if key in self._data:
+            self._data.move_to_end(key)
+            self._data[key] = value
+            return
+        while self.max_entries is not None and \
+                len(self._data) >= self.max_entries:
+            self._evict_one()
+        self._data[key] = value
+
+    def keys(self):
+        return self._data.keys()
+
+    def pop(self, key, default=None):
+        return self._data.pop(key, default)
+
+    # -- lifecycle ----------------------------------------------------
+    def _evict_one(self) -> None:
+        # the fault site lets recovery tests drive an eviction-hook
+        # failure deterministically; it fires BEFORE any state changes,
+        # so an injected fault leaves the cache fully consistent
+        from ..resilience.fault_injector import fault_injector
+        fault_injector.fire("lifecycle.evict", detail=self.name)
+        key, value = self._data.popitem(last=False)
+        self.stats.evictions += 1
+        if self._on_evict is not None:
+            self._on_evict(key, value)
+
+    def invalidate(self, reason: str = "") -> int:
+        """Drop every entry (running ``on_evict`` for each); returns
+        how many were dropped. The explicit-eviction path lifecycle
+        boundaries (checkpoint restore, config change) call."""
+        n = len(self._data)
+        if n:
+            logger.debug(f"lifecycle: invalidating cache {self.name} "
+                         f"({n} entries{': ' + reason if reason else ''})")
+        while self._data:
+            key, value = self._data.popitem(last=False)
+            if self._on_evict is not None:
+                self._on_evict(key, value)
+        self.stats.invalidations += n
+        return n
+
+
+class LifecycleRegistry:
+    """Weak registry of every BoundedCache in the process.
+
+    Weakrefs keep the registry from itself becoming the leak: a cache
+    owned by a dead engine disappears from the gauges once collected
+    (and ``sweep()`` forces that collection)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._caches: List["weakref.ref[BoundedCache]"] = []
+
+    def register(self, cache: BoundedCache) -> None:
+        with self._lock:
+            self._caches.append(weakref.ref(cache))
+
+    def caches(self) -> List[BoundedCache]:
+        out, live = [], []
+        with self._lock:
+            for ref in self._caches:
+                c = ref()
+                if c is not None:
+                    out.append(c)
+                    live.append(ref)
+            self._caches = live
+        return out
+
+    def report(self) -> Dict[str, Any]:
+        """{cache_name: {size, max, kind, stats...}} for live caches."""
+        out: Dict[str, Any] = {}
+        for c in self.caches():
+            entry = {"size": len(c), "max_entries": c.max_entries,
+                     "kind": c.kind}
+            entry.update(c.stats.as_dict())
+            # multiple instances may share a name (one per engine);
+            # suffix duplicates so none shadow another
+            name, i = c.name, 1
+            while name in out:
+                i += 1
+                name = f"{c.name}#{i}"
+            out[name] = entry
+        return out
+
+    def live_executables(self) -> int:
+        return sum(len(c) for c in self.caches()
+                   if c.kind == "executable")
+
+
+registry = LifecycleRegistry()
+
+
+def memory_gauges(include_arrays: bool = True) -> Dict[str, Any]:
+    """Process-lifetime memory gauges (the schema README documents):
+
+    * ``device_bytes_in_use`` / ``device_peak_bytes`` — backend
+      allocator stats (0 where the backend exposes none, e.g. CPU).
+    * ``host_rss_gb`` — THIS process's resident set.
+    * ``live_executables`` — entries across every registered
+      executable-kind cache (AOT compiled programs held alive).
+    * ``live_arrays`` / ``live_array_bytes`` — jax's live-buffer
+      census (skipped when ``include_arrays=False``; the census walks
+      every buffer, so hot paths may opt out).
+    * ``caches`` — per-registered-cache size/cap/hit/eviction stats.
+    """
+    from ..utils.memory import device_memory_stats, host_rss_gb
+    stats = device_memory_stats()
+    out: Dict[str, Any] = {
+        "device_bytes_in_use": int(stats.get("bytes_in_use", 0)),
+        "device_peak_bytes": int(stats.get("peak_bytes_in_use", 0)),
+        "host_rss_gb": host_rss_gb(),
+        "live_executables": registry.live_executables(),
+        "caches": registry.report(),
+    }
+    if include_arrays:
+        try:
+            import jax
+            arrs = jax.live_arrays()
+            out["live_arrays"] = len(arrs)
+            out["live_array_bytes"] = int(sum(
+                a.size * a.dtype.itemsize for a in arrs))
+        except Exception as e:  # census is observability, never fatal
+            logger.warning(f"lifecycle: live-array census failed "
+                           f"({type(e).__name__}: {str(e)[:120]})")
+            out["live_arrays"] = -1
+            out["live_array_bytes"] = -1
+    return out
+
+
+def sweep(reason: str = "") -> Dict[str, Any]:
+    """Deterministic reclamation point for long-running processes:
+    run the cyclic GC (the engine object graph is cyclic — refcounting
+    alone never frees a dead engine's buffers or executables), then
+    return fresh gauges. Call between serving generations, after
+    engine teardown, or periodically from a fleet health loop."""
+    gc.collect()
+    gauges = memory_gauges()
+    if reason:
+        logger.debug(
+            f"lifecycle sweep ({reason}): rss={gauges['host_rss_gb']:.2f}GB "
+            f"executables={gauges['live_executables']} "
+            f"arrays={gauges.get('live_arrays', -1)}")
+    return gauges
+
+
+class LeakCheck:
+    """Leak-detector harness for soak tests.
+
+    Usage::
+
+        lc = LeakCheck()
+        for _ in range(cycles):
+            ...  # one save/restore/train or serve cycle
+            lc.snapshot()
+        lc.assert_bounded("host_rss_gb", slack_frac=0.05)
+        lc.assert_bounded("live_executables", slack_abs=0)
+
+    ``assert_bounded`` compares the late-window high-water mark against
+    the early-window one: bounded (non-monotonic) growth means the
+    second half of the run does not keep climbing past the first —
+    warm-up allocations (compiles, pools) land in the early window and
+    are excluded from the verdict."""
+
+    def __init__(self, include_arrays: bool = True, collect: bool = True):
+        self._include_arrays = include_arrays
+        self._collect = collect
+        self.snapshots: List[Dict[str, Any]] = []
+
+    def snapshot(self) -> Dict[str, Any]:
+        if self._collect:
+            # measure what the process RETAINS, not what gen-2 gc has
+            # not happened to visit yet
+            gc.collect()
+        g = memory_gauges(include_arrays=self._include_arrays)
+        self.snapshots.append(g)
+        return g
+
+    def series(self, key: str) -> List[float]:
+        return [float(s[key]) for s in self.snapshots]
+
+    def assert_bounded(self, key: str, slack_frac: float = 0.0,
+                       slack_abs: float = 0.0) -> None:
+        """Late-window max must not exceed early-window max by more
+        than the slack. Needs >= 4 snapshots to split windows."""
+        xs = self.series(key)
+        if len(xs) < 4:
+            raise ValueError(
+                f"LeakCheck.assert_bounded({key!r}) needs >= 4 "
+                f"snapshots, got {len(xs)}")
+        half = len(xs) // 2
+        early, late = max(xs[:half]), max(xs[half:])
+        limit = early + abs(early) * slack_frac + slack_abs
+        if late > limit:
+            raise AssertionError(
+                f"unbounded growth in {key!r}: early-window max "
+                f"{early:.4g} -> late-window max {late:.4g} "
+                f"(limit {limit:.4g}); series={['%.4g' % x for x in xs]}")
+
+
+def run_soak(cycle_fn: Callable[[int], None], cycles: int,
+             keys: Iterable[str] = ("host_rss_gb", "live_executables"),
+             slack_frac: float = 0.05,
+             slack_abs: float = 0.0) -> LeakCheck:
+    """Run ``cycle_fn(i)`` for ``cycles`` iterations, snapshotting the
+    gauges after each, and assert every ``key`` stays bounded. Returns
+    the LeakCheck for further assertions/inspection."""
+    lc = LeakCheck()
+    for i in range(cycles):
+        cycle_fn(i)
+        lc.snapshot()
+    for key in keys:
+        lc.assert_bounded(key, slack_frac=slack_frac,
+                          slack_abs=slack_abs)
+    return lc

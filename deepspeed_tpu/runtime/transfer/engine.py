@@ -38,8 +38,8 @@ from ...utils.jax_compat import TRANSFER_ERRORS
 from ...utils.logging import logger
 from .bucketizer import BucketPlan
 
-_async_copy_warned = [False]
-_async_kick_warned = [False]
+_async_copy_warned = [False]  # unbounded-ok: single warn-once flag cell, never grows past one element
+_async_kick_warned = [False]  # unbounded-ok: single warn-once flag cell, never grows past one element
 
 
 def start_host_copy(arr) -> None:

@@ -183,6 +183,9 @@ class OffloadCoordinator:
         # audited breakdown bench.py config 4 reports; the engine adds
         # the overlap residue (time the main thread actually stalled)
         self.last_breakdown = {}
+        # post-restore corruption guard (verify_and_repair): leaves
+        # repaired from the host master over this coordinator's life
+        self.repairs = 0
         if self._delta_upload and self.store is not None:
             log_dist("ZeRO-Offload: int8_delta upload disabled on the "
                      "NVMe tier (the device mirror would re-grow DRAM)",
@@ -800,6 +803,68 @@ class OffloadCoordinator:
                 "m": [np.asarray(a) for a in sd["m"]],
                 "v": [np.asarray(a) for a in sd["v"]],
                 "off_idx": list(self.off_idx)}
+
+    def verify_and_repair(self, state_master):
+        """Post-restore corruption guard (runtime/lifecycle.py has the
+        long-process root cause; engine arms this for
+        ``lifecycle.verify_steps_after_restore`` steps after a
+        load_checkpoint): check every offloaded DEVICE leaf against
+        the host-side authority — the delta-upload mirror (bit-equal
+        contract, ties within one compute-dtype ULP) or, without the
+        delta wire, the compute-rounded host master — and REPAIR a
+        violated leaf by re-uploading the authoritative host master
+        (plus a mirror resync, so the error-feedback stream restarts
+        from truth).
+
+        Exists because the observed failure mode is the device buffer
+        going bad (jaxlib 0.4.x XLA-CPU under a hot, fragmented heap:
+        a donated pass-through leaf comes back poisoned at the first
+        post-restore step) while every host array stays finite: the
+        host master IS the optimizer's source of truth, so the repair
+        is exact, not approximate. Returns
+        ``(n_repaired, state_master)``; a repaired tree is rebuilt
+        functionally. NVMe tier: verification reads the store, repair
+        uploads the read-back master (same authority, one read)."""
+        if not self.off_idx:
+            return 0, state_master
+        one_ulp = {jnp.bfloat16: 2.0 ** -7,
+                   jnp.float16: 2.0 ** -10}.get(self.compute_dtype, 0.0)
+        flat, treedef = jax.tree_util.tree_flatten(state_master)
+        masters = None
+        bad = []
+        for slot, i in enumerate(self.off_idx):
+            dev = np.asarray(flat[i], dtype=np.float32)
+            if self._delta_upload:
+                expect = self._mirror[slot].reshape(dev.shape)
+            else:
+                if masters is None:
+                    masters = self.master_arrays()
+                expect = self._round_compute(
+                    np.asarray(masters[slot],
+                               np.float32)).reshape(dev.shape)
+            if not np.isfinite(dev).all():
+                bad.append((slot, i))
+                continue
+            diff = np.abs(dev - expect)
+            denom = np.maximum(np.abs(expect), 1e-30)
+            if float((diff / denom).max()) > one_ulp:
+                bad.append((slot, i))
+        if not bad:
+            return 0, state_master
+        log_dist(
+            f"OFFLOAD REPAIR: {len(bad)} device leaf(s) violated the "
+            f"host-mirror contract after restore (slots "
+            f"{[s for s, _ in bad][:8]}) — re-uploading from the host "
+            f"master (see README 'Long-run durability')", ranks=[0])
+        self.repairs += len(bad)
+        if masters is None:
+            masters = self.master_arrays()
+        for slot, i in bad:
+            p = np.asarray(masters[slot], np.float32)
+            flat[i] = self._device_payload(p, flat[i].sharding)
+            if self._delta_upload:
+                self._mirror[slot] = self._round_compute(p.copy())
+        return len(bad), jax.tree_util.tree_unflatten(treedef, flat)
 
     def resync_mirror(self, state_master):
         """Rebuild the delta-upload mirror from the RESTORED device

@@ -64,13 +64,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...parallel.mesh import FSDP_AXIS
 from ...utils.logging import logger
+from ..lifecycle import BoundedCache
 from .partition import shard_leaf_spec
 
 # ---------------------------------------------------------------------------
 # pillar 1: the XLA options translator
 # ---------------------------------------------------------------------------
 
-_WARNED = set()
+_WARNED = set()  # unbounded-ok: warn-once keys come from a fixed option vocabulary
 
 
 def _warn_once(key, msg):
@@ -289,21 +290,47 @@ class ScheduledStep:
     Any failure on the AOT path before execution falls back (warn-once)
     to plain jitted dispatch — the step always runs, at worst without
     the scheduler options.
+
+    Lifecycle (runtime/lifecycle.py): the executable cache is a
+    BoundedCache — LRU-evicted at ``max_entries`` distinct signatures
+    (a long-running process cycling batch shapes must not pin every
+    program it ever compiled) and dropped wholesale by ``invalidate``,
+    which the engine calls at checkpoint restore: a stale executable
+    would otherwise be re-entered against freshly ``device_put`` state
+    buffers it then donates — the post-restore abort's trigger site.
     """
 
     def __init__(self, fn, options=None, label="step", static_argnums=(),
-                 key_extras=()):
+                 key_extras=(), max_entries: Optional[int] = 8):
         self._fn = fn
         self._options = dict(options or {})
         self._label = label
         self._static = frozenset(static_argnums)
         self._key_extras = tuple(key_extras) + (
             tuple(sorted((k, str(v)) for k, v in self._options.items())),)
-        self._cache: Dict[Any, Any] = {}
+        self._cache = BoundedCache(f"scheduled_step:{label}",
+                                   max_entries=max_entries,
+                                   kind="executable")
         self._fallback = False
         self._last_program = None      # (compiled, applied, dropped)
         self._report: Optional[Dict[str, Any]] = None
         self._report_for = None
+
+    def invalidate(self, reason: str = "") -> int:
+        """Drop every compiled program (and the memoized report). The
+        next call re-lowers and re-compiles against the buffers it is
+        actually handed. Also clears the wrapped jit function's own
+        dispatch cache where the jax version exposes that — the
+        fallback path must not resurrect a stale executable either."""
+        n = self._cache.invalidate(reason)
+        self._last_program = None
+        self._report = None
+        self._report_for = None
+        try:
+            self._fn.clear_cache()
+        except AttributeError:
+            pass  # older jax jit wrappers lack clear_cache
+        return n
 
     def schedule_report(self) -> Dict[str, Any]:
         """Report for the newest compiled program (memoized); {} until
@@ -340,7 +367,8 @@ class ScheduledStep:
                 compiled, applied, dropped = compile_with_options(
                     lowered, self._options, self._label)
                 self._last_program = (compiled, applied, dropped)
-                entry = self._cache[key] = compiled
+                entry = compiled
+                self._cache.put(key, compiled)
         except Exception as e:
             # nothing has executed (and nothing was donated) yet: safe
             # to fall back to plain jit dispatch for good

@@ -35,6 +35,20 @@ def host_memory_usage():
         return 0.0, 0.0, 0.0
 
 
+def host_rss_gb() -> float:
+    """THIS process's resident set size in GB (from /proc/self/status).
+    The machine-wide number from host_memory_usage() cannot distinguish
+    our leak from a neighbor's; the lifecycle gauges need ours."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    return float(line.split()[1]) / (1024**2)
+    except OSError:
+        pass
+    return 0.0
+
+
 def see_memory_usage(message, force=False, ranks=None):
     if not force:
         return

@@ -33,6 +33,22 @@ def _reset_mesh():
     mesh_manager.reset()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _lifecycle_sweep():
+    """Per-module lifecycle sweep (runtime/lifecycle.py): the engine
+    object graph is cyclic, so dead engines — device buffers, host
+    optimizer state, AOT executables — pile up between Python's
+    allocation-count-driven gen-2 GC passes. In a LONG single-process
+    suite that accumulation is what flakily SIGABRTed old jaxlib's CPU
+    runtime at the post-restore train_batch (the quarantine lifted by
+    the lifecycle PR — root cause in runtime/lifecycle.py). One
+    gc.collect per test module costs ~ms and keeps the process's
+    retained set proportional to ONE module's engines."""
+    yield
+    from deepspeed_tpu.runtime.lifecycle import sweep
+    sweep("test-module teardown")
+
+
 @pytest.fixture
 def eight_devices():
     devs = jax.devices()

@@ -1,0 +1,166 @@
+"""Serving overload robustness (the long-run durability PR): admission
+control with a bounded request queue, KV-utilization backpressure,
+typed shedding, and the admission/process-memory additions to the
+serving report. The watchdog/hang behavior lives with the other
+fault-site tests (tests/unit/resilience/test_lifecycle_faults.py)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import InferenceEngineV2
+from deepspeed_tpu.inference.v2.engine_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.resilience.errors import ServingOverloadError
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))
+    return cfg, params
+
+
+def _engine(model_and_params, **cfg_kwargs):
+    cfg, params = model_and_params
+    return InferenceEngineV2(
+        params, cfg,
+        RaggedInferenceEngineConfig(token_budget=32,
+                                    max_ragged_sequence_count=4,
+                                    n_kv_blocks=16, kv_block_size=8,
+                                    max_blocks_per_seq=8,
+                                    kv_dtype="float32", **cfg_kwargs))
+
+
+PROMPTS = {10: [3, 1, 4, 1, 5], 11: [2, 7, 1], 12: [9, 9]}
+
+
+class TestAdmissionControl:
+
+    def test_unbounded_by_default(self, model_and_params):
+        eng = _engine(model_and_params)
+        admitted, shed = eng.admit_requests(
+            {uid: np.asarray(p) for uid, p in PROMPTS.items()})
+        assert sorted(admitted) == sorted(PROMPTS) and shed == []
+
+    def test_queue_depth_bound_sheds_arrival_order_tail(
+            self, model_and_params):
+        eng = _engine(model_and_params, max_queue_depth=2)
+        admitted, shed = eng.admit_requests(
+            {uid: np.asarray(p) for uid, p in PROMPTS.items()})
+        assert sorted(admitted) == [10, 11]     # arrival order kept
+        assert shed == [12]
+
+    def test_active_counts_against_the_bound(self, model_and_params):
+        eng = _engine(model_and_params, max_queue_depth=2)
+        admitted, shed = eng.admit_requests(
+            {uid: np.asarray(p) for uid, p in PROMPTS.items()}, active=2)
+        assert admitted == {} and sorted(shed) == [10, 11, 12]
+
+    def test_kv_util_threshold_refuses_new_work(self, model_and_params):
+        eng = _engine(model_and_params,
+                      admission_kv_util_threshold=0.25)
+        # occupy a quarter of the pool: 2 sequences x 2 blocks
+        eng.put([1], [list(range(16))])
+        eng.put([2], [list(range(16))])
+        assert eng.kv_utilization >= 0.25
+        admitted, shed = eng.admit_requests({5: np.asarray([1, 2])})
+        assert admitted == {} and shed == [5]
+        # draining restores admission
+        eng.flush(1)
+        eng.flush(2)
+        admitted, shed = eng.admit_requests({5: np.asarray([1, 2])})
+        assert sorted(admitted) == [5] and shed == []
+
+    def test_shedding_never_mutates_engine_state(self, model_and_params):
+        eng = _engine(model_and_params, max_queue_depth=1)
+        eng.admit_requests(
+            {uid: np.asarray(p) for uid, p in PROMPTS.items()})
+        assert not eng._state_manager.tracked_sequences
+        assert eng.free_blocks == eng._config.n_kv_blocks
+
+
+class TestGenerateBatchOverload:
+
+    def test_raise_policy_is_typed_and_eager(self, model_and_params):
+        eng = _engine(model_and_params, max_queue_depth=2)
+        with pytest.raises(ServingOverloadError) as ei:
+            eng.generate_batch(dict(PROMPTS), max_new_tokens=3)
+        assert ei.value.shed_uids == (12,)
+        assert not eng._state_manager.tracked_sequences
+
+    def test_shed_policy_serves_admitted_subset(self, model_and_params):
+        eng = _engine(model_and_params, max_queue_depth=2)
+        out = eng.generate_batch(dict(PROMPTS), max_new_tokens=3,
+                                 on_overload="shed")
+        assert sorted(out) == [10, 11]
+        assert all(len(v) == 3 for v in out.values())
+        rep = eng.get_serving_report()
+        assert rep["admission"] == {"requested": 3, "admitted": 2,
+                                    "shed": 1, "shed_uids": [12]}
+        # a shed uid resubmits verbatim once load drains
+        out2 = eng.generate_batch({12: PROMPTS[12]}, max_new_tokens=3)
+        assert len(out2[12]) == 3
+
+    def test_shed_streams_match_unshed_run(self, model_and_params):
+        """Backpressure must not perturb admitted streams: tokens for
+        the admitted uids are identical with and without a shed
+        sibling (draws are keyed per (seed, uid, position))."""
+        eng = _engine(model_and_params)
+        ref = eng.generate_batch({10: PROMPTS[10], 11: PROMPTS[11]},
+                                 max_new_tokens=4)
+        eng2 = _engine(model_and_params, max_queue_depth=2)
+        got = eng2.generate_batch(dict(PROMPTS), max_new_tokens=4,
+                                  on_overload="shed")
+        assert got == ref
+
+    def test_bad_on_overload_rejected(self, model_and_params):
+        eng = _engine(model_and_params)
+        with pytest.raises(ValueError, match="on_overload"):
+            eng.generate_batch(dict(PROMPTS), max_new_tokens=2,
+                               on_overload="drop")
+
+    def test_stuck_workload_degrades_to_typed_error(
+            self, model_and_params):
+        """Sequences that outgrow the pool mid-run: the loop drains
+        what it can (collect-only), then raises the typed overload —
+        never a bare OutOfKVBlocks, never a wedge — and the aborted
+        run's KV blocks are released, so a front-end that catches the
+        error keeps serving instead of inheriting a pinned pool."""
+        eng = _engine(model_and_params)
+        prompts = {uid: list(range(30)) for uid in range(4)}
+        with pytest.raises(ServingOverloadError) as ei:
+            eng.generate_batch(prompts, max_new_tokens=40)
+        assert "KV" in str(ei.value) or "kv" in str(ei.value)
+        # mid-run abort must not leak the dead run's sequences/blocks
+        assert not eng._state_manager.tracked_sequences
+        assert eng.free_blocks == eng._config.n_kv_blocks
+        out = eng.generate_batch({99: [1, 2, 3]}, max_new_tokens=3)
+        assert len(out[99]) == 3
+
+    def test_empty_admission_returns_empty(self, model_and_params):
+        eng = _engine(model_and_params, max_queue_depth=1)
+        out = eng.generate_batch(dict(PROMPTS), max_new_tokens=2,
+                                 on_overload="shed")
+        assert sorted(out) == [10]
+
+
+class TestServingReportDurability:
+
+    def test_report_always_carries_process_memory(self, model_and_params):
+        eng = _engine(model_and_params)
+        rep = eng.get_serving_report()       # before ANY run
+        pm = rep["process_memory"]
+        assert pm["host_rss_gb"] > 0
+        assert "caches" in pm
+        # the dispatch-signature cache is registered and bounded
+        assert any(n.startswith("v2_dispatch_signatures")
+                   for n in pm["caches"])
+
+    def test_signature_cache_bounded(self, model_and_params):
+        eng = _engine(model_and_params, max_dispatch_signatures=1)
+        eng.generate_batch({1: [1, 2, 3]}, max_new_tokens=2)  # greedy
+        eng.put([2], [[1, 2]])                                 # logits
+        assert len(eng._seen_signatures) == 1   # LRU evicted the older

@@ -1,0 +1,127 @@
+"""Long-run durability soaks (the ``soak`` marker, slow tier): drive
+many save/restore/train and serve cycles through one process and
+assert the lifecycle gauges stay BOUNDED — non-monotonic host RSS,
+live-executable count, and live-array footprint. This is the
+leak-detector harness ROADMAP item 5 asked for: the post-restore
+XLA-CPU abort was process-lifetime growth (see runtime/lifecycle.py),
+and these soaks are the regression net that keeps it dead.
+
+Tier-1 keeps a cheap smoke (test_lifecycle.py asserts eviction fires
+and gauges populate); everything here is ``soak + slow``."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.runtime.lifecycle import LeakCheck
+
+pytestmark = [pytest.mark.soak, pytest.mark.slow]
+
+
+def _engine():
+    from deepspeed_tpu.parallel.mesh import mesh_manager
+    mesh_manager.reset()
+    cfg = {"train_micro_batch_size_per_gpu": 4,
+           "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "bf16": {"enabled": True},
+           "zero_optimization": {"stage": 2, "offload_optimizer": {
+               "device": "cpu", "grad_dtype": "int8",
+               "upload_dtype": "int8_delta"}},
+           "gradient_clipping": 1.0, "steps_per_print": 0}
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    ids = np.random.default_rng(0).integers(
+        0, 256, size=(engine.train_batch_size(), 16), dtype=np.int32)
+    return engine, {"input_ids": ids, "labels": ids.copy()}
+
+
+def test_restore_train_cycles_bounded(tmp_path):
+    """>= 20 save/restore/train cycles through ONE engine: the exact
+    sequence that used to abort XLA CPU in long processes. Executable
+    count, device-array footprint, and host RSS must all plateau —
+    every restore drops the stale AOT programs and the recompile
+    replaces (not accumulates) them."""
+    engine, batch = _engine()
+    for _ in range(2):                      # settle compiles
+        engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path))
+
+    lc = LeakCheck()
+    for _ in range(20):
+        engine.train_batch(batch=batch)
+        engine.load_checkpoint(str(tmp_path))
+        loss = float(engine.train_batch(batch=batch))
+        assert np.isfinite(loss)
+        lc.snapshot()
+    lc.assert_bounded("live_executables", slack_abs=0)
+    lc.assert_bounded("live_arrays", slack_abs=0)
+    lc.assert_bounded("live_array_bytes", slack_abs=0)
+    # RSS plateaus but jitters (allocator pools, npz temp buffers):
+    # 5% + 32 MB of slack still catches the ~16 MB/cycle leak class
+    lc.assert_bounded("host_rss_gb", slack_frac=0.05,
+                      slack_abs=32 / 1024)
+    engine.close()
+
+
+def test_engine_lifecycle_cycles_bounded(tmp_path):
+    """>= 20 engine build/train/close cycles: the full-suite pattern
+    that accumulated ~41 dead device arrays per engine before close()
+    + sweep existed. With deterministic teardown the retained set must
+    stay flat."""
+    lc = LeakCheck()
+    for i in range(20):
+        engine, batch = _engine()
+        assert np.isfinite(float(engine.train_batch(batch=batch)))
+        engine.close()
+        del engine
+        lc.snapshot()
+    lc.assert_bounded("live_executables", slack_abs=0)
+    lc.assert_bounded("live_arrays", slack_abs=0)
+    lc.assert_bounded("host_rss_gb", slack_frac=0.05,
+                      slack_abs=48 / 1024)
+
+
+def test_serve_cycles_bounded():
+    """>= 20 generate_batch runs on one v2 engine (lookahead mode):
+    KV pools are donated through every step and the dispatch-signature
+    set is bounded, so serving forever must not grow executables,
+    arrays, or RSS."""
+    import jax
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.v2.engine_v2 import \
+        RaggedInferenceEngineConfig
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))
+    eng = InferenceEngineV2(
+        params, cfg,
+        RaggedInferenceEngineConfig(token_budget=32,
+                                    max_ragged_sequence_count=4,
+                                    n_kv_blocks=16, kv_block_size=8,
+                                    max_blocks_per_seq=8,
+                                    kv_dtype="float32"))
+    prompts = {10: [3, 1, 4, 1, 5], 11: [2, 7, 1], 12: [9, 9]}
+    eng.generate_batch(dict(prompts), max_new_tokens=4)  # compile
+
+    lc = LeakCheck()
+    for i in range(20):
+        out = eng.generate_batch(
+            {uid + 100 * i: list(p) for uid, p in prompts.items()},
+            max_new_tokens=4)
+        assert all(len(v) == 4 for v in out.values())
+        assert not eng._state_manager.tracked_sequences
+        lc.snapshot()
+    lc.assert_bounded("live_arrays", slack_abs=0)
+    lc.assert_bounded("live_array_bytes", slack_abs=0)
+    lc.assert_bounded("host_rss_gb", slack_frac=0.05,
+                      slack_abs=32 / 1024)
+    rep = eng.get_serving_report()
+    # the recompile counter's backing set stayed bounded
+    assert len(eng._seen_signatures) <= \
+        eng._config.max_dispatch_signatures
+    assert rep["recompiles"] == 0       # steady serving recompiles nothing

@@ -1,0 +1,126 @@
+"""tools/lint_unbounded_caches.py: module-level grow-only containers
+are flagged; eviction paths, BoundedCache, and annotated exceptions
+pass; and the shipped package is clean under the lint."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "..",
+    "tools"))
+from lint_unbounded_caches import find_unbounded_caches  # noqa: E402
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "..", "..", "..")
+
+
+def _lint(tmp_path, src):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(src))
+    return find_unbounded_caches(str(p))
+
+
+def test_grow_only_dict_flagged(tmp_path):
+    hits = _lint(tmp_path, """
+        _CACHE = {}
+
+        def put(k, v):
+            _CACHE[k] = v
+    """)
+    assert len(hits) == 1 and "_CACHE" in hits[0][1]
+
+
+def test_grow_only_list_and_set_flagged(tmp_path):
+    hits = _lint(tmp_path, """
+        _SEEN = set()
+        _LOG = []
+
+        def note(x):
+            _SEEN.add(x)
+            _LOG.append(x)
+    """)
+    assert len(hits) == 2
+
+
+def test_eviction_path_passes(tmp_path):
+    assert _lint(tmp_path, """
+        _CACHE = {}
+
+        def put(k, v):
+            while len(_CACHE) > 8:
+                _CACHE.pop(next(iter(_CACHE)))
+            _CACHE[k] = v
+    """) == []
+
+
+def test_clear_counts_as_eviction(tmp_path):
+    assert _lint(tmp_path, """
+        _CACHE = {}
+
+        def put(k, v):
+            _CACHE[k] = v
+
+        def reset():
+            _CACHE.clear()
+    """) == []
+
+
+def test_bounded_cache_passes(tmp_path):
+    assert _lint(tmp_path, """
+        from deepspeed_tpu.runtime.lifecycle import BoundedCache
+        _CACHE = BoundedCache("x", max_entries=8)
+
+        def put(k, v):
+            _CACHE.put(k, v)
+    """) == []
+
+
+def test_annotation_with_reason_passes(tmp_path):
+    assert _lint(tmp_path, """
+        _WARNED = set()  # unbounded-ok: fixed key vocabulary
+
+        def warn_once(k):
+            _WARNED.add(k)
+    """) == []
+
+
+def test_read_only_container_passes(tmp_path):
+    assert _lint(tmp_path, """
+        TABLE = {"a": 1, "b": 2}
+
+        def get(k):
+            return TABLE[k]
+    """) == []
+
+
+def test_function_local_containers_ignored(tmp_path):
+    assert _lint(tmp_path, """
+        def f(xs):
+            out = []
+            for x in xs:
+                out.append(x)
+            return out
+    """) == []
+
+
+def test_deque_maxlen_passes(tmp_path):
+    assert _lint(tmp_path, """
+        from collections import deque
+        _RING = deque(maxlen=16)
+
+        def push(x):
+            _RING.append(x)
+    """) == []
+
+
+def test_package_is_clean():
+    """The shipped package passes its own lint (hits are either
+    BoundedCache-backed or carry an unbounded-ok reason)."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "lint_unbounded_caches.py"),
+         os.path.join(REPO, "deepspeed_tpu")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Repo lint: flag module-level mutable containers that only grow.
+
+A process that serves traffic for weeks dies by a thousand unbounded
+caches: a module-level ``dict``/``list``/``set`` that gains entries on
+a hot path and never evicts is a leak with a delay fuse (the
+post-restore XLA-CPU abort this repo root-caused was exactly
+process-lifetime growth — see runtime/lifecycle.py). This lint walks
+``deepspeed_tpu/`` and reports every MODULE-LEVEL container literal
+that some code in the module grows (``x[k] = ...``, ``.append``,
+``.add``, ``.setdefault``, ``.update``, ...) while nothing ever
+shrinks it (``.pop``, ``.popitem``, ``.clear``, ``.remove``,
+``del x[...]``, slice deletion, or wholesale reassignment).
+
+Sanctioned escapes:
+
+* use ``runtime.lifecycle.BoundedCache`` — bounded, observable,
+  explicitly evictable (assignments whose value is a
+  ``BoundedCache(...)`` call are skipped), or
+* annotate the assignment line with ``# unbounded-ok: <why>`` when the
+  growth is genuinely bounded by construction (e.g. a warn-once set
+  keyed by a fixed vocabulary) — the reason is mandatory.
+
+Usage: python tools/lint_unbounded_caches.py [root_dir]
+Exit code 0 = clean, 1 = violations found.
+"""
+
+import ast
+import os
+import sys
+
+_CONTAINER_CALLS = ("dict", "list", "set", "OrderedDict", "defaultdict",
+                    "deque", "Counter")
+_GROW_METHODS = ("append", "add", "setdefault", "update", "insert",
+                 "extend", "appendleft", "move_to_end")
+_SHRINK_METHODS = ("pop", "popitem", "clear", "remove", "discard",
+                   "popleft", "invalidate")
+_ANNOTATION = "# unbounded-ok:"
+
+
+def _is_container_literal(value) -> bool:
+    if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+        return True
+    if isinstance(value, ast.Call):
+        fn = value.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        return name in _CONTAINER_CALLS
+    return False
+
+
+def _is_bounded_cache(value) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    fn = value.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    return name == "BoundedCache"
+
+
+def _module_level_containers(tree):
+    """{name: lineno} of top-level container-literal assignments.
+    ``deque(maxlen=...)`` is bounded by construction and skipped."""
+    out = {}
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if _is_bounded_cache(value) or not _is_container_literal(value):
+            continue
+        if isinstance(value, ast.Call) and any(
+                kw.arg == "maxlen" for kw in value.keywords):
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = node.lineno
+    return out
+
+
+def _usage_sets(tree, names):
+    """(grown, shrunk): which of ``names`` the module grows/shrinks."""
+    grown, shrunk = set(), set()
+
+    def base_name(expr):
+        return expr.id if isinstance(expr, ast.Name) else None
+
+    for node in ast.walk(tree):
+        # x[k] = v  /  del x[k]
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    n = base_name(t.value)
+                    if n in names:
+                        grown.add(n)
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    n = base_name(t.value)
+                    if n in names:
+                        shrunk.add(n)
+                elif isinstance(t, ast.Name) and t.id in names:
+                    shrunk.add(t.id)
+        # x.method(...)
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            n = base_name(node.func.value)
+            if n in names:
+                if node.func.attr in _GROW_METHODS:
+                    grown.add(n)
+                elif node.func.attr in _SHRINK_METHODS:
+                    shrunk.add(n)
+    # reassignment anywhere below module level counts as a reset path
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and node.col_offset > 0:
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in names:
+                    shrunk.add(t.id)
+    return grown, shrunk
+
+
+def find_unbounded_caches(path):
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    containers = _module_level_containers(tree)
+    if not containers:
+        return []
+    lines = src.splitlines()
+    annotated = {
+        name for name, lineno in containers.items()
+        if lineno <= len(lines) and _ANNOTATION in lines[lineno - 1]}
+    grown, shrunk = _usage_sets(tree, set(containers))
+    hits = []
+    for name in sorted(grown - shrunk - annotated):
+        hits.append((
+            containers[name],
+            f"module-level container {name!r} grows but has no "
+            f"eviction path — use runtime.lifecycle.BoundedCache or "
+            f"annotate '{_ANNOTATION} <reason>'"))
+    return sorted(hits)
+
+
+def main(argv):
+    root = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deepspeed_tpu")
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, name)
+            for lineno, msg in find_unbounded_caches(full):
+                violations.append(f"{full}:{lineno}: {msg}")
+    if violations:
+        print("\n".join(violations))
+        print(f"\n{len(violations)} unbounded module-level cache(s) "
+              "found (see tools/lint_unbounded_caches.py)")
+        return 1
+    print("lint_unbounded_caches: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
