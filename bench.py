@@ -400,6 +400,80 @@ def bench_config5(weight_dtype="bfloat16"):
     }
 
 
+def bench_config6():
+    """Recovery drill (robustness row, ISSUE 7): a supervised run with
+    an injected worker kill — rollback rung — then a permanent loss —
+    shrink-and-reshard rung. Metric = rollback MTTR (detection ->
+    trainable again); the decomposition is the engine's recovery
+    report (ladder, resharded bytes) + the PR-6 memory gauges."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    if jax.device_count() < 2:
+        return {"config": 6, "skipped": "needs 2+ devices"}
+
+    from deepspeed_tpu.elasticity import ElasticSupervisor
+    from deepspeed_tpu.resilience.fault_injector import fault_injector
+    from deepspeed_tpu.runtime.lifecycle import memory_gauges
+    from deepspeed_tpu.tools.pg_sim import SimProcessGroup
+    from deepspeed_tpu.tools.pg_sim.chaos import \
+        _default_engine_factory
+
+    # ONE factory shared with the chaos harness — the bench must
+    # drill exactly the configuration the chaos invariants validate
+    factory = _default_engine_factory()
+
+    ids = np.random.default_rng(0).integers(
+        0, 256, size=(16, 16), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    tmp = tempfile.mkdtemp(prefix="bench_recovery_")
+    try:
+        eng = factory(None, None)
+        world = 2
+        domain = SimProcessGroup(world)
+        # kill->respawn->rollback at step 2, permanent loss (shrink)
+        # at step 4: both ladder rungs in one supervised run
+        fault_injector.configure(
+            ",".join([domain.spec_for(1, 2, "kill"),
+                      domain.spec_for(0, 4, "kill")]))
+        domain.respawnable = True
+        sup = ElasticSupervisor(eng, domain, tmp,
+                                engine_factory=factory)
+        sup.run(3, batch=batch)
+        domain.respawnable = False
+        sup.run(6, batch=batch)
+        fault_injector.reset()
+        report = sup.engine.get_recovery_report()
+        sup.engine.close()
+        sup.close()
+        rungs = [r["rung"] for r in report["ladder"]]
+        mttr = next((r["mttr_s"] for r in report["ladder"]
+                     if r["rung"] == "rollback"), 0.0)
+        out = {
+            "config": 6,
+            "model": "gpt2s", "chips": jax.device_count(),
+            "metric": "rollback_mttr_s",
+            "value": round(mttr, 4),
+            "decomposition": {
+                "rungs": rungs,
+                "detections": len(report["detections"]),
+                "mttr_s": {k: round(v, 4)
+                           for k, v in report["mttr_s"].items()},
+                "resharded_bytes": report["resharded_bytes"],
+                "world_after": (report["ladder"][-1]["world_after"]
+                                if report["ladder"] else world),
+                "memory": _memory_decomposition(
+                    memory_gauges(include_arrays=False)),
+            },
+        }
+        return out
+    finally:
+        fault_injector.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     # the driver contract is ONE JSON line on stdout; the engine's
     # rank-0 INFO logging would interleave with it
@@ -408,13 +482,14 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--config", type=str, default="0",
                    choices=["0", "1", "2", "3", "4", "5", "5_int8",
-                            "5_int4"],
+                            "5_int4", "6_recovery"],
                    help="0 (default) = ALL tracked configs")
     args = p.parse_args()
     fns = {"1": bench_config1, "2": bench_config2, "3": bench_config3,
            "4": bench_config4, "5": bench_config5,
            "5_int8": lambda: bench_config5(weight_dtype="int8"),
-           "5_int4": lambda: bench_config5(weight_dtype="int4")}
+           "5_int4": lambda: bench_config5(weight_dtype="int4"),
+           "6_recovery": bench_config6}
     if args.config != "0":
         print(json.dumps(fns[args.config]()))
         return
@@ -442,7 +517,8 @@ def main():
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.path.join(os.path.dirname(
                        os.path.abspath(__file__)), ".jax_cache"))
-    for key in ("1", "3", "4", "5_int8", "2", "5", "5_int4"):
+    for key in ("1", "3", "4", "5_int8", "2", "5", "5_int4",
+                "6_recovery"):
         if key != "1" and time.time() - t_start > budget * 0.8:
             configs[key] = {"skipped": "bench time budget"}
             continue

@@ -152,6 +152,19 @@ def _in_trace(x):
     return isinstance(x, jax.core.Tracer)
 
 
+# pre-dispatch health gate: None in production (zero overhead beyond
+# one list index). The pg_sim fault domain (tools/pg_sim/pg.py)
+# installs a callable that models rendezvous failure — an eager
+# collective over a dead/hung virtual worker raises a typed
+# WorkerFailureError the way a real mesh's barrier would never return.
+_pre_dispatch_hook = [None]  # unbounded-ok: single hook slot, never grows past one element
+
+
+def set_pre_dispatch_hook(fn):
+    """Install (or clear, with None) the eager-dispatch health gate."""
+    _pre_dispatch_hook[0] = fn
+
+
 def _dispatch(name, thunk):
     """Eager-collective execution seam: the fault-injection site
     (``collective``) plus, when armed, the watchdog deadline. With the
@@ -163,6 +176,8 @@ def _dispatch(name, thunk):
         # the fire lives INSIDE the watched call so an injected hang
         # lands on the watchdog thread — exactly where a real stuck
         # collective would sit
+        if _pre_dispatch_hook[0] is not None:
+            _pre_dispatch_hook[0](name)
         fault_injector.fire("collective", name)
         return thunk()
 

@@ -4,3 +4,6 @@ from .elasticity import (compute_elastic_config, elasticity_enabled,
 from .config import ElasticityConfig, ElasticityError, ElasticityConfigError, \
     ElasticityIncompatibleWorldSize
 from .elastic_agent import DSElasticAgent, resume_latest
+from .reshard import (plan_shrink_batch, reshard_from_manifest,  # noqa: F401
+                      reshard_state)
+from .supervisor import ElasticSupervisor  # noqa: F401

@@ -1,0 +1,211 @@
+"""Shrink-and-reshard: re-partition ZeRO state from the checkpoint
+manifest onto a SURVIVOR mesh.
+
+When a participant is permanently lost, the elastic supervisor's last
+non-terminal rung rebuilds the job on the surviving devices: a new
+engine on a smaller mesh, and every ZeRO-1/2/3 optimizer + parameter
+shard re-partitioned from the last integrity-verified checkpoint. The
+checkpoint stores LOGICAL (full) arrays with a per-file sha256
+manifest (checkpoint/engine.py), so re-sharding is a placement
+problem, not a math problem: read the verified manifest payload, then
+lay each leaf onto the new mesh under the new engine's sharding rules.
+
+Bulk movement rides the PR-2 transfer engine (runtime/transfer/):
+same-dtype leaves are fused into fixed-size buckets, each bucket is
+ONE ``device_put`` (replicated), and a jitted scatter-back slices the
+fused stream into leaves with the target shardings — the host->device
+wire carries ``ceil(bytes/bucket)`` transfers instead of one per leaf.
+All dispatch happens on the CALLING (main) thread: compiled
+multi-device programs must never dispatch from a worker thread
+concurrent with other device work (the PR-2 rendezvous deadlock rule).
+
+The pack/unpack pair is exact concat/slice, so the round trip is
+bitwise: gather-and-compare of optimizer state before and after a
+shrink must match exactly (asserted in
+tests/unit/elasticity/test_supervisor.py).
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..resilience.fault_injector import fault_injector
+from ..utils.logging import logger
+
+_fallback_warned = [False]  # unbounded-ok: single warn-once flag cell, never grows past one element
+
+
+def plan_shrink_batch(global_batch: int, micro_batch: int,
+                      survivors: int) -> Optional[Tuple[int, int, int]]:
+    """(dp_world, micro, gas) for the largest dp_world <= survivors
+    that keeps the GLOBAL batch (and the micro batch) unchanged —
+    convergence-preserving shrink, the same invariant the elasticity
+    math optimizes for (global = micro * gas * dp stays fixed).
+    None when not even dp_world=1 divides (cannot happen when the
+    original config was valid)."""
+    slots = global_batch // micro_batch
+    for dp in range(min(survivors, slots), 0, -1):
+        if global_batch % (micro_batch * dp) == 0:
+            return dp, micro_batch, slots // dp
+    return None
+
+
+def reshard_state(template_state, raw_map: dict,
+                  bucket_bytes: int = 64 << 20):
+    """Host full leaves (by dotted name) -> a state tree matching
+    ``template_state``'s structure and NEW-mesh shardings, moved in
+    fused transfer-engine buckets. Returns (state, bytes_moved).
+
+    ``template_state`` is the target engine's freshly-initialized
+    state (its leaves carry the survivor mesh's shardings);
+    ``raw_map`` is the manifest-verified payload from
+    ``checkpoint.engine.load_raw_named``."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec, \
+        SingleDeviceSharding
+
+    from ..runtime.transfer.engine import TransferEngine
+    from ..utils.tree import flatten_with_names
+
+    names, leaves, treedef = flatten_with_names(template_state)
+    missing = [n for n in names if n not in raw_map]
+    if missing:
+        raise KeyError(
+            f"checkpoint manifest is missing {len(missing)} leaves "
+            f"the survivor topology needs (first: {missing[:3]}) — "
+            "cannot reshard")
+
+    hosts = []
+    for n, tmpl in zip(names, leaves):
+        arr = np.asarray(raw_map[n])
+        dt = getattr(tmpl, "dtype", arr.dtype)
+        shape = tuple(getattr(tmpl, "shape", arr.shape))
+        if tuple(arr.shape) != shape:
+            raise ValueError(
+                f"leaf {n}: checkpoint shape {arr.shape} != survivor "
+                f"template {shape} — a structural change, not a "
+                "reshard")
+        # NOT ascontiguousarray: it silently promotes 0-d arrays to
+        # 1-d (ndmin=1), which would reshape every scalar leaf
+        hosts.append(np.asarray(arr.astype(dt, copy=False), order="C"))
+    bytes_moved = int(sum(h.nbytes for h in hosts))
+
+    # eager scalars (single-device template sharding) stay UNCOMMITTED
+    # exactly like checkpoint restore does — forcing them onto one
+    # device would conflict at the next jit call
+    bulk_idx = [i for i, t in enumerate(leaves)
+                if hasattr(t, "sharding")
+                and not isinstance(t.sharding, SingleDeviceSharding)]
+    out = [None] * len(leaves)
+    for i, (h, tmpl) in enumerate(zip(hosts, leaves)):
+        if i not in bulk_idx:
+            out[i] = jnp.asarray(h, dtype=getattr(tmpl, "dtype", None))
+
+    if bulk_idx:
+        mesh = leaves[bulk_idx[0]].sharding.mesh
+        replicated = NamedSharding(mesh, PartitionSpec())
+        eng = TransferEngine(bucket_bytes=bucket_bytes)
+        plan = eng.plan_specs([(hosts[i].shape, hosts[i].dtype)
+                               for i in bulk_idx])
+        staging = plan.alloc_staging()
+        views = plan.views(staging)
+        for m, i in enumerate(bulk_idx):
+            views[m][...] = hosts[i]
+        from ..resilience.errors import InjectedFault
+        from ..resilience.retry import retry_io
+        try:
+            bucket_lists = []
+            for si, sp in enumerate(plan.streams):
+                devs = []
+                for (b0, b1) in sp.buckets:
+                    # transient transfer failures retry (staging is
+                    # immutable, so a replayed put is exact — same
+                    # contract as the offload upload wire)
+                    def _put(si=si, b0=b0, b1=b1):
+                        fault_injector.fire("reshard.h2d")
+                        return jax.device_put(
+                            np.ascontiguousarray(staging[si][b0:b1]),
+                            replicated)
+
+                    devs.append(retry_io(
+                        _put, retries=2, backoff_seconds=0.01,
+                        description="reshard bucket h2d"))
+                bucket_lists.append(devs)
+            shardings = [leaves[i].sharding for i in bulk_idx]
+            resharded = eng.unpack(plan, bucket_lists, shardings)
+            for m, i in enumerate(bulk_idx):
+                out[i] = resharded[m]
+        except InjectedFault:
+            # a drilled fault that outlived the retry budget must
+            # reach the caller's recovery ladder — swallowing it here
+            # would make the registered site silently inert (the bug
+            # class fault_sites.py exists to prevent)
+            raise
+        except Exception as e:
+            # correctness over cleverness: any bucketed-path failure
+            # (exotic dtype, tiny-mesh layout corner) degrades to the
+            # per-leaf path, which is exact by construction
+            if not _fallback_warned[0]:
+                _fallback_warned[0] = True
+                logger.warning(
+                    f"bucketed reshard fell back to per-leaf "
+                    f"device_put ({type(e).__name__}: {str(e)[:160]})")
+            for i in bulk_idx:
+                out[i] = jax.device_put(hosts[i], leaves[i].sharding)
+
+    state = jax.tree_util.tree_unflatten(treedef, out)
+    logger.info(
+        f"resharded {len(leaves)} leaves / {bytes_moved / 1e6:.1f} MB "
+        f"onto the survivor mesh"
+        + (f" in {plan.n_transfers} fused transfers"
+           if bulk_idx else ""))
+    return state, bytes_moved
+
+
+def reshard_from_manifest(ckpt_dir: str, template_state,
+                          tag: Optional[str] = None,
+                          bucket_bytes: int = 64 << 20):
+    """Verified manifest read + reshard onto the survivor topology.
+    Returns (state, client_state, bytes_moved).
+
+    Same stale-``latest``/corrupt-tag contract as the rollback rung's
+    loader (checkpoint/engine.load_checkpoint): when ``tag`` is None
+    and the ``latest``-resolved tag is unusable, older tags are tried
+    newest-first — a crash that left ``latest`` pointing at a damaged
+    tag must not make the SHRINK rung fail where rollback would have
+    recovered. An explicitly requested tag never silently
+    substitutes."""
+    import pickle
+    import zipfile
+
+    from ..checkpoint.engine import (_fallback_tags, load_raw_named,
+                                     resolve_tag)
+    from ..resilience.errors import (CheckpointCorruptionError,
+                                     CheckpointLoadError)
+    tag0 = str(resolve_tag(ckpt_dir, tag))
+    candidates = [tag0]
+    if tag is None:
+        candidates += _fallback_tags(ckpt_dir, exclude=tag0)
+    failures = []
+    for cand in candidates:
+        try:
+            raw_map, client_state = load_raw_named(ckpt_dir, cand)
+        except (CheckpointCorruptionError, FileNotFoundError,
+                EOFError, pickle.UnpicklingError,
+                zipfile.BadZipFile) as e:
+            logger.warning(
+                f"reshard: checkpoint tag {cand!r} unusable "
+                f"({type(e).__name__}: {str(e)[:160]})"
+                + ("; trying the previous good tag"
+                   if cand != candidates[-1] else ""))
+            failures.append(f"{cand}: {type(e).__name__}: {e}")
+            continue
+        client_state = dict(client_state or {})
+        client_state["_loaded_tag"] = str(cand)
+        state, bytes_moved = reshard_state(template_state, raw_map,
+                                           bucket_bytes=bucket_bytes)
+        return state, client_state, bytes_moved
+    raise CheckpointLoadError(
+        f"no reshardable checkpoint under {ckpt_dir}; tried "
+        f"{candidates}: " + " | ".join(failures))

@@ -1,0 +1,586 @@
+"""Elastic training supervisor: failure detection + the escalation
+ladder (retry -> rollback -> shrink-and-reshard -> terminal).
+
+PR-6 made a single process durable; this layer makes the *job*
+durable. The supervisor drives the engine's training loop in lockstep
+with a fault domain (production: a real heartbeat transport; CI: the
+pg_sim simulator, tools/pg_sim/pg.py), detects failed participants,
+and walks a bounded escalation ladder:
+
+1. **retry** — transient stall (hang/slow): wait out up to
+   ``max_step_retries`` idle ticks and re-issue the step. The dispatch
+   gate raises BEFORE ``train_batch`` dispatches, so engine state is
+   untouched and a retry is a true re-issue.
+2. **rollback** — respawn the failed worker(s) (the elastic-agent
+   restart analog) and restore the last integrity-verified checkpoint
+   through ``resume_latest``. Deterministic resume (data cursor + PRNG
+   + sentinel state ride the checkpoint manifest) makes the replayed
+   trajectory bitwise-identical to an unfaulted run restored from the
+   same step — the chaos harness's core invariant.
+3. **shrink** — the worker is permanently lost: shrink the
+   data-parallel axis to the survivors, rebuild the engine on the
+   survivor mesh (``engine_factory``), and re-partition every
+   ZeRO-1/2/3 optimizer + parameter shard from the checkpoint
+   manifest via the PR-2 transfer engine (elasticity/reshard.py; all
+   dispatch on the main thread per the PR-2 rendezvous rule). The
+   global batch is preserved (gas absorbs the lost replicas), so the
+   optimization trajectory is unchanged.
+4. **terminal** — nothing left to try: raise
+   ``UnrecoverableWorkerFailure`` carrying exit code 75 (the elastic
+   agent's EX_TEMPFAIL terminal code).
+
+Detection is a composition of the resilience watchdog primitives:
+
+* a **dispatch gate** before every step — the simulated analog of the
+  collective rendezvous; hung/dead participants raise a typed
+  ``WorkerFailureError`` instead of wedging the loop. When
+  ``resilience.collective_timeout_seconds`` arms the process-wide
+  ``CollectiveWatchdog``, the gate (host-only work) runs under its
+  wall deadline, so a ``pg_sim.collective:hang`` spec trips a real
+  ``CollectiveTimeout``;
+* the **HeartbeatMonitor** (resilience/watchdog.py) — per-worker
+  heartbeat/progress deadlines in supervised steps, catching silent
+  death and stragglers that never touch a collective;
+* the engine's **train sentinel** — NaN/spike detection for the
+  corrupt mode (the sentinel's own rollback is recorded into the same
+  recovery report).
+
+Every detection and every ladder action lands in the engine's
+``RecoveryReport`` (``engine.get_recovery_report()``: detections,
+rung taken, MTTR, resharded bytes), published alongside the PR-6
+process-memory gauges.
+"""
+
+import time
+from typing import Callable, Optional
+
+from ..resilience.errors import (CollectiveTimeout,
+                                 UnrecoverableWorkerFailure,
+                                 WorkerFailureError)
+from ..resilience.recovery import (Detection, RecoveryRecord, RETRY,
+                                   ROLLBACK, SHRINK)
+from ..resilience.watchdog import (HeartbeatMonitor,
+                                   collective_watchdog)
+from ..utils.logging import logger
+from .elastic_agent import resume_latest
+from .reshard import plan_shrink_batch, reshard_from_manifest
+
+
+class ElasticSupervisor:
+    """Supervises one engine's training loop over a fault domain.
+
+    ``engine_factory(devices, batch_plan) -> engine`` builds a fresh
+    engine on the survivor mesh for the shrink rung (``batch_plan`` is
+    a dict of train_batch_size / train_micro_batch_size_per_gpu /
+    gradient_accumulation_steps that preserves the global batch);
+    without one the ladder skips from rollback to terminal.
+    """
+
+    def __init__(self, engine, domain, ckpt_dir: str,
+                 engine_factory: Optional[Callable] = None,
+                 save_interval: Optional[int] = None,
+                 heartbeat_timeout_steps: Optional[int] = None,
+                 progress_timeout_steps: Optional[int] = None,
+                 max_step_retries: Optional[int] = None,
+                 min_workers: Optional[int] = None,
+                 reshard_bucket_bytes: Optional[int] = None):
+        # explicit kwargs override the engine's config block
+        # (``elasticity.supervisor``, runtime/config.py)
+        cfg = getattr(engine._config, "supervisor_config", None)
+
+        def pick(v, name, fallback):
+            return v if v is not None else getattr(cfg, name, fallback)
+
+        self.engine = engine
+        self.domain = domain
+        self.ckpt_dir = str(ckpt_dir)
+        self.engine_factory = engine_factory
+        self.save_interval = max(
+            1, int(pick(save_interval, "save_interval", 1)))
+        self.max_step_retries = int(
+            pick(max_step_retries, "max_step_retries", 2))
+        self.min_workers = max(
+            1, int(pick(min_workers, "min_workers", 1)))
+        self.reshard_bucket_bytes = int(reshard_bucket_bytes) \
+            if reshard_bucket_bytes is not None else \
+            int(getattr(cfg, "reshard_bucket_mb", 64.0) * (1 << 20))
+        self.monitor = HeartbeatMonitor(
+            domain.world_size,
+            heartbeat_timeout_steps=int(pick(
+                heartbeat_timeout_steps, "heartbeat_timeout_steps", 1)),
+            progress_timeout_steps=int(pick(
+                progress_timeout_steps, "progress_timeout_steps", 3)))
+        # the engine's sentinel rolls back through the same ckpt dir
+        if getattr(engine, "_sentinel", None) is not None \
+                and not engine._sentinel.ckpt_dir:
+            engine._sentinel.ckpt_dir = self.ckpt_dir
+        self._last_batch = None
+        self._stall_streak = 0    # consecutive monitor detections
+        self._initial_saved = False
+        # iterator-flow replay machinery (see _fetch_batch): batches
+        # consumed since the last checkpoint commit, and the queue a
+        # rollback refills for the replayed steps
+        self._since_commit = []
+        self._replay_queue = []
+        self._install_domain()
+
+    def _install_domain(self):
+        """Hook the comm layer's eager-dispatch health gate when the
+        domain is the pg_sim simulator. A production domain (a real
+        heartbeat transport exposing the same ``workers`` surface) is
+        consumed only through the explicit gate/monitor paths — it
+        never touches the simulator's process-global slot."""
+        from ..tools.pg_sim.pg import SimProcessGroup, install_domain
+        if isinstance(self.domain, SimProcessGroup):
+            install_domain(self.domain)
+
+    # ------------------------------------------------------------------
+    def close(self):
+        from ..tools.pg_sim.pg import uninstall_domain
+        uninstall_domain()
+
+    @property
+    def report(self):
+        return self.engine.recovery()
+
+    # ---- detection ----------------------------------------------------
+    def _gate(self):
+        """Pre-dispatch health gate — the rendezvous stand-in: a
+        participant that cannot reach the barrier surfaces HERE as a
+        typed error, not as a wedged dispatch. Runs under the
+        process-wide collective watchdog when armed (host-only work,
+        so the PR-2 main-thread dispatch rule is not violated)."""
+        step = self.engine.global_steps
+
+        def check():
+            from ..tools.pg_sim.pg import check_collective_health
+            check_collective_health("train_step.gate")
+            for w in self.domain.workers:
+                if w.state == "dead":
+                    raise WorkerFailureError(
+                        w.rank, "kill", step=step,
+                        reason="participant lost before dispatch")
+                if w.state == "hung":
+                    raise WorkerFailureError(
+                        w.rank, "hang", step=step,
+                        reason="participant unresponsive at the "
+                               "dispatch barrier")
+
+        collective_watchdog.run("pg_sim.gate", check)
+
+    def _monitor_detections(self, step):
+        dets = []
+        for r, mode, reason in self.monitor.check(step):
+            w = self.domain.worker(r)
+            if not w.alive:
+                mode, reason = "kill", "silent worker found dead"
+            dets.append(Detection(step, r, mode, reason))
+        return dets
+
+    # ---- the supervised step ------------------------------------------
+    def _ensure_initial_checkpoint(self, batch):
+        """The rollback rung needs a committed checkpoint from step 0
+        on — commit one before the first supervised step (a kill at
+        step 0 must be recoverable too)."""
+        if self._initial_saved:
+            return
+        import os
+        if os.path.exists(os.path.join(self.ckpt_dir, "latest")):
+            self._initial_saved = True
+            return
+        if not self.engine._params_initialized:
+            if batch is None:
+                # data_iter flow: params appear after the first
+                # train_batch — retry on the NEXT step so the commit
+                # still happens as early as possible
+                return
+            self.engine.init_params(batch)
+        self.engine.save_checkpoint(self.ckpt_dir)
+        self._initial_saved = True
+
+    def step(self, batch=None, data_iter=None):
+        """One supervised global step, with detection + recovery."""
+        if batch is not None:
+            self._last_batch = batch
+        self._ensure_initial_checkpoint(batch)
+        step = self.engine.global_steps
+        self.domain.begin_step(step)
+
+        attempts = 0
+        incident = None
+        while True:
+            try:
+                self._gate()
+                break
+            except (WorkerFailureError, CollectiveTimeout) as e:
+                det = self._detection_from(e, step)
+                if incident is None:
+                    incident = det
+                    self.report.note_detection(det)
+                else:
+                    # same incident re-observed on a later gate
+                    # attempt: keep the ORIGINAL detection time so
+                    # MTTR spans the whole outage
+                    det.t_detect = incident.t_detect
+                if attempts > self.max_step_retries + 2:
+                    # the ladder already spent its retry budget PLUS a
+                    # rollback (and possibly a shrink) on this one
+                    # incident and the gate still fails — a persistent
+                    # unattributable stall (e.g. a wedged barrier the
+                    # watchdog times out but nobody owns) must reach
+                    # the terminal rung, not loop forever
+                    raise self._terminal(
+                        f"dispatch gate still failing after "
+                        f"{attempts} recovery attempts at step "
+                        f"{step}: {det.reason}",
+                        [incident], incident.t_detect) from e
+                self._recover([det], attempts)
+                attempts += 1
+                step = self.engine.global_steps
+
+        loss = self._run_step(batch, data_iter)
+        self.domain.complete_step(step)
+        for w in self.domain.alive_workers():
+            if w.state != "hung":
+                self.monitor.beat(w.rank, step,
+                                  progressed=w.progress >= step)
+        post = self._monitor_detections(step)
+        if post:
+            self._stall_streak += 1
+            if self._stall_streak == 1:
+                self._stall_t0 = min(d.t_detect for d in post)
+                for d in post:
+                    self.report.note_detection(d)
+            else:
+                # same stall re-observed on a later step: MTTR must
+                # span the whole outage, not the latest observation
+                for d in post:
+                    d.t_detect = self._stall_t0
+            self._recover(post, self._stall_streak - 1)
+        else:
+            self._stall_streak = 0
+        if self.engine.global_steps and \
+                self.engine.global_steps % self.save_interval == 0:
+            self.engine.save_checkpoint(self.ckpt_dir)
+            # commit point: everything consumed so far is covered by
+            # the checkpoint; only batches at/after the commit step
+            # could ever need replay
+            g = self.engine.global_steps
+            self._since_commit = [e for e in self._since_commit
+                                  if e[0] >= g]
+        return loss
+
+    def run(self, num_steps: int, batch=None, data_iter=None):
+        """Supervise until ``num_steps`` global steps completed;
+        returns the per-call losses."""
+        losses = []
+        while self.engine.global_steps < num_steps:
+            losses.append(self.step(batch=batch, data_iter=data_iter))
+        return losses
+
+    def _detection_from(self, e, step):
+        if isinstance(e, CollectiveTimeout):
+            # wall-deadline detection: the gate itself hung — blame
+            # the first non-healthy worker (rank unknown to a timeout)
+            bad = (self.domain.hung_ranks() or self.domain.dead_ranks()
+                   or [-1])
+            return Detection(step, bad[0], "hang",
+                             f"gate exceeded the collective watchdog "
+                             f"deadline ({e.timeout_seconds:.1f}s)")
+        return Detection(step, e.rank, e.mode, str(e))
+
+    def _fetch_batch(self, data_iter):
+        """Supervisor-owned batch fetch for the iterator-driven flow.
+
+        Why the supervisor (not train_batch) consumes the iterator:
+        an EXTERNAL iterator has no checkpointable cursor, so a
+        rollback would rewind the engine but not the caller's stream
+        — the replayed steps would silently train on fresh samples
+        and the bitwise replay invariant would not hold. The
+        supervisor therefore logs every batch consumed since the last
+        checkpoint commit and, after a rollback, REPLAYS the logged
+        batches before touching the iterator again (the engine's own
+        dataloader additionally rides the checkpointed cursor, so
+        both flows replay the exact sample stream). The log is
+        bounded by ``save_interval`` batches."""
+        if self._replay_queue:
+            batch = self._replay_queue.pop(0)
+            self._since_commit.append(
+                (self.engine.global_steps, batch))
+            return batch
+        external = data_iter is not None or not hasattr(
+            self.engine.training_dataloader, "state_dict")
+        it = data_iter if data_iter is not None \
+            else self.engine.data_iterator
+        if it is None:
+            raise ValueError(
+                "supervised step needs a batch, a data_iter, or "
+                "an engine with training data")
+        batch = next(it)
+        if external:
+            # the engine's OWN dataloader already rides the
+            # checkpointed (epoch, batch) cursor — a rollback rewinds
+            # it with the state, so logging those batches too would
+            # feed the replayed steps twice
+            self._since_commit.append(
+                (self.engine.global_steps, batch))
+        return batch
+
+    def _requeue_since(self, restored_step):
+        """After a rollback to ``restored_step``: batches consumed at
+        or past the restore point must be re-fed to the replayed
+        steps."""
+        keep, replay = [], []
+        for s, b in self._since_commit:
+            (replay if s >= restored_step else keep).append((s, b))
+        self._since_commit = keep
+        self._replay_queue = [b for _, b in replay] + \
+            self._replay_queue
+
+    def _run_step(self, batch, data_iter):
+        for r in self.domain.poisoned_ranks():
+            self._poison_contribution(r)
+        if batch is None:
+            batch = self._fetch_batch(data_iter)
+            self._last_batch = batch
+        s = self.engine._sentinel
+        rb_before = s.rollbacks if s is not None else 0
+        loss = self.engine.train_batch(batch=batch)
+        if s is not None and s.rollbacks > rb_before:
+            # the engine's own sentinel rolled back INSIDE
+            # train_batch (corrupt/divergence path): re-feed the
+            # rolled-back steps' batches. Keyed on the rollback
+            # COUNT, not the step number — a rollback to the
+            # just-committed tag leaves global_steps unchanged,
+            # indistinguishable from an overflow skip by steps alone
+            # (a skip consumed its batch legitimately and must NOT
+            # requeue)
+            self._requeue_since(self.engine.global_steps)
+        return loss
+
+    def _poison_contribution(self, rank):
+        """The corrupt mode's observable effect: NaN worker ``rank``'s
+        slice of the first float master leaf — a stand-in for a bad
+        DMA/bit-flip in that worker's shard. The train sentinel sees
+        the non-finite loss and its budgeted rollback restores the
+        poisoned state exactly (the same recovery a real corruption
+        needs)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        eng = self.engine
+        flat, treedef = jax.tree_util.tree_flatten(
+            eng.state.master_params)
+        for i, leaf in enumerate(flat):
+            if not jnp.issubdtype(leaf.dtype, jnp.floating):
+                continue
+            host = np.array(leaf)
+            w = self.domain.world_size
+            if host.ndim and host.shape[0] % w == 0:
+                per = host.shape[0] // w
+                host[rank * per:(rank + 1) * per] = np.nan
+            else:
+                host[...] = np.nan
+            flat[i] = jax.device_put(host, leaf.sharding)
+            break
+        eng.state = eng.state._replace(
+            master_params=jax.tree_util.tree_unflatten(treedef, flat))
+        logger.warning(
+            f"pg_sim: worker {rank}'s shard contribution poisoned "
+            f"(corrupt mode)")
+
+    # ---- the escalation ladder ----------------------------------------
+    def _recover(self, detections, prior_attempts):
+        t0 = min(d.t_detect for d in detections)
+        modes = {d.mode for d in detections}
+        ranks = sorted({d.rank for d in detections if d.rank >= 0})
+        step = self.engine.global_steps
+
+        # rung 1 — retry: wait out a transient stall. Kills are never
+        # transient; and once the retry budget is spent, escalate.
+        if "kill" not in modes and prior_attempts < self.max_step_retries:
+            self.domain.idle_tick()
+            # rank-less detections (a watchdog timeout nobody could
+            # attribute) can never CLAIM recovery here — only a
+            # passing re-gate proves it, so they just wait
+            healthy = bool(ranks) and all(
+                self.domain.worker(r).alive
+                and self.domain.worker(r).state != "hung"
+                and self.domain.worker(r).slow_left <= 0
+                for r in ranks)
+            if healthy:
+                for r in ranks:
+                    self.monitor.restore(r, step)
+                self._stall_streak = 0
+                self.report.note_recovery(RecoveryRecord(
+                    RETRY, detections[0],
+                    mttr_s=time.monotonic() - t0,
+                    restored_step=step,
+                    world_before=len(self.domain.alive_workers()),
+                    world_after=len(self.domain.alive_workers()),
+                    detail=f"stall cleared after "
+                           f"{prior_attempts + 1} wait tick(s)"))
+                logger.warning(
+                    f"supervisor: rung=retry recovered workers "
+                    f"{ranks} at step {step}")
+                return
+            # still stalled: burn the retry budget before escalating
+            if prior_attempts + 1 < self.max_step_retries:
+                return
+        # rung 2 — rollback: respawn + restore the last verified
+        # checkpoint (skipped when a worker cannot respawn)
+        world_before = len(self.domain.alive_workers()) + \
+            len(self.domain.dead_ranks())
+        respawned = all(self.domain.respawn(r) for r in ranks) \
+            if ranks else True
+        if respawned:
+            if not resume_latest(self.engine, self.ckpt_dir):
+                raise self._terminal(
+                    "rollback rung has no committed checkpoint under "
+                    f"{self.ckpt_dir!r}", detections, t0)
+            self._requeue_since(self.engine.global_steps)
+            for r in ranks:
+                self.monitor.restore(r, self.engine.global_steps)
+            self._stall_streak = 0
+            self.report.note_recovery(RecoveryRecord(
+                ROLLBACK, detections[0],
+                mttr_s=time.monotonic() - t0,
+                restored_step=self.engine.global_steps,
+                world_before=world_before,
+                world_after=len(self.domain.alive_workers()),
+                detail=f"respawned workers {ranks}, resumed from "
+                       f"step {self.engine.global_steps}"))
+            logger.warning(
+                f"supervisor: rung=rollback respawned {ranks}, "
+                f"restored step {self.engine.global_steps}")
+            return
+        # rung 3 — shrink-and-reshard onto the survivors
+        if self._try_shrink(detections, t0, world_before):
+            return
+        raise self._terminal(
+            f"workers {ranks} unrecoverable (modes={sorted(modes)}) "
+            "and no shrink path is available", detections, t0)
+
+    def _terminal(self, reason, detections, t0=None):
+        """Record the terminal rung in the report (every ladder action
+        lands there — including running out of ladder) and build the
+        typed error for the caller to raise."""
+        from ..resilience.recovery import TERMINAL
+        alive = len(self.domain.alive_workers())
+        self.report.note_recovery(RecoveryRecord(
+            TERMINAL, detections[0] if detections else None,
+            mttr_s=(time.monotonic() - t0) if t0 is not None else 0.0,
+            restored_step=self.engine.global_steps,
+            world_before=alive + len(self.domain.dead_ranks()),
+            world_after=alive,
+            detail=reason))
+        return UnrecoverableWorkerFailure(reason,
+                                          detections=detections)
+
+    def _try_shrink(self, detections, t0, world_before) -> bool:
+        eng = self.engine
+        # shrink removes EVERY dead worker, not just the detected
+        # ones (two kills in one step surface as one gate error) — the
+        # monitor must retire them all or the next check re-detects a
+        # worker the shrink already accounted for and forces a
+        # spurious second rebuild
+        gone = list(self.domain.dead_ranks())
+        # plan on the survivor view WITHOUT mutating the domain yet —
+        # a non-viable shrink (no factory, min_workers floor, no
+        # batch plan, unrestorable checkpoint) must leave the domain
+        # intact so the terminal record still counts the dead workers
+        survivors = self.domain.survivor_devices()
+        n_alive = len(self.domain.alive_workers())
+        if self.engine_factory is None or not survivors or \
+                n_alive < self.min_workers:
+            return False
+        plan = plan_shrink_batch(
+            eng.train_batch_size(),
+            eng.train_micro_batch_size_per_gpu(),
+            len(survivors))
+        if plan is None:
+            return False
+        dp, micro, gas = plan
+        # the rebuilt mesh's data axis absorbs EVERY device passed, so
+        # the device list must be exactly dp long or the batch plan
+        # contradicts the mesh (micro*gas*dp_world != global raises at
+        # engine init); surplus survivor devices idle
+        devices = survivors[:dp]
+        batch_plan = {
+            "train_batch_size": eng.train_batch_size(),
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": gas,
+        }
+        logger.warning(
+            f"supervisor: rung=shrink rebuilding on {len(devices)} "
+            f"survivor device(s) (dp={dp}, micro={micro}, gas={gas})")
+        new_engine = self.engine_factory(devices, batch_plan)
+        if not new_engine._params_initialized:
+            # the reshard needs a template state tree; any batch with
+            # the training shape works (params don't depend on
+            # values). _run_step records every batch it sees (both
+            # flows), so _last_batch is populated by the first
+            # supervised step; the bail-out below is the
+            # never-stepped-yet corner only.
+            if self._last_batch is not None:
+                new_engine.init_params(self._last_batch)
+            elif new_engine.data_iterator is not None:
+                new_engine.init_params(next(new_engine.data_iterator))
+            else:
+                new_engine.close()
+                return False
+        try:
+            if new_engine._offload is not None:
+                # the offload host payload lives beside the manifest
+                # with its own checksum; the engine's own loader
+                # re-partitions both consistently
+                new_engine.load_checkpoint(self.ckpt_dir)
+                import jax
+                bytes_moved = int(sum(
+                    getattr(l, "nbytes", 0) for l in
+                    jax.tree_util.tree_leaves(new_engine.state)))
+            else:
+                state, client_state, bytes_moved = \
+                    reshard_from_manifest(
+                        self.ckpt_dir, new_engine.state,
+                        bucket_bytes=self.reshard_bucket_bytes)
+                new_engine.state = state
+                new_engine._apply_client_state(client_state)
+                new_engine._invalidate_compiled_steps("shrink_reshard")
+        except Exception as e:
+            # any unrestorable-survivor condition — corrupt/missing
+            # checkpoint (typed), a stale dir with no `latest`
+            # (ValueError), a structural template mismatch (KeyError)
+            # — means "no shrink path": the ladder's TYPED terminal
+            # error must fire from _recover, never a raw loader
+            # exception escaping step(), and never with the built
+            # engine leaked
+            logger.error(f"shrink rung cannot restore "
+                         f"({type(e).__name__}): {e}")
+            new_engine.close()
+            return False
+        # the restore succeeded: NOW commit the domain mutation
+        self.domain.shrink()
+        # carry the report (and its history) onto the new engine
+        new_engine._recovery = eng.recovery()
+        old, self.engine = self.engine, new_engine
+        self._requeue_since(new_engine.global_steps)
+        self._install_domain()
+        for r in set(gone) | {d.rank for d in detections
+                              if d.rank >= 0}:
+            self.monitor.retire(r)
+        if getattr(new_engine, "_sentinel", None) is not None \
+                and not new_engine._sentinel.ckpt_dir:
+            new_engine._sentinel.ckpt_dir = self.ckpt_dir
+        old.close()
+        self._stall_streak = 0
+        self.report.note_recovery(RecoveryRecord(
+            SHRINK, detections[0],
+            mttr_s=time.monotonic() - t0,
+            restored_step=new_engine.global_steps,
+            resharded_bytes=bytes_moved,
+            world_before=world_before,
+            world_after=len(self.domain.alive_workers()),
+            detail=f"resharded onto {len(devices)} device(s), "
+                   f"resumed from step {new_engine.global_steps}"))
+        return True

@@ -12,12 +12,17 @@ elastic respawn). Config lives under the ``resilience`` block
 from .errors import (CheckpointCorruptionError, CheckpointLoadError,  # noqa: F401
                      CollectiveTimeout, InjectedFault, InjectedIOError,
                      ResilienceError, ServingOverloadError,
-                     TrainingDivergenceError)
+                     TrainingDivergenceError,
+                     UnrecoverableWorkerFailure, WorkerFailureError)
 from .fault_injector import (FaultInjector, FaultSpec,  # noqa: F401
                              KNOWN_SITES, fault_injector)
+from .fault_sites import FAULT_SITES  # noqa: F401
+from .recovery import (Detection, RecoveryRecord,  # noqa: F401
+                       RecoveryReport)
 from .integrity import (MANIFEST_NAME, atomic_write_bytes,  # noqa: F401
                         atomic_write_text, file_sha256, verify_manifest,
                         write_manifest)
 from .retry import backoff_delay, retry_io  # noqa: F401
 from .sentinel import TrainSentinel  # noqa: F401
-from .watchdog import CollectiveWatchdog, collective_watchdog  # noqa: F401
+from .watchdog import (CollectiveWatchdog, HeartbeatMonitor,  # noqa: F401
+                       collective_watchdog)

@@ -65,6 +65,42 @@ class ServingOverloadError(ResilienceError):
                if self.shed_uids else "") + ")")
 
 
+class WorkerFailureError(ResilienceError):
+    """A participant of the training job failed (detected via missed
+    heartbeats / stalled progress, or a simulated fault under
+    tools/pg_sim). Carries the worker identity and the failure mode so
+    the elastic supervisor's escalation ladder can pick the right
+    rung (retry / rollback / shrink) programmatically."""
+
+    def __init__(self, rank: int, mode: str, reason: str = "",
+                 step: int = -1):
+        self.rank = rank
+        self.mode = mode
+        self.step = step
+        self.reason = reason
+        super().__init__(
+            f"worker {rank} failed (mode={mode}"
+            + (f", step={step}" if step >= 0 else "")
+            + (f"): {reason}" if reason else ")"))
+
+
+class UnrecoverableWorkerFailure(ResilienceError):
+    """The elastic supervisor exhausted its escalation ladder (retry,
+    rollback, shrink-and-reshard) and cannot keep the job alive.
+    ``exit_code`` is the elastic agent's terminal code (75, BSD
+    EX_TEMPFAIL) so a process-level supervisor that catches this and
+    exits with it composes with outer schedulers exactly like the
+    agent's own restart-budget exhaustion."""
+
+    def __init__(self, reason: str, exit_code: int = 75,
+                 detections=()):
+        self.exit_code = exit_code
+        self.detections = tuple(detections)
+        super().__init__(
+            f"unrecoverable worker failure: {reason} "
+            f"(terminal exit code {exit_code})")
+
+
 class InjectedFault(ResilienceError):
     """A deliberately injected failure (FaultInjector). Base class so
     tests can distinguish injected faults from organic ones."""

@@ -31,10 +31,17 @@ Spec grammar (config ``resilience.fault_injection`` or env
 
     <site>:<kind>[@<after>][x<count>][~<arg>]
 
-    kind   ioerror | error | hang
+    kind   ioerror | error | hang | kill | slow | corrupt
     after  fire on the Nth call to the site (0-based, default 0)
     count  how many consecutive calls fault (default 1; 'inf' = forever)
     arg    kind parameter (hang: seconds to sleep, default 3600)
+
+The ``kill`` / ``slow`` / ``corrupt`` kinds exist for sites that
+*interpret* their matched spec via ``consume()`` instead of having
+``fire()`` act on it — the pg_sim fault domain (tools/pg_sim/pg.py)
+maps them to worker kill / degraded progress / poisoned shard. A
+classic ``fire()`` site that matches one of them degrades sanely:
+kill/corrupt raise like ``error``, slow sleeps like ``hang``.
 
 Examples::
 
@@ -55,14 +62,12 @@ from typing import Dict, List, Optional, Union
 from ..utils.logging import logger
 from .errors import InjectedFault, InjectedIOError
 
-KNOWN_SITES = (
-    "checkpoint.save", "checkpoint.load", "collective",
-    "offload.d2h", "offload.h2d", "transfer.d2h", "transfer.h2d",
-    "data.fetch", "lifecycle.evict", "serving.admit",
-    "serving.dispatch",
-)
+# central registry (fault_sites.py) — the lint
+# tools/lint_fault_sites.py keeps every fire()/consume() call site
+# honest against it
+from .fault_sites import KNOWN_SITES  # noqa: F401  (re-exported)
 
-_KINDS = ("ioerror", "error", "hang")
+_KINDS = ("ioerror", "error", "hang", "kill", "slow", "corrupt")
 
 ENV_SPEC = "DSTPU_FAULT_INJECT"
 
@@ -71,7 +76,8 @@ class FaultSpec:
     """One parsed injection rule (see module docstring for grammar)."""
 
     def __init__(self, site: str, kind: str, after: int = 0,
-                 count: Union[int, float] = 1, arg: float = 3600.0):
+                 count: Union[int, float] = 1, arg: float = 3600.0,
+                 arg_given: bool = False):
         if kind not in _KINDS:
             raise ValueError(f"unknown fault kind {kind!r}; "
                              f"expected one of {_KINDS}")
@@ -85,6 +91,10 @@ class FaultSpec:
         self.after = int(after)
         self.count = count
         self.arg = float(arg)
+        # whether ~arg appeared in the spec text: consuming sites with
+        # per-kind duration defaults (pg_sim) need to tell "default
+        # 3600" apart from "explicit 3600"
+        self.arg_given = bool(arg_given)
 
     @classmethod
     def parse(cls, entry: str) -> "FaultSpec":
@@ -105,7 +115,8 @@ class FaultSpec:
                 else int(m.group("count"))
         return cls(site, m.group("kind"),
                    after=int(m.group("after") or 0), count=count,
-                   arg=float(m.group("arg") or 3600.0))
+                   arg=float(m.group("arg") or 3600.0),
+                   arg_given=m.group("arg") is not None)
 
     def __repr__(self):
         return (f"FaultSpec({self.site}:{self.kind}@{self.after}"
@@ -154,11 +165,11 @@ class FaultInjector:
         with self._lock:
             return self._calls.get(site, 0)
 
-    def fire(self, site: str, detail: str = ""):
-        """Invoked by an instrumented site; raises/sleeps per the
-        matching spec, else returns immediately."""
+    def _match(self, site: str):
+        """Advance ``site``'s call ordinal and return (spec, ordinal)
+        for the matching rule (spec None when nothing matches)."""
         if not self._specs:
-            return
+            return None, -1
         with self._lock:
             n = self._calls.get(site, 0)
             self._calls[site] = n + 1
@@ -169,16 +180,36 @@ class FaultInjector:
                     break
             if spec is not None:
                 self.fired.append(f"{site}:{spec.kind}@{n}")
+        return spec, n
+
+    def fire(self, site: str, detail: str = ""):
+        """Invoked by an instrumented site; raises/sleeps per the
+        matching spec, else returns immediately."""
+        spec, n = self._match(site)
         if spec is None:
             return
         label = f"{site}[{n}]" + (f" ({detail})" if detail else "")
         logger.warning(f"fault injection: {spec.kind} at {label}")
-        if spec.kind == "hang":
+        if spec.kind in ("hang", "slow"):
             time.sleep(spec.arg)
             return
         if spec.kind == "ioerror":
             raise InjectedIOError(f"injected I/O fault at {label}")
         raise InjectedFault(f"injected fault at {label}")
+
+    def consume(self, site: str, detail: str = ""):
+        """Like ``fire`` but returns the matched ``FaultSpec`` (or
+        None) for the CALLER to interpret instead of acting on it —
+        the seam for sites whose failure modes are richer than
+        raise/sleep (pg_sim's per-worker kill/hang/slow/corrupt).
+        Shares the per-site call ordinals and the ``fired`` audit log
+        with ``fire``, so specs and tests reason about one counter."""
+        spec, n = self._match(site)
+        if spec is not None:
+            label = f"{site}[{n}]" + (f" ({detail})" if detail else "")
+            logger.warning(
+                f"fault injection: {spec.kind} consumed at {label}")
+        return spec
 
     class _Scope:
         def __init__(self, injector, spec):

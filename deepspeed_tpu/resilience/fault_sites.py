@@ -1,0 +1,67 @@
+"""Central registry of fault-injection sites.
+
+Every site string passed to ``fault_injector.fire()`` / ``consume()``
+MUST be declared here. A typo'd site string is the worst kind of test
+bug: the spec parses, the drill runs green, and the fault silently
+never fires — the recovery path under test never executes.
+``tools/lint_fault_sites.py`` statically checks every call site in the
+package against this table (wired into the README lint list next to
+``lint_unbounded_caches.py``).
+
+Keys are the site names; values are one-line descriptions of where the
+site fires (kept here, not in fault_injector's docstring, so the
+registry is the single source of truth).
+"""
+
+FAULT_SITES = {
+    "checkpoint.save":
+        "shard payload write (checkpoint/engine.py)",
+    "checkpoint.load":
+        "shard read + verify (checkpoint/engine.py)",
+    "collective":
+        "eager collective dispatch (comm/comm.py)",
+    "offload.d2h":
+        "host-offload grad download (runtime/zero/offload.py)",
+    "offload.h2d":
+        "host-offload param upload (runtime/zero/offload.py)",
+    "transfer.d2h":
+        "bucketed transfer engine: one fire per fused bucket download "
+        "(runtime/zero/offload.py via runtime/transfer/)",
+    "transfer.h2d":
+        "bucketed transfer engine: one fire per fused bucket upload",
+    "data.fetch":
+        "dataloader batch assembly (runtime/dataloader.py)",
+    "lifecycle.evict":
+        "bounded-cache LRU eviction (runtime/lifecycle.py; fires "
+        "BEFORE state changes, so an injected fault leaves the cache "
+        "consistent)",
+    "serving.admit":
+        "serving admission control, one fire per admitted/considered "
+        "request (inference/v2/engine_v2.py admit_requests)",
+    "serving.dispatch":
+        "serving-loop forward dispatch, inside the dispatch watchdog's "
+        "deadline (a ``hang`` spec here is how the watchdog path is "
+        "tested)",
+    # ---- pg_sim fault domain (tools/pg_sim/pg.py) ----
+    # one consume() per (step, worker slot) in rank order — ordinal
+    # = step * world_size + rank, so a spec can target any worker at
+    # any step deterministically (SimProcessGroup.spec_for helper).
+    # Kinds here use the simulator's mode semantics: kill / hang /
+    # slow / corrupt (see pg.py module docstring).
+    "pg_sim.step":
+        "simulated fault domain: per-worker per-step fault poll "
+        "(tools/pg_sim/pg.py begin_step; ordinal = step*world+rank)",
+    "pg_sim.collective":
+        "simulated fault domain: pre-collective health gate "
+        "(comm/comm.py eager dispatch when a SimProcessGroup is "
+        "installed — a hung/dead virtual worker stalls the barrier)",
+    "reshard.h2d":
+        "shrink-and-reshard bulk upload: one fire per fused transfer "
+        "bucket (elasticity/reshard.py via runtime/transfer/)",
+}
+
+KNOWN_SITES = tuple(FAULT_SITES)
+
+
+def describe(site: str) -> str:
+    return FAULT_SITES.get(site, "<unregistered site>")

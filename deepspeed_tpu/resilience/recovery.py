@@ -1,0 +1,116 @@
+"""Recovery bookkeeping: what failed, which ladder rung fixed it, and
+how long the job was down.
+
+The elastic supervisor (elasticity/supervisor.py) and the engine's own
+sentinel rollback both write here; ``engine.get_recovery_report()``
+publishes the aggregate next to the PR-6 process-memory gauges. The
+schema is flat JSON-able dicts so the report can land in bench
+decompositions and monitors unchanged.
+
+MTTR convention: per incident, seconds from *detection* (the moment
+the failure detector flagged the worker / the sentinel crossed its
+budget) to *recovery complete* (the ladder action finished and the
+engine is trainable again). Wall-clock via ``time.monotonic`` — an
+MTTR must never go negative on clock steps.
+"""
+
+import time
+from typing import List, Optional
+
+# ladder rungs, in escalation order
+RETRY = "retry"
+ROLLBACK = "rollback"
+SHRINK = "shrink"
+TERMINAL = "terminal"
+
+LADDER = (RETRY, ROLLBACK, SHRINK, TERMINAL)
+
+
+class Detection:
+    """One failure observation (before any recovery action)."""
+
+    def __init__(self, step: int, rank: int, mode: str, reason: str,
+                 t_detect: Optional[float] = None):
+        self.step = int(step)
+        self.rank = int(rank)
+        self.mode = mode
+        self.reason = reason
+        self.t_detect = time.monotonic() if t_detect is None \
+            else float(t_detect)
+
+    def as_dict(self):
+        return {"step": self.step, "rank": self.rank,
+                "mode": self.mode, "reason": self.reason}
+
+    def __repr__(self):
+        return (f"Detection(step={self.step}, rank={self.rank}, "
+                f"mode={self.mode!r}, reason={self.reason!r})")
+
+
+class RecoveryRecord:
+    """One completed ladder action."""
+
+    def __init__(self, rung: str, detection: Optional[Detection],
+                 mttr_s: float, restored_step: int = -1,
+                 resharded_bytes: int = 0, world_before: int = 0,
+                 world_after: int = 0, detail: str = ""):
+        if rung not in LADDER:
+            raise ValueError(f"unknown ladder rung {rung!r}; "
+                             f"expected one of {LADDER}")
+        self.rung = rung
+        self.detection = detection
+        self.mttr_s = float(mttr_s)
+        self.restored_step = int(restored_step)
+        self.resharded_bytes = int(resharded_bytes)
+        self.world_before = int(world_before)
+        self.world_after = int(world_after)
+        self.detail = detail
+
+    def as_dict(self):
+        d = {"rung": self.rung, "mttr_s": self.mttr_s,
+             "restored_step": self.restored_step,
+             "resharded_bytes": self.resharded_bytes,
+             "world_before": self.world_before,
+             "world_after": self.world_after,
+             "detail": self.detail}
+        d["detection"] = self.detection.as_dict() \
+            if self.detection is not None else None
+        return d
+
+
+class RecoveryReport:
+    """Aggregate the engine publishes via ``get_recovery_report()``."""
+
+    def __init__(self):
+        self.detections: List[Detection] = []
+        self.records: List[RecoveryRecord] = []
+
+    def note_detection(self, detection: Detection):
+        self.detections.append(detection)
+        return detection
+
+    def note_recovery(self, record: RecoveryRecord):
+        self.records.append(record)
+        return record
+
+    @property
+    def rung_counts(self):
+        counts = {r: 0 for r in LADDER}
+        for rec in self.records:
+            counts[rec.rung] += 1
+        return counts
+
+    def as_dict(self):
+        mttrs = [r.mttr_s for r in self.records]
+        return {
+            "detections": [d.as_dict() for d in self.detections],
+            "ladder": [r.as_dict() for r in self.records],
+            "rung_counts": self.rung_counts,
+            "mttr_s": {
+                "last": mttrs[-1] if mttrs else 0.0,
+                "mean": sum(mttrs) / len(mttrs) if mttrs else 0.0,
+                "max": max(mttrs) if mttrs else 0.0,
+            },
+            "resharded_bytes": sum(r.resharded_bytes
+                                   for r in self.records),
+        }

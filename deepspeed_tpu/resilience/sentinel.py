@@ -102,3 +102,23 @@ class TrainSentinel:
     @property
     def budget_exhausted(self) -> bool:
         return self.rollbacks >= self.max_rollbacks
+
+    # ---- checkpoint surface (deterministic resume) ----
+    # The sentinel's statistics ride the checkpoint manifest: a
+    # recovered run must replay the SAME skip/rollback decisions the
+    # original would have made (the chaos harness's bitwise-identity
+    # invariant), and the rollback budget must survive the restore —
+    # otherwise a deterministically-diverging run resets its budget
+    # every rollback and loops forever instead of escalating.
+    def state_dict(self) -> dict:
+        return {"ema": self.ema,
+                "healthy_steps": self.healthy_steps,
+                "consecutive_failures": self.consecutive_failures,
+                "rollbacks": self.rollbacks}
+
+    def load_state_dict(self, sd: dict):
+        self.ema = sd.get("ema")
+        self.healthy_steps = int(sd.get("healthy_steps", 0))
+        self.consecutive_failures = int(
+            sd.get("consecutive_failures", 0))
+        self.rollbacks = int(sd.get("rollbacks", 0))

@@ -88,5 +88,90 @@ class CollectiveWatchdog:
         return val
 
 
+class HeartbeatMonitor:
+    """Per-participant liveness ledger — the job-level half of the
+    failure detector (the ``CollectiveWatchdog`` above bounds one
+    *call*; this bounds each *worker*'s silence across steps).
+
+    Sources (the pg_sim fault domain in tests, a real heartbeat
+    transport in production) call ``beat(rank, step)`` whenever worker
+    ``rank`` proves liveness, with ``progressed=False`` when it is
+    alive but not advancing (the *slow* failure mode: heartbeats
+    arrive, progress doesn't). ``check(step)`` returns the workers in
+    violation of either deadline:
+
+    * no heartbeat for > ``heartbeat_timeout_steps`` supervised steps
+      -> mode ``"hang"`` (dead and hung workers look identical from
+      the outside — silence);
+    * heartbeats fresh but no *progress* for >
+      ``progress_timeout_steps`` steps -> mode ``"slow"``.
+
+    Deadlines are in supervised steps (logical time) so drills replay
+    deterministically on CI; ``wall_timeout_seconds`` adds an optional
+    real-clock bound on top for live deployments where a wedged
+    supervisor loop must still detect silence."""
+
+    def __init__(self, world_size: int,
+                 heartbeat_timeout_steps: int = 1,
+                 progress_timeout_steps: int = 3,
+                 wall_timeout_seconds: Optional[float] = None):
+        if world_size < 1:
+            raise ValueError("world_size must be >= 1")
+        self.world_size = int(world_size)
+        self.heartbeat_timeout_steps = int(heartbeat_timeout_steps)
+        self.progress_timeout_steps = int(progress_timeout_steps)
+        self.wall_timeout_seconds = wall_timeout_seconds
+        import time as _time
+        self._clock = _time.monotonic
+        now = self._clock()
+        self.last_beat_step = {r: -1 for r in range(self.world_size)}
+        self.last_progress_step = {r: -1
+                                   for r in range(self.world_size)}
+        self.last_beat_wall = {r: now for r in range(self.world_size)}
+        self._retired = set()
+
+    def beat(self, rank: int, step: int, progressed: bool = True):
+        if rank in self._retired:
+            return
+        self.last_beat_step[rank] = int(step)
+        self.last_beat_wall[rank] = self._clock()
+        if progressed:
+            self.last_progress_step[rank] = int(step)
+
+    def retire(self, rank: int):
+        """Stop watching ``rank`` (worker shrunk away for good)."""
+        self._retired.add(rank)
+
+    def restore(self, rank: int, step: int):
+        """Re-admit a respawned worker with a fresh ledger entry."""
+        self._retired.discard(rank)
+        self.beat(rank, step, progressed=True)
+
+    def check(self, step: int):
+        """[(rank, mode, reason)] for every worker past a deadline."""
+        out = []
+        now = self._clock()
+        for r in range(self.world_size):
+            if r in self._retired:
+                continue
+            silent_steps = step - self.last_beat_step[r]
+            silent_wall = now - self.last_beat_wall[r]
+            if silent_steps > self.heartbeat_timeout_steps or (
+                    self.wall_timeout_seconds
+                    and silent_wall > self.wall_timeout_seconds):
+                out.append((r, "hang",
+                            f"no heartbeat for {silent_steps} step(s) "
+                            f"/ {silent_wall:.2f}s (deadline "
+                            f"{self.heartbeat_timeout_steps} steps)"))
+                continue
+            stalled = step - self.last_progress_step[r]
+            if stalled > self.progress_timeout_steps:
+                out.append((r, "slow",
+                            f"no progress for {stalled} step(s) "
+                            f"(deadline "
+                            f"{self.progress_timeout_steps} steps)"))
+        return out
+
+
 # process-wide singleton; comm/comm.py dispatches through it
 collective_watchdog = CollectiveWatchdog()

@@ -212,6 +212,28 @@ class ResilienceConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class SupervisorConfig(DeepSpeedConfigModel):
+    """Elastic training supervisor knobs (elasticity/supervisor.py),
+    config section ``elasticity.supervisor`` (the planning fields of
+    the ``elasticity`` section itself keep reference parity and are
+    parsed by elasticity/config.py). See README "Elastic training"."""
+    # commit a checkpoint every N successful global steps — the
+    # rollback rung can only restore what was committed
+    save_interval: int = 1
+    # failure detector deadlines, in supervised steps (logical time,
+    # so CI drills replay deterministically)
+    heartbeat_timeout_steps: int = 1
+    progress_timeout_steps: int = 3
+    # retry-rung budget: idle ticks to wait out a transient stall
+    # before escalating to rollback
+    max_step_retries: int = 2
+    # refuse to shrink below this many workers (terminal instead)
+    min_workers: int = 1
+    # transfer-engine bucket size for shrink-and-reshard bulk moves
+    reshard_bucket_mb: float = 64.0
+
+
+@dataclasses.dataclass
 class PipelineConfig(DeepSpeedConfigModel):
     """Pipeline engine knobs (reference: pipe engine config usage)."""
     stages: str = "auto"
@@ -277,6 +299,8 @@ class DeepSpeedConfig:
             d.get("resilience", {}))
         self.lifecycle_config = LifecycleConfig.from_dict(
             d.get("lifecycle", {}))
+        self.supervisor_config = SupervisorConfig.from_dict(
+            d.get("elasticity", {}).get("supervisor", {}))
         # curriculum learning: legacy top-level section or nested under
         # data_efficiency.data_sampling (reference: data_pipeline/config.py)
         self.curriculum_config = d.get("curriculum_learning", None)

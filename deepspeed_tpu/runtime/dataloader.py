@@ -3,7 +3,16 @@ DeepSpeedDataLoader + RepeatingLoader).
 
 TPU-native: batches are numpy arrays assembled on host then device_put
 with the batch sharding (data+fsdp axes), so each chip receives only its
-slice (the analog of per-rank DistributedSampler sharding)."""
+slice (the analog of per-rank DistributedSampler sharding).
+
+Deterministic resume: the loader tracks a ``(epoch, batch)`` cursor as
+it yields, exposed via ``state_dict``/``load_state_dict`` and carried
+in the engine's checkpoint client_state — a recovered run replays the
+EXACT sample stream from where the checkpoint was cut instead of
+restarting the epoch at batch 0 (the chaos harness's replay-identity
+invariant depends on this; tests/unit/runtime/test_dataloader_resume.py).
+The cursor assumes ONE active iterator per loader (the engine's usage;
+a second concurrent iterator would interleave cursor updates)."""
 
 import numpy as np
 
@@ -14,7 +23,10 @@ from ..resilience.retry import retry_io
 
 class RepeatingLoader:
     """Wraps an iterator to restart on StopIteration
-    (reference: dataloader.py RepeatingLoader)."""
+    (reference: dataloader.py RepeatingLoader). When the wrapped
+    loader exposes ``set_epoch`` (DeepSpeedDataLoader does), each
+    wrap-around advances the epoch so shuffled order differs per epoch
+    and the (epoch, batch) cursor stays well-defined across epochs."""
 
     def __init__(self, loader):
         self.loader = loader
@@ -30,9 +42,25 @@ class RepeatingLoader:
         try:
             batch = next(self.data_iter)
         except StopIteration:
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(
+                    getattr(self.loader, "epoch", 0) + 1)
             self.data_iter = iter(self.loader)
             batch = next(self.data_iter)
         return batch
+
+    # cursor passthrough: the wrapper adds no position state of its
+    # own (the wrapped loader's (epoch, batch) cursor is the whole
+    # truth), so checkpoint code can treat both shapes uniformly
+    def state_dict(self):
+        if hasattr(self.loader, "state_dict"):
+            return self.loader.state_dict()
+        return {}
+
+    def load_state_dict(self, sd):
+        if hasattr(self.loader, "load_state_dict"):
+            self.loader.load_state_dict(sd)
+            self.data_iter = iter(self.loader)
 
 
 class DeepSpeedDataLoader:
@@ -63,14 +91,34 @@ class DeepSpeedDataLoader:
         # engine.set_data_post_process_func, engine.py:452)
         self.post_process_func = None
         self.epoch = 0
+        # batches already yielded in the CURRENT epoch — i.e. the
+        # index of the next batch to fetch; advanced before each
+        # yield so a checkpoint cut mid-iteration records the batch
+        # the consumer already trained on as consumed
+        self.batch_cursor = 0
+        self._resume_cursor = 0
         self.len = len(dataset) // batch_size if drop_last else \
             -(-len(dataset) // batch_size)
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+        self.batch_cursor = 0
 
     def __len__(self):
         return self.len
+
+    # ---- (epoch, batch) cursor: checkpointed sample-stream position ----
+    def state_dict(self):
+        return {"epoch": self.epoch, "batch_cursor": self.batch_cursor}
+
+    def load_state_dict(self, sd):
+        """Position the NEXT iteration at the saved cursor. Index
+        order is a pure function of (seed, epoch), so restoring the
+        cursor replays the exact remaining sample stream — no RNG
+        state beyond the constructor seed needs persisting."""
+        self.epoch = int(sd.get("epoch", 0))
+        self._resume_cursor = int(sd.get("batch_cursor", 0))
+        self.batch_cursor = self._resume_cursor
 
     def __iter__(self):
         n = len(self.dataset)
@@ -81,7 +129,10 @@ class DeepSpeedDataLoader:
             indices = rng.permutation(n).tolist()
         else:
             indices = list(range(n))
-        for start in range(0, n - (self.batch_size - 1 if self.drop_last else 0),
+        start_batch, self._resume_cursor = self._resume_cursor, 0
+        self.batch_cursor = start_batch
+        for start in range(start_batch * self.batch_size,
+                           n - (self.batch_size - 1 if self.drop_last else 0),
                            self.batch_size):
             chunk = indices[start:start + self.batch_size]
             if not chunk:
@@ -104,6 +155,7 @@ class DeepSpeedDataLoader:
                     if hasattr(self.data_sampler, "state_dict") else \
                     {"epoch": self.epoch}
                 batch = self.post_process_func(batch, sampler_state)
+            self.batch_cursor += 1
             yield batch
 
 

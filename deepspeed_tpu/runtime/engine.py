@@ -363,6 +363,10 @@ class DeepSpeedEngine:
         # fp32 leaf per offloaded param); () until the step compiles
         self._offload_grad_residual = ()
         self._pending_grad_residual = None  # checkpoint staging
+        # recovery bookkeeping (resilience/recovery.py): sentinel
+        # rollbacks and the elastic supervisor's ladder actions land
+        # here; published via get_recovery_report()
+        self._recovery = None
 
         log_dist(
             f"DeepSpeedEngine: zero_stage={self.zero_stage} dtype={self.compute_dtype.__name__} "
@@ -1003,6 +1007,27 @@ class DeepSpeedEngine:
         # arrays) — too heavy for a pollable report surface. Deep
         # probes (soak harness, bench) call lifecycle.memory_gauges()
         # directly for the full census.
+        out["process_memory"] = memory_gauges(include_arrays=False)
+        return out
+
+    def recovery(self):
+        """The engine's RecoveryReport (created on first use) — the
+        sentinel's rollbacks and the elastic supervisor's ladder
+        actions both write here."""
+        if self._recovery is None:
+            from ..resilience.recovery import RecoveryReport
+            self._recovery = RecoveryReport()
+        return self._recovery
+
+    def get_recovery_report(self):
+        """Failure-recovery report: every detection, the ladder rung
+        that resolved it (retry / rollback / shrink / terminal),
+        per-incident MTTR (detection -> engine trainable again), and
+        total resharded bytes — published alongside the PR-6
+        process-lifetime memory gauges like the schedule/serving
+        reports (README "Elastic training" documents the schema)."""
+        from .lifecycle import memory_gauges
+        out = self.recovery().as_dict()
         out["process_memory"] = memory_gauges(include_arrays=False)
         return out
 
@@ -1922,7 +1947,14 @@ class DeepSpeedEngine:
         elastic agent (fresh process, possibly fresh topology) can
         help."""
         from ..resilience.errors import TrainingDivergenceError
+        from ..resilience.recovery import (Detection, RecoveryRecord,
+                                           ROLLBACK)
         s = self._sentinel
+        bad_step = self.global_steps
+        det = self.recovery().note_detection(Detection(
+            bad_step, -1, "sentinel",
+            f"sentinel budget exhausted "
+            f"({s.consecutive_failures} consecutive bad steps)"))
         if s.budget_exhausted:
             raise TrainingDivergenceError(
                 f"training diverged: {s.rollbacks} rollback(s) did not "
@@ -1935,6 +1967,13 @@ class DeepSpeedEngine:
                 "save checkpoints periodically or set "
                 "resilience.sentinel.ckpt_dir")
         s.note_rollback()
+        self.recovery().note_recovery(RecoveryRecord(
+            ROLLBACK, det, mttr_s=time.monotonic() - det.t_detect,
+            restored_step=self.global_steps,
+            world_before=self.dp_world_size,
+            world_after=self.dp_world_size,
+            detail=f"sentinel auto-rollback #{s.rollbacks} from "
+                   f"step {bad_step}"))
         log_dist(f"sentinel auto-rollback #{s.rollbacks}: restored "
                  f"step {self.global_steps} from {s.ckpt_dir}",
                  ranks=[0])
@@ -2349,6 +2388,17 @@ class DeepSpeedEngine:
             "skipped_steps": int(self.state.skipped_steps),
             "lr_scheduler": self.lr_scheduler.state_dict()
             if self.lr_scheduler else None,
+            # ---- deterministic-resume state: a recovered run must
+            # replay the EXACT sample stream and RNG draws of the run
+            # it resumes (the chaos harness's bitwise-identity
+            # invariant). The host PRNG needs no entry: dataloader
+            # shuffles are pure functions of (seed, epoch).
+            "rng_key": np.asarray(self._rng).tolist(),
+            "dataloader": self.training_dataloader.state_dict()
+            if hasattr(self.training_dataloader, "state_dict")
+            else None,
+            "sentinel": self._sentinel.state_dict()
+            if self._sentinel is not None else None,
         })
         if self._moq is not None:
             # MoQ schedule state — without it a resume would restart at
@@ -2514,19 +2564,55 @@ class DeepSpeedEngine:
             # repair violations (offload.verify_and_repair)
             self._offload_verify_steps = \
                 self._config.lifecycle_config.verify_steps_after_restore
-        if client_state:
-            self.global_steps = client_state.get("global_steps", 0)
-            self.global_samples = client_state.get("global_samples", 0)
-            self.micro_steps = client_state.get("micro_steps", 0)
-            if load_lr_scheduler_states and self.lr_scheduler is not None \
-                    and client_state.get("lr_scheduler"):
-                self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
-            if self._moq is not None and client_state.get("moq"):
-                for g, saved in zip(self._moq.groups, client_state["moq"]):
-                    g["bits"] = int(saved["bits"])
-                    g["period"] = int(saved["period"])
-                    g["next_drop"] = saved["next_drop"]
+        self._apply_client_state(
+            client_state,
+            load_lr_scheduler_states=load_lr_scheduler_states)
         return load_dir, client_state
+
+    def _apply_client_state(self, client_state,
+                            load_lr_scheduler_states=True):
+        """Restore the host-side bookkeeping a checkpoint carries
+        beside the state tree: step counters, LR schedule, MoQ
+        schedule, and the deterministic-resume trio (device PRNG key,
+        dataloader cursor, sentinel statistics). Shared by
+        ``load_checkpoint`` and the supervisor's shrink-and-reshard
+        path (elasticity/supervisor.py), which restores through the
+        raw manifest instead of the template loader."""
+        if not client_state:
+            return
+        self.global_steps = client_state.get("global_steps", 0)
+        self.global_samples = client_state.get("global_samples", 0)
+        self.micro_steps = client_state.get("micro_steps", 0)
+        if load_lr_scheduler_states and self.lr_scheduler is not None \
+                and client_state.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+        if self._moq is not None and client_state.get("moq"):
+            for g, saved in zip(self._moq.groups, client_state["moq"]):
+                g["bits"] = int(saved["bits"])
+                g["period"] = int(saved["period"])
+                g["next_drop"] = saved["next_drop"]
+        # ---- deterministic resume (see save_checkpoint) ----
+        if client_state.get("rng_key") is not None:
+            self._rng = jnp.asarray(
+                np.asarray(client_state["rng_key"], dtype=np.uint32))
+        if client_state.get("dataloader") is not None and \
+                hasattr(self.training_dataloader, "load_state_dict"):
+            self.training_dataloader.load_state_dict(
+                client_state["dataloader"])
+            # reposition the live iterator at the restored cursor
+            self.data_iterator = iter(
+                RepeatingLoader(self.training_dataloader))
+        if client_state.get("sentinel") is not None and \
+                self._sentinel is not None:
+            saved = dict(client_state["sentinel"])
+            # the rollback budget is monotonic WITHIN a process: a
+            # sentinel-initiated restore must not reset its own count
+            # by reloading a pre-rollback checkpoint (it would loop
+            # instead of escalating); a fresh process starts from the
+            # checkpointed count
+            saved["rollbacks"] = max(int(saved.get("rollbacks", 0)),
+                                     self._sentinel.rollbacks)
+            self._sentinel.load_state_dict(saved)
 
     def close(self):
         """Deterministically release this engine's process-lifetime

@@ -1,0 +1,16 @@
+"""pg_sim — single-process simulated fault domain for elastic
+training (reference: deepspeed/tools/pg_sim/pg.py, which monkey-patches
+a fake torch process group so multi-rank logic runs in one process).
+
+TPU-native reading: the process group is the device mesh, so the
+simulator presents N *virtual workers*, each owning a contiguous slice
+of the local (XLA-CPU-multiplexed) device mesh, with per-worker
+failure modes — kill / hang / slow / corrupt — driven through the
+``resilience.fault_injector`` spec grammar. The elastic supervisor's
+whole detection + recovery ladder is therefore testable on CI where
+real multiprocess is impossible (the PR-1 version-gated skips).
+"""
+
+from .pg import (CORRUPT, DEAD, HANG, HEALTHY, HUNG, KILL,  # noqa: F401
+                 SLOW, SimProcessGroup, SimWorker, install_domain,
+                 installed_domain, uninstall_domain)

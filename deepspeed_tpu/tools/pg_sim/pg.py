@@ -1,0 +1,332 @@
+"""Simulated multi-worker fault domain over the local device mesh.
+
+The reference fork ships a single-process process-group simulator
+(deepspeed/tools/pg_sim/pg.py) precisely so fault-tolerance logic can
+be exercised without a real multi-host job. This is its TPU-native
+analog: one process, N *virtual workers*, each a contiguous slice of
+the local device list (tests multiplex 8 XLA-CPU devices), with
+controllable per-worker failure modes driven through the
+``resilience.fault_injector`` grammar — so every drill is
+deterministic and replayable from a spec string.
+
+Control-plane simulation: compute still runs on the full local mesh
+(XLA cannot lose a device mid-program); what the simulator models is
+the *observable* failure surface the supervisor reacts to — missed
+heartbeats, stalled progress, poisoned contributions, lost device
+capacity — which is exactly the information a real failure detector
+has before any recovery decision.
+
+Failure modes (spec kinds at site ``pg_sim.step``):
+
+    kill      worker dies permanently: never heartbeats again, its
+              devices leave the survivor set (shrink candidates)
+    hang      worker goes silent for ``~arg`` steps (default forever):
+              no heartbeat, no progress — indistinguishable from a
+              kill until/unless it clears
+    slow      worker keeps heartbeating but makes no progress for
+              ``~arg`` steps (default forever) — straggler mode
+    corrupt   worker's contribution is poisoned for ``~arg`` steps
+              (default 1): ``poisoned_ranks()`` reports it and the
+              supervisor NaNs that worker's shard (detectable by the
+              train sentinel)
+
+Spec ordinal convention: ``begin_step`` consumes the ``pg_sim.step``
+site once per WORKER SLOT (dead or alive) in rank order, so the
+ordinal of (step, rank) is always ``step * world_size + rank`` —
+``SimProcessGroup.spec_for(rank, step, mode)`` builds a spec that hits
+exactly one worker at one step, and a chaos harness can place faults
+anywhere deterministically.
+"""
+
+from typing import List, Optional, Sequence
+
+from ...resilience.fault_injector import fault_injector
+from ...utils.logging import logger
+
+KILL, HANG, SLOW, CORRUPT = "kill", "hang", "slow", "corrupt"
+# fire()-grammar kinds that degrade into sim modes when they land on
+# the pg_sim site: a generic "error"/"ioerror" spec behaves like a
+# one-step hang (the worker misses that step's heartbeat)
+_SIM_MODES = (KILL, HANG, SLOW, CORRUPT)
+
+HEALTHY, DEAD, HUNG = "healthy", "dead", "hung"
+# a DEAD worker the supervisor shrank away: still occupies its rank
+# slot (spec ordinals stay step-addressed) but is no longer a
+# participant — gates and liveness queries skip it
+REMOVED = "removed"
+
+_FOREVER = float("inf")
+
+
+class SimWorker:
+    """One virtual worker: a rank, its device slice, and its health."""
+
+    def __init__(self, rank: int, devices: Sequence):
+        self.rank = int(rank)
+        self.devices = tuple(devices)
+        self.state = HEALTHY
+        self.progress = -1         # last step this worker completed
+        self.last_heartbeat = -1   # last step this worker heartbeat
+        # mode countdowns, in steps (inf = until respawn/forever)
+        self.hang_left = 0.0
+        self.slow_left = 0.0
+        self.corrupt_left = 0.0
+        self.respawns = 0
+
+    @property
+    def alive(self) -> bool:
+        return self.state not in (DEAD, REMOVED)
+
+    @property
+    def healthy(self) -> bool:
+        return (self.state == HEALTHY and self.slow_left <= 0
+                and self.corrupt_left <= 0)
+
+    def __repr__(self):
+        return (f"SimWorker(rank={self.rank}, state={self.state}, "
+                f"progress={self.progress}, hb={self.last_heartbeat})")
+
+
+class SimProcessGroup:
+    """N virtual workers over a device list, with fault-injected
+    failure modes and heartbeat/progress accounting.
+
+    The supervisor drives it in lockstep with the training loop::
+
+        domain.begin_step(step)     # faults for this step apply
+        ... dispatch the train step ...
+        domain.complete_step(step)  # live workers heartbeat/progress
+
+    and reads ``check()``-style state (via worker fields), survivor
+    devices for shrink planning, and ``poisoned_ranks()`` for the
+    corrupt mode. ``respawn(rank)`` models the elastic agent bringing
+    a worker process back (same devices) — the rollback rung re-admits
+    respawnable workers; a non-respawnable domain forces the shrink
+    rung instead."""
+
+    def __init__(self, world_size: int, devices: Optional[Sequence] = None,
+                 injector=None, respawnable: bool = True):
+        if devices is None:
+            import jax
+            devices = jax.devices()
+        if world_size < 1 or world_size > len(devices):
+            raise ValueError(
+                f"world_size {world_size} must be in [1, "
+                f"{len(devices)}] (local devices)")
+        if len(devices) % world_size:
+            raise ValueError(
+                f"{len(devices)} devices not divisible into "
+                f"{world_size} equal worker slices")
+        per = len(devices) // world_size
+        self.world_size = int(world_size)
+        self.workers: List[SimWorker] = [
+            SimWorker(r, devices[r * per:(r + 1) * per])
+            for r in range(world_size)]
+        self.injector = injector or fault_injector
+        self.respawnable = bool(respawnable)
+        self.step = -1
+        self.events: List[dict] = []   # audit: applied faults
+
+    # ---- spec helpers -------------------------------------------------
+    def spec_for(self, rank: int, step: int, mode: str,
+                 duration: Optional[float] = None) -> str:
+        """Grammar string hitting exactly (rank, step) with ``mode``."""
+        if mode not in _SIM_MODES:
+            raise ValueError(f"unknown sim mode {mode!r}; expected "
+                             f"one of {_SIM_MODES}")
+        after = step * self.world_size + rank
+        spec = f"pg_sim.step:{mode}@{after}"
+        if duration is not None:
+            spec += f"~{duration:g}"
+        return spec
+
+    # ---- step lifecycle ----------------------------------------------
+    def _apply(self, w: SimWorker, kind: str, arg: float,
+               arg_given: bool, step: int):
+        if kind == KILL:
+            w.state = DEAD
+            w.hang_left = w.slow_left = w.corrupt_left = 0.0
+        elif kind == HANG:
+            w.state = HUNG
+            w.hang_left = arg if arg_given else _FOREVER
+        elif kind == SLOW:
+            w.slow_left = arg if arg_given else _FOREVER
+        elif kind == CORRUPT:
+            w.corrupt_left = arg if arg_given else 1.0
+        else:
+            # classic fire() kinds degrade to a one-step stall
+            w.state = HUNG
+            w.hang_left = 1.0
+        self.events.append({"step": step, "rank": w.rank,
+                            "mode": kind, "arg": arg})
+        logger.warning(
+            f"pg_sim: worker {w.rank} -> {kind} at step {step}"
+            + (f" (for {arg:g} step(s))"
+               if arg_given or kind == CORRUPT else ""))
+
+    def begin_step(self, step: int):
+        """Consume this step's fault specs (one ordinal per worker
+        slot, dead or alive, in rank order). Call BEFORE dispatching
+        the training step. NOTE: only this method consumes
+        ``pg_sim.step`` ordinals — recovery waits (``idle_tick``) and
+        post-rollback replays of earlier step NUMBERS consume fresh
+        ordinals on their next ``begin_step``, so a ``spec_for``
+        placement targets the FIRST execution of (step, rank)."""
+        self.step = int(step)
+        for w in self.workers:
+            spec = self.injector.consume(
+                "pg_sim.step", detail=f"w{w.rank}@s{step}")
+            if spec is not None and w.alive:
+                self._apply(w, spec.kind, spec.arg, spec.arg_given,
+                            step)
+
+    def _tick(self):
+        """Advance mode countdowns by one tick of logical time:
+        transient hangs drain toward recovery."""
+        for w in self.workers:
+            if w.state == HUNG:
+                w.hang_left -= 1
+                if w.hang_left <= 0:
+                    w.state = HEALTHY
+
+    def complete_step(self, step: int):
+        """Post-step accounting: live, non-hung workers heartbeat;
+        non-slow workers also progress. Call AFTER the step ran."""
+        for w in self.workers:
+            if not w.alive or w.state == HUNG:
+                continue
+            w.last_heartbeat = step
+            if w.slow_left > 0:
+                w.slow_left -= 1
+            else:
+                w.progress = step
+            if w.corrupt_left > 0:
+                w.corrupt_left -= 1
+        self._tick()
+
+    def idle_tick(self, step: Optional[int] = None):
+        """One tick of logical time with NO training step — the
+        supervisor waiting out a transient stall (the retry rung).
+        Live, non-hung workers still heartbeat (they are idling, not
+        silent); countdowns advance; injector ordinals are NOT
+        consumed, so fault placement stays step-addressed."""
+        s = self.step if step is None else int(step)
+        for w in self.workers:
+            if w.alive and w.state != HUNG:
+                w.last_heartbeat = s
+                if w.slow_left > 0:
+                    # a straggler catches up while the job waits
+                    w.slow_left -= 1
+        self._tick()
+
+    # ---- queries ------------------------------------------------------
+    def worker(self, rank: int) -> SimWorker:
+        return self.workers[rank]
+
+    def alive_workers(self) -> List[SimWorker]:
+        return [w for w in self.workers if w.alive]
+
+    def dead_ranks(self) -> List[int]:
+        """Dead-but-not-yet-shrunk workers (recovery still owes these
+        an action; REMOVED workers are already accounted for)."""
+        return [w.rank for w in self.workers if w.state == DEAD]
+
+    def hung_ranks(self) -> List[int]:
+        return [w.rank for w in self.workers if w.state == HUNG]
+
+    def poisoned_ranks(self) -> List[int]:
+        """Workers whose CURRENT step contribution is corrupt."""
+        return [w.rank for w in self.workers
+                if w.alive and w.state != HUNG and w.corrupt_left > 0]
+
+    def survivor_devices(self) -> list:
+        """Devices still owned by live workers (shrink candidates),
+        in rank order — the contiguous-slice layout means the result
+        is always a valid submesh of the original device list."""
+        out = []
+        for w in self.alive_workers():
+            out.extend(w.devices)
+        return out
+
+    # ---- recovery actions (the supervisor's levers) -------------------
+    def respawn(self, rank: int, step: Optional[int] = None) -> bool:
+        """Re-admit a dead/hung worker on its original devices (the
+        elastic-agent restart analog). Returns False when the domain
+        models permanent loss (``respawnable=False``) and the worker
+        is dead — the supervisor must then shrink instead."""
+        w = self.workers[rank]
+        if w.state == REMOVED:
+            return False   # shrunk away for good
+        if w.state == DEAD and not self.respawnable:
+            return False
+        w.state = HEALTHY
+        w.hang_left = w.slow_left = w.corrupt_left = 0.0
+        w.respawns += 1
+        s = self.step if step is None else int(step)
+        w.last_heartbeat = s
+        w.progress = s
+        return True
+
+    def shrink(self) -> list:
+        """Drop dead workers permanently (state -> REMOVED: they keep
+        their rank slot for spec-ordinal stability but stop being
+        participants) and return the surviving devices; survivors keep
+        their ranks (rank compaction is the mesh rebuild's job, not
+        the domain's)."""
+        gone = self.dead_ranks()
+        if gone:
+            logger.warning(f"pg_sim: shrinking away dead workers "
+                           f"{gone}")
+        for r in gone:
+            self.workers[r].state = REMOVED
+        return self.survivor_devices()
+
+    def __repr__(self):
+        states = ",".join(f"{w.rank}:{w.state}" for w in self.workers)
+        return (f"SimProcessGroup(world={self.world_size}, "
+                f"step={self.step}, [{states}])")
+
+
+# ---- process-global installation (comm-layer integration) ------------
+# comm/comm.py's eager dispatch consults the installed domain: an
+# eager collective issued while any participant is hung/dead stalls
+# the barrier (fires the registered ``pg_sim.collective`` site, then
+# raises WorkerFailureError) — the simulated analog of a rendezvous
+# that never completes, so watchdog/recovery paths see collectives
+# fail the way a real mesh would.
+_installed: List[Optional[SimProcessGroup]] = [None]  # unbounded-ok: single slot, never grows
+
+
+def install_domain(domain: Optional[SimProcessGroup]):
+    _installed[0] = domain
+    from ...comm import comm as _comm
+    _comm.set_pre_dispatch_hook(
+        check_collective_health if domain is not None else None)
+
+
+def uninstall_domain():
+    install_domain(None)
+
+
+def installed_domain() -> Optional[SimProcessGroup]:
+    return _installed[0]
+
+
+def check_collective_health(op: str = "collective"):
+    """Raise WorkerFailureError when the installed domain (if any) has
+    a dead/hung participant — called from comm/comm.py's eager
+    dispatch seam."""
+    domain = _installed[0]
+    if domain is None:
+        return
+    fault_injector.fire("pg_sim.collective", op)
+    from ...resilience.errors import WorkerFailureError
+    for w in domain.workers:
+        if w.state == DEAD:
+            raise WorkerFailureError(w.rank, KILL, step=domain.step,
+                                     reason=f"eager collective {op!r} "
+                                            "over a dead participant")
+        if w.state == HUNG:
+            raise WorkerFailureError(w.rank, HANG, step=domain.step,
+                                     reason=f"eager collective {op!r} "
+                                            "over a hung participant")

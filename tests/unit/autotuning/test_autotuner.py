@@ -84,6 +84,7 @@ def test_memory_estimate_monotone():
         e(int(1e9), 0, 4096, 4096, 32, world=8)
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7)
 def test_launched_autotuner_runs_real_experiments(tmp_path):
     """LaunchedAutotuner (reference: runner.py:361 run_autotuning):
     each candidate runs the user's training script through the dstpu

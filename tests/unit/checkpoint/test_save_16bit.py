@@ -33,6 +33,7 @@ def _batch(rng):
     return {"input_ids": ids, "labels": ids.copy()}
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7)
 def test_zero3_gated_without_gather_flag(tmp_path, rng, eight_devices):
     engine = _engine({"stage": 3})
     engine.train_batch(batch=_batch(rng))
@@ -84,6 +85,7 @@ def test_saved_weights_match_stage0_math(tmp_path, rng, eight_devices):
                                    err_msg=name)
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7): the zero3 gather smoke stays
 def test_custom_filename_and_atomicity(tmp_path, rng, eight_devices):
     engine = _engine({"stage": 1})
     engine.train_batch(batch=_batch(rng))
@@ -100,6 +102,7 @@ def test_save_before_init_raises(tmp_path, eight_devices):
         engine.save_16bit_model(str(tmp_path))
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7)
 def test_exclude_frozen_rejected(tmp_path, rng, eight_devices):
     import pytest
     engine = _engine({"stage": 1})

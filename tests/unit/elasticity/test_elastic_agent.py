@@ -196,3 +196,102 @@ def test_plan_recomputed_on_shrink(tmp_path):
     # global batch, micro batch still from the allowed ladder
     assert int(plans[1]["batch"]) <= int(plans[0]["batch"])
     assert int(plans[1]["micro"]) in (2, 4)
+
+
+# ---- ISSUE-7 satellite: direct coverage of the worker-side resume
+# contract and the agent env plumbing ----
+
+def test_resume_latest_without_checkpoint_is_a_noop(tmp_path):
+    """No ``latest`` file -> False, and the engine is never touched
+    (a fresh run must not pay a load attempt)."""
+    from deepspeed_tpu.elasticity import resume_latest
+
+    class Boom:
+        def load_checkpoint(self, *a, **k):
+            raise AssertionError("must not be called")
+
+    assert resume_latest(Boom(), str(tmp_path)) is False
+    assert resume_latest(Boom(), str(tmp_path / "missing")) is False
+
+
+def test_resume_latest_env_dir_fallback(tmp_path, monkeypatch):
+    """ckpt_dir defaults to $DSTPU_ELASTIC_CKPT_DIR (the agent's
+    worker contract)."""
+    from deepspeed_tpu.elasticity import resume_latest
+    monkeypatch.setenv("DSTPU_ELASTIC_CKPT_DIR",
+                       str(tmp_path / "nope"))
+
+    class Boom:
+        def load_checkpoint(self, *a, **k):
+            raise AssertionError("must not be called")
+
+    assert resume_latest(Boom()) is False
+
+
+@pytest.mark.fault
+def test_resume_latest_stale_latest_recovers_previous_good(
+        tmp_path, eight_devices):
+    """``latest`` names a tag whose payload is gone (kill between the
+    tag write and a later cleanup, or a corrupted shard): resume must
+    fall back to the previous good tag, repoint ``latest``, and
+    return True — the agent's restarted worker keeps training instead
+    of crash-looping on the stale pointer."""
+    import shutil
+
+    import deepspeed_tpu
+    from deepspeed_tpu.elasticity import resume_latest
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.parallel.mesh import MeshConfig, mesh_manager
+
+    mesh_manager.reset()
+    mesh_manager.init(MeshConfig(data=-1))
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 0,
+    }
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                               config=config)
+    ids = np.random.default_rng(0).integers(
+        0, 256, size=(engine.train_batch_size(), 16), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path))          # global_step1
+    engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path))          # global_step2
+    # the newest tag's payload vanishes; ``latest`` still names it
+    shutil.rmtree(tmp_path / "global_step2")
+    assert (tmp_path / "latest").read_text() == "global_step2"
+
+    assert resume_latest(engine, str(tmp_path)) is True
+    assert engine.global_steps == 1
+    # and the pointer now names what actually loaded
+    assert (tmp_path / "latest").read_text() == "global_step1"
+
+
+def test_spawn_env_contract_without_elasticity(tmp_path):
+    """The agent always exports world/ckpt/restart-ordinal to the
+    worker; the batch plan only appears when the config has an
+    elasticity section."""
+    from deepspeed_tpu.elasticity import DSElasticAgent
+
+    script = tmp_path / "dump.py"
+    script.write_text(textwrap.dedent("""
+        import json, os, sys
+        keys = [k for k in os.environ if k.startswith("DSTPU_ELASTIC")]
+        with open(sys.argv[1], "w") as f:
+            json.dump({k: os.environ[k] for k in keys}, f)
+        sys.exit(0)
+    """))
+    out = tmp_path / "env.json"
+    agent = DSElasticAgent(str(script), [str(out)],
+                           ckpt_dir=str(tmp_path / "ck"),
+                           device_probe=lambda: 3)
+    assert agent.run() == 0
+    env = json.loads(out.read_text())
+    assert env["DSTPU_ELASTIC_WORLD"] == "3"
+    assert env["DSTPU_ELASTIC_RESTART"] == "0"
+    assert env["DSTPU_ELASTIC_CKPT_DIR"] == str(tmp_path / "ck")
+    assert "DSTPU_ELASTIC_BATCH" not in env

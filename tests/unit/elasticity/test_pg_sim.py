@@ -1,0 +1,206 @@
+"""pg_sim mechanics: virtual-worker partitioning, the fault-injected
+failure modes (kill/hang/slow/corrupt), heartbeat/progress accounting,
+respawn semantics, and the comm-layer health gate — all deterministic
+from spec strings (reference idea: deepspeed/tools/pg_sim/pg.py runs
+multi-rank logic in one process)."""
+
+import jax
+import pytest
+
+from deepspeed_tpu.resilience.errors import WorkerFailureError
+from deepspeed_tpu.resilience.fault_injector import fault_injector
+from deepspeed_tpu.tools.pg_sim import (SimProcessGroup, install_domain,
+                                        installed_domain,
+                                        uninstall_domain)
+from deepspeed_tpu.tools.pg_sim.pg import check_collective_health
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector_and_domain():
+    fault_injector.reset()
+    uninstall_domain()
+    yield
+    fault_injector.reset()
+    uninstall_domain()
+
+
+def _run_steps(domain, n, start=0):
+    for s in range(start, start + n):
+        domain.begin_step(s)
+        domain.complete_step(s)
+
+
+class TestPartitioning:
+
+    def test_contiguous_equal_slices(self, eight_devices):
+        d = SimProcessGroup(4, devices=eight_devices)
+        assert [len(w.devices) for w in d.workers] == [2, 2, 2, 2]
+        flat = [dev for w in d.workers for dev in w.devices]
+        assert flat == list(eight_devices)
+
+    def test_indivisible_rejected(self, eight_devices):
+        with pytest.raises(ValueError, match="not divisible"):
+            SimProcessGroup(3, devices=eight_devices)
+
+    def test_ordinal_addressing(self, eight_devices):
+        d = SimProcessGroup(4, devices=eight_devices)
+        assert d.spec_for(2, 3, "kill") == "pg_sim.step:kill@14"
+        assert d.spec_for(0, 0, "hang", duration=2) == \
+            "pg_sim.step:hang@0~2"
+        with pytest.raises(ValueError, match="unknown sim mode"):
+            d.spec_for(0, 0, "explode")
+
+
+class TestFailureModes:
+
+    def test_kill_is_permanent_and_loses_devices(self, eight_devices):
+        d = SimProcessGroup(4, devices=eight_devices)
+        fault_injector.configure(d.spec_for(1, 2, "kill"))
+        _run_steps(d, 5)
+        w = d.worker(1)
+        assert not w.alive
+        assert d.dead_ranks() == [1]
+        # dead at step 2: never heartbeat past step 1
+        assert w.last_heartbeat == 1
+        surv = d.survivor_devices()
+        assert len(surv) == 6
+        assert all(dev not in w.devices for dev in surv)
+        # ordinals consumed for dead slots too: placement stays
+        # step-addressed after the kill
+        assert fault_injector.call_count("pg_sim.step") == 5 * 4
+
+    def test_hang_clears_after_duration(self, eight_devices):
+        d = SimProcessGroup(2, devices=eight_devices)
+        fault_injector.configure(d.spec_for(0, 1, "hang", duration=2))
+        _run_steps(d, 2)           # steps 0,1: hang applied at 1
+        assert d.hung_ranks() == [0]
+        assert d.worker(0).last_heartbeat == 0   # missed step 1
+        _run_steps(d, 1, start=2)  # second hung step
+        _run_steps(d, 1, start=3)  # countdown expired -> healthy
+        assert d.hung_ranks() == []
+        assert d.worker(0).last_heartbeat == 3
+
+    def test_slow_heartbeats_without_progress(self, eight_devices):
+        d = SimProcessGroup(2, devices=eight_devices)
+        fault_injector.configure(d.spec_for(1, 0, "slow", duration=2))
+        _run_steps(d, 2)
+        w = d.worker(1)
+        assert w.alive and w.state == "healthy"
+        assert w.last_heartbeat == 1     # alive the whole time
+        assert w.progress == -1          # but no progress yet
+        _run_steps(d, 2, start=2)
+        assert d.worker(1).progress == 3  # caught up after 2 steps
+
+    def test_corrupt_window_defaults_to_one_step(self, eight_devices):
+        d = SimProcessGroup(2, devices=eight_devices)
+        fault_injector.configure(d.spec_for(0, 1, "corrupt"))
+        d.begin_step(0), d.complete_step(0)
+        assert d.poisoned_ranks() == []
+        d.begin_step(1)
+        assert d.poisoned_ranks() == [0]
+        d.complete_step(1)
+        d.begin_step(2)
+        assert d.poisoned_ranks() == []
+
+    def test_classic_error_kind_degrades_to_one_step_stall(
+            self, eight_devices):
+        d = SimProcessGroup(2, devices=eight_devices)
+        fault_injector.configure("pg_sim.step:error@2")  # w0 at step 1
+        d.begin_step(0), d.complete_step(0)
+        d.begin_step(1)
+        assert d.hung_ranks() == [0]   # stalls THIS step's dispatch
+        d.complete_step(1)
+        assert d.hung_ranks() == []    # and clears at its end
+
+
+class TestRecoveryLevers:
+
+    def test_respawn_restores_health_and_ledger(self, eight_devices):
+        d = SimProcessGroup(2, devices=eight_devices)
+        fault_injector.configure(d.spec_for(0, 1, "kill"))
+        _run_steps(d, 3)
+        assert not d.worker(0).alive
+        assert d.respawn(0) is True
+        w = d.worker(0)
+        assert w.alive and w.state == "healthy" and w.respawns == 1
+        assert w.last_heartbeat == d.step
+
+    def test_non_respawnable_forces_shrink(self, eight_devices):
+        d = SimProcessGroup(4, devices=eight_devices,
+                            respawnable=False)
+        fault_injector.configure(d.spec_for(3, 0, "kill"))
+        _run_steps(d, 1)
+        assert d.respawn(3) is False
+        surv = d.shrink()
+        assert len(surv) == 6
+        # shrunk-away worker keeps its rank slot (ordinal stability)
+        # but is no longer a participant owed a recovery action
+        assert d.dead_ranks() == []
+        assert d.worker(3).state == "removed"
+        assert len(d.alive_workers()) == 3
+
+    def test_respawn_clears_transient_modes_too(self, eight_devices):
+        d = SimProcessGroup(2, devices=eight_devices)
+        fault_injector.configure(
+            d.spec_for(1, 0, "hang"))  # hang forever (no ~arg)
+        _run_steps(d, 2)
+        assert d.hung_ranks() == [1]
+        assert d.respawn(1) is True
+        assert d.hung_ranks() == []
+
+    def test_idle_tick_drains_hang_without_consuming_ordinals(
+            self, eight_devices):
+        d = SimProcessGroup(2, devices=eight_devices)
+        fault_injector.configure(d.spec_for(0, 0, "hang", duration=1))
+        d.begin_step(0)
+        assert d.hung_ranks() == [0]
+        before = fault_injector.call_count("pg_sim.step")
+        d.idle_tick()
+        assert d.hung_ranks() == []
+        assert fault_injector.call_count("pg_sim.step") == before
+
+
+class TestCollectiveGate:
+
+    def test_install_uninstall(self, eight_devices):
+        d = SimProcessGroup(2, devices=eight_devices)
+        install_domain(d)
+        assert installed_domain() is d
+        uninstall_domain()
+        assert installed_domain() is None
+
+    def test_gate_raises_typed_on_dead_participant(self,
+                                                   eight_devices):
+        d = SimProcessGroup(2, devices=eight_devices)
+        fault_injector.configure(d.spec_for(1, 0, "kill"))
+        d.begin_step(0)
+        fault_injector.reset()
+        install_domain(d)
+        with pytest.raises(WorkerFailureError) as ei:
+            check_collective_health("barrier")
+        assert ei.value.rank == 1 and ei.value.mode == "kill"
+
+    def test_eager_collective_goes_through_the_gate(self,
+                                                    eight_devices):
+        """comm/comm.py's eager dispatch consults the installed
+        domain: a hung participant turns an eager all-reduce into a
+        typed WorkerFailureError instead of a silent success."""
+        import jax.numpy as jnp
+
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.parallel.mesh import (MeshConfig,
+                                                 mesh_manager)
+        mesh_manager.init(MeshConfig(data=-1),
+                          devices=eight_devices)
+        d = SimProcessGroup(2, devices=eight_devices)
+        x = jnp.ones((8,))
+        install_domain(d)
+        # healthy: passes through
+        dist.all_reduce(x, group="data")
+        fault_injector.configure(d.spec_for(0, 0, "hang"))
+        d.begin_step(0)
+        fault_injector.reset()
+        with pytest.raises(WorkerFailureError):
+            dist.all_reduce(x, group="data")
+        uninstall_domain()
+        dist.all_reduce(x, group="data")  # gate removed with domain

@@ -65,6 +65,7 @@ def test_gptneox_family():
     _check_family(model, _init(model), cfg)
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7): a dozen cheaper family tests stay
 def test_gptneox_sequential_residual():
     from deepspeed_tpu.models.gptneox import (GPTNeoXConfig,
                                               GPTNeoXForCausalLM)

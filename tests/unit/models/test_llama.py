@@ -105,6 +105,7 @@ class TestHFParity:
 
 class TestLlamaTraining:
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 7)
     def test_engine_loss_falls(self):
         import deepspeed_tpu
         cfg = LlamaConfig.tiny()

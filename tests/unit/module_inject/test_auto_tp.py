@@ -139,6 +139,7 @@ def test_never_annotated_model_tp_inference_parity(bloom, eight_devices):
         [qkv.sharding.spec]) or qkv.sharding.spec[1] == TENSOR_AXIS
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7): the degradation-path TP train stays
 def test_never_annotated_model_tp_training(bloom, eight_devices):
     """Same model trains on a dp2 x tp4 mesh via engine AutoTP."""
     model, _ = bloom

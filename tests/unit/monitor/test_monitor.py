@@ -61,6 +61,7 @@ def test_monitor_master_fans_out(tmp_path):
     assert files
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7): tb_writer/monitor unit tests stay
 def test_engine_writes_monitor_events(tmp_path):
     """Engine train_batch emits Train/Samples/* events through the
     configured monitor (reference: engine.py:2303-2333)."""

@@ -80,6 +80,7 @@ def _nan_poison(tree):
         if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7): the supervisor suite keeps rollback e2e in tier-1
 def test_engine_auto_rollback_restores_verified_checkpoint(
         rng, eight_devices, tmp_path):
     """End to end: train, checkpoint, poison the state to NaN; the

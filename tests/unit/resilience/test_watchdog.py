@@ -61,3 +61,61 @@ def test_eager_collective_hang_detected(eight_devices):
     # recovered: the next collective is clean
     out = dist.all_reduce(x, group="data")
     assert np.asarray(out).shape == (8,)
+
+
+class TestHeartbeatMonitor:
+    """Job-level liveness ledger (the supervisor's detector half):
+    per-worker heartbeat/progress deadlines in logical steps."""
+
+    def _mk(self, **kw):
+        from deepspeed_tpu.resilience.watchdog import HeartbeatMonitor
+        return HeartbeatMonitor(4, **kw)
+
+    def test_fresh_beats_are_clean(self):
+        m = self._mk(heartbeat_timeout_steps=0)
+        for s in range(3):
+            for r in range(4):
+                m.beat(r, s)
+            assert m.check(s) == []
+
+    def test_silence_past_deadline_is_hang(self):
+        m = self._mk(heartbeat_timeout_steps=1)
+        for r in range(4):
+            m.beat(r, 0)
+        for s in (1, 2):
+            for r in (0, 1, 3):   # worker 2 goes silent after step 0
+                m.beat(r, s)
+        bad = m.check(2)
+        assert [(r, mode) for r, mode, _ in bad] == [(2, "hang")]
+
+    def test_heartbeat_without_progress_is_slow(self):
+        m = self._mk(heartbeat_timeout_steps=0,
+                     progress_timeout_steps=1)
+        for s in range(3):
+            for r in range(4):
+                m.beat(r, s, progressed=(r != 1))
+        bad = m.check(2)
+        assert [(r, mode) for r, mode, _ in bad] == [(1, "slow")]
+
+    def test_retire_and_restore(self):
+        m = self._mk(heartbeat_timeout_steps=0)
+        for r in range(4):
+            m.beat(r, 0)
+        m.retire(3)
+        m.beat(3, 5)          # retired workers' beats are ignored
+        # everyone else is silent since step 0; the retired worker is
+        # no longer watched
+        assert sorted(r for r, _, _ in m.check(5)) == [0, 1, 2]
+        m.restore(0, 5)
+        assert sorted(r for r, _, _ in m.check(5)) == [1, 2]
+
+    def test_wall_deadline(self, monkeypatch):
+        m = self._mk(heartbeat_timeout_steps=100,
+                     wall_timeout_seconds=0.05)
+        for r in range(4):
+            m.beat(r, 0)
+        time.sleep(0.08)
+        m.beat(0, 0)          # one fresh wall beat
+        bad = m.check(0)
+        assert sorted(r for r, _, _ in bad) == [1, 2, 3]
+        assert all(mode == "hang" for _, mode, _ in bad)

@@ -189,6 +189,7 @@ def test_eigenvalue_power_iteration():
     assert abs(est - 3.0) < 1e-2
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7)
 def test_engine_pld_config_wiring():
     """PLD config section drives an engine-held scheduler stepped each
     global step (review finding: modules existed but were unreachable

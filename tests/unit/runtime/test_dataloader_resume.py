@@ -1,0 +1,139 @@
+"""Deterministic dataloader resume: the (epoch, batch) cursor + RNG
+contract.
+
+The PR-1..6 loader restarted every resumed run at batch 0 of epoch 0 —
+a recovered run silently re-trained on the head of the epoch and never
+saw its tail (the ISSUE-7 satellite bugfix). The cursor now rides the
+checkpoint client_state; these tests pin the replay-identity contract
+the chaos harness builds on: resume(cursor) continues the EXACT sample
+stream the original run would have produced.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                              RepeatingLoader)
+
+
+def _ds(n=64):
+    return [{"x": np.array([i], dtype=np.int32)} for i in range(n)]
+
+
+def _stream(loader, k):
+    """First k batches' x-columns from a fresh wrap of ``loader``."""
+    rl = RepeatingLoader(loader)
+    return [np.asarray(next(rl)["x"]).ravel().tolist()
+            for _ in range(k)]
+
+
+class TestCursor:
+
+    def test_mid_epoch_resume_replays_identically(self):
+        src = DeepSpeedDataLoader(_ds(), 8, shuffle=True, seed=3)
+        whole = _stream(src, 8)  # one full epoch
+        # consume 3 batches, checkpoint the cursor, resume elsewhere
+        orig = DeepSpeedDataLoader(_ds(), 8, shuffle=True, seed=3)
+        rl = RepeatingLoader(orig)
+        for _ in range(3):
+            next(rl)
+        sd = rl.state_dict()
+        assert sd == {"epoch": 0, "batch_cursor": 3}
+        fresh = DeepSpeedDataLoader(_ds(), 8, shuffle=True, seed=3)
+        frl = RepeatingLoader(fresh)
+        frl.load_state_dict(sd)
+        resumed = [np.asarray(next(frl)["x"]).ravel().tolist()
+                   for _ in range(5)]
+        assert resumed == whole[3:]   # the tail, not batch 0 again
+
+    def test_epoch_advances_on_wrap_and_reshuffles(self):
+        loader = DeepSpeedDataLoader(_ds(32), 8, shuffle=True, seed=0)
+        rl = RepeatingLoader(loader)
+        epoch0 = [np.asarray(next(rl)["x"]).ravel().tolist()
+                  for _ in range(4)]
+        epoch1 = [np.asarray(next(rl)["x"]).ravel().tolist()
+                  for _ in range(4)]
+        assert loader.epoch == 1
+        assert sorted(sum(epoch0, [])) == sorted(sum(epoch1, []))
+        assert epoch0 != epoch1   # per-epoch reshuffle
+        # cursor across the wrap: epoch 1, batch 4 consumed... next is 0
+        rl2 = RepeatingLoader(
+            DeepSpeedDataLoader(_ds(32), 8, shuffle=True, seed=0))
+        rl2.load_state_dict({"epoch": 1, "batch_cursor": 0})
+        replay = [np.asarray(next(rl2)["x"]).ravel().tolist()
+                  for _ in range(4)]
+        assert replay == epoch1
+
+    def test_cursor_counts_yielded_batches(self):
+        loader = DeepSpeedDataLoader(_ds(32), 8)
+        it = iter(loader)
+        assert loader.batch_cursor == 0
+        next(it)
+        assert loader.batch_cursor == 1
+        next(it)
+        assert loader.state_dict()["batch_cursor"] == 2
+
+    def test_unshuffled_resume(self):
+        loader = DeepSpeedDataLoader(_ds(32), 8, shuffle=False)
+        loader.load_state_dict({"epoch": 0, "batch_cursor": 2})
+        first = next(iter(loader))
+        assert first["x"].ravel().tolist() == list(range(16, 24))
+
+
+class TestEngineReplayIdentity:
+
+    # slow tier: post-restore train_batch sequences are where the
+    # known XLA-CPU full-suite flake strikes (README "Long-run
+    # durability"; observed once here mid-suite while the same test
+    # passes standalone). Tier-1 keeps the replay-identity class via
+    # the chaos smokes + supervisor kill test; the loader-level cursor
+    # tests above stay tier-1.
+    @pytest.mark.slow
+    @pytest.mark.fault
+    def test_checkpoint_resume_replays_the_sample_stream(
+            self, tmp_path, eight_devices):
+        """Engine-level: train THROUGH the dataloader, checkpoint
+        mid-epoch, keep training; a restored engine replays the
+        continuation BITWISE (cursor + device PRNG both ride the
+        checkpoint). Before the fix the restored run restarted at
+        batch 0 and the trajectories diverged."""
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt2 import (GPT2Config,
+                                               GPT2LMHeadModel)
+        from deepspeed_tpu.parallel.mesh import (MeshConfig,
+                                                 mesh_manager)
+        rng = np.random.default_rng(1)
+        data = [{"input_ids": row, "labels": row.copy()}
+                for row in rng.integers(
+                    0, 256, size=(96, 16)).astype(np.int32)]
+        config = {
+            "train_batch_size": 16,
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 0,
+        }
+
+        def build():
+            mesh_manager.reset()
+            mesh_manager.init(MeshConfig(data=-1))
+            model = GPT2LMHeadModel(GPT2Config.tiny())
+            eng, _, _, _ = deepspeed_tpu.initialize(
+                model=model, config=config, training_data=data)
+            return eng
+
+        eng = build()
+        for _ in range(3):
+            eng.train_batch()
+        eng.save_checkpoint(str(tmp_path))
+        cont = [float(eng.train_batch()) for _ in range(4)]
+
+        eng2 = build()
+        b0 = {"input_ids": np.stack([d["input_ids"] for d in data[:16]]),
+              "labels": np.stack([d["labels"] for d in data[:16]])}
+        eng2.init_params(b0)
+        eng2.load_checkpoint(str(tmp_path))
+        assert eng2.training_dataloader.state_dict() == \
+            {"epoch": 0, "batch_cursor": 3}
+        replay = [float(eng2.train_batch()) for _ in range(4)]
+        assert replay == cont

@@ -30,7 +30,11 @@ def _make_engine(config_overrides=None, **kw):
     return engine
 
 
-@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+@pytest.mark.parametrize("stage", [
+    0,
+    pytest.param(1, marks=pytest.mark.slow),  # tier-1 diet (ISSUE 7)
+    pytest.param(2, marks=pytest.mark.slow),  # tier-1 diet (ISSUE 7)
+    3])
 def test_train_loss_decreases(stage, rng, eight_devices):
     engine = _make_engine({"zero_optimization": {"stage": stage}})
     losses = []
@@ -42,6 +46,7 @@ def test_train_loss_decreases(stage, rng, eight_devices):
     assert engine.global_steps == 10
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7): stage-0 and stage-3 loss_decreases smokes stay
 def test_zero_stages_match_replicated(rng, eight_devices):
     """ZeRO sharding must not change the math: stage 0 vs stage 3 losses
     must track step-for-step (reference invariant:
@@ -100,6 +105,7 @@ def test_forward_backward_step_parity(rng, eight_devices):
     np.testing.assert_allclose(la, lb, rtol=1e-4)
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7): lr_schedules unit suite stays
 def test_lr_schedule_integration(rng, eight_devices):
     engine = _make_engine({"scheduler": {"type": "WarmupLR", "params": {
         "warmup_min_lr": 0.0, "warmup_max_lr": 1e-3, "warmup_num_steps": 100,

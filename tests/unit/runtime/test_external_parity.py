@@ -80,6 +80,7 @@ def test_gpt2_loss_curve_matches_torch(tiny_hf_gpt2):
     assert ours[-1] < ours[0]
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7): the short loss-curve parity stays
 def test_gpt2_long_horizon_bf16_zero3_tracks_torch(tiny_hf_gpt2):
     """The north-star recipe over a LONG horizon: 100 steps of bf16
     compute + sharded fp32 master under ZeRO-3 must stay inside the

@@ -70,6 +70,7 @@ class TestLora:
         eng.init_params({"input_ids": ids, "labels": ids.copy()})
         return eng, ids
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 7)
     def test_trains_adapters_only_and_rollouts_see_them(self):
         import jax
         eng, ids = self._make()

@@ -180,6 +180,7 @@ class TestEngineLifecycle:
         # and the evicted signature recompiles rather than erroring
         assert np.isfinite(float(engine.train_batch(batch=batch)))
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 7): restore-invalidation stays as the tier-1 abort-regression gate
     def test_post_restore_guard_repairs_poisoned_device_leaf(
             self, tmp_path):
         """Simulate the observed long-process failure deterministically:

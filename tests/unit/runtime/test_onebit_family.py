@@ -84,6 +84,7 @@ class TestOnebitLamb:
         # factors stay inside the reference clamp band
         assert all(0.5 <= f <= 4.0 for f in lf if f != 0.0)
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 7): onebit_adam keeps the wire-payload smoke
     def test_wire_payload_is_one_bit(self, eight_devices):
         import jax
         engine, _ = _train("OneBitLamb", steps=1,
@@ -136,6 +137,7 @@ class TestZeroOneAdam:
         assert int(st.var_interval) > 1
         assert int(st.local_interval) > 1
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 7)
     def test_interval_state_survives_checkpoint(self, eight_devices,
                                                 tmp_path):
         """var/local interval counters resume from a checkpoint — a
@@ -184,6 +186,7 @@ class TestOnebitAdamStage1:
                        params={"freeze_step": 4}, stage=1)
         np.testing.assert_allclose(s1, s0, rtol=2e-3)
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 7)
     def test_stage1_variance_is_sharded(self, eight_devices):
         """The variance leaves store [world, chunk] rows, sharded one
         per device over the batch axes."""

@@ -69,6 +69,7 @@ def _toy_reward(tokens: np.ndarray, target_token: int) -> np.ndarray:
 @pytest.mark.parametrize("tensor", [
     1, pytest.param(2, marks=pytest.mark.slow)],  # tier-1 diet
     ids=["tp1", "tp2"])
+@pytest.mark.slow  # tier-1 diet (ISSUE 7)
 def test_generate_score_update_loop(eight_devices, tensor):
     mesh_manager.reset()
     mesh_manager.init(MeshConfig(data=-1, tensor=tensor))

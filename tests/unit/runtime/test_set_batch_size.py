@@ -84,6 +84,7 @@ def test_set_train_batch_size_rebuilds_engine_loader(rng, eight_devices):
     assert engine.curriculum_scheduler.get_difficulty(99) == 8
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7): gas-change + reset smokes stay
 def test_set_train_micro_batch_size_keeps_gas(rng, eight_devices):
     engine = _engine()
     engine.train_batch(batch=_batch(rng, 16))

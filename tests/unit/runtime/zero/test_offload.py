@@ -50,6 +50,7 @@ def test_select_offload_mask_ratio():
     assert select_offload_mask(params, 0.0) == [False, False, False]
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7): the equivalence suite rides the slow tier; partial-ratio + wire smokes stay
 def test_offload_matches_device_training(eight_devices):
     _, ref_losses = _train(_config(offload=False))
     engine, off_losses = _train(_config(offload=True))

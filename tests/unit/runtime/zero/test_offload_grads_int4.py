@@ -106,6 +106,7 @@ def test_int4_grads_parity_with_bf16_wire(eight_devices):
     assert got[-1] < got[0]
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7): int4 mirror/byte-count smokes stay
 def test_wire_payload_is_packed_nibbles(eight_devices):
     """The device->host stream actually carries uint8 nibble pairs of
     ~half the int8 volume (plus one fp32 scale per 256-block)."""

@@ -361,6 +361,7 @@ class TestKnobAudit:
 @pytest.mark.perf
 class TestScheduleSmoke:
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 7): layer-scan bit-exact + options smokes stay
     def test_zero3_translator_ab_and_report(self, rng, eight_devices):
         """Compile a tiny ZeRO-3 step with and without the options
         translator: (a) bitwise-identical losses (the options steer

@@ -75,6 +75,7 @@ class TestZeroPlusPlus:
         for a, b in zip(base, qwz):
             assert abs(a - b) / abs(a) < 0.05, (base, qwz)
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 7): heaviest zeropp wire; cheaper qwz/qgz tests stay
     def test_qwz_qgz_compose(self, eight_devices):
         """qwZ (stage 3) is ignored-with-warning at stage 2 and qgZ at
         stage 3 — but each works in its regime; stage-2 run with both

@@ -87,6 +87,7 @@ class TestMultiSlice:
                           dcn_axes={"data": 2, "fsdp": 2})
         assert cfg3.dcn_factors() == {"data": 2, "fsdp": 2}
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 7): the cheap multi-slice layout tests stay
     def test_auto_quantized_gradients_on_dcn_fsdp(self, eight_devices):
         """zero_quantized_gradients="auto": the int8 grad exchange is
         selected exactly when the fsdp axis crosses the DCN."""

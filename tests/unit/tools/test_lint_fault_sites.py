@@ -1,0 +1,95 @@
+"""tools/lint_fault_sites.py: typo'd site strings at
+``fault_injector.fire``/``consume`` calls are flagged against the
+central registry, annotated non-literal sites pass, and the shipped
+package is clean under the lint."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "..",
+    "tools"))
+from lint_fault_sites import scan_file  # noqa: E402
+
+from deepspeed_tpu.resilience.fault_sites import (FAULT_SITES,
+                                                  KNOWN_SITES)
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "..", "..", "..")
+
+
+def _scan(tmp_path, src, registry=frozenset(FAULT_SITES)):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(src))
+    violations, used = scan_file(str(p), registry)
+    return violations, used
+
+
+def test_registered_literal_site_passes(tmp_path):
+    v, used = _scan(tmp_path, """
+        from deepspeed_tpu.resilience.fault_injector import \\
+            fault_injector
+
+        def save():
+            fault_injector.fire("checkpoint.save")
+            fault_injector.consume("pg_sim.step")
+    """)
+    assert v == []
+    assert used == {"checkpoint.save", "pg_sim.step"}
+
+
+def test_typoed_site_flagged(tmp_path):
+    """The exact failure class this lint exists for: the spec grammar
+    would accept 'checkpoint.svae' and the drill would silently never
+    fire."""
+    v, _ = _scan(tmp_path, """
+        from deepspeed_tpu.resilience.fault_injector import \\
+            fault_injector
+
+        def save():
+            fault_injector.fire("checkpoint.svae")
+    """)
+    assert len(v) == 1 and "checkpoint.svae" in v[0][2]
+
+
+def test_non_literal_site_needs_annotation(tmp_path):
+    v, _ = _scan(tmp_path, """
+        def drill(injector, site):
+            injector.fire(site)
+    """)
+    assert len(v) == 1 and "non-literal" in v[0][2]
+    v, _ = _scan(tmp_path, """
+        def drill(injector, site):
+            injector.fire(site)  # fault-site-ok: caller passes a registered site
+    """)
+    assert v == []
+
+
+def test_unrelated_fire_apis_ignored(tmp_path):
+    v, used = _scan(tmp_path, """
+        def shoot(missile):
+            missile.fire("at will")
+    """)
+    assert v == [] and used == set()
+
+
+def test_registry_and_docstring_agree():
+    """The injector module re-exports KNOWN_SITES from the registry —
+    one source of truth."""
+    from deepspeed_tpu.resilience.fault_injector import \
+        KNOWN_SITES as injector_sites
+    assert tuple(injector_sites) == tuple(KNOWN_SITES)
+    assert all(FAULT_SITES[s] for s in FAULT_SITES)  # described
+
+
+def test_package_is_clean():
+    """Every site fired in deepspeed_tpu/ is registered (the lint the
+    README wires next to lint_unbounded_caches)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "lint_fault_sites.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "clean" in out.stdout

@@ -65,6 +65,7 @@ def test_activations_cover_submodules(model_and_vars):
     assert isinstance(arr, np.ndarray)
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 7)
 def test_grads_match_direct_jax_grad(model_and_vars):
     model, variables, batch = model_and_vars
     tl = TensorLogger(model, start_iteration=1, end_iteration=1,

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Repo lint: every fault-injection site string must be registered.
+
+A typo'd site passed to ``fault_injector.fire("...")`` /
+``consume("...")`` is a silent hole in the recovery test surface: the
+spec grammar accepts it, the drill runs green, and the fault never
+fires — the failure path under test never executes (the injector only
+WARNS about unknown sites, by design, so specs written for newer
+builds degrade gracefully). This lint closes the loop statically:
+
+* every literal site string at a ``fire``/``consume`` call in
+  ``deepspeed_tpu/`` must be declared in the central registry
+  (``deepspeed_tpu/resilience/fault_sites.py:FAULT_SITES``);
+* non-literal site arguments (computed strings) must carry a
+  ``# fault-site-ok: <why>`` annotation on the call line;
+* registry entries no site ever fires are reported as warnings
+  (dead registry entries hide the reverse typo) — warnings don't
+  fail the lint, because tests may drive a site directly.
+
+Usage: python tools/lint_fault_sites.py [root_dir]
+Exit code 0 = clean, 1 = violations found.
+"""
+
+import ast
+import os
+import sys
+
+_CALL_NAMES = ("fire", "consume")
+_ANNOTATION = "# fault-site-ok:"
+
+
+def _iter_py(root):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for f in filenames:
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def _call_name(node):
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _is_injector_call(node):
+    """``<something>.fire(...)`` / ``.consume(...)`` where the
+    receiver smells like an injector (``fault_injector`` /
+    ``injector`` / ``self.injector``), or a bare registry helper.
+    Receiver filtering keeps unrelated ``.fire()`` APIs out."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) or \
+            fn.attr not in _CALL_NAMES:
+        return False
+    recv = fn.value
+    name = None
+    if isinstance(recv, ast.Name):
+        name = recv.id
+    elif isinstance(recv, ast.Attribute):
+        name = recv.attr
+    return name is not None and "injector" in name.lower()
+
+
+def scan_file(path, registry):
+    """-> (violations, used_sites)"""
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, f"syntax error: {e.msg}")], set()
+    lines = src.splitlines()
+    violations, used = [], set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_injector_call(node):
+            continue
+        if not node.args:
+            continue
+        site_arg = node.args[0]
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) \
+            else ""
+        if isinstance(site_arg, ast.Constant) and \
+                isinstance(site_arg.value, str):
+            site = site_arg.value
+            used.add(site)
+            if site not in registry:
+                violations.append(
+                    (path, node.lineno,
+                     f"site {site!r} is not declared in "
+                     "resilience/fault_sites.py:FAULT_SITES"))
+        elif _ANNOTATION not in line:
+            violations.append(
+                (path, node.lineno,
+                 "non-literal fault site; annotate the line with "
+                 f"'{_ANNOTATION} <why>' if the value is closed over "
+                 "registered sites"))
+    return violations, used
+
+
+def main(root=None):
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = root or os.path.join(os.path.dirname(here), "deepspeed_tpu")
+    sys.path.insert(0, os.path.dirname(root))
+    from deepspeed_tpu.resilience.fault_sites import FAULT_SITES
+    registry = set(FAULT_SITES)
+    violations, used = [], set()
+    for path in sorted(_iter_py(root)):
+        v, u = scan_file(path, registry)
+        violations.extend(v)
+        used |= u
+    for path, lineno, msg in violations:
+        print(f"{path}:{lineno}: {msg}")
+    unused = sorted(registry - used)
+    for site in unused:
+        print(f"warning: registered site {site!r} is never fired from "
+              f"{os.path.basename(root)}/ (dead entry, or test-only)")
+    if violations:
+        print(f"\n{len(violations)} fault-site violation(s).")
+        return 1
+    print(f"fault-site lint clean: {len(used)} sites fired, "
+          f"{len(registry)} registered"
+          + (f", {len(unused)} registered-but-unfired" if unused
+             else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))
