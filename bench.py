@@ -47,6 +47,68 @@ def _memory_decomposition(pm):
     }
 
 
+def _telemetry_artifacts(tag, providers, traced_fn=None, step=0,
+                         attach=()):
+    """Per-config observability artifacts (telemetry/): run
+    ``traced_fn`` (one representative step, AFTER the timed window so
+    tracing never perturbs the recorded numbers) under the armed span
+    tracer and export the Perfetto-loadable Chrome trace; then publish
+    ONE hub sample — every registered report surface flattened — to a
+    JSONL sink beside it. Returns the row's ``telemetry`` JSON block
+    (artifact paths + a span census so a reader can see the timeline
+    decomposed without opening Perfetto)."""
+    import os
+
+    from deepspeed_tpu.telemetry import (JsonlSink, TelemetryHub,
+                                         tracer)
+    out_dir = os.environ.get("DSTPU_TRACE_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".telemetry")
+    block = {}
+    # the row's MEASUREMENT already succeeded by the time this runs —
+    # an observability failure (the extra traced step OOMing a nearly-
+    # full chip, an unwritable artifact dir) must degrade to an error
+    # note on the row, never destroy the measured number
+    if traced_fn is not None:
+        try:
+            tracer.configure(enabled=True, capacity=65536)
+            tracer.clear()
+            try:
+                traced_fn()
+                trace_path = tracer.export(
+                    os.path.join(out_dir, f"{tag}.trace.json"))
+            finally:
+                tracer.disable()
+            spans = {}
+            for r in tracer.snapshot():
+                s = spans.setdefault(r.name,
+                                     {"count": 0, "total_ms": 0.0})
+                s["count"] += 1
+                s["total_ms"] += r.dur_ns / 1e6
+            tracer.clear()
+            block["trace"] = trace_path
+            block["spans"] = {k: {"count": v["count"],
+                                  "total_ms": round(v["total_ms"], 2)}
+                              for k, v in sorted(spans.items())}
+        except Exception as e:  # observability-only step: note + move on
+            tracer.disable()
+            tracer.clear()
+            block["trace_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    try:
+        sink = JsonlSink(os.path.join(out_dir, f"{tag}.metrics.jsonl"))
+        hub = TelemetryHub(sink=sink)
+        for ns, provider in providers.items():
+            hub.register(ns, provider)
+        for attach_fn in attach:   # engine-provided attachment hooks
+            attach_fn(hub)
+        flat = hub.sample(step)
+        block["jsonl"] = sink.path
+        block["metrics_sampled"] = len(flat)
+        block["namespaces"] = sorted(hub.namespaces)
+    except Exception as e:
+        block["sample_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    return block
+
+
 def _run_engine_bench(model, config, seq, steps=5, metric="",
                       warmup=2):
     import jax
@@ -124,6 +186,22 @@ def _run_engine_bench(model, config, seq, steps=5, metric="",
                 "options_applied": len(sched["options_applied"]),
                 "options_dropped": len(sched["options_dropped"]),
             }
+    # observability artifacts (ISSUE 8): a Perfetto trace of ONE
+    # post-measurement step (config 4's shows the per-bucket grad-d2h
+    # timeline against the device step) + one hub sample over every
+    # report surface, published beside the row
+    from deepspeed_tpu.telemetry import memory_snapshot
+    out["telemetry"] = _telemetry_artifacts(
+        metric or "engine_bench",
+        # the engine hub's LEAN providers, not the pull-report
+        # surfaces: one "memory" namespace owns the gauges (the
+        # reports would each re-run + duplicate them per sample)
+        {"schedule": engine._schedule_telemetry_snapshot,
+         "offload": engine.get_offload_breakdown,
+         "recovery": engine._recovery_telemetry_snapshot,
+         "memory": memory_snapshot},
+        traced_fn=lambda: float(engine.train_batch(batch=b)),
+        step=engine.global_steps)
     return out
 
 
@@ -366,12 +444,26 @@ def bench_config5(weight_dtype="bfloat16"):
     # class for 7B prompts (blogs/deepspeed-fastgen); vs_baseline here
     # reports decode tokens/s per chip against a 1000 tok/s/chip bar.
     suffix = "" if weight_dtype == "bfloat16" else f"_{weight_dtype}"
+    # observability artifacts: trace a SHORT post-measurement serving
+    # run (schedule/dispatch/collect spans) + one hub sample carrying
+    # the serving report — the v2 scalars' path into the monitors
+    from deepspeed_tpu.telemetry import memory_snapshot
+    telemetry = _telemetry_artifacts(
+        f"serving{suffix or '_bf16'}",
+        {"memory": memory_snapshot},
+        traced_fn=lambda: v2.generate_batch(
+            {200 + i: prompt[i][:64] for i in range(B)},
+            max_new_tokens=8, mode="lookahead"),
+        # attach_telemetry registers the LEAN serving snapshot (the
+        # raw metrics report, no duplicated process_memory block)
+        attach=(v2.attach_telemetry,))
     return {
         "metric": f"llama7b_shape_tp_inference_p50_ttft_ms{suffix}",
         "value": round(p50_ttft * 1e3, 1),
         "unit": f"ms (decode {decode_tps:,.0f} tok/s, lookahead)",
         "vs_baseline": round(decode_tps / 1000.0, 4),
         "variance": round((max(ttfts) - min(ttfts)) / p50_ttft, 4),
+        "telemetry": telemetry,
         # the serving metrics layer's decomposition: where a decode
         # step's time goes, and proof the loop is async (steady
         # blocking syncs must read 0)

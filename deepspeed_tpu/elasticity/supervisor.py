@@ -61,6 +61,7 @@ from ..resilience.recovery import (Detection, RecoveryRecord, RETRY,
                                    ROLLBACK, SHRINK)
 from ..resilience.watchdog import (HeartbeatMonitor,
                                    collective_watchdog)
+from ..telemetry.trace import span
 from ..utils.logging import logger
 from .elastic_agent import resume_latest
 from .reshard import plan_shrink_batch, reshard_from_manifest
@@ -166,7 +167,8 @@ class ElasticSupervisor:
                         reason="participant unresponsive at the "
                                "dispatch barrier")
 
-        collective_watchdog.run("pg_sim.gate", check)
+        with span("supervisor.gate", step=step):
+            collective_watchdog.run("pg_sim.gate", check)
 
     def _monitor_detections(self, step):
         dets = []
@@ -399,15 +401,16 @@ class ElasticSupervisor:
         # rung 1 — retry: wait out a transient stall. Kills are never
         # transient; and once the retry budget is spent, escalate.
         if "kill" not in modes and prior_attempts < self.max_step_retries:
-            self.domain.idle_tick()
-            # rank-less detections (a watchdog timeout nobody could
-            # attribute) can never CLAIM recovery here — only a
-            # passing re-gate proves it, so they just wait
-            healthy = bool(ranks) and all(
-                self.domain.worker(r).alive
-                and self.domain.worker(r).state != "hung"
-                and self.domain.worker(r).slow_left <= 0
-                for r in ranks)
+            with span("supervisor.retry", step=step):
+                self.domain.idle_tick()
+                # rank-less detections (a watchdog timeout nobody
+                # could attribute) can never CLAIM recovery here —
+                # only a passing re-gate proves it, so they just wait
+                healthy = bool(ranks) and all(
+                    self.domain.worker(r).alive
+                    and self.domain.worker(r).state != "hung"
+                    and self.domain.worker(r).slow_left <= 0
+                    for r in ranks)
             if healthy:
                 for r in ranks:
                     self.monitor.restore(r, step)
@@ -434,13 +437,14 @@ class ElasticSupervisor:
         respawned = all(self.domain.respawn(r) for r in ranks) \
             if ranks else True
         if respawned:
-            if not resume_latest(self.engine, self.ckpt_dir):
-                raise self._terminal(
-                    "rollback rung has no committed checkpoint under "
-                    f"{self.ckpt_dir!r}", detections, t0)
-            self._requeue_since(self.engine.global_steps)
-            for r in ranks:
-                self.monitor.restore(r, self.engine.global_steps)
+            with span("supervisor.rollback", step=step):
+                if not resume_latest(self.engine, self.ckpt_dir):
+                    raise self._terminal(
+                        "rollback rung has no committed checkpoint "
+                        f"under {self.ckpt_dir!r}", detections, t0)
+                self._requeue_since(self.engine.global_steps)
+                for r in ranks:
+                    self.monitor.restore(r, self.engine.global_steps)
             self._stall_streak = 0
             self.report.note_recovery(RecoveryRecord(
                 ROLLBACK, detections[0],
@@ -455,7 +459,9 @@ class ElasticSupervisor:
                 f"restored step {self.engine.global_steps}")
             return
         # rung 3 — shrink-and-reshard onto the survivors
-        if self._try_shrink(detections, t0, world_before):
+        with span("supervisor.shrink", step=step):
+            shrunk = self._try_shrink(detections, t0, world_before)
+        if shrunk:
             return
         raise self._terminal(
             f"workers {ranks} unrecoverable (modes={sorted(modes)}) "
@@ -561,8 +567,12 @@ class ElasticSupervisor:
             return False
         # the restore succeeded: NOW commit the domain mutation
         self.domain.shrink()
-        # carry the report (and its history) onto the new engine
+        # carry the report (and its history) onto the new engine —
+        # including the telemetry hub's alert sink, which was built
+        # against the fresh engine's (empty) report at init
         new_engine._recovery = eng.recovery()
+        if new_engine.telemetry is not None:
+            new_engine.telemetry.recovery = new_engine._recovery
         old, self.engine = self.engine, new_engine
         self._requeue_since(new_engine.global_steps)
         self._install_domain()

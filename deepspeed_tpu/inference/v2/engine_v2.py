@@ -748,3 +748,23 @@ class InferenceEngineV2:
         # (deep probes call lifecycle.memory_gauges() directly)
         out["process_memory"] = memory_gauges(include_arrays=False)
         return out
+
+    def attach_telemetry(self, hub, namespace: str = "serving"):
+        """Register this engine's serving report on a ``TelemetryHub``
+        (telemetry/hub.py) so the steady-window ITL/TTFT medians, KV
+        utilization and recompile counter flow through the hub's
+        MonitorMaster fan-out + JSONL sink next to the training
+        metrics — historically ``_write_monitor`` only ever saw
+        training scalars. Returns the hub for chaining; sample with
+        ``hub.sample(step)`` (a front-end's request loop) or let a
+        co-hosted training engine's per-step sampling carry it."""
+
+        def snapshot():
+            # the raw metrics report, WITHOUT get_serving_report's
+            # process_memory block — the hub's "memory" namespace
+            # owns the gauges; per-sample duplication is just noise
+            return (self._serving_metrics.report()
+                    if self._serving_metrics is not None else {})
+
+        hub.register(namespace, snapshot)
+        return hub

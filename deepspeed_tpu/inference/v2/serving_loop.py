@@ -37,6 +37,7 @@ import numpy as np
 
 from ...resilience.errors import ServingOverloadError
 from ...resilience.fault_injector import fault_injector
+from ...telemetry.trace import span
 from ..sampling import SamplingParams
 from .metrics import ServingMetrics
 from .ragged_manager import SchedulingError, SchedulingResult  # noqa: F401 — re-exported for loop callers
@@ -236,19 +237,22 @@ def _run_sync(engine, pending, out, max_new, eos, sampling, metrics):
     remaining = {uid: max_new for uid in out}
     while pending or decode:
         t0 = metrics.now()
-        uids, toks = engine.schedule(pending, decode)
-        if not uids:
-            # the sync loop has nothing in flight: empty schedule with
-            # live sequences is terminal, not drainable
-            raise _stuck(engine, pending,
-                         "no schedulable work (out of KV blocks)")
-        emit, n_prompt = _trim_prompts(pending, uids, toks)
-        tokens_dev, _, recompiled = _dispatch(
-            engine, lambda: engine.put_sampled(
-                uids, toks, sampling=sampling, base_key=base_key))
+        with span("serving.schedule"):
+            uids, toks = engine.schedule(pending, decode)
+            if not uids:
+                # the sync loop has nothing in flight: empty schedule
+                # with live sequences is terminal, not drainable
+                raise _stuck(engine, pending,
+                             "no schedulable work (out of KV blocks)")
+            emit, n_prompt = _trim_prompts(pending, uids, toks)
+        with span("serving.dispatch", n_seqs=len(uids)):
+            tokens_dev, _, recompiled = _dispatch(
+                engine, lambda: engine.put_sampled(
+                    uids, toks, sampling=sampling, base_key=base_key))
         t1 = metrics.now()
         _start_host_copy(tokens_dev)
-        toks_host = np.asarray(tokens_dev)     # the per-step sync
+        with span("serving.collect"):
+            toks_host = np.asarray(tokens_dev)     # the per-step sync
         t2 = metrics.now()
         n_new = 0
         for row, uid in enumerate(uids):
@@ -283,15 +287,16 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
         # host-visible. Sequences whose pending emission is their LAST
         # (length limit) are excluded — the host knows counts up front,
         # so only EOS ever cancels speculative work.
-        sched_decode = {}
-        for uid, v in decode.items():
-            if isinstance(v, _Ref):
-                assert v.step is inflight, "stale device-token ref"
-                if remaining[uid] > 1:
-                    sched_decode[uid] = 0          # placeholder id
-            else:
-                sched_decode[uid] = v
-        uids, toks = engine.schedule(pending, sched_decode)
+        with span("serving.schedule"):
+            sched_decode = {}
+            for uid, v in decode.items():
+                if isinstance(v, _Ref):
+                    assert v.step is inflight, "stale device-token ref"
+                    if remaining[uid] > 1:
+                        sched_decode[uid] = 0      # placeholder id
+                else:
+                    sched_decode[uid] = v
+            uids, toks = engine.schedule(pending, sched_decode)
         step = None
         n_prompt = 0
         recompiled = False
@@ -301,11 +306,13 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
                 v = decode.get(uid)
                 srcs.append(v.slot if isinstance(v, _Ref) else -1)
             emit, n_prompt = _trim_prompts(pending, uids, toks)
-            tokens_dev, committed, recompiled = _dispatch(
-                engine, lambda: engine.put_sampled(
-                    uids, toks, src_slots=srcs,
-                    prev_tokens=inflight.tokens if inflight else None,
-                    sampling=sampling, base_key=base_key))
+            with span("serving.dispatch", n_seqs=len(uids)):
+                tokens_dev, committed, recompiled = _dispatch(
+                    engine, lambda: engine.put_sampled(
+                        uids, toks, src_slots=srcs,
+                        prev_tokens=inflight.tokens if inflight
+                        else None,
+                        sampling=sampling, base_key=base_key))
             _start_host_copy(tokens_dev)
             step = _Step(uids=uids, emit=emit, tokens=tokens_dev,
                          slot={u: i for i, u in enumerate(uids)},
@@ -331,7 +338,8 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
         sync_wait = 0.0
         if inflight is not None:
             ts = metrics.now()
-            toks_host = np.asarray(inflight.tokens)
+            with span("serving.collect"):
+                toks_host = np.asarray(inflight.tokens)
             sync_wait = metrics.now() - ts
             for row, uid in enumerate(inflight.uids):
                 if not inflight.emit[row] or row in inflight.cancelled:
@@ -380,14 +388,16 @@ def _run_sync_host(engine, pending, out, max_new, eos, sampling,
     remaining = {uid: max_new for uid in out}
     while pending or decode:
         t0 = metrics.now()
-        uids, toks = engine.schedule(pending, decode)
-        if not uids:
-            raise _stuck(engine, pending,
-                         "no schedulable work (out of KV blocks)")
-        emit, n_prompt = _trim_prompts(pending, uids, toks)
+        with span("serving.schedule"):
+            uids, toks = engine.schedule(pending, decode)
+            if not uids:
+                raise _stuck(engine, pending,
+                             "no schedulable work (out of KV blocks)")
+            emit, n_prompt = _trim_prompts(pending, uids, toks)
         t1 = metrics.now()
-        logits = _dispatch(engine,
-                           lambda: engine.put(uids, toks))  # host round-trip
+        with span("serving.dispatch", n_seqs=len(uids)):
+            logits = _dispatch(
+                engine, lambda: engine.put(uids, toks))  # host round-trip
         recompiled = engine._last_dispatch_was_compile
         t2 = metrics.now()
         n_new = 0

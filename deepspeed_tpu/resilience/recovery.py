@@ -82,8 +82,16 @@ class RecoveryReport:
     """Aggregate the engine publishes via ``get_recovery_report()``."""
 
     def __init__(self):
+        from collections import deque
         self.detections: List[Detection] = []
         self.records: List[RecoveryRecord] = []
+        # telemetry anomaly alerts (telemetry/anomaly.TelemetryAlert):
+        # the hub's watchers write here so the recovery report shows
+        # anomalies next to the failures they often precede. Alerts
+        # are leading indicators, not the incident record — bounded to
+        # the newest window (same bound as the hub's own alert log)
+        from ..telemetry.anomaly import MAX_ALERT_LOG
+        self.alerts = deque(maxlen=MAX_ALERT_LOG)
 
     def note_detection(self, detection: Detection):
         self.detections.append(detection)
@@ -92,6 +100,10 @@ class RecoveryReport:
     def note_recovery(self, record: RecoveryRecord):
         self.records.append(record)
         return record
+
+    def note_alert(self, alert):
+        self.alerts.append(alert)
+        return alert
 
     @property
     def rung_counts(self):
@@ -105,6 +117,8 @@ class RecoveryReport:
         return {
             "detections": [d.as_dict() for d in self.detections],
             "ladder": [r.as_dict() for r in self.records],
+            "alerts": [a.as_dict() for a in self.alerts],
+            "alert_count": len(self.alerts),
             "rung_counts": self.rung_counts,
             "mttr_s": {
                 "last": mttrs[-1] if mttrs else 0.0,

@@ -234,6 +234,56 @@ class SupervisorConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class TelemetryTraceConfig(DeepSpeedConfigModel):
+    """Span tracer knobs (telemetry/trace.py). Enabling arms the
+    PROCESS-WIDE tracer (it records from every instrumented subsystem,
+    not just this engine); disabled it is a strict no-op."""
+    enabled: bool = False
+    # ring-buffer bound: spans retained before the oldest fall off
+    capacity: int = 8192
+    # wrap each span in jax.profiler.TraceAnnotation so an xprof
+    # window co-captures the host spans on the device timeline
+    device_annotations: bool = True
+
+
+@dataclasses.dataclass
+class TelemetryAnomalyConfig(DeepSpeedConfigModel):
+    """Always-on anomaly watchers over the hub's metric stream
+    (telemetry/anomaly.py default_watchers). Factors <= 1 / values
+    <= 0 disable the corresponding watcher."""
+    enabled: bool = True
+    # step-time spike: alert when train/step_time_ms > factor x EWMA
+    step_time_spike_factor: float = 3.0
+    # offload overlap-residue regression (the ROADMAP item-4 signal)
+    residue_spike_factor: float = 3.0
+    # serving SLO ceilings (breach counters); 0 = not enforced
+    ttft_slo_ms: float = 0.0
+    itl_slo_ms: float = 0.0
+    # leak watch: least-squares slope over this many samples
+    slope_window: int = 16
+    rss_slope_gb_per_step: float = 0.0
+    hbm_slope_gb_per_step: float = 0.0
+
+
+@dataclasses.dataclass
+class TelemetryConfig(DeepSpeedConfigModel):
+    """The streaming telemetry hub (telemetry/hub.py): every report
+    surface sampled into one flat metric stream every
+    ``sample_interval_steps`` global steps, fanned out to the monitor
+    backends and a rotating JSONL sink, watched by the anomaly layer.
+    See README "Observability"."""
+    enabled: bool = False
+    sample_interval_steps: int = 1
+    # rotating JSONL sink path (None = no file sink)
+    jsonl_path: str = None
+    jsonl_max_mb: float = 16.0
+    # fan the flat stream out to MonitorMaster (tb/wandb/csv)
+    monitor: bool = True
+    trace: TelemetryTraceConfig = submodel(TelemetryTraceConfig)
+    anomaly: TelemetryAnomalyConfig = submodel(TelemetryAnomalyConfig)
+
+
+@dataclasses.dataclass
 class PipelineConfig(DeepSpeedConfigModel):
     """Pipeline engine knobs (reference: pipe engine config usage)."""
     stages: str = "auto"
@@ -301,6 +351,8 @@ class DeepSpeedConfig:
             d.get("lifecycle", {}))
         self.supervisor_config = SupervisorConfig.from_dict(
             d.get("elasticity", {}).get("supervisor", {}))
+        self.telemetry_config = TelemetryConfig.from_dict(
+            d.get("telemetry", {}))
         # curriculum learning: legacy top-level section or nested under
         # data_efficiency.data_sampling (reference: data_pipeline/config.py)
         self.curriculum_config = d.get("curriculum_learning", None)

@@ -53,6 +53,7 @@ from .fp16.loss_scaler import (LossScaleState, dynamic_loss_scale_state,
 from .lr_schedules import LRScheduler, get_lr_schedule
 from .optimizers import build_optimizer
 from ..moe.experts import moe_tensor_rules
+from ..telemetry.trace import span
 from .utils import clip_grad_norm_, ensure_directory_exists, global_norm
 from .zero.partition import ZeroShardingRules, compose_tensor_rules
 
@@ -367,6 +368,20 @@ class DeepSpeedEngine:
         # rollbacks and the elastic supervisor's ladder actions land
         # here; published via get_recovery_report()
         self._recovery = None
+
+        # unified telemetry (telemetry/): arm the process tracer when
+        # configured, and build the streaming hub that samples every
+        # report surface into one metric stream (README "Observability")
+        self.telemetry = None
+        self._last_step_wall_ms = 0.0
+        tcfg = self._config.telemetry_config
+        if tcfg.trace.enabled:
+            from ..telemetry.trace import tracer
+            tracer.configure(
+                enabled=True, capacity=tcfg.trace.capacity,
+                device_annotations=tcfg.trace.device_annotations)
+        if tcfg.enabled:
+            self.telemetry = self._build_telemetry_hub(tcfg)
 
         log_dist(
             f"DeepSpeedEngine: zero_stage={self.zero_stage} dtype={self.compute_dtype.__name__} "
@@ -1008,6 +1023,89 @@ class DeepSpeedEngine:
         # probes (soak harness, bench) call lifecycle.memory_gauges()
         # directly for the full census.
         out["process_memory"] = memory_gauges(include_arrays=False)
+        return out
+
+    def _build_telemetry_hub(self, tcfg):
+        """The engine's TelemetryHub: every report surface this engine
+        owns registered as a namespaced snapshot provider, fan-out to
+        the (already built) MonitorMaster plus the configured JSONL
+        sink, anomaly watchers armed from ``telemetry.anomaly``.
+        Sampled from ``train_batch`` every ``sample_interval_steps``
+        global steps; serving engines attach their own namespace via
+        ``InferenceEngineV2.attach_telemetry(engine.telemetry)``."""
+        from ..telemetry.anomaly import default_watchers
+        from ..telemetry.hub import (JsonlSink, TelemetryHub,
+                                     memory_snapshot)
+        sink = None
+        if tcfg.jsonl_path:
+            sink = JsonlSink(
+                tcfg.jsonl_path,
+                max_bytes=int(tcfg.jsonl_max_mb * (1 << 20)))
+        watchers = default_watchers(tcfg.anomaly) \
+            if tcfg.anomaly.enabled else []
+        # rank-0-only monitor fan-out: the monitor layer's contract
+        # (monitor/monitor.py) is enforced by callers, exactly like
+        # _write_monitor's gate — every rank still samples/sinks/
+        # watches locally
+        mon = self.monitor \
+            if tcfg.monitor and dist.get_rank() == 0 else None
+        hub = TelemetryHub(
+            monitor=mon, sink=sink,
+            sample_interval_steps=tcfg.sample_interval_steps,
+            watchers=watchers, recovery=self.recovery())
+        # lean per-step snapshots, NOT the pull-report surfaces: the
+        # reports each append their own memory_gauges() and serialize
+        # event histories — per-sample that would run the gauges 3x
+        # and publish them in triplicate. One "memory" namespace owns
+        # the gauges; the others stay scalar-only.
+        hub.register("train", self._train_telemetry_snapshot)
+        hub.register("schedule", self._schedule_telemetry_snapshot)
+        hub.register("offload", self.get_offload_breakdown)
+        hub.register("recovery", self._recovery_telemetry_snapshot)
+        hub.register("memory", memory_snapshot)
+        return hub
+
+    def _schedule_telemetry_snapshot(self):
+        """get_schedule_report minus the process_memory block (the
+        hub's "memory" namespace owns the gauges); still lazy — the
+        HLO parse is memoized per compiled program."""
+        s = self._scheduled_steps.get("train_step")
+        return dict(s.schedule_report()) if s is not None else {}
+
+    def _recovery_telemetry_snapshot(self):
+        """Scalar view of the recovery report for the stream: counts
+        and aggregates only — the full detections/ladder/alerts event
+        history stays on the pull surface (get_recovery_report)."""
+        r = self.recovery()
+        mttrs = [rec.mttr_s for rec in r.records]
+        return {
+            "detections": len(r.detections),
+            "alert_count": len(r.alerts),
+            "rung_counts": r.rung_counts,
+            "resharded_bytes": sum(rec.resharded_bytes
+                                   for rec in r.records),
+            "mttr_last_s": mttrs[-1] if mttrs else 0.0,
+        }
+
+    def _train_telemetry_snapshot(self):
+        """The per-step training scalars the hub streams: host wall of
+        the newest step plus the step metrics the monitor already
+        floats. NOTE the float() calls block on the step's device
+        values — same cost the monitor path pays; the hub's sampling
+        interval is the throttle."""
+        out = {"step_time_ms": self._last_step_wall_ms,
+               "global_steps": self.global_steps,
+               "skipped_steps": self.skipped_steps,
+               "global_samples": self.global_samples}
+        m = getattr(self, "_step_metrics", None) or {}
+        for k in ("loss", "grad_norm", "loss_scale"):
+            if k in m:
+                try:
+                    out[k] = float(m[k])
+                except (TypeError, ValueError):
+                    pass  # non-scalar metric entry
+        if self.lr_scheduler is not None:
+            out["lr"] = float(self.get_lr()[0])
         return out
 
     def recovery(self):
@@ -1810,7 +1908,25 @@ class DeepSpeedEngine:
     def train_batch(self, data_iter=None, batch=None):
         """One full training step: gas microbatches + optimizer update
         (reference parity: PipelineEngine.train_batch pipe/engine.py:351;
-        for DeepSpeedEngine users this fuses forward/backward/step)."""
+        for DeepSpeedEngine users this fuses forward/backward/step).
+
+        Telemetry seam: the whole call runs under the
+        ``engine.train_batch`` span (host wall; the jitted dispatch
+        inside is the ``engine.dispatch`` child — the gap between the
+        two is the host-side tail a step timeline decomposes), the
+        host wall feeds ``train/step_time_ms``, and the hub samples
+        the metric stream every ``telemetry.sample_interval_steps``
+        global steps."""
+        t_wall = time.perf_counter()
+        with span("engine.train_batch", step=self.global_steps):
+            loss = self._train_batch_impl(data_iter=data_iter,
+                                          batch=batch)
+        self._last_step_wall_ms = (time.perf_counter() - t_wall) * 1e3
+        if self.telemetry is not None:
+            self.telemetry.maybe_sample(self.global_steps)
+        return loss
+
+    def _train_batch_impl(self, data_iter=None, batch=None):
         if batch is None:
             it = data_iter if data_iter is not None else self.data_iterator
             if it is None:
@@ -1834,10 +1950,11 @@ class DeepSpeedEngine:
                 device_batch)
         comp_bits, prune_on = self._compression_step_args(device_batch)
         self._swap_state_in()
-        self.state, metrics, off_grads, self._offload_grad_residual = \
-            self._jit_train_step(
-                self.state, device_batch, self._next_rng(), comp_bits,
-                prune_on, self._offload_grad_residual)
+        with span("engine.dispatch"):
+            self.state, metrics, off_grads, \
+                self._offload_grad_residual = self._jit_train_step(
+                    self.state, device_batch, self._next_rng(),
+                    comp_bits, prune_on, self._offload_grad_residual)
         self._swap_state_out()
         if self._offload is not None:
             skip = metrics["overflow"] if self.fp16_enabled else False
@@ -2378,6 +2495,13 @@ class DeepSpeedEngine:
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
+        with span("checkpoint.save",
+                  tag=str(tag) if tag is not None else ""):
+            return self._save_checkpoint_impl(save_dir, tag,
+                                              client_state, save_latest)
+
+    def _save_checkpoint_impl(self, save_dir, tag, client_state,
+                              save_latest):
         self._merge_offload_future()  # flush in-flight DPU host update
         tag = tag or f"global_step{self.global_steps}"
         client_state = dict(client_state or {})
@@ -2483,6 +2607,16 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False):
+        with span("checkpoint.load",
+                  tag=str(tag) if tag is not None else ""):
+            return self._load_checkpoint_impl(
+                load_dir, tag, load_optimizer_states,
+                load_lr_scheduler_states, load_module_only)
+
+    def _load_checkpoint_impl(self, load_dir, tag,
+                              load_optimizer_states,
+                              load_lr_scheduler_states,
+                              load_module_only):
         self._merge_offload_future()
         if self.state is None:
             raise ValueError("initialize params before load_checkpoint "
@@ -2643,6 +2777,12 @@ class DeepSpeedEngine:
         self._invalidate_batch_shape_caches()
         self.data_iterator = None
         self.training_dataloader = None
+        if self.telemetry is not None:
+            # the hub's registered providers are bound methods of this
+            # engine — an engine<->hub reference cycle of exactly the
+            # kind close() exists to break (runtime/lifecycle.py)
+            for ns in list(self.telemetry.namespaces):
+                self.telemetry.unregister(ns)
 
     # ------------------------------------------------------------------
     # misc parity surface

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...telemetry.trace import span
 from ...utils.jax_compat import TRANSFER_ERRORS
 from ...utils.logging import logger
 from .bucketizer import BucketPlan
@@ -188,8 +189,11 @@ class TransferEngine:
         if staging is None:
             staging = plan.alloc_staging()
         for si, k, barr in self.iter_buckets(plan, bucket_lists):
-            if on_bucket is not None:
-                on_bucket(si, k)
-            b0, b1 = plan.streams[si].buckets[k]
-            staging[si][b0:b1] = np.asarray(barr).reshape(-1)
+            # per-bucket download span: the wait is where overlap (or
+            # its absence) shows on a step timeline
+            with span("transfer.d2h", stream=si, bucket=k):
+                if on_bucket is not None:
+                    on_bucket(si, k)
+                b0, b1 = plan.streams[si].buckets[k]
+                staging[si][b0:b1] = np.asarray(barr).reshape(-1)
         return plan.views(staging)

@@ -27,6 +27,7 @@ import numpy as np
 from ...ops.adam.cpu_adam import DeepSpeedCPUAdam
 from ...resilience.fault_injector import fault_injector
 from ...resilience.retry import retry_io
+from ...telemetry.trace import span
 from ...utils.jax_compat import TRANSFER_ERRORS
 from ...utils.logging import log_dist
 from ..transfer import StagingPair, TransferEngine, start_host_copy
@@ -234,6 +235,16 @@ class OffloadCoordinator:
 
     def _host_step(self, off_grads, lr, skip, shardings,
                    prepacked=None) -> Optional[list]:
+        # span wrapper: in delayed-update mode this runs on the worker
+        # thread, so the trace shows the host step overlapped (or not)
+        # against the main thread's engine.train_batch — the config-4
+        # stall evidence ROADMAP item 4 needs
+        with span("offload.host_step"):
+            return self._host_step_spanned(off_grads, lr, skip,
+                                           shardings, prepacked)
+
+    def _host_step_spanned(self, off_grads, lr, skip, shardings,
+                           prepacked=None) -> Optional[list]:
         """Host path: grads device->host, host Adam, compute-dtype
         payloads back to device. Returns the device leaves to merge
         (or, on the bucketed path, a ``_PendingUpload`` the main-thread
@@ -301,8 +312,9 @@ class OffloadCoordinator:
                              description="offload grad d2h")
             g = self._decode_entry(slot, entry)
             t1 = time.perf_counter()
-            ha.step_arrays(ha.master[slot], g, ha.m[slot], ha.v[slot],
-                           lr, step_count)
+            with span("offload.adam", slot=slot):
+                ha.step_arrays(ha.master[slot], g, ha.m[slot],
+                               ha.v[slot], lr, step_count)
             t2 = time.perf_counter()
             if self._delta_upload:
                 leaves.append(self._delta_payload(slot, shardings[slot]))
@@ -455,10 +467,11 @@ class OffloadCoordinator:
             fault_injector.fire("transfer.h2d")
             return jax.device_put(buf, self._h2d_rep)
 
-        self._h2d_dev[si][k] = retry_io(
-            _put, retries=2, backoff_seconds=0.01,
-            retryable=TRANSFER_ERRORS,
-            description="offload param h2d (bucket)")
+        with span("transfer.h2d", stream=si, bucket=k):
+            self._h2d_dev[si][k] = retry_io(
+                _put, retries=2, backoff_seconds=0.01,
+                retryable=TRANSFER_ERRORS,
+                description="offload param h2d (bucket)")
 
     def _host_step_bucketed(self, off_grads, lr, shardings,
                             prepacked=None) -> "_PendingUpload":
@@ -512,9 +525,10 @@ class OffloadCoordinator:
                 fault_injector.fire("transfer.d2h")
                 return np.asarray(barr)
 
-            h = retry_io(_wait, retries=2, backoff_seconds=0.01,
-                         retryable=TRANSFER_ERRORS,
-                         description="offload grad d2h (bucket)")
+            with span("transfer.d2h", stream=si, bucket=k):
+                h = retry_io(_wait, retries=2, backoff_seconds=0.01,
+                             retryable=TRANSFER_ERRORS,
+                             description="offload grad d2h (bucket)")
             b0, b1 = dplan.streams[si].buckets[k]
             dstage[si][b0:b1] = h.reshape(-1)
             ready = arrival.mark(si, k)
@@ -525,10 +539,12 @@ class OffloadCoordinator:
                 if slot_left[slot]:
                     continue
                 t1 = time.perf_counter()
-                g = self._decode_entry(
-                    slot, views[slot * per_leaf:(slot + 1) * per_leaf])
-                ha.step_arrays(ha.master[slot], g, ha.m[slot],
-                               ha.v[slot], lr, step_count)
+                with span("offload.adam", slot=slot):
+                    g = self._decode_entry(
+                        slot,
+                        views[slot * per_leaf:(slot + 1) * per_leaf])
+                    ha.step_arrays(ha.master[slot], g, ha.m[slot],
+                                   ha.v[slot], lr, step_count)
                 t2 = time.perf_counter()
                 for j, arr in enumerate(self._payload_np(slot)):
                     m_idx = slot * per_up + j

@@ -63,6 +63,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...parallel.mesh import FSDP_AXIS
+from ...telemetry.trace import span
 from ...utils.logging import logger
 from ..lifecycle import BoundedCache
 from .partition import shard_leaf_spec
@@ -363,9 +364,14 @@ class ScheduledStep:
             key = self._key(args)
             entry = self._cache.get(key)
             if entry is None:
-                lowered = self._fn.lower(*args)
-                compiled, applied, dropped = compile_with_options(
-                    lowered, self._options, self._label)
+                # compile spikes must be attributable on a step
+                # timeline (a serving/train stall that is "just" a
+                # recompile looks identical to a real regression
+                # without this span)
+                with span("schedule.compile", label=self._label):
+                    lowered = self._fn.lower(*args)
+                    compiled, applied, dropped = compile_with_options(
+                        lowered, self._options, self._label)
                 self._last_program = (compiled, applied, dropped)
                 entry = compiled
                 self._cache.put(key, compiled)
@@ -380,7 +386,8 @@ class ScheduledStep:
             return self._fn(*args)
         dyn = [a for i, a in enumerate(args) if i not in self._static]
         try:
-            return entry(*dyn)
+            with span("schedule.step", label=self._label):
+                return entry(*dyn)
         except TypeError as e:
             # signature mismatches raise before execution (no donation
             # happened); anything past execution re-raises as-is

@@ -1,0 +1,30 @@
+"""Unified telemetry (the observability layer the reference treats as
+first-class: monitor fan-out, profilers, comm logging — PAPER.md):
+
+* ``trace`` — bounded ring-buffer span tracer (host spans + xprof
+  co-capture) exporting Chrome-trace JSON; ``view`` is its CLI.
+* ``hub`` — the streaming ``TelemetryHub``: every report surface
+  registered, sampled every N steps into one flat metric stream,
+  fanned out to MonitorMaster + a rotating JSONL sink.
+* ``anomaly`` — always-on watchers over the stream emitting typed
+  ``TelemetryAlert`` events.
+
+See README "Observability" for config and workflow.
+"""
+
+from .anomaly import (EwmaSpikeWatcher, SlopeWatcher, TelemetryAlert,
+                      ThresholdWatcher, Watcher, default_watchers)
+from .hub import (JsonlSink, TelemetryHub, flatten_metrics,
+                  memory_snapshot)
+from .span_sites import SPAN_SITES, KNOWN_SPANS
+from .trace import (Tracer, span, trace_enabled, tracer,
+                    validate_chrome_trace)
+
+__all__ = [
+    "EwmaSpikeWatcher", "SlopeWatcher", "TelemetryAlert",
+    "ThresholdWatcher", "Watcher", "default_watchers",
+    "JsonlSink", "TelemetryHub", "flatten_metrics", "memory_snapshot",
+    "SPAN_SITES", "KNOWN_SPANS",
+    "Tracer", "span", "trace_enabled", "tracer",
+    "validate_chrome_trace",
+]

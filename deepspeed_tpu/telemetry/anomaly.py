@@ -1,0 +1,212 @@
+"""Always-on anomaly watch over the telemetry stream.
+
+Cheap host-side watchers the hub runs on every sample (a handful of
+float compares per metric — nothing here touches the device), emitting
+typed ``TelemetryAlert`` events into the hub's bounded alert log and —
+when the hub is attached to an engine — into the engine's
+``RecoveryReport``, so ``get_recovery_report()`` shows anomalies next
+to the failures they often precede.
+
+The four watchers the ROADMAP's open items need:
+
+* ``EwmaSpikeWatcher`` — step-time (or any metric) spiking above a
+  factor of its exponentially-weighted mean: the "one step suddenly
+  took 4x" signal (a straggler, a recompile, an injected ``slow``
+  fault — the deterministic test drives exactly that).
+* ``ThresholdWatcher`` — SLO breach counters: TTFT/ITL medians over a
+  configured ceiling (the serving front-end's admission signal).
+* ``SlopeWatcher`` — leak watch: least-squares slope of RSS / HBM over
+  a sliding window of samples exceeding a per-step budget (the PR-6
+  memory gauges, finally watched instead of polled by hand).
+
+All watchers are deterministic functions of the sample stream (no
+wall-clock reads, no randomness): a test that replays a metric series
+replays the alerts.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional
+
+# severity levels (advisory; routing is the consumer's job)
+WARN = "warn"
+PAGE = "page"
+
+# ONE bound for every alert log (the hub's and the recovery
+# report's): alerts are leading indicators, not the incident record —
+# keep the newest window, never grow unbounded
+MAX_ALERT_LOG = 256
+
+
+@dataclasses.dataclass
+class TelemetryAlert:
+    """One anomaly observation (flat, JSON-able — it rides the same
+    JSONL stream and recovery report as the metrics)."""
+    kind: str          # "ewma_spike" | "slo_breach" | "slope_leak"
+    metric: str        # the flat stream key that tripped
+    value: float
+    threshold: float
+    step: int
+    message: str
+    severity: str = WARN
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Watcher:
+    """Base: ``observe(samples, step) -> [TelemetryAlert]``. Watchers
+    keep their own state; a metric absent from a sample is skipped
+    (subsystems report at different cadences)."""
+
+    def observe(self, samples: Dict[str, float],
+                step: int) -> List[TelemetryAlert]:
+        raise NotImplementedError
+
+
+class EwmaSpikeWatcher(Watcher):
+    """Alert when ``metric`` exceeds ``factor`` x its EWMA. Two
+    baseline rules, both load-bearing:
+
+    * the first ``warmup`` samples are EXCLUDED entirely (not even
+      averaged in) — a train step's first samples are compiles and
+      cold caches, orders of magnitude above steady state, and a
+      baseline seeded there would mask every real spike for dozens of
+      steps;
+    * the EWMA only absorbs NON-spiking samples — a genuine
+      regression keeps alerting instead of teaching the baseline to
+      accept it."""
+
+    def __init__(self, metric: str, factor: float = 3.0,
+                 alpha: float = 0.2, warmup: int = 3,
+                 severity: str = WARN):
+        if factor <= 1.0:
+            raise ValueError(f"spike factor must be > 1, got {factor}")
+        self.metric = metric
+        self.factor = float(factor)
+        self.alpha = float(alpha)
+        self.warmup = int(warmup)
+        self.severity = severity
+        self._ewma: Optional[float] = None
+        self._seen = 0
+        self.spikes = 0
+
+    def observe(self, samples, step):
+        v = samples.get(self.metric)
+        if v is None:
+            return []
+        v = float(v)
+        self._seen += 1
+        if self._seen <= self.warmup:
+            return []
+        if self._ewma is None:
+            self._ewma = v
+            return []
+        limit = self.factor * self._ewma
+        if not (v > limit and self._ewma > 0):
+            self._ewma += self.alpha * (v - self._ewma)
+            return []
+        self.spikes += 1
+        return [TelemetryAlert(
+            "ewma_spike", self.metric, v, limit, step,
+            f"{self.metric} spiked to {v:.4g} "
+            f"(> {self.factor:g}x EWMA {self._ewma:.4g})",
+            self.severity)]
+
+
+class ThresholdWatcher(Watcher):
+    """SLO breach counter: alert whenever ``metric`` crosses
+    ``max_value`` (breaches accumulate in ``.breaches`` — the counter
+    the serving report's SLO story wants, independent of how many
+    alert consumers are attached)."""
+
+    def __init__(self, metric: str, max_value: float,
+                 severity: str = WARN):
+        self.metric = metric
+        self.max_value = float(max_value)
+        self.severity = severity
+        self.breaches = 0
+
+    def observe(self, samples, step):
+        v = samples.get(self.metric)
+        if v is None or float(v) <= self.max_value:
+            return []
+        self.breaches += 1
+        return [TelemetryAlert(
+            "slo_breach", self.metric, float(v), self.max_value, step,
+            f"{self.metric}={float(v):.4g} breaches the "
+            f"{self.max_value:g} SLO (breach #{self.breaches})",
+            self.severity)]
+
+
+class SlopeWatcher(Watcher):
+    """Leak watch: least-squares slope of ``metric`` over the last
+    ``window`` (step, value) samples; alert when it exceeds
+    ``max_slope_per_step`` (units/step). Windowed, so a one-off jump
+    ages out instead of alerting forever; a real leak keeps the slope
+    positive and keeps alerting."""
+
+    def __init__(self, metric: str, max_slope_per_step: float,
+                 window: int = 16, severity: str = WARN):
+        if window < 4:
+            raise ValueError(f"slope window must be >= 4, got {window}")
+        self.metric = metric
+        self.max_slope = float(max_slope_per_step)
+        self.window = int(window)
+        self.severity = severity
+        self._pts: List[tuple] = []
+
+    def observe(self, samples, step):
+        v = samples.get(self.metric)
+        if v is None:
+            return []
+        self._pts.append((float(step), float(v)))
+        if len(self._pts) > self.window:
+            self._pts.pop(0)
+        if len(self._pts) < 4:
+            return []
+        n = len(self._pts)
+        mx = sum(p[0] for p in self._pts) / n
+        my = sum(p[1] for p in self._pts) / n
+        den = sum((p[0] - mx) ** 2 for p in self._pts)
+        if den <= 0:
+            return []
+        slope = sum((p[0] - mx) * (p[1] - my)
+                    for p in self._pts) / den
+        if slope <= self.max_slope:
+            return []
+        return [TelemetryAlert(
+            "slope_leak", self.metric, slope, self.max_slope, step,
+            f"{self.metric} climbing {slope:.4g}/step over the last "
+            f"{n} samples (budget {self.max_slope:g}/step)",
+            self.severity)]
+
+
+def default_watchers(anomaly_cfg) -> List[Watcher]:
+    """The always-on set, from the ``telemetry.anomaly`` config block
+    (runtime/config.py TelemetryAnomalyConfig). Any knob set to 0
+    disables its watcher."""
+    ws: List[Watcher] = []
+    f = float(getattr(anomaly_cfg, "step_time_spike_factor", 3.0))
+    if f > 1.0:
+        ws.append(EwmaSpikeWatcher("train/step_time_ms", factor=f))
+    f = float(getattr(anomaly_cfg, "residue_spike_factor", 3.0))
+    if f > 1.0:
+        # the offload overlap-residue regression watch: residue is the
+        # host-step time the device step did NOT hide (ROADMAP item 4)
+        ws.append(EwmaSpikeWatcher("offload/overlap_residue_ms",
+                                   factor=f))
+    ttft = float(getattr(anomaly_cfg, "ttft_slo_ms", 0.0))
+    if ttft > 0:
+        ws.append(ThresholdWatcher("serving/ttft_ms/p50", ttft))
+    itl = float(getattr(anomaly_cfg, "itl_slo_ms", 0.0))
+    if itl > 0:
+        ws.append(ThresholdWatcher("serving/itl_ms/p50", itl))
+    win = int(getattr(anomaly_cfg, "slope_window", 16))
+    rss = float(getattr(anomaly_cfg, "rss_slope_gb_per_step", 0.0))
+    if rss > 0:
+        ws.append(SlopeWatcher("memory/host_rss_gb", rss, window=win))
+    hbm = float(getattr(anomaly_cfg, "hbm_slope_gb_per_step", 0.0))
+    if hbm > 0:
+        ws.append(SlopeWatcher("memory/device_gb_in_use", hbm,
+                               window=win))
+    return ws

@@ -1,0 +1,228 @@
+"""The streaming telemetry hub: one pipe for every report surface.
+
+The stack grew four pull-based report surfaces —
+``get_schedule_report()``, ``get_serving_report()``,
+``get_recovery_report()``, ``get_offload_breakdown()`` — plus the
+process-memory gauges, and nothing sampled them continuously,
+correlated them in time, or alerted on them. The ``TelemetryHub``
+closes that: subsystems register snapshot callables under a namespace;
+``sample(step)`` collects every snapshot, FLATTENS it into one
+``namespace/path/to/scalar`` metric stream, and fans the stream out to
+
+* the existing ``MonitorMaster`` (TensorBoard / W&B / CSV — so v2
+  serving scalars finally reach the monitors that only ever saw
+  training metrics), and
+* a rotating JSONL sink (one sample = one json line, appended with a
+  single O_APPEND write so concurrent processes interleave whole
+  lines, rotated at a byte budget),
+
+then runs the anomaly watchers (telemetry/anomaly.py) over the flat
+sample and records their ``TelemetryAlert``s — into the hub's bounded
+alert log, the JSONL stream (as ``{"kind": "alert", ...}`` records)
+and, when attached, the engine's ``RecoveryReport``.
+
+Flattening rules (the schema tests pin these): dicts recurse with
+``/``-joined keys; numbers/bools become floats; strings and lists are
+skipped (histogram-stat dicts flatten fine; event lists like
+``detections`` stay pull-side). A provider raising never breaks the
+step — it is skipped with a warn-once.
+"""
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+from .anomaly import MAX_ALERT_LOG, TelemetryAlert, Watcher
+
+
+def flatten_metrics(obj, prefix: str = "",
+                    out: Optional[Dict[str, float]] = None
+                    ) -> Dict[str, float]:
+    """Nested report dict -> flat {"a/b/c": float}."""
+    if out is None:
+        out = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            flatten_metrics(v, f"{prefix}/{k}" if prefix else str(k),
+                            out)
+    elif isinstance(obj, bool):
+        out[prefix] = 1.0 if obj else 0.0
+    elif isinstance(obj, (int, float)):
+        out[prefix] = float(obj)
+    # strings, lists, None: not scalar telemetry — skipped
+    return out
+
+
+def memory_snapshot() -> Dict[str, float]:
+    """The compact memory-gauge provider every hub registers by
+    default (GB-scaled; census-free — the live-array walk is too heavy
+    for a per-step stream; soaks call lifecycle.memory_gauges()
+    directly)."""
+    from ..runtime.lifecycle import memory_gauges
+    pm = memory_gauges(include_arrays=False)
+    return {
+        "device_gb_in_use": pm.get("device_bytes_in_use", 0) / 1e9,
+        "device_gb_peak": pm.get("device_peak_bytes", 0) / 1e9,
+        "host_rss_gb": pm.get("host_rss_gb", 0.0),
+        "live_executables": pm.get("live_executables", 0),
+    }
+
+
+class JsonlSink:
+    """Rotating JSONL metric sink. One record per line; each append is
+    a single ``os.write`` on an O_APPEND fd, so a line is written
+    whole (atomic for records under the pipe-buffer bound — flat
+    metric samples are) even with multiple writers on the file.
+    Rotation renames ``path`` -> ``path.1`` (previous ``.1`` dropped)
+    once the active file crosses ``max_bytes`` — a week-long run keeps
+    at most two generations on disk."""
+
+    def __init__(self, path: str, max_bytes: int = 16 << 20):
+        if max_bytes < 1024:
+            raise ValueError(
+                f"jsonl max_bytes must be >= 1KiB, got {max_bytes}")
+        self.path = str(path)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+
+    def write(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(record, separators=(",", ":"),
+                          sort_keys=True) + "\n"
+        data = line.encode()
+        with self._lock:
+            try:
+                if os.path.exists(self.path) and \
+                        os.path.getsize(self.path) + len(data) > \
+                        self.max_bytes:
+                    os.replace(self.path, self.path + ".1")
+            except OSError:
+                pass  # rotation is best-effort; the append is not
+            fd = os.open(self.path,
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                os.write(fd, data)
+            finally:
+                os.close(fd)
+
+    def read_records(self) -> List[dict]:
+        """All records currently on disk (rotated generation first) —
+        a test/debug helper, not a streaming consumer."""
+        out = []
+        for p in (self.path + ".1", self.path):
+            if not os.path.exists(p):
+                continue
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        out.append(json.loads(line))
+        return out
+
+
+class TelemetryHub:
+    """One process's telemetry pipe (engines build one from the
+    ``telemetry`` config block; tests and serving front-ends build
+    their own and ``register``/``attach`` what they have)."""
+
+    def __init__(self, monitor=None, sink: Optional[JsonlSink] = None,
+                 sample_interval_steps: int = 1,
+                 watchers: Optional[List[Watcher]] = None,
+                 recovery=None, clock=time.time):
+        self.monitor = monitor
+        self.sink = sink
+        self.sample_interval_steps = max(1, int(sample_interval_steps))
+        self.watchers: List[Watcher] = list(watchers or [])
+        self.recovery = recovery      # RecoveryReport (note_alert)
+        self._clock = clock
+        self._providers: Dict[str, Callable[[], dict]] = {}
+        self._provider_warned = set()
+        self.alerts: "deque[TelemetryAlert]" = \
+            deque(maxlen=MAX_ALERT_LOG)
+        self.samples_taken = 0
+        self.last_sample: Dict[str, float] = {}
+
+    # -- wiring --------------------------------------------------------
+    def register(self, namespace: str,
+                 provider: Callable[[], dict]) -> None:
+        """Register a snapshot callable; its dict is flattened under
+        ``namespace/``. Re-registering a namespace replaces it (an
+        engine rebuilt after shrink re-attaches over its ancestor)."""
+        if "/" in namespace:
+            raise ValueError(
+                f"namespace must not contain '/', got {namespace!r}")
+        self._providers[namespace] = provider
+
+    def unregister(self, namespace: str) -> None:
+        self._providers.pop(namespace, None)
+
+    @property
+    def namespaces(self):
+        return tuple(self._providers)
+
+    def add_watcher(self, watcher: Watcher) -> None:
+        self.watchers.append(watcher)
+
+    # -- the sampling path ---------------------------------------------
+    def maybe_sample(self, step: int) -> Optional[Dict[str, float]]:
+        """The per-step engine hook: samples every
+        ``sample_interval_steps`` global steps, else returns None."""
+        if step % self.sample_interval_steps != 0:
+            return None
+        return self.sample(step)
+
+    def sample(self, step: int) -> Dict[str, float]:
+        flat: Dict[str, float] = {}
+        for ns, provider in list(self._providers.items()):
+            try:
+                snap = provider()
+            except Exception as e:
+                # observability must never break the step; warn once
+                # per namespace so a hot loop doesn't spam
+                if ns not in self._provider_warned:
+                    self._provider_warned.add(ns)
+                    logger.warning(
+                        f"telemetry provider {ns!r} failed "
+                        f"({type(e).__name__}: {str(e)[:120]}); "
+                        "skipping (warn-once)")
+                continue
+            if isinstance(snap, dict):
+                flatten_metrics(snap, ns, flat)
+        self.samples_taken += 1
+        self.last_sample = flat
+        if self.sink is not None:
+            self.sink.write({"kind": "sample", "step": int(step),
+                             "t": self._clock(), "metrics": flat})
+        if self.monitor is not None and \
+                getattr(self.monitor, "enabled", False):
+            self.monitor.write_events(
+                [(name, value, step) for name, value in flat.items()
+                 if "/caches/" not in name])
+        for w in self.watchers:
+            for alert in w.observe(flat, step):
+                self._note_alert(alert)
+        return flat
+
+    def _note_alert(self, alert: TelemetryAlert) -> None:
+        self.alerts.append(alert)
+        logger.warning(f"telemetry alert: [{alert.severity}] "
+                       f"{alert.kind} {alert.message}")
+        if self.sink is not None:
+            self.sink.write({"kind": "alert", "step": alert.step,
+                             "alert": alert.as_dict()})
+        if self.recovery is not None:
+            try:
+                self.recovery.note_alert(alert)
+            except AttributeError:
+                pass  # pre-alert RecoveryReport (external subclass)
+
+    def alert_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for a in self.alerts:
+            out[a.kind] = out.get(a.kind, 0) + 1
+        return out

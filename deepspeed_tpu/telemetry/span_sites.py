@@ -1,0 +1,80 @@
+"""Central registry of trace-span sites.
+
+Every literal name passed to ``telemetry.trace.span("...")`` MUST be
+declared here — the timeline sibling of
+``resilience/fault_sites.py``: a typo'd span name is a silent hole in
+the observability surface (the tracer records it happily, but every
+dashboard, ``view`` summary and test that filters on the registered
+name sees the site vanish). ``tools/lint_span_sites.py`` statically
+checks every call site in the package against this table (wired into
+the README lint list next to ``lint_fault_sites.py``).
+
+Keys are the span names; values are one-line descriptions of what the
+interval covers (kept here, not in trace.py's docstring, so the
+registry is the single source of truth). Naming convention:
+``<subsystem>.<phase>`` — dots, not slashes (slashes are the metric
+namespace separator in hub.py).
+"""
+
+SPAN_SITES = {
+    # ---- training engine (runtime/engine.py) ----
+    "engine.train_batch":
+        "host wall of one full train step: microbatch split, jitted "
+        "dispatch, offload submit/merge, bookkeeping (the parent span "
+        "every per-step child nests under)",
+    "engine.dispatch":
+        "the jitted/AOT train-step dispatch only (async return — this "
+        "is dispatch latency, not device compute; the gap between "
+        "this span and train_batch's end is the host-side tail)",
+    "checkpoint.save":
+        "engine.save_checkpoint end-to-end (offload flush, host "
+        "payload write, shard save, commit)",
+    "checkpoint.load":
+        "engine.load_checkpoint end-to-end (shard read, rebuffer, "
+        "offload host-state reload, AOT invalidation)",
+    # ---- transfer engine + ZeRO-Offload (runtime/transfer/, zero/offload.py) ----
+    "transfer.d2h":
+        "one fused bucket's device->host wait (args: stream, bucket) "
+        "— the per-bucket download timeline config 4's stall "
+        "decomposition needs",
+    "transfer.h2d":
+        "one fused bucket's host->device put (args: stream, bucket)",
+    "offload.host_step":
+        "the whole offload host step (grad download + host Adam + "
+        "upload staging); in delayed-update mode this runs on the "
+        "WORKER thread, so the trace shows it overlapped (or not) "
+        "against the main thread's engine.train_batch",
+    "offload.adam":
+        "one offloaded slot's host Adam update (args: slot)",
+    # ---- ZeRO-3 schedule layer (runtime/zero/schedule.py) ----
+    "schedule.compile":
+        "AOT lower+compile of one step signature (args: label) — the "
+        "compile spikes a step timeline must be able to attribute",
+    "schedule.step":
+        "one ScheduledStep executable dispatch (args: label; async "
+        "return, same caveat as engine.dispatch)",
+    # ---- v2 serving loop (inference/v2/serving_loop.py) ----
+    "serving.schedule":
+        "one serving iteration's host-side SplitFuse schedule + "
+        "prompt-cursor bookkeeping",
+    "serving.dispatch":
+        "one serving forward dispatch (watchdog + put_sampled/put)",
+    "serving.collect":
+        "the host-side token collect (np.asarray wait on the "
+        "in-flight step; ~0 in lookahead steady state)",
+    # ---- elastic supervisor (elasticity/supervisor.py) ----
+    "supervisor.gate":
+        "the pre-dispatch health gate (one per supervised step)",
+    "supervisor.retry":
+        "retry rung: idle tick + worker health re-check",
+    "supervisor.rollback":
+        "rollback rung: respawn + resume_latest restore",
+    "supervisor.shrink":
+        "shrink rung: survivor rebuild + reshard/restore",
+}
+
+KNOWN_SPANS = tuple(SPAN_SITES)
+
+
+def describe(name: str) -> str:
+    return SPAN_SITES.get(name, "<unregistered span>")

@@ -1,0 +1,294 @@
+"""Low-overhead step-timeline tracer: bounded ring buffer of host
+spans, exported as Chrome-trace-event JSON (Perfetto / chrome://tracing
+loadable).
+
+Why another tracer when xprof exists (profiling/xprof.py): xprof
+captures the DEVICE timeline — XLA ops, HBM — but the questions the
+ROADMAP keeps asking ("is the per-bucket grad d2h overlapped against
+backward compute?", "where does a serving iteration's host time go?")
+are about HOST intervals across threads: the offload worker vs the
+dispatching main thread, the serving loop's schedule/dispatch/collect
+split, a checkpoint restore's tail. This tracer records exactly those:
+
+* ``span("transfer.d2h", stream=si, bucket=k)`` context managers with
+  monotonic clocks (``perf_counter_ns``) and thread ids, recorded into
+  a bounded ring (``deque(maxlen=...)`` — old spans fall off, a
+  week-long process never grows);
+* when tracing is enabled, each span body also runs under
+  ``jax.profiler.TraceAnnotation`` (where available), so an xprof
+  window started around the same steps co-captures the host spans on
+  the device timeline — one Perfetto view with both;
+* ``export()`` writes the Chrome trace-event format; ``python -m
+  deepspeed_tpu.telemetry.view trace.json`` summarizes top spans by
+  self-time.
+
+Disabled (the default) the tracer is a STRICT no-op: ``span()`` is one
+module-global flag check returning a shared, stateless context manager
+— nothing is allocated, nothing is locked, nothing is recorded (the
+perf-marked smoke in tests/unit/telemetry/ holds this to <1% of a
+train-step microbench). Span names are registered in
+``span_sites.py`` (``tools/lint_span_sites.py`` keeps call sites
+honest); the registry is advisory at runtime — an unknown name still
+records, so traces from newer builds degrade gracefully.
+"""
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import logger
+from .span_sites import KNOWN_SPANS  # noqa: F401  (re-exported)
+
+_DEFAULT_CAPACITY = 8192
+
+
+class _SpanRecord:
+    __slots__ = ("name", "t0_ns", "dur_ns", "tid", "args")
+
+    def __init__(self, name, t0_ns, dur_ns, tid, args):
+        self.name = name
+        self.t0_ns = t0_ns
+        self.dur_ns = dur_ns
+        self.tid = tid
+        self.args = args
+
+
+class _NoopSpan:
+    """The disabled path: one shared instance, no state, no effect."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _LiveSpan:
+    __slots__ = ("_tracer", "_name", "_args", "_t0", "_annot", "_gen")
+
+    def __init__(self, tracer, name, args):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+        self._annot = None
+
+    def __enter__(self):
+        t = self._tracer
+        self._gen = t._gen
+        if t._annotation_cls is not None:
+            try:
+                self._annot = t._annotation_cls(self._name)
+                self._annot.__enter__()
+            except Exception:
+                # never let a profiler-version quirk break the step;
+                # host recording still happens
+                t._annotation_cls = None
+                self._annot = None
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter_ns() - self._t0
+        if self._annot is not None:
+            self._annot.__exit__(*exc)
+        t = self._tracer
+        # generation guard: a span still open on another thread (the
+        # DPU offload worker) when the tracer is disabled or cleared
+        # must NOT leak into the next trace window — its t0 predates
+        # the new origin and would export with a negative ts
+        if not t._enabled or t._gen != self._gen:
+            return False
+        t._spans.append(_SpanRecord(
+            self._name, self._t0, dur, threading.get_ident(),
+            self._args or None))
+        t._recorded += 1
+        return False
+
+
+class Tracer:
+    """The process tracer (module singleton ``tracer`` below; tests may
+    build private instances). All configuration goes through
+    ``configure`` so enabling is one atomic flag flip."""
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+        self._enabled = False
+        self._spans: "deque[_SpanRecord]" = deque(maxlen=capacity)
+        self._recorded = 0
+        self._annotation_cls = None
+        self._t_origin_ns = time.perf_counter_ns()
+        self._gen = 0  # bumped by clear(); stales in-flight spans
+
+    # -- configuration -------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @property
+    def capacity(self) -> int:
+        return self._spans.maxlen
+
+    def configure(self, enabled: bool = True,
+                  capacity: Optional[int] = None,
+                  device_annotations: bool = True) -> None:
+        """(Re)configure and arm/disarm. ``capacity`` rebuilds the ring
+        (existing spans kept up to the new bound);
+        ``device_annotations`` wraps each enabled span in
+        ``jax.profiler.TraceAnnotation`` so xprof windows co-capture
+        the host spans."""
+        if capacity is not None and capacity != self._spans.maxlen:
+            if capacity < 1:
+                raise ValueError(
+                    f"tracer capacity must be >= 1, got {capacity}")
+            self._spans = deque(self._spans, maxlen=capacity)
+        self._annotation_cls = None
+        if enabled and device_annotations:
+            try:
+                from jax.profiler import TraceAnnotation
+                self._annotation_cls = TraceAnnotation
+            except Exception:  # ancient jax: host-only tracing
+                logger.warning(
+                    "telemetry.trace: jax.profiler.TraceAnnotation "
+                    "unavailable; device co-capture disabled")
+        self._enabled = bool(enabled)
+
+    def disable(self) -> None:
+        self._enabled = False
+        self._annotation_cls = None
+
+    def clear(self) -> None:
+        self._gen += 1
+        self._spans.clear()
+        self._recorded = 0
+        self._t_origin_ns = time.perf_counter_ns()
+
+    # -- recording -----------------------------------------------------
+    def span(self, name: str, **args):
+        if not self._enabled:
+            return _NOOP
+        return _LiveSpan(self, name, args)
+
+    def instant(self, name: str, **args) -> None:
+        """Zero-duration marker (alerts, lifecycle boundaries)."""
+        if not self._enabled:
+            return
+        self._spans.append(_SpanRecord(
+            name, time.perf_counter_ns(), 0, threading.get_ident(),
+            args or None))
+        self._recorded += 1
+
+    # -- inspection / export -------------------------------------------
+    def __len__(self) -> int:
+        return len(self._spans)
+
+    @property
+    def dropped(self) -> int:
+        """Spans that fell off the ring (recorded - retained)."""
+        return self._recorded - len(self._spans)
+
+    def snapshot(self) -> List[_SpanRecord]:
+        return list(self._spans)
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """The Chrome trace-event JSON object (Perfetto-loadable):
+        complete ("ph": "X") events, microsecond timestamps relative to
+        the tracer origin, pid = this process, tid = recording thread.
+        Zero-duration records export as instant ("ph": "i") events."""
+        pid = os.getpid()
+        events = []
+        for r in self._spans:
+            ev = {
+                "name": r.name,
+                "cat": "host",
+                "ts": (r.t0_ns - self._t_origin_ns) / 1e3,
+                "pid": pid,
+                "tid": r.tid,
+            }
+            if r.dur_ns > 0:
+                ev["ph"] = "X"
+                ev["dur"] = r.dur_ns / 1e3
+            else:
+                ev["ph"] = "i"
+                ev["s"] = "t"
+            if r.args:
+                ev["args"] = {k: (v if isinstance(v, (int, float, bool,
+                                                      str)) else repr(v))
+                              for k, v in r.args.items()}
+            events.append(ev)
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "deepspeed_tpu.telemetry.trace",
+                "spans_recorded": self._recorded,
+                "spans_dropped": self.dropped,
+            },
+        }
+
+    def export(self, path: str) -> str:
+        """Write the Chrome trace JSON atomically (tmp+rename — a
+        crash mid-write must not leave a half trace that Perfetto
+        rejects); returns the path."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        os.replace(tmp, path)
+        return path
+
+
+def validate_chrome_trace(obj) -> List[str]:
+    """Structural validation against the Chrome trace-event format
+    (the subset Perfetto's JSON importer requires). Returns a list of
+    violations — empty means conformant. Used by the telemetry tests;
+    exported so external tooling can gate on it too."""
+    errs = []
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        return ["top level must be an object with 'traceEvents'"]
+    evs = obj["traceEvents"]
+    if not isinstance(evs, list):
+        return ["'traceEvents' must be a list"]
+    for i, ev in enumerate(evs):
+        if not isinstance(ev, dict):
+            errs.append(f"event {i}: not an object")
+            continue
+        for key, types in (("name", str), ("ph", str),
+                           ("ts", (int, float)), ("pid", int),
+                           ("tid", int)):
+            if not isinstance(ev.get(key), types):
+                errs.append(f"event {i}: missing/mistyped {key!r}")
+        ph = ev.get("ph")
+        if ph == "X" and not isinstance(ev.get("dur"), (int, float)):
+            errs.append(f"event {i}: complete event without 'dur'")
+        elif ph not in ("X", "i", "B", "E", "M"):
+            errs.append(f"event {i}: unknown phase {ph!r}")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            errs.append(f"event {i}: 'args' must be an object")
+    return errs
+
+
+# process-wide singleton every instrumented site goes through (the
+# fault_injector pattern); module-level ``span`` is the hot-path entry
+tracer = Tracer()
+
+
+def span(name: str, **args):
+    """The instrumented-site entry point. Disabled: one attribute
+    check, a shared no-op context manager, nothing recorded."""
+    if not tracer._enabled:
+        return _NOOP
+    return _LiveSpan(tracer, name, args)
+
+
+def trace_enabled() -> bool:
+    """Guard for sites whose span ARGUMENTS are expensive to build
+    (everything threaded so far passes cheap ints/strs and does not
+    need it)."""
+    return tracer._enabled

@@ -1,0 +1,113 @@
+"""Trace summary CLI: ``python -m deepspeed_tpu.telemetry.view
+trace.json [--top N] [--by self|total]``.
+
+Reads a Chrome-trace-event JSON (as exported by telemetry/trace.py —
+or any conformant producer) and prints per-span-name aggregates:
+count, total time, and SELF time (total minus the time covered by
+spans nested inside on the same thread — the number that actually
+ranks where wall clock goes; a parent like ``engine.train_batch``
+otherwise dwarfs every child it contains).
+"""
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List
+
+
+def summarize(trace: dict) -> Dict[str, Dict[str, float]]:
+    """{name: {count, total_ms, self_ms, mean_ms, max_ms}} from a
+    Chrome trace object. Nesting is resolved per (pid, tid) with an
+    interval stack over start-sorted complete events; instant events
+    count with zero duration."""
+    by_thread: Dict[tuple, List[dict]] = defaultdict(list)
+    stats: Dict[str, Dict[str, float]] = {}
+
+    def stat(name):
+        return stats.setdefault(name, {
+            "count": 0, "total_ms": 0.0, "self_ms": 0.0,
+            "mean_ms": 0.0, "max_ms": 0.0})
+
+    for ev in trace.get("traceEvents", []):
+        ph = ev.get("ph")
+        if ph == "X":
+            by_thread[(ev.get("pid"), ev.get("tid"))].append(ev)
+        elif ph == "i":
+            s = stat(ev.get("name", "?"))
+            s["count"] += 1
+    for evs in by_thread.values():
+        evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+        stack: List[list] = []   # [end_ts, child_dur_accum, event]
+        for ev in evs:
+            ts, dur = ev["ts"], ev.get("dur", 0.0)
+            while stack and ts >= stack[-1][0] - 1e-9:
+                _close(stack.pop(), stat)
+            if stack:
+                stack[-1][1] += dur
+            stack.append([ts + dur, 0.0, ev])
+        while stack:
+            _close(stack.pop(), stat)
+    for s in stats.values():
+        if s["count"]:
+            s["mean_ms"] = s["total_ms"] / s["count"]
+    return stats
+
+
+def _close(frame, stat):
+    end, child_dur, ev = frame
+    dur_ms = ev.get("dur", 0.0) / 1e3
+    s = stat(ev.get("name", "?"))
+    s["count"] += 1
+    s["total_ms"] += dur_ms
+    s["self_ms"] += max(0.0, dur_ms - child_dur / 1e3)
+    s["max_ms"] = max(s["max_ms"], dur_ms)
+
+
+def render(stats: Dict[str, Dict[str, float]], top: int = 20,
+           by: str = "self") -> str:
+    key = "self_ms" if by == "self" else "total_ms"
+    rows = sorted(stats.items(), key=lambda kv: -kv[1][key])[:top]
+    width = max([len("span")] + [len(n) for n, _ in rows])
+    out = [f"{'span':<{width}}  {'count':>7}  {'self_ms':>10}  "
+           f"{'total_ms':>10}  {'mean_ms':>9}  {'max_ms':>9}"]
+    for name, s in rows:
+        out.append(
+            f"{name:<{width}}  {s['count']:>7.0f}  "
+            f"{s['self_ms']:>10.2f}  {s['total_ms']:>10.2f}  "
+            f"{s['mean_ms']:>9.3f}  {s['max_ms']:>9.2f}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry.view",
+        description="summarize a telemetry trace by span self-time")
+    p.add_argument("trace", help="Chrome-trace-event JSON file")
+    p.add_argument("--top", type=int, default=20)
+    p.add_argument("--by", choices=("self", "total"), default="self")
+    args = p.parse_args(argv)
+    try:
+        with open(args.trace) as f:
+            trace = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read trace {args.trace!r}: {e}",
+              file=sys.stderr)
+        return 2
+    from .trace import validate_chrome_trace
+    errs = validate_chrome_trace(trace)
+    if errs:
+        print(f"warning: {len(errs)} trace-format violation(s), "
+              f"first: {errs[0]}", file=sys.stderr)
+    stats = summarize(trace)
+    meta = trace.get("otherData", {})
+    if meta.get("spans_dropped"):
+        print(f"note: ring dropped {meta['spans_dropped']} spans "
+              f"(raise telemetry.trace.capacity for full windows)",
+              file=sys.stderr)
+    print(render(stats, top=args.top, by=args.by))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,6 +120,8 @@ class TestLadder:
         assert losses[-2:] == ctrl
         sup.close()
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 8): retry success + kill->
+    # rollback stay tier-1; the hang ESCALATION path rides full-suite
     def test_persistent_hang_escalates_to_rollback(self, tmp_path,
                                                    eight_devices):
         """A hang that outlives the retry budget escalates: respawn +
@@ -240,6 +242,9 @@ class TestLadder:
         assert losses[-3:] == ctrl_losses
         sup.close()
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 8): the terminal rung keeps
+    # its cheap stub-engine gate (test_persistent_wedged_barrier_
+    # reaches_terminal); this full-engine drill rides full-suite
     def test_terminal_exit_75_when_nothing_left(self, tmp_path,
                                                 eight_devices):
         """Permanent loss with no engine_factory: the ladder runs dry

@@ -1,0 +1,118 @@
+"""Anomaly watchers (telemetry/anomaly.py): every watcher is a
+deterministic function of the sample series — replaying a series
+replays the alerts."""
+
+import pytest
+
+from deepspeed_tpu.telemetry.anomaly import (EwmaSpikeWatcher,
+                                             SlopeWatcher,
+                                             TelemetryAlert,
+                                             ThresholdWatcher,
+                                             default_watchers)
+
+
+def _feed(w, series, metric):
+    alerts = []
+    for step, v in enumerate(series):
+        alerts.extend(w.observe({metric: v}, step))
+    return alerts
+
+
+class TestEwmaSpike:
+
+    def test_spike_fires_and_baseline_not_poisoned(self):
+        w = EwmaSpikeWatcher("m", factor=3.0, warmup=2)
+        series = [10, 10, 10, 10, 50, 10, 50]
+        alerts = _feed(w, series, "m")
+        # both 50s alert: the first spike must NOT teach the EWMA that
+        # 50 is normal
+        assert [round(a.value) for a in alerts] == [50, 50]
+        a = alerts[0]
+        assert a.kind == "ewma_spike" and a.metric == "m"
+        assert a.step == 4 and a.threshold == pytest.approx(30.0)
+        assert w.spikes == 2
+
+    def test_warmup_is_silent(self):
+        w = EwmaSpikeWatcher("m", factor=2.0, warmup=3)
+        assert _feed(w, [1, 100, 1], "m") == []
+
+    def test_missing_metric_skipped(self):
+        w = EwmaSpikeWatcher("m", factor=2.0)
+        assert w.observe({"other": 1.0}, 0) == []
+
+    def test_factor_validated(self):
+        with pytest.raises(ValueError):
+            EwmaSpikeWatcher("m", factor=1.0)
+
+    def test_replay_identity(self):
+        series = [5, 5, 6, 5, 40, 5, 5, 41]
+        a = _feed(EwmaSpikeWatcher("m", factor=3.0), series, "m")
+        b = _feed(EwmaSpikeWatcher("m", factor=3.0), series, "m")
+        assert [x.as_dict() for x in a] == [x.as_dict() for x in b]
+
+
+class TestThreshold:
+
+    def test_slo_breach_counter(self):
+        w = ThresholdWatcher("serving/ttft_ms/p50", max_value=100.0)
+        alerts = _feed(w, [50, 150, 80, 200], "serving/ttft_ms/p50")
+        assert len(alerts) == 2
+        assert w.breaches == 2
+        assert alerts[0].kind == "slo_breach"
+        assert "breach #1" in alerts[0].message
+        assert "breach #2" in alerts[1].message
+
+
+class TestSlope:
+
+    def test_leak_alerts_and_plateau_ages_out(self):
+        w = SlopeWatcher("memory/host_rss_gb",
+                         max_slope_per_step=0.01, window=8)
+        climb = [1.0 + 0.1 * i for i in range(8)]      # 0.1 GB/step
+        alerts = _feed(w, climb, "memory/host_rss_gb")
+        assert alerts and alerts[-1].kind == "slope_leak"
+        assert alerts[-1].value == pytest.approx(0.1)
+        # plateau: the window slides past the climb, slope drops, no
+        # further alerts — a one-off jump must not alert forever
+        flat_alerts = []
+        for step in range(8, 24):
+            flat_alerts.extend(
+                w.observe({"memory/host_rss_gb": 1.8}, step))
+        assert flat_alerts[-1:] == [] or len(flat_alerts) < 8
+
+    def test_needs_four_points(self):
+        w = SlopeWatcher("m", max_slope_per_step=0.0, window=8)
+        assert _feed(w, [1, 2, 3], "m") == []
+        with pytest.raises(ValueError):
+            SlopeWatcher("m", 0.1, window=2)
+
+
+class TestDefaults:
+
+    def test_default_watchers_from_config(self):
+        from deepspeed_tpu.runtime.config import TelemetryAnomalyConfig
+        cfg = TelemetryAnomalyConfig.from_dict({
+            "ttft_slo_ms": 500, "itl_slo_ms": 50,
+            "rss_slope_gb_per_step": 0.05,
+            "hbm_slope_gb_per_step": 0.1})
+        ws = default_watchers(cfg)
+        metrics = {getattr(w, "metric") for w in ws}
+        assert metrics == {
+            "train/step_time_ms", "offload/overlap_residue_ms",
+            "serving/ttft_ms/p50", "serving/itl_ms/p50",
+            "memory/host_rss_gb", "memory/device_gb_in_use"}
+
+    def test_zeros_disable(self):
+        from deepspeed_tpu.runtime.config import TelemetryAnomalyConfig
+        cfg = TelemetryAnomalyConfig.from_dict({
+            "step_time_spike_factor": 0,
+            "residue_spike_factor": 0})
+        assert default_watchers(cfg) == []
+
+    def test_alert_is_flat_jsonable(self):
+        import json
+        a = TelemetryAlert("ewma_spike", "m", 1.0, 2.0, 3, "msg")
+        d = a.as_dict()
+        assert set(d) == {"kind", "metric", "value", "threshold",
+                          "step", "message", "severity"}
+        json.dumps(d)

@@ -1,0 +1,62 @@
+"""bench.py's per-config telemetry artifacts: the traced step exports
+a conformant Chrome trace, the hub sample lands in the JSONL sink,
+and the row block carries the span census + artifact paths."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.telemetry import validate_chrome_trace
+from deepspeed_tpu.telemetry.trace import span, tracer
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "..", "..", "..")
+
+
+@pytest.fixture()
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    yield mod
+    tracer.disable()
+    tracer.clear()
+
+
+def test_artifacts_block(bench, tmp_path, monkeypatch):
+    monkeypatch.setenv("DSTPU_TRACE_DIR", str(tmp_path))
+
+    def traced():
+        with span("engine.train_batch", step=0):
+            with span("engine.dispatch"):
+                pass
+
+    block = bench._telemetry_artifacts(
+        "cfgX", {"train": lambda: {"loss": 2.0},
+                 "memory": lambda: {"host_rss_gb": 1.0}},
+        traced_fn=traced, step=7)
+    # trace artifact: on disk, conformant, censused in the row
+    with open(block["trace"]) as f:
+        assert validate_chrome_trace(json.load(f)) == []
+    assert block["spans"]["engine.dispatch"]["count"] == 1
+    # hub sample: one record in the jsonl beside it
+    with open(block["jsonl"]) as f:
+        (rec,) = [json.loads(line) for line in f]
+    assert rec["step"] == 7
+    assert rec["metrics"] == {"train/loss": 2.0,
+                              "memory/host_rss_gb": 1.0}
+    assert block["metrics_sampled"] == 2
+    assert block["namespaces"] == ["memory", "train"]
+    # the tracer is disarmed afterwards (bench timing must not pay)
+    assert not tracer.enabled
+
+
+def test_no_traced_fn_still_samples(bench, tmp_path, monkeypatch):
+    monkeypatch.setenv("DSTPU_TRACE_DIR", str(tmp_path))
+    block = bench._telemetry_artifacts(
+        "cfgY", {"a": lambda: {"x": 1}})
+    assert "trace" not in block
+    assert os.path.exists(block["jsonl"])

@@ -1,0 +1,176 @@
+"""TelemetryHub (telemetry/hub.py): flattening rules, the JSONL sink
+record schema + rotation + whole-line appends, MonitorMaster fan-out
+(the v2-serving-scalars satellite), provider isolation, and sampling
+cadence."""
+
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.telemetry.anomaly import EwmaSpikeWatcher
+from deepspeed_tpu.telemetry.hub import (JsonlSink, TelemetryHub,
+                                         flatten_metrics,
+                                         memory_snapshot)
+
+
+class TestFlatten:
+
+    def test_rules(self):
+        flat = flatten_metrics({
+            "a": {"b": 1, "c": 2.5, "d": {"e": True}},
+            "s": "skipped",
+            "l": [1, 2, 3],
+            "n": None,
+            "f": False,
+        })
+        assert flat == {"a/b": 1.0, "a/c": 2.5, "a/d/e": 1.0,
+                        "f": 0.0}
+
+    def test_namespace_prefix(self):
+        assert flatten_metrics({"x": 1}, "serving") == {"serving/x": 1.0}
+
+
+class TestJsonlSink:
+
+    def test_record_schema_and_whole_lines(self, tmp_path):
+        sink = JsonlSink(str(tmp_path / "m.jsonl"))
+        hub = TelemetryHub(sink=sink)
+        hub.register("train", lambda: {"loss": 1.25, "step_time_ms": 3})
+        hub.sample(7)
+        recs = sink.read_records()
+        assert len(recs) == 1
+        r = recs[0]
+        # the stable record schema (consumers parse these keys)
+        assert set(r) == {"kind", "step", "t", "metrics"}
+        assert r["kind"] == "sample" and r["step"] == 7
+        assert r["metrics"] == {"train/loss": 1.25,
+                                "train/step_time_ms": 3.0}
+        # every line on disk parses independently (whole-line appends)
+        with open(sink.path) as f:
+            for line in f:
+                json.loads(line)
+
+    def test_rotation_bounds_disk(self, tmp_path):
+        sink = JsonlSink(str(tmp_path / "m.jsonl"), max_bytes=2048)
+        hub = TelemetryHub(sink=sink)
+        hub.register("pad", lambda: {f"k{i}": i for i in range(40)})
+        for i in range(50):
+            hub.sample(i)
+        assert os.path.getsize(sink.path) <= 2048 + 1024
+        assert os.path.exists(sink.path + ".1")
+        # nothing beyond two generations
+        assert not os.path.exists(sink.path + ".2")
+        # records survive rotation and still parse
+        assert len(sink.read_records()) > 2
+
+    def test_min_size_validates(self, tmp_path):
+        with pytest.raises(ValueError):
+            JsonlSink(str(tmp_path / "m.jsonl"), max_bytes=16)
+
+
+class TestMonitorFanout:
+
+    def test_serving_scalars_reach_csv_monitor(self, tmp_path):
+        """THE satellite: v2 serving scalars flow through the hub into
+        MonitorMaster's csv backend — historically _write_monitor only
+        ever saw training metrics."""
+        import dataclasses
+
+        from deepspeed_tpu.monitor.monitor import csvMonitor
+
+        @dataclasses.dataclass
+        class CsvCfg:
+            enabled: bool = True
+            output_path: str = str(tmp_path)
+            job_name: str = "job"
+
+        mon = csvMonitor(CsvCfg())
+        hub = TelemetryHub(monitor=mon)
+        hub.register("serving", lambda: {
+            "itl_ms": {"p50": 4.2}, "kv_util": {"max": 0.8},
+            "recompiles": 1,
+            "caches": {"noise": {"size": 3}},
+        })
+        hub.sample(5)
+        written = os.listdir(os.path.join(str(tmp_path), "job"))
+        assert "serving_itl_ms_p50.csv" in written
+        assert "serving_recompiles.csv" in written
+        # cache internals are filtered from the monitor fan-out
+        assert not any("caches" in f for f in written)
+        with open(os.path.join(str(tmp_path), "job",
+                               "serving_itl_ms_p50.csv")) as f:
+            rows = f.read().splitlines()
+        assert rows[-1] == "5,4.2"
+
+    def test_disabled_monitor_not_written(self):
+        class Mon:
+            enabled = False
+            calls = 0
+
+            def write_events(self, evs):
+                self.calls += 1
+
+        mon = Mon()
+        hub = TelemetryHub(monitor=mon)
+        hub.register("a", lambda: {"x": 1})
+        hub.sample(0)
+        assert mon.calls == 0
+
+
+class TestHubBehavior:
+
+    def test_provider_failure_is_isolated(self):
+        hub = TelemetryHub()
+        hub.register("bad", lambda: 1 / 0)
+        hub.register("good", lambda: {"x": 1})
+        flat = hub.sample(0)
+        assert flat == {"good/x": 1.0}
+        # and again without spamming (warn-once path)
+        assert hub.sample(1) == {"good/x": 1.0}
+
+    def test_sample_interval(self):
+        hub = TelemetryHub(sample_interval_steps=5)
+        hub.register("a", lambda: {"x": 1})
+        assert hub.maybe_sample(3) is None
+        assert hub.maybe_sample(5) == {"a/x": 1.0}
+        assert hub.samples_taken == 1
+
+    def test_reregister_replaces_and_namespace_validated(self):
+        hub = TelemetryHub()
+        hub.register("a", lambda: {"x": 1})
+        hub.register("a", lambda: {"x": 2})
+        assert hub.sample(0) == {"a/x": 2.0}
+        with pytest.raises(ValueError):
+            hub.register("a/b", lambda: {})
+        hub.unregister("a")
+        assert hub.sample(1) == {}
+
+    def test_alerts_ride_sink_and_recovery_report(self, tmp_path):
+        from deepspeed_tpu.resilience.recovery import RecoveryReport
+
+        rec = RecoveryReport()
+        sink = JsonlSink(str(tmp_path / "m.jsonl"))
+        hub = TelemetryHub(
+            sink=sink, recovery=rec,
+            watchers=[EwmaSpikeWatcher("a/x", factor=2.0, warmup=1)])
+        vals = iter([10.0, 10.0, 10.0, 100.0])
+        hub.register("a", lambda: {"x": next(vals)})
+        for i in range(4):
+            hub.sample(i)
+        assert len(hub.alerts) == 1
+        assert hub.alert_counts() == {"ewma_spike": 1}
+        alert_recs = [r for r in sink.read_records()
+                      if r["kind"] == "alert"]
+        assert len(alert_recs) == 1
+        assert alert_recs[0]["alert"]["metric"] == "a/x"
+        # the recovery report carries it too
+        assert rec.as_dict()["alert_count"] == 1
+        assert rec.as_dict()["alerts"][0]["kind"] == "ewma_spike"
+
+
+def test_memory_snapshot_schema():
+    snap = memory_snapshot()
+    assert set(snap) == {"device_gb_in_use", "device_gb_peak",
+                         "host_rss_gb", "live_executables"}
+    assert snap["host_rss_gb"] > 0

@@ -1,0 +1,202 @@
+"""Span tracer (telemetry/trace.py): recording semantics, the ring
+bound, the strict disabled no-op, Chrome-trace-format conformance,
+and the view CLI's self-time decomposition."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.telemetry.trace import (Tracer, span, tracer,
+                                           validate_chrome_trace)
+from deepspeed_tpu.telemetry.span_sites import SPAN_SITES
+from deepspeed_tpu.telemetry import view
+
+
+@pytest.fixture(autouse=True)
+def _clean_singleton():
+    """The module singleton must never leak an armed state into other
+    tests (the engine suite asserts the disabled path is free)."""
+    yield
+    tracer.disable()
+    tracer.clear()
+
+
+class TestRecording:
+
+    def test_span_records_name_duration_thread(self):
+        t = Tracer(capacity=16)
+        t.configure(enabled=True, device_annotations=False)
+        with t.span("engine.dispatch", label="train"):
+            time.sleep(0.002)
+        recs = t.snapshot()
+        assert len(recs) == 1
+        r = recs[0]
+        assert r.name == "engine.dispatch"
+        assert r.dur_ns >= 2e6
+        assert r.tid == threading.get_ident()
+        assert r.args == {"label": "train"}
+
+    def test_nesting_and_threads_recorded_independently(self):
+        t = Tracer(capacity=64)
+        t.configure(enabled=True, device_annotations=False)
+
+        def worker():
+            with t.span("offload.host_step"):
+                time.sleep(0.001)
+
+        th = threading.Thread(target=worker)
+        with t.span("engine.train_batch"):
+            th.start()
+            with t.span("engine.dispatch"):
+                time.sleep(0.001)
+            th.join()
+        names = {r.name for r in t.snapshot()}
+        tids = {r.tid for r in t.snapshot()}
+        assert names == {"engine.train_batch", "engine.dispatch",
+                         "offload.host_step"}
+        assert len(tids) == 2
+
+    def test_ring_is_bounded_and_counts_drops(self):
+        t = Tracer(capacity=8)
+        t.configure(enabled=True, device_annotations=False)
+        for i in range(20):
+            with t.span("schedule.step", i=i):
+                pass
+        assert len(t) == 8
+        assert t.dropped == 12
+        # the ring keeps the NEWEST spans
+        assert [r.args["i"] for r in t.snapshot()] == list(range(12, 20))
+
+    def test_exception_inside_span_still_records(self):
+        t = Tracer(capacity=8)
+        t.configure(enabled=True, device_annotations=False)
+        with pytest.raises(RuntimeError):
+            with t.span("checkpoint.save"):
+                raise RuntimeError("boom")
+        assert [r.name for r in t.snapshot()] == ["checkpoint.save"]
+
+    def test_instant_marker(self):
+        t = Tracer(capacity=8)
+        t.configure(enabled=True, device_annotations=False)
+        t.instant("supervisor.gate", step=3)
+        (r,) = t.snapshot()
+        assert r.dur_ns == 0
+
+    def test_span_open_across_clear_does_not_leak(self):
+        """A span still open when the window is cleared (the DPU
+        worker's offload.host_step outliving a bench config's traced
+        step) must not land in the NEXT window — its t0 predates the
+        new origin and would export with a negative ts."""
+        t = Tracer(capacity=8)
+        t.configure(enabled=True, device_annotations=False)
+        stale = t.span("offload.host_step")
+        stale.__enter__()
+        t.clear()                     # new window begins
+        with t.span("engine.dispatch"):
+            pass
+        stale.__exit__(None, None, None)
+        assert [r.name for r in t.snapshot()] == ["engine.dispatch"]
+        # and a span open across disable() records nothing either
+        stale2 = t.span("offload.host_step")
+        stale2.__enter__()
+        t.disable()
+        stale2.__exit__(None, None, None)
+        assert [r.name for r in t.snapshot()] == ["engine.dispatch"]
+
+
+class TestDisabledPath:
+
+    def test_disabled_records_nothing(self):
+        assert not tracer.enabled
+        with span("engine.train_batch", step=1):
+            with span("engine.dispatch"):
+                pass
+        assert len(tracer) == 0
+
+    def test_disabled_returns_shared_noop(self):
+        a = span("engine.dispatch")
+        b = span("transfer.d2h", stream=0, bucket=1)
+        assert a is b  # one stateless instance, nothing allocated
+
+    def test_configure_capacity_validates(self):
+        t = Tracer()
+        with pytest.raises(ValueError):
+            t.configure(enabled=True, capacity=0)
+
+
+class TestChromeExport:
+
+    def _populated(self):
+        t = Tracer(capacity=32)
+        t.configure(enabled=True, device_annotations=False)
+        with t.span("engine.train_batch", step=2):
+            with t.span("transfer.d2h", stream=0, bucket=0):
+                time.sleep(0.001)
+        t.instant("alert")
+        return t
+
+    def test_export_is_conformant_and_loadable(self, tmp_path):
+        t = self._populated()
+        path = t.export(str(tmp_path / "trace.json"))
+        with open(path) as f:
+            obj = json.load(f)
+        assert validate_chrome_trace(obj) == []
+        evs = obj["traceEvents"]
+        assert {e["name"] for e in evs} == {
+            "engine.train_batch", "transfer.d2h", "alert"}
+        x = [e for e in evs if e["ph"] == "X"]
+        assert all("dur" in e for e in x)
+        d2h = next(e for e in evs if e["name"] == "transfer.d2h")
+        assert d2h["args"] == {"stream": 0, "bucket": 0}
+        # child nests inside parent on the timeline
+        parent = next(e for e in evs
+                      if e["name"] == "engine.train_batch")
+        assert parent["ts"] <= d2h["ts"]
+        assert parent["ts"] + parent["dur"] >= d2h["ts"] + d2h["dur"]
+
+    def test_validator_rejects_malformed(self):
+        assert validate_chrome_trace([]) != []
+        assert validate_chrome_trace({"traceEvents": [{"ph": "X"}]})
+        bad = {"traceEvents": [{"name": "a", "ph": "X", "ts": 0.0,
+                                "pid": 1, "tid": 1}]}  # no dur
+        assert any("dur" in e for e in validate_chrome_trace(bad))
+
+    def test_view_summarize_self_time(self, tmp_path):
+        t = self._populated()
+        stats = view.summarize(t.to_chrome_trace())
+        tb = stats["engine.train_batch"]
+        d2h = stats["transfer.d2h"]
+        assert tb["count"] == 1 and d2h["count"] == 1
+        # parent self-time excludes the nested child
+        assert tb["self_ms"] <= tb["total_ms"] - d2h["total_ms"] + 1e-6
+        out = view.render(stats, top=5)
+        assert "transfer.d2h" in out
+
+    def test_view_cli_main(self, tmp_path, capsys):
+        t = self._populated()
+        path = t.export(str(tmp_path / "t.json"))
+        assert view.main([path, "--top", "3"]) == 0
+        assert "engine.train_batch" in capsys.readouterr().out
+        assert view.main([str(tmp_path / "missing.json")]) == 2
+
+
+class TestDeviceAnnotations:
+
+    def test_trace_annotation_co_capture_smoke(self):
+        """device_annotations=True wraps the span in
+        jax.profiler.TraceAnnotation (the xprof co-capture seam);
+        recording must still work with it armed."""
+        t = Tracer(capacity=8)
+        t.configure(enabled=True, device_annotations=True)
+        with t.span("schedule.compile", label="x"):
+            pass
+        assert len(t) == 1
+
+
+def test_every_registered_span_name_is_dotted():
+    """Naming contract: dots, never slashes (slash is the hub's
+    namespace separator)."""
+    for name in SPAN_SITES:
+        assert "/" not in name and "." in name

@@ -1,0 +1,121 @@
+"""tools/bench_compare.py: the bench regression gate — artifact-shape
+handling, threshold semantics (global + per-config), required-config
+enforcement, and the CI exit-code contract."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "..",
+    "tools"))
+from bench_compare import (compare, load_configs, main,  # noqa: E402
+                           parse_per_config)
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "..", "..", "..")
+
+
+def _artifact(tmp_path, name, configs, wrapped=False):
+    head = {"metric": "m", "configs": configs}
+    doc = {"n": 1, "rc": 0, "parsed": head} if wrapped else head
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def _row(vs):
+    return {"metric": "m", "value": 1.0, "vs_baseline": vs}
+
+
+class TestLoad:
+
+    def test_both_artifact_shapes(self, tmp_path):
+        raw = _artifact(tmp_path, "raw.json", {"1": _row(1.0)})
+        wrapped = _artifact(tmp_path, "wr.json", {"1": _row(1.0)},
+                            wrapped=True)
+        assert load_configs(raw) == load_configs(wrapped)
+
+    def test_checked_in_artifacts_load(self):
+        cfgs = load_configs(os.path.join(REPO, "BENCH_r05.json"))
+        assert "1" in cfgs and "4" in cfgs
+
+    def test_garbage_rejected(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text("[1, 2]")
+        with pytest.raises(ValueError):
+            load_configs(str(p))
+
+
+class TestCompare:
+
+    def test_within_threshold_ok(self):
+        rows, reg, miss = compare({"1": _row(1.0)},
+                                  {"1": _row(0.95)}, 0.10, {}, set())
+        assert reg == [] and miss == []
+        assert rows[0]["status"] == "ok"
+        assert rows[0]["delta"] == pytest.approx(-0.05)
+
+    def test_regression_detected(self):
+        _, reg, _ = compare({"1": _row(1.0)}, {"1": _row(0.85)},
+                            0.10, {}, set())
+        assert reg == ["1"]
+
+    def test_per_config_threshold_overrides(self):
+        # config 4's session band is wider than the scored rows'
+        _, reg, _ = compare({"4": _row(0.58)}, {"4": _row(0.45)},
+                            0.10, {"4": 0.30}, set())
+        assert reg == []
+        assert parse_per_config("4=0.3,5_int4=0.5") == {
+            "4": 0.3, "5_int4": 0.5}
+        with pytest.raises(ValueError):
+            parse_per_config("4:0.3")
+
+    def test_missing_config_skipped_unless_required(self):
+        rows, reg, miss = compare({"1": _row(1.0)},
+                                  {"1": _row(1.0),
+                                   "6": {"metric": "mttr",
+                                         "value": 0.2}},
+                                  0.10, {}, set())
+        assert reg == [] and miss == []
+        assert [r["status"] for r in rows] == ["ok", "skipped"]
+        _, _, miss = compare({}, {"1": _row(1.0)}, 0.10, {}, {"1"})
+        assert miss == ["1"]
+
+
+class TestCLI:
+
+    def test_exit_codes(self, tmp_path, capsys):
+        old = _artifact(tmp_path, "old.json",
+                        {"1": _row(1.0), "2": _row(1.0)})
+        good = _artifact(tmp_path, "good.json",
+                         {"1": _row(1.05), "2": _row(0.99)})
+        bad = _artifact(tmp_path, "bad.json",
+                        {"1": _row(0.5), "2": _row(1.0)})
+        assert main([old, good]) == 0
+        assert "bench gate clean" in capsys.readouterr().out
+        assert main([old, bad]) == 1
+        assert "REGRESSION" in capsys.readouterr().out
+        assert main([old, good, "--require", "9"]) == 1
+        assert main([str(tmp_path / "nope.json"), good]) == 2
+
+    def test_json_output_parses(self, tmp_path, capsys):
+        old = _artifact(tmp_path, "o.json", {"1": _row(1.0)})
+        new = _artifact(tmp_path, "n.json", {"1": _row(0.5)})
+        assert main([old, new, "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["regressions"] == ["1"]
+
+    def test_real_artifacts_via_subprocess(self):
+        """The README workflow end-to-end on the checked-in bench
+        history (r04 -> r05 improved everywhere)."""
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "bench_compare.py"),
+             os.path.join(REPO, "BENCH_r04.json"),
+             os.path.join(REPO, "BENCH_r05.json")],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,96 @@
+"""tools/lint_span_sites.py: typo'd span names at ``span(...)`` /
+``tracer.span(...)`` calls are flagged against the registry,
+annotated non-literal names pass, and the shipped package is clean
+under the lint."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "..",
+    "tools"))
+from lint_span_sites import scan_file  # noqa: E402
+
+from deepspeed_tpu.telemetry.span_sites import SPAN_SITES
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "..", "..", "..")
+
+
+def _scan(tmp_path, src, registry=frozenset(SPAN_SITES)):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(src))
+    violations, used = scan_file(str(p), registry)
+    return violations, used
+
+
+def test_registered_literal_span_passes(tmp_path):
+    v, used = _scan(tmp_path, """
+        from deepspeed_tpu.telemetry.trace import span, tracer
+
+        def step():
+            with span("engine.dispatch"):
+                pass
+            with tracer.span("transfer.d2h", stream=0, bucket=1):
+                pass
+            tracer.instant("supervisor.gate")
+    """)
+    assert v == []
+    assert used == {"engine.dispatch", "transfer.d2h",
+                    "supervisor.gate"}
+
+
+def test_typoed_span_flagged(tmp_path):
+    """The failure class this lint exists for: the tracer records
+    'transfer.dh2' happily and every consumer filtering on the
+    registered name silently loses the site."""
+    v, _ = _scan(tmp_path, """
+        from deepspeed_tpu.telemetry.trace import span
+
+        def step():
+            with span("transfer.dh2"):
+                pass
+    """)
+    assert len(v) == 1 and "transfer.dh2" in v[0][2]
+
+
+def test_non_literal_span_needs_annotation(tmp_path):
+    v, _ = _scan(tmp_path, """
+        from deepspeed_tpu.telemetry.trace import span
+
+        def step(name):
+            with span(name):
+                pass
+    """)
+    assert len(v) == 1 and "non-literal" in v[0][2]
+    v, _ = _scan(tmp_path, """
+        from deepspeed_tpu.telemetry.trace import span
+
+        def step(name):
+            with span(name):  # span-site-ok: closed over KNOWN_SPANS
+                pass
+    """)
+    assert v == []
+
+
+def test_unrelated_span_methods_ignored(tmp_path):
+    """Only tracer-ish receivers count — a bs4/soup-style ``.span``
+    call must not trip the lint."""
+    v, used = _scan(tmp_path, """
+        def render(doc):
+            return doc.span("not-a-trace-site")
+    """)
+    assert v == [] and used == set()
+
+
+def test_shipped_package_is_clean():
+    """Every literal span name in deepspeed_tpu/ is registered, and
+    the CLI exits 0 (the README lint-list contract)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "lint_span_sites.py")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "span-site lint clean" in proc.stdout

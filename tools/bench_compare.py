@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Bench regression gate: diff two bench artifacts with per-config
+thresholds and a CI-friendly exit code.
+
+The bench trajectory (BENCH_r01 -> r05: config 1 at 1.07x, config 4
+stuck at 0.58x, ...) has been eyeballed across PR descriptions; this
+tool makes "did this PR regress a tracked config" a command:
+
+    python tools/bench_compare.py BENCH_r05.json BENCH_r06.json
+    python tools/bench_compare.py old.json new.json \\
+        --threshold 0.10 --per-config 4=0.25,5_int4=0.30 \\
+        --require 1,3,4
+
+Accepts both artifact shapes: the raw bench head (``bench.py``'s JSON
+line, configs under ``"configs"``) and the driver wrapper
+(``{"parsed": <head>, ...}`` as the checked-in BENCH_r*.json are).
+
+Comparison metric: ``vs_baseline`` — the one field that is
+higher-is-better for EVERY tracked config (throughput rows normalize
+MFU, serving rows normalize decode tok/s), where raw ``value`` flips
+direction per config (tokens/s up vs TTFT/MTTR down). A config is a
+REGRESSION when ``new < old * (1 - threshold)``; configs missing from
+either side, skipped, errored, or without ``vs_baseline`` are
+reported but only fail the gate when named in ``--require``.
+
+Exit codes: 0 = clean, 1 = regression (or a required config missing/
+unparseable), 2 = usage/artifact error.
+"""
+
+import argparse
+import json
+import sys
+
+
+def load_configs(path):
+    """-> {config_key: row_dict} from either artifact shape."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a bench artifact (expected an "
+                         "object)")
+    configs = doc.get("configs")
+    if isinstance(configs, dict) and configs:
+        return configs
+    # single-config artifact (bench.py --config N prints one row)
+    if "metric" in doc:
+        return {"_single": doc}
+    raise ValueError(f"{path}: no 'configs' table and no bench row")
+
+
+def parse_per_config(text):
+    out = {}
+    if not text:
+        return out
+    for entry in text.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        key, sep, val = entry.partition("=")
+        if not sep:
+            raise ValueError(
+                f"bad --per-config entry {entry!r} (want key=frac)")
+        out[key.strip()] = float(val)
+    return out
+
+
+def compare(old, new, threshold, per_config, require):
+    """-> (rows, regressions, missing_required); each row is a dict
+    for the report table."""
+    rows, regressions, missing = [], [], []
+    # required configs absent from BOTH sides must still surface (a
+    # gate that silently passes when the scored row vanished from the
+    # artifacts entirely is no gate)
+    keys = sorted(set(old) | set(new) | set(require), key=str)
+    for key in keys:
+        o, n = old.get(key), new.get(key)
+        thr = per_config.get(key, threshold)
+        row = {"config": key, "threshold": thr}
+        ob = (o or {}).get("vs_baseline")
+        nb = (n or {}).get("vs_baseline")
+        if o is None or n is None or ob is None or nb is None:
+            why = ("absent from old" if o is None else
+                   "absent from new" if n is None else
+                   (o if ob is None else n).get("skipped")
+                   or (o if ob is None else n).get("error", "")[:60]
+                   or "no vs_baseline")
+            row.update(status="skipped", note=str(why))
+            if key in require:
+                missing.append(key)
+                row["status"] = "MISSING-REQUIRED"
+        else:
+            ob, nb = float(ob), float(nb)
+            delta = (nb - ob) / ob if ob else 0.0
+            regressed = nb < ob * (1.0 - thr)
+            row.update(old=ob, new=nb, delta=delta,
+                       status="REGRESSION" if regressed else "ok",
+                       metric=(n.get("metric") or ""))
+            if regressed:
+                regressions.append(key)
+        rows.append(row)
+    return rows, regressions, missing
+
+
+def render(rows):
+    out = [f"{'config':<12} {'old':>9} {'new':>9} {'delta':>8} "
+           f"{'thr':>6}  status"]
+    for r in rows:
+        if "old" in r:
+            out.append(
+                f"{r['config']:<12} {r['old']:>9.4f} {r['new']:>9.4f} "
+                f"{r['delta']:>+7.1%} {r['threshold']:>6.0%}  "
+                f"{r['status']}")
+        else:
+            out.append(f"{r['config']:<12} {'-':>9} {'-':>9} {'-':>8} "
+                       f"{r['threshold']:>6.0%}  {r['status']} "
+                       f"({r.get('note', '')})")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python tools/bench_compare.py",
+        description="diff two bench artifacts; exit 1 on regression")
+    p.add_argument("old")
+    p.add_argument("new")
+    p.add_argument("--threshold", type=float, default=0.10,
+                   help="allowed vs_baseline drop fraction "
+                        "(default 0.10)")
+    p.add_argument("--per-config", default="",
+                   help="per-config overrides, e.g. '4=0.25,5=0.3'")
+    p.add_argument("--require", default="",
+                   help="comma list of configs that MUST be "
+                        "comparable (else exit 1)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the comparison as one JSON line")
+    args = p.parse_args(argv)
+    try:
+        old = load_configs(args.old)
+        new = load_configs(args.new)
+        per_config = parse_per_config(args.per_config)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+    require = {k.strip() for k in args.require.split(",") if k.strip()}
+    rows, regressions, missing = compare(
+        old, new, args.threshold, per_config, require)
+    if args.json:
+        print(json.dumps({"rows": rows, "regressions": regressions,
+                          "missing_required": missing}))
+    else:
+        print(render(rows))
+        if regressions:
+            print(f"\nREGRESSION in config(s): "
+                  f"{', '.join(regressions)}")
+        if missing:
+            print(f"required config(s) not comparable: "
+                  f"{', '.join(sorted(missing))}")
+        if not regressions and not missing:
+            print("\nbench gate clean")
+    return 1 if (regressions or missing) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Repo lint: every trace-span site string must be registered.
+
+The timeline sibling of ``lint_fault_sites.py``: a typo'd name passed
+to ``telemetry.trace.span("...")`` records fine at runtime (unknown
+names degrade gracefully, by design), but every consumer that filters
+on the REGISTERED name — the ``view`` CLI groupings, dashboards, the
+tests that assert "per-bucket d2h spans exist" — silently loses the
+site. This lint closes the loop statically:
+
+* every literal name at a ``span(...)`` / ``tracer.span(...)`` /
+  ``tracer.instant(...)`` call in ``deepspeed_tpu/`` must be declared
+  in ``deepspeed_tpu/telemetry/span_sites.py:SPAN_SITES``;
+* non-literal name arguments (computed strings) must carry a
+  ``# span-site-ok: <why>`` annotation on the call line;
+* registry entries no site ever opens are reported as warnings
+  (dead registry entries hide the reverse typo) — warnings don't
+  fail the lint, because tests may open a span directly.
+
+Usage: python tools/lint_span_sites.py [root_dir]
+Exit code 0 = clean, 1 = violations found.
+"""
+
+import ast
+import os
+import sys
+
+_ANNOTATION = "# span-site-ok:"
+# call shapes that open spans: the module-level ``span(...)`` (the
+# threaded import), and ``<tracer-ish>.span(...)`` / ``.instant(...)``
+_METHOD_NAMES = ("span", "instant")
+
+
+def _iter_py(root):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for f in filenames:
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def _is_span_call(node):
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "span"
+    if isinstance(fn, ast.Attribute) and fn.attr in _METHOD_NAMES:
+        recv = fn.value
+        name = None
+        if isinstance(recv, ast.Name):
+            name = recv.id
+        elif isinstance(recv, ast.Attribute):
+            name = recv.attr
+        return name is not None and "trace" in name.lower()
+    return False
+
+
+def scan_file(path, registry):
+    """-> (violations, used_sites)"""
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, f"syntax error: {e.msg}")], set()
+    lines = src.splitlines()
+    violations, used = [], set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_span_call(node):
+            continue
+        if not node.args:
+            continue
+        name_arg = node.args[0]
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) \
+            else ""
+        if isinstance(name_arg, ast.Constant) and \
+                isinstance(name_arg.value, str):
+            name = name_arg.value
+            used.add(name)
+            if name not in registry:
+                violations.append(
+                    (path, node.lineno,
+                     f"span {name!r} is not declared in "
+                     "telemetry/span_sites.py:SPAN_SITES"))
+        elif _ANNOTATION not in line:
+            violations.append(
+                (path, node.lineno,
+                 "non-literal span name; annotate the line with "
+                 f"'{_ANNOTATION} <why>' if the value is closed over "
+                 "registered names"))
+    return violations, used
+
+
+def main(root=None):
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = root or os.path.join(os.path.dirname(here), "deepspeed_tpu")
+    sys.path.insert(0, os.path.dirname(root))
+    from deepspeed_tpu.telemetry.span_sites import SPAN_SITES
+    registry = set(SPAN_SITES)
+    violations, used = [], set()
+    for path in sorted(_iter_py(root)):
+        # the tracer's own module opens no registered spans; its
+        # docstring examples and helpers would false-positive
+        v, u = scan_file(path, registry)
+        violations.extend(v)
+        used |= u
+    for path, lineno, msg in violations:
+        print(f"{path}:{lineno}: {msg}")
+    unused = sorted(registry - used)
+    for name in unused:
+        print(f"warning: registered span {name!r} is never opened "
+              f"from {os.path.basename(root)}/ (dead entry, or "
+              "test-only)")
+    if violations:
+        print(f"\n{len(violations)} span-site violation(s).")
+        return 1
+    print(f"span-site lint clean: {len(used)} spans opened, "
+          f"{len(registry)} registered"
+          + (f", {len(unused)} registered-but-unopened" if unused
+             else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))
