@@ -4,3 +4,5 @@ from .ragged_manager import (BlockedKVCacheManager, DSStateManager,
                              SchedulingError, SchedulingResult,
                              SequenceDescriptor)
 from .ragged_wrapper import RaggedBatchWrapper
+from .serving import (PrefixCache, Request, RequestState,
+                      ServingFrontend, TokenStream)

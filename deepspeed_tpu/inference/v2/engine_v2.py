@@ -66,6 +66,15 @@ class RaggedInferenceEngineConfig:
     # counter (an LRU-evicted signature would merely re-count one
     # compile; the set must never grow without bound)
     max_dispatch_signatures: int = 64
+    # -- prefix-aware KV block reuse (serving/prefix.py; README
+    # "Serving front-end") --
+    # share full KV blocks whose token content matches a previously
+    # served prompt head: host-side trie + allocator refcounts; the
+    # device sees the same fixed-shape block tables (zero recompiles)
+    prefix_cache: bool = False
+    # trie bound in cached blocks (0 = bounded only by the KV pool;
+    # past the bound, leaf-first LRU eviction)
+    prefix_cache_max_blocks: int = 0
 
 
 class InferenceEngineV2:
@@ -120,6 +129,12 @@ class InferenceEngineV2:
             max_ragged_sequence_count=ec.max_ragged_sequence_count,
             max_context=ec.max_blocks_per_seq * ec.kv_block_size,
             n_blocks=ec.n_kv_blocks, block_size=ec.kv_block_size)
+        self.prefix_cache = None
+        if ec.prefix_cache:
+            from .serving.prefix import PrefixCache
+            self.prefix_cache = PrefixCache(
+                ec.kv_block_size, self._state_manager.kv.allocator,
+                max_blocks=ec.prefix_cache_max_blocks)
         self.pools = init_kv_pools(self.spec, ec.n_kv_blocks,
                                    ec.kv_block_size,
                                    dtype=jnp.dtype(ec.kv_dtype))
@@ -587,6 +602,45 @@ class InferenceEngineV2:
         self._defer_age.pop(uid, None)
         self._state_manager.flush_sequence(uid)
 
+    # -- prefix-aware KV block reuse ------------------------------------
+    def adopt_prefix(self, uid: int, prompt) -> np.ndarray:
+        """Map the longest cached full-block prefix of ``prompt`` into
+        a NEW sequence for ``uid`` (shared immutable KV blocks,
+        refcounted — see serving/prefix.py) and return the UNSERVED
+        prompt tail the caller should schedule. A no-op (full prompt
+        returned) when the cache is off, the uid already exists, or
+        nothing matches. Host bookkeeping only: the adopted request
+        skips prefill compute AND KV storage for the shared span."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        pc = self.prefix_cache
+        if pc is None or \
+                self._state_manager.get_sequence(uid) is not None:
+            return prompt
+        blocks, n_tokens = pc.match(prompt)
+        if n_tokens == 0:
+            return prompt
+        self._state_manager.adopt_prefix(uid, blocks, n_tokens)
+        return prompt[n_tokens:]
+
+    def register_prefix(self, uid: int, prompt) -> int:
+        """Publish ``uid``'s full-block prompt prefix into the cache
+        (called once the WHOLE prompt has been staged/dispatched — its
+        KV is in the threaded pools for every later dispatch). Only
+        prompt tokens are cached, never generated tails: the reuse
+        contract is shared system-prompt heads, and generated text is
+        per-user. Returns newly registered blocks."""
+        pc = self.prefix_cache
+        if pc is None:
+            return 0
+        seq = self._state_manager.get_sequence(uid)
+        if seq is None:
+            return 0
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n_full = len(prompt) // self._config.kv_block_size
+        if n_full == 0 or len(seq.blocks) < n_full:
+            return 0
+        return pc.insert(prompt, seq.blocks[:n_full])
+
     # -- admission control / backpressure -------------------------------
     @property
     def kv_utilization(self) -> float:
@@ -669,6 +723,10 @@ class InferenceEngineV2:
             if budget <= 0 or slots <= 0:
                 break
             need = self._blocks_needed(uid, 1)
+            if need > blocks and self.prefix_cache is not None:
+                # pressure valve: evict cache-only prefix blocks
+                # (leaf-first LRU) before deferring live decode work
+                blocks += self.prefix_cache.reclaim(need - blocks)
             if need > blocks:
                 continue  # deferred until blocks free up
             uids.append(uid)
@@ -684,6 +742,8 @@ class InferenceEngineV2:
                 break
             chunk = prompt[:budget]
             need = self._blocks_needed(uid, len(chunk))
+            if need > blocks and self.prefix_cache is not None:
+                blocks += self.prefix_cache.reclaim(need - blocks)
             if need > blocks:
                 self._defer_age[uid] = self._defer_age.get(uid, 0) + 1
                 break  # head-of-line: nobody jumps the starved prompt
@@ -747,6 +807,11 @@ class InferenceEngineV2:
         # the live-buffer census walks every jax buffer in the process
         # (deep probes call lifecycle.memory_gauges() directly)
         out["process_memory"] = memory_gauges(include_arrays=False)
+        if self.prefix_cache is not None:
+            # engine-lifetime reuse counters (hit rate, tokens reused,
+            # cached/evicted blocks) — the serving front-end's
+            # prefix-hit-rate surface
+            out["prefix"] = self.prefix_cache.stats()
         return out
 
     def attach_telemetry(self, hub, namespace: str = "serving"):

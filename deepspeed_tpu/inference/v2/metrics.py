@@ -24,10 +24,11 @@ flips nonzero exactly when the async property is lost.
 """
 
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 
-def _stats(xs: List[float], scale: float = 1.0) -> Dict[str, float]:
+def _stats(xs, scale: float = 1.0) -> Dict[str, float]:
     if not xs:
         return {"count": 0}
     s = sorted(x * scale for x in xs)
@@ -41,23 +42,50 @@ def _stats(xs: List[float], scale: float = 1.0) -> Dict[str, float]:
 
 
 class ServingMetrics:
+    """Per-run (closed-world loops) or per-deployment (the serving
+    front-end installs ONE instance for its whole lifetime) serving
+    metrics. Every history is BOUNDED (``window`` samples, default
+    8192): totals are running counters, distributions are over the
+    most recent window — so a week-long front-end neither grows
+    without bound (the repo's process-lifetime rule) nor reports SLO
+    percentiles frozen by hour-one data. Closed-world runs shorter
+    than the window are unaffected."""
 
     def __init__(self, mode: str, n_kv_blocks: int,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, window: int = 8192):
         self.mode = mode
         self.n_kv_blocks = max(1, n_kv_blocks)
         self._clock = clock
         self._t_start = clock()
-        self._steps: List[dict] = []
-        self._ttft_s: List[float] = []
-        self._itl_s: List[float] = []
-        self._last_emit: Dict[int, float] = {}
+        window = max(16, int(window))
+        self._steps: deque = deque(maxlen=window)
+        self._ttft_s: deque = deque(maxlen=window)
+        self._itl_s: deque = deque(maxlen=window)
+        # per-uid last emission time, for ITL gaps: pruned by the
+        # emitters' flush path is not visible here, so bound it LRU
+        self._last_emit: "Dict[int, float]" = {}
+        self._last_emit_bound = max(1024, window)
+        # running totals (never windowed)
+        self._n_steps = 0
+        self._n_decode_steps = 0
+        self._tokens_total = 0
+        self._prompt_tokens_total = 0
+        self._recompiles_total = 0
+        self._blocking_syncs_total = 0
         self.cancelled_steps = 0
         # admission control (engine.admit_requests): what the run was
         # asked to serve vs what backpressure let in
         self.requested = 0
         self.admitted = 0
         self.shed_uids: List[int] = []
+        # request-lifecycle counters + per-request completion latency
+        # (the serving front-end's surface; the closed-world loops
+        # leave them zero)
+        self.requests_submitted = 0
+        self.requests_finished = 0
+        self.requests_cancelled = 0
+        self.requests_shed = 0
+        self._request_latency_s: deque = deque(maxlen=window)
 
     def now(self) -> float:
         return self._clock()
@@ -68,6 +96,12 @@ class ServingMetrics:
                     n_seqs: int, decode_only: bool, recompiled: bool,
                     blocking_sync: bool, queue_depth: int,
                     kv_free: int) -> None:
+        self._n_steps += 1
+        self._n_decode_steps += 1 if decode_only else 0
+        self._tokens_total += new_tokens
+        self._prompt_tokens_total += prompt_tokens
+        self._recompiles_total += 1 if recompiled else 0
+        self._blocking_syncs_total += 1 if blocking_sync else 0
         self._steps.append({
             "dispatch_s": dispatch_s, "sync_wait_s": sync_wait_s,
             "wall_s": wall_s, "new_tokens": new_tokens,
@@ -78,13 +112,31 @@ class ServingMetrics:
         })
 
     def record_emission(self, uid: int, t: Optional[float] = None,
-                        first: bool = False) -> None:
+                        first: bool = False,
+                        t0: Optional[float] = None) -> None:
+        """``t0`` rebases a first token's TTFT to a per-request submit
+        time (the front-end's open-world clock); the default is the
+        run start — the closed-world loops' contract."""
         t = self.now() if t is None else t
         if first:
-            self._ttft_s.append(t - self._t_start)
+            self._ttft_s.append(t - (self._t_start if t0 is None
+                                     else t0))
         elif uid in self._last_emit:
             self._itl_s.append(t - self._last_emit[uid])
+        if uid not in self._last_emit and \
+                len(self._last_emit) >= self._last_emit_bound:
+            # bound the per-uid table: drop the stalest entry (its
+            # request is long finished; losing one ITL gap on a
+            # window-exceeding deployment is the cheap failure)
+            self._last_emit.pop(min(self._last_emit,
+                                    key=self._last_emit.get))
         self._last_emit[uid] = t
+
+    def forget_uid(self, uid: int) -> None:
+        """Drop a finished/cancelled request's ITL cursor (the
+        front-end's leave path; the LRU bound above is the backstop
+        for callers that never do)."""
+        self._last_emit.pop(uid, None)
 
     def record_cancelled(self, n: int = 1) -> None:
         self.cancelled_steps += n
@@ -95,31 +147,67 @@ class ServingMetrics:
         self.admitted = admitted
         self.shed_uids = list(shed_uids)
 
+    def record_request(self, outcome: str,
+                       latency_s: Optional[float] = None) -> None:
+        """One request lifecycle event for the open-world front-end:
+        ``outcome`` in submitted/finished/cancelled/shed; finished
+        requests carry their submit->last-token latency."""
+        if outcome == "submitted":
+            self.requests_submitted += 1
+        elif outcome == "finished":
+            self.requests_finished += 1
+        elif outcome == "cancelled":
+            self.requests_cancelled += 1
+        elif outcome == "shed":
+            self.requests_shed += 1
+        else:
+            raise ValueError(f"unknown request outcome {outcome!r}")
+        if latency_s is not None:
+            self._request_latency_s.append(latency_s)
+
+    # -- live signals (the SLO admission gate's inputs) ----------------
+    def live_ttft_ms(self, q: float = 0.50) -> Optional[float]:
+        """Percentile over every TTFT recorded so far; None before the
+        first emission (a gate must not shed on no data)."""
+        if not self._ttft_s:
+            return None
+        s = sorted(self._ttft_s)
+        return s[min(len(s) - 1, int(q * len(s)))] * 1e3
+
+    def live_itl_ms(self, q: float = 0.50) -> Optional[float]:
+        if not self._itl_s:
+            return None
+        s = sorted(self._itl_s)
+        return s[min(len(s) - 1, int(q * len(s)))] * 1e3
+
     # -- reporting ----------------------------------------------------
     def _steady_window(self) -> List[dict]:
-        """Decode-only steps after the last compile step."""
+        """Decode-only steps after the last compile step (within the
+        retained window — a compile older than the window has aged
+        out, which makes the whole window steady, as it should)."""
+        steps = list(self._steps)
         last_compile = -1
-        for i, s in enumerate(self._steps):
+        for i, s in enumerate(steps):
             if s["recompiled"]:
                 last_compile = i
-        return [s for s in self._steps[last_compile + 1:]
+        return [s for s in steps[last_compile + 1:]
                 if s["decode_only"]]
 
     def report(self) -> dict:
-        steps = self._steps
-        decode_steps = [s for s in steps if s["decode_only"]]
+        steps = list(self._steps)
         steady = self._steady_window()
         steady_wall = sum(s["wall_s"] for s in steady)
         steady_tokens = sum(s["new_tokens"] for s in steady)
         return {
             "mode": self.mode,
-            "steps": len(steps),
-            "decode_steps": len(decode_steps),
-            "tokens_emitted": sum(s["new_tokens"] for s in steps),
-            "prompt_tokens": sum(s["prompt_tokens"] for s in steps),
-            "recompiles": sum(1 for s in steps if s["recompiled"]),
-            "blocking_syncs": sum(1 for s in steps
-                                  if s["blocking_sync"]),
+            # totals are RUNNING counters (deployment lifetime);
+            # distribution stats below cover the retained window
+            "steps": self._n_steps,
+            "decode_steps": self._n_decode_steps,
+            "tokens_emitted": self._tokens_total,
+            "prompt_tokens": self._prompt_tokens_total,
+            "recompiles": self._recompiles_total,
+            "blocking_syncs": self._blocking_syncs_total,
             "steady_steps": len(steady),
             "steady_blocking_syncs": sum(1 for s in steady
                                          if s["blocking_sync"]),
@@ -130,6 +218,11 @@ class ServingMetrics:
                           "admitted": self.admitted,
                           "shed": len(self.shed_uids),
                           "shed_uids": list(self.shed_uids)},
+            "requests": {"submitted": self.requests_submitted,
+                         "finished": self.requests_finished,
+                         "cancelled": self.requests_cancelled,
+                         "shed": self.requests_shed},
+            "request_latency_ms": _stats(self._request_latency_s, 1e3),
             "dispatch_ms": _stats([s["dispatch_s"] for s in steps], 1e3),
             "sync_wait_ms": _stats([s["sync_wait_s"] for s in steps],
                                    1e3),

@@ -34,25 +34,85 @@ class SchedulingError(RuntimeError):
         self.result = result
 
 
+class BlockError(RuntimeError):
+    """Block-accounting invariant violation: freeing a block id that is
+    not live (double-free / free-list corruption) or taking a reference
+    on one. Freeing a block twice used to silently append it to the
+    free list TWICE, so two later sequences could be handed the same
+    block and overwrite each other's KV — typed and loud instead."""
+
+
 class BlockedAllocator:
-    """Free-list allocator over KV block ids (reference:
-    v2/ragged/blocked_allocator.py)."""
+    """Refcounted free-list allocator over KV block ids (reference:
+    v2/ragged/blocked_allocator.py).
+
+    Every live block carries a reference count: ``allocate`` hands out
+    blocks at refcount 1, ``incref`` lets a second owner (another
+    sequence's block table, the prefix cache's trie) share the block,
+    and ``free`` decrements — the block returns to the free list only
+    when its LAST reference drops. A ``free`` of a non-live id raises
+    ``BlockError`` (cheap dict-membership check): the double-free was
+    previously silent free-list corruption.
+    """
 
     def __init__(self, n_blocks: int):
         self.n_blocks = n_blocks
         self._free = list(range(n_blocks - 1, -1, -1))
+        self._refs: Dict[int, int] = {}   # live block id -> refcount
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
+    @property
+    def live_blocks(self) -> int:
+        return len(self._refs)
+
+    def refcount(self, block: int) -> int:
+        """0 for a free (non-live) block."""
+        return self._refs.get(block, 0)
+
     def allocate(self, n: int) -> List[int]:
         if n > len(self._free):
             raise SchedulingError(SchedulingResult.OutOfKVBlocks)
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._refs[b] = 1
+        return out
+
+    def incref(self, blocks: List[int]) -> None:
+        """Add one reference to each (live) block — the prefix-sharing
+        primitive. Raises before mutating anything, so a bad id cannot
+        leave a half-incref'd batch behind."""
+        for b in blocks:
+            if b not in self._refs:
+                raise BlockError(
+                    f"incref of non-live block {b} (free or never "
+                    f"allocated) — a shared mapping must only adopt "
+                    f"blocks some owner still holds")
+        for b in blocks:
+            self._refs[b] += 1
 
     def free(self, blocks: List[int]) -> None:
-        self._free.extend(blocks)
+        # validate the WHOLE batch (including duplicates within it)
+        # before mutating, so a bad call leaves the allocator untouched
+        dropping: Dict[int, int] = {}
+        for b in blocks:
+            dropping[b] = dropping.get(b, 0) + 1
+        for b, n in dropping.items():
+            if self._refs.get(b, 0) < n:
+                raise BlockError(
+                    f"double-free of KV block {b}: dropping {n} "
+                    f"reference(s) but only {self._refs.get(b, 0)} "
+                    f"live (free list would be corrupted — two "
+                    f"sequences could be handed the same block)")
+        for b, n in dropping.items():
+            r = self._refs[b] - n
+            if r == 0:
+                del self._refs[b]
+                self._free.append(b)
+            else:
+                self._refs[b] = r
 
 
 @dataclasses.dataclass
@@ -66,6 +126,12 @@ class SequenceDescriptor:
     blocks: List[int] = dataclasses.field(default_factory=list)
     seen_tokens: int = 0
     in_flight_tokens: int = 0
+    # prefix span: the first ``shared_prefix_blocks`` entries of
+    # ``blocks`` are SHARED immutable KV blocks adopted from the prefix
+    # cache (refcounted in the allocator; this sequence never writes
+    # them — its first token position is past their token span). The
+    # copy-on-write boundary: everything from this index on is private.
+    shared_prefix_blocks: int = 0
 
     @property
     def cur_allocated_blocks(self) -> int:
@@ -148,6 +214,38 @@ class DSStateManager:
         self._seqs[uid] = seq
         return seq
 
+    def adopt_prefix(self, uid: int, blocks: List[int],
+                     n_tokens: int) -> SequenceDescriptor:
+        """Create a NEW sequence whose leading block-table entries map
+        to shared immutable KV blocks (the prefix cache's reuse seam).
+
+        The blocks are incref'd — this sequence co-owns them with
+        whatever else references them; ``flush_sequence`` later
+        decrements through the allocator's refcounts, so release
+        semantics are unchanged for callers. ``n_tokens`` must cover
+        the shared blocks exactly (full blocks only — a partial shared
+        block would be written by this sequence's own tokens, breaking
+        immutability)."""
+        if uid in self._seqs:
+            raise ValueError(f"uid {uid} already tracked — prefix "
+                             f"adoption is a creation-time operation")
+        if n_tokens != len(blocks) * self.kv.block_size:
+            raise ValueError(
+                f"shared prefix must cover full blocks exactly: "
+                f"{n_tokens} tokens vs {len(blocks)} x "
+                f"{self.kv.block_size}-token blocks")
+        seq = self.get_or_create_sequence(uid)
+        try:
+            self.kv.allocator.incref(blocks)
+        except BlockError:
+            # the just-created (empty) entry must not leak
+            self._seqs.pop(uid, None)
+            raise
+        seq.blocks = list(blocks)
+        seq.seen_tokens = n_tokens
+        seq.shared_prefix_blocks = len(blocks)
+        return seq
+
     def flush_sequence(self, uid: int) -> None:
         seq = self._seqs.pop(uid, None)
         if seq is not None:
@@ -170,6 +268,15 @@ class DSStateManager:
         seq = self._seqs.get(uid)
         if seq is None:
             return
+        if blocks_before < seq.shared_prefix_blocks:
+            # a rollback can only undo work THIS sequence committed;
+            # shared prefix blocks predate every forward of this
+            # sequence, so a record pointing inside the span is a
+            # bookkeeping bug, not a legal rollback
+            raise BlockError(
+                f"rollback for uid {uid} would free shared prefix "
+                f"blocks ({blocks_before} < "
+                f"{seq.shared_prefix_blocks} shared)")
         seq.seen_tokens = max(0, seq.seen_tokens - n_tokens)
         if len(seq.blocks) > blocks_before:
             self.kv.allocator.free(seq.blocks[blocks_before:])

@@ -1,0 +1,29 @@
+"""Serving front-end over the v2 ragged engine (reference shape:
+DeepSpeed-MII / FastGen persistent deployments — request lifecycles,
+continuous request-level batching, streaming delivery — the serving
+product PAPER.md layer 7 stacks on the ragged engine).
+
+Pieces:
+
+* ``request.py`` — the typed ``Request`` state machine
+  (QUEUED -> PREFILL -> DECODE -> {FINISHED, CANCELLED, SHED}) and the
+  per-request ordered ``TokenStream``.
+* ``admission.py`` — the SLO-aware admission gate: capacity via the
+  engine's ``admit_requests`` backpressure, deadline and
+  latency-SLO shedding from the LIVE TTFT/ITL histograms, typed
+  ``TelemetryAlert`` emission on breach.
+* ``prefix.py`` — the host-side prefix trie backing prefix-aware KV
+  block reuse (full-block token hashes -> shared immutable blocks).
+* ``frontend.py`` — ``ServingFrontend``: ``submit/cancel/stream/step``
+  plus the ``serve()`` driver — the open-world generalization of
+  ``serving_loop._run_lookahead`` (requests join and leave the
+  in-flight ragged batch mid-flight, no draining).
+"""
+
+from .admission import AdmissionGate
+from .frontend import ServingFrontend
+from .prefix import PrefixCache
+from .request import Request, RequestState, TokenStream
+
+__all__ = ["AdmissionGate", "PrefixCache", "Request", "RequestState",
+           "ServingFrontend", "TokenStream"]

@@ -1,0 +1,140 @@
+"""SLO-aware admission: the gate between QUEUED requests and the
+in-flight ragged batch.
+
+Extends the engine's ``admit_requests`` backpressure (queue-depth and
+KV-utilization capacity — PR 6's gate, including its ``serving.admit``
+fault site) with the request-level policy a persistent front-end
+needs:
+
+* **deadline shedding** — a queued request whose TTFT budget
+  (``Request.deadline_ms``) already elapsed is shed, not served late:
+  the caller long since timed out, and serving it anyway spends KV
+  blocks and token budget on an answer nobody reads;
+* **SLO shedding** — while the LIVE latency signal (TTFT/ITL
+  percentiles from the continuous ``ServingMetrics`` histograms) is in
+  breach of the configured SLOs, new priority<=0 arrivals are shed so
+  the admitted population can drain back under the objective
+  (priority>0 requests ride through — the paid tier);
+* **typed alert emission** — every breach/shed emits a
+  ``TelemetryAlert`` into the sink (the front-end's bounded log and,
+  when attached, the telemetry hub + recovery report).
+
+Verdicts are three-way: ``admit`` / ``shed`` (terminal, resubmittable)
+/ ``defer`` (stay queued — capacity pressure clears as decodes finish,
+so refusing forever would turn a full pool into dropped traffic).
+"""
+
+from typing import Callable, Optional, Tuple
+
+from ....telemetry.anomaly import TelemetryAlert
+from .request import Request
+
+ADMIT = "admit"
+SHED = "shed"
+DEFER = "defer"
+
+
+class AdmissionGate:
+
+    def __init__(self, engine, config, metrics,
+                 clock: Callable[[], float],
+                 sink: Optional[Callable[[TelemetryAlert], None]] = None):
+        self.engine = engine
+        self.config = config
+        self.metrics = metrics
+        self._clock = clock
+        self._sink = sink
+        # one breach alert per (metric, step) — the gate runs per
+        # queued request per step; alert volume must not scale with
+        # queue length
+        self._alerted_step = {}
+        # the breach EVALUATION is also once per step (cached): the
+        # breach counter counts breached steps, not queue length, and
+        # the live-percentile sorts don't multiply by queue depth
+        self._breach_cache = (-1, False)
+        self.slo_breaches = 0
+        self.deadline_sheds = 0
+        self.slo_sheds = 0
+        self.capacity_defers = 0
+
+    def _alert(self, kind: str, metric: str, value: float,
+               threshold: float, step: int, message: str) -> None:
+        if self._alerted_step.get(metric) == step:
+            return
+        self._alerted_step[metric] = step
+        if self._sink is not None:
+            self._sink(TelemetryAlert(kind, metric, float(value),
+                                      float(threshold), step, message))
+
+    def _slo_breached(self, step: int) -> bool:
+        """LIVE histogram check against the configured ceilings; emits
+        the breach alert (once per metric per step). Evaluated once
+        per step and cached — consider() calls it per queued
+        request."""
+        if self._breach_cache[0] == step:
+            return self._breach_cache[1]
+        cfg = self.config
+        breached = False
+        ttft = self.metrics.live_ttft_ms(0.50)
+        if cfg.ttft_slo_ms > 0 and ttft is not None \
+                and ttft > cfg.ttft_slo_ms:
+            breached = True
+            self.slo_breaches += 1
+            self._alert("slo_breach", "serving/ttft_ms/p50", ttft,
+                        cfg.ttft_slo_ms, step,
+                        f"live TTFT p50 {ttft:.1f}ms breaches the "
+                        f"{cfg.ttft_slo_ms:g}ms SLO")
+        itl = self.metrics.live_itl_ms(0.50)
+        if cfg.itl_slo_ms > 0 and itl is not None \
+                and itl > cfg.itl_slo_ms:
+            breached = True
+            self.slo_breaches += 1
+            self._alert("slo_breach", "serving/itl_ms/p50", itl,
+                        cfg.itl_slo_ms, step,
+                        f"live ITL p50 {itl:.1f}ms breaches the "
+                        f"{cfg.itl_slo_ms:g}ms SLO")
+        self._breach_cache = (step, breached)
+        return breached
+
+    def consider(self, req: Request, active: int,
+                 step: int) -> Tuple[str, str]:
+        """One queued request's verdict -> ``(ADMIT|SHED|DEFER,
+        reason)``. Never mutates engine state (a shed/deferred request
+        can be reconsidered or resubmitted verbatim)."""
+        cfg = self.config
+        # 1) expired deadline: hopeless work is shed first — it would
+        # otherwise consume the capacity the gate is protecting
+        if cfg.shed_expired_deadlines and req.deadline_ms is not None:
+            waited_ms = (self._clock() - req.submitted_t) * 1e3
+            if waited_ms > req.deadline_ms:
+                self.deadline_sheds += 1
+                self._alert(
+                    "slo_breach", "serving/deadline_ms",
+                    waited_ms, req.deadline_ms, step,
+                    f"request {req.uid} queued {waited_ms:.1f}ms past "
+                    f"its {req.deadline_ms:g}ms TTFT deadline — shed")
+                return SHED, "deadline expired in queue"
+        # 2) SLO shedding: while the live histograms are in breach,
+        # unprioritized new arrivals are load we refuse, not serve late
+        if self._slo_breached(step) and cfg.slo_shed \
+                and req.priority <= 0:
+            self.slo_sheds += 1
+            return SHED, "latency SLO in breach (priority <= 0 shed)"
+        # 3) capacity: PR 6's admit_requests (queue-depth + KV-util
+        # gates, one serving.admit fault-site fire) — full pools DEFER
+        # rather than shed: decode of admitted work frees blocks. An
+        # injected/infrastructure ResilienceError from the fault site
+        # propagates to the front-end, which sheds the request without
+        # engine state to clean up (admit_requests mutates nothing).
+        admitted, shed = self.engine.admit_requests(
+            {req.uid: req.prompt}, active=active)
+        if shed:
+            self.capacity_defers += 1
+            return DEFER, "capacity (queue depth / KV utilization)"
+        return ADMIT, ""
+
+    def stats(self) -> dict:
+        return {"slo_breaches": self.slo_breaches,
+                "slo_sheds": self.slo_sheds,
+                "deadline_sheds": self.deadline_sheds,
+                "capacity_defers": self.capacity_defers}

@@ -1,0 +1,580 @@
+"""ServingFrontend — persistent, open-world continuous batching over
+the v2 ragged engine.
+
+``serving_loop._run_lookahead`` serves one fixed cohort: the prompt
+set is known up front, the loop drains, the engine goes idle. A
+persistent deployment (reference: MII/FastGen — PAPER.md layer 7) has
+no cohort: requests arrive whenever, stream their tokens out as they
+decode, get cancelled mid-flight, and leave — while the ragged batch
+keeps stepping. This module generalizes the lookahead machinery into
+that open world:
+
+* **same hot path** — one-step-lookahead dispatch (step N+1's host
+  work overlaps step N's device compute; sampled tokens chain
+  device-to-device through ``token_src``), zero blocking host syncs
+  per decode step in steady state, and the fixed-shape /
+  zero-recompile contract: a request JOINING the batch changes which
+  rows are active, never the executable's signature.
+* **open world** — ``submit()`` queues a request; the admission gate
+  (``admission.py``: capacity + deadline + SLO shedding) decides each
+  step which queued requests JOIN the in-flight batch; FINISHED /
+  CANCELLED requests leave it immediately (KV blocks freed, slots
+  recycled) without draining anyone else.
+* **streaming delivery** — per-request ordered token streams
+  (``stream()`` iterator or ``on_token`` callback) fed from the
+  one-step-late host copy; ``cancel()`` works mid-prefill and
+  mid-decode.
+* **prefix-aware KV reuse** — new prompts adopt cached full-block
+  heads (serving/prefix.py) before scheduling, and completed prompt
+  heads are registered for later arrivals.
+
+Single-threaded by design: ``step()`` is the one place engine state
+moves, so there is no locking and every test is deterministic. A
+server embeds it by calling ``step()`` from its event loop (or
+``serve(poll=...)`` with a poll that drains its network queue).
+"""
+
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ....resilience.errors import ResilienceError, ServingOverloadError
+from ....resilience.fault_injector import fault_injector
+from ....telemetry.trace import span
+from ....utils.logging import logger
+from ...sampling import SamplingParams
+from ..metrics import ServingMetrics
+from ..ragged_manager import SchedulingError
+from ..serving_loop import (StepRecord, TokenRef, _start_host_copy,
+                            dispatch_guarded, emit_token, stuck_error,
+                            trim_prompts)
+from .admission import ADMIT, SHED, AdmissionGate
+from .request import Request, RequestState, TokenStream
+
+
+def _normalize_config(config):
+    from ....runtime.config import ServingConfig
+    if config is None:
+        return ServingConfig()
+    if isinstance(config, ServingConfig):
+        return config
+    if isinstance(config, dict):
+        return ServingConfig.from_dict(config)
+    raise ValueError(f"config must be a ServingConfig, dict or None, "
+                     f"got {type(config)}")
+
+
+class ServingFrontend:
+    """Request-lifecycle owner over an ``InferenceEngineV2``.
+
+    The front-end takes over the engine's serving surface: it installs
+    a CONTINUOUS ``ServingMetrics`` (so ``get_serving_report()``
+    reflects the deployment, not the last closed-world
+    ``generate_batch`` run), applies the ``serving`` config block's
+    admission overrides, and — when ``serving.prefix.enabled`` — arms
+    the engine's prefix cache if the engine config didn't already.
+    """
+
+    def __init__(self, engine, config=None, clock=time.perf_counter):
+        self.engine = engine
+        self.config = cfg = _normalize_config(config)
+        self._clock = clock
+        if cfg.on_overload not in ("raise", "shed"):
+            raise ValueError(f"serving.on_overload must be raise/shed, "
+                             f"got {cfg.on_overload!r}")
+        if cfg.executable not in ("auto", "greedy", "sampled"):
+            raise ValueError(
+                f"serving.executable must be auto/greedy/sampled, "
+                f"got {cfg.executable!r}")
+        # serving-block capacity overrides land on the ENGINE config:
+        # admit_requests reads them there (one source of truth)
+        if cfg.max_queue_depth is not None:
+            engine._config.max_queue_depth = int(cfg.max_queue_depth)
+        if cfg.admission_kv_util_threshold is not None:
+            engine._config.admission_kv_util_threshold = float(
+                cfg.admission_kv_util_threshold)
+        if cfg.prefix.enabled and engine.prefix_cache is None:
+            from .prefix import PrefixCache
+            engine.prefix_cache = PrefixCache(
+                engine._config.kv_block_size,
+                engine._state_manager.kv.allocator,
+                max_blocks=cfg.prefix.max_blocks)
+        self.metrics = ServingMetrics("frontend",
+                                      engine._config.n_kv_blocks,
+                                      clock=clock)
+        engine._serving_metrics = self.metrics
+        engine._defer_age.clear()
+        self.alerts: deque = deque(maxlen=256)
+        self._hub = None
+        self.gate = AdmissionGate(engine, cfg, self.metrics,
+                                  clock=clock, sink=self._note_alert)
+        # -- open-world batch state (the lookahead loop's locals,
+        # promoted to instance state so requests join/leave between
+        # steps) --
+        self._requests: Dict[int, Request] = {}
+        self._queue: List[int] = []            # QUEUED, arrival order
+        self._pending: Dict[int, np.ndarray] = {}   # joined prompt tails
+        self._full_prompts: Dict[int, np.ndarray] = {}
+        self._decode: Dict[int, object] = {}   # uid -> int | TokenRef
+        self._remaining: Dict[int, int] = {}
+        self._inflight: Optional[StepRecord] = None
+        self._retired: deque = deque()
+        self._next_uid = 1
+        self._step_idx = 0
+        self._base_key = None
+        self._seed = cfg.seed
+        # executable pinning (zero-recompile contract): greedy and
+        # sampled tails are DIFFERENT jit signatures; "auto" latches
+        # to sampled the first time a sampled request joins
+        self._use_sampled = cfg.executable == "sampled"
+
+    # -- telemetry ------------------------------------------------------
+    def _note_alert(self, alert) -> None:
+        self.alerts.append(alert)
+        if self._hub is not None:
+            self._hub.note_alert(alert)
+
+    def attach_telemetry(self, hub, namespace: str = "serving"):
+        """Register the serving report on a ``TelemetryHub`` and route
+        admission-gate ``TelemetryAlert``s into its alert log."""
+        self.engine.attach_telemetry(hub, namespace=namespace)
+        self._hub = hub
+        return hub
+
+    # -- submission surface --------------------------------------------
+    @property
+    def active_requests(self) -> int:
+        """Requests inside the ragged batch (prefilling or decoding)."""
+        return len(self._pending) + len(self._decode)
+
+    @property
+    def queued_requests(self) -> int:
+        return len(self._queue)
+
+    def get_request(self, uid: int) -> Optional[Request]:
+        return self._requests.get(uid)
+
+    def submit(self, prompt, *, uid: Optional[int] = None,
+               max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               sampling: Optional[SamplingParams] = None,
+               priority: int = 0,
+               deadline_ms: Optional[float] = None,
+               on_token=None) -> Request:
+        """Queue one request; returns its live ``Request`` handle.
+        Joining the batch happens at the next ``step()`` (the
+        admission gate's call). ``serving.max_queue_depth`` bounds
+        total outstanding work (queued + active): past it, submit
+        raises a typed ``ServingOverloadError`` (``serving.on_overload
+        = "raise"``, the 429/503 path) or returns the request already
+        SHED (``"shed"``)."""
+        cfg = self.config
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if uid is None:
+            while self._next_uid in self._requests:
+                self._next_uid += 1
+            uid = self._next_uid
+            self._next_uid += 1
+        elif uid in self._requests and \
+                not self._requests[uid].done:
+            raise ValueError(f"uid {uid} is already live")
+        if sampling is not None and cfg.executable == "greedy":
+            # rejected HERE, before any queue/engine state exists — a
+            # join-time failure would have to unwind a half-joined
+            # request
+            raise ValueError(
+                "request carries SamplingParams but serving.executable "
+                "is pinned to 'greedy'")
+        if sampling is not None and sampling.seed is not None and \
+                self._seed is not None and self._seed != sampling.seed:
+            raise ValueError(
+                f"request seed {sampling.seed} conflicts with the "
+                f"front-end's base seed {self._seed} (one base "
+                f"key per deployment; per-row keys fold in "
+                f"uid/position)")
+        req = Request(
+            uid=uid, prompt=prompt,
+            max_new_tokens=(cfg.max_new_tokens if max_new_tokens is None
+                            else max_new_tokens),
+            eos_token_id=(cfg.eos_token_id if eos_token_id is None
+                          else eos_token_id),
+            sampling=sampling, priority=priority,
+            deadline_ms=deadline_ms, on_token=on_token,
+            submitted_t=self._clock())
+        outstanding = len(self._queue) + self.active_requests
+        if self.engine._config.max_queue_depth > 0 and \
+                outstanding >= self.engine._config.max_queue_depth:
+            if cfg.on_overload == "raise":
+                raise ServingOverloadError(
+                    "serving queue is full",
+                    queue_depth=outstanding,
+                    kv_util=self.engine.kv_utilization,
+                    free_blocks=self.engine.free_blocks,
+                    shed_uids=[uid])
+            self._requests[uid] = req
+            self.metrics.record_request("submitted")
+            self._shed(req, "queue full at submit")
+            return req
+        # the deployment seed latches only for ACCEPTED requests — a
+        # rejected submit must not pin the base key it never used
+        if sampling is not None and sampling.seed is not None and \
+                self._seed is None:
+            self._seed = sampling.seed
+            self._base_key = None          # rebuilt at next dispatch
+        self._requests[uid] = req
+        self._queue.append(uid)
+        self.metrics.record_request("submitted")
+        return req
+
+    def cancel(self, uid: int) -> bool:
+        """Cancel a live request — mid-queue, mid-prefill or
+        mid-decode. KV blocks and the sequence slot are freed
+        IMMEDIATELY (an in-flight row's stale device writes are masked
+        by ``seq_lens``, exactly like the EOS-overshoot path). Returns
+        False for unknown/already-terminal uids."""
+        req = self._requests.get(uid)
+        if req is None or req.done:
+            return False
+        with span("frontend.leave", uid=uid, why="cancel"):
+            if req.state == RequestState.QUEUED:
+                self._queue.remove(uid)
+            else:
+                self._leave(uid)
+            req.advance(RequestState.CANCELLED)
+            req.finished_t = self._clock()
+        self.metrics.record_request("cancelled")
+        self._retire(uid)
+        return True
+
+    def stream(self, uid: int) -> TokenStream:
+        """Ordered token iterator for ``uid``; iterating pumps
+        ``step()`` while tokens are pending, so a bare
+        ``for tok in frontend.stream(uid)`` serves the request (and
+        everything batched with it) to completion."""
+        req = self._requests.get(uid)
+        if req is None:
+            raise KeyError(f"unknown request uid {uid}")
+        return TokenStream(req, pump=self.step)
+
+    def result(self, uid: int) -> List[int]:
+        """The tokens emitted so far (complete for terminal states)."""
+        return list(self._requests[uid].tokens)
+
+    # -- internal lifecycle helpers ------------------------------------
+    def _retire(self, uid: int) -> None:
+        """Bound the terminal-request table (PR-6 rule: nothing grows
+        for process lifetime)."""
+        self._retired.append(uid)
+        bound = max(1, int(self.config.max_retained_requests))
+        while len(self._retired) > bound:
+            old = self._retired.popleft()
+            dead = self._requests.get(old)
+            # a reused uid's LIVE request must survive the old
+            # lifecycle's eviction (it re-queues on its own retirement)
+            if dead is not None and dead.done:
+                self._requests.pop(old, None)
+
+    def _shed(self, req: Request, reason: str) -> None:
+        req.shed_reason = reason
+        req.advance(RequestState.SHED)
+        req.finished_t = self._clock()
+        self.metrics.record_request("shed")
+        logger.warning(f"serving front-end shed request {req.uid}: "
+                       f"{reason}")
+        self._retire(req.uid)
+
+    def _leave(self, uid: int) -> None:
+        """Remove a joined request from the batch NOW: drop its
+        prompt/decode state, cancel its in-flight row if one is
+        dispatched, free its KV blocks and sequence slot."""
+        self._pending.pop(uid, None)
+        self._full_prompts.pop(uid, None)
+        self._decode.pop(uid, None)
+        self._remaining.pop(uid, None)
+        if self._inflight is not None and uid in self._inflight.slot:
+            self._inflight.cancelled.add(self._inflight.slot[uid])
+        self.metrics.forget_uid(uid)
+        self.engine.flush(uid)
+
+    def _join(self, req: Request) -> None:
+        """Admit one request into the batch: adopt its cached prefix
+        head, then expose the ``frontend.join`` fault site — an
+        injected fault here must not leak the just-created sequence,
+        so the handler flushes before re-raising."""
+        with span("frontend.join", uid=req.uid,
+                  prompt_tokens=len(req.prompt)):
+            tail = self.engine.adopt_prefix(req.uid, req.prompt)
+            try:
+                fault_injector.fire("frontend.join",
+                                    detail=str(req.uid))
+            except Exception:
+                self.engine.flush(req.uid)
+                raise
+            self._pending[req.uid] = tail
+            self._full_prompts[req.uid] = req.prompt
+            self._remaining[req.uid] = req.max_new_tokens
+            req.advance(RequestState.PREFILL)
+            if req.sampling is not None and not self._use_sampled:
+                # "auto" latches to the sampled executable the first
+                # time a sampled request joins: exactly one recompile,
+                # then the signature is pinned again ("greedy" pinning
+                # already rejected the request at submit())
+                self._use_sampled = True
+
+    def _admit(self) -> int:
+        """One step's admission pass over the queue (arrival order,
+        priority first): SHED verdicts are terminal, DEFER leaves the
+        request queued, ADMIT joins it. A typed fault at the admission
+        site or the join site sheds THAT request only and never leaks
+        engine state; an engine-full SchedulingError defers the rest
+        of the queue (aged-FCFS spirit: nobody jumps the line)."""
+        if not self._queue:
+            return 0
+        joined = 0
+        with span("frontend.admit", queued=len(self._queue)):
+            active = self.active_requests
+            order = sorted(range(len(self._queue)),
+                           key=lambda i: (-self._requests[
+                               self._queue[i]].priority, i))
+            stop = False
+            taken = set()
+            for i in order:
+                uid = self._queue[i]
+                req = self._requests[uid]
+                if stop:
+                    continue
+                try:
+                    verdict, reason = self.gate.consider(
+                        req, active=active, step=self._step_idx)
+                except ResilienceError as e:
+                    taken.add(i)
+                    self._shed(req, f"admission fault: {e}")
+                    continue
+                if verdict == SHED:
+                    taken.add(i)
+                    self._shed(req, reason)
+                elif verdict == ADMIT:
+                    try:
+                        self._join(req)
+                    except SchedulingError:
+                        # engine sequence table full: transient — stay
+                        # queued, and stop admitting so younger
+                        # arrivals don't jump the line
+                        stop = True
+                        continue
+                    except ResilienceError as e:
+                        taken.add(i)
+                        self._shed(req, f"join fault: {e}")
+                        continue
+                    taken.add(i)
+                    joined += 1
+                    active += 1
+                # DEFER: leave queued
+            self._queue = [uid for i, uid in enumerate(self._queue)
+                           if i not in taken]
+        return joined
+
+    # -- the open-world lookahead step ---------------------------------
+    def _sampling_arg(self, uids):
+        """Per-row sampling for exactly this dispatch's rows. Built
+        from ``uids`` (the scheduled batch), NOT from the
+        pending/decode tables — a prompt's FINAL chunk has already
+        left ``_pending`` by dispatch time and is not yet in
+        ``_decode``, and that is precisely the row emitting the
+        request's first sampled token."""
+        if not self._use_sampled:
+            return None, None
+        samp = {}
+        for uid in uids:
+            req = self._requests.get(uid)
+            if req is not None and req.sampling is not None:
+                samp[uid] = req.sampling
+        if self._base_key is None:
+            import jax
+            self._base_key = jax.random.PRNGKey(self._seed or 0)
+        return samp, self._base_key
+
+    def step(self) -> bool:
+        """One open-world serving iteration: admit queued requests,
+        schedule+dispatch step k+1 (one-step lookahead — before step
+        k's tokens are host-visible), then collect step k and deliver
+        its tokens to the per-request streams. Returns True when the
+        step moved work (joined/dispatched/collected); raises a typed
+        ``ServingOverloadError`` when the deployment is wedged
+        (requests waiting, nothing schedulable, nothing in flight)."""
+        engine = self.engine
+        metrics = self.metrics
+        self._step_idx += 1
+        t0 = metrics.now()
+        joined = self._admit()
+
+        # ---- schedule + dispatch (the lookahead contract: sequences
+        # whose pending emission is their LAST never speculate)
+        with span("serving.schedule"):
+            sched_decode = {}
+            for uid, v in self._decode.items():
+                if isinstance(v, TokenRef):
+                    assert v.step is self._inflight, \
+                        "stale device-token ref"
+                    if self._remaining[uid] > 1:
+                        sched_decode[uid] = 0      # placeholder id
+                else:
+                    sched_decode[uid] = v
+            uids, toks = engine.schedule(self._pending, sched_decode)
+        step = None
+        n_prompt = 0
+        recompiled = False
+        if uids:
+            srcs = []
+            for uid in uids:
+                v = self._decode.get(uid)
+                srcs.append(v.slot if isinstance(v, TokenRef) else -1)
+            emit, n_prompt, done = trim_prompts(self._pending, uids,
+                                                toks)
+            sampling, base_key = self._sampling_arg(uids)
+            inflight = self._inflight
+            with span("serving.dispatch", n_seqs=len(uids)):
+                tokens_dev, committed, recompiled = dispatch_guarded(
+                    engine, lambda: engine.put_sampled(
+                        uids, toks, src_slots=srcs,
+                        prev_tokens=inflight.tokens if inflight
+                        else None,
+                        sampling=sampling, base_key=base_key))
+            for uid in done:
+                engine.register_prefix(uid, self._full_prompts[uid])
+            _start_host_copy(tokens_dev)
+            step = StepRecord(
+                uids=uids, emit=emit, tokens=tokens_dev,
+                slot={u: i for i, u in enumerate(uids)},
+                committed={u: (n, b) for u, n, b in committed})
+            for row, uid in enumerate(uids):
+                if emit[row]:
+                    self._decode[uid] = TokenRef(step, row)
+        elif self._inflight is None and joined == 0 and \
+                (self._queue or self._pending or self._decode):
+            # nothing dispatched, nothing in flight to drain, nothing
+            # admitted — and work is waiting: the deployment is wedged
+            raise stuck_error(
+                engine, self._pending,
+                "serving front-end stuck: requests waiting but no "
+                "schedulable work and nothing in flight (out of KV "
+                "blocks / engine full)")
+        t1 = metrics.now()
+
+        # ---- collect step k while k+1 computes; deliver tokens
+        n_new = 0
+        sync_wait = 0.0
+        inflight = self._inflight
+        if inflight is not None:
+            ts = metrics.now()
+            with span("serving.collect"):
+                toks_host = np.asarray(inflight.tokens)
+            sync_wait = metrics.now() - ts
+            with span("frontend.stream", n_rows=len(inflight.uids)):
+                n_new = self._deliver(inflight, toks_host, step)
+        metrics.record_step(
+            dispatch_s=t1 - t0, sync_wait_s=sync_wait,
+            wall_s=metrics.now() - t0, new_tokens=n_new,
+            prompt_tokens=n_prompt, n_seqs=len(uids),
+            decode_only=(bool(uids) and n_prompt == 0),
+            recompiled=recompiled,
+            blocking_sync=(inflight is not None and step is None),
+            queue_depth=len(self._queue) + len(self._pending),
+            kv_free=engine.free_blocks)
+        self._inflight = step
+        return bool(joined or uids or inflight is not None)
+
+    def _deliver(self, collected: StepRecord, toks_host,
+                 next_step: Optional[StepRecord]) -> int:
+        """Fan the collected step's tokens out to their requests:
+        append to the ordered stream, fire callbacks, advance states,
+        retire finished requests (cancelling their speculative row in
+        ``next_step``, exactly the closed-world EOS-overshoot path)."""
+        engine = self.engine
+        n_new = 0
+        for row, uid in enumerate(collected.uids):
+            if not collected.emit[row] or row in collected.cancelled:
+                continue
+            req = self._requests.get(uid)
+            if req is None or req.done:   # cancelled + already retired
+                continue
+            tok = int(toks_host[row])
+            n_new += 1
+            out = {uid: req.tokens}       # emit_token appends in place
+            remaining = {uid: self._remaining[uid]}
+            finished = emit_token(out, self.metrics, remaining, uid,
+                                  tok, req.eos_token_id,
+                                  t0=req.submitted_t)
+            self._remaining[uid] = remaining[uid]
+            if req.first_token_t is None:
+                req.first_token_t = self.metrics.now()
+                if req.state == RequestState.PREFILL:
+                    req.advance(RequestState.DECODE)
+            if req.on_token is not None:
+                req.on_token(tok)
+            if finished:
+                if next_step is not None and uid in next_step.slot:
+                    # EOS/budget discovered one step late: cancel the
+                    # speculative row already dispatched (host
+                    # accounting only; seq_lens masks the stale KV)
+                    next_step.cancelled.add(next_step.slot[uid])
+                    n_t, blocks_before = next_step.committed[uid]
+                    engine.rollback_step(uid, n_t, blocks_before)
+                    self.metrics.record_cancelled()
+                with span("frontend.leave", uid=uid, why="finished"):
+                    self._leave(uid)
+                    req.advance(RequestState.FINISHED)
+                    req.finished_t = self.metrics.now()
+                self.metrics.record_request(
+                    "finished",
+                    latency_s=req.finished_t - req.submitted_t)
+                self._retire(uid)
+            else:
+                cur = self._decode.get(uid)
+                if isinstance(cur, TokenRef) and \
+                        cur.step is collected:
+                    self._decode[uid] = tok   # host-known from here on
+        return n_new
+
+    # -- driver ---------------------------------------------------------
+    def serve(self, poll=None, max_steps: Optional[int] = None) -> int:
+        """Drive ``step()`` until idle. ``poll(frontend, step_idx)``
+        (optional) runs before every step — the seam where a server
+        drains its network queue into ``submit()``/``cancel()``;
+        return False from it to stop accepting (serve then drains and
+        returns). Returns the number of steps taken."""
+        steps = 0
+        accepting = poll is not None
+        while True:
+            if accepting:
+                accepting = poll(self, steps) is not False
+            idle = not (self._queue or self._pending or self._decode
+                        or self._inflight is not None)
+            if idle and not accepting:
+                return steps
+            if max_steps is not None and steps >= max_steps:
+                return steps
+            self.step()
+            steps += 1
+
+    def drain(self, max_steps: int = 100000) -> int:
+        """Serve until every live request reaches a terminal state."""
+        return self.serve(max_steps=max_steps)
+
+    def get_serving_report(self) -> dict:
+        """The engine's serving report (continuous front-end metrics,
+        prefix stats, process memory) + the admission gate's counters
+        and the request-table gauges."""
+        rep = self.engine.get_serving_report()
+        rep["gate"] = self.gate.stats()
+        rep["frontend"] = {
+            "queued": len(self._queue),
+            "active": self.active_requests,
+            "retained": len(self._requests),
+            "alerts": len(self.alerts),
+        }
+        return rep

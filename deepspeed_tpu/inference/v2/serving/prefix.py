@@ -1,0 +1,232 @@
+"""Prefix-aware KV block reuse: the host-side trie.
+
+A million-user workload shares prompt heads (system prompts, few-shot
+preambles). Recomputing — and re-storing — their KV per request pays
+prefill FLOPs and KV blocks for identical bytes. This module maps
+**full KV blocks** of previously served prompt heads so a new request
+whose prompt starts with the same tokens adopts the cached blocks into
+its block table instead of recomputing them.
+
+Pure host bookkeeping: the device still sees the same fixed-shape
+block tables and the same single compiled forward — nothing recompiles.
+Sharing is safe because
+
+* only FULL blocks are cached (a block is keyed by the hash chain of
+  every token it and its ancestors contain), so a request that
+  diverges mid-block simply fails the chain walk at that block and
+  computes it privately — "copy-on-write at the first divergent
+  block" without ever copying;
+* an adopting sequence's first own token position lies past the shared
+  span, so its KV writes land exclusively in private blocks — shared
+  blocks are immutable by construction;
+* liveness is the allocator's refcount (``BlockedAllocator``): the
+  trie holds one reference per cached block, each adopting sequence
+  holds another, and the block returns to the free list only when the
+  last owner lets go. ``flush``/rollback semantics are unchanged for
+  every caller.
+
+Keys are a chained ``blake2b`` digest: ``d_i = H(d_{i-1} ||
+tokens[i*bs:(i+1)*bs])`` — a block is only reachable through the exact
+token prefix that produced it, so two prompts sharing block *i* but
+not block *i-1* never alias.
+
+Eviction is leaf-first LRU (an interior entry with live children is
+never evicted — its children would become unreachable and leak their
+references), triggered by the ``max_blocks`` bound and by
+``reclaim()``, the scheduler's pressure valve when the pool runs dry.
+"""
+
+import hashlib
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+class _Entry:
+    __slots__ = ("block", "parent", "tick")
+
+    def __init__(self, block: int, parent: bytes, tick: int):
+        self.block = block
+        self.parent = parent
+        self.tick = tick
+
+
+_ROOT = b""
+
+
+class PrefixCache:
+    """Full-block prefix trie over a ``BlockedAllocator``.
+
+    ``match``/``insert``/``reclaim`` are O(prefix blocks) host
+    operations on the serving admission path — no device interaction
+    anywhere in this file.
+    """
+
+    def __init__(self, block_size: int, allocator,
+                 max_blocks: int = 0):
+        if block_size <= 0:
+            raise ValueError(f"block_size must be > 0, got {block_size}")
+        self.block_size = block_size
+        self.allocator = allocator
+        # 0 = bounded only by the KV pool itself (every cached block is
+        # a live pool block, so the pool size is the hard ceiling)
+        self.max_blocks = max(0, int(max_blocks))
+        self._entries: Dict[bytes, _Entry] = {}
+        self._tick = 0
+        # stats (process-lifetime for this engine; surfaced through
+        # get_serving_report()["prefix"])
+        self.hits = 0
+        self.misses = 0
+        self.tokens_reused = 0
+        self.inserted_blocks = 0
+        self.evicted_blocks = 0
+
+    # -- hashing -------------------------------------------------------
+    def _digest(self, parent: bytes, block_tokens: np.ndarray) -> bytes:
+        h = hashlib.blake2b(parent, digest_size=16)
+        h.update(np.ascontiguousarray(block_tokens, np.int32).tobytes())
+        return h.digest()
+
+    # -- introspection -------------------------------------------------
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._entries)
+
+    def cached_block_ids(self) -> List[int]:
+        return [e.block for e in self._entries.values()]
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": self.hits / total if total else 0.0,
+            "tokens_reused": self.tokens_reused,
+            "cached_blocks": len(self._entries),
+            "inserted_blocks": self.inserted_blocks,
+            "evicted_blocks": self.evicted_blocks,
+        }
+
+    # -- the reuse path ------------------------------------------------
+    def match(self, tokens: np.ndarray) -> Tuple[List[int], int]:
+        """Longest cached full-block prefix of ``tokens`` ->
+        ``(block_ids, n_tokens)``.
+
+        Capped at ``len(tokens) - 1``: at least one prompt token must
+        flow through the forward so the request has a last-token row to
+        sample its first output from (a fully cached prompt would have
+        nothing to put on device). Matched entries are LRU-touched; the
+        hit/miss counters record the outcome. The caller owns taking
+        references (``DSStateManager.adopt_prefix``) — ``match`` itself
+        never mutates ownership."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        n_max = max(0, (len(tokens) - 1) // bs)
+        blocks: List[int] = []
+        parent = _ROOT
+        self._tick += 1
+        for i in range(n_max):
+            d = self._digest(parent, tokens[i * bs:(i + 1) * bs])
+            e = self._entries.get(d)
+            if e is None:
+                break
+            e.tick = self._tick
+            blocks.append(e.block)
+            parent = d
+        n_tokens = len(blocks) * bs
+        if n_tokens:
+            self.hits += 1
+            self.tokens_reused += n_tokens
+        else:
+            self.misses += 1
+        return blocks, n_tokens
+
+    def insert(self, tokens: np.ndarray, blocks: List[int]) -> int:
+        """Register ``tokens``' full-block prefix, mapping block *i* of
+        the chain to ``blocks[i]`` (a live block owned by the sequence
+        that just prefilled it; the cache increfs it).
+
+        Chains already present keep their canonical block (no re-map,
+        no extra reference) — for an ADOPTED sequence the leading
+        entries are exactly such re-walks of its own shared span.
+        Returns the number of newly registered blocks."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        n_full = min(len(tokens) // bs, len(blocks))
+        parent = _ROOT
+        fresh = 0
+        self._tick += 1
+        for i in range(n_full):
+            d = self._digest(parent, tokens[i * bs:(i + 1) * bs])
+            e = self._entries.get(d)
+            if e is None:
+                self.allocator.incref([blocks[i]])
+                self._entries[d] = _Entry(blocks[i], parent, self._tick)
+                fresh += 1
+                self.inserted_blocks += 1
+            else:
+                e.tick = self._tick
+            parent = d
+        if self.max_blocks and len(self._entries) > self.max_blocks:
+            self._evict(count=len(self._entries) - self.max_blocks)
+        return fresh
+
+    # -- eviction ------------------------------------------------------
+    def _leaves(self) -> List[bytes]:
+        """Digests with no live children, LRU-first."""
+        parents = {e.parent for e in self._entries.values()}
+        return sorted((d for d in self._entries if d not in parents),
+                      key=lambda d: self._entries[d].tick)
+
+    def _evict(self, count: int = 0, need_free: int = 0) -> int:
+        """Leaf-first LRU eviction, two modes:
+
+        * ``count`` (the ``max_blocks`` size bound): evict that many
+          entries regardless of sharing — a dropped reference on a
+          still-shared block frees nothing but the TRIE must shrink;
+        * ``need_free`` (the scheduler's reclaim): evict ONLY leaf
+          entries whose block nothing else references — evicting a
+          shared entry frees zero pool blocks while destroying the hot
+          mapping every adopter proves is worth keeping — until
+          ``need_free`` blocks returned to the free list or no
+          reclaimable leaf remains.
+
+        Returns blocks returned to the free list."""
+        freed = 0
+        evicted = 0
+        while self._entries:
+            if count and evicted >= count:
+                break
+            if need_free and freed >= need_free:
+                break
+            leaves = self._leaves()
+            if need_free:
+                leaves = [d for d in leaves
+                          if self.allocator.refcount(
+                              self._entries[d].block) == 1]
+            if not leaves:
+                break
+            d = leaves[0]
+            e = self._entries.pop(d)
+            before = self.allocator.free_blocks
+            self.allocator.free([e.block])
+            freed += self.allocator.free_blocks - before
+            evicted += 1
+            self.evicted_blocks += 1
+        return freed
+
+    def reclaim(self, n_blocks: int) -> int:
+        """Pressure valve for the scheduler: give back up to
+        ``n_blocks`` pool blocks by evicting LRU leaf entries whose
+        blocks nothing else references. Returns blocks actually freed
+        (0 when every cached block is still shared with a live
+        sequence)."""
+        if n_blocks <= 0 or not self._entries:
+            return 0
+        return self._evict(need_free=n_blocks)
+
+    def clear(self) -> int:
+        """Drop every entry (refcounts released through the
+        allocator). Returns blocks returned to the free list."""
+        return self._evict(count=len(self._entries)) if self._entries \
+            else 0

@@ -1,0 +1,133 @@
+"""Typed request lifecycle for the serving front-end.
+
+The state machine (reference shape: MII's request lifecycle over the
+FastGen engine — a request is a long-lived object with observable
+progress, not one dict entry in a batch call)::
+
+    QUEUED --> PREFILL --> DECODE --> FINISHED
+      |           |           |
+      +--> SHED   +-----------+--> CANCELLED
+
+* ``QUEUED``  — submitted, waiting for the admission gate.
+* ``PREFILL`` — joined the in-flight ragged batch; prompt chunks are
+  being staged/dispatched (Dynamic SplitFuse may spread them over
+  several steps).
+* ``DECODE``  — first token delivered; generating.
+* ``FINISHED`` — budget exhausted or EOS emitted.
+* ``CANCELLED`` — ``cancel()``d by the caller (mid-prefill or
+  mid-decode; KV blocks freed immediately).
+* ``SHED``    — refused by admission (capacity, deadline, or SLO
+  shedding); resubmittable verbatim.
+
+Transitions are validated: an illegal edge raises instead of silently
+corrupting the front-end's bookkeeping.
+"""
+
+import dataclasses
+import enum
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+    SHED = "shed"
+
+
+TERMINAL_STATES = frozenset(
+    {RequestState.FINISHED, RequestState.CANCELLED, RequestState.SHED})
+
+_LEGAL = {
+    RequestState.QUEUED: {RequestState.PREFILL, RequestState.SHED,
+                          RequestState.CANCELLED},
+    RequestState.PREFILL: {RequestState.DECODE, RequestState.FINISHED,
+                           RequestState.CANCELLED},
+    RequestState.DECODE: {RequestState.FINISHED,
+                          RequestState.CANCELLED},
+    RequestState.FINISHED: set(),
+    RequestState.CANCELLED: set(),
+    RequestState.SHED: set(),
+}
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request. The front-end owns every mutable field;
+    callers read ``state``/``tokens`` and iterate ``TokenStream``."""
+    uid: int
+    prompt: np.ndarray
+    max_new_tokens: int = 128
+    eos_token_id: Optional[int] = None
+    sampling: Optional[object] = None       # SamplingParams or None
+    # -- per-request SLO fields (the admission gate's inputs) --
+    # higher admits first; priority > 0 is protected from SLO shedding
+    priority: int = 0
+    # wall budget (ms, from submit) to the FIRST token; a queued
+    # request whose budget already elapsed is shed, not served late
+    deadline_ms: Optional[float] = None
+    on_token: Optional[Callable[[int], None]] = None
+    # -- lifecycle (front-end managed) --
+    state: RequestState = RequestState.QUEUED
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    submitted_t: float = 0.0
+    first_token_t: Optional[float] = None
+    finished_t: Optional[float] = None
+    shed_reason: str = ""
+
+    def advance(self, new_state: RequestState) -> None:
+        if new_state not in _LEGAL[self.state]:
+            raise ValueError(
+                f"illegal request transition {self.state.name} -> "
+                f"{new_state.name} (uid {self.uid})")
+        self.state = new_state
+
+    @property
+    def done(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return (self.first_token_t - self.submitted_t) * 1e3
+
+    @property
+    def latency_ms(self) -> Optional[float]:
+        if self.finished_t is None:
+            return None
+        return (self.finished_t - self.submitted_t) * 1e3
+
+
+class TokenStream:
+    """Ordered per-request token iterator, fed from the one-step-late
+    host copy. Iterating PUMPS the front-end (``frontend.step()``)
+    whenever no undelivered token is buffered and the request is not
+    terminal, so ``for tok in frontend.stream(uid)`` drives the serve
+    loop by itself. Ends (StopIteration) at FINISHED, CANCELLED or
+    SHED — read ``request.state`` for which."""
+
+    def __init__(self, request: Request,
+                 pump: Optional[Callable[[], bool]] = None):
+        self.request = request
+        self._pump = pump
+        self._cursor = 0
+
+    def __iter__(self) -> "TokenStream":
+        return self
+
+    def __next__(self) -> int:
+        while True:
+            if self._cursor < len(self.request.tokens):
+                tok = self.request.tokens[self._cursor]
+                self._cursor += 1
+                return tok
+            if self.request.done or self._pump is None:
+                raise StopIteration
+            # a wedged front-end raises a typed ServingOverloadError
+            # from step() — the stream never spins forever
+            self._pump()

@@ -28,6 +28,22 @@ Three modes, one token-stream contract:
 Length-limited sequences never cancel speculative work: the host knows
 ``remaining`` counts up front and simply stops scheduling a sequence
 whose in-flight emission is its last. Only EOS is discovered late.
+
+The lookahead machinery here is REUSABLE: ``TokenRef``/``StepRecord``
+(the device-token handle and per-dispatch host record),
+``trim_prompts``/``emit_token`` (the shared cursor + emission
+semantics the bitwise-equivalence contract lives in),
+``base_key_for``/``dispatch_guarded``/``stuck_error`` (PRNG seeding,
+the watchdog-wrapped dispatch, the typed saturation terminal). The
+open-world serving front-end (``serving/frontend.py``) composes the
+same pieces into a persistent, join/leave-mid-flight loop — the
+fixed-cohort ``_run_lookahead`` below is its closed-world special
+case.
+
+With the engine's prefix cache enabled, ``run_serving_loop`` adopts
+each new prompt's cached full-block head before scheduling (skipping
+prefill compute + KV for the shared span) and registers every
+completed prompt's head for later requests — see serving/prefix.py.
 """
 
 import dataclasses
@@ -51,7 +67,7 @@ from .ragged_manager import SchedulingError, SchedulingResult  # noqa: F401 — 
 from ...runtime.transfer.engine import start_host_copy as _start_host_copy
 
 
-class _Ref:
+class TokenRef:
     """A token that exists on device but not yet on host: row ``slot``
     of the in-flight step's [S] sampled-token array."""
     __slots__ = ("step", "slot")
@@ -62,7 +78,7 @@ class _Ref:
 
 
 @dataclasses.dataclass
-class _Step:
+class StepRecord:
     """Host record of one dispatched forward."""
     uids: List[int]
     emit: List[bool]               # row emits (decode / final chunk)
@@ -72,7 +88,13 @@ class _Step:
     cancelled: Set[int] = dataclasses.field(default_factory=set)
 
 
-def _base_key(sampling):
+# former private names, kept importable (the front-end and any older
+# callers address the same machinery)
+_Ref = TokenRef
+_Step = StepRecord
+
+
+def base_key_for(sampling):
     """One PRNG base key per run. A per-uid dict may set seeds too —
     they must agree (keys are threaded per (seed, uid, position), so a
     single base key serves every row); conflicting seeds raise rather
@@ -94,6 +116,29 @@ def _base_key(sampling):
     return jax.random.PRNGKey(0 if seed is None else seed)
 
 
+_base_key = base_key_for
+
+
+def adopt_prefixes(engine, pending: Dict[int, np.ndarray]
+                   ) -> Dict[int, np.ndarray]:
+    """Prefix-cache adoption for a batch of NEW prompts: returns the
+    pending map with each prompt replaced by its unserved tail (shared
+    full-block heads mapped into the new sequences' block tables). On
+    any failure mid-batch the already-adopted sequences are flushed —
+    a rejected run must leave the engine exactly as it found it."""
+    if engine.prefix_cache is None:
+        return pending
+    adopted: Dict[int, np.ndarray] = {}
+    try:
+        for uid, prompt in pending.items():
+            adopted[uid] = engine.adopt_prefix(uid, prompt)
+    except Exception:
+        for uid in adopted:
+            engine.flush(uid)
+        raise
+    return adopted
+
+
 def run_serving_loop(engine, prompts, *, max_new_tokens: int,
                      eos_token_id: Optional[int], sampling,
                      mode: str,
@@ -109,7 +154,7 @@ def run_serving_loop(engine, prompts, *, max_new_tokens: int,
     if getattr(engine, "_dispatch_poisoned", False):
         # a previous dispatch blew its watchdog deadline; its worker
         # thread may still be alive inside the runtime — new runs on
-        # this engine would race it (see _dispatch)
+        # this engine would race it (see dispatch_guarded)
         raise ServingOverloadError(
             "engine poisoned by a dispatch watchdog timeout — "
             "rebuild the engine (or respawn the worker process)",
@@ -141,16 +186,28 @@ def run_serving_loop(engine, prompts, *, max_new_tokens: int,
     engine._defer_age.clear()
     if not pending:
         return out
+    # prefix-aware KV reuse: map cached full-block prompt heads into
+    # the new sequences, and register every completed prompt head
+    # (blocks exist once the final chunk's dispatch staged them)
+    full_prompts = dict(pending)
+    on_prefill_done = None
+    if engine.prefix_cache is not None:
+        pending = adopt_prefixes(engine, pending)
+
+        def on_prefill_done(uid):
+            engine.register_prefix(uid, full_prompts[uid])
     try:
         if mode == "lookahead":
             _run_lookahead(engine, pending, out, max_new_tokens,
-                           eos_token_id, sampling, metrics)
+                           eos_token_id, sampling, metrics,
+                           on_prefill_done)
         elif mode == "sync":
             _run_sync(engine, pending, out, max_new_tokens,
-                      eos_token_id, sampling, metrics)
+                      eos_token_id, sampling, metrics, on_prefill_done)
         else:
             _run_sync_host(engine, pending, out, max_new_tokens,
-                           eos_token_id, sampling, metrics)
+                           eos_token_id, sampling, metrics,
+                           on_prefill_done)
     except ServingOverloadError:
         # the run is dead but the ENGINE must stay serviceable: free
         # this run's sequences and KV blocks, or a front-end that
@@ -162,7 +219,7 @@ def run_serving_loop(engine, prompts, *, max_new_tokens: int,
     return out
 
 
-def _dispatch(engine, fn):
+def dispatch_guarded(engine, fn):
     """One serving forward dispatch: through the engine's dispatch
     watchdog (a hang raises a typed ``CollectiveTimeout`` instead of
     wedging the loop) with the ``serving.dispatch`` fault site fired
@@ -189,7 +246,10 @@ def _dispatch(engine, fn):
         raise
 
 
-def _stuck(engine, pending, reason) -> ServingOverloadError:
+_dispatch = dispatch_guarded
+
+
+def stuck_error(engine, pending, reason) -> ServingOverloadError:
     """Typed terminal overload: nothing schedulable, nothing in flight
     that could free blocks. Carries the saturation numbers a front-end
     or router needs (the collect-only drain already happened — the
@@ -200,22 +260,34 @@ def _stuck(engine, pending, reason) -> ServingOverloadError:
         kv_util=engine.kv_utilization, free_blocks=engine.free_blocks)
 
 
-def _emit(out, metrics, remaining, uid, tok, eos):
-    """THE emission semantics, shared by all three loops (the
-    bitwise-equivalence contract lives here): append, record TTFT/ITL,
-    decrement the budget, and decide finished. Callers only differ in
-    what they do with `finished` (flush now vs cancel a speculative
-    row first)."""
+_stuck = stuck_error
+
+
+def emit_token(out, metrics, remaining, uid, tok, eos, t0=None):
+    """THE emission semantics, shared by all loops AND the serving
+    front-end (the bitwise-equivalence contract lives here): append,
+    record TTFT/ITL, decrement the budget, and decide finished.
+    Callers only differ in what they do with `finished` (flush now vs
+    cancel a speculative row first). ``t0`` rebases TTFT to a
+    per-request submit time (the front-end's open-world clock; the
+    closed-world loops keep the run-start default)."""
     out[uid].append(tok)
-    metrics.record_emission(uid, first=(len(out[uid]) == 1))
+    metrics.record_emission(uid, first=(len(out[uid]) == 1), t0=t0)
     remaining[uid] -= 1
     return remaining[uid] <= 0 or (eos is not None and tok == eos)
 
 
-def _trim_prompts(pending, uids, toks):
+_emit = emit_token
+
+
+def trim_prompts(pending, uids, toks):
     """Advance prompt cursors for this step's rows at DISPATCH time.
-    Returns (emit flags, prompt token count)."""
-    emit, n_prompt = [], 0
+    Returns ``(emit flags, prompt token count, done_prompts)`` —
+    ``done_prompts`` lists uids whose FINAL prompt chunk is in this
+    step (prefill completes when the step's dispatch stages it; the
+    prefix cache registers them after that dispatch, once their KV
+    blocks exist)."""
+    emit, n_prompt, done = [], 0, []
     for uid, chunk in zip(uids, toks):
         if uid in pending:
             n_prompt += len(chunk)
@@ -226,13 +298,21 @@ def _trim_prompts(pending, uids, toks):
             else:
                 del pending[uid]
                 emit.append(True)      # final chunk: first token
+                done.append(uid)
         else:
             emit.append(True)          # decode row
-    return emit, n_prompt
+    return emit, n_prompt, done
 
 
-def _run_sync(engine, pending, out, max_new, eos, sampling, metrics):
-    base_key = _base_key(sampling)
+def _register_done(on_prefill_done, done_prompts):
+    if on_prefill_done is not None:
+        for uid in done_prompts:
+            on_prefill_done(uid)
+
+
+def _run_sync(engine, pending, out, max_new, eos, sampling, metrics,
+              on_prefill_done=None):
+    base_key = base_key_for(sampling)
     decode: Dict[int, int] = {}
     remaining = {uid: max_new for uid in out}
     while pending or decode:
@@ -242,13 +322,15 @@ def _run_sync(engine, pending, out, max_new, eos, sampling, metrics):
             if not uids:
                 # the sync loop has nothing in flight: empty schedule
                 # with live sequences is terminal, not drainable
-                raise _stuck(engine, pending,
-                             "no schedulable work (out of KV blocks)")
-            emit, n_prompt = _trim_prompts(pending, uids, toks)
+                raise stuck_error(engine, pending,
+                                  "no schedulable work (out of KV "
+                                  "blocks)")
+            emit, n_prompt, done = trim_prompts(pending, uids, toks)
         with span("serving.dispatch", n_seqs=len(uids)):
-            tokens_dev, _, recompiled = _dispatch(
+            tokens_dev, _, recompiled = dispatch_guarded(
                 engine, lambda: engine.put_sampled(
                     uids, toks, sampling=sampling, base_key=base_key))
+        _register_done(on_prefill_done, done)
         t1 = metrics.now()
         _start_host_copy(tokens_dev)
         with span("serving.collect"):
@@ -260,7 +342,7 @@ def _run_sync(engine, pending, out, max_new, eos, sampling, metrics):
                 continue
             tok = int(toks_host[row])
             n_new += 1
-            if _emit(out, metrics, remaining, uid, tok, eos):
+            if emit_token(out, metrics, remaining, uid, tok, eos):
                 decode.pop(uid, None)
                 engine.flush(uid)
             else:
@@ -275,11 +357,11 @@ def _run_sync(engine, pending, out, max_new, eos, sampling, metrics):
 
 
 def _run_lookahead(engine, pending, out, max_new, eos, sampling,
-                   metrics):
-    base_key = _base_key(sampling)
-    decode: Dict[int, object] = {}     # uid -> int | _Ref(inflight)
+                   metrics, on_prefill_done=None):
+    base_key = base_key_for(sampling)
+    decode: Dict[int, object] = {}     # uid -> int | TokenRef(inflight)
     remaining = {uid: max_new for uid in out}
-    inflight: Optional[_Step] = None
+    inflight: Optional[StepRecord] = None
 
     while pending or decode or inflight is not None:
         t0 = metrics.now()
@@ -290,7 +372,7 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
         with span("serving.schedule"):
             sched_decode = {}
             for uid, v in decode.items():
-                if isinstance(v, _Ref):
+                if isinstance(v, TokenRef):
                     assert v.step is inflight, "stale device-token ref"
                     if remaining[uid] > 1:
                         sched_decode[uid] = 0      # placeholder id
@@ -304,32 +386,34 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
             srcs = []
             for uid in uids:
                 v = decode.get(uid)
-                srcs.append(v.slot if isinstance(v, _Ref) else -1)
-            emit, n_prompt = _trim_prompts(pending, uids, toks)
+                srcs.append(v.slot if isinstance(v, TokenRef) else -1)
+            emit, n_prompt, done = trim_prompts(pending, uids, toks)
             with span("serving.dispatch", n_seqs=len(uids)):
-                tokens_dev, committed, recompiled = _dispatch(
+                tokens_dev, committed, recompiled = dispatch_guarded(
                     engine, lambda: engine.put_sampled(
                         uids, toks, src_slots=srcs,
                         prev_tokens=inflight.tokens if inflight
                         else None,
                         sampling=sampling, base_key=base_key))
+            _register_done(on_prefill_done, done)
             _start_host_copy(tokens_dev)
-            step = _Step(uids=uids, emit=emit, tokens=tokens_dev,
-                         slot={u: i for i, u in enumerate(uids)},
-                         committed={u: (n, b) for u, n, b in committed})
+            step = StepRecord(
+                uids=uids, emit=emit, tokens=tokens_dev,
+                slot={u: i for i, u in enumerate(uids)},
+                committed={u: (n, b) for u, n, b in committed})
             # every emitting row's NEXT token now lives in this step's
             # device output
             for row, uid in enumerate(uids):
                 if emit[row]:
-                    decode[uid] = _Ref(step, row)
+                    decode[uid] = TokenRef(step, row)
         elif inflight is None:
             # nothing schedulable and nothing in flight that could
             # free blocks -> genuinely stuck. (empty + inflight is the
             # graceful path: this iteration collects the in-flight
             # step — a drain — and retries the schedule next loop)
-            raise _stuck(engine, pending,
-                         "no schedulable work and nothing in flight "
-                         "(out of KV blocks)")
+            raise stuck_error(engine, pending,
+                              "no schedulable work and nothing in "
+                              "flight (out of KV blocks)")
         t1 = metrics.now()
 
         # ---- collect step k while k+1 computes (EOS/detokenization is
@@ -346,7 +430,7 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
                     continue
                 tok = int(toks_host[row])
                 n_new += 1
-                if _emit(out, metrics, remaining, uid, tok, eos):
+                if emit_token(out, metrics, remaining, uid, tok, eos):
                     if step is not None and uid in step.slot:
                         # EOS discovered one step late: cancel the
                         # speculative row already dispatched in k+1
@@ -360,7 +444,8 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
                     engine.flush(uid)
                 else:
                     cur = decode.get(uid)
-                    if isinstance(cur, _Ref) and cur.step is inflight:
+                    if isinstance(cur, TokenRef) and \
+                            cur.step is inflight:
                         decode[uid] = tok      # host-known from here on
         # blocking = this iteration waited on the most recent dispatch
         # with nothing overlapping it (drain / deferred-schedule steps)
@@ -376,7 +461,7 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
 
 
 def _run_sync_host(engine, pending, out, max_new, eos, sampling,
-                   metrics):
+                   metrics, on_prefill_done=None):
     """Legacy loop: host logits + numpy per-row sampling (kept as the
     differential reference for the device-sampled loops)."""
     from ..sampling import sample_token
@@ -391,13 +476,15 @@ def _run_sync_host(engine, pending, out, max_new, eos, sampling,
         with span("serving.schedule"):
             uids, toks = engine.schedule(pending, decode)
             if not uids:
-                raise _stuck(engine, pending,
-                             "no schedulable work (out of KV blocks)")
-            emit, n_prompt = _trim_prompts(pending, uids, toks)
+                raise stuck_error(engine, pending,
+                                  "no schedulable work (out of KV "
+                                  "blocks)")
+            emit, n_prompt, done = trim_prompts(pending, uids, toks)
         t1 = metrics.now()
         with span("serving.dispatch", n_seqs=len(uids)):
-            logits = _dispatch(
+            logits = dispatch_guarded(
                 engine, lambda: engine.put(uids, toks))  # host round-trip
+        _register_done(on_prefill_done, done)
         recompiled = engine._last_dispatch_was_compile
         t2 = metrics.now()
         n_new = 0
@@ -408,7 +495,7 @@ def _run_sync_host(engine, pending, out, max_new, eos, sampling,
                                temperature=sp.temperature,
                                top_k=sp.top_k, top_p=sp.top_p)
             n_new += 1
-            if _emit(out, metrics, remaining, uid, tok, eos):
+            if emit_token(out, metrics, remaining, uid, tok, eos):
                 decode.pop(uid, None)
                 engine.flush(uid)
             else:

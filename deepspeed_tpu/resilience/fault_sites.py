@@ -42,6 +42,12 @@ FAULT_SITES = {
         "serving-loop forward dispatch, inside the dispatch watchdog's "
         "deadline (a ``hang`` spec here is how the watchdog path is "
         "tested)",
+    "frontend.join":
+        "serving front-end: one fire per request joining the "
+        "in-flight batch, AFTER prefix adoption "
+        "(inference/v2/serving/frontend.py _join) — an injected fault "
+        "here drills the shed-without-leaking path (the handler must "
+        "flush the just-created sequence)",
     # ---- pg_sim fault domain (tools/pg_sim/pg.py) ----
     # one consume() per (step, worker slot) in rank order — ordinal
     # = step * world_size + rank, so a spec can target any worker at

@@ -284,6 +284,54 @@ class TelemetryConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class ServingPrefixConfig(DeepSpeedConfigModel):
+    """Prefix-aware KV block reuse (inference/v2/serving/prefix.py):
+    shared system-prompt heads map to shared immutable KV blocks."""
+    enabled: bool = True
+    # trie bound in cached blocks; 0 = bounded only by the KV pool
+    # (leaf-first LRU eviction past the bound, plus the scheduler's
+    # reclaim-under-pressure valve either way)
+    max_blocks: int = 0
+
+
+@dataclasses.dataclass
+class ServingConfig(DeepSpeedConfigModel):
+    """Serving front-end knobs (inference/v2/serving/), config section
+    ``serving``. See README "Serving front-end" for full semantics."""
+    # per-request defaults (overridable per submit())
+    max_new_tokens: int = 128
+    eos_token_id: int = None
+    # capacity overrides pushed onto the engine's admission gates at
+    # front-end construction; None keeps the engine config's values
+    # (max_queue_depth / admission_kv_util_threshold)
+    max_queue_depth: int = None
+    admission_kv_util_threshold: float = None
+    # what submit() does when the queue bound refuses a request:
+    # "raise" a typed ServingOverloadError (the 429/503 path) or
+    # "shed" (request returned in state SHED, resubmittable)
+    on_overload: str = "raise"
+    # -- per-request SLOs (admission gate; 0 = not enforced) --
+    # live-histogram ceilings: while the continuous TTFT/ITL p50s
+    # breach these, new priority<=0 arrivals are shed
+    ttft_slo_ms: float = 0.0
+    itl_slo_ms: float = 0.0
+    slo_shed: bool = True
+    # shed QUEUED requests whose Request.deadline_ms already elapsed
+    shed_expired_deadlines: bool = True
+    # executable pinning: "greedy" | "sampled" | "auto" (auto runs the
+    # argmax-only executable until the first sampled request joins;
+    # the switch costs exactly one recompile, then stays)
+    executable: str = "auto"
+    # PRNG base seed for sampled requests (per-row draws fold in
+    # (uid, position)); per-request seeds must agree with it
+    seed: int = None
+    # terminal requests retained (for stream()/result readers) before
+    # the oldest are dropped — the front-end's own lifetime bound
+    max_retained_requests: int = 1024
+    prefix: ServingPrefixConfig = submodel(ServingPrefixConfig)
+
+
+@dataclasses.dataclass
 class PipelineConfig(DeepSpeedConfigModel):
     """Pipeline engine knobs (reference: pipe engine config usage)."""
     stages: str = "auto"
@@ -353,6 +401,8 @@ class DeepSpeedConfig:
             d.get("elasticity", {}).get("supervisor", {}))
         self.telemetry_config = TelemetryConfig.from_dict(
             d.get("telemetry", {}))
+        self.serving_config = ServingConfig.from_dict(
+            d.get("serving", {}))
         # curriculum learning: legacy top-level section or nested under
         # data_efficiency.data_sampling (reference: data_pipeline/config.py)
         self.curriculum_config = d.get("curriculum_learning", None)

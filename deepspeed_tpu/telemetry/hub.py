@@ -208,6 +208,14 @@ class TelemetryHub:
                 self._note_alert(alert)
         return flat
 
+    def note_alert(self, alert: TelemetryAlert) -> None:
+        """Public ingest for alerts raised OUTSIDE the watcher pass —
+        e.g. the serving front-end's admission gate emits SLO-breach
+        alerts at admission time, not at sample time. Routed exactly
+        like watcher alerts (bounded log, JSONL ``kind: alert`` line,
+        recovery report)."""
+        self._note_alert(alert)
+
     def _note_alert(self, alert: TelemetryAlert) -> None:
         self.alerts.append(alert)
         logger.warning(f"telemetry alert: [{alert.severity}] "

@@ -62,6 +62,19 @@ SPAN_SITES = {
     "serving.collect":
         "the host-side token collect (np.asarray wait on the "
         "in-flight step; ~0 in lookahead steady state)",
+    # ---- serving front-end (inference/v2/serving/frontend.py) ----
+    "frontend.admit":
+        "one step's admission pass over the queued requests "
+        "(args: queued) — gate verdicts, joins and sheds nest here",
+    "frontend.join":
+        "one request joining the in-flight ragged batch (args: uid, "
+        "prompt_tokens): prefix adoption + lifecycle transition",
+    "frontend.leave":
+        "one request leaving the batch (args: uid, why=finished/"
+        "cancel): KV blocks + sequence slot freed immediately",
+    "frontend.stream":
+        "one collected step's token fan-out to the per-request "
+        "streams/callbacks (args: n_rows)",
     # ---- elastic supervisor (elasticity/supervisor.py) ----
     "supervisor.gate":
         "the pre-dispatch health gate (one per supervised step)",

@@ -1,0 +1,256 @@
+"""Prefix-aware KV block reuse: host-side invariants (no engine, no
+device) — the refcounted allocator's double-free guard, the trie's
+match/insert/evict semantics, and adopt/flush refcount conservation
+under churn. The device-facing bitwise contract lives in
+test_prefix_reuse.py."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.ragged_manager import (
+    BlockedAllocator, BlockError, DSStateManager, SchedulingError)
+from deepspeed_tpu.inference.v2.serving.prefix import PrefixCache
+
+
+class TestAllocatorRefcounts:
+
+    def test_double_free_raises_and_mutates_nothing(self):
+        """The satellite regression: freeing a block id twice used to
+        silently corrupt the free list (two sequences could be handed
+        the same block) — now a typed BlockError, with the allocator
+        untouched."""
+        a = BlockedAllocator(8)
+        got = a.allocate(3)
+        a.free(got)
+        free_before = a.free_blocks
+        with pytest.raises(BlockError, match="double-free"):
+            a.free([got[0]])
+        assert a.free_blocks == free_before
+        # a never-allocated id is the same bug
+        with pytest.raises(BlockError, match="double-free"):
+            a.free([7])
+
+    def test_duplicate_ids_in_one_free_call_rejected_atomically(self):
+        a = BlockedAllocator(8)
+        (b,) = a.allocate(1)
+        with pytest.raises(BlockError):
+            a.free([b, b])
+        # the failed call must not have dropped the single live ref
+        assert a.refcount(b) == 1
+        a.free([b])
+        assert a.free_blocks == 8
+
+    def test_shared_block_frees_on_last_reference(self):
+        a = BlockedAllocator(4)
+        (b,) = a.allocate(1)
+        a.incref([b])
+        assert a.refcount(b) == 2
+        a.free([b])
+        assert a.refcount(b) == 1
+        assert a.free_blocks == 3          # still live
+        a.free([b])
+        assert a.refcount(b) == 0
+        assert a.free_blocks == 4
+
+    def test_incref_of_free_block_raises(self):
+        a = BlockedAllocator(4)
+        with pytest.raises(BlockError, match="non-live"):
+            a.incref([2])
+        (b,) = a.allocate(1)
+        a.free([b])
+        with pytest.raises(BlockError, match="non-live"):
+            a.incref([b])
+
+
+def _cache(n_blocks=16, bs=4, max_blocks=0):
+    a = BlockedAllocator(n_blocks)
+    return PrefixCache(bs, a, max_blocks=max_blocks), a
+
+
+class TestPrefixTrie:
+
+    def test_match_walks_full_block_chain_only(self):
+        pc, a = _cache()
+        prompt = np.arange(11, dtype=np.int32)   # 2 full blocks + 3
+        blocks = a.allocate(3)
+        assert pc.insert(prompt, blocks) == 2    # only full blocks
+        got, n = pc.match(prompt)
+        assert got == blocks[:2] and n == 8
+        # divergence INSIDE block 2 -> chain stops at block 1
+        div = prompt.copy()
+        div[6] = 99
+        got, n = pc.match(div)
+        assert got == blocks[:1] and n == 4
+        # divergence in block 1 -> no match at all
+        div0 = prompt.copy()
+        div0[0] = 99
+        got, n = pc.match(div0)
+        assert got == [] and n == 0
+
+    def test_match_leaves_at_least_one_prompt_token(self):
+        """A fully cached prompt must still put >= 1 token through the
+        forward (the sampled-first-token row)."""
+        pc, a = _cache()
+        prompt = np.arange(8, dtype=np.int32)    # exactly 2 blocks
+        pc.insert(prompt, a.allocate(2))
+        got, n = pc.match(prompt)
+        assert n == 4 and len(got) == 1          # second block unmatched
+        longer = np.arange(9, dtype=np.int32)
+        got, n = pc.match(longer)
+        assert n == 8 and len(got) == 2
+
+    def test_insert_existing_chain_keeps_canonical_block(self):
+        pc, a = _cache()
+        prompt = np.arange(8, dtype=np.int32)
+        first = a.allocate(2)
+        pc.insert(prompt, first)
+        second = a.allocate(2)
+        assert pc.insert(prompt, second) == 0    # nothing new
+        got, _ = pc.match(prompt[:5])
+        assert got == [first[0]]                 # canonical mapping
+        assert a.refcount(second[0]) == 1        # no extra reference
+
+    def test_hit_miss_token_stats(self):
+        pc, a = _cache()
+        prompt = np.arange(9, dtype=np.int32)
+        pc.match(prompt)                         # cold: miss
+        pc.insert(prompt, a.allocate(3))
+        pc.match(prompt)                         # hit, 8 tokens
+        s = pc.stats()
+        assert s["hits"] == 1 and s["misses"] == 1
+        assert s["hit_rate"] == 0.5
+        assert s["tokens_reused"] == 8
+        assert s["cached_blocks"] == 2
+
+    def test_max_blocks_bound_evicts_lru_leaf_first(self):
+        pc, a = _cache(max_blocks=2)
+        p1 = np.arange(5, dtype=np.int32)
+        p2 = np.arange(100, 105, dtype=np.int32)
+        p3 = np.arange(200, 205, dtype=np.int32)
+        b1, b2, b3 = a.allocate(2), a.allocate(2), a.allocate(2)
+        pc.insert(p1, b1)
+        pc.insert(p2, b2)
+        assert pc.cached_blocks == 2
+        pc.match(p1)                 # p1 is now MRU
+        pc.insert(p3, b3)            # bound 2 -> evict LRU (p2's block)
+        assert pc.cached_blocks == 2
+        assert pc.match(p1)[1] == 4
+        assert pc.match(p2)[1] == 0  # evicted
+        assert pc.match(p3)[1] == 4
+
+    def test_interior_entry_never_evicted_before_its_child(self):
+        """Evicting a parent while its child survives would leave the
+        child unreachable (a leaked cache reference) — eviction is
+        leaf-first."""
+        pc, a = _cache()
+        prompt = np.arange(13, dtype=np.int32)   # 3 full blocks
+        blocks = a.allocate(3)
+        pc.insert(prompt, blocks)
+        # evict exactly one entry: must be the DEEPEST (block 3)
+        pc._evict(count=1)
+        got, n = pc.match(prompt)
+        assert n == 8 and got == blocks[:2]
+        assert pc.cached_blocks == 2
+
+    def test_reclaim_frees_unshared_blocks_only(self):
+        pc, a = _cache(n_blocks=8)
+        prompt = np.arange(9, dtype=np.int32)
+        blocks = a.allocate(2)
+        pc.insert(prompt, blocks)
+        a.free(blocks)               # the "sequence" releases its refs
+        assert a.free_blocks == 6    # cache still pins both
+        freed = pc.reclaim(1)
+        assert freed == 1 and a.free_blocks == 7
+        # entries whose block a live owner still shares are NOT
+        # evicted: freeing them reclaims nothing while destroying the
+        # hot mapping — reclaim skips them and stops
+        prompt2 = np.arange(50, 59, dtype=np.int32)
+        blocks2 = a.allocate(2)      # owner keeps its references
+        pc.insert(prompt2, blocks2)
+        freed = pc.reclaim(8)
+        assert freed == 1            # only the unshared leftover
+        assert pc.cached_blocks == 2  # shared chain survives
+        assert pc.match(prompt2)[1] == 8   # still a hit
+        a.free(blocks2)
+        assert pc.clear() == 2
+        assert a.free_blocks == 8
+
+    def test_clear_returns_every_cache_only_block(self):
+        pc, a = _cache()
+        prompt = np.arange(12, dtype=np.int32)
+        blocks = a.allocate(3)
+        pc.insert(prompt, blocks)
+        a.free(blocks)
+        assert pc.clear() == 3
+        assert a.free_blocks == 16
+        assert a.live_blocks == 0
+
+
+class TestManagerAdoption:
+
+    def test_adopt_flush_conserves_blocks_under_churn(self):
+        """Join/leave churn over a shared prefix: refcounts conserve
+        every block — after all sequences flush, exactly the cache's
+        pins remain, and clearing the cache restores the full pool."""
+        m = DSStateManager(n_blocks=16, block_size=4)
+        pc = PrefixCache(4, m.kv.allocator)
+        prompt = np.arange(8, dtype=np.int32)
+        # seed: a "sequence" that prefilled the prompt head
+        seed = m.get_or_create_sequence(1000)
+        m.kv.maybe_allocate(seed, 8)
+        seed.pre_forward(8)
+        seed.post_forward()
+        pc.insert(prompt, seed.blocks[:2])
+        m.flush_sequence(1000)
+        assert m.free_blocks == 14           # 2 pinned by the cache
+        for round_ in range(5):
+            uids = [10 * round_ + k for k in range(3)]
+            for uid in uids:
+                blocks, n = pc.match(np.concatenate(
+                    [prompt, [100 + uid]]).astype(np.int32))
+                assert n == 8
+                seq = m.adopt_prefix(uid, blocks, n)
+                assert seq.shared_prefix_blocks == 2
+                # private tail: one more token -> one private block
+                m.kv.maybe_allocate(seq, 1)
+                seq.pre_forward(1)
+                seq.post_forward()
+            assert m.kv.allocator.refcount(blocks[0]) == 1 + len(uids)
+            for uid in uids:
+                m.flush_sequence(uid)
+            assert m.free_blocks == 14
+        assert pc.clear() == 2
+        assert m.free_blocks == 16
+
+    def test_adopt_rejects_partial_block_span(self):
+        m = DSStateManager(n_blocks=8, block_size=4)
+        seq = m.get_or_create_sequence(1)
+        m.kv.maybe_allocate(seq, 8)
+        with pytest.raises(ValueError, match="full blocks"):
+            m.adopt_prefix(2, seq.blocks[:2], 7)
+        with pytest.raises(ValueError, match="already tracked"):
+            m.adopt_prefix(1, seq.blocks[:1], 4)
+
+    def test_adopt_failure_does_not_leak_sequence_entry(self):
+        m = DSStateManager(n_blocks=8, block_size=4)
+        with pytest.raises(BlockError):
+            m.adopt_prefix(5, [3], 4)    # block 3 was never allocated
+        assert m.get_sequence(5) is None
+
+    def test_rollback_cannot_cross_the_shared_span(self):
+        m = DSStateManager(n_blocks=8, block_size=4)
+        owner = m.get_or_create_sequence(1)
+        m.kv.maybe_allocate(owner, 8)
+        m.adopt_prefix(2, owner.blocks[:2], 8)
+        with pytest.raises(BlockError, match="shared prefix"):
+            m.rollback_tokens(2, 1, blocks_before=1)
+
+    def test_engine_full_adoption_path_is_typed(self):
+        m = DSStateManager(max_tracked_sequences=1, n_blocks=8,
+                           block_size=4)
+        owner = m.get_or_create_sequence(1)
+        m.kv.maybe_allocate(owner, 4)
+        with pytest.raises(SchedulingError):
+            m.adopt_prefix(2, owner.blocks[:1], 4)
+        # the refused adoption took no reference
+        assert m.kv.allocator.refcount(owner.blocks[0]) == 1

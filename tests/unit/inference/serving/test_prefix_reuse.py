@@ -1,0 +1,147 @@
+"""Prefix-aware KV block reuse — the device-facing contract:
+
+* greedy streams are BITWISE identical with the cache on vs off
+  (including through the EOS-overshoot rollback path): shared blocks
+  hold the same KV values a private prefill would have written, and
+  the device reads them through the same fixed-shape block tables;
+* refcount conservation under serve/flush churn through the real
+  engine (`generate_batch` runs, not synthetic descriptors);
+* scheduler pressure reclaims cache-only blocks instead of failing.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+# 2 full 8-token blocks of shared head + unique tails
+SYS = list(range(1, 17))
+PROMPTS_A = {10: SYS + [31, 32, 33], 11: SYS + [41, 42]}
+PROMPTS_B = {20: SYS + [51], 21: SYS + [61, 62, 63, 64]}
+
+
+@pytest.fixture(scope="module")
+def params_cfg():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))
+    return params, cfg
+
+
+def _engine(params_cfg, prefix_cache, n_blocks=32, **kw):
+    params, cfg = params_cfg
+    return InferenceEngineV2(
+        params, cfg,
+        RaggedInferenceEngineConfig(
+            token_budget=32, max_ragged_sequence_count=4,
+            n_kv_blocks=n_blocks, kv_block_size=8,
+            max_blocks_per_seq=8, kv_dtype="float32",
+            prefix_cache=prefix_cache, **kw))
+
+
+def _clean(engine, cached=0):
+    assert not engine._state_manager.tracked_sequences
+    assert engine.free_blocks == engine._config.n_kv_blocks - cached
+
+
+class TestBitwiseReuse:
+
+    def test_streams_identical_with_reuse_on_vs_off(self, params_cfg):
+        off = _engine(params_cfg, False)
+        ref_a = off.generate_batch(dict(PROMPTS_A), max_new_tokens=6)
+        _clean(off)
+        ref_b = off.generate_batch(dict(PROMPTS_B), max_new_tokens=6)
+        _clean(off)
+
+        on = _engine(params_cfg, True)
+        # run 1: cold cache (intra-batch arrivals register, later
+        # requests in the SAME batch may already hit)
+        got_a = on.generate_batch(dict(PROMPTS_A), max_new_tokens=6)
+        assert got_a == ref_a
+        st = on.prefix_cache.stats()
+        assert st["cached_blocks"] == 2
+        # run 2: warm cache — both requests adopt the 16-token head
+        got_b = on.generate_batch(dict(PROMPTS_B), max_new_tokens=6)
+        assert got_b == ref_b
+        st = on.prefix_cache.stats()
+        assert st["hits"] >= 2
+        assert st["tokens_reused"] >= 32
+        _clean(on, cached=st["cached_blocks"])
+
+    def test_eos_overshoot_rollback_path_with_reuse(self, params_cfg):
+        """EOS discovered one step late on an ADOPTED sequence: the
+        speculative row's rollback frees only private blocks, streams
+        still match the cache-off run bitwise."""
+        off = _engine(params_cfg, False)
+        probe = off.generate_batch(dict(PROMPTS_A), max_new_tokens=6)
+        _clean(off)
+        eos = probe[10][2]          # mid-stream token -> late EOS
+        ref = off.generate_batch(dict(PROMPTS_A), max_new_tokens=6,
+                                 eos_token_id=eos)
+        _clean(off)
+
+        on = _engine(params_cfg, True)
+        on.generate_batch(dict(PROMPTS_A), max_new_tokens=2)  # seed
+        got = on.generate_batch(dict(PROMPTS_A), max_new_tokens=6,
+                                eos_token_id=eos)
+        assert got == ref
+        assert len(got[10]) == 3 and got[10][-1] == eos
+        rep = on.get_serving_report()
+        assert rep["cancelled_speculative_steps"] >= 1
+        assert rep["prefix"]["hits"] >= 2
+        _clean(on, cached=on.prefix_cache.stats()["cached_blocks"])
+
+    def test_sampled_streams_identical_with_reuse(self, params_cfg):
+        from deepspeed_tpu.inference.sampling import SamplingParams
+        sp = SamplingParams(temperature=1.3, top_k=16, seed=11)
+        off = _engine(params_cfg, False)
+        ref = off.generate_batch(dict(PROMPTS_A), max_new_tokens=5,
+                                 sampling=sp)
+        _clean(off)
+        on = _engine(params_cfg, True)
+        on.generate_batch(dict(PROMPTS_A), max_new_tokens=2,
+                          sampling=sp)          # seed the cache
+        got = on.generate_batch(dict(PROMPTS_A), max_new_tokens=5,
+                                sampling=sp)
+        # draws are (seed, uid, position)-keyed: adoption shifts WHICH
+        # positions run, never the key of a sampled position
+        assert got == ref
+
+
+class TestRefcountChurn:
+
+    def test_serve_flush_churn_conserves_every_block(self, params_cfg):
+        eng = _engine(params_cfg, True)
+        for r in range(4):
+            prompts = {100 * r + k: SYS + [70 + 10 * r + k]
+                       for k in range(3)}
+            out = eng.generate_batch(prompts, max_new_tokens=3)
+            assert all(len(v) == 3 for v in out.values())
+            _clean(eng, cached=eng.prefix_cache.stats()["cached_blocks"])
+        st = eng.prefix_cache.stats()
+        assert st["hits"] >= 9        # rounds 2-4 all hit (3 each)
+        # cache pins exactly its entries; clearing restores the pool
+        assert eng.prefix_cache.clear() == st["cached_blocks"]
+        assert eng.free_blocks == eng._config.n_kv_blocks
+        assert eng._state_manager.kv.allocator.live_blocks == 0
+
+    def test_scheduler_reclaims_cache_blocks_under_pressure(
+            self, params_cfg):
+        """A pool mostly pinned by the cache must serve new work: the
+        scheduler evicts cache-only blocks instead of raising
+        OutOfKVBlocks."""
+        eng = _engine(params_cfg, True, n_blocks=8)
+        long_head = list(range(1, 41))           # 5 blocks cached
+        eng.generate_batch({1: long_head + [99]}, max_new_tokens=2)
+        assert eng.prefix_cache.stats()["cached_blocks"] == 5
+        assert eng.free_blocks == 3
+        # an unrelated prompt needing 5 blocks forces reclaim
+        out = eng.generate_batch(
+            {2: [200 + i for i in range(33)]}, max_new_tokens=2)
+        assert len(out[2]) == 2
+        assert eng.prefix_cache.stats()["evicted_blocks"] >= 2
+        _clean(eng, cached=eng.prefix_cache.stats()["cached_blocks"])

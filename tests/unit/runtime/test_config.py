@@ -78,3 +78,27 @@ def test_scheduler_section():
     cfg = DeepSpeedConfig({"scheduler": {"type": "WarmupLR", "params": {
         "warmup_min_lr": 0, "warmup_max_lr": 0.001, "warmup_num_steps": 1000}}})
     assert cfg.scheduler_config.type == "WarmupLR"
+
+
+def test_serving_section():
+    cfg = DeepSpeedConfig({
+        "serving": {
+            "max_queue_depth": 64,
+            "ttft_slo_ms": 350.0,
+            "executable": "greedy",
+            "prefix": {"enabled": False, "max_blocks": 128},
+        },
+    })
+    sc = cfg.serving_config
+    assert sc.max_queue_depth == 64
+    assert sc.ttft_slo_ms == 350.0
+    assert sc.executable == "greedy"
+    assert sc.prefix.enabled is False
+    assert sc.prefix.max_blocks == 128
+    # defaults: admission overrides unset (keep the engine's), shed
+    # policy on, prefix reuse on
+    d = DeepSpeedConfig({}).serving_config
+    assert d.max_queue_depth is None
+    assert d.admission_kv_util_threshold is None
+    assert d.slo_shed is True and d.prefix.enabled is True
+    assert d.on_overload == "raise"

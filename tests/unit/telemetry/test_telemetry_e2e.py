@@ -227,11 +227,14 @@ class TestReportSchemas:
             "prompt_tokens", "recompiles", "blocking_syncs",
             "steady_steps", "steady_blocking_syncs",
             "steady_decode_tps", "cancelled_speculative_steps",
-            "admission", "dispatch_ms", "sync_wait_ms", "step_ms",
+            "admission", "requests", "request_latency_ms",
+            "dispatch_ms", "sync_wait_ms", "step_ms",
             "ttft_ms", "itl_ms", "queue_depth", "kv_util",
             "process_memory"}
         assert set(rep["admission"]) == {"requested", "admitted",
                                          "shed", "shed_uids"}
+        assert set(rep["requests"]) == {"submitted", "finished",
+                                        "cancelled", "shed"}
 
     def test_process_memory_keys(self, setup):
         for rep in (setup["engine"].get_schedule_report(),

@@ -74,6 +74,22 @@ class TestCompare:
         with pytest.raises(ValueError):
             parse_per_config("4:0.3")
 
+    def test_tracked_config_cannot_silently_vanish(self):
+        """7_frontend is implicitly required once the OLD artifact has
+        it: a new artifact that dropped the row fails the gate.
+        Artifacts predating it still compare clean."""
+        old = {"1": _row(1.0), "7_frontend": _row(1.2)}
+        _, reg, miss = compare(old, {"1": _row(1.0)}, 0.10, {}, set())
+        assert miss == ["7_frontend"] and reg == []
+        _, _, miss = compare(old, {"1": _row(1.0),
+                                   "7_frontend": _row(1.15)},
+                             0.10, {}, set())
+        assert miss == []
+        # pre-introduction lineage: absent from BOTH sides is clean
+        _, _, miss = compare({"1": _row(1.0)}, {"1": _row(1.0)},
+                             0.10, {}, set())
+        assert miss == []
+
     def test_missing_config_skipped_unless_required(self):
         rows, reg, miss = compare({"1": _row(1.0)},
                                   {"1": _row(1.0),

@@ -9,7 +9,13 @@ tool makes "did this PR regress a tracked config" a command:
     python tools/bench_compare.py BENCH_r05.json BENCH_r06.json
     python tools/bench_compare.py old.json new.json \\
         --threshold 0.10 --per-config 4=0.25,5_int4=0.30 \\
-        --require 1,3,4
+        --require 1,3,4,7_frontend
+
+``TRACKED_CONFIGS`` lists configs that must never silently VANISH:
+once one appears in the old artifact it is implicitly ``--require``d,
+so a future run that drops it (a refactor losing the bench wiring)
+fails the gate instead of passing with one fewer row. Artifacts
+predating a tracked config still compare clean.
 
 Accepts both artifact shapes: the raw bench head (``bench.py``'s JSON
 line, configs under ``"configs"``) and the driver wrapper
@@ -66,9 +72,15 @@ def parse_per_config(text):
     return out
 
 
+# configs that must not vanish from the lineage: present in the old
+# artifact -> required comparable in the new one (see module docstring)
+TRACKED_CONFIGS = ("7_frontend",)
+
+
 def compare(old, new, threshold, per_config, require):
     """-> (rows, regressions, missing_required); each row is a dict
     for the report table."""
+    require = set(require) | {k for k in TRACKED_CONFIGS if k in old}
     rows, regressions, missing = [], [], []
     # required configs absent from BOTH sides must still surface (a
     # gate that silently passes when the scored row vanished from the
