@@ -326,18 +326,26 @@ def bench_config4():
             # grad_d2h at 24.1 s vs param_h2d 9.6 s with int8 down),
             # block-int4 DELTA params UP (error-feedback mirror,
             # 0.625 B/param; r4 A/B vs int8_delta: 15.8 s -> 10.1 s).
-            # transfer: the bucketed double-buffered wire (fused
-            # fixed-size buckets instead of per-leaf copies — the r5
-            # decomposition blamed per-leaf dispatch for grad_d2h
-            # 22.5 s / residue 7.6 s); explicit here so the tracked
-            # config pins the bucket size, and A/B vs the per-leaf
-            # wire is one flag ("enabled": false)
+            # transfer: the STREAMED wire (round 6) — the r5 bucketed
+            # wire still paid the whole download after the step (the
+            # pack program consumes the step's outputs; decomposition:
+            # grad_d2h 22.5 s / residue 7.6 s), so the streamed wire
+            # drops the pack and kicks every grad's d2h from the
+            # dispatch thread the instant dispatch returns, consumed
+            # per layer group so the host Adam pipelines against
+            # later layers' copies (runtime/transfer/streaming.py).
+            # The decomposition now splits grad_d2h_ms into
+            # d2h_exposed_ms (serialized wire) vs d2h_overlapped_ms
+            # (hidden behind compute) — the gate wants residue, not
+            # d2h, as the tail. A/B: "streaming": false restores the
+            # r5 bucketed wire, "enabled": false the per-leaf wire.
             "offload_optimizer": {"device": "cpu",
                                   "delayed_update": True,
                                   "grad_dtype": "int4",
                                   "upload_dtype": "int4_delta",
                                   "transfer": {"enabled": True,
-                                               "bucket_mb": 64}},
+                                               "bucket_mb": 64,
+                                               "streaming": True}},
         },
         "gradient_clipping": 1.0,
         "steps_per_print": 0,

@@ -571,7 +571,10 @@ class DeepSpeedEngine:
             grad_bits=4 if gd == "int4" else 8,
             int8_delta_upload=ud.endswith("_delta"),
             delta_bits=4 if ud == "int4_delta" else 8,
-            transfer=self._offload_cfg.transfer)
+            transfer=self._offload_cfg.transfer,
+            # leaf names key the streamed wire's per-layer grouping
+            # (zero/schedule.py offload_wire_groups)
+            leaf_names=[n for n, _ in named_leaves(master)])
         master = self._offload.initial_device_leaves(master)
         flat, treedef = jax.tree_util.tree_flatten(master)
         device_mask = jax.tree_util.tree_unflatten(
@@ -1963,6 +1966,16 @@ class DeepSpeedEngine:
             # device build_optimizer default — get_lr()'s 0.0 fallback
             # would silently freeze offloaded leaves)
             lr = self.get_lr()[0] if self.lr_scheduler is not None else None
+            # streamed wire: kick every offloaded grad's d2h copy NOW,
+            # on the dispatch thread, before any other host work (the
+            # merge below can take ms) — the async copies ride DMA
+            # while the device still computes. The probe (a scalar
+            # output of the same program) marks device-done for the
+            # exposed/overlapped attribution. No-op (None) unless
+            # transfer.streaming is on.
+            probe = metrics["loss"]
+            stream_tok = self._offload.kick_stream(off_grads,
+                                                   probe=probe)
             if self._offload_cfg.delayed_update:
                 # DPU: merge LAST step's host update (its download/Adam/
                 # upload overlapped this step's device compute), then
@@ -1974,10 +1987,12 @@ class DeepSpeedEngine:
                 # step N-1 — the one coherent instant in DPU mode
                 self._verify_offload_if_armed()
                 self._offload_future = self._offload.apply_grads_async(
-                    self.state.master_params, off_grads, lr=lr, skip=skip)
+                    self.state.master_params, off_grads, lr=lr,
+                    skip=skip, stream=stream_tok, probe=probe)
             else:
                 new_master = self._offload.apply_grads(
-                    self.state.master_params, off_grads, lr=lr, skip=skip)
+                    self.state.master_params, off_grads, lr=lr,
+                    skip=skip, stream=stream_tok, probe=probe)
                 self.state = self.state._replace(master_params=new_master)
                 self._verify_offload_if_armed()
         self.timers(TRAIN_BATCH_TIMER).stop(sync=True)

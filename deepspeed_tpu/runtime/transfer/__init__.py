@@ -1,5 +1,6 @@
 """Bucketed double-buffered transfer engine (see engine.py for the
-design note). Consumers: ZeRO-Offload's host step and NVMe tier
+design note) plus the streaming grad wire's windowed schedule
+(streaming.py). Consumers: ZeRO-Offload's host step and NVMe tier
 (runtime/zero/offload.py), the comm facade's gradient-coalescing eager
 path (comm/comm.py all_reduce_coalesced)."""
 
@@ -7,3 +8,5 @@ from .bucketizer import (ArrivalTracker, BucketPlan, FillTracker,  # noqa: F401
                          StreamPlan, bucket_ranges)
 from .engine import TransferEngine, start_host_copy  # noqa: F401
 from .staging import StagingPair  # noqa: F401
+from .streaming import (StreamSchedule, WireClock, WireGroup,  # noqa: F401
+                        build_wire_groups)

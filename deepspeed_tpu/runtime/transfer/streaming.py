@@ -1,0 +1,221 @@
+"""Streaming grad wire — windowed per-layer download schedule.
+
+The bucketed wire (engine.py in this package) fuses the grad download
+into a few large copies, but the fused pack is a compiled program that
+CONSUMES the train step's outputs: no byte can move until the whole
+step (and the pack behind it) has retired, so the wire is paid
+serially after the device (BENCH_r05 config 4: grad_d2h 22.5 s +
+overlap residue 7.6 s of a ~39 s step). The reference hides this cost
+by pipelining grad transfer with backward compute (ZeRO-Offload's
+overlap loop, stage_1_and_2.py grad-hook buckets).
+
+The streaming translation keeps the main-thread dispatch rule from the
+bucketed wire (compiled programs dispatch from ONE thread) but drops
+the pack: the step's per-leaf grad outputs ARE the wire tensors, and
+``copy_to_host_async`` is issued on each of them from the main thread
+immediately after the step dispatch returns — the async copies ride
+device->host DMA while the device is still computing (this step's
+remaining backward on runtimes with per-buffer definition events; the
+next step's compute in delayed-update mode). Arrival is tracked per
+LAYER group — the per-layer grad subtrees the layer-scan schedule
+emits (zero/schedule.py ``offload_wire_groups``) — so the host Adam
+for layer *i* starts the moment layer *i*'s grads land, pipelined
+against later layers' copies and the bucketed H2D upload.
+
+Pieces:
+
+* :class:`WireGroup` / :class:`StreamSchedule` — the windowed stream
+  plan: groups in expected arrival order, a kick window bounding how
+  many groups' copies are in flight (0 = kick everything up front),
+  and per-group arrival accounting.
+* :class:`WireClock` — host-observable overlap attribution: splits the
+  wire window into ``d2h_exposed_ms`` (host-blocking wall spent after
+  the producing device step finished — the true serialized wire cost)
+  and ``d2h_overlapped_ms`` (the remainder of the wire window: copy
+  time hidden behind device compute or pipelined host work). The
+  device-done edge comes from a 4-byte probe output of the same
+  program, awaited on a watcher thread (a transfer, safe off the
+  dispatch thread).
+
+The streamed wire only changes WHEN bytes move and WHEN each slot's
+host Adam runs — decode, Adam and upload staging are the same
+functions as the per-leaf and bucketed wires, so it is bit-identical
+to both (asserted in tests/unit/runtime/zero/test_offload_streaming.py).
+"""
+
+import threading
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ...telemetry.trace import tracer
+from ...utils.logging import logger
+
+_probe_warned = [False]  # unbounded-ok: single warn-once flag cell, never grows past one element
+
+
+class _ProbeWatcher:
+    """ONE long-lived daemon thread servicing every wire clock's
+    device-done probe (a fresh thread per train step would be per-step
+    churn on the offload hot path). FIFO matches completion order —
+    the device retires steps in dispatch order — so each clock's
+    ``t_done`` lands accurate even when a DPU step's probe queues
+    behind the previous one. Probe waits are transfers (thread-safe;
+    no program dispatch ever happens here)."""
+
+    def __init__(self):
+        import queue
+        self._q = queue.Queue()   # drains every step; never grow-only
+        self._thread = None
+        self._lock = threading.Lock()
+
+    def submit(self, probe, clock) -> None:
+        if self._thread is None:
+            with self._lock:
+                if self._thread is None:
+                    t = threading.Thread(target=self._run,
+                                         name="wire-clock-probe",
+                                         daemon=True)
+                    t.start()
+                    self._thread = t
+        self._q.put((probe, clock))
+
+    def _run(self):
+        while True:
+            probe, clock = self._q.get()
+            try:
+                np.asarray(probe)  # a transfer: safe off-thread
+            except Exception as e:
+                # attribution probe only — a failed wait degrades the
+                # split (t_done = now), never the step itself
+                if not _probe_warned[0]:
+                    _probe_warned[0] = True
+                    logger.warning(
+                        "wire-clock probe wait failed "
+                        f"({type(e).__name__}: {e}); the d2h exposed/"
+                        "overlapped split degrades to conservative")
+            clock.t_done = time.perf_counter()
+            tracer.instant("transfer.device_done")
+
+
+_probe_watcher = _ProbeWatcher()
+
+
+class WireGroup:
+    """One arrival unit of the streamed wire: a layer's offloaded
+    slots, plus the flat wire-tensor indices they own (``per_leaf``
+    tensors per slot — 2 for the int8/int4 grad wire's (q, scales))."""
+
+    def __init__(self, label: str, slots: Sequence[int], per_leaf: int):
+        self.label = str(label)
+        self.slots = list(slots)
+        self.entries = [s * per_leaf + j
+                        for s in self.slots for j in range(per_leaf)]
+
+    def __repr__(self):
+        return f"WireGroup({self.label!r}, slots={self.slots})"
+
+
+def build_wire_groups(slot_layers: Sequence[Optional[int]],
+                      per_leaf: int) -> List[WireGroup]:
+    """Slot groups in expected arrival (backward-completion) order.
+
+    ``slot_layers[slot]`` is the layer index parsed from the leaf name
+    (zero/schedule.py ``layer_index_of``) or None for non-layer leaves
+    (embeddings, final norm, lm head). Backward produces the LAST
+    layer's grads first, so layers are ordered descending; the
+    non-layer leaves — which straddle both ends of the backward (head
+    first, embedding last) — form one trailing group. When no leaf
+    carries a layer index (toy trees), every slot becomes its own
+    group in reverse flatten order — flatten order roughly follows the
+    forward, so its reverse approximates the backward."""
+    layers = sorted({l for l in slot_layers if l is not None},
+                    reverse=True)
+    if not layers:
+        return [WireGroup(f"slot{s}", [s], per_leaf)
+                for s in range(len(slot_layers) - 1, -1, -1)]
+    groups = [WireGroup(f"layer{l}",
+                        [s for s, sl in enumerate(slot_layers)
+                         if sl == l], per_leaf)
+              for l in layers]
+    rest = [s for s, sl in enumerate(slot_layers) if sl is None]
+    if rest:
+        groups.append(WireGroup("rest", rest, per_leaf))
+    return groups
+
+
+class StreamSchedule:
+    """Windowed kick order over the wire groups.
+
+    ``window`` bounds how many groups' async copies are in flight at
+    once (a DRAM bound: each kicked group stages its bytes in PJRT
+    host memory until consumed). 0 — the default — kicks every group
+    up front for maximum overlap; ``window=w`` kicks the first ``w``
+    and releases group ``k+w`` when group ``k`` completes. Kicks are
+    transfers (``copy_to_host_async``), safe from any thread — only
+    compiled-program dispatch is single-threaded."""
+
+    def __init__(self, groups: Sequence[WireGroup], window: int = 0):
+        if window < 0:
+            raise ValueError(f"stream window must be >= 0, got {window}")
+        self.groups = list(groups)
+        self.window = int(window)
+        self._kicked = 0
+
+    def take_initial(self) -> List[WireGroup]:
+        """Groups whose copies start at dispatch time (main thread)."""
+        n = len(self.groups) if self.window == 0 \
+            else min(self.window, len(self.groups))
+        out = self.groups[self._kicked:n]
+        self._kicked = max(self._kicked, n)
+        return out
+
+    def take_next(self) -> List[WireGroup]:
+        """Groups released by one group completing (windowed mode)."""
+        if self.window == 0 or self._kicked >= len(self.groups):
+            return []
+        out = [self.groups[self._kicked]]
+        self._kicked += 1
+        return out
+
+
+class WireClock:
+    """Host-observable d2h overlap attribution (see module docstring).
+
+    Timeline: ``kick()`` stamps when the copies were issued (right
+    after the step dispatch returned) and arms the device-done probe;
+    ``note_wait`` records each blocking arrival wait; ``split()``
+    returns the exposed/overlapped decomposition. All stamps are
+    ``time.perf_counter()`` seconds on this host — the same clock the
+    breakdown's other legs use."""
+
+    def __init__(self):
+        self.t_kick = None
+        self.t_done = None
+        self._waits = []
+        self._t_last = None
+
+    def kick(self, probe=None) -> None:
+        self.t_kick = time.perf_counter()
+        if probe is not None:
+            _probe_watcher.submit(probe, self)
+
+    def note_wait(self, t0: float, t1: float) -> None:
+        self._waits.append((t0, t1))
+        self._t_last = t1 if self._t_last is None else max(self._t_last, t1)
+
+    def split(self) -> dict:
+        """``d2h_exposed_ms``: blocking wait wall after the device
+        finished (what a perfect wire would save). ``d2h_overlapped_ms``:
+        the rest of the wire window (kick -> last arrival) — copy time
+        absorbed by device compute or pipelined host work. Without a
+        probe (or before it lands) every blocking wait counts as
+        exposed — the conservative reading."""
+        if self.t_kick is None or self._t_last is None:
+            return {"d2h_exposed_ms": 0.0, "d2h_overlapped_ms": 0.0}
+        done = self.t_done if self.t_done is not None else self.t_kick
+        exposed = sum(max(0.0, b - max(a, done)) for a, b in self._waits)
+        window = self._t_last - self.t_kick
+        return {"d2h_exposed_ms": exposed * 1e3,
+                "d2h_overlapped_ms": max(0.0, window - exposed) * 1e3}

@@ -62,12 +62,31 @@ class DeepSpeedZeroOffloadTransferConfig(DeepSpeedConfigModel):
     # fused bucket size; fractional MB allowed (tests force multi-
     # bucket schedules on tiny trees with e.g. 0.001)
     bucket_mb: float = 64.0
+    # streaming grad wire (runtime/transfer/streaming.py): the grad
+    # d2h copies are kicked per-leaf from the dispatch thread the
+    # instant the step dispatch returns — no pack program serialized
+    # behind the step — and consumed per LAYER group so the host Adam
+    # for layer i starts as layer i's grads land, pipelined against
+    # later layers' copies and the fused H2D upload. Default off;
+    # bit-identical to the bucketed/per-leaf wires (asserted in
+    # tests). DRAM tier only; requires ``enabled: true`` (the upload
+    # direction rides the fused bucket plan). The int8/int4 grad and
+    # delta-upload codecs compose with it unchanged (the opt-in lossy
+    # wire on the streaming path).
+    streaming: bool = False
+    # how many layer groups' d2h copies may be in flight at once
+    # (bounds PJRT host staging); 0 = kick every group up front
+    window: int = 0
 
     def _validate(self):
         if not float(self.bucket_mb) > 0:
             raise ValueError(
                 f"offload_optimizer.transfer.bucket_mb must be "
                 f"positive, got {self.bucket_mb!r}")
+        if int(self.window) < 0:
+            raise ValueError(
+                f"offload_optimizer.transfer.window must be >= 0 "
+                f"(0 = unwindowed), got {self.window!r}")
 
 
 @dataclasses.dataclass

@@ -14,6 +14,14 @@ grads as an extra output. This coordinator applies DeepSpeedCPUAdam to
 them on host and pushes bf16/fp16 views back via device_put. The
 ``ratio`` knob (ZeRO-Offload++ twin-flow, partial offload) selects the
 largest leaves until ``ratio`` of total elements are host-resident.
+
+Three grad wires, all bit-identical (the codecs and Adam are shared
+functions; only WHEN bytes move differs): per-leaf (transfer
+disabled), bucketed (fused fixed-size copies, ``transfer.enabled``),
+and streamed (``transfer.streaming`` — per-layer d2h kicked from the
+dispatch thread the instant the step dispatch returns, host Adam
+pipelined per layer group; runtime/transfer/streaming.py has the
+design note).
 """
 
 import concurrent.futures
@@ -27,10 +35,11 @@ import numpy as np
 from ...ops.adam.cpu_adam import DeepSpeedCPUAdam
 from ...resilience.fault_injector import fault_injector
 from ...resilience.retry import retry_io
-from ...telemetry.trace import span
+from ...telemetry.trace import span, tracer
 from ...utils.jax_compat import TRANSFER_ERRORS
 from ...utils.logging import log_dist
 from ..transfer import StagingPair, TransferEngine, start_host_copy
+from ..transfer.streaming import StreamSchedule, WireClock
 
 
 def sharding_replicated(sharding):
@@ -86,6 +95,21 @@ def select_offload_mask(params, ratio: float) -> List[bool]:
     return mask
 
 
+class _StreamToken:
+    """One step's streamed-wire state: the kicked wire tensors, the
+    windowed group schedule and the attribution clock. Created on the
+    MAIN thread by ``kick_stream`` right after the step dispatch
+    returns; consumed by the host step (worker thread in delayed
+    mode). Dropped unconsumed on an overflow skip — the in-flight
+    copies just complete into PJRT staging and die with the step's
+    output buffers."""
+
+    def __init__(self, clock, sched, arrs):
+        self.clock = clock
+        self.sched = sched
+        self.arrs = arrs
+
+
 class _PendingUpload:
     """Bucketed H2D still in flight: the staged buckets were put on the
     wire by the host-step thread, but the jitted scatter-back (a
@@ -116,7 +140,8 @@ class OffloadCoordinator:
                  grad_bits: int = 8,
                  int8_delta_upload: bool = False,
                  delta_bits: int = 8,
-                 transfer=None):
+                 transfer=None,
+                 leaf_names: Optional[List[str]] = None):
         self.mask = mask
         self.compute_dtype = compute_dtype
         self._int8_grads = bool(int8_grads)
@@ -204,15 +229,54 @@ class OffloadCoordinator:
             # device states stay bit-EQUAL.
             self._mirror = [self._round_compute(
                 np.asarray(a, np.float32)) for a in off_params]
+        # streaming grad wire (transfer/streaming.py): per-layer d2h
+        # copies kicked from the dispatch thread the instant the step
+        # dispatch returns, arrival tracked per layer group so the
+        # host Adam pipelines against later layers' copies. Default
+        # off; requires the bucketed engine (the upload direction
+        # rides its fused H2D plan) and the DRAM tier.
+        self._streaming = False
+        self._stream_window = int(getattr(transfer, "window", 0) or 0) \
+            if transfer is not None else 0
+        self._wire_groups = None
+        if transfer is not None and getattr(transfer, "streaming", False):
+            if self._transfer is None:
+                log_dist("ZeRO-Offload: transfer.streaming ignored — "
+                         "the streamed wire rides the bucketed "
+                         "engine's fused upload plan (set "
+                         "transfer.enabled: true)", ranks=[0])
+            elif self.store is not None:
+                log_dist("ZeRO-Offload: transfer.streaming ignored on "
+                         "the NVMe tier (the swap pipeline paces its "
+                         "own IO; grad download stays bucketed)",
+                         ranks=[0])
+            elif self.off_idx:
+                from .schedule import offload_wire_groups
+                self._wire_groups = offload_wire_groups(
+                    leaf_names, self.off_idx,
+                    2 if self._int8_grads else 1)
+                self._streaming = True
         n_off = sum(int(np.prod(a.shape)) for a in off_params)
         xfer = f"bucketed {self._transfer.bucket_bytes / (1 << 20):g}MB" \
             if self._transfer else "per-leaf"
+        if self._streaming:
+            xfer = (f"streamed {len(self._wire_groups)} groups "
+                    f"(window="
+                    f"{self._stream_window or 'all'}) + {xfer} h2d")
         log_dist(f"ZeRO-Offload: {len(self.off_idx)} leaves "
                  f"({n_off/1e6:.2f}M params) "
                  f"{'NVMe' if self.store else 'host'}-resident "
                  f"(native={'yes' if self.host_adam.native else 'numpy'}, "
                  f"transfer={xfer})",
                  ranks=[0])
+
+    @property
+    def streaming(self) -> bool:
+        """True when the streamed grad wire is active (config
+        ``transfer.streaming`` accepted at construction) — the engine
+        kicks d2h from the dispatch thread right after the step
+        dispatch returns."""
+        return self._streaming
 
     def master_arrays(self) -> List[np.ndarray]:
         """Current fp32 masters per offloaded slot — from DRAM, or read
@@ -234,17 +298,20 @@ class OffloadCoordinator:
         return jax.tree_util.tree_unflatten(treedef, flat)
 
     def _host_step(self, off_grads, lr, skip, shardings,
-                   prepacked=None) -> Optional[list]:
+                   prepacked=None, stream=None,
+                   probe=None) -> Optional[list]:
         # span wrapper: in delayed-update mode this runs on the worker
         # thread, so the trace shows the host step overlapped (or not)
         # against the main thread's engine.train_batch — the config-4
         # stall evidence ROADMAP item 4 needs
         with span("offload.host_step"):
             return self._host_step_spanned(off_grads, lr, skip,
-                                           shardings, prepacked)
+                                           shardings, prepacked,
+                                           stream, probe)
 
     def _host_step_spanned(self, off_grads, lr, skip, shardings,
-                           prepacked=None) -> Optional[list]:
+                           prepacked=None, stream=None,
+                           probe=None) -> Optional[list]:
         """Host path: grads device->host, host Adam, compute-dtype
         payloads back to device. Returns the device leaves to merge
         (or, on the bucketed path, a ``_PendingUpload`` the main-thread
@@ -260,7 +327,12 @@ class OffloadCoordinator:
         ``skip`` may be a device boolean — it is forced here, so in the
         delayed-update mode the main thread never blocks on it.
         ``prepacked`` carries main-thread-packed D2H buckets for the
-        delayed mode (see _pack_d2h)."""
+        delayed mode (see _pack_d2h); ``stream`` carries the streamed
+        wire's kicked token (kick_stream), either forwarded from the
+        engine's post-dispatch kick or created here on first use;
+        ``probe`` is a small output of the producing step whose
+        arrival marks device-done for the exposed/overlapped
+        attribution (transfer/streaming.py WireClock)."""
         if skip is not None and bool(skip):
             return None
         if self.store is not None:
@@ -286,9 +358,12 @@ class OffloadCoordinator:
                 self.last_breakdown["d2h_buckets"] = \
                     self._d2h_plan.n_transfers
             return leaves
+        if self._streaming and self.off_idx and off_grads:
+            return self._host_step_streamed(off_grads, lr, shardings,
+                                            stream, probe)
         if self._transfer is not None and self.off_idx:
             return self._host_step_bucketed(off_grads, lr, shardings,
-                                            prepacked)
+                                            prepacked, probe=probe)
         ha = self.host_adam
         n = len(self.off_idx)
         per_leaf = 2 if self._int8_grads else 1
@@ -473,8 +548,130 @@ class OffloadCoordinator:
                 retryable=TRANSFER_ERRORS,
                 description="offload param h2d (bucket)")
 
+    def _ensure_h2d_plan(self, shardings):
+        """Upload-side plan + staging (shared by the bucketed and
+        streamed wires): built once from the payload specs, staging
+        reused across steps, per-step device-bucket slots reset."""
+        if self._h2d_plan is None:
+            self._h2d_plan = self._transfer.plan_specs(
+                self._upload_specs())
+            self._h2d_stage = self._h2d_plan.alloc_staging()
+        self._h2d_rep = sharding_replicated(shardings[0]) \
+            if shardings else None
+        self._h2d_dev = [[None] * len(sp.buckets)
+                         for sp in self._h2d_plan.streams]
+        return self._h2d_plan, self._h2d_stage
+
+    def _stage_upload_slot(self, slot, uviews, fill, per_up):
+        """Write one slot's upload payload into the fused staging and
+        fire every H2D bucket the write completed (shared by the
+        bucketed and streamed wires; the payload bytes and the bucket
+        schedule are identical either way)."""
+        for j, arr in enumerate(self._payload_np(slot)):
+            m_idx = slot * per_up + j
+            uviews[m_idx][...] = np.asarray(arr).reshape(
+                uviews[m_idx].shape)
+            for si_u, k_u in fill.fill(m_idx):
+                self._upload_bucket(si_u, k_u)
+
+    def kick_stream(self, off_grads, probe=None):
+        """Streamed-wire d2h kick — MUST run on the dispatch thread,
+        immediately after the train-step dispatch returns (the PR-2
+        rendezvous rule: compiled programs dispatch from one thread;
+        the ``copy_to_host_async`` kicks here are plain transfers that
+        then ride device->host DMA while the device keeps computing).
+        Stamps the wire clock, arms the device-done ``probe`` (a small
+        output of the same step) and kicks the first window of
+        per-layer groups. Returns the ``_StreamToken`` the host step
+        consumes, or None when the streamed wire is off. Dropping the
+        token (overflow skip) is harmless."""
+        if not self._streaming or not off_grads:
+            return None
+        arrs = list(off_grads)
+        sched = StreamSchedule(self._wire_groups, self._stream_window)
+        clock = WireClock()
+        clock.kick(probe)
+        n = 0
+        for grp in sched.take_initial():
+            for e in grp.entries:
+                start_host_copy(arrs[e])
+                n += 1
+        tracer.instant("transfer.d2h_kick", n=n,
+                       groups=len(sched.groups))
+        return _StreamToken(clock, sched, arrs)
+
+    def _host_step_streamed(self, off_grads, lr, shardings,
+                            stream=None, probe=None) -> "_PendingUpload":
+        """DRAM-tier host step over the streamed wire: no device-side
+        pack — the step's per-leaf wire tensors were kicked d2h from
+        the dispatch thread the moment dispatch returned (kick_stream),
+        so the copies overlap the device's remaining work instead of
+        serializing behind a pack program that consumes the whole
+        step. Arrival is consumed per LAYER group in backward-
+        completion order: as layer *i*'s grads land, its slots run the
+        host Adam and stage into the fused H2D buckets (fired as they
+        fill) while later layers' copies are still in flight. Bit-
+        identical to the bucketed and per-leaf wires — decode, Adam,
+        payload staging and scatter-back are the same functions, only
+        the arrival/ordering of byte movement changes."""
+        tok = stream if stream is not None \
+            else self.kick_stream(off_grads, probe)
+        clock, sched, arrs = tok.clock, tok.sched, tok.arrs
+        ha = self.host_adam
+        per_leaf = 2 if self._int8_grads else 1
+        per_up = 2 if self._delta_upload else 1
+        uplan, ustage = self._ensure_h2d_plan(shardings)
+        uviews = uplan.views(ustage)
+        fill = uplan.fill_tracker()
+        t_d2h = t_adam = t_h2d = 0.0
+        step_count = ha.step_count + 1
+        for grp in sched.groups:
+            t0 = time.perf_counter()
+
+            def _wait(grp=grp):
+                # re-reading the still-live wire tensors is idempotent
+                # (the token holds their refs); no program dispatch
+                fault_injector.fire("offload.d2h")
+                fault_injector.fire("transfer.d2h")
+                return [np.asarray(arrs[e]) for e in grp.entries]
+
+            with span("transfer.d2h", group=grp.label,
+                      n=len(grp.entries)):
+                host = retry_io(_wait, retries=2, backoff_seconds=0.01,
+                                retryable=TRANSFER_ERRORS,
+                                description="offload grad d2h (stream)")
+            t1 = time.perf_counter()
+            clock.note_wait(t0, t1)
+            t_d2h += t1 - t0
+            for nxt in sched.take_next():   # windowed mode: release
+                for e in nxt.entries:       # the next group's copies
+                    start_host_copy(arrs[e])
+            for j, slot in enumerate(grp.slots):
+                t1 = time.perf_counter()
+                with span("offload.adam", slot=slot):
+                    g = self._decode_entry(
+                        slot, host[j * per_leaf:(j + 1) * per_leaf])
+                    ha.step_arrays(ha.master[slot], g, ha.m[slot],
+                                   ha.v[slot], lr, step_count)
+                t2 = time.perf_counter()
+                self._stage_upload_slot(slot, uviews, fill, per_up)
+                t3 = time.perf_counter()
+                t_adam += t2 - t1
+                t_h2d += t3 - t2
+        ha.step_count = step_count
+        self.last_breakdown = {
+            "grad_d2h_ms": t_d2h * 1e3,
+            "host_adam_ms": t_adam * 1e3,
+            "param_h2d_ms": t_h2d * 1e3,
+            "d2h_groups": len(sched.groups),
+            "h2d_buckets": uplan.n_transfers,
+            **clock.split(),
+        }
+        return _PendingUpload(shardings)
+
     def _host_step_bucketed(self, off_grads, lr, shardings,
-                            prepacked=None) -> "_PendingUpload":
+                            prepacked=None,
+                            probe=None) -> "_PendingUpload":
         """DRAM-tier host step over fused buckets — the double-buffered
         pipeline of the tentpole: all grad buckets start streaming D2H
         up front; as bucket *k* lands, every leaf it completes runs the
@@ -495,6 +692,11 @@ class OffloadCoordinator:
         per_up = 2 if self._delta_upload else 1
         eng = self._transfer
         t_d2h = t_adam = t_h2d = 0.0
+        # attribution clock: kicked here (≈ the pack's async-copy kick;
+        # in delayed mode the main thread packed microseconds before
+        # this worker-thread entry), device-done from the probe
+        clock = WireClock()
+        clock.kick(probe)
 
         t0 = time.perf_counter()
         dev_buckets = prepacked if prepacked is not None \
@@ -504,16 +706,9 @@ class OffloadCoordinator:
         arrival = dplan.arrival_tracker()
         t_d2h += time.perf_counter() - t0
 
-        if self._h2d_plan is None:
-            self._h2d_plan = eng.plan_specs(self._upload_specs())
-            self._h2d_stage = self._h2d_plan.alloc_staging()
-        uplan, ustage = self._h2d_plan, self._h2d_stage
+        uplan, ustage = self._ensure_h2d_plan(shardings)
         uviews = uplan.views(ustage)
         fill = uplan.fill_tracker()
-        self._h2d_rep = sharding_replicated(shardings[0]) \
-            if shardings else None
-        self._h2d_dev = [[None] * len(sp.buckets)
-                         for sp in uplan.streams]
 
         slot_left = [per_leaf] * n
         step_count = ha.step_count + 1
@@ -529,6 +724,8 @@ class OffloadCoordinator:
                 h = retry_io(_wait, retries=2, backoff_seconds=0.01,
                              retryable=TRANSFER_ERRORS,
                              description="offload grad d2h (bucket)")
+            t1 = time.perf_counter()
+            clock.note_wait(t0, t1)
             b0, b1 = dplan.streams[si].buckets[k]
             dstage[si][b0:b1] = h.reshape(-1)
             ready = arrival.mark(si, k)
@@ -546,12 +743,7 @@ class OffloadCoordinator:
                     ha.step_arrays(ha.master[slot], g, ha.m[slot],
                                    ha.v[slot], lr, step_count)
                 t2 = time.perf_counter()
-                for j, arr in enumerate(self._payload_np(slot)):
-                    m_idx = slot * per_up + j
-                    uviews[m_idx][...] = np.asarray(arr).reshape(
-                        uviews[m_idx].shape)
-                    for si_u, k_u in fill.fill(m_idx):
-                        self._upload_bucket(si_u, k_u)
+                self._stage_upload_slot(slot, uviews, fill, per_up)
                 t3 = time.perf_counter()
                 t_adam += t2 - t1
                 t_h2d += t3 - t2
@@ -562,6 +754,7 @@ class OffloadCoordinator:
             "param_h2d_ms": t_h2d * 1e3,
             "d2h_buckets": dplan.n_transfers,
             "h2d_buckets": uplan.n_transfers,
+            **clock.split(),
         }
         return _PendingUpload(shardings)
 
@@ -772,16 +965,19 @@ class OffloadCoordinator:
         return [flat[i].sharding for i in self.off_idx]
 
     def apply_grads(self, state_master, off_grads, lr: Optional[float],
-                    skip=False):
+                    skip=False, stream=None, probe=None):
         """Synchronous host Adam on the offloaded grads; returns the
         master tree with refreshed compute-dtype leaves. ``skip``
-        mirrors the fp16 overflow roll-back."""
+        mirrors the fp16 overflow roll-back. ``stream``/``probe``:
+        see _host_step_spanned."""
         leaves = self._host_step(off_grads, lr, skip,
-                                 self._leaf_shardings(state_master))
+                                 self._leaf_shardings(state_master),
+                                 stream=stream, probe=probe)
         return self.merge(state_master, leaves)
 
     def apply_grads_async(self, state_master, off_grads,
-                          lr: Optional[float], skip=None
+                          lr: Optional[float], skip=None,
+                          stream=None, probe=None
                           ) -> "concurrent.futures.Future":
         """Delayed-parameter-update path (ZeRO-Offload paper DPU /
         reference pipelined_optimizer_swapper semantics): the grad
@@ -794,13 +990,19 @@ class OffloadCoordinator:
                 max_workers=1, thread_name_prefix="zero-offload")
         shardings = self._leaf_shardings(state_master)
         prepacked = None
-        if self._transfer is not None and self.off_idx and off_grads:
+        if self._streaming and self.off_idx and off_grads:
+            # streamed wire: no pack program — the per-leaf copies
+            # were (or are now) kicked from THIS thread; the worker
+            # only waits arrivals
+            if stream is None:
+                stream = self.kick_stream(off_grads, probe)
+        elif self._transfer is not None and self.off_idx and off_grads:
             # the compiled pack must be dispatched from THIS thread
             # (see _PendingUpload); if the step later turns out skipped
             # the packed buckets are simply dropped
             prepacked = self._pack_d2h(off_grads)
         return self._pool.submit(self._host_step, off_grads, lr, skip,
-                                 shardings, prepacked)
+                                 shardings, prepacked, stream, probe)
 
     # -- checkpoint --------------------------------------------------------
     def state_dict(self):

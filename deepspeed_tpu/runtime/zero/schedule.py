@@ -51,11 +51,23 @@ stage_1_and_2.py ipg buckets, stage3.py overlap_comm):
 function is lowered and compiled explicitly, keyed by (abstract arg
 signature, static args, config extras such as the gas count) — a
 compiler-option or gas change invalidates exactly the steps it affects.
+It also audits buffer donation per compile (``donation_refused`` in
+the report: donated args XLA refused to alias, count + bytes).
+
+The schedule layer also owns the LAYER DECOMPOSITION the streaming
+grad wire keys off (``layer_index_of`` / ``offload_wire_groups``):
+grads already leave the step as per-layer subtree leaves — the master
+tree stays unstacked even under the layer-scan step, whose in-trace
+stack is transposed back to per-layer leaves by the backward — and
+the wire groups recover that per-layer structure from the leaf names
+so each layer's grads can start their d2h copy as soon as backward
+produces them (runtime/transfer/streaming.py).
 """
 
 import dataclasses
 import re
-from typing import Any, Callable, Dict, Optional, Tuple
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -266,6 +278,55 @@ def schedule_report(compiled, applied=None, dropped=None) -> Dict[str, Any]:
 # the compiled-step cache
 # ---------------------------------------------------------------------------
 
+# jax warns once per lowering when XLA refuses to alias a donated input
+# to any output ("Some donated buffers were not usable: f32[8,128],
+# ..."): the donated HBM is then NOT reclaimed and the step silently
+# carries both copies — bench r04 saw exactly this on KV-cache-shaped
+# buffers. The audit parses the shapes out of the warning so the
+# schedule report can carry (count, bytes) per compiled step.
+_DONATION_MSG = "donated buffers were not usable"
+_DONATED_SHAPE_RE = re.compile(r"([A-Za-z][A-Za-z0-9_]*)\[([0-9,]*)\]")
+# dedup registry for warnings re-emitted out of the audit's capture
+# window (stands in for the source modules' __warningregistry__)
+_REEMIT_REGISTRY = {}  # unbounded-ok: bounded by distinct warning sites, same growth as the interpreter's own per-module registries
+
+_DTYPE_NBYTES = {
+    "bfloat16": 2, "bf16": 2, "f16": 2, "f32": 4, "f64": 8,
+    "pred": 1, "bool": 1, "s4": 1, "u4": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1,
+}
+
+
+def _dtype_nbytes(name: str) -> int:
+    # table FIRST: numpy's byte-width grammar collides with XLA's
+    # short dtype names (np.dtype('f16') is float128, 'u4' uint32)
+    n = _DTYPE_NBYTES.get(name)
+    if n is not None:
+        return n
+    try:
+        return np.dtype(name).itemsize
+    except TypeError:
+        return 0
+
+
+def parse_refused_donations(messages) -> Dict[str, int]:
+    """-> {"count", "bytes"} summed over the donation warnings in
+    ``messages`` (best-effort byte sizing: unknown dtypes count 0
+    bytes but still count as refusals)."""
+    count = nbytes = 0
+    for msg in messages:
+        if _DONATION_MSG not in msg:
+            continue
+        for dt, dims in _DONATED_SHAPE_RE.findall(msg):
+            count += 1
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbytes += n * _dtype_nbytes(dt)
+    return {"count": count, "bytes": nbytes}
+
+
 def _leaf_key(x):
     if isinstance(x, jax.Array):
         return (tuple(x.shape), str(x.dtype), x.sharding)
@@ -316,6 +377,8 @@ class ScheduledStep:
         self._last_program = None      # (compiled, applied, dropped)
         self._report: Optional[Dict[str, Any]] = None
         self._report_for = None
+        # donation audit result for the newest compiled program
+        self._donation_refused = {"count": 0, "bytes": 0}
 
     def invalidate(self, reason: str = "") -> int:
         """Drop every compiled program (and the memoized report). The
@@ -341,6 +404,11 @@ class ScheduledStep:
         compiled, applied, dropped = self._last_program
         if self._report is None or self._report_for is not compiled:
             self._report = schedule_report(compiled, applied, dropped)
+            # donation audit (captured at lowering): refused donations
+            # mean the step carries both buffer copies — count + bytes
+            # so the bench schedule report can flag the waste
+            self._report["donation_refused"] = dict(
+                self._donation_refused)
             self._report_for = compiled
         return self._report
 
@@ -369,9 +437,38 @@ class ScheduledStep:
                 # recompile looks identical to a real regression
                 # without this span)
                 with span("schedule.compile", label=self._label):
-                    lowered = self._fn.lower(*args)
-                    compiled, applied, dropped = compile_with_options(
-                        lowered, self._options, self._label)
+                    # donation audit: jax flags refused donations as a
+                    # UserWarning at lowering — capture, attribute to
+                    # this step, re-emit everything else untouched
+                    with warnings.catch_warnings(record=True) as wlist:
+                        warnings.simplefilter("always")
+                        lowered = self._fn.lower(*args)
+                        compiled, applied, dropped = compile_with_options(
+                            lowered, self._options, self._label)
+                    donation_msgs = []
+                    for w in wlist:
+                        if _DONATION_MSG in str(w.message):
+                            donation_msgs.append(str(w.message))
+                        else:
+                            # shared registry preserves once-per-
+                            # location dedup across recompiles (the
+                            # capture bypassed the source module's
+                            # __warningregistry__)
+                            warnings.warn_explicit(
+                                w.message, w.category, w.filename,
+                                w.lineno, registry=_REEMIT_REGISTRY)
+                    self._donation_refused = parse_refused_donations(
+                        donation_msgs)
+                    if self._donation_refused["count"]:
+                        _warn_once(
+                            ("donation", self._label),
+                            f"donation audit: XLA refused "
+                            f"{self._donation_refused['count']} donated "
+                            f"buffer(s) "
+                            f"({self._donation_refused['bytes'] / 1e6:.1f}"
+                            f" MB) compiling {self._label} — the step "
+                            "carries both copies; see "
+                            "schedule_report()['donation_refused']")
                 self._last_program = (compiled, applied, dropped)
                 entry = compiled
                 self._cache.put(key, compiled)
@@ -441,6 +538,43 @@ def derive_prefetch_depth(max_live_parameters, per_layer_params,
     else:
         d = int(max_live_parameters) // max(1, int(per_layer_params)) - 1
     return max(0, min(int(num_layers) - 1, d))
+
+
+# layer-stack member names across the model zoo: gpt2 "h_3", llama
+# "layers_12", neox/bloom-style "blocks_0" / "layer_7" — one numbered
+# token between separators
+_LAYER_NAME_RE = re.compile(
+    r"(?:^|[./_])(?:h|layers?|blocks?)[._]?(\d+)(?=[./_]|$)")
+
+
+def layer_index_of(name: str) -> Optional[int]:
+    """Layer ordinal parsed from a leaf name, or None for non-layer
+    leaves (embeddings, final norm, lm head). This is the name-keyed
+    twin of ``LayerScanSpec.split``'s positional decomposition — the
+    streaming grad wire uses it to group offloaded slots into the
+    per-layer subtrees the backward produces."""
+    m = _LAYER_NAME_RE.search(name or "")
+    return int(m.group(1)) if m else None
+
+
+def offload_wire_groups(leaf_names, off_idx, per_leaf: int) -> List:
+    """Per-layer wire groups for the streaming grad wire, in expected
+    backward-completion order (last layer first, non-layer leaves
+    trailing — transfer/streaming.py ``build_wire_groups`` documents
+    the ordering rationale and the per-slot fallback for unnamed
+    trees).
+
+    The layer-scan step already emits grads leaf-by-leaf (the master
+    tree stays unstacked; the in-trace stack/scan is transposed back
+    to per-layer leaves by the backward), so the per-layer grad
+    subtrees exist as separate step outputs — this function recovers
+    that decomposition for the wire from the leaf names."""
+    from ..transfer.streaming import build_wire_groups
+    slot_layers = [
+        layer_index_of(leaf_names[i]) if leaf_names is not None
+        and i < len(leaf_names) else None
+        for i in off_idx]
+    return build_wire_groups(slot_layers, per_leaf)
 
 
 def _remat_wrap(layer_fn, policy):

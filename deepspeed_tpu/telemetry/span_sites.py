@@ -34,11 +34,20 @@ SPAN_SITES = {
         "offload host-state reload, AOT invalidation)",
     # ---- transfer engine + ZeRO-Offload (runtime/transfer/, zero/offload.py) ----
     "transfer.d2h":
-        "one fused bucket's device->host wait (args: stream, bucket) "
-        "— the per-bucket download timeline config 4's stall "
-        "decomposition needs",
+        "one grad-download wait: a fused bucket (args: stream, "
+        "bucket) or a streamed-wire layer group (args: group, n) — "
+        "the download timeline config 4's stall decomposition needs",
     "transfer.h2d":
         "one fused bucket's host->device put (args: stream, bucket)",
+    "transfer.d2h_kick":
+        "instant: the streamed wire's async d2h copies were issued "
+        "from the dispatch thread (args: n tensors, groups) — every "
+        "transfer.d2h wait that starts before the step's "
+        "transfer.device_done mark overlapped device compute",
+    "transfer.device_done":
+        "instant: the producing step's device wall ended (the wire "
+        "clock's 4-byte probe output landed) — the boundary that "
+        "splits grad_d2h_ms into d2h_exposed_ms vs d2h_overlapped_ms",
     "offload.host_step":
         "the whole offload host step (grad download + host Adam + "
         "upload staging); in delayed-update mode this runs on the "

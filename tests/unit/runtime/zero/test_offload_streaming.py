@@ -1,0 +1,298 @@
+"""Streaming grad wire (runtime/transfer/streaming.py + the streamed
+host step in runtime/zero/offload.py): bit-exactness vs the bucketed
+and per-leaf wires across grad/upload codecs, the per-layer group
+schedule + kick window, the d2h exposed/overlapped attribution, the
+trace evidence that copies start before the step's device wall ends,
+and fault recovery on the streamed waits."""
+
+import math
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.parallel.mesh import mesh_manager
+from deepspeed_tpu.resilience import fault_injector
+from deepspeed_tpu.runtime.transfer.streaming import (StreamSchedule,
+                                                      WireClock,
+                                                      build_wire_groups)
+from deepspeed_tpu.runtime.zero.schedule import (layer_index_of,
+                                                 offload_wire_groups)
+
+
+def _config(streaming=True, window=0, enabled=True, bucket_mb=1 / 64,
+            grad_dtype="bf16", upload_dtype="bf16", delayed=False,
+            bf16=True):
+    return {"train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW",
+                          "params": {"lr": 1e-3, "weight_decay": 0.01}},
+            "bf16": {"enabled": bf16},
+            "zero_optimization": {
+                "stage": 2,
+                "offload_optimizer": {
+                    "device": "cpu", "delayed_update": delayed,
+                    "grad_dtype": grad_dtype,
+                    "upload_dtype": upload_dtype,
+                    "transfer": {"enabled": enabled,
+                                 "bucket_mb": bucket_mb,
+                                 "streaming": streaming,
+                                 "window": window}}},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 0}
+
+
+def _train(config, steps=2, seed=0, gas=None):
+    mesh_manager.reset()
+    if gas:
+        config = dict(config)
+        config["gradient_accumulation_steps"] = gas
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 256, size=(engine.train_batch_size(), 16),
+                       dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    return engine, [float(engine.train_batch(batch=batch))
+                    for _ in range(steps)]
+
+
+def _assert_same_offload_state(e0, e1):
+    for a, b in zip(e0._offload.host_adam.master,
+                    e1._offload.host_adam.master):
+        np.testing.assert_array_equal(a, b)
+    for m0, m1, v0, v1 in zip(e0._offload.host_adam.m,
+                              e1._offload.host_adam.m,
+                              e0._offload.host_adam.v,
+                              e1._offload.host_adam.v):
+        np.testing.assert_array_equal(m0, m1)
+        np.testing.assert_array_equal(v0, v1)
+    f0 = jax.tree_util.tree_leaves(e0.state.master_params)
+    f1 = jax.tree_util.tree_leaves(e1.state.master_params)
+    for i in e0._offload.off_idx:
+        np.testing.assert_array_equal(np.asarray(f0[i]),
+                                      np.asarray(f1[i]))
+
+
+# ---------------------------------------------------------------------------
+# pure planning units (no engine, free)
+# ---------------------------------------------------------------------------
+
+class TestWirePlanning:
+    def test_layer_index_parsing(self):
+        assert layer_index_of("params.h_3.attn.c_attn.kernel") == 3
+        assert layer_index_of("params.layers_12.mlp.up_proj.kernel") == 12
+        assert layer_index_of("params.blocks_0.fc.bias") == 0
+        assert layer_index_of("params.wte") is None
+        assert layer_index_of("params.ln_f.scale") is None
+        assert layer_index_of("params.lm_head") is None
+        # 'h' must be a separated token, not a substring
+        assert layer_index_of("params.head_7x.w") is None
+
+    def test_groups_backward_order_rest_trails(self):
+        names = ["params.wte", "params.h_0.a", "params.h_1.a",
+                 "params.h_1.b", "params.ln_f.scale"]
+        groups = offload_wire_groups(names, [0, 1, 2, 3, 4], per_leaf=1)
+        assert [g.label for g in groups] == ["layer1", "layer0", "rest"]
+        assert groups[0].slots == [2, 3]     # last layer first
+        assert groups[1].slots == [1]
+        assert groups[2].slots == [0, 4]     # embed + final norm trail
+
+    def test_groups_per_leaf_entries(self):
+        # int8/int4 wire: 2 wire tensors (q, scales) per slot
+        names = ["params.h_0.a", "params.h_1.a"]
+        groups = offload_wire_groups(names, [0, 1], per_leaf=2)
+        assert groups[0].label == "layer1"
+        assert groups[0].entries == [2, 3]
+        assert groups[1].entries == [0, 1]
+
+    def test_groups_fallback_per_slot_reversed(self):
+        # no layer tokens anywhere: per-slot groups in reverse flatten
+        # order (flatten ~ forward, so reverse ~ backward completion)
+        groups = offload_wire_groups(["params.a", "params.b"], [0, 1],
+                                     per_leaf=1)
+        assert [g.slots for g in groups] == [[1], [0]]
+
+    def test_stream_schedule_windowing(self):
+        groups = build_wire_groups([2, 1, 0], per_leaf=1)
+        s = StreamSchedule(groups, window=0)
+        assert s.take_initial() == groups        # kick-all
+        assert s.take_next() == []
+        s = StreamSchedule(groups, window=2)
+        assert s.take_initial() == groups[:2]
+        assert s.take_next() == [groups[2]]      # released by arrival
+        assert s.take_next() == []               # nothing left
+        with pytest.raises(ValueError, match="window"):
+            StreamSchedule(groups, window=-1)
+
+    def test_wire_clock_split(self):
+        c = WireClock()
+        c.kick()
+        c.t_kick, c.t_done = 10.0, 10.5   # device busy 500 ms post-kick
+        c.note_wait(10.0, 10.6)           # 100 ms exposed, 500 hidden
+        c.note_wait(10.7, 10.9)           # 200 ms exposed (post-done)
+        out = c.split()
+        # exposed: wait wall after t_done = 0.1 + 0.2 s
+        assert out["d2h_exposed_ms"] == pytest.approx(300.0)
+        # window 10.0 -> 10.9 minus exposed
+        assert out["d2h_overlapped_ms"] == pytest.approx(600.0)
+        # no waits recorded -> zeros, never a crash
+        assert WireClock().split() == {"d2h_exposed_ms": 0.0,
+                                       "d2h_overlapped_ms": 0.0}
+
+    def test_window_config_validated(self):
+        from deepspeed_tpu.runtime.zero.config import (
+            DeepSpeedZeroOffloadTransferConfig)
+        with pytest.raises(ValueError, match="window"):
+            DeepSpeedZeroOffloadTransferConfig.from_dict(
+                {"streaming": True, "window": -2})
+
+
+# ---------------------------------------------------------------------------
+# engine-level: bit-identity, attribution, overlap evidence
+# ---------------------------------------------------------------------------
+
+# tier-1 keeps the default-wire smoke; compressed wires + the window
+# sweep ride the slow tier (tier-1 budget rule)
+@pytest.mark.perf
+@pytest.mark.parametrize("grad_dtype,upload_dtype,delayed", [
+    ("bf16", "bf16", False),
+    pytest.param("int8", "int8_delta", False, marks=pytest.mark.slow),
+    pytest.param("int4", "int4_delta", True, marks=pytest.mark.slow),
+])
+def test_streamed_bit_identical_to_bucketed(eight_devices, grad_dtype,
+                                            upload_dtype, delayed):
+    """THE acceptance invariant: the streamed wire only reorders WHEN
+    bytes move and when each slot's host Adam runs — losses, host
+    Adam state and device leaves stay bitwise equal to the bucketed
+    wire (itself asserted == per-leaf in test_offload_bucketed) for
+    every codec, including the delta-upload error-feedback stream
+    across steps."""
+    steps = 4 if delayed else 2
+    e0, l0 = _train(_config(streaming=False, grad_dtype=grad_dtype,
+                            upload_dtype=upload_dtype, delayed=delayed),
+                    steps=steps)
+    e1, l1 = _train(_config(streaming=True, grad_dtype=grad_dtype,
+                            upload_dtype=upload_dtype, delayed=delayed),
+                    steps=steps)
+    assert e1._offload.streaming and not e0._offload.streaming
+    assert l0 == l1
+    # DPU: join the in-flight host step before comparing state (the
+    # worker mutates host Adam arrays until merged)
+    e0._merge_offload_future()
+    e1._merge_offload_future()
+    _assert_same_offload_state(e0, e1)
+
+
+@pytest.mark.slow
+def test_streamed_window_bit_identical_and_bounded(eight_devices):
+    """A depth-2 kick window changes in-flight bookkeeping only: the
+    update stays bitwise equal to the unwindowed stream."""
+    e0, l0 = _train(_config(streaming=True, window=0), steps=3)
+    e1, l1 = _train(_config(streaming=True, window=2), steps=3)
+    assert l0 == l1
+    _assert_same_offload_state(e0, e1)
+    assert e1._offload._stream_window == 2
+
+
+@pytest.mark.perf
+def test_streamed_overlap_attribution_and_trace(eight_devices):
+    """ISSUE acceptance (tests satellite): (a) the breakdown carries
+    the exposed/overlapped split with exposed <= the blocking wall
+    and a real overlapped share, and (b) a traced step shows the d2h
+    copies STARTING before the step's device wall ends — the kick
+    instant and the first transfer.d2h wait both precede the
+    transfer.device_done mark (async dispatch: the device is still
+    chewing the gas-8 step while the host kicks and waits)."""
+    from deepspeed_tpu.telemetry.trace import tracer
+    tracer.configure(enabled=True, capacity=16384)
+    try:
+        # gas=8 stretches the device wall well past the host's
+        # dispatch->kick->first-wait latency (microseconds)
+        engine, losses = _train(_config(streaming=True), steps=2, gas=8)
+        bd = engine.get_offload_breakdown()
+        for k in ("grad_d2h_ms", "host_adam_ms", "param_h2d_ms",
+                  "d2h_exposed_ms", "d2h_overlapped_ms", "d2h_groups",
+                  "h2d_buckets", "overlap_residue_ms"):
+            assert k in bd, bd
+        assert bd["d2h_groups"] >= 2          # per-layer groups, not one
+        assert bd["d2h_exposed_ms"] <= bd["grad_d2h_ms"] + 1e-6
+        assert bd["d2h_overlapped_ms"] > 0.0  # some wire wall hid
+        spans = tracer.snapshot()
+        kicks = [r for r in spans if r.name == "transfer.d2h_kick"]
+        dones = [r for r in spans if r.name == "transfer.device_done"]
+        waits = [r for r in spans if r.name == "transfer.d2h"]
+        assert kicks and dones and waits
+        # pair each step's kick with the done that follows it
+        k0 = kicks[0].t0_ns
+        done_after = min(d.t0_ns for d in dones if d.t0_ns >= k0)
+        assert k0 < done_after, "copies kicked after the device wall"
+        first_wait = min(w.t0_ns for w in waits if w.t0_ns >= k0)
+        assert first_wait < done_after, \
+            "no transfer.d2h span started before the device wall ended"
+    finally:
+        tracer.disable()
+        tracer.clear()
+
+
+@pytest.mark.perf
+def test_offload_train_step_donations_clean(eight_devices):
+    """Donation audit satellite: the offload train step's donation
+    annotations are clean — XLA aliases every donated buffer (state +
+    int4 grad residual), so the audit reports zero refusals. A future
+    annotation regression (a donated arg XLA must copy) fails here
+    instead of silently doubling HBM."""
+    engine, _ = _train(_config(streaming=True, grad_dtype="int4",
+                               upload_dtype="int4_delta"), steps=2)
+    rep = engine._scheduled_steps["train_step"].schedule_report()
+    assert rep["donation_refused"] == {"count": 0, "bytes": 0}
+
+
+@pytest.mark.slow
+def test_streamed_dpu_pipeline_and_checkpoint_flush(eight_devices,
+                                                    tmp_path):
+    """DPU + streamed wire: one-step-stale pipeline fill holds, the
+    curve falls, and a checkpoint save flushes the in-flight host
+    step (host Adam fully caught up)."""
+    engine, losses = _train(_config(streaming=True, delayed=True),
+                            steps=7)
+    assert losses[0] == losses[1]        # pipeline fill
+    assert losses[-1] < losses[2] < losses[0], losses
+    engine.save_checkpoint(str(tmp_path))
+    assert engine._offload_future is None
+    assert engine._offload.host_adam.step_count == 7
+
+
+@pytest.mark.fault
+def test_streamed_d2h_fault_recovers_via_retry(rng, eight_devices):
+    """A transient fault on one streamed group wait is absorbed by the
+    bounded retry — re-reading the still-live wire tensors is
+    idempotent (the stream token holds their refs)."""
+    mesh_manager.reset()
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=_config(streaming=True, bucket_mb=64))
+    ids = rng.integers(0, 256, size=(engine.train_batch_size(), 16),
+                       dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    l0 = float(engine.train_batch(batch=batch))
+    with fault_injector.inject("transfer.d2h:ioerror"):
+        l1 = float(engine.train_batch(batch=batch))
+        assert fault_injector.fired == ["transfer.d2h:ioerror@0"]
+    assert np.isfinite(l1)
+    l2 = float(engine.train_batch(batch=batch))
+    assert l2 < l0
+
+
+def test_streaming_requires_bucketed_engine(eight_devices):
+    """streaming with transfer.enabled=false falls back (warn) to the
+    per-leaf wire — never a half-configured stream."""
+    engine, losses = _train(_config(streaming=True, enabled=False),
+                            steps=2)
+    off = engine._offload
+    assert not off.streaming and off._transfer is None
+    assert losses[-1] < losses[0]
+    bd = engine.get_offload_breakdown()
+    assert "d2h_groups" not in bd

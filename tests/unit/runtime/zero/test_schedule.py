@@ -152,6 +152,47 @@ class TestScheduledStep:
         assert 0.0 <= rep["overlap_estimate"] <= 1.0
         assert step.schedule_report() is rep  # memoized per program
 
+    def test_donation_audit_reports_refused(self, eight_devices):
+        """A donated arg XLA cannot alias to any output (consumed, but
+        no same-shaped output) is counted with its byte size in the
+        schedule report — the warn-once audit the bench decomposition
+        surfaces."""
+
+        def f(a, b):
+            return (a * 2.0).sum() + b   # 'a' has no aliasable output
+
+        step = ScheduledStep(jax.jit(f, donate_argnums=(0,)),
+                             label="audit")
+        step(jnp.ones((64, 32), jnp.float32), jnp.ones((8,), jnp.float32))
+        rep = step.schedule_report()
+        assert rep["donation_refused"]["count"] == 1
+        assert rep["donation_refused"]["bytes"] == 64 * 32 * 4
+
+    def test_donation_audit_clean_when_aliasable(self, eight_devices):
+        step = ScheduledStep(jax.jit(lambda a: a + 1.0,
+                                     donate_argnums=(0,)),
+                             label="audit_ok")
+        step(jnp.ones((16, 16), jnp.float32))
+        rep = step.schedule_report()
+        assert rep["donation_refused"] == {"count": 0, "bytes": 0}
+
+    def test_donation_parse_helper(self):
+        from deepspeed_tpu.runtime.zero.schedule import (
+            parse_refused_donations)
+        # both message dialects: the AOT path's ShapedArray(...) and
+        # the eager-dispatch plain dtype[shape] list (bench r04)
+        out = parse_refused_donations([
+            "Some donated buffers were not usable: "
+            "ShapedArray(float32[64,32]).\nSee an explanation at "
+            "https://jax.readthedocs.io/faq",
+            "Some donated buffers were not usable: "
+            "bfloat16[16,576,32,128], bfloat16[16,576,32,128].",
+        ])
+        assert out["count"] == 3
+        assert out["bytes"] == 64 * 32 * 4 + 2 * 2 * 16 * 576 * 32 * 128
+        assert parse_refused_donations(["unrelated warning"]) == \
+            {"count": 0, "bytes": 0}
+
 
 # ---------------------------------------------------------------------------
 # pillar 2: the layer-scan step

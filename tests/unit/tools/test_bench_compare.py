@@ -90,6 +90,40 @@ class TestCompare:
                              0.10, {}, set())
         assert miss == []
 
+    def test_floor_trips_after_lineage_clears_it(self):
+        """Config 4's 0.8 floor: dormant while the lineage is still
+        below the bar (r04->r05 era compares clean), armed once the
+        old side clears it — then even a within-threshold drop that
+        crosses under fails the gate (anti-creep)."""
+        # pre-lift history: both sides under the floor -> clean
+        _, reg, _ = compare({"4": _row(0.48)}, {"4": _row(0.58)},
+                            0.10, {"4": 0.30}, set())
+        assert reg == []
+        # armed: 0.82 -> 0.79 is within a 10% threshold but under 0.8
+        rows, reg, _ = compare({"4": _row(0.82)}, {"4": _row(0.79)},
+                               0.10, {}, set())
+        assert reg == ["4"]
+        assert rows[0]["status"] == "BELOW-FLOOR"
+        assert rows[0]["floor"] == 0.8
+        # staying over the bar is clean
+        _, reg, _ = compare({"4": _row(0.92)}, {"4": _row(0.88)},
+                            0.10, {}, set())
+        assert reg == []
+        # explicit floors EXTEND the built-ins (never replace them):
+        # adding a floor for config 1 must not drop config 4's
+        rows, reg, _ = compare(
+            {"1": _row(1.2), "4": _row(0.82)},
+            {"1": _row(1.1), "4": _row(0.79)},
+            0.10, {}, set(), floors={"1": 1.15})
+        assert reg == ["1", "4"]
+        assert all(r["status"] == "BELOW-FLOOR" for r in rows)
+
+    def test_floor_cli_flag(self, tmp_path):
+        old = _artifact(tmp_path, "fo.json", {"2": _row(1.2)})
+        new = _artifact(tmp_path, "fn.json", {"2": _row(1.1)})
+        assert main([old, new]) == 0
+        assert main([old, new, "--floor", "2=1.15"]) == 1
+
     def test_missing_config_skipped_unless_required(self):
         rows, reg, miss = compare({"1": _row(1.0)},
                                   {"1": _row(1.0),

@@ -17,6 +17,12 @@ so a future run that drops it (a refactor losing the bench wiring)
 fails the gate instead of passing with one fewer row. Artifacts
 predating a tracked config still compare clean.
 
+``FLOOR_CONFIGS`` (extend with ``--floor 4=0.8``) pins absolute
+vs_baseline minimums: once the lineage has cleared a floor, any new
+run below it fails the gate even when each individual drop stayed
+within the relative threshold — the anti-creep backstop for config
+4's streaming-wire target.
+
 Accepts both artifact shapes: the raw bench head (``bench.py``'s JSON
 line, configs under ``"configs"``) and the driver wrapper
 (``{"parsed": <head>, ...}`` as the checked-in BENCH_r*.json are).
@@ -76,11 +82,26 @@ def parse_per_config(text):
 # artifact -> required comparable in the new one (see module docstring)
 TRACKED_CONFIGS = ("7_frontend",)
 
+# absolute vs_baseline floors: once a config's LINEAGE has cleared
+# the bar (old side >= floor), no new run may fall back under it —
+# even via a slow creep of individually-within-threshold drops. The
+# floor stays dormant while the old artifact is still below it, so
+# pre-lift history (r04 -> r05 with config 4 at 0.58) compares clean.
+# Config 4's 0.8 floor backs the streaming-wire target (ISSUE 10:
+# 0.58x -> >=0.9x on the accelerator-box sweep, gate at 0.8).
+# Override/extend with --floor.
+FLOOR_CONFIGS = {"4": 0.8}
 
-def compare(old, new, threshold, per_config, require):
+
+def compare(old, new, threshold, per_config, require, floors=None):
     """-> (rows, regressions, missing_required); each row is a dict
-    for the report table."""
+    for the report table. ``floors``: {config: absolute vs_baseline
+    minimum} EXTENDING (never replacing) the built-in FLOOR_CONFIGS —
+    a caller adding one floor must not drop the tracked ones."""
     require = set(require) | {k for k in TRACKED_CONFIGS if k in old}
+    merged_floors = dict(FLOOR_CONFIGS)
+    merged_floors.update(floors or {})
+    floors = merged_floors
     rows, regressions, missing = [], [], []
     # required configs absent from BOTH sides must still surface (a
     # gate that silently passes when the scored row vanished from the
@@ -105,11 +126,17 @@ def compare(old, new, threshold, per_config, require):
         else:
             ob, nb = float(ob), float(nb)
             delta = (nb - ob) / ob if ob else 0.0
+            floor = floors.get(key)
             regressed = nb < ob * (1.0 - thr)
+            below_floor = floor is not None and ob >= float(floor) \
+                and nb < float(floor)
             row.update(old=ob, new=nb, delta=delta,
-                       status="REGRESSION" if regressed else "ok",
+                       status="REGRESSION" if regressed
+                       else "BELOW-FLOOR" if below_floor else "ok",
                        metric=(n.get("metric") or ""))
-            if regressed:
+            if floor is not None:
+                row["floor"] = float(floor)
+            if regressed or below_floor:
                 regressions.append(key)
         rows.append(row)
     return rows, regressions, missing
@@ -142,6 +169,10 @@ def main(argv=None):
                         "(default 0.10)")
     p.add_argument("--per-config", default="",
                    help="per-config overrides, e.g. '4=0.25,5=0.3'")
+    p.add_argument("--floor", default="",
+                   help="absolute vs_baseline floors, e.g. '4=0.8' "
+                        "(extends the built-in FLOOR_CONFIGS; armed "
+                        "once the old artifact clears the bar)")
     p.add_argument("--require", default="",
                    help="comma list of configs that MUST be "
                         "comparable (else exit 1)")
@@ -152,12 +183,13 @@ def main(argv=None):
         old = load_configs(args.old)
         new = load_configs(args.new)
         per_config = parse_per_config(args.per_config)
+        floors = parse_per_config(args.floor)  # compare() merges
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"bench_compare: {e}", file=sys.stderr)
         return 2
     require = {k.strip() for k in args.require.split(",") if k.strip()}
     rows, regressions, missing = compare(
-        old, new, args.threshold, per_config, require)
+        old, new, args.threshold, per_config, require, floors=floors)
     if args.json:
         print(json.dumps({"rows": rows, "regressions": regressions,
                           "missing_required": missing}))
