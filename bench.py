@@ -679,6 +679,127 @@ def bench_config7():
     }
 
 
+def bench_config8(tiny=False):
+    """Fleet serving over 3 data-parallel replicas (ISSUE 11): the
+    config-7 open-world Poisson shared-prefix arrival mix routed
+    through ``FleetRouter`` (prefix-affinity scoring) instead of one
+    front-end. Metric = sustained FLEET tok/s over the open-world
+    window, normalized against 3x the config-5/7 1000 tok/s/chip bar;
+    the decomposition publishes the fleet report head — router totals,
+    per-replica load/recompile counters, and the CROSS-REPLICA prefix
+    hit rate (the number affinity routing exists to move: shared-
+    prompt traffic must hit the trie fleet-wide, not per process).
+    ``tiny=True`` shrinks the model/engine shapes for the local
+    logic-validation run (standing constraint (b): full-size numbers
+    need the accelerator box)."""
+    import dataclasses
+
+    import jax
+
+    from deepspeed_tpu.inference.v2 import (FleetRouter,
+                                            InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from deepspeed_tpu.runtime.lifecycle import memory_gauges
+
+    R = 3
+    if tiny:
+        cfg = LlamaConfig.tiny()
+        block, budget, B, per_seq, new, N = 8, 32, 4, 8, 4, 12
+        kv_dtype, tail_len = "float32", 8
+    else:
+        cfg = dataclasses.replace(LlamaConfig.llama2_7b(),
+                                  num_hidden_layers=4,
+                                  max_position_embeddings=2048)
+        block, budget, B, per_seq, new, N = 128, 512, 16, 4, 24, 60
+        kv_dtype, tail_len = "bfloat16", 32
+    model = LlamaForCausalLM(cfg)
+    params = jax.tree_util.tree_map(
+        lambda s: jax.numpy.zeros(s.shape, jax.numpy.bfloat16)
+        if jax.numpy.issubdtype(s.dtype, jax.numpy.floating)
+        else jax.numpy.zeros(s.shape, s.dtype),
+        jax.eval_shape(lambda r: model.init(
+            r, np.zeros((1, 8), np.int32)), jax.random.PRNGKey(0)))
+    eng_cfg = RaggedInferenceEngineConfig(
+        token_budget=budget, max_ragged_sequence_count=B,
+        max_tracked_sequences=4 * B,
+        n_kv_blocks=4 * B + 12,    # 3 blocks/seq + shared + slack
+        kv_block_size=block, max_blocks_per_seq=per_seq,
+        kv_dtype=kv_dtype, prefix_cache=True)
+
+    def engine_factory(slot):
+        return InferenceEngineV2(params, cfg, eng_cfg)
+
+    router = FleetRouter(engine_factory, {"fleet": {"n_replicas": R}})
+
+    rng = np.random.default_rng(8)
+    vocab = cfg.vocab_size
+    # 3 shared system prompts (2 full blocks each) + unique per-request
+    # tails: the million-user common-prompt-head shape, now fanned over
+    # a fleet — affinity keeps each head's followers on its home trie
+    sys_prompts = [rng.integers(0, vocab, size=2 * block,
+                                dtype=np.int32) for _ in range(3)]
+    tails = [rng.integers(0, vocab, size=tail_len, dtype=np.int32)
+             for _ in range(N)]
+    # Poisson arrivals in ROUTER STEPS (deterministic replay), rate
+    # scaled to keep a 3-replica fleet saturated mid-trace
+    arrive = np.cumsum(rng.poisson(0.3, size=N))
+
+    # warmup: R unique sub-block prompts load-balance across the pool
+    # and compile every replica's fused greedy executable (no trie
+    # writes: a prompt under block+1 tokens never caches)
+    for k in range(R):
+        router.submit(rng.integers(0, vocab, size=block,
+                                   dtype=np.int32), max_new_tokens=2)
+    router.drain()
+
+    handles = {}
+
+    def poll(r, step):
+        while len(handles) < N and step >= arrive[len(handles)]:
+            k = len(handles)
+            handles[k] = r.submit(
+                np.concatenate([sys_prompts[k % 3], tails[k]]),
+                max_new_tokens=new)
+        return len(handles) < N
+
+    t0 = time.time()
+    steps = router.serve(poll=poll)
+    wall = time.time() - t0
+    rep = router.get_fleet_report()
+    assert rep["router"]["finished"] == N + R, rep["router"]
+    trace_tokens = sum(len(h.tokens) for h in handles.values())
+    sustained = trace_tokens / wall if wall > 0 else 0.0
+    per_replica = {}
+    for slot, snap in rep["replicas"].items():
+        per_replica[slot] = {
+            k: snap[k] for k in ("steps", "tokens_emitted",
+                                 "recompiles", "blocking_syncs",
+                                 "prefix_hits", "prefix_misses")
+            if k in snap}
+    return {
+        "config": "8_fleet",
+        "model": ("llama_tiny" if tiny else "llama7b_shape_4l"),
+        "chips": jax.device_count(),
+        "metric": "fleet_sustained_tok_per_s",
+        "value": round(sustained, 1),
+        "unit": (f"tok/s over {steps} open-world steps x {R} replicas "
+                 f"({N} Poisson arrivals, 3 shared prefixes)"),
+        "vs_baseline": round(sustained / (1000.0 * R), 4),
+        "decomposition": {
+            "sustained_fleet_tok_per_s": round(sustained, 1),
+            "replicas": R,
+            "cross_replica_prefix_hit_rate": round(
+                rep["prefix"]["hit_rate"], 4),
+            "prefix": rep["prefix"],
+            "router": rep["router"],
+            "per_replica": per_replica,
+            "memory": _memory_decomposition(
+                memory_gauges(include_arrays=False)),
+        },
+    }
+
+
 def main():
     # the driver contract is ONE JSON line on stdout; the engine's
     # rank-0 INFO logging would interleave with it
@@ -687,14 +808,24 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--config", type=str, default="0",
                    choices=["0", "1", "2", "3", "4", "5", "5_int8",
-                            "5_int4", "6_recovery", "7_frontend"],
+                            "5_int4", "6_recovery", "7_frontend",
+                            "8_fleet"],
                    help="0 (default) = ALL tracked configs")
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny-shape logic validation (config 8_fleet "
+                        "only; never an artifact row)")
     args = p.parse_args()
+    if args.tiny and args.config != "8_fleet":
+        # a tiny-shape row must never land in an artifact lineage the
+        # gate compares against real hardware numbers
+        p.error("--tiny is only valid with --config 8_fleet "
+                "(local logic validation, never an artifact row)")
     fns = {"1": bench_config1, "2": bench_config2, "3": bench_config3,
            "4": bench_config4, "5": bench_config5,
            "5_int8": lambda: bench_config5(weight_dtype="int8"),
            "5_int4": lambda: bench_config5(weight_dtype="int4"),
-           "6_recovery": bench_config6, "7_frontend": bench_config7}
+           "6_recovery": bench_config6, "7_frontend": bench_config7,
+           "8_fleet": lambda: bench_config8(tiny=args.tiny)}
     if args.config != "0":
         print(json.dumps(fns[args.config]()))
         return
@@ -723,7 +854,7 @@ def main():
                    os.path.join(os.path.dirname(
                        os.path.abspath(__file__)), ".jax_cache"))
     for key in ("1", "3", "4", "5_int8", "2", "5", "7_frontend",
-                "5_int4", "6_recovery"):
+                "8_fleet", "5_int4", "6_recovery"):
         if key != "1" and time.time() - t_start > budget * 0.8:
             configs[key] = {"skipped": "bench time budget"}
             continue

@@ -4,5 +4,6 @@ from .ragged_manager import (BlockedKVCacheManager, DSStateManager,
                              SchedulingError, SchedulingResult,
                              SequenceDescriptor)
 from .ragged_wrapper import RaggedBatchWrapper
-from .serving import (PrefixCache, Request, RequestState,
-                      ServingFrontend, TokenStream)
+from .serving import (FleetRouter, FleetSupervisor, PrefixCache,
+                      Replica, Request, RequestState, RoundRobinPolicy,
+                      ScoringPolicy, ServingFrontend, TokenStream)

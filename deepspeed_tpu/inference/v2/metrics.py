@@ -86,6 +86,15 @@ class ServingMetrics:
         self.requests_cancelled = 0
         self.requests_shed = 0
         self._request_latency_s: deque = deque(maxlen=window)
+        # polling-cheap per-step snapshot (quick_stats): ONE dict,
+        # updated in place by record_step — a fleet router polls every
+        # replica every step, so this path must not build report()'s
+        # sorted distributions (or any fresh containers) per poll
+        self._quick = {
+            "steps": 0.0, "decode_steps": 0.0, "tokens_emitted": 0.0,
+            "recompiles": 0.0, "blocking_syncs": 0.0,
+            "queue_depth": 0.0, "kv_util": 0.0,
+        }
 
     def now(self) -> float:
         return self._clock()
@@ -102,14 +111,23 @@ class ServingMetrics:
         self._prompt_tokens_total += prompt_tokens
         self._recompiles_total += 1 if recompiled else 0
         self._blocking_syncs_total += 1 if blocking_sync else 0
+        kv_util = 1.0 - kv_free / self.n_kv_blocks
         self._steps.append({
             "dispatch_s": dispatch_s, "sync_wait_s": sync_wait_s,
             "wall_s": wall_s, "new_tokens": new_tokens,
             "prompt_tokens": prompt_tokens, "n_seqs": n_seqs,
             "decode_only": decode_only, "recompiled": recompiled,
             "blocking_sync": blocking_sync, "queue_depth": queue_depth,
-            "kv_util": 1.0 - kv_free / self.n_kv_blocks,
+            "kv_util": kv_util,
         })
+        q = self._quick
+        q["steps"] = float(self._n_steps)
+        q["decode_steps"] = float(self._n_decode_steps)
+        q["tokens_emitted"] = float(self._tokens_total)
+        q["recompiles"] = float(self._recompiles_total)
+        q["blocking_syncs"] = float(self._blocking_syncs_total)
+        q["queue_depth"] = float(queue_depth)
+        q["kv_util"] = kv_util
 
     def record_emission(self, uid: int, t: Optional[float] = None,
                         first: bool = False,
@@ -164,6 +182,19 @@ class ServingMetrics:
             raise ValueError(f"unknown request outcome {outcome!r}")
         if latency_s is not None:
             self._request_latency_s.append(latency_s)
+
+    def quick_stats(self) -> Dict[str, float]:
+        """Per-step counters a fleet router polls (steps, tokens,
+        recompiles, blocking syncs) WITHOUT report()'s sorted
+        percentile work. ``queue_depth``/``kv_util`` are AS OF THE
+        LAST RECORDED STEP — submits between steps do not refresh
+        them; for live load use the O(1) gauges the frontend/engine
+        expose (``queued_requests``, ``kv_utilization``), which is
+        what ``Replica.snapshot()`` does. No allocation: the SAME
+        dict instance is returned every call and updated in place by
+        ``record_step`` — callers must read-and-drop (copy() to
+        retain across steps)."""
+        return self._quick
 
     # -- live signals (the SLO admission gate's inputs) ----------------
     def live_ttft_ms(self, q: float = 0.50) -> Optional[float]:

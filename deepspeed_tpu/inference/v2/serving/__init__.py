@@ -18,12 +18,20 @@ Pieces:
   plus the ``serve()`` driver — the open-world generalization of
   ``serving_loop._run_lookahead`` (requests join and leave the
   in-flight ragged batch mid-flight, no draining).
+* ``fleet/`` — the deployment tier above N front-ends: ``FleetRouter``
+  (prefix-affinity load balancing over data-parallel replicas),
+  ``Replica`` (health surface + simulated fault sites) and
+  ``FleetSupervisor`` (elastic replica recovery: requeue + respawn).
 """
 
 from .admission import AdmissionGate
+from .fleet import (FleetRouter, FleetSupervisor, Replica,
+                    RoundRobinPolicy, ScoringPolicy)
 from .frontend import ServingFrontend
 from .prefix import PrefixCache
 from .request import Request, RequestState, TokenStream
 
-__all__ = ["AdmissionGate", "PrefixCache", "Request", "RequestState",
-           "ServingFrontend", "TokenStream"]
+__all__ = ["AdmissionGate", "FleetRouter", "FleetSupervisor",
+           "PrefixCache", "Replica", "Request", "RequestState",
+           "RoundRobinPolicy", "ScoringPolicy", "ServingFrontend",
+           "TokenStream"]

@@ -1,0 +1,18 @@
+"""Fleet serving: a data-parallel replica router over N
+``ServingFrontend``s with prefix-affinity load balancing and elastic
+replica recovery (README "Fleet serving"; the deployment tier of
+PAPER.md layer 7 — MII/FastGen persistent deployments multiplex
+request traffic over engine replicas)."""
+
+from .elastic import FleetRecoveryEvent, FleetSupervisor
+from .replica import Replica
+from .router import FleetRouter, RoundRobinPolicy, ScoringPolicy
+
+__all__ = [
+    "FleetRecoveryEvent",
+    "FleetRouter",
+    "FleetSupervisor",
+    "Replica",
+    "RoundRobinPolicy",
+    "ScoringPolicy",
+]

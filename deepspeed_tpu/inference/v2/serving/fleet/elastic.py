@@ -1,0 +1,136 @@
+"""Elastic replica recovery: the serving-side supervisor (the PR-7
+elastic-training pattern, serving flavor).
+
+Detectors — the three ways a replica failure surfaces at the router:
+
+* **typed dispatch failure** — stepping a dead replica raises
+  ``WorkerFailureError`` (a real fleet's failed RPC), a wedged
+  dispatch raises ``CollectiveTimeout`` from the replica's own
+  watchdog; both are immediate.
+* **heartbeat deadline** — a hung replica is silent; the
+  ``HeartbeatMonitor`` ledger flags it after
+  ``serving.fleet.heartbeat_timeout_steps`` router steps.
+* **progress deadline** — a slow replica beats without advancing;
+  flagged after ``progress_timeout_steps``.
+
+Recovery is requeue-then-respawn, not a ladder: serving replicas are
+STATELESS below the request level (KV is a cache, the prefix trie is a
+cache), so there is nothing to roll back — the dead replica's
+in-flight requests are resubmitted onto survivors, where they replay
+BITWISE (per-request sampling keys are ``fold_in(fold_in(seed, uid),
+position)`` — batch-composition- and loop-invariant by construction),
+and the router's delivered-token cursor suppresses the replayed prefix
+so every stream stays gap-free and duplicate-free. Respawn rebuilds
+the replica through its factory and rejoins it to the scoring pool
+with a fresh ledger entry.
+
+Every detection/recovery lands in the fleet report (bounded histories,
+MTTR) and emits a typed ``TelemetryAlert`` through the router's sink.
+"""
+
+import dataclasses
+import time
+from collections import deque
+from typing import Tuple
+
+from .....telemetry.anomaly import TelemetryAlert
+from .....utils.logging import logger
+
+
+@dataclasses.dataclass
+class FleetRecoveryEvent:
+    """One handled replica failure: detection through pool-restored."""
+    slot: int
+    mode: str            # kill | hang | slow | error
+    reason: str
+    step: int            # router step of the detection
+    t: float
+    requeued_uids: Tuple[int, ...] = ()
+    respawned: bool = False
+    generation: int = 0  # replica generation AFTER recovery
+    mttr_s: float = 0.0  # detection -> requeued + pool restored
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FleetSupervisor:
+    """Owns the detect -> requeue -> respawn sequence over a
+    ``FleetRouter``'s replicas. The router feeds it (typed step
+    failures plus the heartbeat ledger's verdicts); it drives the
+    router's evacuation/respawn primitives and keeps the recovery
+    half of the fleet report."""
+
+    def __init__(self, router, monitor, fleet_config,
+                 clock=time.perf_counter):
+        self.router = router
+        self.monitor = monitor
+        self.config = fleet_config
+        self._clock = clock
+        # bounded histories (the PR-6 process-lifetime rule)
+        self.events: deque = deque(maxlen=256)
+        self._mttr_s: deque = deque(maxlen=256)
+        self.deaths = 0
+        self.requeued = 0
+        self.respawns = 0
+
+    # -- detectors ------------------------------------------------------
+    def check(self, step: int) -> int:
+        """The ledger sweep the router runs after stepping everyone:
+        handle every replica past a heartbeat/progress deadline.
+        Returns failures handled."""
+        handled = 0
+        for slot, mode, reason in self.monitor.check(step):
+            if slot in self.router._pool:
+                self.on_failure(slot, mode, reason, step)
+                handled += 1
+        return handled
+
+    def on_failure(self, slot: int, mode: str, reason: str,
+                   step: int) -> FleetRecoveryEvent:
+        """One replica failure, detection through recovery: quarantine
+        (a detected zombie must never rejoin on its own), retire its
+        ledger entry, evacuate its in-flight requests onto survivors,
+        respawn when configured, and record the whole incident."""
+        t0 = self._clock()
+        router = self.router
+        rep = router._replicas[slot]
+        logger.warning(f"fleet supervisor: replica {slot} failed "
+                       f"(mode={mode}, step={step}): {reason}")
+        rep.kill(reason)                      # idempotent quarantine
+        router._pool.discard(slot)
+        self.monitor.retire(slot)
+        self.deaths += 1
+        router._note_alert(TelemetryAlert(
+            "replica_death", f"fleet/replicas/r{slot}/alive", 0.0, 1.0,
+            step, f"replica {slot} failed (mode={mode}): {reason}"))
+        uids = router._evacuate(slot, step)
+        self.requeued += len(uids)
+        respawned = False
+        if self.config.respawn:
+            router._respawn(slot, step)
+            respawned = True
+            self.respawns += 1
+        mttr = self._clock() - t0
+        self._mttr_s.append(mttr)
+        event = FleetRecoveryEvent(
+            slot=slot, mode=mode, reason=reason, step=step, t=t0,
+            requeued_uids=tuple(uids), respawned=respawned,
+            generation=rep.generation, mttr_s=mttr)
+        self.events.append(event)
+        return event
+
+    # -- reporting ------------------------------------------------------
+    def report(self) -> dict:
+        mttr = list(self._mttr_s)
+        return {
+            "deaths": self.deaths,
+            "requeued": self.requeued,
+            "respawns": self.respawns,
+            "events": [e.as_dict() for e in self.events],
+            "mttr_s": {
+                "last": mttr[-1] if mttr else 0.0,
+                "mean": sum(mttr) / len(mttr) if mttr else 0.0,
+                "max": max(mttr) if mttr else 0.0,
+            },
+        }

@@ -1,0 +1,187 @@
+"""One data-parallel serving replica: a ``ServingFrontend`` + engine
+with the health surface the fleet router balances and recovers on.
+
+A replica adds three things the bare front-end does not have:
+
+* **a cheap ``snapshot()``** — queue depth, KV utilization and
+  prefix-cache counters for the router's per-step scoring pass, drawn
+  from ``ServingMetrics.quick_stats()`` (no-allocation) plus direct
+  attribute reads off the prefix trie — never the full
+  ``get_serving_report()`` percentile build;
+* **a liveness surface** — ``step()`` returns ``(stepped,
+  progressed)`` so the router can feed the fleet's
+  ``HeartbeatMonitor`` ledger (silence = hang, beats without progress
+  = slow), and a dead replica's dispatch raises a typed
+  ``WorkerFailureError`` (the health-gate / typed-dispatch-failure
+  detector);
+* **the ``fleet.dispatch`` fault site** — replica death is
+  simulatable on one process through the standard injector grammar:
+  ``fleet.dispatch:kill@5`` kills the replica polled at ordinal 5.
+  One ``consume()`` per replica SLOT per router step — ordinal =
+  ``step * n_replicas + slot`` (the pg_sim placement rule, so a
+  drill's fault lands on the same (replica, step) regardless of
+  earlier kills). Kinds map to the three serving failure modes:
+  ``kill`` -> permanent death, ``hang`` -> silence for ``~arg`` steps
+  (no step, no beat), ``slow`` -> beats without progressing for
+  ``~arg`` steps.
+"""
+
+import time
+from typing import Callable, Tuple
+
+from .....resilience.errors import WorkerFailureError
+from .....resilience.fault_injector import fault_injector
+from .....utils.logging import logger
+
+_FOREVER = float("inf")
+
+
+class Replica:
+    """Slot-addressed wrapper over one ``ServingFrontend``.
+
+    ``frontend_factory(slot)`` builds the front-end (and its engine);
+    the supervisor calls it again on respawn, so everything a fresh
+    replica needs must come from the factory — a respawned replica
+    starts with an empty KV pool and an empty prefix trie, exactly
+    like a restarted process."""
+
+    def __init__(self, slot: int, frontend_factory: Callable,
+                 clock=time.perf_counter):
+        self.slot = int(slot)
+        self._factory = frontend_factory
+        self._clock = clock
+        self.frontend = frontend_factory(self.slot)
+        self.generation = 1
+        # simulation truth: False once killed/quarantined. The router
+        # must NOT branch on this directly (a real router cannot read
+        # a remote replica's memory) — its view of death comes through
+        # the HEALTH SURFACE this flag simulates: ``snapshot()``
+        # returns alive=False (a failed health probe), dispatch
+        # (``submit()``/``cancel()``/``step()``) raises the typed
+        # ``WorkerFailureError`` a failed RPC would, and a hung
+        # replica is silent on the heartbeat ledger. Direct reads are
+        # reserved for the reporting surfaces.
+        self.alive = True
+        self.deaths = 0
+        self._hang_left = 0.0
+        self._slow_left = 0.0
+
+    @property
+    def engine(self):
+        return self.frontend.engine
+
+    # -- fault surface -------------------------------------------------
+    def poll_fault(self) -> None:
+        """One ``fleet.dispatch`` consume for this SLOT this router
+        step. Called for every slot every step — dead ones included —
+        so the site ordinal stays ``step * n_replicas + slot`` and a
+        drill's later faults land where the seed said regardless of
+        earlier kills (the pg_sim rule)."""
+        spec = fault_injector.consume("fleet.dispatch",
+                                      detail=f"replica{self.slot}")
+        if spec is None or not self.alive:
+            return
+        if spec.kind == "hang":
+            self._hang_left = spec.arg if spec.arg_given else _FOREVER
+        elif spec.kind == "slow":
+            self._slow_left = spec.arg if spec.arg_given else _FOREVER
+        else:
+            # kill / corrupt / error / ioerror: the process is gone
+            self.kill(f"injected {spec.kind}")
+
+    def kill(self, reason: str = "") -> None:
+        """Simulated replica death (also the quarantine path for a
+        detected hang/slow zombie: once replaced it must never rejoin
+        on its own). Idempotent."""
+        if not self.alive:
+            return
+        self.alive = False
+        self.deaths += 1
+        self._hang_left = self._slow_left = 0.0
+        logger.warning(f"fleet replica {self.slot} died"
+                       + (f": {reason}" if reason else ""))
+
+    def respawn(self) -> None:
+        """Rebuild the front-end + engine through the factory and
+        rejoin: fresh KV pool, empty prefix trie, generation bumped."""
+        self.frontend = self._factory(self.slot)
+        self.generation += 1
+        self.alive = True
+        self._hang_left = self._slow_left = 0.0
+
+    # -- the dispatch surface ------------------------------------------
+    def submit(self, *args, **kwargs):
+        """One submit dispatched to this replica — the simulated RPC:
+        on a dead replica it raises the typed ``WorkerFailureError`` a
+        failed remote call would surface as, never silently reaching
+        the (in-process) front-end object."""
+        if not self.alive:
+            raise WorkerFailureError(self.slot, "kill",
+                                     "replica is dead")
+        return self.frontend.submit(*args, **kwargs)
+
+    def cancel(self, uid: int):
+        """One cancel dispatched to this replica (same typed-failure
+        contract as ``submit``)."""
+        if not self.alive:
+            raise WorkerFailureError(self.slot, "kill",
+                                     "replica is dead")
+        return self.frontend.cancel(uid)
+
+    # -- the supervised step -------------------------------------------
+    def step(self) -> Tuple[bool, bool]:
+        """One front-end step under the simulated fault state ->
+        ``(stepped, progressed)`` for the heartbeat ledger. A dead
+        replica raises the typed ``WorkerFailureError`` (what a failed
+        RPC to a dead process surfaces as); a hung one is SILENT
+        (``(False, False)`` — no beat); a slow one beats without
+        progressing (``(True, False)``)."""
+        if not self.alive:
+            raise WorkerFailureError(self.slot, "kill",
+                                     "replica is dead")
+        if self._hang_left > 0:
+            self._hang_left -= 1
+            return False, False
+        if self._slow_left > 0:
+            self._slow_left -= 1
+            return True, False
+        self.frontend.step()
+        return True, True
+
+    # -- the scoring surface -------------------------------------------
+    def snapshot(self) -> dict:
+        """Polling-cheap health/load view for the router's scoring
+        pass: live queue/active gauges (O(1) properties), the
+        metrics' ``quick_stats()`` step counters, and the prefix
+        trie's counters read as plain attributes — NO percentile
+        sorts, no report build. Called once per replica per routed
+        request, so it must stay near-free (the perf smoke in
+        tests/unit/inference/serving/fleet/ holds it under 1% of a
+        steady decode step)."""
+        fe = self.frontend
+        if not self.alive or fe is None:
+            return {"alive": False, "slot": self.slot,
+                    "generation": self.generation}
+        q = fe.metrics.quick_stats()
+        eng = fe.engine
+        snap = {
+            "alive": True,
+            "slot": self.slot,
+            "generation": self.generation,
+            "queued": fe.queued_requests,
+            "active": fe.active_requests,
+            "outstanding": fe.queued_requests + fe.active_requests,
+            "capacity": eng._config.max_ragged_sequence_count,
+            "kv_util": eng.kv_utilization,
+            "steps": q["steps"],
+            "tokens_emitted": q["tokens_emitted"],
+            "recompiles": q["recompiles"],
+            "blocking_syncs": q["blocking_syncs"],
+        }
+        pc = eng.prefix_cache
+        if pc is not None:
+            snap["prefix_hits"] = pc.hits
+            snap["prefix_misses"] = pc.misses
+            snap["prefix_tokens_reused"] = pc.tokens_reused
+            snap["prefix_cached_blocks"] = pc.cached_blocks
+        return snap

@@ -1,0 +1,776 @@
+"""FleetRouter — the data-parallel replica router.
+
+One router fans request traffic out over N ``Replica``s (each a
+``ServingFrontend`` + engine), mirroring the front-end's own surface
+(``submit() / cancel() / stream() / step() / serve()``) so a server
+written against one frontend scales to a fleet by swapping the object.
+
+**Placement** is a scoring pass over the alive replicas::
+
+    score = affinity_weight * (matched prefix blocks / prompt blocks)
+          - queue_weight    * (outstanding / capacity)
+          - kv_weight       * kv_utilization
+
+where *matched prefix blocks* comes from the router's own block-hash
+-> replica map, keyed by the SAME chained blake2b digests as each
+replica's prefix trie (``serving/prefix.py chain_digests``) — so
+shared-prompt traffic lands where its KV prefix is already cached and
+the trie hits across the fleet instead of one process. Requests are
+STICKY after placement: cancel/stream route to the placed replica
+(and the placement survives in the router's map even while the
+replica's answer is in flight).
+
+**Admission composes**: each replica keeps its own gate (SLO /
+deadline / capacity — PR 9's ``AdmissionGate``); the router only adds
+the fleet dimension. When every alive replica refuses a submit, the
+router sheds or raises a typed ``ServingOverloadError`` carrying the
+aggregated fleet view (``.fleet_view``: per-replica snapshots).
+
+**Elastic recovery** is the ``FleetSupervisor``'s job (elastic.py):
+on a detected failure, the dead replica's in-flight requests are
+requeued onto survivors, where they replay BITWISE (sampling keys are
+``fold_in(fold_in(seed, uid), position)``), and the router's
+delivered-token cursor suppresses the replayed prefix so every
+``TokenStream`` resumes gap-free and duplicate-free.
+
+Single-threaded like the front-end: ``step()`` polls fault sites,
+steps every pooled replica once, feeds the heartbeat ledger, syncs
+request states, runs the supervisor sweep and retries the requeue
+backlog. Deterministic by construction — every test replays.
+"""
+
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from .....resilience.errors import (CollectiveTimeout,
+                                    ServingOverloadError,
+                                    TerminalRequestError,
+                                    UnknownRequestError,
+                                    WorkerFailureError)
+from .....runtime.lifecycle import BoundedCache
+from .....telemetry.anomaly import TelemetryAlert
+from .....telemetry.trace import span
+from .....utils.logging import logger
+from ..frontend import (ServingFrontend, _normalize_config,
+                        drive_serving)
+from ..prefix import chain_digests
+from ..request import Request, RequestState, TokenStream
+from .elastic import FleetSupervisor
+from .replica import Replica
+
+
+class ScoringPolicy:
+    """The default pluggable scorer: prefix affinity pulls, load and
+    KV pressure push. ``score`` consumes one replica ``snapshot()``
+    plus the affinity fraction (matched prefix blocks / prompt
+    blocks) the router computed from its block-hash map."""
+
+    def __init__(self, affinity_weight: float = 4.0,
+                 queue_weight: float = 1.0, kv_weight: float = 1.0):
+        self.affinity_weight = float(affinity_weight)
+        self.queue_weight = float(queue_weight)
+        self.kv_weight = float(kv_weight)
+
+    def score(self, snapshot: dict, affinity_fraction: float) -> float:
+        load = snapshot["outstanding"] / max(1.0,
+                                             float(snapshot["capacity"]))
+        return (self.affinity_weight * affinity_fraction
+                - self.queue_weight * load
+                - self.kv_weight * snapshot["kv_util"])
+
+
+class RoundRobinPolicy:
+    """Affinity-blind baseline (the A/B control the acceptance test
+    compares hit rates against): replicas in rotation, load ignored."""
+
+    def __init__(self):
+        self._next = 0
+
+    def rank(self, alive: List[int]) -> List[int]:
+        if not alive:
+            return []
+        start = self._next % len(alive)
+        self._next += 1
+        return alive[start:] + alive[:start]
+
+
+class _FleetEntry:
+    """Router-side bookkeeping for one request: the user-visible
+    ``Request`` handle plus placement + replay-cursor state."""
+    __slots__ = ("req", "slot", "kwargs", "digests", "seen",
+                 "requeues", "user_on_token")
+
+    def __init__(self, req, kwargs, digests, user_on_token):
+        self.req = req
+        self.slot: Optional[int] = None
+        self.kwargs = kwargs
+        self.digests = digests
+        self.seen = 0          # tokens seen from the CURRENT attempt
+        self.requeues = 0
+        self.user_on_token = user_on_token
+
+
+class FleetRouter:
+
+    def __init__(self, engine_factory: Callable, config=None, *,
+                 n_replicas: Optional[int] = None, policy=None,
+                 clock=time.perf_counter):
+        """``engine_factory(slot) -> InferenceEngineV2`` builds one
+        replica's engine (and is called again on respawn — replicas
+        must be rebuildable from scratch). All replicas must share
+        engine geometry (same factory, same config): the affinity map
+        assumes one ``kv_block_size`` fleet-wide."""
+        import dataclasses as _dc
+        self.config = cfg = _normalize_config(config)
+        fc = self.config.fleet
+        self._clock = clock
+        n = int(fc.n_replicas if n_replicas is None else n_replicas)
+        if n < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n}")
+        if cfg.on_overload not in ("raise", "shed"):
+            raise ValueError(f"serving.on_overload must be raise/shed, "
+                             f"got {cfg.on_overload!r}")
+        if policy is None:
+            if fc.policy == "affinity":
+                policy = ScoringPolicy(fc.affinity_weight,
+                                       fc.queue_weight, fc.kv_weight)
+            elif fc.policy == "round_robin":
+                policy = RoundRobinPolicy()
+            else:
+                raise ValueError(f"serving.fleet.policy must be "
+                                 f"affinity/round_robin, got "
+                                 f"{fc.policy!r}")
+        self.policy = policy
+        self._engine_factory = engine_factory
+        # replica front-ends always RAISE on their queue bound: the
+        # router owns fleet-level shed policy (cfg.on_overload) and a
+        # replica that silently shed a routed request would corrupt
+        # the router's placement bookkeeping
+        self._replica_cfg = _dc.replace(cfg, on_overload="raise")
+        self._replicas = [Replica(slot, self._frontend_factory, clock)
+                          for slot in range(n)]
+        self._pool: Set[int] = set(range(n))  # the router's view
+        from .....resilience.watchdog import HeartbeatMonitor
+        self._monitor = HeartbeatMonitor(
+            world_size=n,
+            heartbeat_timeout_steps=fc.heartbeat_timeout_steps,
+            progress_timeout_steps=fc.progress_timeout_steps)
+        self._supervisor = FleetSupervisor(self, self._monitor, fc,
+                                           clock=clock)
+        # block-hash -> slot, same chained blake2b keys as the trie;
+        # LRU-bounded (the PR-6 rule: nothing grows for process
+        # lifetime)
+        self._affinity_map = BoundedCache(
+            "fleet_affinity_map",
+            max_entries=max(1, int(fc.affinity_map_entries)))
+        self._block_size = \
+            self._replicas[0].engine._config.kv_block_size
+        # request bookkeeping
+        self._entries: Dict[int, _FleetEntry] = {}
+        self._placed: Dict[int, Set[int]] = {s: set() for s in range(n)}
+        self._backlog: deque = deque()
+        self._retired: deque = deque()
+        self._next_uid = 1
+        self._step_idx = 0
+        self._imbalanced = False
+        # fleet totals
+        self.submitted = 0
+        self.finished = 0
+        self.cancelled = 0
+        self.shed = 0
+        self.abandoned = 0
+        self.affinity_routed = 0
+        self.replay_mismatches = 0
+        self.alerts: deque = deque(maxlen=256)
+        self._hub = None
+
+    def _frontend_factory(self, slot: int) -> ServingFrontend:
+        return ServingFrontend(self._engine_factory(slot),
+                               self._replica_cfg, clock=self._clock)
+
+    # -- telemetry ------------------------------------------------------
+    def _note_alert(self, alert) -> None:
+        self.alerts.append(alert)
+        if self._hub is not None:
+            self._hub.note_alert(alert)
+
+    def attach_telemetry(self, hub, namespace: str = "fleet"):
+        """Register the fleet snapshot (per-replica scalars + router
+        totals) on a ``TelemetryHub`` and route fleet
+        ``TelemetryAlert``s (replica death / rebalance / imbalance)
+        into its alert log."""
+        hub.register(namespace, self._telemetry_snapshot)
+        self._hub = hub
+        return hub
+
+    def _telemetry_snapshot(self) -> dict:
+        reps = {f"r{rep.slot}": rep.snapshot()
+                for rep in self._replicas}
+        return {"replicas": reps, "router": self._router_stats(),
+                "prefix": self._fleet_prefix_stats()}
+
+    # -- introspection --------------------------------------------------
+    @property
+    def n_replicas(self) -> int:
+        return len(self._replicas)
+
+    @property
+    def pooled_replicas(self) -> List[int]:
+        return sorted(self._pool)
+
+    def get_request(self, uid: int) -> Optional[Request]:
+        e = self._entries.get(uid)
+        return e.req if e is not None else None
+
+    @property
+    def idle(self) -> bool:
+        if self._backlog:
+            return False
+        if any(not e.req.done for e in self._entries.values()):
+            return False
+        return all(self._replicas[s].frontend.idle
+                   for s in self._pool)
+
+    def spec_for(self, slot: int, step: int, mode: str,
+                 duration: Optional[float] = None) -> str:
+        """Fault-grammar string hitting exactly (slot, step) on the
+        ``fleet.dispatch`` site (ordinal = step * n_replicas + slot —
+        the pg_sim placement rule poll_fault preserves). ``step`` is
+        0-based and counted from when the spec is ARMED:
+        ``fault_injector.configure`` resets the site ordinals, so the
+        first router step after arming is step 0."""
+        after = step * len(self._replicas) + slot
+        spec = f"fleet.dispatch:{mode}@{after}"
+        if duration is not None:
+            spec += f"~{duration:g}"
+        return spec
+
+    # -- submission surface --------------------------------------------
+    def submit(self, prompt, *, uid: Optional[int] = None,
+               max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               sampling=None, priority: int = 0,
+               deadline_ms: Optional[float] = None,
+               on_token=None) -> Request:
+        """Queue-and-place one request; returns the ROUTER's live
+        ``Request`` handle (tokens accumulate here across requeues).
+        Placement is immediate (scoring pass + the chosen replica's
+        submit); when every alive replica refuses, the router raises a
+        typed ``ServingOverloadError`` with the fleet view attached
+        (``serving.on_overload = "raise"``) or returns the request
+        already SHED (``"shed"``)."""
+        cfg = self.config
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if uid is None:
+            while self._next_uid in self._entries:
+                self._next_uid += 1
+            uid = self._next_uid
+            self._next_uid += 1
+        elif uid in self._entries and not self._entries[uid].req.done:
+            raise ValueError(f"uid {uid} is already live")
+        if sampling is not None and cfg.executable == "greedy":
+            raise ValueError(
+                "request carries SamplingParams but serving.executable "
+                "is pinned to 'greedy'")
+        if sampling is not None and sampling.seed is not None and \
+                sampling.seed != cfg.seed:
+            # a per-request seed would latch ONE replica's base key and
+            # leave the others on the deployment default — the bitwise
+            # requeue-replay contract needs one fleet-wide base key
+            raise ValueError(
+                f"per-request seed {sampling.seed} requires the "
+                f"deployment-pinned serving.seed to match (fleet "
+                f"replay must be replica-invariant; serving.seed is "
+                f"{cfg.seed})")
+        req = Request(
+            uid=uid, prompt=prompt,
+            max_new_tokens=(cfg.max_new_tokens if max_new_tokens is None
+                            else max_new_tokens),
+            eos_token_id=(cfg.eos_token_id if eos_token_id is None
+                          else eos_token_id),
+            sampling=sampling, priority=priority,
+            deadline_ms=deadline_ms, submitted_t=self._clock())
+        entry = _FleetEntry(
+            req,
+            kwargs=dict(max_new_tokens=req.max_new_tokens,
+                        eos_token_id=req.eos_token_id,
+                        sampling=sampling, priority=priority,
+                        deadline_ms=deadline_ms),
+            digests=chain_digests(prompt, self._block_size),
+            user_on_token=on_token)
+        self._entries[uid] = entry
+        self.submitted += 1
+        try:
+            placed = self._place(uid)
+        except Exception:
+            # a replica-side validation error must not leave a ghost
+            self._entries.pop(uid, None)
+            self.submitted -= 1
+            raise
+        if not placed:
+            if cfg.on_overload == "raise":
+                # never accepted: unwind the accounting exactly like
+                # the replica-side validation-error path above
+                self._entries.pop(uid, None)
+                self.submitted -= 1
+                raise self._overload_error([uid])
+            req.shed_reason = "fleet saturated at submit"
+            self._finish(entry, RequestState.SHED)
+            self.shed += 1
+        return req
+
+    def cancel(self, uid: int) -> bool:
+        """Cancel a live request wherever it is — backlog, queued or
+        in flight on its sticky replica. Same typed contract as the
+        front-end: unknown -> ``UnknownRequestError``, terminal ->
+        ``TerminalRequestError``."""
+        e = self._entries.get(uid)
+        if e is None:
+            raise UnknownRequestError(uid, surface="fleet router")
+        if e.req.done:
+            raise TerminalRequestError(uid, e.req.state.name)
+        slot = e.slot
+        if slot is not None and slot in self._pool:
+            try:
+                self._replicas[slot].cancel(uid)
+            except TerminalRequestError:
+                # finished while routing: the buffered tokens are the
+                # complete answer — surface that, not a cancel
+                self._sync_replica(slot)
+                raise TerminalRequestError(uid, e.req.state.name) \
+                    from None
+            except (UnknownRequestError, WorkerFailureError):
+                # never landed there / the replica just died (the
+                # dispatch raced its detection): nothing live remotely
+                pass
+        if slot is not None:
+            self._placed.get(slot, set()).discard(uid)
+        try:
+            self._backlog.remove(uid)
+        except ValueError:
+            pass
+        self._finish(e, RequestState.CANCELLED)
+        self.cancelled += 1
+        return True
+
+    def stream(self, uid: int) -> TokenStream:
+        """Ordered token iterator over the ROUTER's request handle —
+        requeue-transparent (the replay cursor keeps it gap-free and
+        duplicate-free across replica deaths); iterating pumps
+        ``step()``."""
+        e = self._entries.get(uid)
+        if e is None:
+            raise UnknownRequestError(uid, surface="fleet router")
+        return TokenStream(e.req, pump=self.step)
+
+    def result(self, uid: int) -> List[int]:
+        e = self._entries.get(uid)
+        if e is None:
+            raise UnknownRequestError(uid, surface="fleet router")
+        return list(e.req.tokens)
+
+    # -- internal lifecycle --------------------------------------------
+    def _retire(self, uid: int) -> None:
+        self._retired.append(uid)
+        bound = max(1, int(self.config.max_retained_requests))
+        while len(self._retired) > bound:
+            old = self._retired.popleft()
+            dead = self._entries.get(old)
+            if dead is not None and dead.req.done:
+                self._entries.pop(old, None)
+
+    def _finish(self, entry: _FleetEntry,
+                state: RequestState) -> None:
+        req = entry.req
+        # walk the legal edges forward to the terminal state
+        if state != RequestState.SHED:
+            if req.state == RequestState.QUEUED and \
+                    state == RequestState.FINISHED:
+                req.advance(RequestState.PREFILL)
+        req.advance(state)
+        req.finished_t = self._clock()
+        self._retire(req.uid)
+
+    def _abandon(self, entry: _FleetEntry, reason: str) -> None:
+        """Terminal give-up on a request the fleet cannot keep
+        replaying (cascading deaths past the requeue bound)."""
+        entry.req.shed_reason = reason
+        logger.warning(f"fleet router abandoned request "
+                       f"{entry.req.uid}: {reason}")
+        self._finish(entry, RequestState.CANCELLED)
+        self.abandoned += 1
+
+    def _make_on_token(self, uid: int):
+        def cb(tok: int) -> None:
+            e = self._entries.get(uid)
+            if e is None:
+                return
+            e.seen += 1
+            if e.seen <= len(e.req.tokens):
+                # replayed position after a requeue: suppressed — and,
+                # per the replay contract, bitwise identical
+                if e.req.tokens[e.seen - 1] != tok:
+                    self.replay_mismatches += 1
+                    logger.warning(
+                        f"fleet replay mismatch for uid {uid} at "
+                        f"position {e.seen - 1}: "
+                        f"{e.req.tokens[e.seen - 1]} -> {tok}")
+                return
+            e.req.tokens.append(tok)
+            if e.req.first_token_t is None:
+                e.req.first_token_t = self._clock()
+            if e.user_on_token is not None:
+                e.user_on_token(tok)
+        return cb
+
+    # -- placement ------------------------------------------------------
+    def _affinity(self, digests) -> Tuple[Optional[int], int]:
+        """Walk the block-hash map from the root: the replica holding
+        the longest consecutive head of this chain, and how many
+        blocks of it. (A chain split across replicas stops the walk —
+        a trie hit needs every ancestor local.)"""
+        slot = None
+        n = 0
+        for d in digests:
+            s = self._affinity_map.get(d)
+            if s is None or (slot is not None and s != slot):
+                break
+            slot = s
+            n += 1
+        return slot, n
+
+    def _ranked_slots(self, entry
+                      ) -> Tuple[List[int], Optional[int], int]:
+        """Rank the POOLED slots — the router's own view, never the
+        replicas' simulation-truth liveness. Death it has not yet
+        detected surfaces the way a real fleet's would: a failed
+        health probe (``snapshot()`` reporting alive=False) drops the
+        candidate here; a dead dispatch raises typed in ``_place``."""
+        probed = [(s, snap) for s in sorted(self._pool)
+                  if (snap := self._replicas[s].snapshot()).get("alive")]
+        if not probed:
+            return [], None, 0
+        if hasattr(self.policy, "rank"):          # round-robin family
+            return self.policy.rank([s for s, _ in probed]), None, 0
+        aff_slot, aff_n = self._affinity(entry.digests)
+        n_blocks = max(1, len(entry.digests))
+        scored = []
+        for s, snap in probed:
+            af = aff_n / n_blocks if s == aff_slot else 0.0
+            scored.append((-self.policy.score(snap, af), s))
+        scored.sort()
+        order = [s for _, s in scored]
+        if aff_n == 0:
+            aff_slot = None
+        return order, aff_slot, aff_n
+
+    def _place(self, uid: int) -> bool:
+        """One scoring pass + submit; returns False when every alive
+        replica refused (fleet saturated)."""
+        e = self._entries[uid]
+        order, aff_slot, aff_n = self._ranked_slots(e)
+        kwargs = e.kwargs
+        if kwargs.get("deadline_ms") is not None:
+            # the deadline clock does NOT restart on a requeue: the
+            # survivor's gate sees only the budget the request has
+            # left (0 left -> it sheds there, and the router
+            # propagates) — a client's deadline is end-to-end, not
+            # per-attempt
+            elapsed_ms = (self._clock() - e.req.submitted_t) * 1e3
+            kwargs = dict(kwargs, deadline_ms=max(
+                0.0, kwargs["deadline_ms"] - elapsed_ms))
+        with span("fleet.route", uid=uid, affinity=aff_n):
+            for slot in order:
+                rep = self._replicas[slot]
+                try:
+                    rep.submit(e.req.prompt, uid=uid,
+                               on_token=self._make_on_token(uid),
+                               **kwargs)
+                except ServingOverloadError:
+                    continue
+                except WorkerFailureError:
+                    # dead dispatch (the simulated failed RPC): try
+                    # the next candidate; the formal detection +
+                    # evacuation runs on the next router step
+                    continue
+                e.slot = slot
+                e.seen = 0
+                self._placed.setdefault(slot, set()).add(uid)
+                for d in e.digests:
+                    self._affinity_map.put(d, slot)
+                if slot == aff_slot:
+                    self.affinity_routed += 1
+                return True
+        return False
+
+    def _overload_error(self, shed_uids) -> ServingOverloadError:
+        snaps = {s: self._replicas[s].snapshot() for s in self._pool}
+        alive = [v for v in snaps.values() if v.get("alive")]
+        total_out = sum(v["outstanding"] for v in alive)
+        free = sum(self._replicas[s].engine.free_blocks
+                   for s, v in snaps.items() if v.get("alive"))
+        kv = (sum(v["kv_util"] for v in alive) / len(alive)
+              if alive else 1.0)
+        err = ServingOverloadError(
+            "fleet saturated: every alive replica refused the request",
+            queue_depth=total_out, kv_util=kv, free_blocks=free,
+            shed_uids=shed_uids)
+        err.fleet_view = snaps
+        return err
+
+    # -- the fleet step -------------------------------------------------
+    def step(self) -> bool:
+        """One fleet iteration: poll every slot's fault site (ordinal
+        discipline), step every pooled replica (beating the heartbeat
+        ledger; a typed step failure is an immediate detection), sync
+        request states, run the supervisor's deadline sweep, then
+        retry the requeue backlog on the survivors."""
+        self._step_idx += 1
+        step = self._step_idx
+        for rep in self._replicas:
+            rep.poll_fault()
+        for slot in sorted(self._pool):
+            rep = self._replicas[slot]
+            try:
+                stepped, progressed = rep.step()
+            except (WorkerFailureError, CollectiveTimeout) as e:
+                mode = getattr(e, "mode", "hang")
+                self._supervisor.on_failure(slot, mode, str(e), step)
+                continue
+            if stepped:
+                self._monitor.beat(slot, step, progressed=progressed)
+                self._sync_replica(slot)
+        self._supervisor.check(step)
+        if self._backlog:
+            if not self._pool:
+                # every replica is gone and respawn is off: nothing
+                # can ever place these again — typed give-up (the
+                # handles close CANCELLED with the reason) instead of
+                # a serve()/stream() livelock on a non-idle backlog
+                for uid in list(self._backlog):
+                    e = self._entries.get(uid)
+                    if e is not None and not e.req.done:
+                        self._abandon(e, "no replicas left in the "
+                                         "pool (respawn disabled)")
+                self._backlog.clear()
+            else:
+                self._place_backlog()
+        self._check_imbalance(step)
+        return not self.idle
+
+    def _sync_replica(self, slot: int) -> None:
+        """Mirror replica-side request states onto the router handles
+        (the router cannot be called back for lifecycle edges — only
+        tokens flow through ``on_token``)."""
+        placed = self._placed.get(slot)
+        if not placed:
+            return
+        fe = self._replicas[slot].frontend
+        for uid in list(placed):
+            e = self._entries.get(uid)
+            if e is None or e.slot != slot:
+                placed.discard(uid)
+                continue
+            req = e.req
+            if req.done:
+                placed.discard(uid)
+                continue
+            rr = fe.get_request(uid)
+            if rr is None:
+                # the replica RETIRED it (past max_retained_requests)
+                # before this sync: it reached a terminal state there.
+                # Router cancels close the handle before this point
+                # and the gate only sheds QUEUED (tokenless) work, so
+                # buffered tokens imply the decode FINISHED — close
+                # the handle instead of skipping it forever (a live
+                # handle nothing will ever finish livelocks serve())
+                logger.warning(
+                    f"fleet router: uid {uid} vanished from replica "
+                    f"{slot} (retired before sync); closing from "
+                    f"{len(req.tokens)} buffered token(s)")
+                if req.tokens:
+                    if req.state == RequestState.QUEUED:
+                        req.advance(RequestState.PREFILL)
+                    self._finish(e, RequestState.FINISHED)
+                    self.finished += 1
+                else:
+                    req.shed_reason = ("vanished from replica "
+                                       "(retired before router sync)")
+                    self._finish(e, RequestState.SHED
+                                 if req.state == RequestState.QUEUED
+                                 else RequestState.CANCELLED)
+                    self.shed += 1
+                placed.discard(uid)
+                continue
+            if rr.state == RequestState.PREFILL:
+                if req.state == RequestState.QUEUED:
+                    req.advance(RequestState.PREFILL)
+            elif rr.state == RequestState.DECODE:
+                if req.state == RequestState.QUEUED:
+                    req.advance(RequestState.PREFILL)
+                if req.state == RequestState.PREFILL:
+                    req.advance(RequestState.DECODE)
+            elif rr.state == RequestState.FINISHED:
+                if req.state == RequestState.QUEUED:
+                    req.advance(RequestState.PREFILL)
+                self._finish(e, RequestState.FINISHED)
+                self.finished += 1
+                placed.discard(uid)
+            elif rr.state == RequestState.SHED:
+                # the replica's gate refused it (deadline/SLO): the
+                # router propagates — SHED from the queue, CANCELLED
+                # (with the reason) for a request already mid-flight
+                # from an earlier attempt
+                req.shed_reason = rr.shed_reason
+                if req.state == RequestState.QUEUED:
+                    self._finish(e, RequestState.SHED)
+                else:
+                    self._finish(e, RequestState.CANCELLED)
+                self.shed += 1
+                placed.discard(uid)
+            elif rr.state == RequestState.CANCELLED:
+                # replica-side cancels only originate at the router;
+                # reaching here means cancel() already closed the
+                # handle — nothing to mirror
+                placed.discard(uid)
+
+    # -- elastic-recovery primitives (the supervisor drives these) -----
+    def _evacuate(self, slot: int, step: int) -> List[int]:
+        """Pull the failed replica's live placements into the requeue
+        backlog (their replay cursors reset; tokens already delivered
+        stay on the router handle and suppress the replayed prefix).
+        Returns the uids actually REQUEUED — a request past its
+        ``max_requeues_per_request`` bound is abandoned instead and
+        must not inflate the requeue accounting."""
+        uids = sorted(
+            uid for uid in self._placed.get(slot, set())
+            if (e := self._entries.get(uid)) is not None
+            and e.slot == slot and not e.req.done)
+        requeued: List[int] = []
+        with span("fleet.requeue", slot=slot, n=len(uids)):
+            for uid in uids:
+                e = self._entries[uid]
+                e.slot = None
+                e.seen = 0
+                e.requeues += 1
+                if e.requeues > \
+                        self.config.fleet.max_requeues_per_request:
+                    self._abandon(
+                        e, f"evacuated {e.requeues} times "
+                           f"(max_requeues_per_request)")
+                    continue
+                self._backlog.append(uid)
+                requeued.append(uid)
+        self._placed[slot] = set()
+        if requeued:
+            self._note_alert(TelemetryAlert(
+                "fleet_rebalance", "fleet/router/requeued",
+                float(len(requeued)), 0.0, step,
+                f"requeued {len(requeued)} in-flight request(s) off "
+                f"replica {slot} onto the survivors"))
+        return requeued
+
+    def _respawn(self, slot: int, step: int) -> None:
+        rep = self._replicas[slot]
+        with span("fleet.respawn", slot=slot,
+                  generation=rep.generation + 1):
+            rep.respawn()
+        # its trie died with it: stale affinity must not pull traffic
+        # to an empty cache (stats-neutral sweep — a get() per key
+        # would promote every entry to MRU and fake 4k hits)
+        stale = [d for d, s in list(self._affinity_map.items())
+                 if s == slot]
+        for d in stale:
+            self._affinity_map.pop(d)
+        self._pool.add(slot)
+        self._monitor.restore(slot, step)
+
+    def _place_backlog(self) -> None:
+        pending = list(self._backlog)
+        self._backlog.clear()
+        for uid in pending:
+            e = self._entries.get(uid)
+            if e is None or e.req.done:
+                continue
+            if not self._place(uid):
+                self._backlog.append(uid)   # defer: capacity frees up
+
+    def _check_imbalance(self, step: int) -> None:
+        spread_max = int(self.config.fleet.imbalance_alert_spread)
+        if spread_max <= 0:
+            return
+        outs = [snap["outstanding"] for s in self._pool
+                if (snap := self._replicas[s].snapshot()).get("alive")]
+        if len(outs) < 2:
+            return
+        spread = max(outs) - min(outs)
+        if spread > spread_max and not self._imbalanced:
+            self._note_alert(TelemetryAlert(
+                "fleet_imbalance", "fleet/router/outstanding_spread",
+                float(spread), float(spread_max), step,
+                f"outstanding work spread {spread} across replicas "
+                f"exceeds {spread_max}"))
+        self._imbalanced = spread > spread_max
+
+    # -- driver ---------------------------------------------------------
+    def serve(self, poll=None, max_steps: Optional[int] = None) -> int:
+        """Drive ``step()`` until the fleet is idle; same contract as
+        ``ServingFrontend.serve`` (``poll(router, step_idx)`` runs
+        before every step, return False to stop accepting)."""
+        return drive_serving(self, poll, max_steps)
+
+    def drain(self, max_steps: int = 100000) -> int:
+        return self.serve(max_steps=max_steps)
+
+    # -- reporting ------------------------------------------------------
+    def _router_stats(self) -> dict:
+        return {
+            "step": self._step_idx,
+            "submitted": self.submitted,
+            "finished": self.finished,
+            "cancelled": self.cancelled,
+            "shed": self.shed,
+            "abandoned": self.abandoned,
+            "requeued": self._supervisor.requeued,
+            "deaths": self._supervisor.deaths,
+            "respawns": self._supervisor.respawns,
+            "affinity_routed": self.affinity_routed,
+            "replay_mismatches": self.replay_mismatches,
+            "backlog": len(self._backlog),
+            "pooled": len(self._pool),
+            "alerts": len(self.alerts),
+        }
+
+    def _fleet_prefix_stats(self) -> dict:
+        """Cross-replica reuse counters, aggregated over the ALIVE
+        replicas (a dead replica's counters died with its engine —
+        the fleet rate covers the serving pool as it stands)."""
+        hits = misses = reused = cached = 0
+        for rep in self._replicas:
+            if not rep.alive or rep.engine.prefix_cache is None:
+                continue
+            pc = rep.engine.prefix_cache
+            hits += pc.hits
+            misses += pc.misses
+            reused += pc.tokens_reused
+            cached += pc.cached_blocks
+        total = hits + misses
+        return {"hits": hits, "misses": misses,
+                "hit_rate": hits / total if total else 0.0,
+                "tokens_reused": reused, "cached_blocks": cached}
+
+    def get_fleet_report(self) -> dict:
+        """Per-replica snapshots + router totals + aggregated prefix
+        reuse + the supervisor's recovery history."""
+        return {
+            "replicas": {str(rep.slot): rep.snapshot()
+                         for rep in self._replicas},
+            "router": self._router_stats(),
+            "prefix": self._fleet_prefix_stats(),
+            "recovery": self._supervisor.report(),
+        }

@@ -40,7 +40,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ....resilience.errors import ResilienceError, ServingOverloadError
+from ....resilience.errors import (ResilienceError, ServingOverloadError,
+                                   TerminalRequestError,
+                                   UnknownRequestError)
 from ....resilience.fault_injector import fault_injector
 from ....telemetry.trace import span
 from ....utils.logging import logger
@@ -64,6 +66,26 @@ def _normalize_config(config):
         return ServingConfig.from_dict(config)
     raise ValueError(f"config must be a ServingConfig, dict or None, "
                      f"got {type(config)}")
+
+
+def drive_serving(surface, poll=None, max_steps=None) -> int:
+    """THE serve loop, shared by every serving surface exposing
+    ``.idle``/``.step()`` (``ServingFrontend``, ``FleetRouter``): one
+    poll-then-step iteration until idle-and-not-accepting (or
+    ``max_steps``). One copy so the poll contract (``is not False``
+    accepting semantics, step accounting) cannot silently diverge
+    between the single-replica and fleet surfaces."""
+    steps = 0
+    accepting = poll is not None
+    while True:
+        if accepting:
+            accepting = poll(surface, steps) is not False
+        if surface.idle and not accepting:
+            return steps
+        if max_steps is not None and steps >= max_steps:
+            return steps
+        surface.step()
+        steps += 1
 
 
 class ServingFrontend:
@@ -153,6 +175,13 @@ class ServingFrontend:
     def queued_requests(self) -> int:
         return len(self._queue)
 
+    @property
+    def idle(self) -> bool:
+        """No queued/joined work and nothing in flight — the drain
+        terminal ``serve()`` (and the fleet router) test for."""
+        return not (self._queue or self._pending or self._decode
+                    or self._inflight is not None)
+
     def get_request(self, uid: int) -> Optional[Request]:
         return self._requests.get(uid)
 
@@ -234,11 +263,18 @@ class ServingFrontend:
         """Cancel a live request — mid-queue, mid-prefill or
         mid-decode. KV blocks and the sequence slot are freed
         IMMEDIATELY (an in-flight row's stale device writes are masked
-        by ``seq_lens``, exactly like the EOS-overshoot path). Returns
-        False for unknown/already-terminal uids."""
+        by ``seq_lens``, exactly like the EOS-overshoot path).
+
+        Typed failure contract (the fleet router's requeue path keys
+        off it): an unknown uid raises ``UnknownRequestError`` ("never
+        placed" — nothing to clean up), an already-terminal uid raises
+        ``TerminalRequestError`` carrying the state ("finished while
+        routing" — the buffered tokens are the complete answer)."""
         req = self._requests.get(uid)
-        if req is None or req.done:
-            return False
+        if req is None:
+            raise UnknownRequestError(uid)
+        if req.done:
+            raise TerminalRequestError(uid, req.state.name)
         with span("frontend.leave", uid=uid, why="cancel"):
             if req.state == RequestState.QUEUED:
                 self._queue.remove(uid)
@@ -254,15 +290,20 @@ class ServingFrontend:
         """Ordered token iterator for ``uid``; iterating pumps
         ``step()`` while tokens are pending, so a bare
         ``for tok in frontend.stream(uid)`` serves the request (and
-        everything batched with it) to completion."""
+        everything batched with it) to completion. An unknown uid
+        raises a typed ``UnknownRequestError`` (terminal-but-retained
+        requests still stream their buffered tokens)."""
         req = self._requests.get(uid)
         if req is None:
-            raise KeyError(f"unknown request uid {uid}")
+            raise UnknownRequestError(uid)
         return TokenStream(req, pump=self.step)
 
     def result(self, uid: int) -> List[int]:
         """The tokens emitted so far (complete for terminal states)."""
-        return list(self._requests[uid].tokens)
+        req = self._requests.get(uid)
+        if req is None:
+            raise UnknownRequestError(uid)
+        return list(req.tokens)
 
     # -- internal lifecycle helpers ------------------------------------
     def _retire(self, uid: int) -> None:
@@ -547,19 +588,7 @@ class ServingFrontend:
         drains its network queue into ``submit()``/``cancel()``;
         return False from it to stop accepting (serve then drains and
         returns). Returns the number of steps taken."""
-        steps = 0
-        accepting = poll is not None
-        while True:
-            if accepting:
-                accepting = poll(self, steps) is not False
-            idle = not (self._queue or self._pending or self._decode
-                        or self._inflight is not None)
-            if idle and not accepting:
-                return steps
-            if max_steps is not None and steps >= max_steps:
-                return steps
-            self.step()
-            steps += 1
+        return drive_serving(self, poll, max_steps)
 
     def drain(self, max_steps: int = 100000) -> int:
         """Serve until every live request reaches a terminal state."""

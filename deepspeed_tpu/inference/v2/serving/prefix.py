@@ -54,6 +54,33 @@ class _Entry:
 _ROOT = b""
 
 
+def block_digest(parent: bytes, block_tokens: np.ndarray) -> bytes:
+    """THE key schema: one chained blake2b digest per full block.
+    Shared by the trie below and the fleet router's affinity map
+    (serving/fleet/router.py) — the two must hash identically or
+    affinity routing stops predicting trie hits."""
+    h = hashlib.blake2b(parent, digest_size=16)
+    h.update(np.ascontiguousarray(block_tokens, np.int32).tobytes())
+    return h.digest()
+
+
+def chain_digests(tokens, block_size: int) -> List[bytes]:
+    """The chained digests of ``tokens``' full-block prefix, capped at
+    ``len(tokens) - 1`` exactly like ``PrefixCache.match`` (the last
+    token never caches — it must flow through the forward), so digest
+    ``i`` here is the key under which block ``i`` would live in any
+    replica's trie."""
+    tokens = np.asarray(tokens, np.int32).reshape(-1)
+    n_max = max(0, (len(tokens) - 1) // block_size)
+    out: List[bytes] = []
+    parent = _ROOT
+    for i in range(n_max):
+        parent = block_digest(
+            parent, tokens[i * block_size:(i + 1) * block_size])
+        out.append(parent)
+    return out
+
+
 class PrefixCache:
     """Full-block prefix trie over a ``BlockedAllocator``.
 
@@ -83,9 +110,7 @@ class PrefixCache:
 
     # -- hashing -------------------------------------------------------
     def _digest(self, parent: bytes, block_tokens: np.ndarray) -> bytes:
-        h = hashlib.blake2b(parent, digest_size=16)
-        h.update(np.ascontiguousarray(block_tokens, np.int32).tobytes())
-        return h.digest()
+        return block_digest(parent, block_tokens)
 
     # -- introspection -------------------------------------------------
     @property

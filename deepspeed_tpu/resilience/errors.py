@@ -41,7 +41,43 @@ class TrainingDivergenceError(ResilienceError):
     non-finite/spiking."""
 
 
-class ServingOverloadError(ResilienceError):
+class ServingError(ResilienceError):
+    """Base for typed serving-request errors raised by the serving
+    surfaces (front-end, fleet router). A router juggling requests
+    across replicas must key recovery decisions off the error TYPE —
+    a ``KeyError`` from a bookkeeping dict cannot tell "this uid was
+    never placed here" apart from a programming bug."""
+
+
+class UnknownRequestError(ServingError):
+    """The uid was never placed on this serving surface (or has been
+    retired past the retention bound). For the fleet requeue path this
+    means "never placed": the request must be (re)submitted from
+    scratch, nothing to clean up."""
+
+    def __init__(self, uid, surface: str = "front-end"):
+        self.uid = uid
+        self.surface = surface
+        super().__init__(
+            f"unknown request uid {uid}: never placed on this "
+            f"{surface} (or already retired)")
+
+
+class TerminalRequestError(ServingError):
+    """The request is already in a terminal state (FINISHED /
+    CANCELLED / SHED), so the operation (cancel, requeue) has nothing
+    live to act on. Carries the state so a router can distinguish
+    "finished while routing" (deliver the buffered tokens) from a
+    cancel/shed race."""
+
+    def __init__(self, uid, state: str):
+        self.uid = uid
+        self.state = state
+        super().__init__(
+            f"request {uid} is already terminal ({state})")
+
+
+class ServingOverloadError(ServingError):
     """The serving engine cannot make progress or accept work within
     its configured bounds: the request queue is past
     ``max_queue_depth``, KV utilization crossed the admission

@@ -48,6 +48,14 @@ FAULT_SITES = {
         "(inference/v2/serving/frontend.py _join) — an injected fault "
         "here drills the shed-without-leaking path (the handler must "
         "flush the just-created sequence)",
+    "fleet.dispatch":
+        "fleet serving replica dispatch: one consume() per replica "
+        "SLOT per router step — ordinal = step * n_replicas + slot, "
+        "the pg_sim placement rule, so a spec targets any replica at "
+        "any step deterministically and placement survives kills "
+        "(inference/v2/serving/fleet/replica.py poll_fault; kinds "
+        "kill / hang / slow map to replica death / silence / "
+        "beats-without-progress)",
     # ---- pg_sim fault domain (tools/pg_sim/pg.py) ----
     # one consume() per (step, worker slot) in rank order — ordinal
     # = step * world_size + rank, so a spec can target any worker at

@@ -295,6 +295,40 @@ class ServingPrefixConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class ServingFleetConfig(DeepSpeedConfigModel):
+    """Fleet router knobs (inference/v2/serving/fleet/), config section
+    ``serving.fleet``: N data-parallel replicas behind one router with
+    prefix-affinity load balancing and elastic replica recovery. See
+    README "Fleet serving" for full semantics."""
+    # replicas the router builds from its engine factory
+    n_replicas: int = 2
+    # scoring policy: score = affinity_weight * (matched prefix blocks
+    # / prompt blocks) - queue_weight * (outstanding / capacity)
+    #                - kv_weight * kv_utilization
+    # "affinity" (default) | "round_robin" (the A/B baseline)
+    policy: str = "affinity"
+    affinity_weight: float = 4.0
+    queue_weight: float = 1.0
+    kv_weight: float = 1.0
+    # router-side block-hash -> replica map bound (LRU entries; the
+    # same chained blake2b keys as each replica's prefix trie)
+    affinity_map_entries: int = 4096
+    # failure detectors (resilience.watchdog.HeartbeatMonitor ledger,
+    # deadlines in router steps — logical time, so drills replay)
+    heartbeat_timeout_steps: int = 2
+    progress_timeout_steps: int = 4
+    # rebuild a failed replica and rejoin it to the scoring pool (off:
+    # the fleet shrinks and survivors absorb the traffic)
+    respawn: bool = True
+    # evacuations one request survives before the router gives up on
+    # it (bounds cascading-death loops)
+    max_requeues_per_request: int = 3
+    # alert when (max - min) outstanding work across alive replicas
+    # exceeds this spread; 0 = off
+    imbalance_alert_spread: int = 0
+
+
+@dataclasses.dataclass
 class ServingConfig(DeepSpeedConfigModel):
     """Serving front-end knobs (inference/v2/serving/), config section
     ``serving``. See README "Serving front-end" for full semantics."""
@@ -329,6 +363,7 @@ class ServingConfig(DeepSpeedConfigModel):
     # the oldest are dropped — the front-end's own lifetime bound
     max_retained_requests: int = 1024
     prefix: ServingPrefixConfig = submodel(ServingPrefixConfig)
+    fleet: ServingFleetConfig = submodel(ServingFleetConfig)
 
 
 @dataclasses.dataclass

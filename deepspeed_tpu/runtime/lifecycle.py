@@ -145,6 +145,12 @@ class BoundedCache:
     def keys(self):
         return self._data.keys()
 
+    def items(self):
+        """Stats-neutral iteration: no LRU refresh, no hit/miss count
+        (``get`` in a sweep would promote every entry to MRU and
+        inflate the hit stats)."""
+        return self._data.items()
+
     def pop(self, key, default=None):
         return self._data.pop(key, default)
 

@@ -84,6 +84,18 @@ SPAN_SITES = {
     "frontend.stream":
         "one collected step's token fan-out to the per-request "
         "streams/callbacks (args: n_rows)",
+    # ---- fleet router (inference/v2/serving/fleet/) ----
+    "fleet.route":
+        "one request's fleet placement (args: uid, affinity = matched "
+        "prefix blocks): scoring pass over the alive replicas + the "
+        "chosen replica's submit",
+    "fleet.requeue":
+        "evacuating a failed replica's in-flight requests onto the "
+        "survivors (args: slot, n) — the serving analog of the "
+        "supervisor's rollback rung",
+    "fleet.respawn":
+        "rebuilding a failed replica and rejoining it to the scoring "
+        "pool (args: slot, generation)",
     # ---- elastic supervisor (elasticity/supervisor.py) ----
     "supervisor.gate":
         "the pre-dispatch health gate (one per supervised step)",

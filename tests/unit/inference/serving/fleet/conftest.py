@@ -1,0 +1,18 @@
+"""Shared fleet fixtures: ONE tiny-llama param init for the whole
+package (the router and chaos modules both build engines from it;
+a per-module init would pay the ~2s twice against the tier-1 wall)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="package")
+def params_cfg():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))
+    return params, cfg

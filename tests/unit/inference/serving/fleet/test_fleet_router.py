@@ -1,0 +1,623 @@
+"""Fleet serving: the data-parallel replica router — placement
+scoring, prefix-affinity vs round-robin, per-uid stickiness, typed
+request errors, requeue-with-bitwise-replay on replica death, fleet
+telemetry, and the ISSUE acceptance e2e."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.sampling import SamplingParams
+from deepspeed_tpu.inference.v2 import (FleetRouter, InferenceEngineV2,
+                                        RaggedInferenceEngineConfig,
+                                        RequestState, RoundRobinPolicy,
+                                        ScoringPolicy, ServingFrontend)
+from deepspeed_tpu.inference.v2.metrics import ServingMetrics
+from deepspeed_tpu.inference.v2.serving.prefix import chain_digests
+from deepspeed_tpu.resilience.errors import (ServingError,
+                                             ServingOverloadError,
+                                             TerminalRequestError,
+                                             UnknownRequestError)
+from deepspeed_tpu.resilience.fault_injector import fault_injector
+
+# 3 shared system prompts, 2 full 8-token blocks each (the config-7
+# million-user common-prompt-head shape)
+SYS = [list(range(1, 18)), list(range(101, 118)),
+       list(range(201, 218))]
+
+
+def _factory(params_cfg, **kw):
+    params, cfg = params_cfg
+    eng_kw = dict(token_budget=32, max_ragged_sequence_count=4,
+                  n_kv_blocks=48, kv_block_size=8,
+                  max_blocks_per_seq=8, kv_dtype="float32")
+    eng_kw.update(kw)
+
+    def engine_factory(slot):
+        return InferenceEngineV2(params, cfg,
+                                 RaggedInferenceEngineConfig(**eng_kw))
+    return engine_factory
+
+
+def _router(params_cfg, n=2, serving=None, engine_kw=None, **kw):
+    cfg = {"fleet": {"n_replicas": n}}
+    for k, v in (serving or {}).items():
+        if k == "fleet":
+            cfg["fleet"].update(v)
+        else:
+            cfg[k] = v
+    return FleetRouter(_factory(params_cfg, **(engine_kw or {})),
+                       cfg, **kw)
+
+
+def _assert_replicas_clean(router):
+    """Block conservation on every alive replica: no tracked
+    sequences, every non-cached block back on the free list."""
+    for slot in router.pooled_replicas:
+        eng = router._replicas[slot].engine
+        assert not eng._state_manager.tracked_sequences, slot
+        cached = (eng.prefix_cache.cached_blocks
+                  if eng.prefix_cache else 0)
+        assert eng.free_blocks == eng._config.n_kv_blocks - cached, slot
+
+
+def _single_frontend_refs(params_cfg, requests, max_new_tokens,
+                          serving=None):
+    """Undisturbed single-frontend control runs, one per request."""
+    eng = _factory(params_cfg)(0)
+    refs = {}
+    for uid, prompt in requests.items():
+        fe = ServingFrontend(eng, serving)
+        r = fe.submit(prompt, uid=uid, max_new_tokens=max_new_tokens)
+        fe.drain()
+        assert r.state == RequestState.FINISHED
+        refs[uid] = list(r.tokens)
+    return refs
+
+
+class TestHostUnits:
+    """No-engine units: digest schema, policies, quick_stats."""
+
+    def test_chain_digests_schema(self):
+        toks = np.arange(1, 18, dtype=np.int32)        # 17 tokens
+        d = chain_digests(toks, 8)
+        # cap at len-1 exactly like PrefixCache.match: 2 full blocks
+        assert len(d) == 2
+        assert chain_digests(toks[:17], 8) == d
+        assert len(chain_digests(toks[:16], 8)) == 1   # 16 -> cap 15
+        # chained: a block-0 change reshapes EVERY digest downstream
+        mut = toks.copy()
+        mut[0] += 1
+        d2 = chain_digests(mut, 8)
+        assert d2[0] != d[0] and d2[1] != d[1]
+        # a block-1 change leaves block 0's digest alone
+        mut = toks.copy()
+        mut[9] += 1
+        d3 = chain_digests(mut, 8)
+        assert d3[0] == d[0] and d3[1] != d[1]
+
+    def test_scoring_policy_math(self):
+        p = ScoringPolicy(affinity_weight=4.0, queue_weight=1.0,
+                          kv_weight=2.0)
+        idle = {"outstanding": 0, "capacity": 4, "kv_util": 0.0}
+        busy = {"outstanding": 4, "capacity": 4, "kv_util": 0.5}
+        assert p.score(idle, 0.0) > p.score(busy, 0.0)
+        # full affinity outweighs a loaded replica at these weights
+        assert p.score(busy, 1.0) > p.score(idle, 0.0)
+
+    def test_round_robin_rotation(self):
+        p = RoundRobinPolicy()
+        assert p.rank([0, 1, 2]) == [0, 1, 2]
+        assert p.rank([0, 1, 2]) == [1, 2, 0]
+        assert p.rank([0, 1, 2]) == [2, 0, 1]
+
+    def test_quick_stats_no_allocation_contract(self):
+        m = ServingMetrics("test", n_kv_blocks=10)
+        q0 = m.quick_stats()
+        m.record_step(dispatch_s=0.0, sync_wait_s=0.0, wall_s=0.01,
+                      new_tokens=3, prompt_tokens=0, n_seqs=3,
+                      decode_only=True, recompiled=False,
+                      blocking_sync=False, queue_depth=2, kv_free=6)
+        # the SAME dict instance, updated in place
+        assert m.quick_stats() is q0
+        assert q0["steps"] == 1.0 and q0["tokens_emitted"] == 3.0
+        assert q0["queue_depth"] == 2.0
+        assert q0["kv_util"] == pytest.approx(0.4)
+        m.record_step(dispatch_s=0.0, sync_wait_s=0.0, wall_s=0.01,
+                      new_tokens=1, prompt_tokens=4, n_seqs=2,
+                      decode_only=False, recompiled=True,
+                      blocking_sync=True, queue_depth=0, kv_free=10)
+        assert q0["steps"] == 2.0 and q0["recompiles"] == 1.0
+        assert q0["blocking_syncs"] == 1.0 and q0["kv_util"] == 0.0
+
+    def test_affinity_map_keys_match_the_trie(self, params_cfg):
+        """Cross-module parity: the keys the router hashes a prompt to
+        are exactly the keys a replica's trie registers it under —
+        affinity predicting trie hits depends on it."""
+        eng = _factory(params_cfg)(0)
+        fe = ServingFrontend(eng)
+        prompt = SYS[0] + [31]
+        fe.submit(prompt, max_new_tokens=2)
+        fe.drain()
+        digests = chain_digests(np.asarray(prompt, np.int32), 8)
+        assert digests
+        trie_keys = set(eng.prefix_cache._entries.keys())
+        assert set(digests) <= trie_keys
+
+
+class TestRouterBasics:
+
+    def test_streams_match_single_frontend_and_stick(self, params_cfg):
+        reqs_in = {11: SYS[0] + [31], 12: SYS[1] + [41],
+                   13: SYS[0] + [51]}
+        refs = _single_frontend_refs(params_cfg, reqs_in, 4)
+        router = _router(params_cfg, n=2)
+        handles = {uid: router.submit(p, uid=uid, max_new_tokens=4)
+                   for uid, p in reqs_in.items()}
+        # sticky: the placement map answers for every live uid
+        for uid in handles:
+            assert router._entries[uid].slot in (0, 1)
+        router.drain()
+        for uid, r in handles.items():
+            assert r.state == RequestState.FINISHED
+            assert r.tokens == refs[uid], uid
+            assert router.result(uid) == refs[uid]
+        rep = router.get_fleet_report()
+        assert rep["router"]["submitted"] == 3
+        assert rep["router"]["finished"] == 3
+        assert rep["router"]["replay_mismatches"] == 0
+        _assert_replicas_clean(router)
+
+    def test_stream_iterator_pumps_the_fleet(self, params_cfg):
+        router = _router(params_cfg, n=2)
+        refs = _single_frontend_refs(params_cfg, {21: SYS[2] + [61]}, 5)
+        r = router.submit(SYS[2] + [61], uid=21, max_new_tokens=5)
+        toks = list(router.stream(21))
+        assert toks == refs[21] and r.state == RequestState.FINISHED
+
+    def test_typed_request_errors(self, params_cfg):
+        router = _router(params_cfg, n=2)
+        with pytest.raises(UnknownRequestError) as ei:
+            router.stream(999)
+        assert ei.value.uid == 999 and "fleet router" in str(ei.value)
+        with pytest.raises(UnknownRequestError):
+            router.cancel(999)
+        with pytest.raises(UnknownRequestError):
+            router.result(999)
+        r = router.submit(SYS[0] + [71], max_new_tokens=3)
+        router.drain()
+        assert r.state == RequestState.FINISHED
+        with pytest.raises(TerminalRequestError) as ei:
+            router.cancel(r.uid)
+        assert ei.value.state == "FINISHED"
+        assert isinstance(ei.value, ServingError)
+        # terminal-but-retained: the stream still yields the buffer
+        assert list(router.stream(r.uid)) == r.tokens
+
+    def test_cancel_mid_flight_and_on_token(self, params_cfg):
+        router = _router(params_cfg, n=2)
+        seen = []
+        r1 = router.submit(SYS[0] + [81], max_new_tokens=8,
+                           on_token=seen.append)
+        r2 = router.submit(SYS[1] + [82], max_new_tokens=3)
+        for _ in range(4):
+            router.step()
+        assert not r1.done
+        assert router.cancel(r1.uid) is True
+        assert r1.state == RequestState.CANCELLED
+        router.drain()
+        assert r2.state == RequestState.FINISHED
+        assert seen == r1.tokens        # ordered delivery, then stop
+        rep = router.get_fleet_report()
+        assert rep["router"]["cancelled"] == 1
+        _assert_replicas_clean(router)
+
+    def test_fleet_saturated_raises_typed_with_fleet_view(
+            self, params_cfg):
+        router = _router(params_cfg, n=2,
+                         serving={"max_queue_depth": 1})
+        router.submit(SYS[0] + [83], max_new_tokens=2)
+        router.submit(SYS[1] + [84], max_new_tokens=2)
+        with pytest.raises(ServingOverloadError) as ei:
+            router.submit(SYS[2] + [85], max_new_tokens=2)
+        view = ei.value.fleet_view
+        assert set(view) == {0, 1}
+        assert all(v["outstanding"] >= 1 for v in view.values())
+        # never accepted => not counted (same unwind as a replica-side
+        # validation error): the router totals stay conserved
+        assert router.submitted == 2
+        router.drain()
+        rep = router.get_fleet_report()["router"]
+        assert rep["submitted"] == rep["finished"] == 2
+        # shed policy: the refused request comes back SHED instead
+        router2 = _router(params_cfg, n=2,
+                          serving={"max_queue_depth": 1,
+                                   "on_overload": "shed"})
+        router2.submit(SYS[0] + [86], max_new_tokens=2)
+        router2.submit(SYS[1] + [87], max_new_tokens=2)
+        shed = router2.submit(SYS[2] + [88], max_new_tokens=2)
+        assert shed.state == RequestState.SHED
+        router2.drain()
+        assert router2.get_fleet_report()["router"]["shed"] == 1
+
+    def test_per_request_seed_requires_deployment_pin(self, params_cfg):
+        """(The matching-pin ACCEPT path decodes in the slow-tier
+        sampled replay test — serving.seed 11 + per-request seed 11.)"""
+        router = _router(params_cfg, n=2)
+        with pytest.raises(ValueError, match="serving.seed"):
+            router.submit(SYS[0] + [89],
+                          sampling=SamplingParams(temperature=1.2,
+                                                  seed=7))
+        assert router.submitted == 0 and 1 not in router._entries
+
+    def test_affinity_routes_shared_prefixes_together(self, params_cfg):
+        """Same-prefix traffic lands on the replica whose trie holds
+        the head; the router's map keys agree with the trie's."""
+        router = _router(params_cfg, n=2)
+        first = router.submit(SYS[0] + [90], max_new_tokens=2)
+        home = router._entries[first.uid].slot
+        router.drain()
+        followers = [router.submit(SYS[0] + [91 + i], max_new_tokens=2)
+                     for i in range(3)]
+        placed = {router._entries[r.uid].slot for r in followers}
+        assert placed == {home}
+        router.drain()
+        rep = router.get_fleet_report()
+        assert rep["router"]["affinity_routed"] >= 3
+        assert rep["prefix"]["hits"] >= 3
+
+    def test_telemetry_hub_fleet_namespace_and_alerts(self, params_cfg,
+                                                      tmp_path):
+        from deepspeed_tpu.telemetry.hub import JsonlSink, TelemetryHub
+        sink = JsonlSink(str(tmp_path / "fleet.jsonl"))
+        hub = TelemetryHub(sink=sink)
+        router = _router(params_cfg, n=2)
+        router.attach_telemetry(hub)
+        r = router.submit(SYS[0] + [95], max_new_tokens=6)
+        victim = router._entries[r.uid].slot
+        for _ in range(3):
+            router.step()
+        fault_injector.configure(router.spec_for(victim, 0, "kill"))
+        try:
+            router.drain()
+        finally:
+            fault_injector.reset()
+        assert r.state == RequestState.FINISHED
+        # typed alerts reached the bounded log AND the hub
+        kinds = {a.kind for a in router.alerts}
+        assert "replica_death" in kinds and "fleet_rebalance" in kinds
+        assert hub.alert_counts().get("replica_death", 0) >= 1
+        # per-replica scalars + router totals flow through the flat
+        # stream under the fleet namespace
+        flat = hub.sample(1)
+        assert any(k.startswith("fleet/replicas/r0/") for k in flat)
+        assert "fleet/router/submitted" in flat
+        assert "fleet/prefix/hit_rate" in flat
+        recs = sink.read_records()
+        assert any(rec.get("kind") == "alert" for rec in recs)
+
+
+class TestElasticRecovery:
+
+    def test_kill_requeues_and_respawns(self, params_cfg):
+        refs = _single_frontend_refs(params_cfg, {31: SYS[0] + [96]}, 6)
+        router = _router(params_cfg, n=2)
+        r = router.submit(SYS[0] + [96], uid=31, max_new_tokens=6)
+        victim = router._entries[31].slot
+        for _ in range(3):
+            router.step()
+        assert r.state == RequestState.DECODE      # mid-decode
+        fault_injector.configure(router.spec_for(victim, 0, "kill"))
+        try:
+            router.drain()
+        finally:
+            fault_injector.reset()
+        assert r.state == RequestState.FINISHED
+        assert r.tokens == refs[31]                # gap/dup-free replay
+        rep = router.get_fleet_report()
+        rec = rep["recovery"]
+        assert rec["deaths"] == 1 and rec["respawns"] == 1
+        assert rec["requeued"] == 1
+        assert rec["events"][0]["requeued_uids"] == [31] or \
+            rec["events"][0]["requeued_uids"] == (31,)
+        assert rec["mttr_s"]["last"] > 0
+        assert rep["router"]["replay_mismatches"] == 0
+        # the respawned replica rejoined the pool, generation bumped
+        assert sorted(router.pooled_replicas) == [0, 1]
+        assert router._replicas[victim].generation == 2
+        _assert_replicas_clean(router)
+
+    def test_hang_detected_by_heartbeat_deadline(self, params_cfg):
+        router = _router(params_cfg, n=2,
+                         serving={"fleet": {"heartbeat_timeout_steps": 1,
+                                            "progress_timeout_steps": 2}})
+        r = router.submit(SYS[1] + [97], max_new_tokens=6)
+        victim = router._entries[r.uid].slot
+        for _ in range(2):
+            router.step()
+        # silent for long enough that the ledger's deadline fires
+        fault_injector.configure(
+            router.spec_for(victim, 0, "hang", duration=50))
+        try:
+            router.drain()
+        finally:
+            fault_injector.reset()
+        assert r.state == RequestState.FINISHED
+        rec = router.get_fleet_report()["recovery"]
+        assert rec["deaths"] == 1
+        assert rec["events"][0]["mode"] == "hang"
+
+    @pytest.mark.slow
+    def test_slow_detected_by_progress_deadline(self, params_cfg):
+        """Slow tier (tier-1 diet): the hang test above drives the
+        same ledger sweep; the chaos sweep draws slow-mode drills."""
+        router = _router(params_cfg, n=2,
+                         serving={"fleet": {"heartbeat_timeout_steps": 3,
+                                            "progress_timeout_steps": 1}})
+        r = router.submit(SYS[2] + [98], max_new_tokens=6)
+        victim = router._entries[r.uid].slot
+        for _ in range(2):
+            router.step()
+        fault_injector.configure(
+            router.spec_for(victim, 0, "slow", duration=50))
+        try:
+            router.drain()
+        finally:
+            fault_injector.reset()
+        assert r.state == RequestState.FINISHED
+        rec = router.get_fleet_report()["recovery"]
+        assert rec["deaths"] == 1
+        assert rec["events"][0]["mode"] == "slow"
+
+    def test_replica_retired_before_sync_still_closes_handle(
+            self, params_cfg):
+        """max_retained_requests=1 + two requests finishing in the
+        same replica step: the frontend retires the first before the
+        router's sync sees it. The vanished uid must still close its
+        router handle (FINISHED from the buffered tokens) — skipping
+        it would leave a live handle nothing ever finishes and
+        livelock serve()."""
+        router = _router(params_cfg, n=1,
+                         serving={"max_retained_requests": 1})
+        # short prompts co-prefill inside one 32-token budget, same
+        # length + budget => they finish in the same collect pass and
+        # the frontend's retention bound evicts the first immediately
+        a = router.submit(list(range(1, 9)), max_new_tokens=3)
+        b = router.submit(list(range(11, 19)), max_new_tokens=3)
+        steps = router.drain(max_steps=300)
+        assert steps < 300                        # no livelock
+        # the scenario really fired: the first-finished uid is GONE
+        # from the replica's table (evicted by the retention bound)
+        fe = router._replicas[0].frontend
+        assert fe.get_request(a.uid) is None
+        for r in (a, b):
+            assert r.state == RequestState.FINISHED
+            assert len(r.tokens) == 3
+        assert router.get_fleet_report()["router"]["finished"] == 2
+
+    def test_requeue_does_not_restart_the_deadline_clock(
+            self, params_cfg):
+        """A client's deadline_ms is end-to-end, not per-attempt: the
+        survivor's gate sees only the remaining budget, so a request
+        whose deadline elapsed while replica A held it is SHED on
+        requeue, not served late with a fresh clock. Deterministic via
+        the injected clock (1µs per observation + an explicit 10ms
+        jump while A holds the request)."""
+        t = {"now": 0.0}
+
+        def clock():
+            t["now"] += 1e-6
+            return t["now"]
+
+        router = _router(params_cfg, n=2, clock=clock)
+        r = router.submit(SYS[0] + [66], max_new_tokens=64,
+                          deadline_ms=1.0)
+        victim = router._entries[r.uid].slot
+        for _ in range(3):
+            router.step()
+        assert not r.done               # joined well under the 1ms
+        t["now"] += 0.010               # 10ms pass mid-decode on A
+        fault_injector.configure(router.spec_for(victim, 0, "kill"))
+        try:
+            router.drain()
+        finally:
+            fault_injector.reset()
+        # the requeue carried deadline_ms=0 (budget long blown), so
+        # the survivor's gate shed it instead of serving it late
+        assert r.done and r.state != RequestState.FINISHED
+        assert r.shed_reason            # the gate's reason propagated
+        rep = router.get_fleet_report()["router"]
+        assert rep["shed"] == 1 and rep["replay_mismatches"] == 0
+        _assert_replicas_clean(router)
+
+    def test_all_replicas_dead_abandons_instead_of_livelock(
+            self, params_cfg):
+        """respawn=False and EVERY replica killed: the backlog cannot
+        ever place again — the router abandons it typed (CANCELLED
+        with the reason) and drain() terminates instead of spinning
+        on a non-idle backlog forever."""
+        router = _router(params_cfg, n=2,
+                         serving={"fleet": {"respawn": False}})
+        r1 = router.submit(SYS[0] + [61], max_new_tokens=8)
+        r2 = router.submit(SYS[1] + [62], max_new_tokens=8)
+        for _ in range(2):
+            router.step()
+        fault_injector.configure(",".join([
+            router.spec_for(0, 0, "kill"),
+            router.spec_for(1, 1, "kill")]))
+        try:
+            steps = router.drain(max_steps=200)
+        finally:
+            fault_injector.reset()
+        assert steps < 200                       # terminated, no spin
+        assert router.idle
+        assert router.pooled_replicas == []
+        for r in (r1, r2):
+            assert r.state == RequestState.CANCELLED
+            assert "no replicas left" in r.shed_reason
+        rep = router.get_fleet_report()
+        assert rep["router"]["abandoned"] == 2
+        assert rep["recovery"]["deaths"] == 2
+
+    def test_respawn_off_shrinks_the_pool(self, params_cfg):
+        router = _router(params_cfg, n=2,
+                         serving={"fleet": {"respawn": False}})
+        r = router.submit(SYS[0] + [99], max_new_tokens=4)
+        victim = router._entries[r.uid].slot
+        router.step()
+        fault_injector.configure(router.spec_for(victim, 0, "kill"))
+        try:
+            router.drain()
+        finally:
+            fault_injector.reset()
+        assert r.state == RequestState.FINISHED    # survivor absorbed
+        assert router.pooled_replicas == [1 - victim]
+        rec = router.get_fleet_report()["recovery"]
+        assert rec["deaths"] == 1 and rec["respawns"] == 0
+
+    @pytest.mark.slow
+    def test_sampled_requeue_replays_bitwise(self, params_cfg):
+        """The replay contract under sampling: keys are
+        fold_in(fold_in(seed, uid), position), so a requeued SAMPLED
+        request regenerates the identical stream on the survivor.
+        Slow tier (the sampled executable is a second compile); the
+        greedy replay + acceptance e2e keep the contract in tier-1."""
+        sp = SamplingParams(temperature=1.3, top_k=16, seed=11)
+        serving = {"seed": 11, "executable": "sampled"}
+        eng = _factory(params_cfg)(0)
+        fe = ServingFrontend(eng, serving)
+        ref = fe.submit(SYS[1] + [41], uid=51, max_new_tokens=6,
+                        sampling=sp)
+        fe.drain()
+        router = _router(params_cfg, n=2, serving=serving)
+        r = router.submit(SYS[1] + [41], uid=51, max_new_tokens=6,
+                          sampling=sp)
+        victim = router._entries[51].slot
+        for _ in range(3):
+            router.step()
+        assert not r.done
+        fault_injector.configure(router.spec_for(victim, 0, "kill"))
+        try:
+            router.drain()
+        finally:
+            fault_injector.reset()
+        assert r.state == RequestState.FINISHED
+        assert r.tokens == ref.tokens
+        assert router.replay_mismatches == 0
+
+
+class TestAcceptanceE2E:
+
+    def test_fleet_kill_mid_decode_acceptance(self, params_cfg):
+        """The ISSUE acceptance e2e: N=2 replicas, staggered
+        shared-prefix requests through router.serve(); one replica
+        killed mid-decode via the fleet.dispatch fault site; every
+        accepted request finishes with its FULL stream bitwise equal
+        to an undisturbed single-frontend run (gap-free,
+        duplicate-free); per-replica recompiles <= 1 and
+        steady_blocking_syncs == 0."""
+        N = 8
+        rng = np.random.default_rng(5)
+        mix = [int(rng.integers(0, 3)) for _ in range(N)]
+        reqs_in = {900 + k: SYS[mix[k]] + [60 + k] for k in range(N)}
+        refs = _single_frontend_refs(params_cfg, reqs_in, 5)
+
+        router = _router(params_cfg, n=2)
+        handles = {}
+        armed = {}
+
+        def poll(r, step):
+            if step % 2 == 0 and len(handles) < N:
+                k = len(handles)
+                uid = 900 + k
+                handles[uid] = r.submit(reqs_in[uid], uid=uid,
+                                        max_new_tokens=5)
+            if step == 7 and not armed:
+                # kill the replica currently decoding the most work —
+                # mid-decode by construction (requests are in flight)
+                live = [e for e in r._entries.values()
+                        if not e.req.done and e.slot is not None]
+                assert any(e.req.state == RequestState.DECODE
+                           for e in live)
+                slots = [e.slot for e in live]
+                victim = max(set(slots), key=slots.count)
+                fault_injector.configure(r.spec_for(victim, 0, "kill"))
+                armed["victim"] = victim
+            return len(handles) < N
+
+        try:
+            router.serve(poll=poll)
+        finally:
+            fault_injector.reset()
+        assert len(handles) == N and "victim" in armed
+        rep = router.get_fleet_report()
+        # every accepted request finished, streams bitwise == the
+        # undisturbed single-frontend runs — requeued ones included
+        for uid, r in handles.items():
+            assert r.state == RequestState.FINISHED, uid
+            assert r.tokens == refs[uid], uid
+        rec = rep["recovery"]
+        assert rec["deaths"] == 1 and rec["requeued"] >= 1
+        assert rep["router"]["replay_mismatches"] == 0
+        # the PR 9 contract holds under routing and requeue: one
+        # compile per (fresh or respawned) executable, then zero —
+        # and zero blocking host syncs in every steady decode window
+        for slot in router.pooled_replicas:
+            frep = router._replicas[slot].frontend.get_serving_report()
+            assert frep["recompiles"] <= 1, slot
+            assert frep["steady_blocking_syncs"] == 0, slot
+        _assert_replicas_clean(router)
+
+    def test_affinity_beats_round_robin_on_seeded_traffic(
+            self, params_cfg):
+        """Cross-replica prefix-affinity routing yields a STRICTLY
+        higher fleet prefix hit rate than round-robin on the same
+        seeded traffic."""
+        rng = np.random.default_rng(3)
+        mix = [int(rng.integers(0, 3)) for _ in range(9)]
+
+        def run(policy):
+            router = _router(params_cfg, n=2,
+                             serving={"fleet": {"policy": policy}})
+            reqs = []
+
+            def poll(r, step):
+                if len(reqs) < len(mix) and step % 2 == 0:
+                    k = len(reqs)
+                    reqs.append(r.submit(SYS[mix[k]] + [230 + k],
+                                         max_new_tokens=3))
+                return len(reqs) < len(mix)
+
+            router.serve(poll=poll)
+            assert all(r.state == RequestState.FINISHED for r in reqs)
+            return router.get_fleet_report()
+
+        aff = run("affinity")
+        rr = run("round_robin")
+        assert aff["prefix"]["hit_rate"] > rr["prefix"]["hit_rate"]
+        assert aff["router"]["affinity_routed"] > 0
+
+
+class TestPollingOverhead:
+
+    @pytest.mark.perf
+    def test_router_polling_under_one_percent_of_decode_step(
+            self, params_cfg):
+        """The quick_stats satellite: the router's per-replica
+        snapshot() poll must cost <1% of a steady decode step (the
+        overhead-smoke pattern the telemetry suite uses)."""
+        import time
+        router = _router(params_cfg, n=2)
+        r = router.submit(SYS[0] + [77], max_new_tokens=12)
+        router.drain()
+        assert r.state == RequestState.FINISHED
+        rep = router._replicas[0].frontend.get_serving_report()
+        step_ms = rep["step_ms"]["p50"]
+        assert step_ms > 0
+        replica = router._replicas[0]
+        n = 2000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            replica.snapshot()
+        per_poll_ms = (time.perf_counter() - t0) / n * 1e3
+        assert per_poll_ms < 0.01 * step_ms, \
+            f"snapshot() {per_poll_ms:.4f}ms vs step {step_ms:.3f}ms"

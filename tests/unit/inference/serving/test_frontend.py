@@ -14,8 +14,10 @@ from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
                                         RaggedInferenceEngineConfig,
                                         RequestState, ServingFrontend)
 from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-from deepspeed_tpu.resilience.errors import (InjectedFault,
-                                             ServingOverloadError)
+from deepspeed_tpu.resilience.errors import (InjectedFault, ServingError,
+                                             ServingOverloadError,
+                                             TerminalRequestError,
+                                             UnknownRequestError)
 from deepspeed_tpu.resilience.fault_injector import fault_injector
 
 SYS = list(range(1, 17))                 # 2 full 8-token shared blocks
@@ -163,14 +165,41 @@ class TestLifecycleAndStreaming:
         assert r2.state == RequestState.FINISHED
 
     def test_queued_cancel_and_unknown_uid(self, engine):
+        """The typed cancel/stream contract (fleet satellite): unknown
+        uids raise UnknownRequestError ("never placed"), terminal uids
+        raise TerminalRequestError carrying the state ("finished while
+        routing") — never a bare KeyError / silent False."""
         fe = ServingFrontend(engine)
         r = fe.submit(SYS, max_new_tokens=2)
         assert fe.cancel(r.uid) is True      # still QUEUED
         assert r.state == RequestState.CANCELLED
-        assert fe.cancel(r.uid) is False     # already terminal
-        assert fe.cancel(12345) is False
-        with pytest.raises(KeyError):
+        with pytest.raises(TerminalRequestError) as ei:
+            fe.cancel(r.uid)                 # already terminal
+        assert ei.value.uid == r.uid and ei.value.state == "CANCELLED"
+        assert isinstance(ei.value, ServingError)
+        with pytest.raises(UnknownRequestError) as ei:
+            fe.cancel(12345)
+        assert ei.value.uid == 12345
+        with pytest.raises(UnknownRequestError):
             fe.stream(12345)
+        with pytest.raises(UnknownRequestError):
+            fe.result(12345)
+        # a terminal-but-retained request still streams its buffer
+        assert list(fe.stream(r.uid)) == r.tokens
+        _clean(engine)
+
+    def test_cancel_finished_request_is_typed_terminal(self, engine):
+        """'finished while routing': a FINISHED request's cancel raises
+        TerminalRequestError with state FINISHED (distinguishable from
+        never-placed) and its tokens stay readable."""
+        fe = ServingFrontend(engine)
+        r = fe.submit(SYS + [71], max_new_tokens=3)
+        fe.drain()
+        assert r.state == RequestState.FINISHED
+        with pytest.raises(TerminalRequestError) as ei:
+            fe.cancel(r.uid)
+        assert ei.value.state == "FINISHED"
+        assert fe.result(r.uid) == r.tokens and len(r.tokens) == 3
         _clean(engine)
 
     def test_mixed_greedy_and_sampled_requests(self, engine):

@@ -102,3 +102,30 @@ def test_serving_section():
     assert d.admission_kv_util_threshold is None
     assert d.slo_shed is True and d.prefix.enabled is True
     assert d.on_overload == "raise"
+
+
+def test_serving_fleet_section():
+    cfg = DeepSpeedConfig({
+        "serving": {
+            "fleet": {
+                "n_replicas": 3,
+                "policy": "round_robin",
+                "affinity_weight": 2.5,
+                "heartbeat_timeout_steps": 1,
+                "respawn": False,
+                "imbalance_alert_spread": 8,
+            },
+        },
+    })
+    fc = cfg.serving_config.fleet
+    assert fc.n_replicas == 3
+    assert fc.policy == "round_robin"
+    assert fc.affinity_weight == 2.5
+    assert fc.heartbeat_timeout_steps == 1
+    assert fc.respawn is False
+    assert fc.imbalance_alert_spread == 8
+    # defaults: affinity policy, respawn on, bounded affinity map
+    d = DeepSpeedConfig({}).serving_config.fleet
+    assert d.n_replicas == 2 and d.policy == "affinity"
+    assert d.respawn is True and d.affinity_map_entries > 0
+    assert d.max_requeues_per_request >= 1

@@ -90,6 +90,25 @@ class TestCompare:
                              0.10, {}, set())
         assert miss == []
 
+    def test_8_fleet_joins_the_vanish_gate(self):
+        """ISSUE 11: 8_fleet is tracked exactly like 7_frontend —
+        present-in-old => implicitly required in new; an artifact
+        predating its introduction still compares clean."""
+        from bench_compare import TRACKED_CONFIGS
+        assert "8_fleet" in TRACKED_CONFIGS
+        pre = {"1": _row(1.0), "7_frontend": _row(1.2)}
+        post = {"1": _row(1.0), "7_frontend": _row(1.2),
+                "8_fleet": _row(0.9)}
+        # pre-introduction artifact (no 8_fleet row) on the OLD side:
+        # nothing required, the gate stays clean
+        _, reg, miss = compare(pre, post, 0.10, {}, set())
+        assert reg == [] and miss == []
+        # once the lineage carries it, dropping the row fails the gate
+        _, reg, miss = compare(post, pre, 0.10, {}, set())
+        assert miss == ["8_fleet"] and reg == []
+        _, reg, miss = compare(post, dict(post), 0.10, {}, set())
+        assert reg == [] and miss == []
+
     def test_floor_trips_after_lineage_clears_it(self):
         """Config 4's 0.8 floor: dormant while the lineage is still
         below the bar (r04->r05 era compares clean), armed once the

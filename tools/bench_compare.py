@@ -80,7 +80,7 @@ def parse_per_config(text):
 
 # configs that must not vanish from the lineage: present in the old
 # artifact -> required comparable in the new one (see module docstring)
-TRACKED_CONFIGS = ("7_frontend",)
+TRACKED_CONFIGS = ("7_frontend", "8_fleet")
 
 # absolute vs_baseline floors: once a config's LINEAGE has cleared
 # the bar (old side >= floor), no new run may fall back under it —
