@@ -160,19 +160,32 @@ def ragged_sample(logits, temperature, top_k, top_p, uids, positions,
 
 
 class SamplingParams:
-    """Per-request knobs for the v2 serving loop (the MII analog)."""
+    """Per-request knobs for the v2 serving loop (the MII analog).
+
+    ``speculation`` is the per-request draft length for speculative
+    decoding: None defers to the deployment's ``SpeculationConfig.k``,
+    0 opts this request out, and any positive value is clamped to the
+    deployment's k (the padded verify slot). It rides a traced
+    per-row array, so mixing/changing values never recompiles; it is
+    ignored entirely when the serving loop runs without speculation.
+    """
 
     def __init__(self, temperature: float = 0.0,
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 speculation: Optional[int] = None):
         if temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if top_k is not None and top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {top_k}")
         if top_p is not None and not 0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if speculation is not None and speculation < 0:
+            raise ValueError(
+                f"speculation must be >= 0, got {speculation}")
         self.temperature = temperature
         self.top_k = top_k
         self.top_p = top_p
         self.seed = seed
+        self.speculation = speculation

@@ -7,3 +7,5 @@ from .ragged_wrapper import RaggedBatchWrapper
 from .serving import (FleetRouter, FleetSupervisor, PrefixCache,
                       Replica, Request, RequestState, RoundRobinPolicy,
                       ScoringPolicy, ServingFrontend, TokenStream)
+from .spec import (Drafter, PromptLookupDrafter, SpeculationConfig,
+                   SpecSession, make_drafter)

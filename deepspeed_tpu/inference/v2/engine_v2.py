@@ -20,7 +20,7 @@ import numpy as np
 
 from ...utils.logging import logger
 from .model import (init_kv_pools, normalize_params, ragged_forward,
-                    ragged_forward_sampled)
+                    ragged_forward_sampled, ragged_forward_verify)
 from .ragged_manager import (DSStateManager, SchedulingError,
                              SchedulingResult)
 from .ragged_wrapper import RaggedBatchWrapper
@@ -187,9 +187,17 @@ class InferenceEngineV2:
             return ragged_forward_sampled(prep(tree), spec, pools,
                                           *args, **fwd_kw)
 
+        # draft-k-verify tail (put_verify): scores k drafted positions
+        # per decode row and runs the accept kernel on device
+        def fwd_verify(tree, pools, *args):
+            return ragged_forward_verify(prep(tree), spec, pools,
+                                         *args, **fwd_kw)
+
         self._jit_forward = jax.jit(fwd, donate_argnums=(1,))
         self._jit_forward_sampled = jax.jit(fwd_sampled,
                                             donate_argnums=(1,))
+        self._jit_forward_verify = jax.jit(fwd_verify,
+                                           donate_argnums=(1,))
         # serving-loop state: FCFS aging for block-starved prompts,
         # dispatch-signature set (the recompile counter — the jit cache
         # is keyed the same way: treedef + shapes, both fixed here;
@@ -490,10 +498,13 @@ class InferenceEngineV2:
             self._state_manager.get_sequence(uid).post_forward()
         return np.asarray(logits[:len(batch_uids)])
 
-    def _samp_arrays(self, batch_uids: List[int], rb, sampling):
+    def _samp_arrays(self, batch_uids: List[int], rb, sampling,
+                     pos: Optional[np.ndarray] = None):
         """Per-slot sampling arrays for the fused device sampler.
         ``sampling``: one SamplingParams for the whole batch, or a
-        per-uid dict (missing uids sample greedily)."""
+        per-uid dict (missing uids sample greedily). ``pos`` overrides
+        the position half of the PRNG key (``put_verify`` keys each
+        row on its FIRST emission's position, ``seq_lens - k``)."""
         from ..sampling import SamplingParams
         S = self._config.max_ragged_sequence_count
         temp = np.zeros((S,), np.float32)
@@ -513,8 +524,10 @@ class InferenceEngineV2:
         # the sampled token's absolute position is exactly seq_lens
         # (tokens 0..L-1 are cached after this step) — the second half
         # of the per-(uid, position) PRNG key
+        if pos is None:
+            pos = rb.seq_lens
         return {"temperature": temp, "top_k": topk, "top_p": topp,
-                "uid": uid_arr, "pos": rb.seq_lens.astype(np.uint32)}
+                "uid": uid_arr, "pos": pos.astype(np.uint32)}
 
     def put_sampled(self, batch_uids: Iterable[int],
                     batch_tokens: Iterable, *,
@@ -591,6 +604,126 @@ class InferenceEngineV2:
         for uid in batch_uids:
             self._state_manager.get_sequence(uid).post_forward()
         return tokens, committed, recompiled
+
+    def put_verify(self, batch_uids: Iterable[int],
+                   batch_tokens: Iterable, *, draft_lens: List[int],
+                   max_draft: int,
+                   src_slots: Optional[List[int]] = None,
+                   prev_packed=None, sampling=None, base_key=None,
+                   do_checks: bool = True):
+        """One draft-k-verify forward (``ragged_forward_verify``): each
+        decode row carries ``[t0, d_1 .. d_k]`` (its last token plus
+        ``draft_lens[i]`` drafted guesses, 0 <= k <= ``max_draft``) and
+        the fused accept kernel scores/accepts them on device.
+
+        Returns ``(packed, committed, recompiled)``; ``packed`` is the
+        [max_seqs, max_draft + 2] int32 DEVICE array — column 0 the
+        accepted count, columns 1.. the emitted tokens (consume columns
+        ``1 .. 2 + a``; no host sync here). ``prev_packed`` chains
+        verify steps device-to-device: a ``src_slots[i] >= 0`` row
+        (which must carry exactly one token and no drafts, like
+        ``put_sampled``'s device-fed rows) gathers
+        ``prev_packed[src, 1]`` — the previous step's emission 0.
+
+        ``max_draft`` pads every shape (the zero-recompile contract:
+        per-row k rides the traced ``draft_lens`` array, so mixed and
+        changing per-request draft lengths never recompile; only a
+        different ``max_draft`` is a new signature).
+        """
+        batch_uids = list(batch_uids)
+        batch_tokens = [np.asarray(t, np.int32).reshape(-1)
+                        for t in batch_tokens]
+        draft_lens = [int(k) for k in draft_lens]
+        K = int(max_draft)
+        if K < 1:
+            raise ValueError(f"max_draft must be >= 1, got {K}")
+        if len(draft_lens) != len(batch_uids):
+            raise ValueError("draft_lens must align with batch_uids")
+        for i, (toks, k) in enumerate(zip(batch_tokens, draft_lens)):
+            if not 0 <= k <= K:
+                raise ValueError(f"row {i}: draft_len {k} outside "
+                                 f"[0, max_draft={K}]")
+            if len(toks) <= k:
+                raise ValueError(
+                    f"row {i}: needs its last real token ahead of the "
+                    f"{k} draft(s), got {len(toks)} token(s)")
+        if do_checks:
+            res = self.can_schedule(batch_uids,
+                                    [len(t) for t in batch_tokens])
+            if res != SchedulingResult.Success:
+                raise SchedulingError(res)
+        if (src_slots is not None and prev_packed is None
+                and any(s >= 0 for s in src_slots)):
+            raise ValueError("src_slots marks device-fed rows but "
+                             "prev_packed is None")
+        rb, committed = self._stage_batch(batch_uids, batch_tokens,
+                                          do_checks)
+        ec = self._config
+        S = ec.max_ragged_sequence_count
+        token_src = np.full((ec.token_budget,), -1, np.int32)
+        verify_idx = np.zeros((S, K + 1), np.int32)
+        draft_toks = np.zeros((S, K), np.int32)
+        dlens = np.zeros((S,), np.int32)
+        cursor = 0
+        for i, toks in enumerate(batch_tokens):
+            n, k = len(toks), draft_lens[i]
+            if src_slots is not None and src_slots[i] >= 0:
+                if n != 1 or k != 0:
+                    raise ValueError(
+                        f"device-fed row {i} must carry exactly one "
+                        f"token and no drafts, got {n} token(s), "
+                        f"k={k}")
+                token_src[cursor] = src_slots[i]
+            # scoring positions: the row's last 1+k packed tokens;
+            # entries past k repeat the last position (don't-cares)
+            base = cursor + n - 1 - k
+            verify_idx[i] = base + np.minimum(np.arange(K + 1), k)
+            if k:
+                draft_toks[i, :k] = toks[-k:]
+            dlens[i] = k
+            cursor += n
+        # emission 0's absolute position: seq_lens - k (== seq_lens
+        # for k=0 rows — the plain sampled executable's key position)
+        pos0 = np.maximum(rb.seq_lens - dlens, 0).astype(np.uint32)
+        if prev_packed is None:
+            prev_packed = np.zeros((S, K + 2), np.int32)
+        samp = None
+        if sampling is not None:
+            samp = self._samp_arrays(batch_uids, rb, sampling, pos=pos0)
+            if base_key is None:
+                base_key = jax.random.PRNGKey(0)
+        else:
+            base_key = None
+
+        recompiled = self._note_dispatch(
+            f"verify{K}:" + ("greedy" if samp is None else "samp"))
+        packed, self.pools = self._jit_forward_verify(
+            self.tree, self.pools, rb.token_ids, token_src, prev_packed,
+            rb.token_seq, rb.token_pos, rb.token_qidx, rb.seq_lens,
+            rb.q_counts, rb.block_tables, verify_idx, draft_toks, dlens,
+            pos0, samp, base_key)
+
+        for uid in batch_uids:
+            self._state_manager.get_sequence(uid).post_forward()
+        return packed, committed, recompiled
+
+    def rollback_rejected(self, uid: int, n_tokens: int) -> None:
+        """Unwind ``uid``'s last ``n_tokens`` REJECTED draft tokens
+        after a verify step's acceptance is known: host accounting via
+        ``rollback_tokens`` (stale KV is masked by the shrunk
+        seq_lens) plus freeing any KV blocks the rejected tail alone
+        occupied — clamped so a partially-used block survives and the
+        shared-prefix boundary is never crossed."""
+        if n_tokens <= 0:
+            return
+        seq = self._state_manager.get_sequence(uid)
+        if seq is None:
+            return
+        bs = self._config.kv_block_size
+        new_seen = max(0, seq.seen_tokens - n_tokens)
+        keep = max(-(-new_seen // bs), seq.shared_prefix_blocks)
+        keep = min(keep, len(seq.blocks))
+        self._state_manager.rollback_tokens(uid, n_tokens, keep)
 
     def rollback_step(self, uid: int, n_tokens: int,
                       blocks_before: int) -> None:
@@ -722,7 +855,22 @@ class InferenceEngineV2:
         for uid, tok in active_decode.items():
             if budget <= 0 or slots <= 0:
                 break
-            need = self._blocks_needed(uid, 1)
+            # a decode value may be one token (the classic chain) or a
+            # [1+k] verify row ``[t0, drafts...]`` — drafts are best-
+            # effort, so budget/context pressure trims them (never t0)
+            arr = np.asarray(tok, np.int32).reshape(-1) \
+                if isinstance(tok, np.ndarray) \
+                else np.asarray([tok], np.int32)
+            if len(arr) > budget:
+                arr = arr[:budget]
+            seq = self._state_manager.get_sequence(uid)
+            if seq is not None and len(arr) > 1:
+                room = self._state_manager.max_context \
+                    - seq.seen_tokens - seq.in_flight_tokens
+                if len(arr) > room:
+                    arr = arr[:max(1, room)]
+            n = len(arr)
+            need = self._blocks_needed(uid, n)
             if need > blocks and self.prefix_cache is not None:
                 # pressure valve: evict cache-only prefix blocks
                 # (leaf-first LRU) before deferring live decode work
@@ -730,8 +878,8 @@ class InferenceEngineV2:
             if need > blocks:
                 continue  # deferred until blocks free up
             uids.append(uid)
-            toks.append(np.asarray([tok], np.int32))
-            budget -= 1
+            toks.append(arr)
+            budget -= n
             slots -= 1
             blocks -= need
         order = sorted(
@@ -760,7 +908,8 @@ class InferenceEngineV2:
                        eos_token_id: Optional[int] = None,
                        sampling=None,
                        mode: str = "lookahead",
-                       on_overload: str = "raise") -> Dict[int, List[int]]:
+                       on_overload: str = "raise",
+                       speculation=None) -> Dict[int, List[int]]:
         """Continuous-batching serving loop (the MII-side loop the
         reference leaves out of deepspeed; here for tests/benchmarks).
         Greedy by default; pass ``sampling=SamplingParams(...)`` (or a
@@ -786,13 +935,19 @@ class InferenceEngineV2:
         ``get_serving_report()["admission"]["shed_uids"]`` (shed
         prompts are absent from the returned dict and can be
         resubmitted verbatim).
+
+        ``speculation`` turns on draft-k-verify speculative decoding
+        for the lookahead loop: ``True`` for defaults, a dict or a
+        ``SpeculationConfig`` for knobs (see inference/v2/spec/).
+        Greedy streams stay bitwise identical to ``speculation=None``.
         """
         from .serving_loop import run_serving_loop
         return run_serving_loop(self, prompts,
                                 max_new_tokens=max_new_tokens,
                                 eos_token_id=eos_token_id,
                                 sampling=sampling, mode=mode,
-                                on_overload=on_overload)
+                                on_overload=on_overload,
+                                speculation=speculation)
 
     def get_serving_report(self) -> dict:
         """Metrics report of the most recent generate_batch run (see

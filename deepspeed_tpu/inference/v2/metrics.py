@@ -86,6 +86,17 @@ class ServingMetrics:
         self.requests_cancelled = 0
         self.requests_shed = 0
         self._request_latency_s: deque = deque(maxlen=window)
+        # speculative decoding (draft-k-verify) counters: always
+        # present in the report (zeros when speculation is off) so the
+        # serving-report schema is stable spec-on/off
+        self.spec_drafted_total = 0
+        self.spec_accepted_total = 0
+        self.spec_emitted_total = 0
+        self.spec_verify_steps = 0
+        self.spec_rows_total = 0
+        self.spec_throttled_uids = 0
+        self.spec_draft_faults = 0
+        self._spec_verify_wall_s: deque = deque(maxlen=window)
         # polling-cheap per-step snapshot (quick_stats): ONE dict,
         # updated in place by record_step — a fleet router polls every
         # replica every step, so this path must not build report()'s
@@ -104,8 +115,12 @@ class ServingMetrics:
                     wall_s: float, new_tokens: int, prompt_tokens: int,
                     n_seqs: int, decode_only: bool, recompiled: bool,
                     blocking_sync: bool, queue_depth: int,
-                    kv_free: int) -> None:
+                    kv_free: int, spec_rows: int = 0) -> None:
         self._n_steps += 1
+        if spec_rows > 0:
+            self.spec_verify_steps += 1
+            self.spec_rows_total += spec_rows
+            self._spec_verify_wall_s.append(dispatch_s)
         self._n_decode_steps += 1 if decode_only else 0
         self._tokens_total += new_tokens
         self._prompt_tokens_total += prompt_tokens
@@ -158,6 +173,21 @@ class ServingMetrics:
 
     def record_cancelled(self, n: int = 1) -> None:
         self.cancelled_steps += n
+
+    def record_speculation(self, *, drafted: int, accepted: int,
+                           emitted: int) -> None:
+        """One sequence's verify outcome: ``drafted`` tokens went up,
+        ``accepted`` matched, ``emitted`` actually reached the stream
+        (1 + accepted, minus any tail cut by EOS/length)."""
+        self.spec_drafted_total += drafted
+        self.spec_accepted_total += accepted
+        self.spec_emitted_total += emitted
+
+    def record_spec_throttle(self, n: int = 1) -> None:
+        self.spec_throttled_uids += n
+
+    def record_spec_draft_fault(self, n: int = 1) -> None:
+        self.spec_draft_faults += n
 
     def record_admission(self, requested: int, admitted: int,
                          shed_uids: List[int]) -> None:
@@ -245,6 +275,28 @@ class ServingMetrics:
             "steady_decode_tps": (steady_tokens / steady_wall
                                   if steady_wall > 0 else 0.0),
             "cancelled_speculative_steps": self.cancelled_steps,
+            "speculation": {
+                "drafted_tokens": self.spec_drafted_total,
+                "accepted_tokens": self.spec_accepted_total,
+                "rejected_tokens": (self.spec_drafted_total
+                                    - self.spec_accepted_total),
+                "emitted_tokens": self.spec_emitted_total,
+                "acceptance_rate": (
+                    self.spec_accepted_total / self.spec_drafted_total
+                    if self.spec_drafted_total else 0.0),
+                "verify_steps": self.spec_verify_steps,
+                "verify_rows": self.spec_rows_total,
+                "mean_accepted_len": (
+                    self.spec_accepted_total / self.spec_rows_total
+                    if self.spec_rows_total else 0.0),
+                "emitted_per_verify": (
+                    self.spec_emitted_total / self.spec_rows_total
+                    if self.spec_rows_total else 0.0),
+                "throttled_uids": self.spec_throttled_uids,
+                "draft_faults": self.spec_draft_faults,
+                "verify_dispatch_ms": _stats(self._spec_verify_wall_s,
+                                             1e3),
+            },
             "admission": {"requested": self.requested,
                           "admitted": self.admitted,
                           "shed": len(self.shed_uids),

@@ -592,6 +592,30 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
     heads against its local slice of the KV pool (the reference's
     per-rank sharded blocked_flash, v2/model_implementations/sharding/).
     """
+    x, new_pools = _ragged_trunk(
+        tree, spec, pools, token_ids, token_seq, token_pos, token_qidx,
+        seq_lens, q_counts, block_tables, block_size,
+        interpret=interpret, tp_axis=tp_axis, ep_axis=ep_axis,
+        attn_kwargs=attn_kwargs)
+    last = x[logits_idx]                            # [S, C]
+    logits = last @ tree["head"].T
+    if tree.get("head_bias") is not None:
+        logits = logits + tree["head_bias"]
+    return logits.astype(jnp.float32), new_pools
+
+
+def _ragged_trunk(tree, spec: RaggedSpec, pools, token_ids, token_seq,
+                  token_pos, token_qidx, seq_lens, q_counts,
+                  block_tables, block_size: int,
+                  interpret: bool = False,
+                  tp_axis: Optional[str] = None,
+                  ep_axis: Optional[str] = None,
+                  attn_kwargs: Optional[dict] = None):
+    """The shared transformer trunk of the ragged forwards: embedding
+    through final norm, KV pool writes included. Returns
+    (hidden [budget, C], new_pools) — the logits tail is the caller's
+    (``ragged_forward`` gathers one position per sequence,
+    ``ragged_forward_verify`` gathers k+1)."""
     S = block_tables.shape[0]
     bs = block_size
     nh, nkv, hd = spec.n_heads, spec.n_kv_heads, spec.head_dim
@@ -725,11 +749,7 @@ def ragged_forward(tree, spec: RaggedSpec, pools, token_ids, token_seq,
 
     x = _norm(x, tree["final_scale"], tree.get("final_bias"), spec.norm,
               spec.eps)
-    last = x[logits_idx]                            # [S, C]
-    logits = last @ tree["head"].T
-    if tree.get("head_bias") is not None:
-        logits = logits + tree["head_bias"]
-    return logits.astype(jnp.float32), new_pools
+    return x, new_pools
 
 
 def ragged_forward_sampled(tree, spec: RaggedSpec, pools, token_ids,
@@ -773,3 +793,60 @@ def ragged_forward_sampled(tree, spec: RaggedSpec, pools, token_ids,
                                samp["top_k"], samp["top_p"],
                                samp["uid"], samp["pos"], base_key)
     return tokens, new_pools
+
+
+def ragged_forward_verify(tree, spec: RaggedSpec, pools, token_ids,
+                          token_src, prev_packed, token_seq, token_pos,
+                          token_qidx, seq_lens, q_counts, block_tables,
+                          verify_idx, draft_tokens, draft_lens, pos0,
+                          samp, base_key, block_size: int, **kw):
+    """Ragged forward that scores k drafted positions per decode row in
+    ONE dispatch and folds the speculative accept/reject decision into
+    the tail (draft-k-verify — see ``spec/accept.py``).
+
+    A verify decode row carries ``1 + k`` host-staged tokens
+    ``[t0, d_1 .. d_k]`` through the SAME SplitFuse packing prefill
+    chunks use; ``verify_idx`` [S, K+1] addresses each row's k+1
+    scoring positions in the packed hidden states (for rows with fewer
+    tokens — prompt chunks, k=0 decode — the trailing entries repeat
+    the last real position and their logits are don't-cares).
+
+    Device-fed chaining survives: ``token_src >= 0`` rows gather their
+    single token from ``prev_packed[src, 1]`` — column 1 of the
+    previous VERIFY step's packed output is its emission 0, the direct
+    analog of ``prev_tokens[src]``.
+
+    The logits tail runs one head matmul per draft position at the
+    exact ``[S, C] @ [C, V]`` shape the decode tail uses (not one
+    broadcast ``[S, K+1, C]`` contraction), so greedy verify logits —
+    and therefore the emitted greedy stream — are bitwise identical to
+    the non-speculative executable's.
+
+    Returns ``(packed [S, K+2] int32, new_pools)`` — column 0 the
+    accepted count, columns 1.. the emitted tokens (host consumes
+    ``1 .. 2+a``; see ``accept_tokens``).
+    """
+    if prev_packed is not None:
+        hi = prev_packed.shape[0] - 1
+        token_ids = jnp.where(
+            token_src >= 0,
+            prev_packed[jnp.clip(token_src, 0, hi), 1], token_ids)
+    x, new_pools = _ragged_trunk(
+        tree, spec, pools, token_ids, token_seq, token_pos, token_qidx,
+        seq_lens, q_counts, block_tables, block_size, **kw)
+    last = x[verify_idx]                            # [S, K+1, C]
+    head = tree["head"]
+    bias = tree.get("head_bias")
+
+    def head_at(t):                                 # [S, C] -> [S, V]
+        lg = t @ head.T
+        if bias is not None:
+            lg = lg + bias
+        return lg.astype(jnp.float32)
+
+    logits = jax.lax.map(head_at, last.transpose(1, 0, 2))
+    logits = logits.transpose(1, 0, 2)              # [S, K+1, V]
+    from .spec.accept import accept_tokens
+    packed = accept_tokens(logits, draft_tokens, draft_lens, samp,
+                           base_key, pos0)
+    return packed, new_pools

@@ -49,9 +49,10 @@ from ....utils.logging import logger
 from ...sampling import SamplingParams
 from ..metrics import ServingMetrics
 from ..ragged_manager import SchedulingError
-from ..serving_loop import (StepRecord, TokenRef, _start_host_copy,
-                            dispatch_guarded, emit_token, stuck_error,
-                            trim_prompts)
+from ..serving_loop import (SpecRef, StepRecord, TokenRef,
+                            _start_host_copy, dispatch_guarded,
+                            emit_token, stuck_error, trim_prompts)
+from ..spec import SpeculationConfig, SpecSession
 from .admission import ADMIT, SHED, AdmissionGate
 from .request import Request, RequestState, TokenStream
 
@@ -151,6 +152,21 @@ class ServingFrontend:
         # sampled tails are DIFFERENT jit signatures; "auto" latches
         # to sampled the first time a sampled request joins
         self._use_sampled = cfg.executable == "sampled"
+        # speculative decoding: one SpecSession for the deployment's
+        # lifetime (per-uid drafter history + throttle state); the
+        # verify executable replaces the plain decode tail wholesale,
+        # so the pinning story is unchanged — verify{K}:greedy and
+        # verify{K}:samp are the two signatures
+        self._spec = None
+        if cfg.speculation.enabled:
+            sc = cfg.speculation
+            self._spec = SpecSession(SpeculationConfig(
+                k=sc.k, drafter=sc.drafter, ngram_max=sc.ngram_max,
+                ngram_min=sc.ngram_min, max_history=sc.max_history,
+                max_tracked_uids=sc.max_tracked_uids,
+                acceptance_floor=sc.acceptance_floor,
+                ewma_alpha=sc.ewma_alpha,
+                warmup_drafts=sc.warmup_drafts), metrics=self.metrics)
 
     # -- telemetry ------------------------------------------------------
     def _note_alert(self, alert) -> None:
@@ -338,6 +354,8 @@ class ServingFrontend:
         self._remaining.pop(uid, None)
         if self._inflight is not None and uid in self._inflight.slot:
             self._inflight.cancelled.add(self._inflight.slot[uid])
+        if self._spec is not None:
+            self._spec.forget(uid)
         self.metrics.forget_uid(uid)
         self.engine.flush(uid)
 
@@ -359,6 +377,14 @@ class ServingFrontend:
             self._full_prompts[req.uid] = req.prompt
             self._remaining[req.uid] = req.max_new_tokens
             req.advance(RequestState.PREFILL)
+            if self._spec is not None:
+                # the drafter sees the FULL prompt (adopted prefix
+                # span included — shared heads are where the n-gram
+                # hits live)
+                self._spec.admit(
+                    req.uid, req.prompt,
+                    k_req=None if req.sampling is None
+                    else req.sampling.speculation)
             if req.sampling is not None and not self._use_sampled:
                 # "auto" latches to the sampled executable the first
                 # time a sampled request joins: exactly one recompile,
@@ -455,20 +481,37 @@ class ServingFrontend:
 
         # ---- schedule + dispatch (the lookahead contract: sequences
         # whose pending emission is their LAST never speculate)
+        spec = self._spec
         with span("serving.schedule"):
             sched_decode = {}
+            spec_plan = set()
             for uid, v in self._decode.items():
+                if isinstance(v, SpecRef):
+                    assert v.step is self._inflight, \
+                        "stale verify-row ref"
+                    continue      # acceptance unknown until collect
                 if isinstance(v, TokenRef):
                     assert v.step is self._inflight, \
                         "stale device-token ref"
-                    if self._remaining[uid] > 1:
+                    if self._remaining[uid] > 1 and not (
+                            spec is not None and spec.wants_spec(
+                                uid, self._remaining[uid])):
                         sched_decode[uid] = 0      # placeholder id
-                else:
-                    sched_decode[uid] = v
+                    # a spec-bound uid sits this step out: its token
+                    # goes host-known at collect, then it drafts
+                    continue
+                if spec is not None:
+                    row = spec.plan_row(uid, v, self._remaining[uid])
+                    if row is not None:
+                        sched_decode[uid] = row
+                        spec_plan.add(uid)
+                        continue
+                sched_decode[uid] = v
             uids, toks = engine.schedule(self._pending, sched_decode)
         step = None
         n_prompt = 0
         recompiled = False
+        n_spec_rows = 0
         if uids:
             srcs = []
             for uid in uids:
@@ -479,12 +522,30 @@ class ServingFrontend:
             sampling, base_key = self._sampling_arg(uids)
             inflight = self._inflight
             with span("serving.dispatch", n_seqs=len(uids)):
-                tokens_dev, committed, recompiled = dispatch_guarded(
-                    engine, lambda: engine.put_sampled(
-                        uids, toks, src_slots=srcs,
-                        prev_tokens=inflight.tokens if inflight
-                        else None,
-                        sampling=sampling, base_key=base_key))
+                if spec is not None:
+                    dlens = [len(toks[i]) - 1 if u in spec_plan else 0
+                             for i, u in enumerate(uids)]
+                    n_spec_rows = sum(1 for u in uids
+                                      if u in spec_plan)
+                    with span("spec.verify", n_seqs=len(uids),
+                              drafted=sum(dlens)):
+                        tokens_dev, committed, recompiled = \
+                            dispatch_guarded(
+                                engine, lambda: engine.put_verify(
+                                    uids, toks, draft_lens=dlens,
+                                    max_draft=spec.k, src_slots=srcs,
+                                    prev_packed=inflight.tokens
+                                    if inflight else None,
+                                    sampling=sampling,
+                                    base_key=base_key))
+                else:
+                    tokens_dev, committed, recompiled = \
+                        dispatch_guarded(
+                            engine, lambda: engine.put_sampled(
+                                uids, toks, src_slots=srcs,
+                                prev_tokens=inflight.tokens if inflight
+                                else None,
+                                sampling=sampling, base_key=base_key))
             for uid in done:
                 engine.register_prefix(uid, self._full_prompts[uid])
             _start_host_copy(tokens_dev)
@@ -492,9 +553,14 @@ class ServingFrontend:
                 uids=uids, emit=emit, tokens=tokens_dev,
                 slot={u: i for i, u in enumerate(uids)},
                 committed={u: (n, b) for u, n, b in committed})
+            if spec is not None:
+                step.spec = {u: dlens[i] for i, u in enumerate(uids)
+                             if u in spec_plan}
             for row, uid in enumerate(uids):
                 if emit[row]:
-                    self._decode[uid] = TokenRef(step, row)
+                    self._decode[uid] = (
+                        SpecRef(step, row, step.spec[uid])
+                        if uid in step.spec else TokenRef(step, row))
         elif self._inflight is None and joined == 0 and \
                 (self._queue or self._pending or self._decode):
             # nothing dispatched, nothing in flight to drain, nothing
@@ -525,7 +591,7 @@ class ServingFrontend:
             recompiled=recompiled,
             blocking_sync=(inflight is not None and step is None),
             queue_depth=len(self._queue) + len(self._pending),
-            kv_free=engine.free_blocks)
+            kv_free=engine.free_blocks, spec_rows=n_spec_rows)
         self._inflight = step
         return bool(joined or uids or inflight is not None)
 
@@ -536,6 +602,7 @@ class ServingFrontend:
         retire finished requests (cancelling their speculative row in
         ``next_step``, exactly the closed-world EOS-overshoot path)."""
         engine = self.engine
+        spec = self._spec
         n_new = 0
         for row, uid in enumerate(collected.uids):
             if not collected.emit[row] or row in collected.cancelled:
@@ -543,20 +610,41 @@ class ServingFrontend:
             req = self._requests.get(uid)
             if req is None or req.done:   # cancelled + already retired
                 continue
-            tok = int(toks_host[row])
-            n_new += 1
+            k_eff = a = None
+            if spec is None:
+                emitted = (int(toks_host[row]),)
+            elif uid not in collected.spec:
+                emitted = (int(toks_host[row, 1]),)
+            else:
+                k_eff = collected.spec[uid]
+                a = min(int(toks_host[row, 0]), k_eff)
+                emitted = tuple(int(t) for t in toks_host[row, 1:2 + a])
             out = {uid: req.tokens}       # emit_token appends in place
             remaining = {uid: self._remaining[uid]}
-            finished = emit_token(out, self.metrics, remaining, uid,
-                                  tok, req.eos_token_id,
-                                  t0=req.submitted_t)
+            finished = False
+            tok = None
+            n_emitted = 0
+            for tok in emitted:
+                n_new += 1
+                n_emitted += 1
+                if spec is not None:
+                    spec.observe(uid, tok)
+                finished = emit_token(out, self.metrics, remaining,
+                                      uid, tok, req.eos_token_id,
+                                      t0=req.submitted_t)
+                if req.first_token_t is None:
+                    req.first_token_t = self.metrics.now()
+                    if req.state == RequestState.PREFILL:
+                        req.advance(RequestState.DECODE)
+                if req.on_token is not None:
+                    req.on_token(tok)
+                if finished:
+                    break       # EOS/budget inside the accepted span
             self._remaining[uid] = remaining[uid]
-            if req.first_token_t is None:
-                req.first_token_t = self.metrics.now()
-                if req.state == RequestState.PREFILL:
-                    req.advance(RequestState.DECODE)
-            if req.on_token is not None:
-                req.on_token(tok)
+            if k_eff is not None:
+                spec.record_result(uid, k_eff, a)
+                self.metrics.record_speculation(
+                    drafted=k_eff, accepted=a, emitted=n_emitted)
             if finished:
                 if next_step is not None and uid in next_step.slot:
                     # EOS/budget discovered one step late: cancel the
@@ -575,8 +663,13 @@ class ServingFrontend:
                     latency_s=req.finished_t - req.submitted_t)
                 self._retire(uid)
             else:
+                if k_eff is not None and k_eff - a > 0:
+                    # unwind the rejected tail before this uid is ever
+                    # scheduled again (a SpecRef row sat the step out)
+                    with span("spec.rollback", uid=uid, n=k_eff - a):
+                        engine.rollback_rejected(uid, k_eff - a)
                 cur = self._decode.get(uid)
-                if isinstance(cur, TokenRef) and \
+                if isinstance(cur, (TokenRef, SpecRef)) and \
                         cur.step is collected:
                     self._decode[uid] = tok   # host-known from here on
         return n_new

@@ -77,6 +77,23 @@ class TokenRef:
         self.slot = slot
 
 
+class SpecRef:
+    """A verify row in flight: the uid's accepted count + emitted
+    tokens live in row ``slot`` of the in-flight step's packed output
+    ([S, K+2] — see ``spec/accept.py``). Unlike ``TokenRef`` rows the
+    uid is NOT re-schedulable while this is pending: the host must
+    learn the accepted count before it can roll the rejected tail
+    back, draft again, or chain — the spec cadence is dispatch / sit
+    out one step / collect / dispatch, and it pays off whenever the
+    verify step emits > 1 token on average."""
+    __slots__ = ("step", "slot", "k_eff")
+
+    def __init__(self, step, slot, k_eff):
+        self.step = step
+        self.slot = slot
+        self.k_eff = k_eff
+
+
 @dataclasses.dataclass
 class StepRecord:
     """Host record of one dispatched forward."""
@@ -86,6 +103,8 @@ class StepRecord:
     slot: Dict[int, int]
     committed: Dict[int, tuple]    # uid -> (n_tokens, blocks_before)
     cancelled: Set[int] = dataclasses.field(default_factory=set)
+    # verify rows this step carries: uid -> k_eff (drafts dispatched)
+    spec: Dict[int, int] = dataclasses.field(default_factory=dict)
 
 
 # former private names, kept importable (the front-end and any older
@@ -139,10 +158,17 @@ def adopt_prefixes(engine, pending: Dict[int, np.ndarray]
     return adopted
 
 
+def speculation_of(sampling, uid):
+    """The per-request ``SamplingParams.speculation`` knob for ``uid``
+    (None = deployment default)."""
+    sp = sampling.get(uid) if isinstance(sampling, dict) else sampling
+    return getattr(sp, "speculation", None) if sp is not None else None
+
+
 def run_serving_loop(engine, prompts, *, max_new_tokens: int,
                      eos_token_id: Optional[int], sampling,
-                     mode: str,
-                     on_overload: str = "raise") -> Dict[int, List[int]]:
+                     mode: str, on_overload: str = "raise",
+                     speculation=None) -> Dict[int, List[int]]:
     if mode not in ("lookahead", "sync", "sync_host"):
         # validate BEFORE touching engine state so a typo'd mode does
         # not clobber the previous run's metrics report
@@ -151,6 +177,13 @@ def run_serving_loop(engine, prompts, *, max_new_tokens: int,
     if on_overload not in ("raise", "shed"):
         raise ValueError(
             f"on_overload must be raise/shed, got {on_overload!r}")
+    from .spec import SpecSession, SpeculationConfig
+    spec_cfg = SpeculationConfig.resolve(speculation)
+    if spec_cfg is not None and mode != "lookahead":
+        # the verify cadence rides the lookahead overlap; the sync
+        # loops stay the plain differential references
+        raise ValueError(
+            f"speculation requires mode='lookahead', got {mode!r}")
     if getattr(engine, "_dispatch_poisoned", False):
         # a previous dispatch blew its watchdog deadline; its worker
         # thread may still be alive inside the runtime — new runs on
@@ -196,11 +229,18 @@ def run_serving_loop(engine, prompts, *, max_new_tokens: int,
 
         def on_prefill_done(uid):
             engine.register_prefix(uid, full_prompts[uid])
+    spec = None
+    if spec_cfg is not None:
+        spec = SpecSession(spec_cfg, metrics=metrics)
+        for uid, p in full_prompts.items():
+            # the drafter sees the FULL prompt (adopted prefix span
+            # included) — shared heads are where the n-gram hits live
+            spec.admit(uid, p, k_req=speculation_of(sampling, uid))
     try:
         if mode == "lookahead":
             _run_lookahead(engine, pending, out, max_new_tokens,
                            eos_token_id, sampling, metrics,
-                           on_prefill_done)
+                           on_prefill_done, spec=spec)
         elif mode == "sync":
             _run_sync(engine, pending, out, max_new_tokens,
                       eos_token_id, sampling, metrics, on_prefill_done)
@@ -357,9 +397,10 @@ def _run_sync(engine, pending, out, max_new, eos, sampling, metrics,
 
 
 def _run_lookahead(engine, pending, out, max_new, eos, sampling,
-                   metrics, on_prefill_done=None):
+                   metrics, on_prefill_done=None, spec=None):
     base_key = base_key_for(sampling)
-    decode: Dict[int, object] = {}     # uid -> int | TokenRef(inflight)
+    # uid -> int | TokenRef(inflight) | SpecRef(inflight)
+    decode: Dict[int, object] = {}
     remaining = {uid: max_new for uid in out}
     inflight: Optional[StepRecord] = None
 
@@ -368,20 +409,37 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
         # ---- schedule + dispatch step k+1 before step k's tokens are
         # host-visible. Sequences whose pending emission is their LAST
         # (length limit) are excluded — the host knows counts up front,
-        # so only EOS ever cancels speculative work.
+        # so only EOS ever cancels speculative work. With speculation,
+        # host-known uids draft a verify row here (host work riding the
+        # overlap window) and verify rows in flight sit the step out.
         with span("serving.schedule"):
             sched_decode = {}
+            spec_plan: Set[int] = set()
             for uid, v in decode.items():
+                if isinstance(v, SpecRef):
+                    assert v.step is inflight, "stale verify-row ref"
+                    continue      # acceptance unknown until collect
                 if isinstance(v, TokenRef):
                     assert v.step is inflight, "stale device-token ref"
-                    if remaining[uid] > 1:
+                    if remaining[uid] > 1 and not (
+                            spec is not None
+                            and spec.wants_spec(uid, remaining[uid])):
                         sched_decode[uid] = 0      # placeholder id
-                else:
-                    sched_decode[uid] = v
+                    # a spec-bound uid sits this step out instead: its
+                    # token goes host-known at collect, then it drafts
+                    continue
+                if spec is not None:
+                    row = spec.plan_row(uid, v, remaining[uid])
+                    if row is not None:
+                        sched_decode[uid] = row
+                        spec_plan.add(uid)
+                        continue
+                sched_decode[uid] = v
             uids, toks = engine.schedule(pending, sched_decode)
         step = None
         n_prompt = 0
         recompiled = False
+        n_spec_rows = 0
         if uids:
             srcs = []
             for uid in uids:
@@ -389,23 +447,47 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
                 srcs.append(v.slot if isinstance(v, TokenRef) else -1)
             emit, n_prompt, done = trim_prompts(pending, uids, toks)
             with span("serving.dispatch", n_seqs=len(uids)):
-                tokens_dev, committed, recompiled = dispatch_guarded(
-                    engine, lambda: engine.put_sampled(
-                        uids, toks, src_slots=srcs,
-                        prev_tokens=inflight.tokens if inflight
-                        else None,
-                        sampling=sampling, base_key=base_key))
+                if spec is not None:
+                    # the scheduler may trim drafts under pressure, so
+                    # k_eff comes from the scheduled row lengths
+                    dlens = [len(toks[i]) - 1 if u in spec_plan else 0
+                             for i, u in enumerate(uids)]
+                    n_spec_rows = sum(1 for u in uids if u in spec_plan)
+                    with span("spec.verify", n_seqs=len(uids),
+                              drafted=sum(dlens)):
+                        tokens_dev, committed, recompiled = \
+                            dispatch_guarded(
+                                engine, lambda: engine.put_verify(
+                                    uids, toks, draft_lens=dlens,
+                                    max_draft=spec.k, src_slots=srcs,
+                                    prev_packed=inflight.tokens
+                                    if inflight else None,
+                                    sampling=sampling,
+                                    base_key=base_key))
+                else:
+                    tokens_dev, committed, recompiled = \
+                        dispatch_guarded(
+                            engine, lambda: engine.put_sampled(
+                                uids, toks, src_slots=srcs,
+                                prev_tokens=inflight.tokens if inflight
+                                else None,
+                                sampling=sampling, base_key=base_key))
             _register_done(on_prefill_done, done)
             _start_host_copy(tokens_dev)
             step = StepRecord(
                 uids=uids, emit=emit, tokens=tokens_dev,
                 slot={u: i for i, u in enumerate(uids)},
                 committed={u: (n, b) for u, n, b in committed})
+            if spec is not None:
+                step.spec = {u: dlens[i] for i, u in enumerate(uids)
+                             if u in spec_plan}
             # every emitting row's NEXT token now lives in this step's
             # device output
             for row, uid in enumerate(uids):
                 if emit[row]:
-                    decode[uid] = TokenRef(step, row)
+                    decode[uid] = (
+                        SpecRef(step, row, step.spec[uid])
+                        if uid in step.spec else TokenRef(step, row))
         elif inflight is None:
             # nothing schedulable and nothing in flight that could
             # free blocks -> genuinely stuck. (empty + inflight is the
@@ -428,9 +510,33 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
             for row, uid in enumerate(inflight.uids):
                 if not inflight.emit[row] or row in inflight.cancelled:
                     continue
-                tok = int(toks_host[row])
-                n_new += 1
-                if emit_token(out, metrics, remaining, uid, tok, eos):
+                k_eff = a = None
+                if spec is None:
+                    emitted = (int(toks_host[row]),)
+                elif uid not in inflight.spec:
+                    emitted = (int(toks_host[row, 1]),)
+                else:
+                    k_eff = inflight.spec[uid]
+                    a = min(int(toks_host[row, 0]), k_eff)
+                    emitted = tuple(int(t)
+                                    for t in toks_host[row, 1:2 + a])
+                finished = False
+                tok = None
+                n_emitted = 0
+                for tok in emitted:
+                    n_new += 1
+                    n_emitted += 1
+                    if spec is not None:
+                        spec.observe(uid, tok)
+                    finished = emit_token(out, metrics, remaining, uid,
+                                          tok, eos)
+                    if finished:
+                        break       # EOS/budget inside the accepted span
+                if k_eff is not None:
+                    spec.record_result(uid, k_eff, a)
+                    metrics.record_speculation(
+                        drafted=k_eff, accepted=a, emitted=n_emitted)
+                if finished:
                     if step is not None and uid in step.slot:
                         # EOS discovered one step late: cancel the
                         # speculative row already dispatched in k+1
@@ -441,10 +547,18 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
                         engine.rollback_step(uid, n_t, blocks_before)
                         metrics.record_cancelled()
                     decode.pop(uid, None)
+                    if spec is not None:
+                        spec.forget(uid)
                     engine.flush(uid)
                 else:
+                    if k_eff is not None and k_eff - a > 0:
+                        # unwind the rejected tail before this uid is
+                        # ever scheduled again (it sat this step out)
+                        with span("spec.rollback", uid=uid,
+                                  n=k_eff - a):
+                            engine.rollback_rejected(uid, k_eff - a)
                     cur = decode.get(uid)
-                    if isinstance(cur, TokenRef) and \
+                    if isinstance(cur, (TokenRef, SpecRef)) and \
                             cur.step is inflight:
                         decode[uid] = tok      # host-known from here on
         # blocking = this iteration waited on the most recent dispatch
@@ -456,7 +570,8 @@ def _run_lookahead(engine, pending, out, max_new, eos, sampling,
             decode_only=(bool(uids) and n_prompt == 0),
             recompiled=recompiled,
             blocking_sync=(inflight is not None and step is None),
-            queue_depth=len(pending), kv_free=engine.free_blocks)
+            queue_depth=len(pending), kv_free=engine.free_blocks,
+            spec_rows=n_spec_rows)
         inflight = step
 
 

@@ -1,0 +1,16 @@
+"""Speculative decoding (draft-k-verify) for the v2 ragged engine.
+
+Host-side prompt-lookup drafting (``drafter.py``), the on-device
+accept kernel (``accept.py``), and the per-run session glue shared by
+the serving loops (``session.py``). The verify forward itself lives in
+``inference/v2/model.py`` (``ragged_forward_verify``) next to the
+other forwards; the engine's ``put_verify``/``rollback_rejected``
+dispatch/unwind it.
+"""
+
+from .accept import accept_tokens
+from .drafter import Drafter, PromptLookupDrafter, make_drafter
+from .session import SpeculationConfig, SpecSession
+
+__all__ = ["accept_tokens", "Drafter", "PromptLookupDrafter",
+           "make_drafter", "SpeculationConfig", "SpecSession"]

@@ -1,0 +1,117 @@
+"""On-device accept kernel for draft-k-verify speculative decoding.
+
+The verify forward scores every drafted position in one dispatch
+(``model.ragged_forward_verify``); this kernel turns the
+[S, K+1, vocab] logits into accepted counts + emitted tokens WITHOUT a
+host round-trip, so the lookahead serving loop keeps its
+0-blocking-syncs property with speculation on.
+
+Index convention (one verify row = ``[t0, d_1 .. d_k]``): position j's
+logits predict **emission j**, and ``draft_tokens[:, j]`` is the
+drafter's guess for emission j. Position K (input d_k) yields the
+BONUS emission when every draft is accepted.
+
+Greedy rows emit the longest exact-match prefix against the
+per-position argmax — the emitted stream is bitwise identical to
+non-speculative greedy decode by construction. Sampled rows use
+point-mass rejection sampling: the drafter is deterministic, so the
+proposal q is a point mass on the draft token d, and the standard
+accept rule ``u < p(d)/q(d)`` reduces to ``u < p(d)`` with the
+rejection residual ``norm(p - q)+`` being p with d masked out. The
+per-(uid, position) keys are the SAME ``fold_in(fold_in(base, uid),
+pos)`` threading ``sampling.ragged_sample`` uses, so sampled draws are
+batch-composition invariant. The replacement/bonus categorical uses
+that key RAW — exactly the key ``ragged_sample`` would use at the same
+absolute position — so any draw the drafts don't influence (a k=0 row,
+a draft-less degraded row, the bonus slot) is bitwise identical to the
+non-speculative stream; only the accept uniform splits off a sub-key
+(``fold_in(key, 1)``).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def accept_tokens(logits, draft_tokens, draft_lens, samp, base_key,
+                  pos0):
+    """-> packed [S, K+2] int32: column 0 = accepted draft count ``a``,
+    columns 1.. = emitted tokens. The host consumes columns
+    ``1 .. 2+a`` (the ``a`` accepted drafts plus one correction/bonus
+    token); later columns are don't-cares. Column 1 doubles as the
+    next step's device-fed token for k=0 rows (``prev_packed[:, 1]``).
+
+    ``logits`` [S, K+1, V] float32; ``draft_tokens`` [S, K] int32;
+    ``draft_lens`` [S] int32 (k may vary per row, 0..K);
+    ``samp``/``base_key`` as in ``ragged_forward_sampled`` (None =
+    all-greedy); ``pos0`` [S] uint32 = absolute sampling position of
+    emission 0 (``seq_lens - draft_len``, which for a k=0 row is
+    exactly the ``seq_lens`` position non-speculative sampling keys
+    on).
+    """
+    S, K1, V = logits.shape
+    K = K1 - 1
+    tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)     # [S, K+1]
+    dlen = draft_lens.astype(jnp.int32)
+    if K == 0:
+        a0 = jnp.zeros((S, 1), jnp.int32)
+        return jnp.concatenate([a0, tgt], axis=1)
+
+    jj = jnp.arange(K, dtype=jnp.int32)[None, :]
+    g_match = (draft_tokens == tgt[:, :K]) & (jj < dlen[:, None])
+    # longest all-accepted prefix
+    g_acc = jnp.cumprod(g_match.astype(jnp.int32), axis=1).sum(axis=1)
+    if samp is None:
+        return jnp.concatenate([g_acc[:, None], tgt], axis=1)
+
+    from ...sampling import filter_logits
+    temp = samp["temperature"].astype(jnp.float32)          # [S]
+    scaled = logits / jnp.where(temp > 0, temp, 1.0)[:, None, None]
+    total = S * K1
+
+    def rep(v):          # [S] -> [S*K1], row-major match for reshape
+        return jnp.repeat(v, K1, total_repeat_length=total)
+
+    filtered = filter_logits(scaled.reshape(total, V),
+                             top_k=rep(samp["top_k"]),
+                             top_p=rep(samp["top_p"]), xp=jnp)
+    filtered = filtered.reshape(S, K1, V)
+    probs = jax.nn.softmax(filtered, axis=-1)
+    neg = jnp.asarray(-jnp.inf, filtered.dtype)
+
+    def row(probs_r, filt_r, draft_r, dlen_r, uid_r, p0_r):
+        key_u = jax.random.fold_in(base_key, uid_r)
+        ks = jax.vmap(lambda j: jax.random.fold_in(key_u, p0_r + j))(
+            jnp.arange(K1, dtype=jnp.uint32))
+        u = jax.vmap(lambda k: jax.random.uniform(
+            jax.random.fold_in(k, 1)))(ks[:K])               # [K]
+        p_d = jnp.take_along_axis(
+            probs_r[:K], draft_r[:, None], axis=-1)[:, 0]    # [K]
+        ok = (u < p_d) & (jnp.arange(K) < dlen_r)
+        a = jnp.cumprod(ok.astype(jnp.int32)).sum()
+        # replacement draw per position: the point-mass residual masks
+        # the draft token out where a draft exists; past-dlen positions
+        # and the bonus slot K sample the filtered distribution as-is
+        d_pad = jnp.concatenate(
+            [draft_r, jnp.zeros((1,), jnp.int32)])           # [K+1]
+        has_draft = jnp.arange(K1) < dlen_r
+        mask = jax.nn.one_hot(d_pad, V, dtype=bool) \
+            & has_draft[:, None]
+        masked = jnp.where(mask, neg, filt_r)
+        # RAW per-position key: where no mask applies this is the
+        # exact draw ragged_sample makes at the same (uid, position)
+        fresh = jax.vmap(jax.random.categorical)(
+            ks, masked).astype(jnp.int32)
+        # a top-k=1 filter can leave the residual empty — but then
+        # p(d) == 1 and the draft is always accepted, so the fallback
+        # value is never consumed; it only keeps the draw well-defined
+        fresh = jnp.where(jnp.all(masked == neg, axis=-1), d_pad, fresh)
+        emitted = jnp.where(jnp.arange(K1) < a, d_pad, fresh)
+        return a, emitted
+
+    a_s, emit_s = jax.vmap(row)(
+        probs, filtered, draft_tokens, dlen,
+        samp["uid"].astype(jnp.uint32), pos0.astype(jnp.uint32))
+    is_greedy = temp <= 0.0
+    a = jnp.where(is_greedy, g_acc, a_s).astype(jnp.int32)
+    emitted = jnp.where(is_greedy[:, None], tgt, emit_s)
+    return jnp.concatenate([a[:, None], emitted], axis=1)

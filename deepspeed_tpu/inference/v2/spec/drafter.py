@@ -1,0 +1,113 @@
+"""Host-side drafters for speculative decoding.
+
+The draft side of draft-k-verify is pure host work: given what a
+sequence has already said (prompt + generated tokens), guess its next
+``k`` tokens so the verify forward can score all of them in one
+dispatch. The ``Drafter`` interface keeps the guessing strategy
+pluggable (a self-drafting head or a small draft model can land later
+without touching the verify path); the one shipped implementation is
+**prompt lookup** (n-gram suffix match against the sequence's OWN
+history) — no second model, no extra device memory, and it wins
+hardest on exactly the repetitive / shared-prefix traffic the serving
+bench models.
+
+Per-uid histories live in a ``BoundedCache`` (the repo's
+process-lifetime rule: a week-long front-end must not grow an index
+per uid forever) and each history is clipped to ``max_history``
+tokens, so the n-gram index is bounded in BOTH dimensions.
+"""
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+from ....runtime.lifecycle import BoundedCache
+
+_EMPTY = np.empty((0,), np.int32)
+
+
+class Drafter:
+    """Interface: propose up to ``k`` draft tokens for ``uid``.
+
+    ``observe`` feeds the drafter every token the sequence actually
+    produced/was prompted with (in order); ``draft`` returns a
+    [<=k] int32 array of guesses for the NEXT tokens; ``forget``
+    drops all per-uid state when the request leaves.
+    """
+
+    def observe(self, uid: int, tokens: Iterable[int]) -> None:
+        raise NotImplementedError
+
+    def draft(self, uid: int, k: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def forget(self, uid: int) -> None:
+        raise NotImplementedError
+
+
+class PromptLookupDrafter(Drafter):
+    """N-gram prompt lookup: match the history's trailing n-gram
+    (``ngram_max`` down to ``ngram_min``) against earlier positions of
+    the SAME history and draft the tokens that followed the match.
+
+    Among the candidate matches the most recent one with a full ``k``
+    continuation wins (recency tracks the sequence's current mode —
+    e.g. a generation loop — while a full continuation keeps drafts
+    long); with no full-length candidate the most recent match
+    contributes a partial draft.
+    """
+
+    def __init__(self, ngram_max: int = 3, ngram_min: int = 1,
+                 max_history: int = 4096, max_uids: int = 1024):
+        if ngram_min < 1 or ngram_max < ngram_min:
+            raise ValueError(
+                f"need 1 <= ngram_min <= ngram_max, got "
+                f"[{ngram_min}, {ngram_max}]")
+        self.ngram_max = ngram_max
+        self.ngram_min = ngram_min
+        self.max_history = max(ngram_max + 1, int(max_history))
+        self._hist = BoundedCache("spec_ngram_index",
+                                  max_entries=max(1, int(max_uids)),
+                                  kind="index")
+
+    def observe(self, uid: int, tokens) -> None:
+        h = self._hist.get(uid)
+        if h is None:
+            h = []
+            self._hist.put(uid, h)
+        h.extend(int(t) for t in np.asarray(tokens).reshape(-1))
+        if len(h) > self.max_history:
+            del h[:len(h) - self.max_history]
+
+    def draft(self, uid: int, k: int) -> np.ndarray:
+        h = self._hist.get(uid)
+        if h is None or k <= 0:
+            return _EMPTY
+        hist = np.asarray(h, np.int32)
+        m = len(hist)
+        for n in range(self.ngram_max, self.ngram_min - 1, -1):
+            if m <= n:
+                continue
+            pat = hist[m - n:]
+            win = np.lib.stride_tricks.sliding_window_view(hist, n)
+            # exclude the trailing window (the pattern itself)
+            hits = np.flatnonzero((win[:-1] == pat).all(axis=1))
+            if hits.size == 0:
+                continue
+            starts = hits + n          # continuation start indices
+            full = starts[m - starts >= k]
+            start = int(full[-1] if full.size else starts[-1])
+            return hist[start:start + k].copy()
+        return _EMPTY
+
+    def forget(self, uid: int) -> None:
+        self._hist.pop(uid, None)
+
+
+def make_drafter(name: str, **kwargs) -> Drafter:
+    """Drafter registry keyed by config name (``"prompt_lookup"`` is
+    the only shipped entry; the hook is the pluggability seam)."""
+    if name == "prompt_lookup":
+        return PromptLookupDrafter(**kwargs)
+    raise ValueError(f"unknown drafter {name!r} "
+                     "(available: 'prompt_lookup')")

@@ -1,0 +1,180 @@
+"""Per-run speculative-decoding session state.
+
+``SpecSession`` is the host-side glue both serving loops
+(``serving_loop._run_lookahead`` and ``ServingFrontend.step``) share:
+it owns the drafter, resolves each request's draft length (the
+per-request ``SamplingParams.speculation`` knob against the deployment
+default), plans each step's verify rows, and runs the
+acceptance-EWMA auto-throttle — a uid whose acceptance rate falls
+below ``acceptance_floor`` is dropped to k=0 permanently, so
+adversarial / low-repetition traffic stops paying the verify cost and
+rejoins the full-speed device-fed decode chain.
+
+Drafting is host work that rides the lookahead loop's overlap window
+(it happens while the previous step computes on device), wrapped in
+the ``spec.draft`` span and exposed as the ``spec.draft`` fault site:
+an injected fault degrades that row to a draft-less verify (k_eff=0)
+instead of killing the request — speculation is an optimization, never
+a liveness dependency.
+"""
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+from ....resilience.errors import ResilienceError
+from ....resilience.fault_injector import fault_injector
+from ....runtime.lifecycle import BoundedCache
+from ....telemetry.trace import span
+from .drafter import Drafter, make_drafter
+
+
+@dataclasses.dataclass
+class SpeculationConfig:
+    """Knobs for draft-k-verify speculative decoding.
+
+    ``k`` is both the padded draft slot (the verify executable's fixed
+    shape — the zero-recompile contract) and the default per-request
+    draft length; a request's ``SamplingParams.speculation`` may lower
+    it per row (traced, never recompiles). ``acceptance_floor`` /
+    ``ewma_alpha`` / ``warmup_drafts`` drive the auto-throttle;
+    ``ngram_*`` / ``max_history`` / ``max_tracked_uids`` configure the
+    prompt-lookup drafter's bounded index.
+    """
+    k: int = 4
+    drafter: str = "prompt_lookup"
+    ngram_max: int = 3
+    ngram_min: int = 1
+    max_history: int = 4096
+    max_tracked_uids: int = 1024
+    acceptance_floor: float = 0.1
+    ewma_alpha: float = 0.3
+    warmup_drafts: int = 4
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"speculation k must be >= 1, got {self.k}")
+        if not 0.0 <= self.acceptance_floor <= 1.0:
+            raise ValueError("acceptance_floor must be in [0, 1], got "
+                             f"{self.acceptance_floor}")
+        if not 0.0 < self.ewma_alpha <= 1.0:
+            raise ValueError("ewma_alpha must be in (0, 1], got "
+                             f"{self.ewma_alpha}")
+
+    @classmethod
+    def resolve(cls, value) -> Optional["SpeculationConfig"]:
+        """Normalize a user-facing ``speculation=`` argument:
+        None/False -> off, True -> defaults, dict -> kwargs,
+        SpeculationConfig -> itself."""
+        if value is None or value is False:
+            return None
+        if value is True:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            return cls(**value)
+        raise TypeError("speculation must be None/bool/dict/"
+                        f"SpeculationConfig, got {type(value).__name__}")
+
+
+class SpecSession:
+    """One serving run's (or one front-end deployment's) speculative
+    state. Not thread-safe — owned by the single serving loop thread,
+    like the engine itself."""
+
+    def __init__(self, config: SpeculationConfig, metrics=None,
+                 drafter: Optional[Drafter] = None):
+        self.config = config
+        self.k = config.k
+        self.metrics = metrics
+        self.drafter = drafter if drafter is not None else make_drafter(
+            config.drafter, ngram_max=config.ngram_max,
+            ngram_min=config.ngram_min, max_history=config.max_history,
+            max_uids=config.max_tracked_uids)
+        # per-uid throttle state: [ewma, n_observations, k_req]
+        self._state = BoundedCache("spec_uid_state",
+                                   max_entries=max(
+                                       1, config.max_tracked_uids),
+                                   kind="state")
+
+    # -- request lifecycle ------------------------------------------------
+    def admit(self, uid: int, prompt, k_req: Optional[int] = None
+              ) -> None:
+        """Register a request: seed the drafter with its FULL prompt
+        (the adopted shared-prefix span included — that's where the
+        n-gram hits live) and latch its resolved draft length."""
+        k = self.k if k_req is None else max(0, min(int(k_req), self.k))
+        self._state.put(uid, [1.0, 0, k])
+        self.drafter.observe(uid, prompt)
+
+    def observe(self, uid: int, token: int) -> None:
+        """Feed one emitted token into the drafter's history."""
+        self.drafter.observe(uid, (token,))
+
+    def forget(self, uid: int) -> None:
+        self.drafter.forget(uid)
+        self._state.pop(uid, None)
+
+    # -- planning ---------------------------------------------------------
+    def throttled(self, uid: int) -> bool:
+        st = self._state.get(uid)
+        return st is not None and st[2] <= 0
+
+    def wants_spec(self, uid: int, remaining: int) -> bool:
+        """True when ``uid``'s NEXT row should be a verify row — the
+        lookahead loop uses this to keep a spec-eligible uid off the
+        device-fed placeholder chain (a device-fed row can't carry
+        host drafts), letting its token go host-known at collect."""
+        st = self._state.get(uid)
+        k_req = st[2] if st is not None else self.k
+        return min(k_req, max(0, remaining - 1)) > 0
+
+    def plan_row(self, uid: int, last_tok: int, remaining: int
+                 ) -> Optional[np.ndarray]:
+        """Plan ``uid``'s next decode row. Returns the host-staged
+        token array ``[t0, d_1 .. d_k]`` for a verify row, or None
+        when the uid should ride the plain device-fed chain instead
+        (throttled, per-request k=0, or no headroom: a verify row is
+        only worth its 2-step cadence when it can emit > 1 token)."""
+        st = self._state.get(uid)
+        k_req = st[2] if st is not None else self.k
+        # remaining-1 clamp: never draft past the generation budget
+        k = min(k_req, max(0, remaining - 1))
+        if k <= 0:
+            return None
+        with span("spec.draft", uid=uid, k=k):
+            try:
+                fault_injector.fire("spec.draft", detail=str(uid))
+                drafts = self.drafter.draft(uid, k)
+            except ResilienceError:
+                # degrade to a draft-less verify row: the uid stays on
+                # the spec cadence (host-known next step) and retries
+                drafts = np.empty((0,), np.int32)
+                if self.metrics is not None:
+                    self.metrics.record_spec_draft_fault()
+        return np.concatenate(
+            [np.asarray([last_tok], np.int32),
+             np.asarray(drafts, np.int32).reshape(-1)])
+
+    # -- results ----------------------------------------------------------
+    def record_result(self, uid: int, k_eff: int, accepted: int
+                      ) -> None:
+        """Fold one verify step's outcome into the uid's acceptance
+        EWMA and throttle below the floor. A draft-less verify row
+        (k_eff=0 — drafter found nothing) counts as acceptance 0: a
+        sequence the drafter cannot draft for should stop paying the
+        verify cadence just like one whose drafts get rejected."""
+        st = self._state.get(uid)
+        if st is None or st[2] <= 0:
+            return
+        rate = accepted / k_eff if k_eff > 0 else 0.0
+        alpha = self.config.ewma_alpha
+        st[0] = (1.0 - alpha) * st[0] + alpha * rate
+        st[1] += 1
+        if (st[1] >= self.config.warmup_drafts
+                and st[0] < self.config.acceptance_floor):
+            st[2] = 0           # permanent: rejoin the full-speed chain
+            if self.metrics is not None:
+                self.metrics.record_spec_throttle()

@@ -48,6 +48,12 @@ FAULT_SITES = {
         "(inference/v2/serving/frontend.py _join) — an injected fault "
         "here drills the shed-without-leaking path (the handler must "
         "flush the just-created sequence)",
+    "spec.draft":
+        "speculative decoding: one fire per host-side draft attempt "
+        "(inference/v2/spec/session.py plan_row) — an injected fault "
+        "degrades that row to a draft-less verify (k_eff=0) instead "
+        "of failing the request; speculation is an optimization, "
+        "never a liveness dependency",
     "fleet.dispatch":
         "fleet serving replica dispatch: one consume() per replica "
         "SLOT per router step — ordinal = step * n_replicas + slot, "

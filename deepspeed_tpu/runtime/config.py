@@ -295,6 +295,33 @@ class ServingPrefixConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class ServingSpeculationConfig(DeepSpeedConfigModel):
+    """Speculative decoding (inference/v2/spec/), config section
+    ``serving.speculation``: host-side prompt-lookup drafting +
+    on-device draft-k-verify through the ragged verify executable.
+    See README "Speculative decoding" for full semantics."""
+    enabled: bool = False
+    # padded draft slot / default per-request draft length (the verify
+    # executable's fixed shape — the zero-recompile contract);
+    # per-request SamplingParams.speculation may lower it per row
+    k: int = 4
+    # drafter choice ("prompt_lookup" is the only built-in)
+    drafter: str = "prompt_lookup"
+    # prompt-lookup n-gram window (longest match tried first)
+    ngram_max: int = 3
+    ngram_min: int = 1
+    # per-uid history bound (tokens) and tracked-uid bound (LRU)
+    max_history: int = 4096
+    max_tracked_uids: int = 1024
+    # acceptance-EWMA auto-throttle: a uid whose EWMA acceptance rate
+    # falls below the floor after warmup_drafts observations drops to
+    # k=0 permanently (rejoins the full-speed device-fed chain)
+    acceptance_floor: float = 0.1
+    ewma_alpha: float = 0.3
+    warmup_drafts: int = 4
+
+
+@dataclasses.dataclass
 class ServingFleetConfig(DeepSpeedConfigModel):
     """Fleet router knobs (inference/v2/serving/fleet/), config section
     ``serving.fleet``: N data-parallel replicas behind one router with
@@ -363,6 +390,8 @@ class ServingConfig(DeepSpeedConfigModel):
     # the oldest are dropped — the front-end's own lifetime bound
     max_retained_requests: int = 1024
     prefix: ServingPrefixConfig = submodel(ServingPrefixConfig)
+    speculation: ServingSpeculationConfig = submodel(
+        ServingSpeculationConfig)
     fleet: ServingFleetConfig = submodel(ServingFleetConfig)
 
 

@@ -71,6 +71,18 @@ SPAN_SITES = {
     "serving.collect":
         "the host-side token collect (np.asarray wait on the "
         "in-flight step; ~0 in lookahead steady state)",
+    # ---- speculative decoding (inference/v2/spec/, serving loops) ----
+    "spec.draft":
+        "one uid's host-side prompt-lookup draft (args: uid, k) — "
+        "rides the lookahead overlap window, so nonzero time here is "
+        "only a problem if it exceeds the device step it overlaps",
+    "spec.verify":
+        "one verify-forward dispatch scoring k drafted positions per "
+        "spec row in a single ragged step (args: n_seqs, drafted); "
+        "nests inside serving.dispatch",
+    "spec.rollback":
+        "one uid's rejected-tail unwind (args: uid, n): host KV "
+        "accounting only — seq_lens masks the stale device KV",
     # ---- serving front-end (inference/v2/serving/frontend.py) ----
     "frontend.admit":
         "one step's admission pass over the queued requests "

@@ -145,10 +145,13 @@ class TestServingMetrics:
         for key in ("mode", "steps", "decode_steps", "tokens_emitted",
                     "recompiles", "blocking_syncs", "steady_steps",
                     "steady_blocking_syncs", "steady_decode_tps",
-                    "cancelled_speculative_steps", "dispatch_ms",
-                    "sync_wait_ms", "step_ms", "ttft_ms", "itl_ms",
-                    "queue_depth", "kv_util"):
+                    "cancelled_speculative_steps", "speculation",
+                    "dispatch_ms", "sync_wait_ms", "step_ms",
+                    "ttft_ms", "itl_ms", "queue_depth", "kv_util"):
             assert key in rep, key
+        # speculation block always present, all-zero without spec
+        assert rep["speculation"]["drafted_tokens"] == 0
+        assert rep["speculation"]["acceptance_rate"] == 0.0
         assert rep["mode"] == "lookahead"
         assert rep["tokens_emitted"] == sum(len(v) for v in out.values())
         assert rep["ttft_ms"]["count"] == len(PROMPTS)

@@ -163,6 +163,9 @@ class TestEndToEnd:
         assert last["offload/grad_d2h_ms"] >= 0
         assert last["schedule/collective_count"] >= 0
         assert last["serving/steady_decode_tps"] >= 0
+        # the speculation block reaches the stream even when spec is
+        # off (stable key set: acceptance rate is always publishable)
+        assert last["serving/speculation/acceptance_rate"] >= 0
         assert last["memory/host_rss_gb"] > 0
         assert last["train/step_time_ms"] > 0
 
@@ -232,14 +235,21 @@ class TestReportSchemas:
             "prompt_tokens", "recompiles", "blocking_syncs",
             "steady_steps", "steady_blocking_syncs",
             "steady_decode_tps", "cancelled_speculative_steps",
-            "admission", "requests", "request_latency_ms",
-            "dispatch_ms", "sync_wait_ms", "step_ms",
-            "ttft_ms", "itl_ms", "queue_depth", "kv_util",
+            "speculation", "admission", "requests",
+            "request_latency_ms", "dispatch_ms", "sync_wait_ms",
+            "step_ms", "ttft_ms", "itl_ms", "queue_depth", "kv_util",
             "process_memory"}
         assert set(rep["admission"]) == {"requested", "admitted",
                                          "shed", "shed_uids"}
         assert set(rep["requests"]) == {"submitted", "finished",
                                         "cancelled", "shed"}
+        # the speculation block is ALWAYS present (zeros when off) so
+        # JSONL/monitor streams keep a stable key set spec-on/off
+        assert set(rep["speculation"]) == {
+            "drafted_tokens", "accepted_tokens", "rejected_tokens",
+            "emitted_tokens", "acceptance_rate", "verify_steps",
+            "verify_rows", "mean_accepted_len", "emitted_per_verify",
+            "throttled_uids", "draft_faults", "verify_dispatch_ms"}
 
     def test_process_memory_keys(self, setup):
         for rep in (setup["engine"].get_schedule_report(),

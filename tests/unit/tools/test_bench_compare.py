@@ -109,6 +109,45 @@ class TestCompare:
         _, reg, miss = compare(post, dict(post), 0.10, {}, set())
         assert reg == [] and miss == []
 
+    def test_tracked_decomposition_key_cannot_silently_vanish(self):
+        """ISSUE 13: once a lineage's config-5/7 row publishes the
+        ``speculation`` decomposition block, a new artifact whose row
+        lost it fails the gate — but artifacts PREDATING the block
+        (no key on the old side) compare clean, so the gate can be
+        introduced without invalidating checked-in history."""
+        from bench_compare import TRACKED_DECOMP_KEYS
+        assert "speculation" in TRACKED_DECOMP_KEYS["5"]
+        assert "speculation" in TRACKED_DECOMP_KEYS["7_frontend"]
+
+        def row_with(decomp):
+            r = _row(1.0)
+            r["decomposition"] = decomp
+            return r
+
+        pre = {"7_frontend": _row(1.0)}           # predates the block
+        post = {"7_frontend": row_with({"speculation": {
+            "emitted_per_verify": 1.7}})}
+        bare = {"7_frontend": row_with({"steps": 9})}
+        # pre-introduction old side arms nothing
+        _, reg, miss = compare(pre, post, 0.10, {}, set())
+        assert reg == [] and miss == []
+        _, reg, miss = compare(pre, bare, 0.10, {}, set())
+        assert reg == [] and miss == []
+        # armed: the new row dropped the published block -> gate fails
+        rows, reg, miss = compare(post, bare, 0.10, {}, set())
+        assert miss == ["7_frontend.decomposition.speculation"]
+        assert reg == []
+        assert rows[0]["status"] == "MISSING-DECOMP"
+        assert "speculation" in rows[0]["note"]
+        # keeping the block is clean
+        _, reg, miss = compare(post, dict(post), 0.10, {}, set())
+        assert reg == [] and miss == []
+        # untracked configs never arm decomposition keys
+        _, _, miss = compare(
+            {"2": row_with({"speculation": {}})},
+            {"2": row_with({})}, 0.10, {}, set())
+        assert miss == []
+
     def test_floor_trips_after_lineage_clears_it(self):
         """Config 4's 0.8 floor: dormant while the lineage is still
         below the bar (r04->r05 era compares clean), armed once the

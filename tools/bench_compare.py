@@ -16,6 +16,9 @@ once one appears in the old artifact it is implicitly ``--require``d,
 so a future run that drops it (a refactor losing the bench wiring)
 fails the gate instead of passing with one fewer row. Artifacts
 predating a tracked config still compare clean.
+``TRACKED_DECOMP_KEYS`` applies the same arming rule one level down:
+a decomposition key (config 5/7's ``speculation`` block) published by
+the old row may not vanish from the new one.
 
 ``FLOOR_CONFIGS`` (extend with ``--floor 4=0.8``) pins absolute
 vs_baseline minimums: once the lineage has cleared a floor, any new
@@ -82,6 +85,15 @@ def parse_per_config(text):
 # artifact -> required comparable in the new one (see module docstring)
 TRACKED_CONFIGS = ("7_frontend", "8_fleet")
 
+# decomposition keys that must not vanish from a config's lineage:
+# once the OLD artifact's row publishes the key, a new row without it
+# fails the gate (a refactor silently losing the speculation block
+# would otherwise pass with one fewer number). Artifacts predating
+# the key's introduction compare clean — same arming rule as
+# TRACKED_CONFIGS, applied one level down.
+TRACKED_DECOMP_KEYS = {"5": ("speculation",),
+                       "7_frontend": ("speculation",)}
+
 # absolute vs_baseline floors: once a config's LINEAGE has cleared
 # the bar (old side >= floor), no new run may fall back under it —
 # even via a slow creep of individually-within-threshold drops. The
@@ -130,12 +142,22 @@ def compare(old, new, threshold, per_config, require, floors=None):
             regressed = nb < ob * (1.0 - thr)
             below_floor = floor is not None and ob >= float(floor) \
                 and nb < float(floor)
+            # decomposition-key vanish gate: armed per key once the
+            # old row publishes it (pre-introduction rows arm nothing)
+            lost = [dk for dk in TRACKED_DECOMP_KEYS.get(key, ())
+                    if dk in (o.get("decomposition") or {})
+                    and dk not in (n.get("decomposition") or {})]
             row.update(old=ob, new=nb, delta=delta,
                        status="REGRESSION" if regressed
-                       else "BELOW-FLOOR" if below_floor else "ok",
+                       else "BELOW-FLOOR" if below_floor
+                       else "MISSING-DECOMP" if lost else "ok",
                        metric=(n.get("metric") or ""))
             if floor is not None:
                 row["floor"] = float(floor)
+            if lost:
+                row["note"] = "decomposition lost: " + ", ".join(lost)
+                missing.extend(f"{key}.decomposition.{dk}"
+                               for dk in lost)
             if regressed or below_floor:
                 regressions.append(key)
         rows.append(row)
@@ -147,10 +169,11 @@ def render(rows):
            f"{'thr':>6}  status"]
     for r in rows:
         if "old" in r:
+            note = f" ({r['note']})" if r.get("note") else ""
             out.append(
                 f"{r['config']:<12} {r['old']:>9.4f} {r['new']:>9.4f} "
                 f"{r['delta']:>+7.1%} {r['threshold']:>6.0%}  "
-                f"{r['status']}")
+                f"{r['status']}{note}")
         else:
             out.append(f"{r['config']:<12} {'-':>9} {'-':>9} {'-':>8} "
                        f"{r['threshold']:>6.0%}  {r['status']} "
