@@ -718,19 +718,23 @@ def bench_config7():
     }
 
 
-def bench_config8(tiny=False):
+def bench_config8(tiny=False, transport="loopback"):
     """Fleet serving over 3 data-parallel replicas (ISSUE 11): the
     config-7 open-world Poisson shared-prefix arrival mix routed
     through ``FleetRouter`` (prefix-affinity scoring) instead of one
     front-end. Metric = sustained FLEET tok/s over the open-world
     window, normalized against 3x the config-5/7 1000 tok/s/chip bar;
     the decomposition publishes the fleet report head — router totals,
-    per-replica load/recompile counters, and the CROSS-REPLICA prefix
+    per-replica load/recompile counters, the CROSS-REPLICA prefix
     hit rate (the number affinity routing exists to move: shared-
-    prompt traffic must hit the trie fleet-wide, not per process).
-    ``tiny=True`` shrinks the model/engine shapes for the local
-    logic-validation run (standing constraint (b): full-size numbers
-    need the accelerator box)."""
+    prompt traffic must hit the trie fleet-wide, not per process) —
+    and, since the fleet-transport PR, the TRANSPORT block (rpcs,
+    retries, timeouts, reconnects, bytes, probe latency): the RPC tax
+    the loopback default keeps near zero and ``transport="socket"``
+    (one OS process per replica, ``--transport socket``, tiny-only)
+    prices for real. ``tiny=True`` shrinks the model/engine shapes
+    for the local logic-validation run (standing constraint (b):
+    full-size numbers need the accelerator box)."""
     import dataclasses
 
     import jax
@@ -769,7 +773,24 @@ def bench_config8(tiny=False):
     def engine_factory(slot):
         return InferenceEngineV2(params, cfg, eng_cfg)
 
-    router = FleetRouter(engine_factory, {"fleet": {"n_replicas": R}})
+    fleet_cfg = {"n_replicas": R}
+    if transport == "socket":
+        if not tiny:
+            # the full-size bench params are shape-only zeros built
+            # in THIS process; a worker process cannot rebuild them —
+            # only the tiny built-in worker factory crosses the wire
+            raise ValueError("--transport socket requires --tiny")
+        fleet_cfg["transport"] = {
+            "channel": "socket",
+            # the built-in tiny-llama worker factory, pinned to the
+            # bench engine geometry (geometry must match fleet-wide)
+            "worker_args": {"engine": dict(
+                token_budget=budget, max_ragged_sequence_count=B,
+                max_tracked_sequences=4 * B, n_kv_blocks=4 * B + 12,
+                kv_block_size=block, max_blocks_per_seq=per_seq,
+                kv_dtype=kv_dtype, prefix_cache=True)},
+        }
+    router = FleetRouter(engine_factory, {"fleet": fleet_cfg})
 
     rng = np.random.default_rng(8)
     vocab = cfg.vocab_size
@@ -833,6 +854,13 @@ def bench_config8(tiny=False):
             "prefix": rep["prefix"],
             "router": rep["router"],
             "per_replica": per_replica,
+            # the RPC tax: near-zero on loopback, priced for real
+            # over --transport socket (tracked by the lineage gate)
+            "transport": {
+                k: rep["transport"][k]
+                for k in ("channel", "rpcs", "retries", "timeouts",
+                          "reconnects", "bytes_sent", "bytes_recv",
+                          "probes", "probe_latency_ms")},
             "memory": _memory_decomposition(
                 memory_gauges(include_arrays=False)),
         },
@@ -853,18 +881,30 @@ def main():
     p.add_argument("--tiny", action="store_true",
                    help="tiny-shape logic validation (config 8_fleet "
                         "only; never an artifact row)")
+    p.add_argument("--transport", choices=["loopback", "socket"],
+                   default="loopback",
+                   help="fleet channel for config 8_fleet: loopback "
+                        "(in-process, default) or socket (one OS "
+                        "process per replica; requires --tiny)")
     args = p.parse_args()
     if args.tiny and args.config != "8_fleet":
         # a tiny-shape row must never land in an artifact lineage the
         # gate compares against real hardware numbers
         p.error("--tiny is only valid with --config 8_fleet "
                 "(local logic validation, never an artifact row)")
+    if args.transport != "loopback" and \
+            (args.config != "8_fleet" or not args.tiny):
+        p.error("--transport socket is only valid with "
+                "--config 8_fleet --tiny (worker processes rebuild "
+                "the tiny built-in engine; full-size rows stay "
+                "loopback)")
     fns = {"1": bench_config1, "2": bench_config2, "3": bench_config3,
            "4": bench_config4, "5": bench_config5,
            "5_int8": lambda: bench_config5(weight_dtype="int8"),
            "5_int4": lambda: bench_config5(weight_dtype="int4"),
            "6_recovery": bench_config6, "7_frontend": bench_config7,
-           "8_fleet": lambda: bench_config8(tiny=args.tiny)}
+           "8_fleet": lambda: bench_config8(tiny=args.tiny,
+                                            transport=args.transport)}
     if args.config != "0":
         print(json.dumps(fns[args.config]()))
         return

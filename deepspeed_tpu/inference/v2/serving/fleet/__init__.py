@@ -1,18 +1,34 @@
-"""Fleet serving: a data-parallel replica router over N
-``ServingFrontend``s with prefix-affinity load balancing and elastic
-replica recovery (README "Fleet serving"; the deployment tier of
-PAPER.md layer 7 — MII/FastGen persistent deployments multiplex
-request traffic over engine replicas)."""
+"""Fleet serving: a data-parallel replica router over N replicas with
+prefix-affinity load balancing and elastic replica recovery (README
+"Fleet serving"; the deployment tier of PAPER.md layer 7 — MII/FastGen
+persistent deployments multiplex request traffic over engine
+replicas). Replicas live behind a real RPC boundary
+(``transport.py``): in-process over ``LoopbackChannel`` by default,
+one OS process each over ``SocketChannel``
+(``serving.fleet.transport.channel = "socket"``)."""
 
 from .elastic import FleetRecoveryEvent, FleetSupervisor
 from .replica import Replica
 from .router import FleetRouter, RoundRobinPolicy, ScoringPolicy
+from .transport import (FaultyChannel, HealthProber, LoopbackChannel,
+                        RpcClient, SocketChannel, TransportError,
+                        TransportTimeout)
+from .worker import WorkerCore, tiny_llama_factory
 
 __all__ = [
+    "FaultyChannel",
     "FleetRecoveryEvent",
     "FleetRouter",
     "FleetSupervisor",
+    "HealthProber",
+    "LoopbackChannel",
     "Replica",
     "RoundRobinPolicy",
+    "RpcClient",
     "ScoringPolicy",
+    "SocketChannel",
+    "TransportError",
+    "TransportTimeout",
+    "WorkerCore",
+    "tiny_llama_factory",
 ]

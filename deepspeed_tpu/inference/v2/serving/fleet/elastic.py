@@ -108,9 +108,12 @@ class FleetSupervisor:
         self.requeued += len(uids)
         respawned = False
         if self.config.respawn:
-            router._respawn(slot, step)
-            respawned = True
-            self.respawns += 1
+            # over a real transport the respawn itself can fail (the
+            # new worker never answers HELLO): the pool stays shrunk
+            # and the router's typed alert records it
+            respawned = router._respawn(slot, step)
+            if respawned:
+                self.respawns += 1
         mttr = self._clock() - t0
         self._mttr_s.append(mttr)
         event = FleetRecoveryEvent(

@@ -1,74 +1,126 @@
-"""One data-parallel serving replica: a ``ServingFrontend`` + engine
-with the health surface the fleet router balances and recovers on.
+"""One data-parallel serving replica, reached THROUGH the transport.
 
-A replica adds three things the bare front-end does not have:
+PR 11's ``Replica`` held its ``ServingFrontend`` as a plain attribute
+and the router called methods on it. This version puts the fleet
+transport (transport.py) between them: the replica owns a channel
+(``LoopbackChannel`` in-process by default, ``SocketChannel`` one OS
+process per replica) wrapped in a ``FaultyChannel`` and an
+``RpcClient``, and every router-facing operation is a real RPC —
+SUBMIT / CANCEL / STEP / TOKENS / SNAPSHOT / HEARTBEAT — with a
+deadline, a retry budget, and typed terminal errors.
 
-* **a cheap ``snapshot()``** — queue depth, KV utilization and
-  prefix-cache counters for the router's per-step scoring pass, drawn
-  from ``ServingMetrics.quick_stats()`` (no-allocation) plus direct
-  attribute reads off the prefix trie — never the full
-  ``get_serving_report()`` percentile build;
-* **a liveness surface** — ``step()`` returns ``(stepped,
-  progressed)`` so the router can feed the fleet's
-  ``HeartbeatMonitor`` ledger (silence = hang, beats without progress
-  = slow), and a dead replica's dispatch raises a typed
-  ``WorkerFailureError`` (the health-gate / typed-dispatch-failure
-  detector);
-* **the ``fleet.dispatch`` fault site** — replica death is
-  simulatable on one process through the standard injector grammar:
-  ``fleet.dispatch:kill@5`` kills the replica polled at ordinal 5.
-  One ``consume()`` per replica SLOT per router step — ordinal =
-  ``step * n_replicas + slot`` (the pg_sim placement rule, so a
-  drill's fault lands on the same (replica, step) regardless of
-  earlier kills). Kinds map to the three serving failure modes:
-  ``kill`` -> permanent death, ``hang`` -> silence for ``~arg`` steps
-  (no step, no beat), ``slow`` -> beats without progressing for
-  ``~arg`` steps.
+The router-facing contract keeps its three pillars:
+
+* **a cheap ``snapshot()``** — now the LAST WORKER-REPORTED health
+  snapshot (it rides every STEP reply), merged with router-side
+  liveness; the scoring pass reads replica memory on no channel;
+* **a liveness surface** — ``step(cursors)`` returns the STEP reply
+  (token tails past the router's cursors, request states, TRIE_DELTA,
+  snapshot) or ``None`` for silence: a transport-lost STEP is a missed
+  heartbeat, not an instant death, so the existing ``HeartbeatMonitor``
+  ledger and the new ``HealthProber`` decide together. A dead
+  replica's dispatch raises the same typed ``WorkerFailureError`` the
+  FleetSupervisor ladder already keys on;
+* **the ``fleet.dispatch`` fault site** — unchanged grammar and
+  ordinal discipline (``step * n_replicas + slot``), kinds kill /
+  hang / slow. ``kill`` now also CLOSES the channel — on the socket
+  channel that terminates the worker process for real. Channel-level
+  chaos (drop/dup/reorder/...) lives at the ``transport.*`` sites
+  inside ``FaultyChannel``, not here.
 """
 
 import time
-from typing import Callable, Tuple
+from typing import Optional
 
-from .....resilience.errors import WorkerFailureError
+import numpy as np
+
+from .....resilience.errors import (InjectedFault, TransportError,
+                                    WorkerFailureError)
 from .....resilience.fault_injector import fault_injector
+from .....telemetry.trace import span
 from .....utils.logging import logger
+from .transport import (MSG_CANCEL, MSG_HEARTBEAT, MSG_HELLO,
+                        MSG_SNAPSHOT, MSG_STEP, MSG_SUBMIT, MSG_TOKENS,
+                        FaultyChannel, HealthProber, RpcClient,
+                        TransportStats)
+from .worker import sampling_to_wire
 
 _FOREVER = float("inf")
 
 
 class Replica:
-    """Slot-addressed wrapper over one ``ServingFrontend``.
+    """Slot-addressed RPC proxy for one fleet worker.
 
-    ``frontend_factory(slot)`` builds the front-end (and its engine);
-    the supervisor calls it again on respawn, so everything a fresh
-    replica needs must come from the factory — a respawned replica
-    starts with an empty KV pool and an empty prefix trie, exactly
-    like a restarted process."""
+    ``channel_factory(slot) -> Channel`` builds the transport leg (the
+    router provides it: loopback wraps a fresh ``WorkerCore`` +
+    frontend, socket spawns a worker process); respawn calls it again,
+    so a respawned replica starts with a fresh channel, a fresh worker
+    and an empty trie — exactly like a restarted process, and stale
+    in-flight frames can never cross generations."""
 
-    def __init__(self, slot: int, frontend_factory: Callable,
+    def __init__(self, slot: int, channel_factory, transport_cfg,
                  clock=time.perf_counter):
         self.slot = int(slot)
-        self._factory = frontend_factory
+        self._factory = channel_factory
+        self._tcfg = transport_cfg
         self._clock = clock
-        self.frontend = frontend_factory(self.slot)
+        self.stats = TransportStats()
+        self.prober = HealthProber()
         self.generation = 1
-        # simulation truth: False once killed/quarantined. The router
-        # must NOT branch on this directly (a real router cannot read
-        # a remote replica's memory) — its view of death comes through
-        # the HEALTH SURFACE this flag simulates: ``snapshot()``
-        # returns alive=False (a failed health probe), dispatch
-        # (``submit()``/``cancel()``/``step()``) raises the typed
-        # ``WorkerFailureError`` a failed RPC would, and a hung
-        # replica is silent on the heartbeat ledger. Direct reads are
-        # reserved for the reporting surfaces.
         self.alive = True
         self.deaths = 0
         self._hang_left = 0.0
         self._slow_left = 0.0
+        self.hello: dict = {}
+        self.last_snapshot: dict = {}
+        self._channel: Optional[FaultyChannel] = None
+        self._rpc: Optional[RpcClient] = None
+        self._connect()
+
+    def _connect(self) -> None:
+        ch = FaultyChannel(self._factory(self.slot), self.slot)
+        ch.connect()
+        self._channel = ch
+        self._rpc = RpcClient(ch, self.slot, self._tcfg,
+                              stats=self.stats)
+        # HELLO under the connect deadline: geometry (kv_block_size),
+        # the full trie listing + seq, and the first health snapshot
+        self.hello = self._rpc.call(
+            MSG_HELLO,
+            deadline_s=float(self._tcfg.connect_deadline_seconds))
+        self.last_snapshot = self.hello.get("snapshot") or {}
+
+    # -- passthroughs (loopback-only introspection) --------------------
+    @property
+    def channel(self) -> Optional[FaultyChannel]:
+        return self._channel
+
+    @property
+    def frontend(self):
+        """The worker's in-process frontend on the loopback channel;
+        ``None`` over a socket (a real router cannot reach into a
+        worker process — reporting must ride the protocol)."""
+        if self._channel is None:
+            return None
+        core = getattr(self._channel.inner, "core", None)
+        return core.frontend if core is not None else None
 
     @property
     def engine(self):
-        return self.frontend.engine
+        fe = self.frontend
+        return fe.engine if fe is not None else None
+
+    @property
+    def kv_block_size(self) -> Optional[int]:
+        return self.hello.get("kv_block_size")
+
+    @property
+    def idle(self) -> bool:
+        fe = self.frontend
+        if fe is not None:
+            return fe.idle
+        return int((self.last_snapshot or {}).get("outstanding", 0)) \
+            == 0
 
     # -- fault surface -------------------------------------------------
     def poll_fault(self) -> None:
@@ -90,98 +142,188 @@ class Replica:
             self.kill(f"injected {spec.kind}")
 
     def kill(self, reason: str = "") -> None:
-        """Simulated replica death (also the quarantine path for a
-        detected hang/slow zombie: once replaced it must never rejoin
-        on its own). Idempotent."""
+        """Replica death (also the quarantine path for a detected
+        hang/slow zombie: once replaced it must never rejoin on its
+        own). Closes the channel — over a socket that terminates the
+        worker PROCESS. Idempotent."""
         if not self.alive:
             return
         self.alive = False
         self.deaths += 1
         self._hang_left = self._slow_left = 0.0
+        if self._channel is not None:
+            try:
+                self._channel.close()
+            except OSError:
+                pass
         logger.warning(f"fleet replica {self.slot} died"
                        + (f": {reason}" if reason else ""))
 
     def respawn(self) -> None:
-        """Rebuild the front-end + engine through the factory and
-        rejoin: fresh KV pool, empty prefix trie, generation bumped."""
-        self.frontend = self._factory(self.slot)
+        """Fresh channel, fresh worker (the factory again), generation
+        bumped: empty KV pool, empty trie, empty reply cache — and any
+        frame still in flight from the old generation died with the
+        old channel. Raises typed (``TransportConnectError`` /
+        ``TransportTimeout``) when the new worker cannot be reached —
+        the supervisor counts the respawn only on success."""
+        if self._channel is not None:
+            try:
+                self._channel.close()
+            except OSError:
+                pass
         self.generation += 1
+        self._connect()
         self.alive = True
         self._hang_left = self._slow_left = 0.0
+        self.prober.reset()
+        self.stats.reconnects += 1
+
+    # -- the RPC seam ---------------------------------------------------
+    def _call(self, kind: str, payload: Optional[dict] = None,
+              **kw) -> dict:
+        if not self.alive:
+            raise WorkerFailureError(self.slot, "kill",
+                                     "replica is dead")
+        try:
+            return self._rpc.call(kind, payload, **kw)
+        except InjectedFault as e:
+            # a hard injected transport error (kind "error"): the
+            # channel is broken, not merely lossy
+            raise WorkerFailureError(
+                self.slot, "error", f"transport fault: {e}") from e
 
     # -- the dispatch surface ------------------------------------------
-    def submit(self, *args, **kwargs):
-        """One submit dispatched to this replica — the simulated RPC:
-        on a dead replica it raises the typed ``WorkerFailureError`` a
-        failed remote call would surface as, never silently reaching
-        the (in-process) front-end object."""
-        if not self.alive:
-            raise WorkerFailureError(self.slot, "kill",
-                                     "replica is dead")
-        return self.frontend.submit(*args, **kwargs)
+    def submit(self, prompt, *, uid: int,
+               max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None, sampling=None,
+               priority: int = 0,
+               deadline_ms: Optional[float] = None):
+        """One SUBMIT RPC. Typed replica-side refusals
+        (``ServingOverloadError`` et al.) come back re-raised; an
+        exhausted transport budget surfaces as the same typed
+        ``WorkerFailureError`` a dead dispatch raises, so the router's
+        next-candidate / supervisor paths need no new branches. Token
+        delivery does NOT ride a callback — tails ride STEP replies
+        against the router's cursors."""
+        payload = {
+            "uid": int(uid),
+            "prompt": [int(t) for t in
+                       np.asarray(prompt, np.int32).reshape(-1)],
+            "max_new_tokens": max_new_tokens,
+            "eos_token_id": eos_token_id,
+            "sampling": sampling_to_wire(sampling),
+            "priority": int(priority),
+            "deadline_ms": deadline_ms,
+        }
+        try:
+            return self._call(MSG_SUBMIT, payload)
+        except TransportError as e:
+            raise WorkerFailureError(
+                self.slot, "error",
+                f"submit transport failure: {e}") from e
 
     def cancel(self, uid: int):
-        """One cancel dispatched to this replica (same typed-failure
-        contract as ``submit``)."""
-        if not self.alive:
-            raise WorkerFailureError(self.slot, "kill",
-                                     "replica is dead")
-        return self.frontend.cancel(uid)
+        """One CANCEL RPC (same typed contract as ``submit``)."""
+        try:
+            return self._call(MSG_CANCEL, {"uid": int(uid)})
+        except TransportError as e:
+            raise WorkerFailureError(
+                self.slot, "error",
+                f"cancel transport failure: {e}") from e
+
+    def fetch_tokens(self, cursors: dict) -> dict:
+        """One read-only TOKENS RPC: tails + states past ``cursors``
+        WITHOUT stepping — the cancel-race drain."""
+        try:
+            return self._call(MSG_TOKENS,
+                              {"cursors": dict(cursors)})
+        except TransportError as e:
+            raise WorkerFailureError(
+                self.slot, "error",
+                f"tokens transport failure: {e}") from e
 
     # -- the supervised step -------------------------------------------
-    def step(self) -> Tuple[bool, bool]:
-        """One front-end step under the simulated fault state ->
-        ``(stepped, progressed)`` for the heartbeat ledger. A dead
-        replica raises the typed ``WorkerFailureError`` (what a failed
-        RPC to a dead process surfaces as); a hung one is SILENT
-        (``(False, False)`` — no beat); a slow one beats without
-        progressing (``(True, False)``)."""
+    def step(self, cursors: Optional[dict] = None) -> Optional[dict]:
+        """One STEP RPC -> the reply dict (``progressed``, token
+        tails, states, TRIE_DELTA, snapshot), or ``None`` for SILENCE
+        (hang, or the whole retry budget lost to the channel — a
+        missed heartbeat the ledger escalates, not an instant death).
+        A dead replica raises the typed ``WorkerFailureError``; a slow
+        one beats without progressing (a synthetic no-RPC reply)."""
         if not self.alive:
             raise WorkerFailureError(self.slot, "kill",
                                      "replica is dead")
         if self._hang_left > 0:
             self._hang_left -= 1
-            return False, False
+            return None
         if self._slow_left > 0:
             self._slow_left -= 1
-            return True, False
-        self.frontend.step()
-        return True, True
+            return {"kind": "STEP_OK", "progressed": False}
+        try:
+            return self._call(MSG_STEP,
+                              {"cursors": dict(cursors or {})})
+        except TransportError as e:
+            logger.warning(f"fleet replica {self.slot} STEP lost to "
+                           f"the transport: {e}")
+            return None
+
+    # -- health ---------------------------------------------------------
+    def probe(self) -> Optional[str]:
+        """One HEARTBEAT round-trip under the (short) probe deadline,
+        retries=0 — a failure IS the signal. Returns ``"ok"``,
+        ``"recovered"`` (first success after a failure streak: the
+        router resyncs the trie view) or ``"failed"``; ``None`` on a
+        dead replica (the supervisor already owns it)."""
+        if not self.alive:
+            return None
+        if self._hang_left <= 0:
+            t0 = time.monotonic()
+            try:
+                with span("transport.probe", slot=self.slot):
+                    self._call(
+                        MSG_HEARTBEAT,
+                        deadline_s=float(
+                            self._tcfg.probe_deadline_seconds),
+                        retries=0)
+                lat = time.monotonic() - t0
+                self.stats.probes += 1
+                self.stats.probe_latencies.append(lat)
+                if self.prober.ok(lat):
+                    self.stats.reconnects += 1
+                    return "recovered"
+                return "ok"
+            except (TransportError, WorkerFailureError):
+                pass
+        self.stats.probes += 1
+        self.stats.probe_failures += 1
+        self.prober.fail()
+        return "failed"
+
+    def resync(self) -> dict:
+        """One SNAPSHOT RPC: the full trie listing + seq baseline the
+        router rebuilds this slot's affinity view from after a
+        reconnect or a delta gap."""
+        try:
+            return self._call(MSG_SNAPSHOT)
+        except TransportError as e:
+            raise WorkerFailureError(
+                self.slot, "error",
+                f"resync transport failure: {e}") from e
 
     # -- the scoring surface -------------------------------------------
     def snapshot(self) -> dict:
-        """Polling-cheap health/load view for the router's scoring
-        pass: live queue/active gauges (O(1) properties), the
-        metrics' ``quick_stats()`` step counters, and the prefix
-        trie's counters read as plain attributes — NO percentile
-        sorts, no report build. Called once per replica per routed
-        request, so it must stay near-free (the perf smoke in
-        tests/unit/inference/serving/fleet/ holds it under 1% of a
-        steady decode step)."""
-        fe = self.frontend
-        if not self.alive or fe is None:
+        """The router's health/load view: the last WORKER-REPORTED
+        snapshot (it rides every STEP reply — the router never peeks
+        replica memory) merged with router-side liveness and the
+        prober's suspect verdict. Near-free: a dict copy, no RPC (the
+        perf smoke in tests/unit/inference/serving/fleet/ holds it
+        under 1% of a steady decode step)."""
+        if not self.alive:
             return {"alive": False, "slot": self.slot,
                     "generation": self.generation}
-        q = fe.metrics.quick_stats()
-        eng = fe.engine
-        snap = {
-            "alive": True,
-            "slot": self.slot,
-            "generation": self.generation,
-            "queued": fe.queued_requests,
-            "active": fe.active_requests,
-            "outstanding": fe.queued_requests + fe.active_requests,
-            "capacity": eng._config.max_ragged_sequence_count,
-            "kv_util": eng.kv_utilization,
-            "steps": q["steps"],
-            "tokens_emitted": q["tokens_emitted"],
-            "recompiles": q["recompiles"],
-            "blocking_syncs": q["blocking_syncs"],
-        }
-        pc = eng.prefix_cache
-        if pc is not None:
-            snap["prefix_hits"] = pc.hits
-            snap["prefix_misses"] = pc.misses
-            snap["prefix_tokens_reused"] = pc.tokens_reused
-            snap["prefix_cached_blocks"] = pc.cached_blocks
+        snap = dict(self.last_snapshot)
+        snap["alive"] = True
+        snap["slot"] = self.slot
+        snap["generation"] = self.generation
+        snap["suspect"] = self.prober.suspect
         return snap

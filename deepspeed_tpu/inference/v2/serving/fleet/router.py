@@ -1,42 +1,50 @@
-"""FleetRouter — the data-parallel replica router.
+"""FleetRouter — the data-parallel replica router, over the transport.
 
-One router fans request traffic out over N ``Replica``s (each a
-``ServingFrontend`` + engine), mirroring the front-end's own surface
-(``submit() / cancel() / stream() / step() / serve()``) so a server
-written against one frontend scales to a fleet by swapping the object.
+One router fans request traffic out over N ``Replica``s, mirroring the
+front-end's own surface (``submit() / cancel() / stream() / step() /
+serve()``) so a server written against one frontend scales to a fleet
+by swapping the object. Since the fleet-transport PR every
+router<->replica interaction is a real RPC over a failable channel
+(``serving.fleet.transport.channel``: in-process loopback by default,
+one OS process per replica over localhost sockets) — the router's
+knowledge of each replica is exactly what arrived in replies.
 
-**Placement** is a scoring pass over the alive replicas::
+**Placement** is a scoring pass over the pooled replicas::
 
     score = affinity_weight * (matched prefix blocks / prompt blocks)
           - queue_weight    * (outstanding / capacity)
           - kv_weight       * kv_utilization
 
-where *matched prefix blocks* comes from the router's own block-hash
--> replica map, keyed by the SAME chained blake2b digests as each
-replica's prefix trie (``serving/prefix.py chain_digests``) — so
-shared-prompt traffic lands where its KV prefix is already cached and
-the trie hits across the fleet instead of one process. Requests are
-STICKY after placement: cancel/stream route to the placed replica
-(and the placement survives in the router's map even while the
-replica's answer is in flight).
+where *matched prefix blocks* comes from the router's block-hash ->
+replica map — keyed by the SAME chained blake2b digests as each
+replica's prefix trie (``serving/prefix.py chain_digests``) and fed by
+the replicas' own TRIE_DELTA reports riding STEP replies. The map
+mirrors each trie's ACTUAL contents: a replica-side LRU eviction
+arrives as a delete, so affinity never pulls traffic at KV that is no
+longer there (the stale-affinity bug the delta feed replaced the old
+placement-time writes to fix). Requests are STICKY after placement.
 
-**Admission composes**: each replica keeps its own gate (SLO /
-deadline / capacity — PR 9's ``AdmissionGate``); the router only adds
-the fleet dimension. When every alive replica refuses a submit, the
-router sheds or raises a typed ``ServingOverloadError`` carrying the
-aggregated fleet view (``.fleet_view``: per-replica snapshots).
+**Degraded mode**: a per-replica health prober (HEARTBEAT round-trips
+under a short deadline, no retries) marks a replica SUSPECT on its
+first failed probe — suspects drop to the back of the placement order
+(new traffic prefers reachable survivors; they keep stepping) — and a
+failure streak past ``probe_fail_threshold`` is the router's partition
+verdict, handled by the same supervisor ladder as a death. A probe
+success after failures is a RECONNECT: the router resyncs that
+replica's affinity view from a full SNAPSHOT, then deltas resume;
+reconnect storms raise a ``transport_flap`` alert. When every
+candidate refuses a submit the typed ``ServingOverloadError`` carries
+the fleet view WITH per-replica transport health.
 
-**Elastic recovery** is the ``FleetSupervisor``'s job (elastic.py):
-on a detected failure, the dead replica's in-flight requests are
-requeued onto survivors, where they replay BITWISE (sampling keys are
-``fold_in(fold_in(seed, uid), position)``), and the router's
-delivered-token cursor suppresses the replayed prefix so every
-``TokenStream`` resumes gap-free and duplicate-free.
+**Elastic recovery** is unchanged in shape (``FleetSupervisor``:
+requeue-then-respawn, bitwise replay, delivered-token cursor): only
+the failure sources became real — typed dispatch failures now include
+exhausted transport budgets, and a respawn builds a fresh channel (and
+worker process, on sockets), so it can FAIL typed and the pool shrinks
+honestly.
 
-Single-threaded like the front-end: ``step()`` polls fault sites,
-steps every pooled replica once, feeds the heartbeat ledger, syncs
-request states, runs the supervisor sweep and retries the requeue
-backlog. Deterministic by construction — every test replays.
+Single-threaded like the front-end; deterministic by construction on
+the loopback channel — every drill replays.
 """
 
 import time
@@ -48,6 +56,7 @@ import numpy as np
 from .....resilience.errors import (CollectiveTimeout,
                                     ServingOverloadError,
                                     TerminalRequestError,
+                                    TransportError,
                                     UnknownRequestError,
                                     WorkerFailureError)
 from .....runtime.lifecycle import BoundedCache
@@ -60,6 +69,8 @@ from ..prefix import chain_digests
 from ..request import Request, RequestState, TokenStream
 from .elastic import FleetSupervisor
 from .replica import Replica
+from .transport import (LoopbackChannel, SocketChannel,
+                        probe_percentiles_ms)
 
 
 class ScoringPolicy:
@@ -119,13 +130,17 @@ class FleetRouter:
                  n_replicas: Optional[int] = None, policy=None,
                  clock=time.perf_counter):
         """``engine_factory(slot) -> InferenceEngineV2`` builds one
-        replica's engine (and is called again on respawn — replicas
-        must be rebuildable from scratch). All replicas must share
-        engine geometry (same factory, same config): the affinity map
-        assumes one ``kv_block_size`` fleet-wide."""
+        replica's engine ON THE LOOPBACK CHANNEL (and is called again
+        on respawn — replicas must be rebuildable from scratch). Over
+        sockets the worker PROCESS builds its own engine from
+        ``serving.fleet.transport.worker_factory`` / ``worker_args``
+        (the built-in deterministic tiny-llama when empty). All
+        replicas must share engine geometry: the affinity map assumes
+        one ``kv_block_size`` fleet-wide (taken from HELLO)."""
         import dataclasses as _dc
         self.config = cfg = _normalize_config(config)
         fc = self.config.fleet
+        self._transport_cfg = tc = fc.transport
         self._clock = clock
         n = int(fc.n_replicas if n_replicas is None else n_replicas)
         if n < 1:
@@ -133,6 +148,9 @@ class FleetRouter:
         if cfg.on_overload not in ("raise", "shed"):
             raise ValueError(f"serving.on_overload must be raise/shed, "
                              f"got {cfg.on_overload!r}")
+        if tc.channel not in ("loopback", "socket"):
+            raise ValueError(f"serving.fleet.transport.channel must be "
+                             f"loopback/socket, got {tc.channel!r}")
         if policy is None:
             if fc.policy == "affinity":
                 policy = ScoringPolicy(fc.affinity_weight,
@@ -150,7 +168,8 @@ class FleetRouter:
         # replica that silently shed a routed request would corrupt
         # the router's placement bookkeeping
         self._replica_cfg = _dc.replace(cfg, on_overload="raise")
-        self._replicas = [Replica(slot, self._frontend_factory, clock)
+        self._replicas = [Replica(slot, self._channel_factory, tc,
+                                  clock)
                           for slot in range(n)]
         self._pool: Set[int] = set(range(n))  # the router's view
         from .....resilience.watchdog import HeartbeatMonitor
@@ -160,14 +179,17 @@ class FleetRouter:
             progress_timeout_steps=fc.progress_timeout_steps)
         self._supervisor = FleetSupervisor(self, self._monitor, fc,
                                            clock=clock)
-        # block-hash -> slot, same chained blake2b keys as the trie;
-        # LRU-bounded (the PR-6 rule: nothing grows for process
-        # lifetime)
+        # block-hash -> slot, same chained blake2b keys as the tries;
+        # fed EXCLUSIVELY by replica-reported TRIE_DELTA / SNAPSHOT
+        # (never by placement-time guesses); LRU-bounded (the PR-6
+        # rule: nothing grows for process lifetime)
         self._affinity_map = BoundedCache(
             "fleet_affinity_map",
             max_entries=max(1, int(fc.affinity_map_entries)))
-        self._block_size = \
-            self._replicas[0].engine._config.kv_block_size
+        self._trie_seqs = {rep.slot: int(rep.hello.get("trie_seq", 0))
+                           for rep in self._replicas}
+        self._block_size = int(self._replicas[0].kv_block_size
+                               or self.config.prefix.max_blocks or 8)
         # request bookkeeping
         self._entries: Dict[int, _FleetEntry] = {}
         self._placed: Dict[int, Set[int]] = {s: set() for s in range(n)}
@@ -176,6 +198,9 @@ class FleetRouter:
         self._next_uid = 1
         self._step_idx = 0
         self._imbalanced = False
+        # transport health bookkeeping
+        self._reconnect_steps: deque = deque(maxlen=256)
+        self._last_flap_alert = -(10 ** 9)
         # fleet totals
         self.submitted = 0
         self.finished = 0
@@ -191,6 +216,16 @@ class FleetRouter:
         return ServingFrontend(self._engine_factory(slot),
                                self._replica_cfg, clock=self._clock)
 
+    def _channel_factory(self, slot: int):
+        tc = self._transport_cfg
+        if tc.channel == "socket":
+            from .worker import make_connector
+            return SocketChannel(make_connector(
+                slot, tc, self._replica_cfg.to_dict()))
+        from .worker import WorkerCore
+        return LoopbackChannel(
+            WorkerCore(slot, self._frontend_factory(slot)))
+
     # -- telemetry ------------------------------------------------------
     def _note_alert(self, alert) -> None:
         self.alerts.append(alert)
@@ -199,9 +234,9 @@ class FleetRouter:
 
     def attach_telemetry(self, hub, namespace: str = "fleet"):
         """Register the fleet snapshot (per-replica scalars + router
-        totals) on a ``TelemetryHub`` and route fleet
-        ``TelemetryAlert``s (replica death / rebalance / imbalance)
-        into its alert log."""
+        totals + the transport block) on a ``TelemetryHub`` and route
+        fleet ``TelemetryAlert``s (replica death / rebalance /
+        imbalance / transport flap) into its alert log."""
         hub.register(namespace, self._telemetry_snapshot)
         self._hub = hub
         return hub
@@ -210,7 +245,8 @@ class FleetRouter:
         reps = {f"r{rep.slot}": rep.snapshot()
                 for rep in self._replicas}
         return {"replicas": reps, "router": self._router_stats(),
-                "prefix": self._fleet_prefix_stats()}
+                "prefix": self._fleet_prefix_stats(),
+                "transport": self._transport_stats()}
 
     # -- introspection --------------------------------------------------
     @property
@@ -231,8 +267,7 @@ class FleetRouter:
             return False
         if any(not e.req.done for e in self._entries.values()):
             return False
-        return all(self._replicas[s].frontend.idle
-                   for s in self._pool)
+        return all(self._replicas[s].idle for s in self._pool)
 
     def spec_for(self, slot: int, step: int, mode: str,
                  duration: Optional[float] = None) -> str:
@@ -258,10 +293,10 @@ class FleetRouter:
         """Queue-and-place one request; returns the ROUTER's live
         ``Request`` handle (tokens accumulate here across requeues).
         Placement is immediate (scoring pass + the chosen replica's
-        submit); when every alive replica refuses, the router raises a
-        typed ``ServingOverloadError`` with the fleet view attached
-        (``serving.on_overload = "raise"``) or returns the request
-        already SHED (``"shed"``)."""
+        SUBMIT RPC); when every pooled replica refuses, the router
+        raises a typed ``ServingOverloadError`` with the fleet view
+        (incl. transport health) attached (``serving.on_overload =
+        "raise"``) or returns the request already SHED (``"shed"``)."""
         cfg = self.config
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
@@ -339,9 +374,10 @@ class FleetRouter:
             try:
                 self._replicas[slot].cancel(uid)
             except TerminalRequestError:
-                # finished while routing: the buffered tokens are the
-                # complete answer — surface that, not a cancel
-                self._sync_replica(slot)
+                # finished while routing: drain the final tokens with
+                # a read-only TOKENS RPC — the buffered stream is the
+                # complete answer; surface that, not a cancel
+                self._drain_uid(slot, uid)
                 raise TerminalRequestError(uid, e.req.state.name) \
                     from None
             except (UnknownRequestError, WorkerFailureError):
@@ -357,6 +393,20 @@ class FleetRouter:
         self._finish(e, RequestState.CANCELLED)
         self.cancelled += 1
         return True
+
+    def _drain_uid(self, slot: int, uid: int) -> None:
+        """Pull one uid's remaining tail + terminal state off its
+        replica without stepping (the cancel-race close-out)."""
+        e = self._entries.get(uid)
+        if e is None:
+            return
+        try:
+            reply = self._replicas[slot].fetch_tokens(
+                {str(uid): e.seen})
+        except WorkerFailureError:
+            return
+        self._deliver_tokens(slot, reply.get("tokens") or {})
+        self._sync_states(slot, reply.get("states") or {})
 
     def stream(self, uid: int) -> TokenStream:
         """Ordered token iterator over the ROUTER's request handle —
@@ -405,30 +455,57 @@ class FleetRouter:
         self._finish(entry, RequestState.CANCELLED)
         self.abandoned += 1
 
-    def _make_on_token(self, uid: int):
-        def cb(tok: int) -> None:
-            e = self._entries.get(uid)
-            if e is None:
-                return
-            e.seen += 1
-            if e.seen <= len(e.req.tokens):
-                # replayed position after a requeue: suppressed — and,
-                # per the replay contract, bitwise identical
-                if e.req.tokens[e.seen - 1] != tok:
-                    self.replay_mismatches += 1
-                    logger.warning(
-                        f"fleet replay mismatch for uid {uid} at "
-                        f"position {e.seen - 1}: "
-                        f"{e.req.tokens[e.seen - 1]} -> {tok}")
-                return
-            e.req.tokens.append(tok)
-            if e.req.first_token_t is None:
-                e.req.first_token_t = self._clock()
-            if e.user_on_token is not None:
-                e.user_on_token(tok)
-        return cb
+    # -- token delivery (STEP/TOKENS replies) ---------------------------
+    def _deliver_tokens(self, slot: int, tokens: dict) -> None:
+        for uid_s, blk in tokens.items():
+            e = self._entries.get(int(uid_s))
+            if e is None or e.slot != slot or e.req.done:
+                continue
+            start = int(blk.get("start", 0))
+            for i, tok in enumerate(blk.get("toks", ())):
+                self._deliver_one(e, start + i, int(tok))
+
+    def _deliver_one(self, e: _FleetEntry, pos: int, tok: int) -> None:
+        """One token at its attempt-local position through the per-uid
+        delivered cursor: duplicates (a re-collected tail) fall below
+        the cursor; a requeued attempt replays from position 0 and the
+        replayed prefix is suppressed — and, per the replay contract,
+        bitwise identical."""
+        if pos < e.seen:
+            return
+        e.seen = pos + 1
+        req = e.req
+        if e.seen <= len(req.tokens):
+            if req.tokens[e.seen - 1] != tok:
+                self.replay_mismatches += 1
+                logger.warning(
+                    f"fleet replay mismatch for uid {req.uid} at "
+                    f"position {e.seen - 1}: "
+                    f"{req.tokens[e.seen - 1]} -> {tok}")
+            return
+        req.tokens.append(tok)
+        if req.first_token_t is None:
+            req.first_token_t = self._clock()
+        if e.user_on_token is not None:
+            e.user_on_token(tok)
 
     # -- placement ------------------------------------------------------
+    def _outstanding(self, slot: int) -> int:
+        """Router-side live-placement count for one slot — the
+        router's OWN knowledge of what it put where (fresher than the
+        last snapshot between steps, and honest: it never reads
+        replica memory)."""
+        return sum(
+            1 for uid in self._placed.get(slot, ())
+            if (e := self._entries.get(uid)) is not None
+            and e.slot == slot and not e.req.done)
+
+    def _scoring_snapshot(self, slot: int) -> dict:
+        snap = self._replicas[slot].snapshot()
+        if snap.get("alive"):
+            snap["outstanding"] = self._outstanding(slot)
+        return snap
+
     def _affinity(self, digests) -> Tuple[Optional[int], int]:
         """Walk the block-hash map from the root: the replica holding
         the longest consecutive head of this chain, and how many
@@ -446,32 +523,42 @@ class FleetRouter:
 
     def _ranked_slots(self, entry
                       ) -> Tuple[List[int], Optional[int], int]:
-        """Rank the POOLED slots — the router's own view, never the
-        replicas' simulation-truth liveness. Death it has not yet
-        detected surfaces the way a real fleet's would: a failed
-        health probe (``snapshot()`` reporting alive=False) drops the
-        candidate here; a dead dispatch raises typed in ``_place``."""
+        """Rank the POOLED slots from the router's own view (cached
+        worker snapshots + its placement ledger — never replica
+        memory). Suspect replicas (>= 1 failed probe) drop to the BACK
+        of the order: new traffic prefers reachable survivors, but a
+        fleet that is all-suspect still serves rather than shedding
+        outright (degraded mode)."""
         probed = [(s, snap) for s in sorted(self._pool)
-                  if (snap := self._replicas[s].snapshot()).get("alive")]
+                  if (snap := self._scoring_snapshot(s)).get("alive")]
         if not probed:
             return [], None, 0
         if hasattr(self.policy, "rank"):          # round-robin family
-            return self.policy.rank([s for s, _ in probed]), None, 0
+            healthy = [s for s, snap in probed
+                       if not snap.get("suspect")]
+            suspects = [s for s, snap in probed
+                        if snap.get("suspect")]
+            return self.policy.rank(healthy) + suspects, None, 0
         aff_slot, aff_n = self._affinity(entry.digests)
         n_blocks = max(1, len(entry.digests))
         scored = []
         for s, snap in probed:
             af = aff_n / n_blocks if s == aff_slot else 0.0
-            scored.append((-self.policy.score(snap, af), s))
+            scored.append((1 if snap.get("suspect") else 0,
+                           -self.policy.score(snap, af), s))
         scored.sort()
-        order = [s for _, s in scored]
+        order = [s for _, _, s in scored]
         if aff_n == 0:
             aff_slot = None
         return order, aff_slot, aff_n
 
     def _place(self, uid: int) -> bool:
-        """One scoring pass + submit; returns False when every alive
-        replica refused (fleet saturated)."""
+        """One scoring pass + SUBMIT RPC; returns False when every
+        pooled replica refused (fleet saturated). The affinity map is
+        NOT written here — placement is a guess; the map mirrors what
+        each replica's trie PROVES it holds via TRIE_DELTA (the old
+        placement-time writes went stale the moment a replica evicted
+        an entry, and kept pulling traffic at KV that was gone)."""
         e = self._entries[uid]
         order, aff_slot, aff_n = self._ranked_slots(e)
         kwargs = e.kwargs
@@ -488,33 +575,35 @@ class FleetRouter:
             for slot in order:
                 rep = self._replicas[slot]
                 try:
-                    rep.submit(e.req.prompt, uid=uid,
-                               on_token=self._make_on_token(uid),
-                               **kwargs)
+                    rep.submit(e.req.prompt, uid=uid, **kwargs)
                 except ServingOverloadError:
                     continue
                 except WorkerFailureError:
-                    # dead dispatch (the simulated failed RPC): try
-                    # the next candidate; the formal detection +
-                    # evacuation runs on the next router step
+                    # dead dispatch or exhausted transport budget (the
+                    # failed RPC): try the next candidate; the formal
+                    # detection + evacuation runs on the next step
                     continue
                 e.slot = slot
                 e.seen = 0
                 self._placed.setdefault(slot, set()).add(uid)
-                for d in e.digests:
-                    self._affinity_map.put(d, slot)
                 if slot == aff_slot:
                     self.affinity_routed += 1
                 return True
         return False
 
     def _overload_error(self, shed_uids) -> ServingOverloadError:
-        snaps = {s: self._replicas[s].snapshot() for s in self._pool}
+        snaps = {}
+        for s in self._pool:
+            rep = self._replicas[s]
+            snap = rep.snapshot()
+            if snap.get("alive"):
+                snap["outstanding"] = self._outstanding(s)
+            snap["probe"] = rep.prober.as_dict()   # transport health
+            snaps[s] = snap
         alive = [v for v in snaps.values() if v.get("alive")]
-        total_out = sum(v["outstanding"] for v in alive)
-        free = sum(self._replicas[s].engine.free_blocks
-                   for s, v in snaps.items() if v.get("alive"))
-        kv = (sum(v["kv_util"] for v in alive) / len(alive)
+        total_out = sum(v.get("outstanding", 0) for v in alive)
+        free = sum(int(v.get("free_blocks", 0)) for v in alive)
+        kv = (sum(v.get("kv_util", 0.0) for v in alive) / len(alive)
               if alive else 1.0)
         err = ServingOverloadError(
             "fleet saturated: every alive replica refused the request",
@@ -524,12 +613,22 @@ class FleetRouter:
         return err
 
     # -- the fleet step -------------------------------------------------
+    def _cursors(self, slot: int) -> dict:
+        """Per-uid delivered-token cursors for one slot's STEP RPC
+        (string keys: they cross the JSON wire)."""
+        return {str(uid): e.seen
+                for uid in self._placed.get(slot, ())
+                if (e := self._entries.get(uid)) is not None
+                and e.slot == slot and not e.req.done}
+
     def step(self) -> bool:
         """One fleet iteration: poll every slot's fault site (ordinal
-        discipline), step every pooled replica (beating the heartbeat
-        ledger; a typed step failure is an immediate detection), sync
-        request states, run the supervisor's deadline sweep, then
-        retry the requeue backlog on the survivors."""
+        discipline), STEP every pooled replica over its channel
+        (ingesting tokens/states/deltas from the replies and beating
+        the heartbeat ledger — silence is a missed beat, a typed
+        failure an immediate detection), run the probe pass, the
+        supervisor's deadline sweep, then retry the requeue backlog on
+        the survivors."""
         self._step_idx += 1
         step = self._step_idx
         for rep in self._replicas:
@@ -537,14 +636,18 @@ class FleetRouter:
         for slot in sorted(self._pool):
             rep = self._replicas[slot]
             try:
-                stepped, progressed = rep.step()
+                reply = rep.step(self._cursors(slot))
             except (WorkerFailureError, CollectiveTimeout) as e:
                 mode = getattr(e, "mode", "hang")
                 self._supervisor.on_failure(slot, mode, str(e), step)
                 continue
-            if stepped:
-                self._monitor.beat(slot, step, progressed=progressed)
-                self._sync_replica(slot)
+            if reply is None:
+                continue          # silence: no beat this step
+            self._monitor.beat(slot, step,
+                               progressed=bool(reply.get("progressed")))
+            if "states" in reply:
+                self._ingest_step_reply(slot, reply, step)
+        self._probe_pass(step)
         self._supervisor.check(step)
         if self._backlog:
             if not self._pool:
@@ -563,15 +666,28 @@ class FleetRouter:
         self._check_imbalance(step)
         return not self.idle
 
-    def _sync_replica(self, slot: int) -> None:
-        """Mirror replica-side request states onto the router handles
-        (the router cannot be called back for lifecycle edges — only
-        tokens flow through ``on_token``)."""
+    def _ingest_step_reply(self, slot: int, reply: dict,
+                           step: int) -> None:
+        """Everything one STEP reply carries, in dependency order:
+        token tails first (a FINISHED state must not close a handle
+        before its final tokens land), then states, then the trie
+        delta, then the health snapshot."""
+        self._deliver_tokens(slot, reply.get("tokens") or {})
+        self._sync_states(slot, reply.get("states") or {})
+        self._apply_trie_delta(slot, reply.get("trie_delta"), step)
+        snap = reply.get("snapshot")
+        if snap:
+            self._replicas[slot].last_snapshot = snap
+
+    def _sync_states(self, slot: int, states: dict) -> None:
+        """Mirror replica-reported request states onto the router
+        handles (lifecycle edges only ride replies — the router is
+        never called back)."""
         placed = self._placed.get(slot)
-        if not placed:
+        if placed is None:
             return
-        fe = self._replicas[slot].frontend
-        for uid in list(placed):
+        for uid_s, st in states.items():
+            uid = int(uid_s)
             e = self._entries.get(uid)
             if e is None or e.slot != slot:
                 placed.discard(uid)
@@ -580,13 +696,12 @@ class FleetRouter:
             if req.done:
                 placed.discard(uid)
                 continue
-            rr = fe.get_request(uid)
-            if rr is None:
+            if st is None:
                 # the replica RETIRED it (past max_retained_requests)
                 # before this sync: it reached a terminal state there.
                 # Router cancels close the handle before this point
                 # and the gate only sheds QUEUED (tokenless) work, so
-                # buffered tokens imply the decode FINISHED — close
+                # delivered tokens imply the decode FINISHED — close
                 # the handle instead of skipping it forever (a live
                 # handle nothing will ever finish livelocks serve())
                 logger.warning(
@@ -607,37 +722,132 @@ class FleetRouter:
                     self.shed += 1
                 placed.discard(uid)
                 continue
-            if rr.state == RequestState.PREFILL:
+            state = RequestState[st["state"]]
+            if state == RequestState.PREFILL:
                 if req.state == RequestState.QUEUED:
                     req.advance(RequestState.PREFILL)
-            elif rr.state == RequestState.DECODE:
+            elif state == RequestState.DECODE:
                 if req.state == RequestState.QUEUED:
                     req.advance(RequestState.PREFILL)
                 if req.state == RequestState.PREFILL:
                     req.advance(RequestState.DECODE)
-            elif rr.state == RequestState.FINISHED:
+            elif state == RequestState.FINISHED:
                 if req.state == RequestState.QUEUED:
                     req.advance(RequestState.PREFILL)
                 self._finish(e, RequestState.FINISHED)
                 self.finished += 1
                 placed.discard(uid)
-            elif rr.state == RequestState.SHED:
+            elif state == RequestState.SHED:
                 # the replica's gate refused it (deadline/SLO): the
                 # router propagates — SHED from the queue, CANCELLED
                 # (with the reason) for a request already mid-flight
                 # from an earlier attempt
-                req.shed_reason = rr.shed_reason
+                req.shed_reason = st.get("shed_reason")
                 if req.state == RequestState.QUEUED:
                     self._finish(e, RequestState.SHED)
                 else:
                     self._finish(e, RequestState.CANCELLED)
                 self.shed += 1
                 placed.discard(uid)
-            elif rr.state == RequestState.CANCELLED:
+            elif state == RequestState.CANCELLED:
                 # replica-side cancels only originate at the router;
                 # reaching here means cancel() already closed the
                 # handle — nothing to mirror
                 placed.discard(uid)
+
+    # -- the affinity feed (TRIE_DELTA / SNAPSHOT) ----------------------
+    def _apply_trie_delta(self, slot: int, delta: Optional[dict],
+                          step: int) -> None:
+        """One replica-reported trie-membership delta into the
+        affinity map. Deltas are sequenced per replica; a gap means a
+        delta died with a lost STEP RPC (its reply is cached under an
+        rpc_id the router will never re-ask) — the map may be stale
+        both ways, so rebuild from the full trie."""
+        if not delta:
+            return
+        expected = self._trie_seqs.get(slot, 0) + 1
+        seq = int(delta.get("seq", 0))
+        if seq != expected:
+            logger.warning(
+                f"fleet router: trie-delta gap on replica {slot} "
+                f"(seq {seq}, expected {expected}); resyncing")
+            self._resync(slot, step)
+            return
+        self._trie_seqs[slot] = seq
+        for hx in delta.get("add", ()):
+            self._affinity_map.put(bytes.fromhex(hx), slot)
+        for hx in delta.get("del", ()):
+            d = bytes.fromhex(hx)
+            cur = self._affinity_map.pop(d)
+            if cur is not None and cur != slot:
+                # the digest re-homed to another replica since: that
+                # mapping is still live — put it back
+                self._affinity_map.put(d, cur)
+
+    def _resync(self, slot: int, step: int) -> None:
+        """Rebuild one slot's affinity view from a full SNAPSHOT:
+        purge its entries, re-add the trie listing, rebase the delta
+        seq. Runs after a reconnect and on a delta gap."""
+        rep = self._replicas[slot]
+        try:
+            reply = rep.resync()
+        except WorkerFailureError as e:
+            logger.warning(f"fleet resync of replica {slot} "
+                           f"failed: {e}")
+            return
+        trie = reply.get("trie") or []
+        with span("fleet.resync", slot=slot, blocks=len(trie)):
+            stale = [d for d, s in list(self._affinity_map.items())
+                     if s == slot]
+            for d in stale:
+                self._affinity_map.pop(d)
+            for hx in trie:
+                self._affinity_map.put(bytes.fromhex(hx), slot)
+            self._trie_seqs[slot] = int(reply.get("trie_seq", 0))
+            snap = reply.get("snapshot")
+            if snap:
+                rep.last_snapshot = snap
+
+    # -- health probing -------------------------------------------------
+    def _probe_pass(self, step: int) -> None:
+        """One HEARTBEAT probe per pooled replica every
+        ``probe_interval_steps``: a recovery triggers the affinity
+        resync (+ flap tracking); a failure streak past
+        ``probe_fail_threshold`` is the partition verdict, handled by
+        the same supervisor ladder as a death."""
+        tc = self._transport_cfg
+        interval = int(tc.probe_interval_steps)
+        if interval <= 0 or step % interval:
+            return
+        for slot in sorted(self._pool):
+            rep = self._replicas[slot]
+            outcome = rep.probe()
+            if outcome == "recovered":
+                self._resync(slot, step)
+                self._note_reconnect(step)
+            elif outcome == "failed" and slot in self._pool and \
+                    rep.prober.consec_fails >= \
+                    int(tc.probe_fail_threshold):
+                self._supervisor.on_failure(
+                    slot, "partition",
+                    f"{rep.prober.consec_fails} consecutive probe "
+                    f"failures (deadline "
+                    f"{tc.probe_deadline_seconds:g}s)", step)
+
+    def _note_reconnect(self, step: int) -> None:
+        tc = self._transport_cfg
+        self._reconnect_steps.append(step)
+        window = max(1, int(tc.flap_window_steps))
+        recent = sum(1 for s in self._reconnect_steps
+                     if step - s < window)
+        if recent >= int(tc.flap_alert_reconnects) and \
+                step - self._last_flap_alert >= window:
+            self._last_flap_alert = step
+            self._note_alert(TelemetryAlert(
+                "transport_flap", "fleet/transport/reconnects",
+                float(recent), float(tc.flap_alert_reconnects), step,
+                f"{recent} replica reconnect(s) within {window} "
+                f"router steps — flapping transport"))
 
     # -- elastic-recovery primitives (the supervisor drives these) -----
     def _evacuate(self, slot: int, step: int) -> List[int]:
@@ -675,11 +885,24 @@ class FleetRouter:
                 f"replica {slot} onto the survivors"))
         return requeued
 
-    def _respawn(self, slot: int, step: int) -> None:
+    def _respawn(self, slot: int, step: int) -> bool:
+        """Fresh channel + worker through the replica's factory;
+        returns False (pool stays shrunk, typed alert) when the new
+        worker cannot be reached — a respawn over a real transport can
+        fail."""
         rep = self._replicas[slot]
         with span("fleet.respawn", slot=slot,
                   generation=rep.generation + 1):
-            rep.respawn()
+            try:
+                rep.respawn()
+            except (TransportError, OSError) as e:
+                logger.warning(f"fleet respawn of replica {slot} "
+                               f"failed: {e}")
+                self._note_alert(TelemetryAlert(
+                    "replica_respawn_failed",
+                    f"fleet/replicas/r{slot}/alive", 0.0, 1.0, step,
+                    f"respawn of replica {slot} failed: {e}"))
+                return False
         # its trie died with it: stale affinity must not pull traffic
         # to an empty cache (stats-neutral sweep — a get() per key
         # would promote every entry to MRU and fake 4k hits)
@@ -687,8 +910,10 @@ class FleetRouter:
                  if s == slot]
         for d in stale:
             self._affinity_map.pop(d)
+        self._trie_seqs[slot] = int(rep.hello.get("trie_seq", 0))
         self._pool.add(slot)
         self._monitor.restore(slot, step)
+        return True
 
     def _place_backlog(self) -> None:
         pending = list(self._backlog)
@@ -704,8 +929,8 @@ class FleetRouter:
         spread_max = int(self.config.fleet.imbalance_alert_spread)
         if spread_max <= 0:
             return
-        outs = [snap["outstanding"] for s in self._pool
-                if (snap := self._replicas[s].snapshot()).get("alive")]
+        outs = [self._outstanding(s) for s in self._pool
+                if self._replicas[s].alive]
         if len(outs) < 2:
             return
         spread = max(outs) - min(outs)
@@ -748,29 +973,57 @@ class FleetRouter:
 
     def _fleet_prefix_stats(self) -> dict:
         """Cross-replica reuse counters, aggregated over the ALIVE
-        replicas (a dead replica's counters died with its engine —
-        the fleet rate covers the serving pool as it stands)."""
+        replicas' last reported snapshots (a dead replica's counters
+        died with its engine — the fleet rate covers the serving pool
+        as it stands)."""
         hits = misses = reused = cached = 0
         for rep in self._replicas:
-            if not rep.alive or rep.engine.prefix_cache is None:
+            if not rep.alive:
                 continue
-            pc = rep.engine.prefix_cache
-            hits += pc.hits
-            misses += pc.misses
-            reused += pc.tokens_reused
-            cached += pc.cached_blocks
+            snap = rep.last_snapshot or {}
+            hits += int(snap.get("prefix_hits", 0))
+            misses += int(snap.get("prefix_misses", 0))
+            reused += int(snap.get("prefix_tokens_reused", 0))
+            cached += int(snap.get("prefix_cached_blocks", 0))
         total = hits + misses
         return {"hits": hits, "misses": misses,
                 "hit_rate": hits / total if total else 0.0,
                 "tokens_reused": reused, "cached_blocks": cached}
 
+    def _transport_stats(self) -> dict:
+        """The fleet report's ``transport`` block: channel counters
+        summed across replicas (+ per-replica breakdown with each
+        prober's ledger) and the fleet-wide probe-latency
+        percentiles."""
+        agg = {"rpcs": 0, "retries": 0, "timeouts": 0,
+               "decode_errors": 0, "stale": 0, "send_errors": 0,
+               "bytes_sent": 0, "bytes_recv": 0, "reconnects": 0,
+               "probes": 0, "probe_failures": 0, "injected": 0}
+        lat: List[float] = []
+        per = {}
+        for rep in self._replicas:
+            d = rep.stats.as_dict()
+            for k in agg:
+                agg[k] += int(d.get(k, 0))
+            injected = getattr(rep.channel, "injected", 0)
+            agg["injected"] += int(injected)
+            lat.extend(rep.stats.probe_latencies)
+            per[f"r{rep.slot}"] = {**d, "injected": injected,
+                                   "probe": rep.prober.as_dict()}
+        agg["channel"] = self._transport_cfg.channel
+        agg["probe_latency_ms"] = probe_percentiles_ms(lat)
+        agg["per_replica"] = per
+        return agg
+
     def get_fleet_report(self) -> dict:
         """Per-replica snapshots + router totals + aggregated prefix
-        reuse + the supervisor's recovery history."""
+        reuse + the transport block + the supervisor's recovery
+        history."""
         return {
             "replicas": {str(rep.slot): rep.snapshot()
                          for rep in self._replicas},
             "router": self._router_stats(),
             "prefix": self._fleet_prefix_stats(),
+            "transport": self._transport_stats(),
             "recovery": self._supervisor.report(),
         }

@@ -1,0 +1,653 @@
+"""Fleet transport: the RPC layer between the router and its replica
+workers.
+
+PR 11's fleet was honest about placement and recovery but its
+"replicas" were in-process objects — the router could not lose a
+message, see a torn frame, or wait on a partitioned host. This module
+puts a real, failable channel between them:
+
+* **a typed message protocol** — SUBMIT / CANCEL / STEP / TOKENS /
+  SNAPSHOT / HEARTBEAT requests, OK / ERR replies with TOKENS +
+  TRIE_DELTA payload blocks riding STEP replies; versioned,
+  length-prefixed JSON frames (msgpack-shaped but dependency-free —
+  the deployment image bakes no msgpack, and JSON keeps frames
+  readable in logs);
+* **two interchangeable channels** — ``LoopbackChannel`` (the worker
+  core lives in-process; synchronous, deterministic, zero wall-clock:
+  the default for tests and single-host runs) and ``SocketChannel``
+  (one OS process per replica via the ``fleet.worker`` entrypoint,
+  localhost sockets — worker.py owns the process spawn);
+* **a ``FaultyChannel`` decorator** — drives message drop / delay /
+  duplicate / reorder / truncate through the standard fault-injector
+  grammar at the ``transport.send`` / ``transport.recv`` /
+  ``transport.connect`` sites. A fractional ``~arg`` < 1 is a rate
+  ("transport.send:drop~0.1"), applied deterministically off a hash
+  of the site ordinal — drills replay bitwise;
+* **deadline / retry / backoff** — every RPC carries a deadline and
+  rides the shared ``backoff_delay`` policy; retried asks reuse the
+  rpc_id, so the worker's bounded reply cache answers them without
+  re-executing (at-least-once delivery, exactly-once effects).
+  Exhausted budgets surface as typed ``TransportError``s, which the
+  ``Replica`` translates into the ``WorkerFailureError`` the
+  FleetSupervisor ladder already keys on — the recovery path is
+  UNCHANGED, only the failure source became real;
+* **a health prober** — per-replica HEARTBEAT round-trips under their
+  own (short) deadline; a failure streak is the router's partition
+  verdict, one failure already marks the replica suspect (degraded
+  mode: no new placements, existing work keeps stepping).
+
+Token integrity through all of this rests on one invariant the router
+already had: delivery dedups on the per-uid delivered-token cursor
+(``_FleetEntry.seen``), so dropped / duplicated / reordered frames can
+delay tokens but never skip or repeat one.
+"""
+
+import hashlib
+import json
+import socket
+import struct
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from .....resilience.errors import (ServingOverloadError,
+                                    TerminalRequestError,
+                                    TransportConnectError,
+                                    TransportDecodeError,
+                                    TransportError,
+                                    TransportTimeout,
+                                    UnknownRequestError)
+from .....resilience.fault_injector import fault_injector
+from .....resilience.retry import backoff_delay
+from .....telemetry.trace import span
+from .....utils.logging import logger
+
+# -- the wire protocol ----------------------------------------------------
+
+PROTOCOL_VERSION = 1
+_MAGIC = b"DTPF"                       # deepspeed-tpu fleet
+_HEADER = struct.Struct(">4sHI")       # magic, version, payload bytes
+
+# message kinds (requests; replies are "<kind>_OK" or "ERR"). TOKENS
+# doubles as a read-only request — "send me token tails + states past
+# these cursors WITHOUT stepping" (the cancel-race drain) — and as the
+# payload block of the same name inside STEP_OK replies; TRIE_DELTA
+# names the trie-membership block riding STEP_OK.
+MSG_HELLO = "HELLO"
+MSG_SUBMIT = "SUBMIT"
+MSG_CANCEL = "CANCEL"
+MSG_STEP = "STEP"
+MSG_TOKENS = "TOKENS"
+MSG_SNAPSHOT = "SNAPSHOT"
+MSG_HEARTBEAT = "HEARTBEAT"
+MSG_SHUTDOWN = "SHUTDOWN"
+MSG_ERR = "ERR"
+
+
+def encode_frame(msg: dict) -> bytes:
+    payload = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    return _HEADER.pack(_MAGIC, PROTOCOL_VERSION, len(payload)) + payload
+
+
+def decode_frame(data: bytes) -> dict:
+    """Whole-frame decode -> message dict; every failure mode is the
+    one typed ``TransportDecodeError`` (retryable: the peer's reply
+    cache answers a re-ask without re-executing)."""
+    if len(data) < _HEADER.size:
+        raise TransportDecodeError(-1, "decode",
+                                   f"short frame ({len(data)} bytes)")
+    magic, ver, n = _HEADER.unpack_from(data)
+    if magic != _MAGIC:
+        raise TransportDecodeError(-1, "decode", "bad magic")
+    if ver != PROTOCOL_VERSION:
+        raise TransportDecodeError(-1, "decode",
+                                   f"protocol version {ver} != "
+                                   f"{PROTOCOL_VERSION}")
+    body = data[_HEADER.size:]
+    if len(body) != n:
+        raise TransportDecodeError(
+            -1, "decode", f"length prefix {n} != body {len(body)}")
+    try:
+        msg = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise TransportDecodeError(-1, "decode",
+                                   f"payload: {e}") from None
+    if not isinstance(msg, dict):
+        raise TransportDecodeError(-1, "decode", "payload not a dict")
+    return msg
+
+
+# -- channels -------------------------------------------------------------
+
+
+class Channel:
+    """Frame-oriented duplex pipe: ``send(frame)`` toward the worker,
+    ``recv(timeout) -> frame | None`` from it. Implementations deal in
+    WHOLE encoded frames — the RPC client owns encode/decode, so a
+    decorator (FaultyChannel) can mangle bytes in between."""
+
+    synchronous = False   # True: recv never waits (loopback) — the
+    #                       RPC client skips backoff sleeps
+
+    def connect(self) -> None:
+        raise NotImplementedError
+
+    def send(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def recv(self, timeout: float = 0.0) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class LoopbackChannel(Channel):
+    """In-process channel: ``send`` hands the decoded message straight
+    to the worker core and queues the encoded reply for ``recv``.
+    Synchronous and deterministic — no threads, no wall clock — which
+    is exactly what the fault matrix needs: every drop/dup/reorder
+    drill replays bitwise. An undecodable frame is swallowed like a
+    real worker would (it cannot even read the rpc_id to answer), so
+    the client's deadline/retry path runs for real."""
+
+    synchronous = True
+
+    def __init__(self, core):
+        self._core = core
+        self._inbox: deque = deque()
+        self._connected = False
+
+    @property
+    def core(self):
+        return self._core
+
+    def connect(self) -> None:
+        self._connected = True
+
+    def send(self, data: bytes) -> None:
+        if not self._connected:
+            raise ConnectionError("loopback channel is closed")
+        try:
+            msg = decode_frame(data)
+        except TransportDecodeError as e:
+            logger.warning(f"loopback worker dropped undecodable "
+                           f"frame: {e.reason}")
+            return
+        self._inbox.append(encode_frame(self._core.handle(msg)))
+
+    def recv(self, timeout: float = 0.0) -> Optional[bytes]:
+        return self._inbox.popleft() if self._inbox else None
+
+    def close(self) -> None:
+        self._connected = False
+        self._inbox.clear()
+
+
+class SocketChannel(Channel):
+    """One localhost TCP stream to a worker process. ``connector()``
+    owns establishment (spawn + accept — worker.py provides it) so the
+    ``transport.connect`` fault site wraps the whole thing; frames are
+    reassembled from the stream by the length prefix, and a partial
+    frame survives across ``recv`` timeouts."""
+
+    synchronous = False
+
+    def __init__(self, connector: Callable):
+        self._connector = connector
+        self._sock: Optional[socket.socket] = None
+        self._proc = None
+        self._buf = bytearray()
+
+    def connect(self) -> None:
+        self._proc, self._sock = self._connector()
+
+    @property
+    def proc(self):
+        return self._proc
+
+    def send(self, data: bytes) -> None:
+        if self._sock is None:
+            raise ConnectionError("socket channel is not connected")
+        self._sock.sendall(data)
+
+    def _extract_frame(self) -> Optional[bytes]:
+        if len(self._buf) < _HEADER.size:
+            return None
+        magic, _ver, n = _HEADER.unpack_from(bytes(self._buf[:_HEADER.size]))
+        if magic != _MAGIC:
+            # stream desync is unrecoverable for this connection
+            raise ConnectionError("socket stream lost frame alignment")
+        end = _HEADER.size + n
+        if len(self._buf) < end:
+            return None
+        frame = bytes(self._buf[:end])
+        del self._buf[:end]
+        return frame
+
+    def recv(self, timeout: float = 0.0) -> Optional[bytes]:
+        if self._sock is None:
+            raise ConnectionError("socket channel is not connected")
+        deadline = time.monotonic() + max(0.0, timeout)
+        while True:
+            frame = self._extract_frame()
+            if frame is not None:
+                return frame
+            left = deadline - time.monotonic()
+            if left <= 0 and timeout > 0:
+                return None
+            self._sock.settimeout(max(left, 1e-3))
+            try:
+                chunk = self._sock.recv(65536)
+            except socket.timeout:
+                return None
+            except InterruptedError:
+                continue
+            if not chunk:
+                raise ConnectionError("worker closed the connection")
+            self._buf += chunk
+            if timeout <= 0:
+                # non-blocking poll: drain what arrived, no re-wait
+                deadline = time.monotonic()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5.0)
+            except Exception:   # still alive past the grace period
+                self._proc.kill()
+                self._proc.wait(timeout=5.0)
+        self._buf.clear()
+
+
+_CHANNEL_FAULTS = ("drop", "delay", "dup", "reorder", "truncate")
+
+
+def _truncate_frame(data: bytes) -> bytes:
+    """Chop the payload tail but REWRITE the length prefix so stream
+    framing stays aligned — the receiver gets a well-framed frame
+    whose JSON no longer parses (TransportDecodeError), which is what
+    real payload corruption behind intact framing looks like."""
+    if len(data) <= _HEADER.size:
+        return data[:max(0, len(data) - 1)]
+    body = data[_HEADER.size:]
+    body = body[:len(body) // 2]
+    return _HEADER.pack(_MAGIC, PROTOCOL_VERSION, len(body)) + body
+
+
+class FaultyChannel(Channel):
+    """Decorator driving channel chaos through the injector grammar.
+
+    One ``transport.send`` consume per outbound message, one
+    ``transport.recv`` consume per INBOUND message (not per empty
+    poll), one ``transport.connect`` consume per (re)establishment.
+    Kinds: ``drop`` loses the message, ``dup`` delivers it twice,
+    ``truncate`` corrupts its payload (framing intact), ``delay~k``
+    holds it for k channel operations, ``reorder`` holds it behind the
+    next message. Delayed/held messages tick on every send/recv CALL,
+    so they surface even on the wall-clock-free loopback channel. The
+    classic kinds degrade sanely: hang/slow sleep, ioerror raises the
+    retryable ``InjectedIOError``, the rest raise ``InjectedFault``.
+    """
+
+    def __init__(self, inner: Channel, slot: int = -1):
+        self._inner = inner
+        self.slot = int(slot)
+        self._held_out = []     # [ops_left, frame] toward the worker
+        self._held_in = []      # [ops_left, frame] toward the router
+        self._ready_in: deque = deque()
+        self.injected = 0       # channel faults actually applied
+
+    @property
+    def synchronous(self):      # delegate: wrapping must not change it
+        return self._inner.synchronous
+
+    @property
+    def inner(self):
+        return self._inner
+
+    @staticmethod
+    def _applies(spec, ordinal: int, site: str) -> bool:
+        """Rate specs (count=inf, fractional arg) apply per-ordinal by
+        hash — deterministic, so a seeded drill replays; windowed
+        specs (@after / xcount) already selected this call."""
+        if spec is None:
+            return False
+        if spec.count == float("inf") and spec.arg_given and \
+                spec.arg < 1.0:
+            h = hashlib.blake2b(f"{site}:{ordinal}".encode(),
+                                digest_size=8).digest()
+            return int.from_bytes(h, "big") / 2.0 ** 64 < spec.arg
+        return True
+
+    def _degrade(self, spec, site: str):
+        """Non-channel kinds at a channel site: act like fire()."""
+        from .....resilience.errors import InjectedFault, InjectedIOError
+        if spec.kind in ("hang", "slow"):
+            time.sleep(spec.arg if spec.arg_given else 0.0)
+            return
+        if spec.kind == "ioerror":
+            raise InjectedIOError(f"injected I/O fault at {site}")
+        raise InjectedFault(f"injected {spec.kind} at {site}")
+
+    @staticmethod
+    def _delay_ops(spec) -> int:
+        # ~arg >= 1 is the hold length in channel ops; a fractional
+        # arg is the RATE, so the hold falls back to the default
+        if spec.arg_given and spec.arg >= 1.0:
+            return int(spec.arg)
+        return 2
+
+    def _tick_out(self, new) -> None:
+        released = []
+        for h in self._held_out:
+            h[0] -= 1
+            if h[0] <= 0:
+                released.append(h[1])
+        self._held_out = [h for h in self._held_out if h[0] > 0] + new
+        for frame in released:
+            self._inner.send(frame)
+
+    def _tick_in(self, new) -> None:
+        released = []
+        for h in self._held_in:
+            h[0] -= 1
+            if h[0] <= 0:
+                released.append(h[1])
+        self._held_in = [h for h in self._held_in if h[0] > 0] + new
+        self._ready_in.extend(released)
+
+    def connect(self) -> None:
+        spec = fault_injector.consume("transport.connect",
+                                      detail=f"replica{self.slot}")
+        if spec is not None:
+            self.injected += 1
+            raise TransportConnectError(
+                self.slot, "connect", f"injected {spec.kind}")
+        self._inner.connect()
+
+    def send(self, data: bytes) -> None:
+        spec, n = fault_injector.consume(
+            "transport.send", detail=f"replica{self.slot}",
+            with_ordinal=True)
+        new = []
+        if self._applies(spec, n, "transport.send"):
+            if spec.kind not in _CHANNEL_FAULTS:
+                self._tick_out(new)
+                self._tick_in([])
+                self._degrade(spec, "transport.send")
+                return
+            self.injected += 1
+            if spec.kind == "drop":
+                pass                      # the worker never sees it
+            elif spec.kind == "dup":
+                self._inner.send(data)
+                self._inner.send(data)
+            elif spec.kind == "truncate":
+                self._inner.send(_truncate_frame(data))
+            elif spec.kind == "delay":
+                new.append([self._delay_ops(spec), data])
+            elif spec.kind == "reorder":
+                new.append([1, data])     # lands after the NEXT message
+        else:
+            self._inner.send(data)
+        self._tick_out(new)
+        self._tick_in([])
+
+    def recv(self, timeout: float = 0.0) -> Optional[bytes]:
+        if self._ready_in:
+            return self._ready_in.popleft()
+        data = self._inner.recv(timeout)
+        new = []
+        out = None
+        if data is not None:
+            spec, n = fault_injector.consume(
+                "transport.recv", detail=f"replica{self.slot}",
+                with_ordinal=True)
+            if self._applies(spec, n, "transport.recv"):
+                if spec.kind not in _CHANNEL_FAULTS:
+                    self._tick_in(new)
+                    self._degrade(spec, "transport.recv")
+                    return None
+                self.injected += 1
+                if spec.kind == "drop":
+                    out = None                # lost after the worker acted
+                elif spec.kind == "dup":
+                    self._ready_in.append(data)
+                    out = data
+                elif spec.kind == "truncate":
+                    out = _truncate_frame(data)
+                elif spec.kind == "delay":
+                    new.append([self._delay_ops(spec), data])
+                elif spec.kind == "reorder":
+                    new.append([1, data])
+            else:
+                out = data
+        self._tick_in(new)
+        self._tick_out([])      # held requests tick on recvs too
+        if out is None and self._ready_in:
+            out = self._ready_in.popleft()
+        return out
+
+    def close(self) -> None:
+        self._held_out = []
+        self._held_in = []
+        self._ready_in.clear()
+        self._inner.close()
+
+
+# -- stats ----------------------------------------------------------------
+
+
+class TransportStats:
+    """Per-replica channel counters (the fleet report's ``transport``
+    block sums them across replicas). Latency history is bounded."""
+
+    __slots__ = ("rpcs", "retries", "timeouts", "decode_errors",
+                 "stale", "send_errors", "bytes_sent", "bytes_recv",
+                 "reconnects", "probes", "probe_failures",
+                 "probe_latencies")
+
+    def __init__(self):
+        self.rpcs = 0
+        self.retries = 0
+        self.timeouts = 0
+        self.decode_errors = 0
+        self.stale = 0          # frames for a different rpc_id (dup/late)
+        self.send_errors = 0
+        self.bytes_sent = 0
+        self.bytes_recv = 0
+        self.reconnects = 0
+        self.probes = 0
+        self.probe_failures = 0
+        self.probe_latencies = deque(maxlen=256)
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__
+                if k != "probe_latencies"}
+
+
+def probe_percentiles_ms(latencies) -> dict:
+    lat = sorted(latencies)
+    if not lat:
+        return {"p50": 0.0, "p99": 0.0}
+    def q(p):
+        return lat[min(len(lat) - 1, int(p * (len(lat) - 1) + 0.5))]
+    return {"p50": q(0.50) * 1e3, "p99": q(0.99) * 1e3}
+
+
+# -- the RPC client -------------------------------------------------------
+
+
+class RpcClient:
+    """Deadline/retry/backoff over a ``Channel``.
+
+    One logical RPC = one rpc_id across every retry, so the worker's
+    reply cache answers a re-ask without re-executing — the channel
+    may be at-least-once, effects stay exactly-once. Stale frames (a
+    duplicated or delayed reply for an earlier rpc_id) are counted and
+    skipped. A definitive ERR reply raises the matching typed serving
+    error; an exhausted budget raises ``TransportTimeout`` /
+    ``TransportError`` for the replica layer to fold into the
+    supervisor ladder."""
+
+    def __init__(self, channel: Channel, slot: int, transport_cfg, *,
+                 stats: Optional[TransportStats] = None,
+                 clock=time.monotonic, sleep=time.sleep):
+        self.channel = channel
+        self.slot = int(slot)
+        self.cfg = transport_cfg
+        self.stats = stats if stats is not None else TransportStats()
+        self._clock = clock
+        self._sleep = sleep
+        self._next_id = 1
+
+    def call(self, kind: str, payload: Optional[dict] = None, *,
+             deadline_s: Optional[float] = None,
+             retries: Optional[int] = None) -> dict:
+        cfg = self.cfg
+        deadline_s = float(cfg.rpc_deadline_seconds
+                           if deadline_s is None else deadline_s)
+        retries = int(cfg.rpc_retries if retries is None else retries)
+        rpc_id = self._next_id
+        self._next_id += 1
+        msg = {"v": PROTOCOL_VERSION, "id": rpc_id, "kind": kind}
+        if payload:
+            msg.update(payload)
+        frame = encode_frame(msg)
+        self.stats.rpcs += 1
+        t0 = self._clock()
+        attempts = retries + 1
+        last = "no attempt ran"
+        with span("transport.rpc", kind=kind, slot=self.slot):
+            for attempt in range(attempts):
+                if attempt:
+                    self.stats.retries += 1
+                    if not self.channel.synchronous:
+                        self._sleep(backoff_delay(
+                            attempt - 1,
+                            base_seconds=cfg.retry_backoff_seconds,
+                            max_seconds=1.0))
+                left = deadline_s - (self._clock() - t0)
+                if left <= 0:
+                    break
+                try:
+                    self.channel.send(frame)
+                    self.stats.bytes_sent += len(frame)
+                except (OSError, TransportError) as e:
+                    self.stats.send_errors += 1
+                    last = f"send failed: {e}"
+                    continue
+                reply = self._await_reply(rpc_id, left / attempts)
+                if reply is None:
+                    last = f"no reply within attempt {attempt + 1}"
+                    continue
+                if reply.get("kind") == MSG_ERR:
+                    self._raise_error_reply(kind, reply)
+                return reply
+        self.stats.timeouts += 1
+        raise TransportTimeout(
+            self.slot, kind,
+            f"{deadline_s:.1f}s deadline over {attempts} attempt(s); "
+            f"last: {last}")
+
+    def _await_reply(self, rpc_id: int,
+                     timeout: float) -> Optional[dict]:
+        t0 = self._clock()
+        while True:
+            left = max(0.0, timeout - (self._clock() - t0))
+            try:
+                data = self.channel.recv(left)
+            except (OSError, TransportError) as e:
+                logger.warning(f"transport recv failed on replica "
+                               f"{self.slot}: {e}")
+                return None
+            if data is None:
+                return None
+            self.stats.bytes_recv += len(data)
+            try:
+                reply = decode_frame(data)
+            except TransportDecodeError:
+                self.stats.decode_errors += 1
+                return None         # attempt over; the re-ask recovers
+            if reply.get("id") != rpc_id:
+                self.stats.stale += 1
+                continue            # dup/late frame for an earlier rpc
+            return reply
+
+    def _raise_error_reply(self, op: str, reply: dict):
+        etype = reply.get("etype", "")
+        text = reply.get("error", "")
+        if etype == "overload":
+            err = ServingOverloadError(
+                reply.get("reason", text),
+                queue_depth=int(reply.get("queue_depth", 0)),
+                kv_util=float(reply.get("kv_util", 0.0)),
+                free_blocks=int(reply.get("free_blocks", 0)),
+                shed_uids=tuple(reply.get("shed_uids", ())))
+            raise err
+        if etype == "unknown":
+            raise UnknownRequestError(reply.get("uid"),
+                                      surface=f"replica {self.slot}")
+        if etype == "terminal":
+            raise TerminalRequestError(reply.get("uid"),
+                                       reply.get("state", "?"))
+        if etype == "value":
+            raise ValueError(text)
+        raise TransportError(self.slot, op,
+                             f"worker error reply: {text}")
+
+
+# -- health probing -------------------------------------------------------
+
+
+class HealthProber:
+    """Per-replica probe ledger the router's degraded-mode logic reads:
+    ``consec_fails >= 1`` -> suspect (no NEW placements), a streak past
+    ``probe_fail_threshold`` -> the partition verdict, and an
+    ``ok()`` after failures -> a reconnect (resync + flap tracking)."""
+
+    def __init__(self):
+        self.probes = 0
+        self.failures = 0
+        self.consec_fails = 0
+        self.reconnects = 0
+        self.latencies: deque = deque(maxlen=256)
+
+    @property
+    def suspect(self) -> bool:
+        return self.consec_fails > 0
+
+    def ok(self, latency_s: float) -> bool:
+        """Record a round-trip; returns True when this probe RECOVERED
+        the replica from a failure streak (a reconnect)."""
+        self.probes += 1
+        self.latencies.append(float(latency_s))
+        recovered = self.consec_fails > 0
+        self.consec_fails = 0
+        if recovered:
+            self.reconnects += 1
+        return recovered
+
+    def fail(self) -> int:
+        self.probes += 1
+        self.failures += 1
+        self.consec_fails += 1
+        return self.consec_fails
+
+    def reset(self) -> None:
+        self.consec_fails = 0
+
+    def as_dict(self) -> dict:
+        return {"probes": self.probes, "failures": self.failures,
+                "consec_fails": self.consec_fails,
+                "reconnects": self.reconnects,
+                "suspect": self.suspect,
+                "latency_ms": probe_percentiles_ms(self.latencies)}

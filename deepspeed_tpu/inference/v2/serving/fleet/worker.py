@@ -1,0 +1,482 @@
+"""Fleet worker: the replica-side half of the transport.
+
+``WorkerCore`` owns one ``ServingFrontend`` and answers the typed
+message protocol (transport.py): SUBMIT/CANCEL mutate the frontend,
+STEP advances it one iteration and replies with everything the router
+needs that step — per-uid token tails past the router's cursors, the
+request states, a TRIE_DELTA of prefix-cache membership churn, and a
+fresh health snapshot — so steady-state serving is exactly ONE
+round-trip per replica per router step. TOKENS is the read-only
+variant (tails + states, no step) for the cancel-race drain; SNAPSHOT
+returns the FULL trie listing for resync after a reconnect.
+
+Exactly-once effects over an at-least-once channel: every effectful
+reply (SUBMIT/CANCEL/STEP) is cached by rpc_id in a small bounded
+cache, so a duplicated or re-asked request gets the recorded answer
+without re-executing — a dropped reply costs a retry, never a double
+step.
+
+The module is also the ``SocketChannel`` process entrypoint::
+
+    python -m deepspeed_tpu.inference.v2.serving.fleet.worker \
+        --connect 127.0.0.1:PORT --slot 0 --serving-json '{...}' \
+        --factory mod:fn --worker-args '{...}'
+
+``--factory mod:fn`` resolves to ``fn(slot, **worker_args) ->
+InferenceEngineV2`` inside the worker process; the default (empty)
+factory builds the built-in tiny-llama engine (deterministic params
+from a fixed seed), which is how the socket e2e reproduces the
+loopback streams bitwise.
+"""
+
+import argparse
+import json
+import socket
+import struct
+import subprocess
+import sys
+from typing import Optional
+
+import numpy as np
+
+from .....resilience.errors import (ServingOverloadError,
+                                    TerminalRequestError,
+                                    TransportConnectError,
+                                    UnknownRequestError)
+from .....runtime.lifecycle import BoundedCache
+from .....utils.logging import logger
+from ..frontend import ServingFrontend
+from .transport import (MSG_CANCEL, MSG_ERR, MSG_HEARTBEAT, MSG_HELLO,
+                        MSG_SHUTDOWN, MSG_SNAPSHOT, MSG_STEP,
+                        MSG_SUBMIT, MSG_TOKENS, PROTOCOL_VERSION,
+                        TransportDecodeError, decode_frame,
+                        encode_frame)
+
+_EFFECTFUL = (MSG_SUBMIT, MSG_CANCEL, MSG_STEP)
+
+
+def _sampling_from_wire(d: Optional[dict]):
+    if not d:
+        return None
+    from ....sampling import SamplingParams
+    return SamplingParams(
+        temperature=float(d.get("temperature", 0.0)),
+        top_k=d.get("top_k"), top_p=d.get("top_p"),
+        seed=d.get("seed"), speculation=d.get("speculation"))
+
+
+def sampling_to_wire(sp) -> Optional[dict]:
+    if sp is None:
+        return None
+    return {"temperature": sp.temperature, "top_k": sp.top_k,
+            "top_p": sp.top_p, "seed": sp.seed,
+            "speculation": sp.speculation}
+
+
+class WorkerCore:
+    """One replica's request handler — channel-agnostic: the loopback
+    channel calls ``handle()`` in-process, the socket loop feeds it
+    decoded frames. Single-threaded like everything in the serving
+    stack."""
+
+    def __init__(self, slot: int, frontend: ServingFrontend):
+        self.slot = int(slot)
+        self.frontend = frontend
+        self.shutdown = False
+        self.steps = 0
+        # rpc_id -> recorded reply: the exactly-once seam. 64 entries
+        # cover far more channel lag than a held/duplicated frame can
+        # accumulate before the retry budget gives up on it.
+        self._replies = BoundedCache("fleet_worker_replies",
+                                     max_entries=64)
+        # trie membership journal -> TRIE_DELTA (drained every STEP,
+        # so it never grows past one step's churn)
+        self._journal = []
+        self._trie_seq = 0
+        pc = frontend.engine.prefix_cache
+        if pc is not None:
+            pc.journal = self._journal
+        # per-uid token accumulation fed by the frontend's on_token:
+        # tails must survive the frontend RETIRING a finished request
+        # (max_retained_requests) before the router's cursor catches
+        # up. Pruned every STEP once a uid leaves the router's cursor
+        # set with its request terminal/gone, so it stays bounded by
+        # the in-flight window.
+        self._tokens = {}
+
+    # -- dispatch -------------------------------------------------------
+    def handle(self, msg: dict) -> dict:
+        kind = msg.get("kind", "")
+        rpc_id = msg.get("id")
+        if kind in _EFFECTFUL:
+            cached = self._replies.get(rpc_id)
+            if cached is not None:
+                return cached
+        try:
+            reply = self._dispatch(kind, msg)
+        except ServingOverloadError as e:
+            reply = {"kind": MSG_ERR, "etype": "overload",
+                     "error": str(e), "reason": e.reason,
+                     "queue_depth": e.queue_depth, "kv_util": e.kv_util,
+                     "free_blocks": e.free_blocks,
+                     "shed_uids": list(e.shed_uids)}
+        except UnknownRequestError as e:
+            reply = {"kind": MSG_ERR, "etype": "unknown",
+                     "error": str(e), "uid": e.uid}
+        except TerminalRequestError as e:
+            reply = {"kind": MSG_ERR, "etype": "terminal",
+                     "error": str(e), "uid": e.uid, "state": e.state}
+        except (ValueError, TypeError) as e:
+            reply = {"kind": MSG_ERR, "etype": "value", "error": str(e)}
+        reply["id"] = rpc_id
+        reply["v"] = PROTOCOL_VERSION
+        if kind in _EFFECTFUL and reply.get("kind") != MSG_ERR:
+            self._replies.put(rpc_id, reply)
+        return reply
+
+    def _dispatch(self, kind: str, msg: dict) -> dict:
+        if kind == MSG_HELLO:
+            return self._hello()
+        if kind == MSG_SUBMIT:
+            return self._submit(msg)
+        if kind == MSG_CANCEL:
+            self.frontend.cancel(int(msg["uid"]))
+            return {"kind": "CANCEL_OK"}
+        if kind == MSG_STEP:
+            return self._step(msg)
+        if kind == MSG_TOKENS:
+            out = self._collect(msg.get("cursors") or {})
+            out["kind"] = "TOKENS_OK"
+            return out
+        if kind == MSG_SNAPSHOT:
+            return self._full_snapshot("SNAPSHOT_OK")
+        if kind == MSG_HEARTBEAT:
+            fe = self.frontend
+            return {"kind": "HEARTBEAT_OK",
+                    "queued": fe.queued_requests,
+                    "active": fe.active_requests}
+        if kind == MSG_SHUTDOWN:
+            self.shutdown = True
+            return {"kind": "BYE"}
+        raise ValueError(f"unknown message kind {kind!r}")
+
+    # -- handlers -------------------------------------------------------
+    def _hello(self) -> dict:
+        out = self._full_snapshot("HELLO_OK")
+        out["slot"] = self.slot
+        out["kv_block_size"] = \
+            self.frontend.engine._config.kv_block_size
+        return out
+
+    def _submit(self, msg: dict) -> dict:
+        uid = int(msg["uid"])
+        buf = self._tokens[uid] = []     # fresh attempt, fresh tail
+        self.frontend.submit(
+            np.asarray(msg["prompt"], np.int32),
+            uid=uid,
+            max_new_tokens=msg.get("max_new_tokens"),
+            eos_token_id=msg.get("eos_token_id"),
+            sampling=_sampling_from_wire(msg.get("sampling")),
+            priority=int(msg.get("priority", 0)),
+            deadline_ms=msg.get("deadline_ms"),
+            on_token=buf.append)
+        return {"kind": "SUBMIT_OK"}
+
+    def _step(self, msg: dict) -> dict:
+        cursors = msg.get("cursors") or {}
+        self.frontend.step()
+        self.steps += 1
+        out = self._collect(cursors)
+        out["kind"] = "STEP_OK"
+        out["progressed"] = True
+        delta = self._drain_delta()
+        if delta is not None:
+            out["trie_delta"] = delta
+        out["snapshot"] = self.snapshot()
+        self._prune_buffers(cursors)
+        return out
+
+    def _collect(self, cursors: dict) -> dict:
+        """Token tails past the router's per-uid cursors + request
+        states. Tails come from the worker-side accumulation buffers
+        (they survive the frontend retiring a finished request); a uid
+        the frontend no longer knows reports state ``None`` — the
+        router's vanished-request close-out path infers FINISHED from
+        the delivered tokens."""
+        tokens = {}
+        states = {}
+        fe = self.frontend
+        for uid_s, cur in cursors.items():
+            uid = int(uid_s)
+            cur = max(0, int(cur))
+            buf = self._tokens.get(uid)
+            tail = buf[cur:] if buf else []
+            if tail:
+                tokens[uid_s] = {"start": cur,
+                                 "toks": [int(t) for t in tail]}
+            rr = fe.get_request(uid)
+            if rr is None:
+                states[uid_s] = None
+            else:
+                states[uid_s] = {"state": rr.state.name,
+                                 "shed_reason": rr.shed_reason}
+        return {"tokens": tokens, "states": states}
+
+    def _prune_buffers(self, cursors: dict) -> None:
+        """Drop token buffers the router is done with: the uid left
+        the STEP cursor set (the router closed its handle) and the
+        request is terminal or gone on this side. A lost STEP reply
+        keeps the uid in the router's cursors, so its buffer survives
+        for the re-collect."""
+        live = {int(u) for u in cursors}
+        for uid in list(self._tokens):
+            if uid in live:
+                continue
+            rr = self.frontend.get_request(uid)
+            if rr is None or rr.done:
+                del self._tokens[uid]
+
+    def _drain_delta(self) -> Optional[dict]:
+        """Fold the journal into one net TRIE_DELTA (an add+del of the
+        same digest within a step cancels). Sequence numbers order
+        deltas against SNAPSHOT resyncs; no churn -> no delta, seq
+        unchanged."""
+        if not self._journal:
+            return None
+        net = {}
+        for op, d in self._journal:
+            net[d] = op
+        self._journal.clear()
+        self._trie_seq += 1
+        return {"seq": self._trie_seq,
+                "add": [d.hex() for d, op in net.items()
+                        if op == "add"],
+                "del": [d.hex() for d, op in net.items()
+                        if op == "del"]}
+
+    def _full_snapshot(self, kind: str) -> dict:
+        self._drain_delta()     # fold pending churn into the seq
+        pc = self.frontend.engine.prefix_cache
+        trie = [d.hex() for d in pc._entries] if pc is not None else []
+        return {"kind": kind, "snapshot": self.snapshot(),
+                "trie": trie, "trie_seq": self._trie_seq,
+                # the PR-9 steady-window invariant, checkable over the
+                # wire (the socket acceptance cannot read the worker's
+                # frontend report directly)
+                "steady_blocking_syncs": int(
+                    self.frontend.metrics.report()
+                    ["steady_blocking_syncs"])}
+
+    def snapshot(self) -> dict:
+        """The polling-cheap health/load view (Replica caches the
+        latest one, so the router's scoring pass costs no RPC)."""
+        fe = self.frontend
+        q = fe.metrics.quick_stats()
+        eng = fe.engine
+        snap = {
+            "queued": fe.queued_requests,
+            "active": fe.active_requests,
+            "outstanding": fe.queued_requests + fe.active_requests,
+            "capacity": eng._config.max_ragged_sequence_count,
+            "kv_util": eng.kv_utilization,
+            "free_blocks": eng.free_blocks,
+            "steps": q["steps"],
+            "tokens_emitted": q["tokens_emitted"],
+            "recompiles": q["recompiles"],
+            "blocking_syncs": q["blocking_syncs"],
+        }
+        pc = eng.prefix_cache
+        if pc is not None:
+            snap["prefix_hits"] = pc.hits
+            snap["prefix_misses"] = pc.misses
+            snap["prefix_tokens_reused"] = pc.tokens_reused
+            snap["prefix_cached_blocks"] = pc.cached_blocks
+        return snap
+
+
+# -- engine factories ----------------------------------------------------
+
+
+def tiny_llama_factory(slot: int, *, engine: Optional[dict] = None,
+                       tp: int = 1, seed: int = 0):
+    """The built-in worker factory: a deterministic tiny-llama engine
+    (fixed-seed params), geometry-compatible with the fleet test
+    fixtures — a socket worker built from this produces the SAME token
+    streams as an in-process loopback replica, bitwise. ``tp > 1``
+    initializes the mesh inside the worker process (the process owns
+    its whole simulated host, so it takes all local devices)."""
+    import jax
+    from .....models.llama import LlamaConfig, LlamaForCausalLM
+    from ...engine_v2 import (InferenceEngineV2,
+                              RaggedInferenceEngineConfig)
+    tp = int(tp)
+    if tp > 1:
+        from .....parallel.mesh import MeshConfig, mesh_manager
+        mesh_manager.reset()
+        mesh_manager.init(MeshConfig(data=-1, tensor=tp))
+    cfg = LlamaConfig.tiny()
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.PRNGKey(int(seed)), np.zeros((1, 8), np.int32))
+    ekw = dict(token_budget=32, max_ragged_sequence_count=4,
+               n_kv_blocks=48, kv_block_size=8, max_blocks_per_seq=8,
+               kv_dtype="float32")
+    ekw.update(engine or {})
+    if tp > 1:
+        ekw.setdefault("tp_size", tp)
+    return InferenceEngineV2(params, cfg,
+                             RaggedInferenceEngineConfig(**ekw))
+
+
+def resolve_factory(spec: str):
+    """``"module:function"`` -> the callable; "" -> the built-in."""
+    if not spec:
+        return tiny_llama_factory
+    mod, sep, fn = spec.partition(":")
+    if not sep:
+        raise ValueError(f"worker factory spec {spec!r}: expected "
+                         f"'module:function'")
+    import importlib
+    return getattr(importlib.import_module(mod), fn)
+
+
+# -- process spawn (the SocketChannel connector) -------------------------
+
+
+def make_connector(slot: int, transport_cfg, serving_cfg_dict: dict):
+    """Build the ``SocketChannel`` connector for one replica slot:
+    listen on an ephemeral localhost port, spawn the worker process
+    pointed back at it, and accept within the connect deadline. The
+    worker builds its whole engine BEFORE dialing, so the accept
+    doubles as the readiness signal and ``connect_deadline_seconds``
+    budgets the entire cold start (jax import + engine build)."""
+
+    def connector():
+        lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(1)
+        port = lst.getsockname()[1]
+        cmd = [sys.executable, "-m",
+               "deepspeed_tpu.inference.v2.serving.fleet.worker",
+               "--connect", f"127.0.0.1:{port}",
+               "--slot", str(slot),
+               "--serving-json", json.dumps(serving_cfg_dict),
+               "--factory", transport_cfg.worker_factory or "",
+               "--worker-args",
+               json.dumps(transport_cfg.worker_args or {})]
+        proc = subprocess.Popen(cmd)      # env inherited: JAX_PLATFORMS
+        lst.settimeout(float(transport_cfg.connect_deadline_seconds))
+        try:
+            conn, _ = lst.accept()
+        except socket.timeout:
+            proc.kill()
+            proc.wait(timeout=5.0)
+            raise TransportConnectError(
+                slot, "connect",
+                f"worker did not dial back within "
+                f"{transport_cfg.connect_deadline_seconds:.0f}s") \
+                from None
+        finally:
+            lst.close()
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return proc, conn
+
+    return connector
+
+
+# -- the socket serve loop -----------------------------------------------
+
+_HDR = struct.Struct(">4sHI")
+
+
+def _read_frame(sock: socket.socket, buf: bytearray,
+                core: WorkerCore) -> Optional[bytes]:
+    """Blocking framed read (1s poll ticks so shutdown/parent-death
+    are noticed); returns None when the peer is gone."""
+    while not core.shutdown:
+        if len(buf) >= _HDR.size:
+            _m, _v, n = _HDR.unpack_from(bytes(buf[:_HDR.size]))
+            end = _HDR.size + n
+            if len(buf) >= end:
+                frame = bytes(buf[:end])
+                del buf[:end]
+                return frame
+        sock.settimeout(1.0)
+        try:
+            chunk = sock.recv(65536)
+        except socket.timeout:
+            continue
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return None
+
+
+def serve_socket(core: WorkerCore, sock: socket.socket) -> None:
+    buf = bytearray()
+    while not core.shutdown:
+        frame = _read_frame(sock, buf, core)
+        if frame is None:
+            break
+        try:
+            msg = decode_frame(frame)
+        except TransportDecodeError as e:
+            # cannot even read the rpc_id — the router's retry re-asks
+            logger.warning(f"worker {core.slot} dropped undecodable "
+                           f"frame: {e.reason}")
+            continue
+        try:
+            reply = core.handle(msg)
+        except Exception as e:  # noqa: BLE001 — the process boundary:
+            # a worker that died answering one RPC must still answer
+            # the next; the router sees a typed worker-error reply
+            logger.error(f"worker {core.slot} handler failed on "
+                         f"{msg.get('kind')}: {type(e).__name__}: {e}")
+            reply = {"kind": MSG_ERR, "etype": "", "error": str(e),
+                     "id": msg.get("id"), "v": PROTOCOL_VERSION}
+        try:
+            sock.sendall(encode_frame(reply))
+        except OSError:
+            break
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.inference.v2.serving.fleet.worker",
+        description="one fleet replica worker process (SocketChannel)")
+    p.add_argument("--connect", required=True,
+                   help="host:port the router is listening on")
+    p.add_argument("--slot", type=int, default=0)
+    p.add_argument("--serving-json", default="{}",
+                   help="ServingConfig as JSON (the router's replica "
+                        "config)")
+    p.add_argument("--factory", default="",
+                   help="module:function engine factory; empty = the "
+                        "built-in tiny-llama")
+    p.add_argument("--worker-args", default="{}",
+                   help="JSON kwargs for the factory")
+    args = p.parse_args(argv)
+    host, _, port = args.connect.rpartition(":")
+    factory = resolve_factory(args.factory)
+    kwargs = json.loads(args.worker_args)
+    serving_cfg = json.loads(args.serving_json)
+    # build EVERYTHING before dialing the router: the accept on the
+    # other side doubles as the readiness signal, and the connect
+    # deadline budgets the whole cold start (jax import + engine)
+    engine = factory(args.slot, **kwargs)
+    core = WorkerCore(args.slot, ServingFrontend(engine, serving_cfg))
+    sock = socket.create_connection((host or "127.0.0.1", int(port)))
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    logger.warning(f"fleet worker slot {args.slot} connected to "
+                   f"{args.connect} (pid {__import__('os').getpid()})")
+    serve_socket(core, sock)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

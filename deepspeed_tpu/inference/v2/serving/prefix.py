@@ -100,6 +100,14 @@ class PrefixCache:
         self.max_blocks = max(0, int(max_blocks))
         self._entries: Dict[bytes, _Entry] = {}
         self._tick = 0
+        # optional membership journal: when set (a list), every digest
+        # registered or evicted is appended as ("add"/"del", digest).
+        # The fleet worker drains it into TRIE_DELTA replies so the
+        # router's affinity map tracks this trie's ACTUAL contents —
+        # eviction here must never strand a stale router entry. The
+        # owner drains per step, so it never grows past one step's
+        # churn.
+        self.journal = None
         # stats (process-lifetime for this engine; surfaced through
         # get_serving_report()["prefix"])
         self.hits = 0
@@ -189,6 +197,8 @@ class PrefixCache:
                 self._entries[d] = _Entry(blocks[i], parent, self._tick)
                 fresh += 1
                 self.inserted_blocks += 1
+                if self.journal is not None:
+                    self.journal.append(("add", d))
             else:
                 e.tick = self._tick
             parent = d
@@ -238,6 +248,8 @@ class PrefixCache:
             freed += self.allocator.free_blocks - before
             evicted += 1
             self.evicted_blocks += 1
+            if self.journal is not None:
+                self.journal.append(("del", d))
         return freed
 
     def reclaim(self, n_blocks: int) -> int:

@@ -137,6 +137,44 @@ class UnrecoverableWorkerFailure(ResilienceError):
             f"(terminal exit code {exit_code})")
 
 
+class TransportError(ResilienceError):
+    """Terminal transport failure on a fleet RPC channel: the retry
+    budget is exhausted (or the failure is not retryable at all). The
+    caller-facing contract is one hop up — ``Replica`` translates this
+    into the ``WorkerFailureError`` the FleetSupervisor's ladder
+    already keys on — but the transport layer keeps its own taxonomy
+    so telemetry can tell a timeout from a torn frame from a refused
+    connection."""
+
+    def __init__(self, slot: int, op: str, reason: str = ""):
+        self.slot = slot
+        self.op = op
+        self.reason = reason
+        super().__init__(
+            f"transport failure on replica {slot} ({op})"
+            + (f": {reason}" if reason else ""))
+
+
+class TransportTimeout(TransportError):
+    """An RPC's deadline elapsed with no decodable reply (every
+    attempt of the retry budget timed out — a dropped message, a hung
+    worker, or a partition; the transport cannot tell which, the
+    health prober's streak logic decides)."""
+
+
+class TransportConnectError(TransportError):
+    """Establishing (or re-establishing) the channel to a worker
+    failed past the retry budget — the worker process is gone or
+    never came up."""
+
+
+class TransportDecodeError(TransportError):
+    """A received frame failed to decode (truncated or corrupt
+    payload behind an intact length prefix). Retryable per attempt —
+    the peer's reply cache answers a re-ask without re-executing —
+    and terminal only once the budget is spent."""
+
+
 class InjectedFault(ResilienceError):
     """A deliberately injected failure (FaultInjector). Base class so
     tests can distinguish injected faults from organic ones."""

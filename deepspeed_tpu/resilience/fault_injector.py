@@ -32,9 +32,18 @@ Spec grammar (config ``resilience.fault_injection`` or env
     <site>:<kind>[@<after>][x<count>][~<arg>]
 
     kind   ioerror | error | hang | kill | slow | corrupt
+           | drop | delay | dup | reorder | truncate
     after  fire on the Nth call to the site (0-based, default 0)
     count  how many consecutive calls fault (default 1; 'inf' = forever)
     arg    kind parameter (hang: seconds to sleep, default 3600)
+
+The drop/delay/dup/reorder/truncate kinds are MESSAGE-CHANNEL faults
+for consuming sites (the fleet transport's ``FaultyChannel``): a
+fractional ``~arg`` < 1 with no explicit count reads as a rate
+("transport.send:drop~0.1" drops ~10% of sends forever — count
+defaults to 'inf' and the site hashes the call ordinal to decide each
+occurrence deterministically). A classic ``fire()`` site degrades
+them sanely: delay sleeps like hang, the rest raise like error.
 
 The ``kill`` / ``slow`` / ``corrupt`` kinds exist for sites that
 *interpret* their matched spec via ``consume()`` instead of having
@@ -67,7 +76,16 @@ from .errors import InjectedFault, InjectedIOError
 # honest against it
 from .fault_sites import KNOWN_SITES  # noqa: F401  (re-exported)
 
-_KINDS = ("ioerror", "error", "hang", "kill", "slow", "corrupt")
+_KINDS = ("ioerror", "error", "hang", "kill", "slow", "corrupt",
+          "drop", "delay", "dup", "reorder", "truncate")
+
+# the message-channel kinds (serving/fleet/transport.py FaultyChannel
+# interprets them via consume()): for these, a fractional ``~arg``
+# (< 1) with no explicit count reads as a RATE — "drop~0.1" means
+# "drop ~10% of messages forever", so count defaults to 'inf' and the
+# consuming site applies the probability deterministically off the
+# site ordinal (never randomness — drills replay)
+_CHANNEL_KINDS = ("drop", "delay", "dup", "reorder", "truncate")
 
 ENV_SPEC = "DSTPU_FAULT_INJECT"
 
@@ -113,6 +131,9 @@ class FaultSpec:
         if m.group("count"):
             count = float("inf") if m.group("count") == "inf" \
                 else int(m.group("count"))
+        elif m.group("kind") in _CHANNEL_KINDS and m.group("arg") \
+                and float(m.group("arg")) < 1.0:
+            count = float("inf")      # a rate spec: applies forever
         return cls(site, m.group("kind"),
                    after=int(m.group("after") or 0), count=count,
                    arg=float(m.group("arg") or 3600.0),
@@ -190,26 +211,37 @@ class FaultInjector:
             return
         label = f"{site}[{n}]" + (f" ({detail})" if detail else "")
         logger.warning(f"fault injection: {spec.kind} at {label}")
-        if spec.kind in ("hang", "slow"):
+        if spec.kind in ("hang", "slow", "delay"):
             time.sleep(spec.arg)
             return
         if spec.kind == "ioerror":
             raise InjectedIOError(f"injected I/O fault at {label}")
+        # kill/corrupt and the channel kinds (drop/dup/reorder/
+        # truncate) only have rich semantics at consuming sites; a
+        # classic fire() site degrades them to a raise
         raise InjectedFault(f"injected fault at {label}")
 
-    def consume(self, site: str, detail: str = ""):
+    def consume(self, site: str, detail: str = "",
+                with_ordinal: bool = False):
         """Like ``fire`` but returns the matched ``FaultSpec`` (or
         None) for the CALLER to interpret instead of acting on it —
         the seam for sites whose failure modes are richer than
-        raise/sleep (pg_sim's per-worker kill/hang/slow/corrupt).
-        Shares the per-site call ordinals and the ``fired`` audit log
-        with ``fire``, so specs and tests reason about one counter."""
+        raise/sleep (pg_sim's per-worker kill/hang/slow/corrupt, the
+        fleet transport's message-channel kinds). Shares the per-site
+        call ordinals and the ``fired`` audit log with ``fire``, so
+        specs and tests reason about one counter. With
+        ``with_ordinal`` the return is ``(spec, ordinal)`` — the hook
+        rate specs need: a consuming site hashes the ordinal to decide
+        deterministically whether this occurrence applies."""
         spec, n = self._match(site)
-        if spec is not None:
+        if spec is not None and (spec.count != float("inf")
+                                 or n == spec.after):
+            # an 'inf' rate spec matches every call — log the arming
+            # occurrence only, not one line per message
             label = f"{site}[{n}]" + (f" ({detail})" if detail else "")
             logger.warning(
                 f"fault injection: {spec.kind} consumed at {label}")
-        return spec
+        return (spec, n) if with_ordinal else spec
 
     class _Scope:
         def __init__(self, injector, spec):

@@ -62,6 +62,24 @@ FAULT_SITES = {
         "(inference/v2/serving/fleet/replica.py poll_fault; kinds "
         "kill / hang / slow map to replica death / silence / "
         "beats-without-progress)",
+    # ---- fleet transport channel (inference/v2/serving/fleet/transport.py) ----
+    # one consume() per message through a FaultyChannel, interpreted
+    # by the channel itself: drop / delay / dup / reorder / truncate
+    # (fractional ~arg < 1 = deterministic rate keyed on the ordinal).
+    "transport.send":
+        "faulty-channel hook on every router->worker message "
+        "(SUBMIT/CANCEL/STEP/SNAPSHOT/HEARTBEAT requests): drop loses "
+        "the request (the worker never sees it), truncate corrupts "
+        "its payload behind an intact length prefix",
+    "transport.recv":
+        "faulty-channel hook on every worker->router message "
+        "(replies incl. TOKENS/TRIE_DELTA payloads): drop loses the "
+        "reply after the worker already acted (the retried ask hits "
+        "the worker's reply cache), dup re-delivers it",
+    "transport.connect":
+        "faulty-channel hook on channel (re)establishment — drop / "
+        "error refuse the connection (a worker that never comes up); "
+        "drives the respawn-connect-failure path",
     # ---- pg_sim fault domain (tools/pg_sim/pg.py) ----
     # one consume() per (step, worker slot) in rank order — ordinal
     # = step * world_size + rank, so a spec can target any worker at

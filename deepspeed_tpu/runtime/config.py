@@ -322,6 +322,45 @@ class ServingSpeculationConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class FleetTransportConfig(DeepSpeedConfigModel):
+    """Fleet RPC transport knobs (inference/v2/serving/fleet/
+    transport.py), config section ``serving.fleet.transport``. See
+    README "Fleet serving" / "Transport" for full semantics."""
+    # "loopback" (in-process worker core, deterministic — the default
+    # for tests and single-host runs) | "socket" (one OS process per
+    # replica via the ``fleet.worker`` entrypoint, localhost sockets)
+    channel: str = "loopback"
+    # per-RPC deadlines (wall seconds; loopback treats an empty inbox
+    # as an immediate attempt timeout, so these only gate sockets).
+    # STEP's deadline must absorb a worker-side compile.
+    rpc_deadline_seconds: float = 30.0
+    probe_deadline_seconds: float = 2.0
+    # a socket worker imports jax and builds its engine before it
+    # answers HELLO — the connect budget covers that cold start
+    connect_deadline_seconds: float = 120.0
+    # retry budget per RPC (re-asks ride the worker's reply cache, so
+    # at-least-once delivery keeps exactly-once effects) + backoff
+    rpc_retries: int = 3
+    retry_backoff_seconds: float = 0.02
+    # health prober: HEARTBEAT round-trip per pooled replica every N
+    # router steps; ``probe_fail_threshold`` consecutive failures is
+    # the partition verdict (supervisor ladder). 1+ failures marks the
+    # replica suspect: excluded from NEW placements, still stepped.
+    probe_interval_steps: int = 1
+    probe_fail_threshold: int = 3
+    # transport_flap alert: this many reconnects (suspect->healthy
+    # recoveries) within the window trips the alert
+    flap_window_steps: int = 50
+    flap_alert_reconnects: int = 3
+    # socket workers: "module:function" spec resolving to
+    # ``factory(slot) -> InferenceEngineV2`` in the worker process;
+    # "" = the built-in tiny-llama factory (worker.py), whose kwargs
+    # come from ``worker_args`` (JSON-able)
+    worker_factory: str = ""
+    worker_args: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
 class ServingFleetConfig(DeepSpeedConfigModel):
     """Fleet router knobs (inference/v2/serving/fleet/), config section
     ``serving.fleet``: N data-parallel replicas behind one router with
@@ -353,6 +392,8 @@ class ServingFleetConfig(DeepSpeedConfigModel):
     # alert when (max - min) outstanding work across alive replicas
     # exceeds this spread; 0 = off
     imbalance_alert_spread: int = 0
+    # the RPC layer between router and replica workers
+    transport: FleetTransportConfig = submodel(FleetTransportConfig)
 
 
 @dataclasses.dataclass

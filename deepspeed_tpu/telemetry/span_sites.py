@@ -108,6 +108,19 @@ SPAN_SITES = {
     "fleet.respawn":
         "rebuilding a failed replica and rejoining it to the scoring "
         "pool (args: slot, generation)",
+    # ---- fleet transport (inference/v2/serving/fleet/transport.py) ----
+    "transport.rpc":
+        "one fleet RPC end-to-end incl. its retry budget (args: kind, "
+        "slot, attempts) — the per-message cost the fleet step "
+        "decomposition attributes to the channel",
+    "transport.probe":
+        "one health-probe HEARTBEAT round-trip (args: slot) — its "
+        "wall time feeds the probe-latency percentiles in the fleet "
+        "report's transport block",
+    "fleet.resync":
+        "resynchronizing a reconnecting replica's affinity view: "
+        "SNAPSHOT full-trie rebuild, then deltas resume (args: slot, "
+        "blocks)",
     # ---- elastic supervisor (elasticity/supervisor.py) ----
     "supervisor.gate":
         "the pre-dispatch health gate (one per supervised step)",

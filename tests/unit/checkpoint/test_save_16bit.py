@@ -41,6 +41,7 @@ def test_zero3_gated_without_gather_flag(tmp_path, rng, eight_devices):
     assert not os.path.exists(tmp_path / "model_16bit.npz")
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 14)
 def test_zero3_gathers_full_weights(tmp_path, rng, eight_devices):
     from deepspeed_tpu.checkpoint import load_16bit_state
     from deepspeed_tpu.utils.tree import flatten_with_names

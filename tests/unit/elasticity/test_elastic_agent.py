@@ -229,6 +229,7 @@ def test_resume_latest_env_dir_fallback(tmp_path, monkeypatch):
 
 
 @pytest.mark.fault
+@pytest.mark.slow  # tier-1 diet (ISSUE 14)
 def test_resume_latest_stale_latest_recovers_previous_good(
         tmp_path, eight_devices):
     """``latest`` names a tag whose payload is gone (kill between the

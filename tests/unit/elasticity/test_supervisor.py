@@ -96,6 +96,7 @@ class TestLadder:
         assert losses[-2:] == ctrl
         sup.close()
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 14)
     def test_transient_hang_recovers_via_retry_rung(self, tmp_path,
                                                     eight_devices):
         """A one-step hang clears on the retry rung: no rollback, no
@@ -285,6 +286,7 @@ def test_plan_shrink_batch_keeps_global_batch():
 @pytest.mark.fault
 class TestShrinkReshard:
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 14)
     def test_reshard_round_trips_state_exactly(self, tmp_path,
                                                eight_devices):
         """Gather-and-compare: every master/optimizer leaf resharded

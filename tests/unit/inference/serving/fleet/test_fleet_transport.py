@@ -1,0 +1,756 @@
+"""Fleet transport: the wire protocol, FaultyChannel chaos semantics,
+RpcClient deadline/retry/backoff, the worker's exactly-once reply
+cache, the socket serve loop, the affinity-eviction regression
+(stale router map entries after replica-side trie LRU eviction), the
+seeded chaos fault matrix, and the transport acceptance e2e (kill +
+send-drop over both channels)."""
+
+import socket
+import struct
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (FleetRouter, InferenceEngineV2,
+                                        RaggedInferenceEngineConfig,
+                                        RequestState, ServingFrontend)
+from deepspeed_tpu.inference.v2.serving.fleet.transport import (
+    MSG_HEARTBEAT, MSG_HELLO, MSG_SHUTDOWN, PROTOCOL_VERSION, Channel,
+    FaultyChannel, HealthProber, LoopbackChannel, RpcClient,
+    SocketChannel, TransportStats, _truncate_frame, decode_frame,
+    encode_frame)
+from deepspeed_tpu.inference.v2.serving.fleet.worker import (
+    WorkerCore, serve_socket)
+from deepspeed_tpu.inference.v2.serving.prefix import chain_digests
+from deepspeed_tpu.resilience.errors import (ServingOverloadError,
+                                             TerminalRequestError,
+                                             TransportConnectError,
+                                             TransportDecodeError,
+                                             TransportError,
+                                             TransportTimeout,
+                                             UnknownRequestError)
+from deepspeed_tpu.resilience.fault_injector import fault_injector
+from deepspeed_tpu.runtime.config import FleetTransportConfig
+
+SYS = [list(range(1, 18)), list(range(101, 118)),
+       list(range(201, 218))]
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault_injector.reset()
+    yield
+    fault_injector.reset()
+
+
+def _tcfg(**kw):
+    base = dict(rpc_deadline_seconds=5.0, rpc_retries=3,
+                retry_backoff_seconds=0.0)
+    base.update(kw)
+    return FleetTransportConfig(**base)
+
+
+# -- engine-free stand-ins ------------------------------------------------
+
+
+class _EchoCore:
+    """Worker-shaped handler with no reply cache: every delivered
+    frame executes (exposes at-least-once delivery so the tests can
+    count it)."""
+
+    def __init__(self):
+        self.handled = 0
+
+    def handle(self, msg):
+        self.handled += 1
+        return {"kind": msg.get("kind", "?") + "_OK", "id": msg["id"],
+                "v": PROTOCOL_VERSION}
+
+
+class _FakeMetrics:
+    def quick_stats(self):
+        return {"steps": 0.0, "tokens_emitted": 0.0, "recompiles": 0.0,
+                "blocking_syncs": 0.0}
+
+    def report(self):
+        return {"steady_blocking_syncs": 0}
+
+
+class _FakeFrontend:
+    """Just enough frontend surface for WorkerCore units (no engine,
+    no jax): counts effectful calls so exactly-once is observable."""
+
+    queued_requests = 0
+    active_requests = 0
+
+    def __init__(self):
+        self.engine = types.SimpleNamespace(
+            prefix_cache=None, kv_utilization=0.0, free_blocks=48,
+            _config=types.SimpleNamespace(max_ragged_sequence_count=4,
+                                          kv_block_size=8))
+        self.metrics = _FakeMetrics()
+        self.submits = []
+        self.steps = 0
+        self.fail_kind = None
+
+    def submit(self, prompt, *, uid, on_token=None, **kw):
+        if self.fail_kind is not None:
+            raise self.fail_kind("injected frontend failure")
+        self.submits.append(uid)
+
+    def cancel(self, uid):
+        raise UnknownRequestError(uid, surface="fake frontend")
+
+    def step(self):
+        if self.fail_kind is not None:
+            raise self.fail_kind("injected frontend failure")
+        self.steps += 1
+
+    def get_request(self, uid):
+        return None
+
+
+class _NullChannel(Channel):
+    """Accepts every send, never replies — a black-holed worker."""
+    synchronous = True
+
+    def connect(self):
+        pass
+
+    def send(self, data):
+        pass
+
+    def recv(self, timeout=0.0):
+        return None
+
+    def close(self):
+        pass
+
+
+class _ScriptChannel(Channel):
+    """Replies per send from a script of callables (msg -> reply dict
+    or list of reply dicts)."""
+    synchronous = True
+
+    def __init__(self, script):
+        self._script = list(script)
+        self._inbox = []
+
+    def connect(self):
+        pass
+
+    def send(self, data):
+        msg = decode_frame(data)
+        if not self._script:
+            return
+        out = self._script.pop(0)(msg)
+        if out is None:
+            return
+        for m in (out if isinstance(out, list) else [out]):
+            self._inbox.append(encode_frame(m))
+
+    def recv(self, timeout=0.0):
+        return self._inbox.pop(0) if self._inbox else None
+
+    def close(self):
+        self._inbox.clear()
+
+
+def _ok(msg, **extra):
+    return {"kind": msg["kind"] + "_OK", "id": msg["id"],
+            "v": PROTOCOL_VERSION, **extra}
+
+
+# -- engine-backed helpers (mirror test_fleet_router's fixtures) ----------
+
+
+def _factory(params_cfg, **kw):
+    params, cfg = params_cfg
+    eng_kw = dict(token_budget=32, max_ragged_sequence_count=4,
+                  n_kv_blocks=48, kv_block_size=8,
+                  max_blocks_per_seq=8, kv_dtype="float32")
+    eng_kw.update(kw)
+
+    def engine_factory(slot):
+        return InferenceEngineV2(params, cfg,
+                                 RaggedInferenceEngineConfig(**eng_kw))
+    return engine_factory
+
+
+def _router(params_cfg, n=2, serving=None, **kw):
+    cfg = {"fleet": {"n_replicas": n}}
+    for k, v in (serving or {}).items():
+        if k == "fleet":
+            cfg["fleet"].update(v)
+        else:
+            cfg[k] = v
+    return FleetRouter(_factory(params_cfg), cfg, **kw)
+
+
+def _single_frontend_refs(params_cfg, requests, max_new_tokens):
+    eng = _factory(params_cfg)(0)
+    refs = {}
+    for uid, prompt in requests.items():
+        fe = ServingFrontend(eng)
+        r = fe.submit(prompt, uid=uid, max_new_tokens=max_new_tokens)
+        fe.drain()
+        assert r.state == RequestState.FINISHED
+        refs[uid] = list(r.tokens)
+    return refs
+
+
+class TestWireProtocol:
+
+    def test_roundtrip(self):
+        msg = {"v": 1, "id": 7, "kind": "STEP",
+               "cursors": {"4": 2}, "flag": None}
+        assert decode_frame(encode_frame(msg)) == msg
+
+    def test_decode_rejects_torn_frames(self):
+        good = encode_frame({"id": 1, "kind": "HEARTBEAT"})
+        with pytest.raises(TransportDecodeError):
+            decode_frame(good[:3])                       # short
+        with pytest.raises(TransportDecodeError):
+            decode_frame(b"XXXX" + good[4:])             # bad magic
+        bad_ver = struct.pack(">4sHI", b"DTPF", 99,
+                              len(good) - 10) + good[10:]
+        with pytest.raises(TransportDecodeError):
+            decode_frame(bad_ver)                        # version
+        with pytest.raises(TransportDecodeError):
+            decode_frame(good + b"x")                    # length lie
+        arr = b"\x00" * 5
+        frame = struct.pack(">4sHI", b"DTPF", 1, len(arr)) + arr
+        with pytest.raises(TransportDecodeError):
+            decode_frame(frame)                          # not JSON
+        body = b"[1,2,3]"
+        frame = struct.pack(">4sHI", b"DTPF", 1, len(body)) + body
+        with pytest.raises(TransportDecodeError):
+            decode_frame(frame)                          # not a dict
+
+    def test_truncate_keeps_framing_breaks_payload(self):
+        frame = encode_frame({"id": 3, "kind": "STEP",
+                              "cursors": {"9": 1}})
+        t = _truncate_frame(frame)
+        magic, ver, n = struct.unpack_from(">4sHI", t)
+        assert magic == b"DTPF" and ver == PROTOCOL_VERSION
+        assert len(t) == struct.calcsize(">4sHI") + n    # aligned
+        with pytest.raises(TransportDecodeError):
+            decode_frame(t)                              # JSON broken
+
+
+class TestRpcClient:
+
+    def test_deadline_exhaustion_is_typed(self):
+        stats = TransportStats()
+        rpc = RpcClient(_NullChannel(), 0, _tcfg(rpc_retries=2),
+                        stats=stats)
+        with pytest.raises(TransportTimeout):
+            rpc.call(MSG_HEARTBEAT)
+        assert stats.timeouts == 1 and stats.retries == 2
+        assert stats.rpcs == 1
+
+    def test_stale_frames_skipped(self):
+        stats = TransportStats()
+        ch = _ScriptChannel([
+            lambda m: [{"id": m["id"] + 50, "kind": "LATE_OK", "v": 1},
+                       _ok(m)]])
+        rpc = RpcClient(ch, 0, _tcfg(), stats=stats)
+        reply = rpc.call(MSG_HEARTBEAT)
+        assert reply["kind"] == "HEARTBEAT_OK"
+        assert stats.stale == 1
+
+    def test_error_replies_raise_typed(self):
+        def err(etype, **extra):
+            ch = _ScriptChannel([lambda m: {
+                "kind": "ERR", "id": m["id"], "v": 1, "etype": etype,
+                "error": "boom", **extra}])
+            return RpcClient(ch, 0, _tcfg())
+        with pytest.raises(ServingOverloadError):
+            err("overload", reason="full").call("SUBMIT")
+        with pytest.raises(UnknownRequestError):
+            err("unknown", uid=4).call("CANCEL")
+        with pytest.raises(TerminalRequestError):
+            err("terminal", uid=4, state="FINISHED").call("CANCEL")
+        with pytest.raises(ValueError):
+            err("value").call("SUBMIT")
+        with pytest.raises(TransportError):
+            err("").call("STEP")                # the generic fallback
+
+    def test_same_rpc_id_across_retries(self):
+        seen = []
+
+        def record(m):
+            seen.append(m["id"])
+            return _ok(m) if len(seen) > 1 else None   # drop 1st reply
+        rpc = RpcClient(_ScriptChannel([record, record]), 0, _tcfg())
+        rpc.call(MSG_HEARTBEAT)
+        assert len(seen) == 2 and seen[0] == seen[1]
+
+
+class TestFaultyChannel:
+
+    def _rpc(self, core=None, **cfg):
+        core = core if core is not None else _EchoCore()
+        ch = FaultyChannel(LoopbackChannel(core), slot=0)
+        ch.connect()
+        stats = TransportStats()
+        return core, ch, RpcClient(ch, 0, _tcfg(**cfg), stats=stats), \
+            stats
+
+    def test_send_drop_recovers_via_retry(self):
+        core, ch, rpc, stats = self._rpc()
+        fault_injector.configure("transport.send:drop@0")
+        assert rpc.call(MSG_HEARTBEAT)["kind"] == "HEARTBEAT_OK"
+        assert stats.retries == 1 and core.handled == 1
+        assert ch.injected == 1
+
+    def test_recv_dup_counts_stale(self):
+        core, ch, rpc, stats = self._rpc()
+        fault_injector.configure("transport.recv:dup@0")
+        rpc.call(MSG_HEARTBEAT)
+        rpc.call(MSG_HEARTBEAT)
+        assert stats.stale == 1               # the duplicated frame
+        assert core.handled == 2
+
+    def test_recv_truncate_recovers(self):
+        core, ch, rpc, stats = self._rpc()
+        fault_injector.configure("transport.recv:truncate@0")
+        assert rpc.call(MSG_HEARTBEAT)["kind"] == "HEARTBEAT_OK"
+        assert stats.decode_errors == 1 and stats.retries == 1
+
+    def test_send_delay_released_by_channel_ops(self):
+        core, ch, rpc, stats = self._rpc()
+        fault_injector.configure("transport.send:delay@0~2")
+        assert rpc.call(MSG_HEARTBEAT)["kind"] == "HEARTBEAT_OK"
+        assert stats.retries >= 1             # first attempt held
+
+    def test_reorder_swaps_adjacent_messages(self):
+        core = _EchoCore()
+        ch = FaultyChannel(LoopbackChannel(core), slot=0)
+        ch.connect()
+        fault_injector.configure("transport.send:reorder@0")
+        ch.send(encode_frame({"id": 1, "kind": "A"}))
+        ch.send(encode_frame({"id": 2, "kind": "B"}))
+        ids = [decode_frame(ch.recv())["id"],
+               decode_frame(ch.recv())["id"]]
+        assert ids == [2, 1]                  # B overtook A
+
+    def test_rate_spec_is_partial_and_deterministic(self):
+        def run():
+            core = _EchoCore()
+            ch = FaultyChannel(LoopbackChannel(core), slot=0)
+            ch.connect()
+            fault_injector.configure("transport.send:drop~0.3")
+            for i in range(100):
+                ch.send(encode_frame({"id": i, "kind": "HEARTBEAT"}))
+            fault_injector.reset()
+            return core.handled
+        a, b = run(), run()
+        assert a == b                         # ordinal-hash replay
+        assert 40 < a < 95                    # partial, ~70 expected
+
+    def test_connect_fault_is_typed(self):
+        ch = FaultyChannel(LoopbackChannel(_EchoCore()), slot=0)
+        fault_injector.configure("transport.connect:error")
+        with pytest.raises(TransportConnectError):
+            ch.connect()
+
+    def test_classic_kind_degrades_to_send_error(self):
+        core, ch, rpc, stats = self._rpc()
+        fault_injector.configure("transport.send:ioerror@0")
+        assert rpc.call(MSG_HEARTBEAT)["kind"] == "HEARTBEAT_OK"
+        assert stats.send_errors == 1         # InjectedIOError retried
+
+
+class TestWorkerExactlyOnce:
+
+    def test_duplicate_submit_executes_once(self):
+        fe = _FakeFrontend()
+        core = WorkerCore(0, fe)
+        msg = {"v": 1, "id": 7, "kind": "SUBMIT", "uid": 5,
+               "prompt": [1, 2, 3]}
+        r1 = core.handle(dict(msg))
+        r2 = core.handle(dict(msg))           # the re-asked duplicate
+        assert r1["kind"] == "SUBMIT_OK" and r2 == r1
+        assert fe.submits == [5]              # ONE effect
+
+    def test_duplicate_step_steps_once(self):
+        fe = _FakeFrontend()
+        core = WorkerCore(0, fe)
+        msg = {"v": 1, "id": 9, "kind": "STEP", "cursors": {}}
+        r1 = core.handle(dict(msg))
+        r2 = core.handle(dict(msg))
+        assert r1["kind"] == "STEP_OK" and r2 == r1
+        assert fe.steps == 1
+
+    def test_error_replies_are_not_cached(self):
+        fe = _FakeFrontend()
+        fe.fail_kind = ValueError
+        core = WorkerCore(0, fe)
+        msg = {"v": 1, "id": 3, "kind": "SUBMIT", "uid": 5,
+               "prompt": [1]}
+        assert core.handle(dict(msg))["etype"] == "value"
+        fe.fail_kind = None
+        # the re-ask re-executes: a transient failure isn't pinned
+        assert core.handle(dict(msg))["kind"] == "SUBMIT_OK"
+        assert fe.submits == [5]
+
+    def test_unknown_kind_is_a_value_error_reply(self):
+        core = WorkerCore(0, _FakeFrontend())
+        r = core.handle({"v": 1, "id": 1, "kind": "BOGUS"})
+        assert r["kind"] == "ERR" and r["etype"] == "value"
+
+
+class TestSocketServeLoop:
+    """The socket worker loop over an OS socketpair — real framed
+    stream, no subprocess (the subprocess path is the slow-marked
+    socket acceptance + the graft fleet leg)."""
+
+    def _serve(self, fe):
+        a, b = socket.socketpair()
+        core = WorkerCore(0, fe)
+        t = threading.Thread(target=serve_socket, args=(core, b),
+                             daemon=True)
+        t.start()
+        ch = SocketChannel(lambda: (None, a))
+        ch.connect()
+        return core, ch, RpcClient(ch, 0, _tcfg()), t
+
+    def test_rpc_roundtrip_and_shutdown(self):
+        core, ch, rpc, t = self._serve(_FakeFrontend())
+        hello = rpc.call(MSG_HELLO)
+        assert hello["kind"] == "HELLO_OK"
+        assert hello["kv_block_size"] == 8
+        assert rpc.call(MSG_HEARTBEAT)["kind"] == "HEARTBEAT_OK"
+        assert rpc.call(MSG_SHUTDOWN)["kind"] == "BYE"
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        ch.close()
+
+    def test_handler_crash_answers_typed_and_keeps_serving(self):
+        fe = _FakeFrontend()
+        core, ch, rpc, t = self._serve(fe)
+        fe.fail_kind = RuntimeError       # NOT a typed serving error
+        with pytest.raises(TransportError):
+            rpc.call("STEP", {"cursors": {}})
+        fe.fail_kind = None
+        # the process boundary held: the worker answers the next RPC
+        assert rpc.call(MSG_HEARTBEAT)["kind"] == "HEARTBEAT_OK"
+        rpc.call(MSG_SHUTDOWN)
+        t.join(timeout=10.0)
+        ch.close()
+
+
+class TestAffinityEvictionRegression:
+    """The satellite bugfix: the router's affinity map is fed by
+    replica-reported TRIE_DELTAs, so a replica-side LRU eviction
+    DROPS the corresponding map entry (the old placement-time writes
+    kept routing traffic at KV that was gone)."""
+
+    def test_eviction_drops_and_next_delta_refreshes(self, params_cfg):
+        router = _router(params_cfg, n=2,
+                         serving={"prefix": {"max_blocks": 2}})
+        pa = np.asarray(SYS[0] + [31], np.int32)
+        # shares block 0 with pa, diverges in block 1 -> its insert
+        # overflows the 2-block trie and LRU-evicts pa's leaf block
+        pb = np.asarray(SYS[0][:8] + list(range(300, 310)), np.int32)
+        da, db = chain_digests(pa, 8), chain_digests(pb, 8)
+        assert da[0] == db[0] and da[1] != db[1]
+
+        r1 = router.submit(pa, uid=1, max_new_tokens=3)
+        router.drain()
+        assert r1.state == RequestState.FINISHED
+        home = router._entries[1].slot
+        assert all(router._affinity_map.get(d) == home for d in da)
+
+        r2 = router.submit(pb, uid=2, max_new_tokens=3)
+        assert router._entries[2].slot == home    # affinity pulled it
+        router.drain()
+        assert r2.state == RequestState.FINISHED
+        # the replica evicted pa's leaf block; the delta's del reached
+        # the map — no stale entry pulls traffic at evicted KV
+        assert router._affinity_map.get(da[1]) is None
+        assert router._affinity_map.get(db[1]) == home
+        assert router._affinity_map.get(da[0]) == home  # still cached
+        # and the affinity walk degrades to the 1-block prefix cleanly
+        assert router._affinity(da) == (home, 1)
+
+        # resubmitting the evicted chain re-inserts it: the NEXT delta
+        # refreshes the map instead of leaving it stale forever
+        r3 = router.submit(pa, uid=3, max_new_tokens=3)
+        router.drain()
+        assert r3.state == RequestState.FINISHED
+        assert router._affinity_map.get(da[1]) == home
+
+
+def _chaos_serve(params_cfg, specs, n_req=6, max_new_tokens=4,
+                 serving=None):
+    """Staggered shared-prefix traffic through a 2-replica fleet
+    (loopback unless ``serving`` picks the socket channel) with
+    channel chaos armed; returns (router, handles, refs).
+    Deterministic: rate faults hash the site ordinal, so a given spec
+    string replays the identical drill."""
+    reqs_in = {700 + k: SYS[k % 3] + [40 + k] for k in range(n_req)}
+    refs = _single_frontend_refs(params_cfg, reqs_in, max_new_tokens)
+    router = _router(params_cfg, n=2, serving=serving)
+    handles = {}
+
+    def poll(r, step):
+        k = len(handles)
+        if step % 2 == 0 and k < n_req:
+            uid = 700 + k
+            try:
+                handles[uid] = r.submit(reqs_in[uid], uid=uid,
+                                        max_new_tokens=max_new_tokens)
+            except ServingOverloadError:
+                pass          # chaos refused everywhere; retry later
+        return len(handles) < n_req
+    fault_injector.configure(specs)
+    try:
+        router.serve(poll=poll, max_steps=500)
+    finally:
+        fault_injector.reset()
+    router.drain()            # close any tail with the channel clean
+    return router, handles, refs
+
+
+def _assert_chaos_exact(router, handles, refs, n_req):
+    """No request lost, none double-delivered, every finished stream
+    bitwise identical to the undisturbed run."""
+    assert len(handles) == n_req
+    for uid, r in handles.items():
+        assert r.state == RequestState.FINISHED, (uid, r.state,
+                                                  r.shed_reason)
+        assert r.tokens == refs[uid], uid
+    rep = router.get_fleet_report()
+    assert rep["router"]["replay_mismatches"] == 0
+    assert rep["router"]["abandoned"] == 0
+    assert rep["transport"]["injected"] > 0      # chaos actually hit
+    assert rep["transport"]["rpcs"] > 0
+
+
+class TestChaosFaultMatrix:
+    """Seeded chaos over the channel-fault kinds on both transport
+    sites. Rate specs strike every message class — SUBMIT, STEP,
+    TOKENS and HEARTBEAT frames alike — per the ordinal hash, so each
+    (kind, rate) cell is one deterministic drill. Tier-1 runs the
+    drop cell (the harshest: whole frames vanish both ways); the full
+    matrix rides the slow tier."""
+
+    def test_chaos_drop_smoke(self, params_cfg):
+        router, handles, refs = _chaos_serve(
+            params_cfg, "transport.send:drop~0.15,"
+                        "transport.recv:drop~0.15")
+        _assert_chaos_exact(router, handles, refs, 6)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("kind", ["delay", "dup", "reorder",
+                                      "truncate"])
+    def test_chaos_matrix(self, params_cfg, kind):
+        router, handles, refs = _chaos_serve(
+            params_cfg, f"transport.send:{kind}~0.15,"
+                        f"transport.recv:{kind}~0.15")
+        _assert_chaos_exact(router, handles, refs, 6)
+
+    @pytest.mark.slow
+    def test_chaos_mixed_kinds(self, params_cfg):
+        router, handles, refs = _chaos_serve(
+            params_cfg, "transport.send:drop~0.1,"
+                        "transport.recv:dup~0.1")
+        _assert_chaos_exact(router, handles, refs, 6)
+
+    @pytest.mark.slow
+    @pytest.mark.soak
+    def test_socket_churn_soak(self, params_cfg):
+        """Sustained churn over the SOCKET channel with mixed chaos:
+        18 staggered arrivals across two real worker processes while
+        frames drop and duplicate — every stream bitwise, nothing
+        lost or double-delivered, and the drops provably cost retried
+        RPCs rather than lost requests."""
+        router, handles, refs = _chaos_serve(
+            params_cfg, "transport.send:drop~0.1,"
+                        "transport.recv:dup~0.1",
+            n_req=18, max_new_tokens=3,
+            # short deadline: over a REAL socket a dropped frame
+            # costs remaining/attempts of wall clock before the
+            # retry, so the soak exercises the timeout path cheaply
+            serving={"fleet": {"transport": {
+                "channel": "socket", "rpc_deadline_seconds": 2.0}}})
+        try:
+            _assert_chaos_exact(router, handles, refs, 18)
+            t = router.get_fleet_report()["transport"]
+            assert t["channel"] == "socket"
+            assert t["retries"] > 0         # drops actually cost RPCs
+        finally:
+            for slot in router.pooled_replicas:
+                router._replicas[slot].kill("test teardown")
+
+
+def _acceptance_drill(params_cfg, serving=None):
+    """The ISSUE transport acceptance: staggered shared-prefix
+    serve(), the busiest replica killed mid-decode, WITH
+    ``transport.send:drop~0.1`` active throughout."""
+    N = 8
+    rng = np.random.default_rng(5)
+    mix = [int(rng.integers(0, 3)) for _ in range(N)]
+    reqs_in = {900 + k: SYS[mix[k]] + [60 + k] for k in range(N)}
+    refs = _single_frontend_refs(params_cfg, reqs_in, 5)
+    router = _router(params_cfg, n=2, serving=serving)
+    handles = {}
+    armed = {}
+    DROP = "transport.send:drop~0.1"
+    fault_injector.configure(DROP)
+
+    def poll(r, step):
+        if step % 2 == 0 and len(handles) < N:
+            k = len(handles)
+            uid = 900 + k
+            try:
+                handles[uid] = r.submit(reqs_in[uid], uid=uid,
+                                        max_new_tokens=5)
+            except ServingOverloadError:
+                pass
+        if step == 7 and not armed:
+            live = [e for e in r._entries.values()
+                    if not e.req.done and e.slot is not None]
+            assert any(e.req.state == RequestState.DECODE
+                       for e in live)
+            slots = [e.slot for e in live]
+            victim = max(set(slots), key=slots.count)
+            # re-arm BOTH: configure() replaces the active rules
+            fault_injector.configure(
+                f"{r.spec_for(victim, 0, 'kill')},{DROP}")
+            armed["victim"] = victim
+        return len(handles) < N
+
+    try:
+        router.serve(poll=poll, max_steps=500)
+    finally:
+        fault_injector.reset()
+    router.drain()
+    assert len(handles) == N and "victim" in armed
+    rep = router.get_fleet_report()
+    for uid, r in handles.items():
+        assert r.state == RequestState.FINISHED, (uid, r.state,
+                                                  r.shed_reason)
+        assert r.tokens == refs[uid], uid
+    assert rep["recovery"]["deaths"] >= 1
+    assert rep["router"]["replay_mismatches"] == 0
+    assert rep["router"]["abandoned"] == 0
+    assert rep["transport"]["injected"] > 0
+    return router, rep
+
+
+class TestTransportAcceptanceE2E:
+
+    def test_kill_under_send_drop_loopback(self, params_cfg):
+        """Loopback channel: kill mid-decode + drop~0.1, every stream
+        bitwise; recompiles <= 1 and steady_blocking_syncs == 0 per
+        surviving replica (the PR-9 contract holds under chaos)."""
+        router, rep = _acceptance_drill(params_cfg)
+        for slot in router.pooled_replicas:
+            frep = router._replicas[slot].frontend.get_serving_report()
+            assert frep["recompiles"] <= 1, slot
+            assert frep["steady_blocking_syncs"] == 0, slot
+
+    @pytest.mark.slow
+    def test_kill_under_send_drop_socket(self, params_cfg):
+        """SocketChannel: one real OS process per replica (the
+        built-in tiny-llama worker factory reproduces the loopback
+        params bitwise); the kill terminates the worker PROCESS and
+        the respawn cold-starts a new one. Slow tier: two+ worker
+        cold starts (jax import + engine build each)."""
+        router, rep = _acceptance_drill(
+            params_cfg,
+            serving={"fleet": {"transport": {"channel": "socket"}}})
+        for slot in router.pooled_replicas:
+            replica = router._replicas[slot]
+            assert replica.frontend is None    # real process isolation
+            snap = replica.snapshot()
+            assert snap["recompiles"] <= 1, slot
+            full = replica.resync()
+            assert full["steady_blocking_syncs"] == 0, slot
+            proc = replica.channel.inner.proc
+            assert proc is not None and proc.poll() is None
+        # tear the worker processes down
+        for slot in router.pooled_replicas:
+            router._replicas[slot].kill("test teardown")
+
+
+class TestTransportTelemetry:
+
+    def test_fleet_report_transport_block(self, params_cfg):
+        router = _router(params_cfg, n=2)
+        r = router.submit(SYS[0] + [88], max_new_tokens=3)
+        router.drain()
+        assert r.state == RequestState.FINISHED
+        t = router.get_fleet_report()["transport"]
+        assert t["channel"] == "loopback"
+        assert t["rpcs"] > 0 and t["bytes_sent"] > 0
+        assert t["probes"] > 0                      # the probe pass
+        assert set(t["probe_latency_ms"]) == {"p50", "p99"}
+        assert set(t["per_replica"]) == {"r0", "r1"}
+        assert t["per_replica"]["r0"]["probe"]["suspect"] is False
+
+    def test_transport_flap_alert_on_reconnect_storm(self,
+                                                     params_cfg):
+        router = _router(params_cfg, n=1, serving={"fleet": {
+            "transport": {"flap_window_steps": 50,
+                          "flap_alert_reconnects": 3}}})
+        for s in (5, 9, 13):
+            router._note_reconnect(s)
+        kinds = [a.kind for a in router.alerts]
+        assert kinds.count("transport_flap") == 1   # debounced
+        router._note_reconnect(20)                  # still in window
+        assert [a.kind for a in router.alerts].count(
+            "transport_flap") == 1
+
+    def test_prober_ledger_units(self):
+        p = HealthProber()
+        assert not p.suspect
+        assert p.fail() == 1 and p.suspect
+        assert p.ok(0.001) is True                  # a reconnect
+        assert not p.suspect and p.reconnects == 1
+        assert p.as_dict()["reconnects"] == 1
+
+    def test_partition_verdict_and_degraded_placement(self,
+                                                      params_cfg):
+        """A replica whose peer becomes unreachable (the channel
+        breaks under it — no fault injector, a REAL dead transport):
+        first failed probe marks it suspect, so new placements prefer
+        the survivor (degraded mode); the streak past
+        ``probe_fail_threshold`` is the PARTITION verdict through the
+        standard supervisor ladder; the respawn builds a fresh channel
+        and the evacuated work replays bitwise."""
+        refs = _single_frontend_refs(
+            params_cfg, {4: SYS[1] + [77], 5: SYS[2] + [78]}, 6)
+        # heartbeat/progress deadlines parked high: the PROBE ladder
+        # must be the detector under test, not step silence
+        router = _router(params_cfg, n=2, serving={"fleet": {
+            "heartbeat_timeout_steps": 10,
+            "progress_timeout_steps": 20}})
+        r4 = router.submit(SYS[1] + [77], uid=4, max_new_tokens=6)
+        home = router._entries[4].slot
+        router.step()
+        # the partition: the victim's underlying channel dies (every
+        # send raises), while the replica object is still "alive"
+        router._replicas[home].channel.inner.close()
+        router.step()                     # probe fail 1 -> suspect
+        assert router._replicas[home].prober.suspect
+        r5 = router.submit(SYS[2] + [78], uid=5, max_new_tokens=6)
+        assert router._entries[5].slot == 1 - home   # degraded mode
+        router.step()                     # probe fail 2
+        router.step()                     # streak 3 -> the verdict
+        rec = router.get_fleet_report()["recovery"]
+        assert rec["deaths"] == 1
+        ev = rec["events"][0]
+        assert ev["slot"] == home and ev["mode"] == "partition"
+        assert "probe failures" in ev["reason"]
+        router.drain()
+        assert r4.state == RequestState.FINISHED
+        assert r5.state == RequestState.FINISHED
+        assert r4.tokens == refs[4] and r5.tokens == refs[5]
+        assert router.replay_mismatches == 0
+        assert sorted(router.pooled_replicas) == [0, 1]  # respawned

@@ -75,6 +75,7 @@ def test_gptneox_sequential_residual():
     _check_family(model, _init(model), cfg)
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 14)
 def test_opt_family():
     from deepspeed_tpu.models.opt import OPTConfig, OPTForCausalLM
     cfg = OPTConfig.tiny()       # learned positions (+2), relu FFN
@@ -89,6 +90,7 @@ def test_gpt2_family():
     _check_family(model, _init(model), cfg)
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 14)
 def test_bloom_family():
     from deepspeed_tpu.models.bloom import BloomConfig, BloomForCausalLM
     cfg = BloomConfig.tiny()     # ALiBi + embedding LayerNorm

@@ -229,6 +229,7 @@ def test_mistral_cached_decode_respects_window(rng):
 
 class TestGPTNeoX:
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 14)
     def test_trains(self):
         from deepspeed_tpu.models.gptneox import (GPTNeoXConfig,
                                                   GPTNeoXForCausalLM)

@@ -232,6 +232,7 @@ class TestAutoTPDegradesGracefully:
                      shapes["params.mystery_fused.kernel"])
         assert spec == jax.sharding.PartitionSpec(None, TENSOR_AXIS)
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 14)
     def test_weird_model_trains_under_tp(self, eight_devices):
         """End to end: on the SAME dp2 x tp4 mesh and batch, training
         with AutoTP-inferred sharding matches training with everything

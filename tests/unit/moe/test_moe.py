@@ -114,6 +114,7 @@ class TestMoELayer:
         assert "residual_mlp" in params["params"]
         assert "coefficient" in params["params"]
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 14)
     def test_grad_flows_through_gate(self, rng):
         x = jnp.asarray(rng.standard_normal((8, 16)).astype(np.float32))
         moe = MoE(hidden_size=16, num_experts=4, min_capacity=8,

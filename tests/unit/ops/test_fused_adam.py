@@ -110,6 +110,7 @@ def test_large_unaligned_leaf_streams_through_grid():
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 14)
 def test_engine_config_knob_routes_to_fused_kernel(eight_devices):
     """use_fused_adam_kernel=true in the engine config routes the
     optimizer through scale_by_fused_adam on pallas-capable backends

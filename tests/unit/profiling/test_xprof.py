@@ -31,6 +31,7 @@ def test_engine_trace_window_produces_profile(tmp_path, eight_devices):
         "no profile artifacts captured"
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 14)
 def test_scoped_trace_and_ranges(tmp_path):
     import jax
     import jax.numpy as jnp

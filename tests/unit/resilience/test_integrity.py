@@ -210,6 +210,7 @@ while True:
 """
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 14)
 def test_kill_between_shard_writes_never_leaves_corrupt_tag(tmp_path):
     """SIGKILL an npz checkpoint writer mid-loop: whatever instant the
     kill lands (between payload writes, before the manifest, before

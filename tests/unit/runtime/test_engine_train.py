@@ -125,6 +125,7 @@ def test_eval_batch(rng, eight_devices):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 14)
 def test_checkpoint_roundtrip(tmp_path, rng, eight_devices):
     """Save/load round trip (reference: tests/unit/checkpoint/)."""
     from deepspeed_tpu.parallel.mesh import mesh_manager

@@ -118,6 +118,7 @@ class TestEngineLifecycle:
     """The post-restore-abort regression gates (root cause: README
     "Long-run durability" / runtime/lifecycle.py docstring)."""
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 14)
     def test_restore_invalidates_aot_executables(self, tmp_path):
         engine, batch, _ = _train(_config(), steps=3)
         engine.save_checkpoint(str(tmp_path))

@@ -78,6 +78,7 @@ class TestOnebitAdam:
         # shards hold different residuals (local grads differ)
         assert np.abs(big[0] - big[1]).max() > 0
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 14)
     def test_wire_payload_is_one_bit(self, eight_devices):
         """The compiled step must move packed uint8 sign words over the
         wire (not fp32 momentum)."""

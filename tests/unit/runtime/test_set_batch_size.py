@@ -25,6 +25,7 @@ def _batch(rng, n, seq=16):
     return {"input_ids": ids, "labels": ids.copy()}
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 14)
 def test_set_train_batch_size_changes_gas(rng, eight_devices):
     engine = _engine()
     assert engine.train_batch_size() == 16      # 1 micro * 2 gas * 8 dp

@@ -85,6 +85,7 @@ def test_delayed_update_converges_and_flushes(eight_devices, tmp_path):
     assert engine._offload.host_adam.step_count == 10
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 14)
 def test_partial_offload_ratio(eight_devices):
     engine, losses = _train(_config(offload=True, ratio=0.5))
     n_leaves = len(jax.tree_util.tree_leaves(engine.state.master_params))
@@ -239,6 +240,7 @@ class TestCompressedWire:
                                   upload_dtype="int8_delta"), steps=10)
         np.testing.assert_allclose(got, ref, atol=5e-3)
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 14)
     def test_mirror_tracks_device_leaves(self, eight_devices):
         """After delta uploads the host mirror tracks the device
         leaves to within ONE bf16 ULP (XLA's fused add+cast can break
@@ -260,6 +262,7 @@ class TestCompressedWire:
             # overwhelmingly bitwise-equal (ties are rare)
             assert (diff == 0).mean() > 0.999
 
+    @pytest.mark.slow  # tier-1 diet (ISSUE 14)
     def test_breakdown_reported(self, eight_devices):
         engine, _ = _train(self._cfg(), steps=3)
         bd = engine.get_offload_breakdown()

@@ -238,6 +238,7 @@ def test_streamed_overlap_attribution_and_trace(eight_devices):
 
 
 @pytest.mark.perf
+@pytest.mark.slow  # tier-1 diet (ISSUE 14)
 def test_offload_train_step_donations_clean(eight_devices):
     """Donation audit satellite: the offload train step's donation
     annotations are clean — XLA aliases every donated buffer (state +
