@@ -773,6 +773,11 @@ def bench_config8(tiny=False, transport="loopback"):
     def engine_factory(slot):
         return InferenceEngineV2(params, cfg, eng_cfg)
 
+    worker_engine = dict(
+        token_budget=budget, max_ragged_sequence_count=B,
+        max_tracked_sequences=4 * B, n_kv_blocks=4 * B + 12,
+        kv_block_size=block, max_blocks_per_seq=per_seq,
+        kv_dtype=kv_dtype, prefix_cache=True)
     fleet_cfg = {"n_replicas": R}
     if transport == "socket":
         if not tiny:
@@ -784,13 +789,38 @@ def bench_config8(tiny=False, transport="loopback"):
             "channel": "socket",
             # the built-in tiny-llama worker factory, pinned to the
             # bench engine geometry (geometry must match fleet-wide)
-            "worker_args": {"engine": dict(
-                token_budget=budget, max_ragged_sequence_count=B,
-                max_tracked_sequences=4 * B, n_kv_blocks=4 * B + 12,
-                kv_block_size=block, max_blocks_per_seq=per_seq,
-                kv_dtype=kv_dtype, prefix_cache=True)},
+            "worker_args": {"engine": worker_engine},
         }
-    router = FleetRouter(engine_factory, {"fleet": fleet_cfg})
+    listener = procs = None
+    if transport == "remote":
+        if not tiny:
+            raise ValueError("--transport remote requires --tiny")
+        # the multi-host bootstrap path priced end to end: workers are
+        # launched OUT-OF-BAND (as a cluster scheduler would) and dial
+        # in through the authenticated JOIN handshake; the router runs
+        # with its write-ahead journal armed, so the decomposition
+        # prices the full durability + bootstrap tax, not just RPC
+        import os
+        import secrets
+        import tempfile
+        from deepspeed_tpu.inference.v2.serving.fleet import (
+            FleetListener, spawn_dialin_workers)
+        token = secrets.token_hex(16)
+        listener = FleetListener("127.0.0.1", 0, token=token, epoch=1)
+        procs = spawn_dialin_workers(
+            R, listener.address,
+            worker_args={"engine": worker_engine},
+            serving_cfg_dict={"on_overload": "raise"},
+            extra_env={"DSTPU_FLEET_TOKEN": token})
+        fleet_cfg["transport"] = {"channel": "remote"}
+        # worker cold start = jax import + engine build, per process
+        fleet_cfg["bootstrap"] = {"join_deadline_seconds": 300.0}
+        journal_path = os.path.join(tempfile.mkdtemp(prefix="dstpu8_"),
+                                    "router-journal.jsonl")
+        router = FleetRouter(engine_factory, {"fleet": fleet_cfg},
+                             listener=listener, journal=journal_path)
+    else:
+        router = FleetRouter(engine_factory, {"fleet": fleet_cfg})
 
     rng = np.random.default_rng(8)
     vocab = cfg.vocab_size
@@ -827,6 +857,16 @@ def bench_config8(tiny=False, transport="loopback"):
     steps = router.serve(poll=poll)
     wall = time.time() - t0
     rep = router.get_fleet_report()
+    if procs is not None:
+        # graceful teardown of the out-of-band workers: detach sends
+        # SHUTDOWN, the worker main() returns 0
+        for replica in router._replicas:
+            replica.detach()
+        for proc in procs:
+            try:
+                proc.wait(timeout=30.0)
+            except Exception:
+                proc.kill()
     assert rep["router"]["finished"] == N + R, rep["router"]
     trace_tokens = sum(len(h.tokens) for h in handles.values())
     sustained = trace_tokens / wall if wall > 0 else 0.0
@@ -861,6 +901,23 @@ def bench_config8(tiny=False, transport="loopback"):
                 for k in ("channel", "rpcs", "retries", "timeouts",
                           "reconnects", "bytes_sent", "bytes_recv",
                           "probes", "probe_latency_ms")},
+            # the bootstrap tax (--transport remote): dial-in joins,
+            # auth/fencing refusals, the fencing epoch, write-ahead
+            # journal durability counters; loopback/socket rows keep
+            # listener/journal null (tracked by the lineage gate)
+            "bootstrap": {
+                "channel": rep["bootstrap"]["channel"],
+                "epoch": rep["bootstrap"]["epoch"],
+                "listener": ({
+                    k: rep["bootstrap"]["listener"][k]
+                    for k in ("joins", "auth_failures", "fenced",
+                              "handshake_errors")}
+                    if rep["bootstrap"]["listener"] else None),
+                "journal": ({
+                    k: rep["bootstrap"]["journal"][k]
+                    for k in ("records_written", "fsyncs")}
+                    if rep["bootstrap"]["journal"] else None),
+            },
             "memory": _memory_decomposition(
                 memory_gauges(include_arrays=False)),
         },
@@ -881,11 +938,15 @@ def main():
     p.add_argument("--tiny", action="store_true",
                    help="tiny-shape logic validation (config 8_fleet "
                         "only; never an artifact row)")
-    p.add_argument("--transport", choices=["loopback", "socket"],
+    p.add_argument("--transport",
+                   choices=["loopback", "socket", "remote"],
                    default="loopback",
                    help="fleet channel for config 8_fleet: loopback "
-                        "(in-process, default) or socket (one OS "
-                        "process per replica; requires --tiny)")
+                        "(in-process, default), socket (one OS "
+                        "process per replica; requires --tiny) or "
+                        "remote (out-of-band dial-in workers over the "
+                        "authenticated JOIN bootstrap, journal armed; "
+                        "requires --tiny)")
     args = p.parse_args()
     if args.tiny and args.config != "8_fleet":
         # a tiny-shape row must never land in an artifact lineage the
@@ -894,7 +955,7 @@ def main():
                 "(local logic validation, never an artifact row)")
     if args.transport != "loopback" and \
             (args.config != "8_fleet" or not args.tiny):
-        p.error("--transport socket is only valid with "
+        p.error(f"--transport {args.transport} is only valid with "
                 "--config 8_fleet --tiny (worker processes rebuild "
                 "the tiny built-in engine; full-size rows stay "
                 "loopback)")

@@ -5,24 +5,34 @@ persistent deployments multiplex request traffic over engine
 replicas). Replicas live behind a real RPC boundary
 (``transport.py``): in-process over ``LoopbackChannel`` by default,
 one OS process each over ``SocketChannel``
-(``serving.fleet.transport.channel = "socket"``)."""
+(``serving.fleet.transport.channel = "socket"``), or dialing IN from
+other hosts over the authenticated, epoch-fenced bootstrap handshake
+(``channel = "remote"``; ``FleetListener`` is the router's front door,
+``RequestJournal`` + ``FleetRouter.recover`` make the router itself
+survive a crash)."""
 
 from .elastic import FleetRecoveryEvent, FleetSupervisor
+from .journal import JournalState, RequestJournal, replay
 from .replica import Replica
 from .router import FleetRouter, RoundRobinPolicy, ScoringPolicy
-from .transport import (FaultyChannel, HealthProber, LoopbackChannel,
-                        RpcClient, SocketChannel, TransportError,
-                        TransportTimeout)
-from .worker import WorkerCore, tiny_llama_factory
+from .transport import (FaultyChannel, FleetListener, HealthProber,
+                        LoopbackChannel, RpcClient, SocketChannel,
+                        TransportError, TransportTimeout, redact_auth,
+                        remote_connector, worker_join)
+from .worker import (WorkerCore, run_dialin_worker,
+                     spawn_dialin_workers, tiny_llama_factory)
 
 __all__ = [
     "FaultyChannel",
+    "FleetListener",
     "FleetRecoveryEvent",
     "FleetRouter",
     "FleetSupervisor",
     "HealthProber",
+    "JournalState",
     "LoopbackChannel",
     "Replica",
+    "RequestJournal",
     "RoundRobinPolicy",
     "RpcClient",
     "ScoringPolicy",
@@ -30,5 +40,11 @@ __all__ = [
     "TransportError",
     "TransportTimeout",
     "WorkerCore",
+    "redact_auth",
+    "remote_connector",
+    "replay",
+    "run_dialin_worker",
+    "spawn_dialin_workers",
     "tiny_llama_factory",
+    "worker_join",
 ]

@@ -73,6 +73,7 @@ class FleetSupervisor:
         self.deaths = 0
         self.requeued = 0
         self.respawns = 0
+        self.drains = 0
 
     # -- detectors ------------------------------------------------------
     def check(self, step: int) -> int:
@@ -123,6 +124,25 @@ class FleetSupervisor:
         self.events.append(event)
         return event
 
+    def on_drain(self, slot: int, step: int, t0: float,
+                 steps_drained: int) -> FleetRecoveryEvent:
+        """One graceful drain, recorded in the same event history as
+        failures (``mode="drain"``) — an operator reading the fleet
+        report sees every pool departure in one ledger, with the
+        intent distinguishing a rolling restart from an outage. No
+        death, no requeue: the drain finished the in-flight work in
+        place before detaching."""
+        rep = self.router._replicas[slot]
+        self.drains += 1
+        event = FleetRecoveryEvent(
+            slot=slot, mode="drain",
+            reason=f"drained over {steps_drained} step(s)",
+            step=step, t=t0, requeued_uids=(), respawned=False,
+            generation=rep.generation,
+            mttr_s=self._clock() - t0)
+        self.events.append(event)
+        return event
+
     # -- reporting ------------------------------------------------------
     def report(self) -> dict:
         mttr = list(self._mttr_s)
@@ -130,6 +150,7 @@ class FleetSupervisor:
             "deaths": self.deaths,
             "requeued": self.requeued,
             "respawns": self.respawns,
+            "drains": self.drains,
             "events": [e.as_dict() for e in self.events],
             "mttr_s": {
                 "last": mttr[-1] if mttr else 0.0,

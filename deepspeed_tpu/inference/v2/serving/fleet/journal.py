@@ -1,0 +1,182 @@
+"""The durable router's write-ahead request journal.
+
+PR 11/14 made the fleet survive its WORKERS dying; the router itself
+was still a single point of forgetting — kill it mid-decode and every
+in-flight request was gone even though the workers holding their
+tokens were fine. This module is the router's memory: an append-only
+JSONL journal (riding the telemetry ``JsonlSink`` — single O_APPEND
+writes, byte-budget rotation, batched fsync) recording just enough to
+reconstruct placement state:
+
+* ``epoch``     — a router generation claimed this journal (fencing),
+* ``submit``    — a request was accepted: uid + prompt + the full
+                  submit kwargs (everything a bitwise re-place needs),
+* ``place``     — a uid was placed on a replica slot,
+* ``cursors``   — the per-uid delivered-token cursors that CHANGED
+                  this step (batched: one record per router step),
+* ``terminal``  — a uid reached FINISHED/CANCELLED/SHED with n tokens
+                  delivered (recovery skips it entirely).
+
+The write protocol is write-ahead where it matters: ``submit`` is
+journaled before the request is placed anywhere, so a crash can lose
+at most progress, never the request itself.
+
+``replay()`` is deliberately paranoid: the journal's author CRASHED —
+a torn half-line tail is the expected case, not the exception. Every
+line parses independently; a bad line becomes a typed
+``JournalCorruptionError`` in ``JournalState.errors`` (counted,
+skipped) and replay NEVER raises on content. Requests whose submit
+record itself is unreadable are the only provably unrecoverable ones
+— the recovering router sheds exactly those, typed.
+"""
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from .....resilience.errors import JournalCorruptionError
+from .....telemetry.hub import JsonlSink
+from .transport import redact_auth
+
+_KNOWN_RECS = ("epoch", "submit", "place", "cursors", "terminal")
+
+
+class JournalState:
+    """The replayed view of a journal: last-writer-wins maps keyed by
+    uid, plus the per-record damage report."""
+
+    def __init__(self):
+        self.epoch = 0                  # newest epoch record seen
+        self.submits: Dict[int, dict] = {}
+        self.placements: Dict[int, int] = {}
+        self.cursors: Dict[int, int] = {}
+        self.terminals: Dict[int, dict] = {}
+        self.records_read = 0
+        self.errors: List[JournalCorruptionError] = []
+        self.exists = False
+
+    @property
+    def corrupt_records(self) -> int:
+        return len(self.errors)
+
+    def live_uids(self) -> List[int]:
+        """Submitted, never reached terminal — the recovery worklist."""
+        return sorted(u for u in self.submits if u not in self.terminals)
+
+    def as_dict(self) -> dict:
+        return {"epoch": self.epoch, "exists": self.exists,
+                "records_read": self.records_read,
+                "corrupt_records": self.corrupt_records,
+                "submits": len(self.submits),
+                "terminals": len(self.terminals),
+                "live": len(self.live_uids())}
+
+
+class RequestJournal:
+    """Append side. One instance per router; ``note_*`` calls sit on
+    the router's existing submit/place/deliver/finish paths and cost
+    one buffered append each (fsync every ``fsync_every`` records —
+    the durability/latency knob from ``serving.fleet.bootstrap``)."""
+
+    def __init__(self, path: str, *, fsync_every: int = 16,
+                 max_bytes: int = 16 << 20):
+        self.path = str(path)
+        self._sink = JsonlSink(path, max_bytes=max_bytes,
+                               fsync_every=fsync_every)
+        self.records_written = 0
+
+    def _write(self, rec: dict) -> None:
+        self._sink.write(rec)
+        self.records_written += 1
+
+    def note_epoch(self, epoch: int) -> None:
+        self._write({"rec": "epoch", "epoch": int(epoch)})
+
+    def note_submit(self, uid: int, prompt, kwargs: dict) -> None:
+        # redact_auth is defense-in-depth: submit kwargs are sampling/
+        # deadline fields today, but the journal is a durable file and
+        # must never become a secret surface as kwargs grow
+        self._write({"rec": "submit", "uid": int(uid),
+                     "prompt": [int(t) for t in prompt],
+                     "kwargs": redact_auth(dict(kwargs))})
+
+    def note_place(self, uid: int, slot: int) -> None:
+        self._write({"rec": "place", "uid": int(uid),
+                     "slot": int(slot)})
+
+    def note_cursors(self, changed: Dict[int, int]) -> None:
+        if changed:
+            self._write({"rec": "cursors",
+                         "c": {str(u): int(c)
+                               for u, c in changed.items()}})
+
+    def note_terminal(self, uid: int, state: str,
+                      n_tokens: int) -> None:
+        self._write({"rec": "terminal", "uid": int(uid),
+                     "state": str(state), "n_tokens": int(n_tokens)})
+
+    @property
+    def fsyncs(self) -> int:
+        return self._sink.fsyncs
+
+    def as_dict(self) -> dict:
+        return {"path": self.path,
+                "records_written": self.records_written,
+                "fsyncs": self._sink.fsyncs}
+
+
+def replay(path: str) -> JournalState:
+    """Tolerant journal read -> ``JournalState``. Reads the rotated
+    generation (``path.1``) before the active file; every failure mode
+    of a LINE (torn tail, garbage bytes, non-dict JSON, unknown or
+    malformed record) degrades to a counted, typed entry in
+    ``state.errors`` — a recovering router must come up on whatever
+    journal its dead predecessor left, crashing on it would turn one
+    outage into two."""
+    st = JournalState()
+    lineno = 0
+    for p in (str(path) + ".1", str(path)):
+        if not os.path.exists(p):
+            continue
+        st.exists = True
+        with open(p, "rb") as f:
+            raw = f.read()
+        for line in raw.split(b"\n"):
+            line = line.strip()
+            if not line:
+                continue
+            lineno += 1
+            try:
+                rec = json.loads(line.decode("utf-8"))
+                if not isinstance(rec, dict):
+                    raise ValueError("record is not a dict")
+                _apply(st, rec)
+            except (ValueError, KeyError, TypeError,
+                    UnicodeDecodeError) as e:
+                st.errors.append(JournalCorruptionError(
+                    f"journal {p} line {lineno}: "
+                    f"{type(e).__name__}: {str(e)[:120]}"))
+                continue
+            st.records_read += 1
+    return st
+
+
+def _apply(st: JournalState, rec: dict) -> None:
+    kind = rec.get("rec")
+    if kind == "epoch":
+        st.epoch = max(st.epoch, int(rec["epoch"]))
+    elif kind == "submit":
+        st.submits[int(rec["uid"])] = {
+            "prompt": [int(t) for t in rec["prompt"]],
+            "kwargs": dict(rec.get("kwargs") or {})}
+    elif kind == "place":
+        st.placements[int(rec["uid"])] = int(rec["slot"])
+    elif kind == "cursors":
+        for u, c in (rec.get("c") or {}).items():
+            st.cursors[int(u)] = int(c)
+    elif kind == "terminal":
+        st.terminals[int(rec["uid"])] = {
+            "state": str(rec.get("state", "?")),
+            "n_tokens": int(rec.get("n_tokens", 0))}
+    else:
+        raise ValueError(f"unknown journal record {kind!r}")

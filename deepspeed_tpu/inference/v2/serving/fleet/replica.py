@@ -40,9 +40,9 @@ from .....resilience.fault_injector import fault_injector
 from .....telemetry.trace import span
 from .....utils.logging import logger
 from .transport import (MSG_CANCEL, MSG_HEARTBEAT, MSG_HELLO,
-                        MSG_SNAPSHOT, MSG_STEP, MSG_SUBMIT, MSG_TOKENS,
-                        FaultyChannel, HealthProber, RpcClient,
-                        TransportStats)
+                        MSG_SHUTDOWN, MSG_SNAPSHOT, MSG_STEP,
+                        MSG_SUBMIT, MSG_TOKENS, FaultyChannel,
+                        HealthProber, RpcClient, TransportStats)
 from .worker import sampling_to_wire
 
 _FOREVER = float("inf")
@@ -84,10 +84,20 @@ class Replica:
         self._rpc = RpcClient(ch, self.slot, self._tcfg,
                               stats=self.stats)
         # HELLO under the connect deadline: geometry (kv_block_size),
-        # the full trie listing + seq, and the first health snapshot
-        self.hello = self._rpc.call(
-            MSG_HELLO,
-            deadline_s=float(self._tcfg.connect_deadline_seconds))
+        # the full trie listing + seq, and the first health snapshot.
+        # A worker that connected but died (or hung) before answering
+        # HELLO must not leak: the channel close reaps the child
+        # process and shuts the half-open socket down both ways.
+        try:
+            self.hello = self._rpc.call(
+                MSG_HELLO,
+                deadline_s=float(self._tcfg.connect_deadline_seconds))
+        except BaseException:
+            try:
+                ch.close()
+            except OSError:
+                pass
+            raise
         self.last_snapshot = self.hello.get("snapshot") or {}
 
     # -- passthroughs (loopback-only introspection) --------------------
@@ -158,6 +168,28 @@ class Replica:
                 pass
         logger.warning(f"fleet replica {self.slot} died"
                        + (f": {reason}" if reason else ""))
+
+    def detach(self) -> None:
+        """Graceful goodbye — the DRAIN path's counterpart to
+        ``kill()``: a best-effort SHUTDOWN RPC tells the worker to
+        exit its serve (and, for a dial-in worker, its re-dial) loop,
+        then the channel closes. Deliberately NOT a death: deaths and
+        generation stay untouched, this replica left the pool on
+        purpose. Idempotent."""
+        if self.alive and self._rpc is not None:
+            try:
+                self._rpc.call(MSG_SHUTDOWN, retries=0,
+                               deadline_s=float(
+                                   self._tcfg.probe_deadline_seconds))
+            except (TransportError, OSError):
+                pass      # already gone — closing is all that is left
+        self.alive = False
+        self._hang_left = self._slow_left = 0.0
+        if self._channel is not None:
+            try:
+                self._channel.close()
+            except OSError:
+                pass
 
     def respawn(self) -> None:
         """Fresh channel, fresh worker (the factory again), generation

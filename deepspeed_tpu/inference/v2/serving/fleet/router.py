@@ -43,10 +43,25 @@ exhausted transport budgets, and a respawn builds a fresh channel (and
 worker process, on sockets), so it can FAIL typed and the pool shrinks
 honestly.
 
+**Multi-host bootstrap & durability** (the fleet-bootstrap PR): with
+``serving.fleet.transport.channel = "remote"`` workers are launched
+OUT-OF-BAND and dial IN to the router's advertised address, admitted
+through an authenticated, epoch-fenced JOIN handshake
+(``transport.FleetListener``); and with a ``journal_path`` configured
+the router write-ahead journals every submit/placement/cursor/terminal
+so ``FleetRouter.recover()`` can bring a FRESH router up on a dead
+one's journal — re-handshaking the surviving workers (epoch+1),
+re-attaching their live uids off the SNAPSHOT inventory, re-placing
+the rest under the bitwise-replay contract, and shedding (typed) only
+requests whose journal records are provably unreadable.
+``drain_replica()`` is the graceful counterpart: stop placing, finish
+in-flight, detach — the rolling-restart primitive.
+
 Single-threaded like the front-end; deterministic by construction on
 the loopback channel — every drill replays.
 """
 
+import os
 import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Set, Tuple
@@ -67,10 +82,13 @@ from ..frontend import (ServingFrontend, _normalize_config,
                         drive_serving)
 from ..prefix import chain_digests
 from ..request import Request, RequestState, TokenStream
+from . import journal as journal_mod
 from .elastic import FleetSupervisor
+from .journal import RequestJournal
 from .replica import Replica
-from .transport import (LoopbackChannel, SocketChannel,
-                        probe_percentiles_ms)
+from .transport import (FleetListener, LoopbackChannel, SocketChannel,
+                        probe_percentiles_ms, redact_auth,
+                        remote_connector, server_ssl_context)
 
 
 class ScoringPolicy:
@@ -128,19 +146,31 @@ class FleetRouter:
 
     def __init__(self, engine_factory: Callable, config=None, *,
                  n_replicas: Optional[int] = None, policy=None,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter,
+                 listener: Optional[FleetListener] = None,
+                 journal=None, epoch: int = 1):
         """``engine_factory(slot) -> InferenceEngineV2`` builds one
         replica's engine ON THE LOOPBACK CHANNEL (and is called again
         on respawn — replicas must be rebuildable from scratch). Over
         sockets the worker PROCESS builds its own engine from
         ``serving.fleet.transport.worker_factory`` / ``worker_args``
-        (the built-in deterministic tiny-llama when empty). All
-        replicas must share engine geometry: the affinity map assumes
-        one ``kv_block_size`` fleet-wide (taken from HELLO)."""
+        (the built-in deterministic tiny-llama when empty); over the
+        ``remote`` channel workers are launched OUT-OF-BAND entirely
+        and dial in through the (given or bootstrap-configured)
+        ``listener``. All replicas must share engine geometry: the
+        affinity map assumes one ``kv_block_size`` fleet-wide (taken
+        from HELLO).
+
+        ``epoch`` is this router's fencing generation (``recover()``
+        passes the journal's epoch + 1); ``journal`` is a path or
+        ``RequestJournal`` enabling the write-ahead request journal
+        (``serving.fleet.bootstrap.journal_path`` is the config-side
+        spelling)."""
         import dataclasses as _dc
         self.config = cfg = _normalize_config(config)
         fc = self.config.fleet
         self._transport_cfg = tc = fc.transport
+        self._bootstrap_cfg = bc = fc.bootstrap
         self._clock = clock
         n = int(fc.n_replicas if n_replicas is None else n_replicas)
         if n < 1:
@@ -148,9 +178,41 @@ class FleetRouter:
         if cfg.on_overload not in ("raise", "shed"):
             raise ValueError(f"serving.on_overload must be raise/shed, "
                              f"got {cfg.on_overload!r}")
-        if tc.channel not in ("loopback", "socket"):
+        if tc.channel not in ("loopback", "socket", "remote"):
             raise ValueError(f"serving.fleet.transport.channel must be "
-                             f"loopback/socket, got {tc.channel!r}")
+                             f"loopback/socket/remote, got "
+                             f"{tc.channel!r}")
+        self.epoch = int(epoch)
+        # the dial-in front door (remote channel only): the router
+        # OWNS whatever listener it serves behind — a caller-provided
+        # one (tests bind the port before starting workers) is adopted
+        # onto this router's fencing epoch
+        self._listener = listener
+        if tc.channel == "remote" and self._listener is None:
+            token = bc.token or os.environ.get(bc.token_env, "")
+            ssl_ctx = None
+            if bc.ssl_enabled:
+                ssl_ctx = server_ssl_context(bc.ssl_certfile,
+                                             bc.ssl_keyfile)
+            self._listener = FleetListener(
+                bc.listen_host, bc.listen_port, token=token,
+                epoch=self.epoch, require_auth=bc.require_auth,
+                ssl_context=ssl_ctx)
+        if self._listener is not None:
+            self._listener.epoch = self.epoch
+        # the write-ahead request journal (durability is opt-in)
+        if journal is None and bc.journal_path:
+            journal = bc.journal_path
+        if isinstance(journal, str):
+            journal = RequestJournal(
+                journal, fsync_every=int(bc.journal_fsync_every),
+                max_bytes=int(bc.journal_max_bytes))
+        self._journal: Optional[RequestJournal] = journal
+        if self._journal is not None:
+            self._journal.note_epoch(self.epoch)
+        self._journaled_cursors: Dict[int, int] = {}
+        self._draining: Set[int] = set()
+        self.recover_stats: dict = {}
         if policy is None:
             if fc.policy == "affinity":
                 policy = ScoringPolicy(fc.affinity_weight,
@@ -218,10 +280,19 @@ class FleetRouter:
 
     def _channel_factory(self, slot: int):
         tc = self._transport_cfg
+        if tc.channel == "remote":
+            return SocketChannel(remote_connector(
+                self._listener, slot,
+                float(self._bootstrap_cfg.join_deadline_seconds)))
         if tc.channel == "socket":
             from .worker import make_connector
-            return SocketChannel(make_connector(
-                slot, tc, self._replica_cfg.to_dict()))
+            cfg_dict = self._replica_cfg.to_dict()
+            # the worker gets the config on argv (--serving-json) and
+            # argv is world-readable via ps: the fleet block — which
+            # carries bootstrap auth material and is router-side state
+            # the worker never reads anyway — must not ride along
+            cfg_dict.pop("fleet", None)
+            return SocketChannel(make_connector(slot, tc, cfg_dict))
         from .worker import WorkerCore
         return LoopbackChannel(
             WorkerCore(slot, self._frontend_factory(slot)))
@@ -246,7 +317,8 @@ class FleetRouter:
                 for rep in self._replicas}
         return {"replicas": reps, "router": self._router_stats(),
                 "prefix": self._fleet_prefix_stats(),
-                "transport": self._transport_stats()}
+                "transport": self._transport_stats(),
+                "bootstrap": self._bootstrap_stats()}
 
     # -- introspection --------------------------------------------------
     @property
@@ -340,12 +412,17 @@ class FleetRouter:
             user_on_token=on_token)
         self._entries[uid] = entry
         self.submitted += 1
+        # write-AHEAD: the submit record lands before any placement is
+        # attempted, so a router crash from here on can lose progress
+        # but never the request itself
+        self._journal_submit(entry)
         try:
             placed = self._place(uid)
         except Exception:
             # a replica-side validation error must not leave a ghost
             self._entries.pop(uid, None)
             self.submitted -= 1
+            self._journal_terminal(uid, "SHED", 0)
             raise
         if not placed:
             if cfg.on_overload == "raise":
@@ -353,6 +430,7 @@ class FleetRouter:
                 # the replica-side validation-error path above
                 self._entries.pop(uid, None)
                 self.submitted -= 1
+                self._journal_terminal(uid, "SHED", 0)
                 raise self._overload_error([uid])
             req.shed_reason = "fleet saturated at submit"
             self._finish(entry, RequestState.SHED)
@@ -424,6 +502,37 @@ class FleetRouter:
             raise UnknownRequestError(uid, surface="fleet router")
         return list(e.req.tokens)
 
+    # -- the write-ahead journal seam -----------------------------------
+    def _journal_submit(self, entry: "_FleetEntry") -> None:
+        if self._journal is None:
+            return
+        from .worker import sampling_to_wire
+        kw = dict(entry.kwargs)
+        kw["sampling"] = sampling_to_wire(kw.get("sampling"))
+        self._journal.note_submit(entry.req.uid, entry.req.prompt, kw)
+
+    def _journal_terminal(self, uid: int, state: str,
+                          n_tokens: int) -> None:
+        if self._journal is not None:
+            self._journal.note_terminal(uid, state, n_tokens)
+        self._journaled_cursors.pop(uid, None)
+
+    def _journal_cursors(self) -> None:
+        """One batched ``cursors`` record per router step, carrying
+        only the per-uid delivered counts that CHANGED — the journal's
+        progress ledger (recovery reporting / validation; correctness
+        rides the submit/terminal records plus the replay contract)."""
+        if self._journal is None:
+            return
+        changed = {}
+        for uid, e in self._entries.items():
+            if e.req.done:
+                continue
+            if self._journaled_cursors.get(uid) != e.seen:
+                changed[uid] = e.seen
+                self._journaled_cursors[uid] = e.seen
+        self._journal.note_cursors(changed)
+
     # -- internal lifecycle --------------------------------------------
     def _retire(self, uid: int) -> None:
         self._retired.append(uid)
@@ -444,6 +553,7 @@ class FleetRouter:
                 req.advance(RequestState.PREFILL)
         req.advance(state)
         req.finished_t = self._clock()
+        self._journal_terminal(req.uid, state.name, len(req.tokens))
         self._retire(req.uid)
 
     def _abandon(self, entry: _FleetEntry, reason: str) -> None:
@@ -530,7 +640,8 @@ class FleetRouter:
         fleet that is all-suspect still serves rather than shedding
         outright (degraded mode)."""
         probed = [(s, snap) for s in sorted(self._pool)
-                  if (snap := self._scoring_snapshot(s)).get("alive")]
+                  if s not in self._draining
+                  and (snap := self._scoring_snapshot(s)).get("alive")]
         if not probed:
             return [], None, 0
         if hasattr(self.policy, "rank"):          # round-robin family
@@ -586,6 +697,8 @@ class FleetRouter:
                 e.slot = slot
                 e.seen = 0
                 self._placed.setdefault(slot, set()).add(uid)
+                if self._journal is not None:
+                    self._journal.note_place(uid, slot)
                 if slot == aff_slot:
                     self.affinity_routed += 1
                 return True
@@ -664,6 +777,7 @@ class FleetRouter:
             else:
                 self._place_backlog()
         self._check_imbalance(step)
+        self._journal_cursors()
         return not self.idle
 
     def _ingest_step_reply(self, slot: int, reply: dict,
@@ -952,6 +1066,183 @@ class FleetRouter:
     def drain(self, max_steps: int = 100000) -> int:
         return self.serve(max_steps=max_steps)
 
+    # -- graceful ops + durability (the bootstrap PR) -------------------
+    def drain_replica(self, slot: int, max_steps: int = 100000) -> int:
+        """Graceful removal of one replica — the rolling-restart
+        primitive: stop placing NEW work on ``slot`` (it drops out of
+        the scoring order), keep stepping the whole fleet until its
+        in-flight requests finish IN PLACE (no requeue, no replay),
+        then detach it: best-effort SHUTDOWN, channel closed, pool
+        shrunk, ledger retired. Recorded as a ``mode="drain"`` event
+        in the recovery history. Returns the steps the drain took;
+        ``_respawn`` (or a fresh dial-in worker on the remote channel)
+        re-admits the slot afterwards."""
+        slot = int(slot)
+        if slot not in self._pool:
+            raise ValueError(f"replica {slot} is not in the pool")
+        t0 = self._clock()
+        self._draining.add(slot)
+        steps = 0
+        try:
+            with span("fleet.drain", slot=slot):
+                while self._outstanding(slot) > 0 and \
+                        steps < max_steps:
+                    self.step()
+                    steps += 1
+        finally:
+            self._draining.discard(slot)
+        self._replicas[slot].detach()
+        self._pool.discard(slot)
+        self._monitor.retire(slot)
+        self._supervisor.on_drain(slot, self._step_idx, t0, steps)
+        return steps
+
+    def crash(self) -> None:
+        """Chaos-drill helper: die ABRUPTLY. Every channel and the
+        listener close with no SHUTDOWN RPCs and no draining; the
+        journal is left exactly as the crash caught it (torn tail
+        included). Dial-in workers see a dropped connection, keep
+        their engines and token buffers warm, and re-dial whichever
+        router generation answers the address next — which is what
+        ``recover()`` counts on."""
+        for rep in self._replicas:
+            ch = rep.channel
+            if ch is not None:
+                try:
+                    ch.close()
+                except OSError:
+                    pass
+            rep.alive = False
+        if self._listener is not None:
+            self._listener.close()
+
+    @classmethod
+    def recover(cls, engine_factory: Callable, config=None, *,
+                journal_path: Optional[str] = None,
+                listener: Optional[FleetListener] = None,
+                **kw) -> "FleetRouter":
+        """Bring a FRESH router up on a dead one's journal: replay the
+        write-ahead records (tolerantly — the author crashed), claim
+        the next fencing epoch, re-handshake the surviving dial-in
+        workers (their re-dials present the dead router's epoch, which
+        is exactly the epoch-1 this router's admission window
+        accepts), then reconcile every live uid:
+
+        * found in a surviving worker's SNAPSHOT/HELLO inventory —
+          RE-ATTACHED with cursor 0; the worker's buffered tail
+          replays through the dedup cursor, so the finished stream is
+          bitwise the undisturbed one with zero recompute;
+        * on no survivor — RE-PLACED from its journaled submit record;
+          the fold_in sampling-key contract makes the fresh attempt
+          replay bitwise from position 0;
+        * journal-corrupt submit record — the only provably
+          unrecoverable case: shed, typed, counted.
+
+        ``recover_stats`` (and the fleet report's ``bootstrap`` block)
+        carries the full reconciliation."""
+        cfg = _normalize_config(config)
+        path = journal_path or cfg.fleet.bootstrap.journal_path
+        if not path:
+            raise ValueError(
+                "FleetRouter.recover needs a journal: pass "
+                "journal_path or set serving.fleet.bootstrap."
+                "journal_path")
+        st = journal_mod.replay(path)
+        router = cls(engine_factory, cfg, listener=listener,
+                     journal=path, epoch=st.epoch + 1, **kw)
+        router._recover_from(st)
+        return router
+
+    def _recover_from(self, st: "journal_mod.JournalState") -> None:
+        from .worker import _sampling_from_wire
+        live = st.live_uids()
+        with span("fleet.recover", epoch=self.epoch, live=len(live)):
+            inventories = {rep.slot: (rep.hello.get("uids") or {})
+                           for rep in self._replicas if rep.alive}
+            attached: List[int] = []
+            replaced: List[int] = []
+            for uid in live:
+                rec = st.submits[uid]
+                kw = dict(rec["kwargs"])
+                sampling = _sampling_from_wire(kw.get("sampling"))
+                prompt = np.asarray(rec["prompt"], np.int32)
+                req = Request(
+                    uid=uid, prompt=prompt,
+                    max_new_tokens=kw.get("max_new_tokens"),
+                    eos_token_id=kw.get("eos_token_id"),
+                    sampling=sampling,
+                    priority=int(kw.get("priority") or 0),
+                    deadline_ms=kw.get("deadline_ms"),
+                    submitted_t=self._clock())
+                entry = _FleetEntry(
+                    req,
+                    kwargs=dict(max_new_tokens=req.max_new_tokens,
+                                eos_token_id=req.eos_token_id,
+                                sampling=sampling,
+                                priority=req.priority,
+                                deadline_ms=kw.get("deadline_ms")),
+                    digests=chain_digests(prompt, self._block_size),
+                    user_on_token=None)
+                self._entries[uid] = entry
+                self.submitted += 1
+                slot = self._find_survivor(uid, st, inventories)
+                if slot is not None:
+                    # re-attach: cursor 0 pulls the worker's whole
+                    # buffered tail back through the dedup cursor
+                    entry.slot = slot
+                    entry.seen = 0
+                    self._placed.setdefault(slot, set()).add(uid)
+                    if self._journal is not None:
+                        self._journal.note_place(uid, slot)
+                    attached.append(uid)
+                else:
+                    self._backlog.append(uid)
+                    replaced.append(uid)
+            # uids some record references but whose SUBMIT line the
+            # journal lost: no prompt to replay from — the only
+            # provably unrecoverable class, shed typed (and journaled
+            # terminal, so a SECOND recovery does not re-shed them)
+            shed = sorted((set(st.placements) | set(st.cursors))
+                          - set(st.submits) - set(st.terminals))
+            for uid in shed:
+                logger.warning(
+                    f"fleet recover: uid {uid} is unrecoverable (its "
+                    f"submit record is missing/corrupt in the "
+                    f"journal); shedding")
+                self._journal_terminal(uid, "SHED", 0)
+            self.shed += len(shed)
+            self.recover_stats = {
+                "journal": st.as_dict(),
+                "attached": len(attached),
+                "attached_uids": attached,
+                "replaced": len(replaced),
+                "replaced_uids": replaced,
+                "shed_unrecoverable": len(shed),
+                "shed_uids": list(shed),
+                "corrupt_records": st.corrupt_records,
+            }
+        logger.warning(
+            f"fleet recover (epoch {self.epoch}): "
+            f"{len(attached)} re-attached, {len(replaced)} re-placed, "
+            f"{len(shed)} shed unrecoverable, "
+            f"{st.corrupt_records} corrupt journal record(s)")
+
+    def _find_survivor(self, uid: int, st, inventories) -> Optional[int]:
+        """The slot (journaled placement first, then any survivor)
+        whose worker still holds this uid's tokens or live state."""
+        def held(s):
+            info = inventories.get(s, {}).get(str(uid))
+            return info is not None and (
+                int(info.get("buffered", 0)) > 0
+                or not info.get("done", True))
+        last = st.placements.get(uid)
+        if last is not None and last in self._pool and held(last):
+            return last
+        for s in sorted(inventories):
+            if s in self._pool and held(s):
+                return s
+        return None
+
     # -- reporting ------------------------------------------------------
     def _router_stats(self) -> dict:
         return {
@@ -1015,15 +1306,37 @@ class FleetRouter:
         agg["per_replica"] = per
         return agg
 
+    def _bootstrap_stats(self) -> dict:
+        """The fleet report's ``bootstrap`` block: fencing epoch,
+        dial-in listener counters, journal durability counters, drain
+        count and the last recovery's reconciliation. Routed through
+        ``redact_auth`` — this block reaches logs, JSONL telemetry and
+        operator dashboards, and must stay secret-free even as fields
+        are added."""
+        out = {
+            "channel": self._transport_cfg.channel,
+            "epoch": self.epoch,
+            "drains": self._supervisor.drains,
+            "draining": sorted(self._draining),
+            "listener": (self._listener.as_dict()
+                         if self._listener is not None else None),
+            "journal": (self._journal.as_dict()
+                        if self._journal is not None else None),
+            "recover": (dict(self.recover_stats)
+                        if self.recover_stats else None),
+        }
+        return redact_auth(out)
+
     def get_fleet_report(self) -> dict:
         """Per-replica snapshots + router totals + aggregated prefix
-        reuse + the transport block + the supervisor's recovery
-        history."""
+        reuse + the transport block + the bootstrap block + the
+        supervisor's recovery history."""
         return {
             "replicas": {str(rep.slot): rep.snapshot()
                          for rep in self._replicas},
             "router": self._router_stats(),
             "prefix": self._fleet_prefix_stats(),
             "transport": self._transport_stats(),
+            "bootstrap": self._bootstrap_stats(),
             "recovery": self._supervisor.report(),
         }

@@ -43,14 +43,19 @@ delay tokens but never skip or repeat one.
 """
 
 import hashlib
+import hmac
 import json
+import secrets
 import socket
+import ssl as ssl_module
 import struct
 import time
 from collections import deque
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
-from .....resilience.errors import (ServingOverloadError,
+from .....resilience.errors import (BootstrapAuthError,
+                                    FencingError,
+                                    ServingOverloadError,
                                     TerminalRequestError,
                                     TransportConnectError,
                                     TransportDecodeError,
@@ -82,6 +87,18 @@ MSG_SNAPSHOT = "SNAPSHOT"
 MSG_HEARTBEAT = "HEARTBEAT"
 MSG_SHUTDOWN = "SHUTDOWN"
 MSG_ERR = "ERR"
+
+# bootstrap handshake (pre-HELLO, same frame format, rpc id 0): a
+# dial-in worker opens with JOIN; the router fences on epochs, then —
+# when auth is required — answers JOIN_CHALLENGE with a fresh nonce;
+# the worker proves the shared secret with JOIN_AUTH (an HMAC over
+# nonce:epoch:slot — the secret itself NEVER rides the wire); the
+# router admits with JOIN_OK or refuses with a typed ERR
+# (etype "auth" / "fenced").
+MSG_JOIN = "JOIN"
+MSG_JOIN_CHALLENGE = "JOIN_CHALLENGE"
+MSG_JOIN_AUTH = "JOIN_AUTH"
+MSG_JOIN_OK = "JOIN_OK"
 
 
 def encode_frame(msg: dict) -> bytes:
@@ -251,19 +268,36 @@ class SocketChannel(Channel):
                 deadline = time.monotonic()
 
     def close(self) -> None:
-        if self._sock is not None:
+        """Idempotent teardown with NO leak paths: the socket is shut
+        down both ways (so a worker blocked in recv sees EOF instead
+        of hanging on a half-open connection) and the child — when
+        this channel owns one — is terminated, escalated to kill past
+        the grace period, and ALWAYS reaped (a dead-but-unwaited child
+        is a zombie that survives the channel object). ``_proc`` /
+        ``_sock`` are nulled first so a second close (or a close
+        racing the prober) is a no-op."""
+        sock, self._sock = self._sock, None
+        if sock is not None:
             try:
-                self._sock.close()
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass        # already disconnected / never connected
+            try:
+                sock.close()
             except OSError:
                 pass
-            self._sock = None
-        if self._proc is not None and self._proc.poll() is None:
-            self._proc.terminate()
+        proc, self._proc = self._proc, None
+        if proc is not None:
             try:
-                self._proc.wait(timeout=5.0)
-            except Exception:   # still alive past the grace period
-                self._proc.kill()
-                self._proc.wait(timeout=5.0)
+                if proc.poll() is None:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=5.0)
+                    except Exception:   # alive past the grace period
+                        proc.kill()
+                        proc.wait(timeout=5.0)
+            except OSError:
+                pass        # raced its own exit; poll() above reaped
         self._buf.clear()
 
 
@@ -651,3 +685,363 @@ class HealthProber:
                 "reconnects": self.reconnects,
                 "suspect": self.suspect,
                 "latency_ms": probe_percentiles_ms(self.latencies)}
+
+
+# -- multi-host bootstrap: dial-in workers, auth, fencing -----------------
+
+# Exact field names whose values are auth material. Every surface that
+# serializes bootstrap state (logs, spans, JSONL telemetry, the fleet
+# report) must route dicts through ``redact_auth`` — matched exactly
+# (not by substring) so telemetry names like ``tokens`` / ``n_tokens``
+# stay readable. ``token_env`` holds an env-var NAME, not a secret,
+# and is deliberately absent.
+_AUTH_FIELDS = frozenset((
+    "token", "mac", "nonce", "secret", "hmac", "password",
+    "auth_token", "shared_secret", "ssl_keyfile_password"))
+
+_REDACTED = "<redacted>"
+
+
+def redact_auth(obj):
+    """Deep-copy ``obj`` with every ``_AUTH_FIELDS`` value replaced by
+    ``"<redacted>"`` (empty values pass through — an operator reading a
+    report needs to see that auth is UNCONFIGURED, not that a secret
+    exists). Non-dict leaves are returned as-is."""
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if isinstance(v, (dict, list, tuple)):
+                out[k] = redact_auth(v)
+            elif str(k).lower() in _AUTH_FIELDS and v:
+                out[k] = _REDACTED
+            else:
+                out[k] = v
+        return out
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(redact_auth(v) for v in obj)
+    return obj
+
+
+def join_mac(token: str, nonce: str, epoch: int, slot: int) -> str:
+    """The challenge-response proof: HMAC-SHA256 of the router's nonce,
+    its epoch, and the claimed slot, keyed on the shared secret. The
+    epoch and slot are inside the MAC so a captured proof cannot be
+    replayed against a later router generation or for another slot."""
+    msg = f"{nonce}:{int(epoch)}:{int(slot)}".encode()
+    return hmac.new(token.encode(), msg, hashlib.sha256).hexdigest()
+
+
+def server_ssl_context(certfile: str,
+                       keyfile: str = "") -> "ssl_module.SSLContext":
+    """Opt-in TLS for the listener side (stdlib ``ssl`` only)."""
+    ctx = ssl_module.SSLContext(ssl_module.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile, keyfile or None)
+    return ctx
+
+
+def client_ssl_context(cafile: str = "") -> "ssl_module.SSLContext":
+    """Opt-in TLS for the dial-in worker side. With a ``cafile`` the
+    router's cert is verified against it (hostname checks stay off —
+    fleet hosts dial addresses, not DNS names); without one the
+    channel is encrypted but unauthenticated at the TLS layer — the
+    HMAC handshake still authenticates the JOIN either way."""
+    ctx = ssl_module.SSLContext(ssl_module.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    if cafile:
+        ctx.load_verify_locations(cafile)
+    else:
+        ctx.verify_mode = ssl_module.CERT_NONE
+    return ctx
+
+
+def recv_frame(sock: socket.socket, timeout: float = 5.0) -> dict:
+    """Blocking single-frame read off a raw socket (handshake helper —
+    steady-state traffic goes through ``SocketChannel``'s buffered
+    reassembly). Raises ``ConnectionError`` on EOF/timeout and
+    ``TransportDecodeError`` on a torn frame."""
+    deadline = time.monotonic() + max(0.05, timeout)
+
+    def _read(n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise ConnectionError("handshake frame timed out")
+            sock.settimeout(left)
+            try:
+                chunk = sock.recv(n - len(buf))
+            except socket.timeout:
+                raise ConnectionError(
+                    "handshake frame timed out") from None
+            if not chunk:
+                raise ConnectionError(
+                    "peer closed during handshake")
+            buf += chunk
+        return buf
+
+    head = _read(_HEADER.size)
+    magic, _ver, n = _HEADER.unpack(head)
+    if magic != _MAGIC or n > (64 << 20):
+        raise TransportDecodeError(-1, "join", "bad handshake header")
+    return decode_frame(head + _read(n))
+
+
+class FleetListener:
+    """The router's dial-in front door: binds an advertised address,
+    accepts worker connections, runs the JOIN handshake (fencing +
+    optional HMAC challenge-response + optional TLS), and parks each
+    authenticated socket by its claimed slot until the router's
+    ``RemoteConnector`` takes it.
+
+    Fencing admits ``worker_epoch`` 0 (a fresh worker that never
+    joined), the router's own epoch (a re-dial inside this
+    generation), or epoch-1 (a worker surviving from the generation
+    the recovered router replaced). Anything NEWER than the router is
+    split-brain — the worker already belongs to a later generation and
+    this (stale) router must not reclaim it; anything older than
+    epoch-1 is a long-partitioned stray. Both are refused with the
+    typed ``fenced`` ERR so the worker can decide restart-vs-walk-away
+    programmatically.
+
+    A second JOIN for an already-parked slot replaces the parked
+    socket (the old one is closed) — a worker that re-dialed after a
+    network flap wins over its own stale connection."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 token: str = "", epoch: int = 1,
+                 require_auth: bool = True,
+                 ssl_context: Optional["ssl_module.SSLContext"] = None,
+                 handshake_timeout_s: float = 5.0):
+        if require_auth and not token:
+            raise ValueError(
+                "fleet listener requires a bootstrap token when "
+                "require_auth is on (set serving.fleet.bootstrap."
+                "token_env, or disable require_auth for loopback "
+                "drills)")
+        self._token = token
+        self.epoch = int(epoch)
+        self.require_auth = bool(require_auth)
+        self._ssl_context = ssl_context
+        self._handshake_timeout_s = float(handshake_timeout_s)
+        self._parked: Dict[int, socket.socket] = {}
+        self._caps: Dict[int, dict] = {}
+        self.joins = 0
+        self.auth_failures = 0
+        self.fenced = 0
+        self.handshake_errors = 0
+        self._closed = False
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()[:2]
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def parked_slots(self):
+        return tuple(sorted(self._parked))
+
+    def capabilities(self, slot: int) -> dict:
+        return dict(self._caps.get(int(slot), {}))
+
+    # -- the handshake -------------------------------------------------
+    def poll_join(self, timeout: float = 0.5) -> Optional[int]:
+        """Accept at most one dial-in and run its handshake; returns
+        the admitted slot, or None (nothing dialed in, or the
+        handshake was refused — refusals are counted, never raised:
+        one hostile/broken dialer must not break the accept loop)."""
+        if self._closed:
+            raise ConnectionError("fleet listener is closed")
+        self._sock.settimeout(max(0.05, timeout))
+        try:
+            conn, _addr = self._sock.accept()
+        except socket.timeout:
+            return None
+        try:
+            if self._ssl_context is not None:
+                conn.settimeout(self._handshake_timeout_s)
+                conn = self._ssl_context.wrap_socket(
+                    conn, server_side=True)
+            return self._admit(conn)
+        except (OSError, TransportError, ssl_module.SSLError) as e:
+            self.handshake_errors += 1
+            logger.warning(f"fleet bootstrap: handshake failed: "
+                           f"{type(e).__name__}: {e}")
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return None
+
+    def _refuse(self, conn, etype: str, text: str, **fields) -> None:
+        try:
+            conn.sendall(encode_frame(dict(
+                {"v": PROTOCOL_VERSION, "id": 0, "kind": MSG_ERR,
+                 "etype": etype, "error": text}, **fields)))
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _admit(self, conn) -> Optional[int]:
+        msg = recv_frame(conn, self._handshake_timeout_s)
+        if msg.get("kind") != MSG_JOIN:
+            self.handshake_errors += 1
+            self._refuse(conn, "value",
+                         f"expected JOIN, got {msg.get('kind')!r}")
+            return None
+        slot = int(msg.get("slot", -1))
+        worker_epoch = int(msg.get("epoch", 0))
+        with span("fleet.join", slot=slot, epoch=self.epoch):
+            if worker_epoch > self.epoch or \
+                    0 < worker_epoch < self.epoch - 1:
+                self.fenced += 1
+                self._refuse(conn, "fenced",
+                             "worker epoch outside this router's "
+                             "admission window",
+                             worker_epoch=worker_epoch,
+                             router_epoch=self.epoch)
+                return None
+            if self.require_auth:
+                nonce = secrets.token_hex(16)
+                conn.sendall(encode_frame(
+                    {"v": PROTOCOL_VERSION, "id": 0,
+                     "kind": MSG_JOIN_CHALLENGE, "nonce": nonce,
+                     "epoch": self.epoch}))
+                auth = recv_frame(conn, self._handshake_timeout_s)
+                want = join_mac(self._token, nonce, self.epoch, slot)
+                got = str(auth.get("mac", "")) \
+                    if auth.get("kind") == MSG_JOIN_AUTH else ""
+                if not hmac.compare_digest(want, got):
+                    self.auth_failures += 1
+                    self._refuse(conn, "auth",
+                                 "JOIN challenge-response failed")
+                    return None
+            conn.sendall(encode_frame(
+                {"v": PROTOCOL_VERSION, "id": 0, "kind": MSG_JOIN_OK,
+                 "epoch": self.epoch}))
+        conn.settimeout(None)
+        stale = self._parked.pop(slot, None)
+        if stale is not None:
+            try:
+                stale.close()
+            except OSError:
+                pass
+        self._parked[slot] = conn
+        self._caps[slot] = dict(msg.get("caps") or {})
+        self.joins += 1
+        return slot
+
+    def take(self, slot: int, deadline_s: float = 60.0
+             ) -> socket.socket:
+        """Block until an authenticated socket for ``slot`` is parked,
+        servicing other slots' joins meanwhile. Typed timeout when no
+        such worker dials in."""
+        slot = int(slot)
+        deadline = time.monotonic() + max(0.05, float(deadline_s))
+        while True:
+            if slot in self._parked:
+                return self._parked.pop(slot)
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TransportConnectError(
+                    slot, "join",
+                    f"no authenticated dial-in for slot {slot} "
+                    f"within {deadline_s:.1f}s "
+                    f"(parked: {self.parked_slots})")
+            self.poll_join(min(0.5, left))
+
+    def as_dict(self) -> dict:
+        return {"address": self.address, "epoch": self.epoch,
+                "require_auth": self.require_auth,
+                "ssl": self._ssl_context is not None,
+                "joins": self.joins,
+                "auth_failures": self.auth_failures,
+                "fenced": self.fenced,
+                "handshake_errors": self.handshake_errors,
+                "parked": len(self._parked)}
+
+    def close(self) -> None:
+        self._closed = True
+        for s in self._parked.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._parked.clear()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def remote_connector(listener: FleetListener, slot: int,
+                     join_deadline_s: float = 60.0) -> Callable:
+    """Connector for a ``SocketChannel`` whose worker dials IN: no
+    process is spawned (workers are launched out-of-band — a cluster
+    scheduler, a systemd unit, an operator's shell), establishment
+    just waits for the slot's authenticated socket at the listener.
+    Returns ``(None, sock)`` — SocketChannel already handles a
+    channel that owns no child process."""
+
+    def connector():
+        return None, listener.take(slot, join_deadline_s)
+
+    return connector
+
+
+def worker_join(sock: socket.socket, *, slot: int, token: str = "",
+                epoch: int = 0, capabilities: Optional[dict] = None,
+                timeout: float = 5.0) -> int:
+    """Worker-side JOIN handshake on a freshly dialed socket. Returns
+    the router's epoch (the worker adopts it — its next re-dial
+    presents it, which is what lets a surviving worker pass the
+    recovered router's epoch-1 admission window). Raises
+    ``BootstrapAuthError`` / ``FencingError`` typed; the worker's
+    re-dial loop retries neither (same secret cannot start passing,
+    and a fenced worker must restart fresh, not hammer the router)."""
+    sock.sendall(encode_frame(
+        {"v": PROTOCOL_VERSION, "id": 0, "kind": MSG_JOIN,
+         "slot": int(slot), "epoch": int(epoch),
+         "caps": dict(capabilities or {})}))
+    reply = recv_frame(sock, timeout)
+    if reply.get("kind") == MSG_JOIN_CHALLENGE:
+        router_epoch = int(reply.get("epoch", 0))
+        if router_epoch < epoch:
+            # a stale router generation trying to reclaim this worker
+            # — the newer claim (ours) wins, walk away
+            raise FencingError(int(slot), "join",
+                               worker_epoch=epoch,
+                               router_epoch=router_epoch,
+                               reason="stale router generation")
+        sock.sendall(encode_frame(
+            {"v": PROTOCOL_VERSION, "id": 0, "kind": MSG_JOIN_AUTH,
+             "mac": join_mac(token, str(reply.get("nonce", "")),
+                             router_epoch, int(slot))}))
+        reply = recv_frame(sock, timeout)
+    if reply.get("kind") == MSG_JOIN_OK:
+        router_epoch = int(reply.get("epoch", 0))
+        if router_epoch < epoch:
+            raise FencingError(int(slot), "join",
+                               worker_epoch=epoch,
+                               router_epoch=router_epoch,
+                               reason="stale router generation")
+        return router_epoch
+    etype = reply.get("etype", "")
+    if etype == "fenced":
+        raise FencingError(
+            int(slot), "join", worker_epoch=epoch,
+            router_epoch=int(reply.get("router_epoch", 0)),
+            reason=str(reply.get("error", "")))
+    if etype == "auth":
+        raise BootstrapAuthError(int(slot), "join",
+                                 str(reply.get("error", "")))
+    raise TransportError(int(slot), "join",
+                         f"unexpected bootstrap reply: "
+                         f"{reply.get('kind')!r} {reply.get('error', '')}")

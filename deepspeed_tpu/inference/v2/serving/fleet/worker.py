@@ -31,26 +31,30 @@ loopback streams bitwise.
 
 import argparse
 import json
+import os
 import socket
 import struct
 import subprocess
 import sys
+import time
 from typing import Optional
 
 import numpy as np
 
-from .....resilience.errors import (ServingOverloadError,
+from .....resilience.errors import (BootstrapAuthError, FencingError,
+                                    ServingOverloadError,
                                     TerminalRequestError,
                                     TransportConnectError,
                                     UnknownRequestError)
+from .....resilience.retry import backoff_delay
 from .....runtime.lifecycle import BoundedCache
 from .....utils.logging import logger
 from ..frontend import ServingFrontend
 from .transport import (MSG_CANCEL, MSG_ERR, MSG_HEARTBEAT, MSG_HELLO,
                         MSG_SHUTDOWN, MSG_SNAPSHOT, MSG_STEP,
                         MSG_SUBMIT, MSG_TOKENS, PROTOCOL_VERSION,
-                        TransportDecodeError, decode_frame,
-                        encode_frame)
+                        TransportDecodeError, client_ssl_context,
+                        decode_frame, encode_frame, worker_join)
 
 _EFFECTFUL = (MSG_SUBMIT, MSG_CANCEL, MSG_STEP)
 
@@ -258,8 +262,21 @@ class WorkerCore:
         self._drain_delta()     # fold pending churn into the seq
         pc = self.frontend.engine.prefix_cache
         trie = [d.hex() for d in pc._entries] if pc is not None else []
+        # per-uid survivor inventory: which requests this worker still
+        # holds token tails / live state for. A RECOVERED router reads
+        # this off the resync SNAPSHOT to re-attach surviving uids
+        # (cursor 0 -> the full buffered tail replays through the
+        # dedup cursor) instead of re-placing them from scratch.
+        uids = {}
+        for uid, buf in self._tokens.items():
+            rr = self.frontend.get_request(uid)
+            uids[str(uid)] = {
+                "buffered": len(buf),
+                "state": rr.state.name if rr is not None else None,
+                "done": bool(rr.done) if rr is not None else True}
         return {"kind": kind, "snapshot": self.snapshot(),
                 "trie": trie, "trie_seq": self._trie_seq,
+                "uids": uids,
                 # the PR-9 steady-window invariant, checkable over the
                 # wire (the socket acceptance cannot read the worker's
                 # frontend report directly)
@@ -375,12 +392,120 @@ def make_connector(slot: int, transport_cfg, serving_cfg_dict: dict):
                 f"worker did not dial back within "
                 f"{transport_cfg.connect_deadline_seconds:.0f}s") \
                 from None
+        except OSError as e:
+            # the accept itself failed (listener torn down, fd limit):
+            # the just-spawned child must not outlive the failed
+            # establishment as an orphan
+            proc.kill()
+            proc.wait(timeout=5.0)
+            raise TransportConnectError(
+                slot, "connect", f"accept failed: {e}") from None
         finally:
             lst.close()
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return proc, conn
 
     return connector
+
+
+# -- dial-in bootstrap (the multi-host path) ------------------------------
+
+
+def run_dialin_worker(core: WorkerCore, address: str, *,
+                      token: str = "", capabilities: Optional[dict] = None,
+                      ssl_cafile: str = "", use_ssl: bool = False,
+                      dial_backoff_seconds: float = 0.2,
+                      max_dials: int = 0) -> int:
+    """The dial-IN serve loop: connect to the router's advertised
+    ``host:port``, run the authenticated JOIN handshake, serve until
+    the connection drops, re-dial. A router crash is just a dropped
+    connection here — the worker keeps its engine and its token
+    buffers warm and rejoins whichever router generation answers the
+    address next (adopting its epoch), which is exactly what the
+    recovered router's SNAPSHOT resync counts on.
+
+    Refused dials (connection refused / reset — no router up yet)
+    retry on the shared backoff policy. ``BootstrapAuthError`` and
+    ``FencingError`` are NOT retried: re-presenting the same secret
+    cannot start passing, and a fenced worker must restart fresh
+    rather than hammer a router that already refused its generation —
+    both propagate typed to the caller. Returns the number of
+    successful joins; ``max_dials`` > 0 bounds dial attempts (tests)."""
+    host, _, port = address.rpartition(":")
+    host = host or "127.0.0.1"
+    caps = dict(capabilities or {})
+    caps.setdefault("pid", os.getpid())
+    epoch = 0
+    joins = 0
+    dials = 0
+    while not core.shutdown:
+        if max_dials and dials >= max_dials:
+            break
+        dials += 1
+        try:
+            sock = socket.create_connection((host, int(port)),
+                                            timeout=5.0)
+        except OSError:
+            time.sleep(backoff_delay(
+                min(dials, 8), base_seconds=dial_backoff_seconds,
+                max_seconds=2.0))
+            continue
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if use_ssl or ssl_cafile:
+                sock = client_ssl_context(ssl_cafile).wrap_socket(
+                    sock, server_hostname=host)
+            epoch = worker_join(sock, slot=core.slot, token=token,
+                                epoch=epoch, capabilities=caps)
+        except (BootstrapAuthError, FencingError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        except (OSError, ConnectionError) as e:
+            logger.warning(f"fleet worker slot {core.slot}: dial to "
+                           f"{address} failed ({e}); retrying")
+            try:
+                sock.close()
+            except OSError:
+                pass
+            time.sleep(backoff_delay(
+                min(dials, 8), base_seconds=dial_backoff_seconds,
+                max_seconds=2.0))
+            continue
+        joins += 1
+        sock.settimeout(None)
+        logger.warning(f"fleet worker slot {core.slot} joined "
+                       f"{address} (epoch {epoch}, join #{joins})")
+        serve_socket(core, sock)
+    return joins
+
+
+def spawn_dialin_workers(n: int, address: str, *,
+                         token_env: str = "DSTPU_FLEET_TOKEN",
+                         factory: str = "", worker_args=None,
+                         serving_cfg_dict=None, extra_env=None):
+    """Launch ``n`` dial-in worker PROCESSES aimed at ``address`` —
+    the out-of-band launcher a cluster scheduler would be, for bench
+    and the slow-tier drills. The bootstrap token travels ONLY via the
+    environment (``token_env`` names the variable; argv is visible to
+    every user on the host via ps). Returns the ``subprocess.Popen``
+    list; callers own termination."""
+    procs = []
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    for slot in range(int(n)):
+        cmd = [sys.executable, "-m",
+               "deepspeed_tpu.inference.v2.serving.fleet.worker",
+               "--join", address,
+               "--slot", str(slot),
+               "--token-env", token_env,
+               "--serving-json", json.dumps(serving_cfg_dict or {}),
+               "--factory", factory,
+               "--worker-args", json.dumps(worker_args or {})]
+        procs.append(subprocess.Popen(cmd, env=env))
+    return procs
 
 
 # -- the socket serve loop -----------------------------------------------
@@ -449,8 +574,17 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.inference.v2.serving.fleet.worker",
         description="one fleet replica worker process (SocketChannel)")
-    p.add_argument("--connect", required=True,
-                   help="host:port the router is listening on")
+    mode = p.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--connect",
+                      help="host:port the ROUTER spawned a listener "
+                           "on for this worker (Popen mode: the "
+                           "router launched this process)")
+    mode.add_argument("--join",
+                      help="the router's advertised bootstrap "
+                           "host:port to DIAL IN to (multi-host "
+                           "mode: this process was launched "
+                           "out-of-band and authenticates via the "
+                           "JOIN handshake)")
     p.add_argument("--slot", type=int, default=0)
     p.add_argument("--serving-json", default="{}",
                    help="ServingConfig as JSON (the router's replica "
@@ -460,8 +594,17 @@ def main(argv=None) -> int:
                         "built-in tiny-llama")
     p.add_argument("--worker-args", default="{}",
                    help="JSON kwargs for the factory")
+    p.add_argument("--token-env", default="DSTPU_FLEET_TOKEN",
+                   help="env var holding the bootstrap token (the "
+                        "secret NEVER rides argv — ps shows argv to "
+                        "every user on the host)")
+    p.add_argument("--token-file", default="",
+                   help="file holding the bootstrap token (overrides "
+                        "--token-env)")
+    p.add_argument("--ssl-cafile", default="",
+                   help="enable TLS on the dial-in connection, "
+                        "verifying the router's cert against this CA")
     args = p.parse_args(argv)
-    host, _, port = args.connect.rpartition(":")
     factory = resolve_factory(args.factory)
     kwargs = json.loads(args.worker_args)
     serving_cfg = json.loads(args.serving_json)
@@ -470,10 +613,25 @@ def main(argv=None) -> int:
     # deadline budgets the whole cold start (jax import + engine)
     engine = factory(args.slot, **kwargs)
     core = WorkerCore(args.slot, ServingFrontend(engine, serving_cfg))
+    if args.join:
+        if args.token_file:
+            with open(args.token_file) as f:
+                token = f.read().strip()
+        else:
+            token = os.environ.get(args.token_env, "")
+        try:
+            run_dialin_worker(core, args.join, token=token,
+                              ssl_cafile=args.ssl_cafile)
+        except (BootstrapAuthError, FencingError) as e:
+            logger.error(f"fleet worker slot {args.slot}: "
+                         f"bootstrap refused: {e}")
+            return 76 if isinstance(e, FencingError) else 77
+        return 0
+    host, _, port = args.connect.rpartition(":")
     sock = socket.create_connection((host or "127.0.0.1", int(port)))
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     logger.warning(f"fleet worker slot {args.slot} connected to "
-                   f"{args.connect} (pid {__import__('os').getpid()})")
+                   f"{args.connect} (pid {os.getpid()})")
     serve_socket(core, sock)
     return 0
 

@@ -175,6 +175,40 @@ class TransportDecodeError(TransportError):
     and terminal only once the budget is spent."""
 
 
+class BootstrapAuthError(TransportError):
+    """A dial-in worker's JOIN failed the HMAC challenge-response
+    (wrong shared secret, or auth material missing where the router
+    requires it). Terminal for that connection — retrying with the
+    same secret cannot succeed, the operator must fix the token."""
+
+
+class FencingError(TransportError):
+    """A JOIN was refused on fencing epochs: the worker belongs to a
+    different router generation than the one it dialed (a partitioned
+    worker reconnecting to a newer router, or a stale router trying
+    to reclaim a worker a newer generation already owns). Carries
+    both epochs so the refused side can decide restart-fresh vs
+    walk-away programmatically — admitting the stale side would
+    split-brain the fleet."""
+
+    def __init__(self, slot: int, op: str, *, worker_epoch: int,
+                 router_epoch: int, reason: str = ""):
+        self.worker_epoch = int(worker_epoch)
+        self.router_epoch = int(router_epoch)
+        super().__init__(
+            slot, op,
+            f"fenced (worker epoch {worker_epoch}, router epoch "
+            f"{router_epoch})" + (f": {reason}" if reason else ""))
+
+
+class JournalCorruptionError(ResilienceError):
+    """A write-ahead journal record failed to parse (torn tail from a
+    crash mid-append, or on-disk corruption). Recovery degrades PER
+    RECORD — the bad line is counted and skipped, requests whose
+    submit record is unreadable are shed typed — it never crashes the
+    recovering router on a journal its dead predecessor tore."""
+
+
 class InjectedFault(ResilienceError):
     """A deliberately injected failure (FaultInjector). Base class so
     tests can distinguish injected faults from organic ones."""

@@ -29,8 +29,16 @@ sites wired through the stack:
 Spec grammar (config ``resilience.fault_injection`` or env
 ``DSTPU_FAULT_INJECT``), comma-separated entries::
 
-    <site>:<kind>[@<after>][x<count>][~<arg>]
+    <site>[@<target>]:<kind>[@<after>][x<count>][~<arg>]
 
+    target fault only calls whose ``detail`` equals this (e.g.
+           ``transport.send@replica1:drop~0.2`` drops ~20% of ONE
+           replica's sends). A targeted spec keeps its own
+           per-(site, target) call ordinal, so ``@after``/``xcount``
+           windows and rate hashes count that target's calls alone —
+           the fix for the PR 14 gotcha that ``transport.*`` ordinals
+           are global across replicas and a drill aiming at one
+           worker had to reverse-engineer the interleaving.
     kind   ioerror | error | hang | kill | slow | corrupt
            | drop | delay | dup | reorder | truncate
     after  fire on the Nth call to the site (0-based, default 0)
@@ -95,7 +103,7 @@ class FaultSpec:
 
     def __init__(self, site: str, kind: str, after: int = 0,
                  count: Union[int, float] = 1, arg: float = 3600.0,
-                 arg_given: bool = False):
+                 arg_given: bool = False, target: Optional[str] = None):
         if kind not in _KINDS:
             raise ValueError(f"unknown fault kind {kind!r}; "
                              f"expected one of {_KINDS}")
@@ -113,6 +121,9 @@ class FaultSpec:
         # per-kind duration defaults (pg_sim) need to tell "default
         # 3600" apart from "explicit 3600"
         self.arg_given = bool(arg_given)
+        # per-target spec: only calls whose consume(detail=...) equals
+        # this fault, counted on the spec's own (site, target) ordinal
+        self.target = target or None
 
     @classmethod
     def parse(cls, entry: str) -> "FaultSpec":
@@ -120,13 +131,16 @@ class FaultSpec:
         site, sep, rest = entry.partition(":")
         if not sep or not rest:
             raise ValueError(f"bad fault spec {entry!r}: expected "
-                             "'<site>:<kind>[@after][xcount][~arg]'")
+                             "'<site>[@target]:<kind>"
+                             "[@after][xcount][~arg]'")
+        site, _, target = site.partition("@")
         m = re.fullmatch(
             r"(?P<kind>[a-z]+)(?:@(?P<after>\d+))?"
             r"(?:x(?P<count>\d+|inf))?(?:~(?P<arg>[\d.]+))?", rest)
         if m is None:
             raise ValueError(f"bad fault spec {entry!r}: expected "
-                             "'<site>:<kind>[@after][xcount][~arg]'")
+                             "'<site>[@target]:<kind>"
+                             "[@after][xcount][~arg]'")
         count: Union[int, float] = 1
         if m.group("count"):
             count = float("inf") if m.group("count") == "inf" \
@@ -137,10 +151,12 @@ class FaultSpec:
         return cls(site, m.group("kind"),
                    after=int(m.group("after") or 0), count=count,
                    arg=float(m.group("arg") or 3600.0),
-                   arg_given=m.group("arg") is not None)
+                   arg_given=m.group("arg") is not None,
+                   target=target or None)
 
     def __repr__(self):
-        return (f"FaultSpec({self.site}:{self.kind}@{self.after}"
+        tgt = f"@{self.target}" if self.target else ""
+        return (f"FaultSpec({self.site}{tgt}:{self.kind}@{self.after}"
                 f"x{self.count}~{self.arg})")
 
 
@@ -186,27 +202,51 @@ class FaultInjector:
         with self._lock:
             return self._calls.get(site, 0)
 
-    def _match(self, site: str):
+    def _match(self, site: str, detail: str = ""):
         """Advance ``site``'s call ordinal and return (spec, ordinal)
-        for the matching rule (spec None when nothing matches)."""
+        for the matching rule (spec None when nothing matches).
+
+        A targeted spec (``site@target:...``) only considers calls
+        whose ``detail`` equals its target, and both its window
+        (``@after``/``xcount``) and the returned ordinal run on the
+        spec's own per-(site, target) counter — so drills can aim at
+        one replica without counting the others' traffic. The global
+        per-site ordinal still advances on every call (untargeted
+        specs and ``call_count`` keep their PR 14 semantics)."""
         if not self._specs:
             return None, -1
         with self._lock:
             n = self._calls.get(site, 0)
             self._calls[site] = n + 1
+            m = -1
+            if detail and any(s.site == site and s.target == detail
+                              for s in self._specs):
+                tkey = f"{site}@{detail}"
+                m = self._calls.get(tkey, 0)
+                self._calls[tkey] = m + 1
             spec = None
+            ordinal = n
             for s in self._specs:
-                if s.site == site and s.after <= n < s.after + s.count:
-                    spec = s
+                if s.site != site:
+                    continue
+                if s.target is not None:
+                    if s.target != detail or m < 0:
+                        continue
+                    if s.after <= m < s.after + s.count:
+                        spec, ordinal = s, m
+                        break
+                elif s.after <= n < s.after + s.count:
+                    spec, ordinal = s, n
                     break
             if spec is not None:
-                self.fired.append(f"{site}:{spec.kind}@{n}")
-        return spec, n
+                tgt = f"@{spec.target}" if spec.target else ""
+                self.fired.append(f"{site}{tgt}:{spec.kind}@{ordinal}")
+        return spec, ordinal
 
     def fire(self, site: str, detail: str = ""):
         """Invoked by an instrumented site; raises/sleeps per the
         matching spec, else returns immediately."""
-        spec, n = self._match(site)
+        spec, n = self._match(site, detail)
         if spec is None:
             return
         label = f"{site}[{n}]" + (f" ({detail})" if detail else "")
@@ -232,8 +272,9 @@ class FaultInjector:
         specs and tests reason about one counter. With
         ``with_ordinal`` the return is ``(spec, ordinal)`` — the hook
         rate specs need: a consuming site hashes the ordinal to decide
-        deterministically whether this occurrence applies."""
-        spec, n = self._match(site)
+        deterministically whether this occurrence applies (a targeted
+        spec's ordinal counts that target's calls alone)."""
+        spec, n = self._match(site, detail)
         if spec is not None and (spec.count != float("inf")
                                  or n == spec.after):
             # an 'inf' rate spec matches every call — log the arming

@@ -66,6 +66,11 @@ FAULT_SITES = {
     # one consume() per message through a FaultyChannel, interpreted
     # by the channel itself: drop / delay / dup / reorder / truncate
     # (fractional ~arg < 1 = deterministic rate keyed on the ordinal).
+    # Ordinals are per-site GLOBAL across replicas; to aim at ONE
+    # replica use the per-target grammar — "transport.send@replica1:
+    # drop~0.2" — which matches only calls whose consume(detail=...)
+    # is "replica<slot>" and counts that target's calls on its own
+    # ordinal (registry keys stay the base site names).
     "transport.send":
         "faulty-channel hook on every router->worker message "
         "(SUBMIT/CANCEL/STEP/SNAPSHOT/HEARTBEAT requests): drop loses "

@@ -322,6 +322,51 @@ class ServingSpeculationConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class FleetBootstrapConfig(DeepSpeedConfigModel):
+    """Multi-host fleet bootstrap + durability knobs (inference/v2/
+    serving/fleet/), config section ``serving.fleet.bootstrap``. Two
+    concerns live here: the DIAL-IN tier (``channel = "remote"``:
+    workers launched out-of-band register themselves at the router's
+    advertised address over an authenticated, fenced JOIN handshake)
+    and the router's write-ahead request journal (survives the
+    router's own crash; ``FleetRouter.recover``). See README "Fleet
+    serving" / "Bootstrap"."""
+    # the router's listener (workers dial IN; 0 = ephemeral port —
+    # fine for tests, a production fleet pins a port so workers can
+    # re-dial a recovered router at the same address)
+    listen_host: str = "127.0.0.1"
+    listen_port: int = 0
+    # address advertised to out-of-band workers ("" = listen_host)
+    advertise_host: str = ""
+    # shared-secret HMAC admission. The secret itself NEVER rides the
+    # wire (challenge-response) and should not live in config files
+    # either: leave ``token`` empty and export it under ``token_env``
+    # on both sides (argv/config/telemetry never see it). An explicit
+    # ``token`` is for tests.
+    token: str = ""
+    token_env: str = "DSTPU_FLEET_TOKEN"
+    # refuse unauthenticated JOINs (False = dev mode: HMAC skipped
+    # when no token is configured anywhere)
+    require_auth: bool = True
+    # how long the router waits for one slot's worker to dial in
+    # (initial connect AND respawn — a remote respawn is "wait for
+    # the out-of-band relaunch to dial back")
+    join_deadline_seconds: float = 60.0
+    # opt-in stdlib-ssl channel wrap (server cert on the router;
+    # workers verify against ssl_cafile when given)
+    ssl_enabled: bool = False
+    ssl_certfile: str = ""
+    ssl_keyfile: str = ""
+    ssl_cafile: str = ""
+    # write-ahead request journal ("" = durability off): append-only
+    # JSONL of submit/placement/delivered-cursor/terminal records,
+    # fsync'd every ``journal_fsync_every`` appends
+    journal_path: str = ""
+    journal_fsync_every: int = 16
+    journal_max_bytes: int = 16 << 20
+
+
+@dataclasses.dataclass
 class FleetTransportConfig(DeepSpeedConfigModel):
     """Fleet RPC transport knobs (inference/v2/serving/fleet/
     transport.py), config section ``serving.fleet.transport``. See
@@ -329,6 +374,8 @@ class FleetTransportConfig(DeepSpeedConfigModel):
     # "loopback" (in-process worker core, deterministic — the default
     # for tests and single-host runs) | "socket" (one OS process per
     # replica via the ``fleet.worker`` entrypoint, localhost sockets)
+    # | "remote" (workers launched out-of-band dial the router's
+    # ``serving.fleet.bootstrap`` listener and JOIN authenticated)
     channel: str = "loopback"
     # per-RPC deadlines (wall seconds; loopback treats an empty inbox
     # as an immediate attempt timeout, so these only gate sockets).
@@ -394,6 +441,8 @@ class ServingFleetConfig(DeepSpeedConfigModel):
     imbalance_alert_spread: int = 0
     # the RPC layer between router and replica workers
     transport: FleetTransportConfig = submodel(FleetTransportConfig)
+    # multi-host dial-in bootstrap + the durable-router journal
+    bootstrap: FleetBootstrapConfig = submodel(FleetBootstrapConfig)
 
 
 @dataclasses.dataclass

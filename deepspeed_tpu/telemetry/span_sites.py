@@ -121,6 +121,18 @@ SPAN_SITES = {
         "resynchronizing a reconnecting replica's affinity view: "
         "SNAPSHOT full-trie rebuild, then deltas resume (args: slot, "
         "blocks)",
+    "fleet.join":
+        "one dial-in worker's bootstrap admission: fencing check + "
+        "HMAC challenge-response (args: slot, epoch) — "
+        "transport.FleetListener._admit",
+    "fleet.recover":
+        "a fresh router reconciling a dead one's journal: re-attach "
+        "surviving uids, re-place the rest, shed the unrecoverable "
+        "(args: epoch, live)",
+    "fleet.drain":
+        "gracefully draining one replica before detach: no new "
+        "placements, in-flight work finishes in place (args: slot) — "
+        "the rolling-restart primitive",
     # ---- elastic supervisor (elasticity/supervisor.py) ----
     "supervisor.gate":
         "the pre-dispatch health gate (one per supervised step)",

@@ -1,0 +1,702 @@
+"""Multi-host fleet bootstrap: the dial-in JOIN handshake (HMAC
+challenge-response auth + fencing epochs, both directions), secret
+redaction, the SocketChannel teardown/orphan regression, per-target
+channel faults, and the remote-channel acceptance e2e — the router
+killed mid-decode, a fresh one ``recover()``-ing from the journal with
+every finished stream bitwise identical to the undisturbed run."""
+
+import json
+import os
+import socket
+import subprocess
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.inference.v2 import FleetRouter, RequestState
+from deepspeed_tpu.inference.v2.serving.fleet.replica import Replica
+from deepspeed_tpu.inference.v2.serving.fleet.transport import (
+    MSG_HELLO, MSG_JOIN, MSG_JOIN_CHALLENGE, MSG_JOIN_OK, MSG_SHUTDOWN,
+    PROTOCOL_VERSION, FleetListener, RpcClient, SocketChannel,
+    encode_frame, join_mac, recv_frame, redact_auth, remote_connector,
+    server_ssl_context, worker_join)
+from deepspeed_tpu.inference.v2.serving.fleet.worker import (
+    WorkerCore, run_dialin_worker, spawn_dialin_workers)
+from deepspeed_tpu.inference.v2.serving.frontend import ServingFrontend
+from deepspeed_tpu.resilience.errors import (BootstrapAuthError,
+                                             FencingError,
+                                             TransportConnectError,
+                                             TransportDecodeError)
+from deepspeed_tpu.resilience.fault_injector import fault_injector
+from tests.unit.inference.serving.fleet.test_fleet_transport import (
+    SYS, _FakeFrontend, _factory, _single_frontend_refs, _tcfg)
+
+TOK = "bootstrap-drill-secret"
+OPENSSL = "/usr/bin/openssl"
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault_injector.reset()
+    yield
+    fault_injector.reset()
+
+
+def _dial(listener, *, slot=0, token="", epoch=0, caps=None,
+          poll_s=6.0):
+    """One worker-side dial + JOIN against a live listener (the
+    listener's accept loop runs here, the dial in a thread — both
+    halves of the handshake block on each other)."""
+    out = {}
+
+    def worker():
+        try:
+            s = socket.create_connection(
+                (listener.host, listener.port), timeout=5.0)
+        except OSError as e:
+            out["exc"] = e
+            return
+        try:
+            out["epoch"] = worker_join(s, slot=slot, token=token,
+                                       epoch=epoch, capabilities=caps)
+            out["sock"] = s
+        except BaseException as e:
+            out["exc"] = e
+            s.close()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    deadline = time.monotonic() + poll_s
+    while t.is_alive() and time.monotonic() < deadline:
+        listener.poll_join(0.2)
+    t.join(5.0)
+    assert not t.is_alive(), "handshake deadlocked"
+    return out
+
+
+class TestRedaction:
+
+    def test_exact_keys_redacted_deep(self):
+        obj = {"token": "s3cret", "nested": [{"mac": "ff", "slot": 1}],
+               "listener": {"nonce": "aa", "address": "h:1"}}
+        r = redact_auth(obj)
+        assert r["token"] == "<redacted>"
+        assert r["nested"][0]["mac"] == "<redacted>"
+        assert r["nested"][0]["slot"] == 1
+        assert r["listener"]["nonce"] == "<redacted>"
+        assert "s3cret" not in json.dumps(r)
+        # the input is untouched (deep copy, not mutation)
+        assert obj["token"] == "s3cret"
+
+    def test_exact_match_not_substring(self):
+        # telemetry names sharing a substring stay readable
+        r = redact_auth({"tokens": [1, 2], "n_tokens": 7,
+                         "token_budget": 32, "machine": "h9",
+                         "token_env": "DSTPU_FLEET_TOKEN"})
+        assert r == {"tokens": [1, 2], "n_tokens": 7,
+                     "token_budget": 32, "machine": "h9",
+                     "token_env": "DSTPU_FLEET_TOKEN"}
+
+    def test_empty_values_pass_through(self):
+        # an operator must be able to SEE that auth is unconfigured
+        assert redact_auth({"token": ""}) == {"token": ""}
+
+
+class TestJoinMac:
+
+    def test_mac_binds_epoch_and_slot(self):
+        base = join_mac(TOK, "nonce", 3, 1)
+        assert join_mac(TOK, "nonce", 3, 1) == base
+        assert join_mac(TOK, "nonce", 4, 1) != base   # epoch bound
+        assert join_mac(TOK, "nonce", 3, 2) != base   # slot bound
+        assert join_mac(TOK, "other", 3, 1) != base   # nonce bound
+        assert join_mac("other", "nonce", 3, 1) != base
+
+
+class TestRecvFrame:
+
+    def test_torn_and_timeout_and_eof(self):
+        a, b = socket.socketpair()
+        try:
+            # bad magic is a typed decode error
+            b.sendall(b"XXXX" + b"\x00" * 6)
+            with pytest.raises(TransportDecodeError):
+                recv_frame(a, timeout=1.0)
+            # nothing arriving is a ConnectionError, not a hang
+            with pytest.raises(ConnectionError):
+                recv_frame(a, timeout=0.2)
+            # peer death mid-frame is a ConnectionError
+            b.sendall(encode_frame({"id": 0, "kind": "JOIN"})[:5])
+            b.close()
+            with pytest.raises(ConnectionError):
+                recv_frame(a, timeout=1.0)
+        finally:
+            a.close()
+
+
+class TestJoinHandshake:
+
+    def test_good_join_parks_slot_and_adopts_epoch(self):
+        lst = FleetListener(token=TOK, epoch=3)
+        try:
+            out = _dial(lst, slot=1, token=TOK, epoch=0,
+                        caps={"host": "w1"})
+            assert out.get("epoch") == 3       # worker adopts it
+            assert lst.parked_slots == (1,)
+            assert lst.capabilities(1)["host"] == "w1"
+            assert lst.joins == 1 and lst.auth_failures == 0
+            out["sock"].close()
+        finally:
+            lst.close()
+
+    def test_wrong_token_is_typed_and_not_parked(self):
+        lst = FleetListener(token=TOK, epoch=1)
+        try:
+            out = _dial(lst, slot=0, token="wrong", epoch=0)
+            assert isinstance(out.get("exc"), BootstrapAuthError)
+            assert lst.auth_failures == 1 and lst.joins == 0
+            assert lst.parked_slots == ()
+        finally:
+            lst.close()
+
+    def test_newer_worker_epoch_is_fenced(self):
+        """Split-brain: a worker already owned by a LATER router
+        generation must be refused by this (stale) one."""
+        lst = FleetListener(token=TOK, epoch=3)
+        try:
+            out = _dial(lst, slot=0, token=TOK, epoch=9)
+            e = out.get("exc")
+            assert isinstance(e, FencingError)
+            assert e.worker_epoch == 9 and e.router_epoch == 3
+            assert lst.fenced == 1 and lst.joins == 0
+        finally:
+            lst.close()
+
+    def test_long_partitioned_stray_is_fenced(self):
+        lst = FleetListener(token=TOK, epoch=5)
+        try:
+            out = _dial(lst, slot=0, token=TOK, epoch=1)
+            assert isinstance(out.get("exc"), FencingError)
+            assert lst.fenced == 1
+        finally:
+            lst.close()
+
+    def test_admission_window_fresh_own_and_previous(self):
+        lst = FleetListener(token=TOK, epoch=5)
+        try:
+            for slot, epoch in ((0, 0), (1, 5), (2, 4)):
+                out = _dial(lst, slot=slot, token=TOK, epoch=epoch)
+                assert out.get("epoch") == 5, (slot, epoch, out)
+                out["sock"].close()
+            assert lst.joins == 3 and lst.fenced == 0
+        finally:
+            lst.close()
+
+    def test_worker_fences_stale_router(self):
+        """The worker side of fencing: a stale router generation that
+        somehow passes the listener check (or skips auth) must not
+        reclaim a worker that already joined a newer one."""
+        for reply in (
+            {"v": PROTOCOL_VERSION, "id": 0,
+             "kind": MSG_JOIN_CHALLENGE, "nonce": "n", "epoch": 1},
+            {"v": PROTOCOL_VERSION, "id": 0, "kind": MSG_JOIN_OK,
+             "epoch": 1},
+        ):
+            a, b = socket.socketpair()
+
+            def stale_router(r=reply, sock=b):
+                msg = recv_frame(sock, 5.0)
+                assert msg["kind"] == MSG_JOIN
+                sock.sendall(encode_frame(r))
+
+            t = threading.Thread(target=stale_router, daemon=True)
+            t.start()
+            with pytest.raises(FencingError) as ei:
+                worker_join(a, slot=0, token=TOK, epoch=5)
+            assert ei.value.router_epoch == 1
+            assert ei.value.worker_epoch == 5
+            t.join(5.0)
+            a.close()
+            b.close()
+
+    def test_split_brain_drill_newer_router_wins(self):
+        """Two routers claim the fleet: the worker ends up owned by
+        the NEWER epoch, and the older router cannot take it back."""
+        old = FleetListener(token=TOK, epoch=2)
+        new = FleetListener(token=TOK, epoch=3)
+        try:
+            out = _dial(new, slot=0, token=TOK, epoch=0)
+            assert out.get("epoch") == 3
+            out["sock"].close()
+            # the stale router's reclaim attempt is refused typed
+            out2 = _dial(old, slot=0, token=TOK, epoch=3)
+            assert isinstance(out2.get("exc"), FencingError)
+            assert old.fenced == 1 and old.joins == 0
+            # the owning router re-admits its own epoch
+            out3 = _dial(new, slot=0, token=TOK, epoch=3)
+            assert out3.get("epoch") == 3
+            out3["sock"].close()
+        finally:
+            old.close()
+            new.close()
+
+    def test_no_auth_mode_skips_challenge(self):
+        lst = FleetListener(token="", epoch=1, require_auth=False)
+        try:
+            out = _dial(lst, slot=0, token="", epoch=0)
+            assert out.get("epoch") == 1 and lst.joins == 1
+            out["sock"].close()
+        finally:
+            lst.close()
+
+    def test_require_auth_demands_a_token(self):
+        with pytest.raises(ValueError, match="token"):
+            FleetListener(token="", require_auth=True)
+
+    def test_garbage_dialer_does_not_break_the_listener(self):
+        lst = FleetListener(token=TOK, epoch=1)
+        try:
+            s = socket.create_connection((lst.host, lst.port),
+                                         timeout=5.0)
+            s.sendall(b"GET / HTTP/1.0\r\n\r\n")
+            assert lst.poll_join(2.0) is None
+            s.close()
+            assert lst.handshake_errors == 1
+            # and a real worker still gets in afterwards
+            out = _dial(lst, slot=0, token=TOK)
+            assert out.get("epoch") == 1
+            out["sock"].close()
+        finally:
+            lst.close()
+
+    def test_take_deadline_is_typed(self):
+        lst = FleetListener(token=TOK, epoch=1)
+        try:
+            with pytest.raises(TransportConnectError, match="slot 3"):
+                lst.take(3, deadline_s=0.2)
+        finally:
+            lst.close()
+
+    def test_rejoin_replaces_parked_socket(self):
+        lst = FleetListener(token=TOK, epoch=1)
+        try:
+            out1 = _dial(lst, slot=0, token=TOK)
+            out2 = _dial(lst, slot=0, token=TOK, epoch=1)
+            assert lst.joins == 2 and lst.parked_slots == (0,)
+            taken = lst.take(0, deadline_s=1.0)
+            assert taken is not out1["sock"]   # the re-dial won
+            taken.close()
+            out1["sock"].close()
+            out2["sock"].close()
+        finally:
+            lst.close()
+
+    def test_listener_report_is_secret_free(self):
+        lst = FleetListener(token=TOK, epoch=2)
+        try:
+            d = lst.as_dict()
+            assert d["require_auth"] is True and d["epoch"] == 2
+            assert TOK not in json.dumps(d)
+        finally:
+            lst.close()
+
+
+class _FakeProc:
+    """Popen-shaped recorder for the teardown regression tests."""
+
+    def __init__(self, ignores_terminate=False):
+        self.returncode = None
+        self.calls = []
+        self._stubborn = ignores_terminate
+
+    def poll(self):
+        self.calls.append("poll")
+        return self.returncode
+
+    def terminate(self):
+        self.calls.append("terminate")
+        if not self._stubborn:
+            self.returncode = -15
+
+    def kill(self):
+        self.calls.append("kill")
+        self.returncode = -9
+
+    def wait(self, timeout=None):
+        self.calls.append("wait")
+        if self.returncode is None:
+            raise subprocess.TimeoutExpired("worker", timeout)
+        return self.returncode
+
+
+class TestSocketChannelTeardown:
+    """The connect-failure audit: no orphaned worker process and no
+    half-open socket survives any teardown path."""
+
+    def test_close_reaps_child_and_shuts_socket(self):
+        a, b = socket.socketpair()
+        proc = _FakeProc()
+        ch = SocketChannel(lambda: (proc, a))
+        ch.connect()
+        ch.close()
+        assert "terminate" in proc.calls and "wait" in proc.calls
+        assert proc.returncode == -15
+        # the peer sees EOF, not a half-open hang
+        b.settimeout(1.0)
+        assert b.recv(1) == b""
+        b.close()
+        assert a.fileno() == -1               # really closed
+
+    def test_close_is_idempotent(self):
+        a, b = socket.socketpair()
+        proc = _FakeProc()
+        ch = SocketChannel(lambda: (proc, a))
+        ch.connect()
+        ch.close()
+        n = len(proc.calls)
+        ch.close()                            # second close: no-op
+        ch.close()
+        assert len(proc.calls) == n
+        b.close()
+
+    def test_close_escalates_to_kill(self):
+        a, b = socket.socketpair()
+        proc = _FakeProc(ignores_terminate=True)
+        ch = SocketChannel(lambda: (proc, a))
+        ch.connect()
+        ch.close()
+        assert "kill" in proc.calls           # past the grace period
+        assert proc.returncode == -9
+        assert proc.calls.count("wait") == 2  # reaped after the kill
+        b.close()
+
+    def test_worker_death_before_hello_leaks_nothing(self):
+        """The regression: a worker that dialed back and died before
+        answering HELLO used to leave a zombie child and a half-open
+        socket pinned to the failed Replica."""
+        a, b = socket.socketpair()
+        b.close()                 # died between dial-back and HELLO
+        proc = _FakeProc()
+        with pytest.raises(Exception):
+            Replica(0, lambda slot: SocketChannel(lambda: (proc, a)),
+                    _tcfg(rpc_retries=0, rpc_deadline_seconds=0.5,
+                          connect_deadline_seconds=0.5))
+        assert proc.returncode is not None    # child reaped
+        assert "wait" in proc.calls
+        assert a.fileno() == -1               # socket closed
+
+
+class TestDialinWorkerLoop:
+
+    def test_serve_and_shutdown_roundtrip(self):
+        lst = FleetListener(token=TOK, epoch=1)
+        core = WorkerCore(0, _FakeFrontend())
+        t = threading.Thread(target=run_dialin_worker,
+                             args=(core, lst.address),
+                             kwargs=dict(token=TOK), daemon=True)
+        t.start()
+        try:
+            ch = SocketChannel(remote_connector(lst, 0, 10.0))
+            ch.connect()
+            rpc = RpcClient(ch, 0, _tcfg())
+            assert rpc.call(MSG_HELLO)["kind"] == "HELLO_OK"
+            assert rpc.call(MSG_SHUTDOWN)["kind"] == "BYE"
+            t.join(10.0)
+            assert not t.is_alive()           # SHUTDOWN ended the loop
+            ch.close()
+        finally:
+            core.shutdown = True
+            lst.close()
+            t.join(5.0)
+
+    def test_auth_refusal_propagates_and_is_not_retried(self):
+        lst = FleetListener(token=TOK, epoch=1)
+        core = WorkerCore(0, _FakeFrontend())
+        box = {}
+
+        def run():
+            try:
+                run_dialin_worker(core, lst.address, token="wrong",
+                                  max_dials=5)
+            except BootstrapAuthError as e:
+                box["exc"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 6.0
+        try:
+            while t.is_alive() and time.monotonic() < deadline:
+                lst.poll_join(0.2)
+            t.join(5.0)
+            assert isinstance(box.get("exc"), BootstrapAuthError)
+            # ONE refusal, not five: the same secret cannot start
+            # passing, so hammering the router is forbidden
+            assert lst.auth_failures == 1
+        finally:
+            core.shutdown = True
+            lst.close()
+            t.join(5.0)
+
+    def test_redial_survives_listener_restart(self):
+        """A router crash is just a dropped connection to the worker:
+        the dial loop backs off and joins whichever generation answers
+        the address next, adopting its epoch."""
+        lst1 = FleetListener(token=TOK, epoch=1)
+        port = lst1.port
+        core = WorkerCore(0, _FakeFrontend())
+        t = threading.Thread(target=run_dialin_worker,
+                             args=(core, lst1.address),
+                             kwargs=dict(token=TOK), daemon=True)
+        t.start()
+        lst2 = None
+        try:
+            s1 = lst1.take(0, deadline_s=10.0)
+            lst1.close()                      # the crash
+            s1.close()
+            lst2 = FleetListener("127.0.0.1", port, token=TOK, epoch=2)
+            s2 = lst2.take(0, deadline_s=10.0)
+            assert lst2.joins == 1            # the worker re-dialed
+            s2.close()
+        finally:
+            core.shutdown = True
+            lst1.close()
+            if lst2 is not None:
+                lst2.close()
+            t.join(5.0)
+
+
+class TestPerTargetChannelFaults:
+    """`transport.send@replica1:drop~0.2`-style specs through the real
+    channel: the fault lands on ONE replica's traffic, counted on that
+    target's own ordinal."""
+
+    def test_targeted_drop_spares_the_other_replica(self):
+        from deepspeed_tpu.inference.v2.serving.fleet.transport import (
+            FaultyChannel, LoopbackChannel)
+        from tests.unit.inference.serving.fleet.test_fleet_transport \
+            import _EchoCore
+        cores = {s: _EchoCore() for s in (0, 1)}
+        chans = {s: FaultyChannel(LoopbackChannel(cores[s]), slot=s)
+                 for s in (0, 1)}
+        for ch in chans.values():
+            ch.connect()
+        fault_injector.configure("transport.send@replica1:drop~0.5")
+        for i in range(40):
+            for s in (0, 1):
+                chans[s].send(encode_frame(
+                    {"id": i, "kind": "HEARTBEAT"}))
+        fault_injector.reset()
+        assert cores[0].handled == 40          # untargeted: untouched
+        assert 5 < cores[1].handled < 35       # targeted: ~50% dropped
+        assert chans[0].injected == 0
+        assert chans[1].injected > 0
+
+
+def _start_workers(params_cfg, address, n, token, **dial_kw):
+    """N dial-in worker THREADS with real tiny-llama engines — the
+    tier-1 stand-in for out-of-band worker processes (real loopback
+    TCP, real handshake; the process variant rides the slow tier)."""
+    cores, threads = [], []
+    for slot in range(n):
+        fe = ServingFrontend(_factory(params_cfg)(slot),
+                             {"on_overload": "raise"})
+        core = WorkerCore(slot, fe)
+        t = threading.Thread(target=run_dialin_worker,
+                             args=(core, address),
+                             kwargs=dict(token=token, **dial_kw),
+                             daemon=True)
+        t.start()
+        cores.append(core)
+        threads.append(t)
+    return cores, threads
+
+
+def _stop_workers(cores, threads, *listeners):
+    for c in cores:
+        c.shutdown = True
+    for lst in listeners:
+        if lst is not None:
+            lst.close()
+    for t in threads:
+        t.join(10.0)
+
+
+def _remote_cfg(journal_path=None):
+    cfg = {"fleet": {"n_replicas": 2,
+                     "transport": {"channel": "remote"},
+                     "bootstrap": {"join_deadline_seconds": 30.0}}}
+    if journal_path:
+        cfg["fleet"]["bootstrap"]["journal_path"] = journal_path
+    return cfg
+
+
+def _kill_router_drill(params_cfg, router1, lst):
+    """Shared core of the acceptance e2e: staggered traffic through
+    ``router1``, killed mid-decode, a fresh router recovered from the
+    journal + the surviving workers — returns (router2, refs,
+    live_uids). Streams router1 finished BEFORE the crash are asserted
+    bitwise here; the live ones are router2's to finish."""
+    N = 6
+    reqs = {800 + k: SYS[k % 3] + [50 + k] for k in range(N)}
+    refs = _single_frontend_refs(params_cfg, reqs, 6)
+    port = lst.port
+    jpath = router1._journal.path
+
+    handles = {}
+    for uid, prompt in reqs.items():
+        handles[uid] = router1.submit(prompt, uid=uid,
+                                      max_new_tokens=6)
+        router1.step()
+    for _ in range(3):
+        router1.step()
+    live = [e for e in router1._entries.values() if not e.req.done]
+    assert live, "drill must catch requests mid-flight"
+    assert any(e.req.state == RequestState.DECODE for e in live)
+    assert any(e.seen > 0 for e in live)      # tokens already streamed
+    live_uids = sorted(e.req.uid for e in live)
+    for uid, h in handles.items():            # pre-crash deliveries
+        if uid not in live_uids and h.state == RequestState.FINISHED:
+            assert list(h.tokens) == refs[uid], uid
+
+    router1.crash()                           # die abruptly
+    # the next generation answers the SAME advertised address
+    lst2 = FleetListener("127.0.0.1", port, token=TOK, epoch=1)
+    router2 = FleetRouter.recover(_factory(params_cfg),
+                                  _remote_cfg(), journal_path=jpath,
+                                  listener=lst2)
+    assert router2.epoch == router1.epoch + 1
+    assert router2._listener.epoch == router2.epoch
+    rs = router2.recover_stats
+    assert rs["attached"] + rs["replaced"] == len(live_uids)
+    assert rs["attached"] >= 1                # survivors were reused
+    assert rs["shed_unrecoverable"] == 0
+    router2.drain()
+    return router2, refs, live_uids
+
+
+def _assert_bitwise_and_quiet(router2, refs, live_uids, frontends):
+    for uid in live_uids:
+        req = router2.get_request(uid)
+        assert req is not None and req.state == RequestState.FINISHED
+        assert list(req.tokens) == refs[uid], uid
+    assert router2.replay_mismatches == 0
+    assert router2.abandoned == 0
+    for slot, fe in frontends.items():
+        rep = fe.get_serving_report()
+        assert rep["recompiles"] <= 1, slot
+        assert rep["steady_blocking_syncs"] == 0, slot
+    report = router2.get_fleet_report()
+    blob = json.dumps(report)
+    assert TOK not in blob                    # secrets never surface
+    boot = report["bootstrap"]
+    assert boot["channel"] == "remote" and boot["epoch"] == 2
+    assert boot["recover"]["attached"] >= 1
+    assert boot["journal"]["records_written"] > 0
+
+
+class TestRemoteBootstrapE2E:
+    """The acceptance drill, tier-1 flavor: dial-in worker THREADS
+    over real loopback TCP with HMAC auth, the router killed
+    mid-decode, recovery via journal replay + SNAPSHOT re-attach."""
+
+    def test_kill_router_mid_decode_recovers_bitwise(self, params_cfg,
+                                                     tmp_path):
+        lst = FleetListener(token=TOK, epoch=1)
+        cores, threads = _start_workers(params_cfg, lst.address, 2,
+                                        TOK)
+        router2 = None
+        try:
+            router1 = FleetRouter(
+                _factory(params_cfg), _remote_cfg(),
+                listener=lst,
+                journal=str(tmp_path / "fleet.journal"))
+            assert lst.joins >= 2             # both workers admitted
+            router2, refs, live_uids = _kill_router_drill(
+                params_cfg, router1, lst)
+            frontends = {c.slot: c.frontend for c in cores}
+            _assert_bitwise_and_quiet(router2, refs, live_uids,
+                                      frontends)
+        finally:
+            if router2 is not None:
+                for slot in list(router2.pooled_replicas):
+                    router2._replicas[slot].detach()
+            _stop_workers(cores, threads, lst,
+                          router2._listener if router2 else None)
+
+    @pytest.mark.skipif(not os.path.exists(OPENSSL),
+                        reason="openssl binary unavailable")
+    def test_ssl_dialin_variant(self, params_cfg, tmp_path):
+        """Opt-in TLS on the dial-in channel (stdlib ssl, self-signed
+        cert): handshake + one HELLO round-trip, report flags ssl."""
+        cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+        subprocess.run(
+            [OPENSSL, "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key, "-out", cert, "-days", "1",
+             "-subj", "/CN=127.0.0.1"],
+            check=True, capture_output=True)
+        lst = FleetListener(token=TOK, epoch=1,
+                            ssl_context=server_ssl_context(cert, key))
+        core = WorkerCore(0, _FakeFrontend())
+        t = threading.Thread(target=run_dialin_worker,
+                             args=(core, lst.address),
+                             kwargs=dict(token=TOK, ssl_cafile=cert),
+                             daemon=True)
+        t.start()
+        try:
+            ch = SocketChannel(remote_connector(lst, 0, 15.0))
+            ch.connect()
+            rpc = RpcClient(ch, 0, _tcfg())
+            assert rpc.call(MSG_HELLO)["kind"] == "HELLO_OK"
+            assert lst.as_dict()["ssl"] is True
+            rpc.call(MSG_SHUTDOWN)
+            ch.close()
+        finally:
+            core.shutdown = True
+            lst.close()
+            t.join(10.0)
+
+    @pytest.mark.slow
+    def test_kill_router_with_real_worker_processes(self, params_cfg,
+                                                    tmp_path):
+        """The multi-HOST shape for real: workers are OS processes
+        launched out-of-band (`spawn_dialin_workers`), the token
+        travels via the environment, the router dies and a fresh one
+        recovers — streams still bitwise vs the single-frontend run.
+        Slow tier: two worker cold starts (jax import + engine)."""
+        lst = FleetListener(token=TOK, epoch=1)
+        procs = spawn_dialin_workers(
+            2, lst.address,
+            serving_cfg_dict={"on_overload": "raise"},
+            extra_env={"DSTPU_FLEET_TOKEN": TOK})
+        router2 = None
+        try:
+            router1 = FleetRouter(
+                _factory(params_cfg), _remote_cfg(),
+                listener=lst,
+                journal=str(tmp_path / "fleet.journal"))
+            router2, refs, live_uids = _kill_router_drill(
+                params_cfg, router1, lst)
+            for uid in live_uids:
+                req = router2.get_request(uid)
+                assert req.state == RequestState.FINISHED
+                assert list(req.tokens) == refs[uid], uid
+            assert router2.replay_mismatches == 0
+            report = router2.get_fleet_report()
+            assert TOK not in json.dumps(report)
+            for slot in router2.pooled_replicas:
+                snap = router2._replicas[slot].snapshot()
+                assert snap["recompiles"] <= 1, slot
+            # graceful goodbye: SHUTDOWN ends each worker process
+            for slot in list(router2.pooled_replicas):
+                router2._replicas[slot].detach()
+            for p in procs:
+                assert p.wait(timeout=30.0) == 0
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10.0)
+            lst.close()
+            if router2 is not None and router2._listener is not None:
+                router2._listener.close()

@@ -1,0 +1,334 @@
+"""The durable router: write-ahead request journal round-trip,
+tolerant replay under corruption (the author crashed — a torn tail is
+the expected case), the loopback crash-recover drill with bitwise
+re-placed streams, unrecoverable-uid shedding, and the graceful
+drain / rolling-restart ops."""
+
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.inference.v2 import FleetRouter, RequestState
+from deepspeed_tpu.inference.v2.serving.fleet.journal import (
+    JournalState, RequestJournal, replay)
+from deepspeed_tpu.resilience.errors import (JournalCorruptionError,
+                                             ServingOverloadError)
+from deepspeed_tpu.resilience.fault_injector import fault_injector
+from tests.unit.inference.serving.fleet.test_fleet_transport import (
+    SYS, _factory, _router, _single_frontend_refs)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault_injector.reset()
+    yield
+    fault_injector.reset()
+
+
+class TestJournalRoundtrip:
+
+    def test_all_record_kinds_round_trip(self, tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        j = RequestJournal(p)
+        j.note_epoch(3)
+        j.note_submit(7, [1, 2, 3], {"max_new_tokens": 4})
+        j.note_submit(8, [9], {"max_new_tokens": 2})
+        j.note_place(7, 1)
+        j.note_cursors({7: 2})
+        j.note_cursors({7: 5, 8: 1})          # last writer wins
+        j.note_cursors({})                    # empty batch: no record
+        j.note_terminal(8, "FINISHED", 2)
+        st = replay(p)
+        assert st.exists and st.epoch == 3
+        assert st.records_read == j.records_written == 7
+        assert st.corrupt_records == 0
+        assert st.submits[7]["prompt"] == [1, 2, 3]
+        assert st.submits[7]["kwargs"]["max_new_tokens"] == 4
+        assert st.placements == {7: 1}
+        assert st.cursors == {7: 5, 8: 1}
+        assert st.terminals[8] == {"state": "FINISHED", "n_tokens": 2}
+        assert st.live_uids() == [7]          # 8 reached terminal
+
+    def test_missing_journal_is_empty_not_an_error(self, tmp_path):
+        st = replay(str(tmp_path / "never-written.jsonl"))
+        assert not st.exists and st.records_read == 0
+        assert st.live_uids() == [] and st.errors == []
+
+    def test_fsync_batching(self, tmp_path):
+        j = RequestJournal(str(tmp_path / "j.jsonl"), fsync_every=3)
+        for uid in range(9):
+            j.note_place(uid, 0)
+        # first write syncs (an empty journal is the worst loss), then
+        # one sync per batch — far fewer than one per record
+        assert 1 <= j.fsyncs < 9
+        assert j.as_dict()["records_written"] == 9
+
+    def test_rotation_replays_both_generations(self, tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        # ~140B/record against a 1KiB budget: exactly one rotation
+        j = RequestJournal(p, max_bytes=1024)
+        j.note_epoch(1)
+        for uid in range(12):
+            j.note_submit(uid, [100 + uid] * 20, {"max_new_tokens": 1})
+        assert os.path.exists(p + ".1")
+        st = replay(p)
+        # the byte budget rotated the file; replay reads .1 then the
+        # active generation and loses nothing
+        assert set(st.submits) == set(range(12))
+        assert st.submits[0]["prompt"] == [100] * 20
+
+    def test_submit_kwargs_are_redacted(self, tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        j = RequestJournal(p)
+        j.note_submit(1, [1], {"max_new_tokens": 2, "token": "sssh"})
+        with open(p) as f:
+            raw = f.read()
+        assert "sssh" not in raw              # a durable FILE surface
+        assert replay(p).submits[1]["kwargs"]["token"] == "<redacted>"
+
+
+class TestJournalCorruption:
+    """The corruption drill: every damaged line degrades to a typed,
+    counted ``JournalCorruptionError`` — replay NEVER raises on
+    content (crashing on the dead router's journal would turn one
+    outage into two)."""
+
+    def _write(self, tmp_path, *lines):
+        p = str(tmp_path / "j.jsonl")
+        with open(p, "wb") as f:
+            f.write(b"\n".join(lines) + b"\n")
+        return p
+
+    def test_torn_tail_and_garbage_degrade_typed(self, tmp_path):
+        good = json.dumps({"rec": "submit", "uid": 1, "prompt": [5],
+                           "kwargs": {}}).encode()
+        p = self._write(
+            tmp_path,
+            json.dumps({"rec": "epoch", "epoch": 2}).encode(),
+            good,
+            b'{"rec": "place", "uid": 1, "slo',      # torn tail
+            b"\x00\xff garbage bytes \xfe",          # binary noise
+            b'[1, 2, 3]',                            # JSON, not a dict
+            b'{"rec": "warp", "uid": 9}',            # unknown kind
+            b'{"rec": "place", "uid": "NaN?"}')      # malformed field
+        st = replay(p)
+        assert st.epoch == 2 and st.live_uids() == [1]
+        assert st.records_read == 2
+        assert st.corrupt_records == 5
+        assert all(isinstance(e, JournalCorruptionError)
+                   for e in st.errors)
+        assert st.as_dict()["corrupt_records"] == 5
+
+    def test_recover_sheds_only_provably_unrecoverable(self, params_cfg,
+                                                       tmp_path):
+        """A uid referenced by place/cursor records whose SUBMIT line
+        the journal lost has no prompt to replay from — the ONLY class
+        recovery may shed; everything else is re-placed and finishes
+        bitwise."""
+        ref = _single_frontend_refs(params_cfg, {1: SYS[0] + [42]}, 4)
+        p = self._write(
+            tmp_path,
+            json.dumps({"rec": "epoch", "epoch": 1}).encode(),
+            json.dumps({"rec": "submit", "uid": 1,
+                        "prompt": SYS[0] + [42],
+                        "kwargs": {"max_new_tokens": 4}}).encode(),
+            json.dumps({"rec": "place", "uid": 1, "slot": 0}).encode(),
+            # uid 2's submit record never made it / was torn:
+            json.dumps({"rec": "place", "uid": 2, "slot": 1}).encode(),
+            json.dumps({"rec": "cursors", "c": {"2": 3}}).encode())
+        router = FleetRouter.recover(_factory(params_cfg),
+                                     {"fleet": {"n_replicas": 2}},
+                                     journal_path=p)
+        rs = router.recover_stats
+        assert rs["shed_unrecoverable"] == 1 and rs["shed_uids"] == [2]
+        assert rs["replaced"] == 1            # loopback: no survivors
+        router.drain()
+        req = router.get_request(1)
+        assert req.state == RequestState.FINISHED
+        assert list(req.tokens) == ref[1]     # bitwise from position 0
+        # the shed was journaled terminal: a SECOND recovery of the
+        # same journal does not re-shed (idempotent)
+        router2 = FleetRouter.recover(_factory(params_cfg),
+                                      {"fleet": {"n_replicas": 2}},
+                                      journal_path=p)
+        assert router2.recover_stats["shed_unrecoverable"] == 0
+        assert router2.epoch == router.epoch + 1
+
+
+class TestRouterJournalWiring:
+
+    def _recs(self, path):
+        out = []
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    out.append(json.loads(line))
+        return out
+
+    def test_write_ahead_order_and_terminals(self, params_cfg,
+                                             tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        router = _router(params_cfg, n=2, journal=p)
+        r = router.submit(SYS[0] + [30], uid=5, max_new_tokens=3)
+        router.submit(SYS[1] + [31], uid=6, max_new_tokens=3)
+        router.drain()
+        assert r.state == RequestState.FINISHED
+        recs = self._recs(p)
+        assert recs[0] == {"rec": "epoch", "epoch": 1}
+        for uid in (5, 6):
+            kinds = [(i, rec["rec"]) for i, rec in enumerate(recs)
+                     if rec.get("uid") == uid or
+                     str(uid) in (rec.get("c") or {})]
+            order = [k for _, k in kinds]
+            # submit journals BEFORE place (write-ahead), terminal last
+            assert order[0] == "submit" and order[1] == "place"
+            assert order[-1] == "terminal"
+        st = replay(p)
+        assert st.live_uids() == []           # everything terminal
+        assert st.terminals[5]["state"] == "FINISHED"
+        assert st.terminals[5]["n_tokens"] == 3
+        # delivered cursors were batched per step, not per token
+        n_cursor_recs = sum(1 for rec in recs if rec["rec"] == "cursors")
+        assert 0 < n_cursor_recs <= router._step_idx
+
+    def test_refused_submit_journals_terminal_shed(self, params_cfg,
+                                                   tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        router = _router(params_cfg, n=1, journal=p,
+                         serving={"max_queue_depth": 2})
+        router.submit(SYS[0] + [1], uid=1, max_new_tokens=2)
+        router.submit(SYS[1] + [2], uid=2, max_new_tokens=2)
+        with pytest.raises(ServingOverloadError):
+            router.submit(SYS[2] + [3], uid=3, max_new_tokens=2)
+        st = replay(p)
+        # the refused uid is submit+terminal SHED: a recovery of this
+        # journal must not resurrect a request the caller saw refused
+        assert st.terminals[3]["state"] == "SHED"
+        assert sorted(st.live_uids()) == [1, 2]
+        router.drain()
+
+    def test_bootstrap_report_block(self, params_cfg, tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        router = _router(params_cfg, n=1, journal=p)
+        router.submit(SYS[0] + [9], max_new_tokens=2)
+        router.drain()
+        boot = router.get_fleet_report()["bootstrap"]
+        assert boot["channel"] == "loopback" and boot["epoch"] == 1
+        assert boot["journal"]["records_written"] > 0
+        assert boot["listener"] is None and boot["recover"] is None
+        assert boot["drains"] == 0 and boot["draining"] == []
+
+
+class TestLoopbackCrashRecover:
+    """Kill-router drill, loopback flavor: no workers survive a
+    loopback crash (they live in the router process), so EVERY live
+    uid exercises the re-place path — bitwise replay from position 0
+    via the fold_in sampling-key contract."""
+
+    def test_crash_mid_decode_recover_replays_bitwise(self, params_cfg,
+                                                      tmp_path):
+        N = 4
+        reqs = {600 + k: SYS[k % 3] + [20 + k] for k in range(N)}
+        refs = _single_frontend_refs(params_cfg, reqs, 5)
+        p = str(tmp_path / "j.jsonl")
+        router = _router(params_cfg, n=2, journal=p)
+        for uid, prompt in reqs.items():
+            router.submit(prompt, uid=uid, max_new_tokens=5)
+            router.step()
+        live = [e for e in router._entries.values() if not e.req.done]
+        assert live and any(e.seen > 0 for e in live)  # mid-decode
+        live_uids = sorted(e.req.uid for e in live)
+
+        router.crash()
+        router2 = FleetRouter.recover(_factory(params_cfg),
+                                      {"fleet": {"n_replicas": 2}},
+                                      journal_path=p)
+        assert router2.epoch == 2
+        rs = router2.recover_stats
+        assert rs["replaced"] == len(live_uids)
+        assert rs["attached"] == 0            # loopback: none survive
+        assert rs["shed_unrecoverable"] == 0
+        router2.drain()
+        for uid in live_uids:
+            req = router2.get_request(uid)
+            assert req.state == RequestState.FINISHED
+            assert list(req.tokens) == refs[uid], uid
+        assert router2.replay_mismatches == 0
+        assert router2.abandoned == 0
+        # zero double delivery: the recovered streams are exactly the
+        # reference length, not reference + replayed prefix
+        for uid in live_uids:
+            assert len(router2.result(uid)) == len(refs[uid])
+
+
+class TestDrainRollingRestart:
+
+    def test_drain_replica_smoke(self, params_cfg):
+        router = _router(params_cfg, n=2)
+        reqs = {70 + k: SYS[k % 3] + [10 + k] for k in range(4)}
+        refs = _single_frontend_refs(params_cfg, reqs, 4)
+        handles = {uid: router.submit(pr, uid=uid, max_new_tokens=4)
+                   for uid, pr in reqs.items()}
+        victim = next(e.slot for e in router._entries.values())
+        steps = router.drain_replica(victim)
+        assert steps > 0
+        assert victim not in router.pooled_replicas
+        # the drained replica's work finished IN PLACE: no deaths, no
+        # requeue, no replay
+        rec = router.get_fleet_report()["recovery"]
+        assert rec["drains"] == 1 and rec["deaths"] == 0
+        assert rec["requeued"] == 0
+        ev = rec["events"][-1]
+        assert ev["mode"] == "drain" and ev["slot"] == victim
+        assert not ev["requeued_uids"]
+        # new work places on the survivor only
+        r = router.submit(SYS[0] + [99], uid=99, max_new_tokens=3)
+        assert router._entries[99].slot != victim
+        router.drain()
+        assert r.state == RequestState.FINISHED
+        for uid, h in handles.items():
+            assert h.state == RequestState.FINISHED
+            assert list(h.tokens) == refs[uid], uid
+        # the restart half: respawn re-admits the drained slot
+        assert router._respawn(victim, router._step_idx)
+        assert sorted(router.pooled_replicas) == [0, 1]
+
+    def test_drain_unknown_slot_is_typed(self, params_cfg):
+        router = _router(params_cfg, n=1)
+        with pytest.raises(ValueError, match="not in the pool"):
+            router.drain_replica(5)
+
+    @pytest.mark.slow
+    def test_rolling_restart_under_traffic(self, params_cfg):
+        """The runbook drill: drain -> respawn each replica in turn
+        while requests keep arriving; every stream bitwise, zero
+        deaths, zero requeues — a rolling restart is invisible to
+        callers."""
+        N = 8
+        reqs = {500 + k: SYS[k % 3] + [80 + k] for k in range(N)}
+        refs = _single_frontend_refs(params_cfg, reqs, 4)
+        router = _router(params_cfg, n=2)
+        handles = {}
+        uids = list(reqs)
+        for phase_slot in (0, 1):
+            for _ in range(2):
+                uid = uids.pop(0)
+                handles[uid] = router.submit(reqs[uid], uid=uid,
+                                             max_new_tokens=4)
+                router.step()
+            router.drain_replica(phase_slot)
+            assert router._respawn(phase_slot, router._step_idx)
+        while uids:
+            uid = uids.pop(0)
+            handles[uid] = router.submit(reqs[uid], uid=uid,
+                                         max_new_tokens=4)
+            router.step()
+        router.drain()
+        for uid, h in handles.items():
+            assert h.state == RequestState.FINISHED
+            assert list(h.tokens) == refs[uid], uid
+        rec = router.get_fleet_report()["recovery"]
+        assert rec["drains"] == 2 and rec["deaths"] == 0
+        assert rec["requeued"] == 0
+        assert sorted(router.pooled_replicas) == [0, 1]

@@ -72,3 +72,66 @@ def test_env_spec_arms_on_construction(monkeypatch):
     assert inj.enabled
     with pytest.raises(InjectedIOError):
         inj.fire("checkpoint.load")
+
+
+# -- per-target specs (site@target:...) --------------------------------
+
+def test_target_spec_grammar():
+    s = FaultSpec.parse("transport.send@replica1:drop~0.2")
+    assert (s.site, s.target, s.kind) == \
+        ("transport.send", "replica1", "drop")
+    assert s.count == float("inf")      # rate spec: applies forever
+    assert s.arg == 0.2
+    s = FaultSpec.parse("transport.send@replica0:error@1x2")
+    assert (s.target, s.after, s.count) == ("replica0", 1, 2)
+    assert FaultSpec.parse("transport.send:drop~0.2").target is None
+
+
+def test_target_spec_matches_only_its_detail():
+    with fault_injector.inject("transport.send@replica1:error"):
+        fault_injector.fire("transport.send", detail="replica0")
+        fault_injector.fire("transport.send", detail="replica2")
+        with pytest.raises(InjectedFault):
+            fault_injector.fire("transport.send", detail="replica1")
+        # the audit log names the target and the TARGET's ordinal
+        assert fault_injector.fired == \
+            ["transport.send@replica1:error@0"]
+
+
+def test_target_window_counts_targets_calls_alone():
+    # @after=2 means "replica1's third send", however much other
+    # replicas' traffic interleaves — the global ordinal would need
+    # the drill to reverse-engineer the interleaving.
+    with fault_injector.inject("transport.send@replica1:error@2"):
+        for _ in range(5):
+            fault_injector.fire("transport.send", detail="replica0")
+        fault_injector.fire("transport.send", detail="replica1")  # m=0
+        fault_injector.fire("transport.send", detail="replica1")  # m=1
+        with pytest.raises(InjectedFault):
+            fault_injector.fire("transport.send", detail="replica1")
+        # the global per-site counter still saw every call
+        assert fault_injector.call_count("transport.send") == 8
+
+
+def test_targeted_and_global_specs_coexist():
+    with fault_injector.inject("transport.send@replica1:error@0x1,"
+                               "transport.send:ioerror@2x1"):
+        fault_injector.fire("transport.send", detail="replica0")  # n=0
+        with pytest.raises(InjectedFault):                        # m=0
+            fault_injector.fire("transport.send", detail="replica1")
+        with pytest.raises(InjectedIOError):                      # n=2
+            fault_injector.fire("transport.send", detail="replica0")
+
+
+def test_targeted_consume_returns_target_ordinal():
+    with fault_injector.inject("transport.recv@replica1:drop~0.5"):
+        spec, m = fault_injector.consume("transport.recv",
+                                         detail="replica0",
+                                         with_ordinal=True)
+        assert spec is None
+        for want in range(3):
+            spec, m = fault_injector.consume("transport.recv",
+                                             detail="replica1",
+                                             with_ordinal=True)
+            assert spec is not None and spec.target == "replica1"
+            assert m == want    # the TARGET's own counter

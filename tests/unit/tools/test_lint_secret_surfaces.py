@@ -1,0 +1,122 @@
+"""The secret-surface lint itself must work: it is the only static
+guarantee that bootstrap tokens / HMAC material never reach logs,
+spans, or JSONL sinks (README "Fleet serving" / Bootstrap)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_TOOLS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "..", "..", "..", "tools")
+sys.path.insert(0, os.path.abspath(_TOOLS))
+
+import lint_secret_surfaces as lint  # noqa: E402
+
+
+def _scan(tmp_path, src):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(src))
+    return lint.scan_file(str(p))
+
+
+class TestScan:
+    def test_clean_logging_passes(self, tmp_path):
+        v = _scan(tmp_path, """
+            def f(logger, slot, n_tokens):
+                logger.info(f"worker {slot} emitted {n_tokens} tokens")
+        """)
+        assert v == []
+
+    def test_token_in_log_flagged(self, tmp_path):
+        v = _scan(tmp_path, """
+            def f(logger, token):
+                logger.warning(f"joining with {token}")
+        """)
+        assert len(v) == 1
+        assert "token" in v[0][2]
+
+    def test_secret_attribute_flagged(self, tmp_path):
+        v = _scan(tmp_path, """
+            def f(logger, cfg):
+                logger.info("auth=%s", cfg.shared_secret)
+        """)
+        assert len(v) == 1
+        assert "shared_secret" in v[0][2]
+
+    def test_span_kwarg_flagged(self, tmp_path):
+        v = _scan(tmp_path, """
+            def f(span, nonce):
+                with span("fleet.join", nonce=nonce):
+                    pass
+        """)
+        assert len(v) == 1
+        assert "nonce" in v[0][2]
+
+    def test_sink_write_flagged(self, tmp_path):
+        v = _scan(tmp_path, """
+            def f(sink, mac):
+                sink.write({"mac": mac})
+        """)
+        # keyword-free dict: the Name node `mac` is what trips it
+        assert len(v) == 1
+
+    def test_redact_auth_wrap_passes(self, tmp_path):
+        v = _scan(tmp_path, """
+            def f(logger, redact_auth, cfg):
+                logger.info("bootstrap=%s", redact_auth(cfg.token))
+        """)
+        assert v == []
+
+    def test_annotation_escape(self, tmp_path):
+        v = _scan(tmp_path, """
+            def f(logger, mac):
+                logger.info(f"checksum {mac}")  # secret-ok: frame CRC, not auth
+        """)
+        assert v == []
+
+    def test_exact_name_match_only(self, tmp_path):
+        # tokens / n_tokens / token_budget / machine are NOT secrets —
+        # substring matching would make the whole serving telemetry
+        # surface unlintable.
+        v = _scan(tmp_path, """
+            def f(logger, tokens, n_tokens, token_budget, machine):
+                logger.info(f"{len(tokens)} {n_tokens} "
+                            f"{token_budget} {machine}")
+        """)
+        assert v == []
+
+    def test_non_surface_calls_ignored(self, tmp_path):
+        # Sending the MAC over the handshake socket is the PROTOCOL,
+        # not a leak; only observability surfaces are linted.
+        v = _scan(tmp_path, """
+            def f(sock, send_frame, mac, token):
+                send_frame(sock, {"kind": "JOIN_AUTH", "mac": mac})
+                derive(token)
+        """)
+        assert v == []
+
+    def test_syntax_error_reported(self, tmp_path):
+        v = _scan(tmp_path, "def f(:\n")
+        assert len(v) == 1
+        assert "syntax error" in v[0][2]
+
+
+class TestPackage:
+    def test_package_is_clean(self):
+        tool = os.path.join(_TOOLS, "lint_secret_surfaces.py")
+        r = subprocess.run([sys.executable, tool],
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "clean" in r.stdout
+
+    def test_guarded_names_agree_with_transport(self):
+        # the lint's name list and transport.redact_auth's field list
+        # must not drift apart: a key redacted at runtime should also
+        # be flagged statically.
+        from deepspeed_tpu.inference.v2.serving.fleet.transport import \
+            _AUTH_FIELDS
+        missing = set(_AUTH_FIELDS) - set(lint._SECRET_NAMES)
+        assert not missing, f"lint misses runtime-redacted keys: {missing}"

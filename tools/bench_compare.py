@@ -93,7 +93,7 @@ TRACKED_CONFIGS = ("7_frontend", "8_fleet")
 # TRACKED_CONFIGS, applied one level down.
 TRACKED_DECOMP_KEYS = {"5": ("speculation",),
                        "7_frontend": ("speculation",),
-                       "8_fleet": ("transport",)}
+                       "8_fleet": ("transport", "bootstrap")}
 
 # absolute vs_baseline floors: once a config's LINEAGE has cleared
 # the bar (old side >= floor), no new run may fall back under it —

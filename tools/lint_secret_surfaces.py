@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Repo lint: bootstrap secrets must never reach an observable surface.
+
+The fleet bootstrap handshake deals in a shared-secret token and HMAC
+material (token / mac / nonce). One careless ``logger.warning(f"...
+{token}")`` or ``span("fleet.join", token=...)`` and the secret is in
+every log file, JSONL telemetry stream and operator dashboard — the
+kind of leak that ships silently because nothing functional breaks.
+This lint closes the loop statically:
+
+* at every OBSERVABLE-SURFACE call in ``deepspeed_tpu/`` — logger
+  methods (``logger.debug/info/warning/error/critical/exception``),
+  trace ``span(...)`` calls, and ``.write(...)`` on sink-like
+  receivers — no argument subtree may reference a secret-named
+  identifier (``token``, ``secret``, ``mac``, ``nonce``, ``hmac``,
+  ``password``, ...; exact-name match, so ``tokens_emitted`` /
+  ``max_new_tokens`` stay usable);
+* a subtree wrapped in ``redact_auth(...)`` is exempt — that IS the
+  sanctioned way to put bootstrap state on a surface;
+* a line annotated ``# secret-ok: <why>`` is exempt (for the false
+  positive where an identifier merely shares a name).
+
+Usage: python tools/lint_secret_surfaces.py [root_dir]
+Exit code 0 = clean, 1 = violations found.
+"""
+
+import ast
+import os
+import sys
+
+_SECRET_NAMES = frozenset((
+    "token", "secret", "mac", "nonce", "hmac", "password",
+    "auth_token", "shared_secret", "ssl_keyfile_password"))
+_LOG_METHODS = ("debug", "info", "warning", "error", "critical",
+                "exception")
+_ANNOTATION = "# secret-ok:"
+
+
+def _iter_py(root):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for f in filenames:
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def _recv_name(fn):
+    recv = fn.value
+    if isinstance(recv, ast.Name):
+        return recv.id
+    if isinstance(recv, ast.Attribute):
+        return recv.attr
+    return ""
+
+
+def _is_surface_call(node):
+    """Logger methods on logger-like receivers, ``span(...)``, and
+    ``.write(...)`` on sink-like receivers — the three ways data
+    leaves the process as observability in this codebase."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "span"
+    if not isinstance(fn, ast.Attribute):
+        return False
+    if fn.attr == "span":
+        return True
+    if fn.attr in _LOG_METHODS:
+        return "log" in _recv_name(fn).lower()
+    if fn.attr == "write":
+        return "sink" in _recv_name(fn).lower()
+    return False
+
+
+def _secret_refs(node):
+    """Secret-named identifiers (Name / Attribute / keyword) anywhere
+    in this subtree, NOT descending into ``redact_auth(...)`` calls —
+    redaction is the sanctioned exit."""
+    if isinstance(node, ast.Call):
+        fn = node.func
+        fname = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else "")
+        if fname == "redact_auth":
+            return
+    name = None
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.keyword):
+        name = node.arg
+    if name and name.lower() in _SECRET_NAMES:
+        yield name
+    for child in ast.iter_child_nodes(node):
+        yield from _secret_refs(child)
+
+
+def scan_file(path):
+    """-> violations [(path, lineno, msg)]"""
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    violations = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_surface_call(node):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) \
+            else ""
+        if _ANNOTATION in line:
+            continue
+        refs = set()
+        for arg in list(node.args) + list(node.keywords):
+            refs.update(_secret_refs(arg))
+        if refs:
+            violations.append(
+                (path, node.lineno,
+                 f"secret-named identifier(s) {sorted(refs)} reach an "
+                 f"observable surface; wrap in redact_auth(...) or "
+                 f"annotate with '{_ANNOTATION} <why>'"))
+    return violations
+
+
+def main(root=None):
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = root or os.path.join(os.path.dirname(here), "deepspeed_tpu")
+    violations = []
+    n_files = 0
+    for path in sorted(_iter_py(root)):
+        n_files += 1
+        violations.extend(scan_file(path))
+    for path, lineno, msg in violations:
+        print(f"{path}:{lineno}: {msg}")
+    if violations:
+        print(f"\n{len(violations)} secret-surface violation(s).")
+        return 1
+    print(f"secret-surface lint clean: {n_files} files scanned, "
+          f"{len(_SECRET_NAMES)} guarded names")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))
