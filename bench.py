@@ -610,6 +610,8 @@ def bench_config7():
     ITL p50/p99, prefix-hit-rate, request/gate counters — so request-
     level latency and reuse get pinned, diffable numbers."""
     import dataclasses
+    import shutil
+    import tempfile
 
     import jax
 
@@ -657,8 +659,14 @@ def bench_config7():
     # hits make this row the tiny-scale PROOF OF WIN — the
     # decomposition must publish emitted_per_verify > 1.3. Pinned in
     # the serving CONFIG (both front-ends, so the warmup compiles the
-    # verify executable and the measured window stays recompile-free)
-    spec_cfg = {"speculation": {"enabled": True}}
+    # verify executable and the measured window stays recompile-free).
+    # Tiered spill pinned ON too (ISSUE 16): the cache decomposition
+    # block pins demote/promote/degraded counters next to the hit rate
+    spill_dir = tempfile.mkdtemp(prefix="bench7_cache_")
+    spec_cfg = {"speculation": {"enabled": True},
+                "prefix": {"tiers": {
+                    "enabled": True, "dram_max_mb": 64.0,
+                    "disk_enabled": True, "disk_path": spill_dir}}}
 
     # warmup front-end compiles the fused verify executable (and
     # seeds the prefix cache exactly once per system prompt)
@@ -679,10 +687,15 @@ def bench_config7():
         return state["next"] < N
 
     t0 = time.time()
-    steps = fe.serve(poll=poll)
-    wall = time.time() - t0
-    rep = fe.get_serving_report()
+    try:
+        steps = fe.serve(poll=poll)
+        wall = time.time() - t0
+        rep = fe.get_serving_report()
+    finally:
+        fe.close()
+        shutil.rmtree(spill_dir, ignore_errors=True)
     sustained = rep["tokens_emitted"] / wall if wall > 0 else 0.0
+    pfx = rep["prefix"]
     return {
         "config": "7_frontend",
         "model": "llama7b_shape_4l", "chips": jax.device_count(),
@@ -712,6 +725,20 @@ def bench_config7():
             # decode-step multiplier the gate's lineage pins
             "speculation": _spec_decomposition(rep["speculation"],
                                                enabled=True),
+            # the ISSUE-16 row: tier crossings + integrity outcomes —
+            # degraded must stay 0 on a healthy run, and the eviction
+            # split shows demotion has replaced true eviction
+            "cache": {
+                "hits": pfx["hits"], "misses": pfx["misses"],
+                "hit_rate": round(pfx["hit_rate"], 4),
+                "demoted_blocks": pfx.get("demoted_blocks", 0),
+                "promoted_blocks": pfx.get("promoted_blocks", 0),
+                "degraded": pfx.get("degraded", 0),
+                "demote_failures": pfx.get("demote_failures", 0),
+                "spilled_blocks": pfx.get("spilled_blocks", 0),
+                "evicted_size_bound": pfx.get("evicted_size_bound", 0),
+                "evicted_reclaim": pfx.get("evicted_reclaim", 0),
+            },
             "memory": _memory_decomposition(
                 memory_gauges(include_arrays=False)),
         },

@@ -175,7 +175,7 @@ class Autotuner:
     def write_results(self):
         os.makedirs(self.tuning.results_dir, exist_ok=True)
         path = os.path.join(self.tuning.results_dir, "results.json")
-        with open(path, "w") as f:
+        with open(path, "w") as f:  # atomic-ok: tuner report, rewritten whole each run
             json.dump([dataclasses.asdict(r) for r in self.results], f,
                       indent=2)
         return path
@@ -277,7 +277,7 @@ class LaunchedAutotuner(Autotuner):
         os.makedirs(exp_dir, exist_ok=True)
         cfg_path = os.path.join(exp_dir, "ds_config.json")
         result_path = os.path.join(exp_dir, "result.json")
-        with open(cfg_path, "w") as f:
+        with open(cfg_path, "w") as f:  # atomic-ok: per-experiment scratch config
             json.dump(self._merged(overrides), f, indent=2)
         if os.path.exists(result_path):
             os.remove(result_path)   # never score a stale result

@@ -86,7 +86,8 @@ def ds_to_universal(ckpt_dir: str, output_dir: str, tag: Optional[str] = None,
     meta = {"param_count": count,
             "client_state": {k: v for k, v in (client_state or {}).items()
                              if isinstance(v, (int, float, str, bool))}}
-    with open(os.path.join(output_dir, "universal_meta.json"), "w") as f:
+    with open(os.path.join(output_dir,  # atomic-ok: one-shot export dir, unreadable half-writes re-export
+              "universal_meta.json"), "w") as f:
         json.dump(meta, f)
     logger.info(f"Universal checkpoint: {count} params -> {output_dir}")
     return output_dir
@@ -182,7 +183,7 @@ def zero_to_fp32(ckpt_dir: str, output_file: str, tag: Optional[str] = None,
     sd = {name: np.asarray(leaf, dtype=np.float32)
           for name, leaf in zip(names, leaves)
           if hasattr(leaf, "shape")}
-    with open(output_file, "wb") as f:
+    with open(output_file, "wb") as f:  # atomic-ok: one-shot export, re-run on failure
         pickle.dump(sd, f)
     logger.info(f"fp32 state dict ({len(sd)} tensors) -> {output_file}")
     return sd

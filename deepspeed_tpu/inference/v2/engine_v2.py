@@ -774,6 +774,66 @@ class InferenceEngineV2:
             return 0
         return pc.insert(prompt, seq.blocks[:n_full])
 
+    # -- KV block I/O (the tiered prefix cache's device adapter) --------
+    def _kv_block_fns(self):
+        """Lazily build the jitted one-block gather/scatter pair. The
+        block's ROW OFFSET is a traced scalar, so one compile covers
+        every block index — demotion and promotion at any cache state
+        reuse the same two executables (the zero-recompile contract).
+        The scatter donates the pools and the caller reassigns
+        ``self.pools``, exactly like the threaded forwards above."""
+        fns = getattr(self, "_kv_block_jit", None)
+        if fns is not None:
+            return fns
+        bs = self._config.kv_block_size
+
+        def gather(pools, start):
+            outs = []
+            for (k, v) in pools:
+                kb = jax.lax.dynamic_slice_in_dim(k, start, bs, axis=1)
+                vb = jax.lax.dynamic_slice_in_dim(v, start, bs, axis=1)
+                outs.append(jnp.stack([kb, vb]))
+            return jnp.stack(outs)  # [L, 2, H, bs, D]
+
+        def scatter(pools, data, start):
+            out = []
+            for i, (k, v) in enumerate(pools):
+                out.append((jax.lax.dynamic_update_slice_in_dim(
+                                k, data[i, 0], start, axis=1),
+                            jax.lax.dynamic_update_slice_in_dim(
+                                v, data[i, 1], start, axis=1)))
+            return out
+
+        self._kv_block_jit = (jax.jit(gather),
+                              jax.jit(scatter, donate_argnums=(0,)))
+        return self._kv_block_jit
+
+    def read_kv_block(self, block: int) -> np.ndarray:
+        """One pool block's KV across all layers -> host array
+        ``[n_layers, 2, n_kv_heads, block_size, head_dim]`` (d2h).
+        The demotion path's gather."""
+        gather, _ = self._kv_block_fns()
+        bs = self._config.kv_block_size
+        return np.asarray(gather(self.pools, block * bs))
+
+    def write_kv_block(self, block: int, data) -> None:
+        """Scatter ``data`` (the ``read_kv_block`` layout) into pool
+        block ``block`` (h2d). The promotion path's restore; called
+        from the main thread between dispatches, like every pool
+        mutation."""
+        _, scatter = self._kv_block_fns()
+        bs = self._config.kv_block_size
+        self.pools = scatter(self.pools, jnp.asarray(data), block * bs)
+
+    def close(self) -> None:
+        """Release held OS resources. Today that is the prefix
+        cache's spill tiers (the disk tier holds an open index-journal
+        fd — the NVMe-store lifecycle rule: every store the engine
+        opens, the engine's close reaches). Idempotent."""
+        pc = self.prefix_cache
+        if pc is not None and hasattr(pc, "close"):
+            pc.close()
+
     # -- admission control / backpressure -------------------------------
     @property
     def kv_utilization(self) -> float:

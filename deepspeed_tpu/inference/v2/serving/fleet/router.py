@@ -248,6 +248,15 @@ class FleetRouter:
         self._affinity_map = BoundedCache(
             "fleet_affinity_map",
             max_entries=max(1, int(fc.affinity_map_entries)))
+        # map values are (slot, tier): tier residency rides the same
+        # delta stream as the digests, and the scoring pass discounts
+        # a spilled prefix by these weights — promoting from a
+        # replica's host tier still beats recomputing elsewhere, but a
+        # true HBM hit outranks both
+        self._tier_weights = {
+            "hbm": 1.0,
+            "dram": float(getattr(fc, "dram_affinity_weight", 0.7)),
+            "disk": float(getattr(fc, "disk_affinity_weight", 0.4))}
         self._trie_seqs = {rep.slot: int(rep.hello.get("trie_seq", 0))
                            for rep in self._replicas}
         self._block_size = int(self._replicas[0].kv_block_size
@@ -616,20 +625,28 @@ class FleetRouter:
             snap["outstanding"] = self._outstanding(slot)
         return snap
 
-    def _affinity(self, digests) -> Tuple[Optional[int], int]:
+    def _affinity(self, digests
+                  ) -> Tuple[Optional[int], int, float]:
         """Walk the block-hash map from the root: the replica holding
-        the longest consecutive head of this chain, and how many
-        blocks of it. (A chain split across replicas stops the walk —
-        a trie hit needs every ancestor local.)"""
+        the longest consecutive head of this chain, how many blocks of
+        it, and the tier-weighted sum of those blocks (an HBM-resident
+        block counts 1.0, a spilled one its configured discount). (A
+        chain split across replicas stops the walk — a trie hit needs
+        every ancestor local.)"""
         slot = None
         n = 0
+        weight = 0.0
         for d in digests:
-            s = self._affinity_map.get(d)
-            if s is None or (slot is not None and s != slot):
+            v = self._affinity_map.get(d)
+            if v is None:
+                break
+            s, tier = v
+            if slot is not None and s != slot:
                 break
             slot = s
             n += 1
-        return slot, n
+            weight += self._tier_weights.get(tier, 0.0)
+        return slot, n, weight
 
     def _ranked_slots(self, entry
                       ) -> Tuple[List[int], Optional[int], int]:
@@ -650,11 +667,11 @@ class FleetRouter:
             suspects = [s for s, snap in probed
                         if snap.get("suspect")]
             return self.policy.rank(healthy) + suspects, None, 0
-        aff_slot, aff_n = self._affinity(entry.digests)
+        aff_slot, aff_n, aff_w = self._affinity(entry.digests)
         n_blocks = max(1, len(entry.digests))
         scored = []
         for s, snap in probed:
-            af = aff_n / n_blocks if s == aff_slot else 0.0
+            af = aff_w / n_blocks if s == aff_slot else 0.0
             scored.append((1 if snap.get("suspect") else 0,
                            -self.policy.score(snap, af), s))
         scored.sort()
@@ -888,12 +905,14 @@ class FleetRouter:
             self._resync(slot, step)
             return
         self._trie_seqs[slot] = seq
+        tiers = delta.get("tiers") or {}
         for hx in delta.get("add", ()):
-            self._affinity_map.put(bytes.fromhex(hx), slot)
+            self._affinity_map.put(bytes.fromhex(hx),
+                                   (slot, tiers.get(hx, "hbm")))
         for hx in delta.get("del", ()):
             d = bytes.fromhex(hx)
             cur = self._affinity_map.pop(d)
-            if cur is not None and cur != slot:
+            if cur is not None and cur[0] != slot:
                 # the digest re-homed to another replica since: that
                 # mapping is still live — put it back
                 self._affinity_map.put(d, cur)
@@ -910,13 +929,16 @@ class FleetRouter:
                            f"failed: {e}")
             return
         trie = reply.get("trie") or []
+        trie_tiers = reply.get("trie_tiers") or {}
         with span("fleet.resync", slot=slot, blocks=len(trie)):
-            stale = [d for d, s in list(self._affinity_map.items())
-                     if s == slot]
+            stale = [d for d, v in list(self._affinity_map.items())
+                     if v[0] == slot]
             for d in stale:
                 self._affinity_map.pop(d)
             for hx in trie:
-                self._affinity_map.put(bytes.fromhex(hx), slot)
+                self._affinity_map.put(
+                    bytes.fromhex(hx),
+                    (slot, trie_tiers.get(hx, "hbm")))
             self._trie_seqs[slot] = int(reply.get("trie_seq", 0))
             snap = reply.get("snapshot")
             if snap:
@@ -1020,8 +1042,8 @@ class FleetRouter:
         # its trie died with it: stale affinity must not pull traffic
         # to an empty cache (stats-neutral sweep — a get() per key
         # would promote every entry to MRU and fake 4k hits)
-        stale = [d for d, s in list(self._affinity_map.items())
-                 if s == slot]
+        stale = [d for d, v in list(self._affinity_map.items())
+                 if v[0] == slot]
         for d in stale:
             self._affinity_map.pop(d)
         self._trie_seqs[slot] = int(rep.hello.get("trie_seq", 0))

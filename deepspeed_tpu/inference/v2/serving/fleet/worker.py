@@ -242,26 +242,52 @@ class WorkerCore:
 
     def _drain_delta(self) -> Optional[dict]:
         """Fold the journal into one net TRIE_DELTA (an add+del of the
-        same digest within a step cancels). Sequence numbers order
-        deltas against SNAPSHOT resyncs; no churn -> no delta, seq
-        unchanged."""
+        same digest within a step cancels). Journal records are
+        2-tuples (``("add"/"del", digest)``) from the flat trie, plus
+        3-tuples (``("tier", digest, tiername)``) from a tiered cache
+        — a tier move nets to a residency update, folded into the
+        delta's ``tiers`` map so the router's affinity scoring can
+        discount spilled prefixes without a second stream. Sequence
+        numbers order deltas against SNAPSHOT resyncs; no churn -> no
+        delta, seq unchanged."""
         if not self._journal:
             return None
         net = {}
-        for op, d in self._journal:
-            net[d] = op
+        for rec in self._journal:
+            if rec[0] == "tier":
+                # residency move; an hbm move is just "add" (the
+                # router's default tier), others keep the tier name
+                _, d, tier = rec
+                net[d] = ("add", "hbm") if tier == "hbm" \
+                    else ("add", tier)
+            else:
+                op, d = rec
+                net[d] = (op, "hbm")
         self._journal.clear()
         self._trie_seq += 1
-        return {"seq": self._trie_seq,
-                "add": [d.hex() for d, op in net.items()
-                        if op == "add"],
-                "del": [d.hex() for d, op in net.items()
-                        if op == "del"]}
+        tiers = {d.hex(): tier for d, (op, tier) in net.items()
+                 if op == "add" and tier != "hbm"}
+        out = {"seq": self._trie_seq,
+               "add": [d.hex() for d, (op, _) in net.items()
+                       if op == "add"],
+               "del": [d.hex() for d, (op, _) in net.items()
+                       if op == "del"]}
+        if tiers:
+            out["tiers"] = tiers
+        return out
 
     def _full_snapshot(self, kind: str) -> dict:
         self._drain_delta()     # fold pending churn into the seq
         pc = self.frontend.engine.prefix_cache
         trie = [d.hex() for d in pc._entries] if pc is not None else []
+        # a tiered cache's spilled digests are still servable (promote
+        # beats recompute): list them too, with their residency so the
+        # router can discount them
+        trie_tiers = {}
+        if pc is not None and hasattr(pc, "_spilled"):
+            for d, s in pc._spilled.items():
+                trie.append(d.hex())
+                trie_tiers[d.hex()] = s.tier
         # per-uid survivor inventory: which requests this worker still
         # holds token tails / live state for. A RECOVERED router reads
         # this off the resync SNAPSHOT to re-attach surviving uids
@@ -274,15 +300,18 @@ class WorkerCore:
                 "buffered": len(buf),
                 "state": rr.state.name if rr is not None else None,
                 "done": bool(rr.done) if rr is not None else True}
-        return {"kind": kind, "snapshot": self.snapshot(),
-                "trie": trie, "trie_seq": self._trie_seq,
-                "uids": uids,
-                # the PR-9 steady-window invariant, checkable over the
-                # wire (the socket acceptance cannot read the worker's
-                # frontend report directly)
-                "steady_blocking_syncs": int(
-                    self.frontend.metrics.report()
-                    ["steady_blocking_syncs"])}
+        out = {"kind": kind, "snapshot": self.snapshot(),
+               "trie": trie, "trie_seq": self._trie_seq,
+               "uids": uids,
+               # the PR-9 steady-window invariant, checkable over the
+               # wire (the socket acceptance cannot read the worker's
+               # frontend report directly)
+               "steady_blocking_syncs": int(
+                   self.frontend.metrics.report()
+                   ["steady_blocking_syncs"])}
+        if trie_tiers:
+            out["trie_tiers"] = trie_tiers
+        return out
 
     def snapshot(self) -> dict:
         """The polling-cheap health/load view (Replica caches the

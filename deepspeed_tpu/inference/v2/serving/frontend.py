@@ -44,6 +44,7 @@ from ....resilience.errors import (ResilienceError, ServingOverloadError,
                                    TerminalRequestError,
                                    UnknownRequestError)
 from ....resilience.fault_injector import fault_injector
+from ....telemetry.anomaly import TelemetryAlert
 from ....telemetry.trace import span
 from ....utils.logging import logger
 from ...sampling import SamplingParams
@@ -118,7 +119,25 @@ class ServingFrontend:
         if cfg.admission_kv_util_threshold is not None:
             engine._config.admission_kv_util_threshold = float(
                 cfg.admission_kv_util_threshold)
-        if cfg.prefix.enabled and engine.prefix_cache is None:
+        if cfg.prefix.enabled and getattr(cfg.prefix, "tiers", None) \
+                is not None and cfg.prefix.tiers.enabled and \
+                not hasattr(engine.prefix_cache, "spilled_blocks"):
+            # tiered spill REPLACES a flat trie the engine armed (the
+            # engine-config path only knows the flat cache); an
+            # already-tiered cache is KEPT — a warmup front-end's
+            # seeded tiers must survive into the serving front-end
+            # exactly like the flat cache does
+            from .tiered import TieredPrefixCache
+            engine.prefix_cache = TieredPrefixCache(
+                engine._config.kv_block_size,
+                engine._state_manager.kv.allocator,
+                max_blocks=cfg.prefix.max_blocks,
+                kv_io=engine,
+                dram_store=self._build_dram_store(cfg.prefix.tiers),
+                disk_store=self._build_disk_store(cfg.prefix.tiers),
+                codec=cfg.prefix.tiers.codec,
+                alert_sink=self._note_alert)
+        elif cfg.prefix.enabled and engine.prefix_cache is None:
             from .prefix import PrefixCache
             engine.prefix_cache = PrefixCache(
                 engine._config.kv_block_size,
@@ -168,6 +187,40 @@ class ServingFrontend:
                 ewma_alpha=sc.ewma_alpha,
                 warmup_drafts=sc.warmup_drafts), metrics=self.metrics)
 
+    # -- tiered prefix-cache construction -------------------------------
+    @staticmethod
+    def _build_dram_store(tc):
+        from ....runtime.store import HostBlockStore
+        return HostBlockStore(
+            int(tc.dram_max_mb * 1024 * 1024),
+            retries=tc.io_retries,
+            backoff_seconds=tc.io_backoff_seconds,
+            deadline_seconds=tc.io_deadline_seconds)
+
+    @staticmethod
+    def _build_disk_store(tc):
+        if not tc.disk_enabled:
+            return None
+        if not tc.disk_path:
+            raise ValueError(
+                "serving.prefix.tiers.disk_enabled requires "
+                "serving.prefix.tiers.disk_path")
+        from ....runtime.store import DiskBlockStore
+        return DiskBlockStore(
+            tc.disk_path,
+            max_bytes=int(tc.disk_max_mb * 1024 * 1024),
+            fsync_every=tc.journal_fsync_every,
+            retries=tc.io_retries,
+            backoff_seconds=tc.io_backoff_seconds,
+            deadline_seconds=tc.io_deadline_seconds)
+
+    def close(self) -> None:
+        """Release the engine's held OS resources — today the spill
+        tiers' stores (the disk tier holds an open index-journal fd).
+        Idempotent; a deployment embedding the front-end calls this on
+        shutdown exactly like the NVMe offload store's owner."""
+        self.engine.close()
+
     # -- telemetry ------------------------------------------------------
     def _note_alert(self, alert) -> None:
         self.alerts.append(alert)
@@ -176,8 +229,14 @@ class ServingFrontend:
 
     def attach_telemetry(self, hub, namespace: str = "serving"):
         """Register the serving report on a ``TelemetryHub`` and route
-        admission-gate ``TelemetryAlert``s into its alert log."""
+        admission-gate ``TelemetryAlert``s into its alert log. A
+        tiered prefix cache additionally registers its tier counters
+        under the ``cache`` namespace (hit/miss/demote/promote/
+        degraded — the bench decomposition's cache block)."""
         self.engine.attach_telemetry(hub, namespace=namespace)
+        pc = self.engine.prefix_cache
+        if pc is not None and hasattr(pc, "spilled_blocks"):
+            hub.register("cache", pc.stats)
         self._hub = hub
         return hub
 
@@ -592,8 +651,38 @@ class ServingFrontend:
             blocking_sync=(inflight is not None and step is None),
             queue_depth=len(self._queue) + len(self._pending),
             kv_free=engine.free_blocks, spec_rows=n_spec_rows)
+        self._check_prefix_thrash()
         self._inflight = step
         return bool(joined or uids or inflight is not None)
+
+    # -- prefix-thrash detector ----------------------------------------
+    # every _THRASH_WINDOW steps compare the window's evictions against
+    # its insertions: a cache that evicts faster than it inserts is
+    # churning entries it never gets to reuse — the operator should
+    # raise max_blocks or enable the spill tiers (demotions don't
+    # count: a demoted block is still servable)
+    _THRASH_WINDOW = 64
+
+    def _check_prefix_thrash(self) -> None:
+        pc = self.engine.prefix_cache
+        if pc is None or self._step_idx % self._THRASH_WINDOW:
+            return
+        last = getattr(self, "_thrash_marks", (0, 0))
+        marks = (pc.evicted_blocks, pc.inserted_blocks)
+        self._thrash_marks = marks
+        d_evict = marks[0] - last[0]
+        d_insert = marks[1] - last[1]
+        if d_evict > 0 and d_evict > d_insert:
+            self._note_alert(TelemetryAlert(
+                kind="prefix_thrash",
+                metric="prefix/evicted_blocks",
+                value=float(d_evict), threshold=float(d_insert),
+                step=self._step_idx,
+                message=f"prefix cache thrashing: {d_evict} evictions "
+                        f"vs {d_insert} insertions over the last "
+                        f"{self._THRASH_WINDOW} steps — raise "
+                        f"serving.prefix.max_blocks or enable "
+                        f"serving.prefix.tiers"))
 
     def _deliver(self, collected: StepRecord, toks_host,
                  next_step: Optional[StepRecord]) -> int:

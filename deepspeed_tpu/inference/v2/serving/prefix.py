@@ -115,6 +115,14 @@ class PrefixCache:
         self.tokens_reused = 0
         self.inserted_blocks = 0
         self.evicted_blocks = 0
+        # eviction split by CAUSE: the size bound (``max_blocks``
+        # exceeded at insert) churns by design, reclaim-under-pressure
+        # means the pool itself ran dry — an operator tuning
+        # ``max_blocks`` needs the two separated (and with tiers,
+        # demotion vs true eviction separated again — see
+        # TieredPrefixCache.stats()).
+        self.evicted_size_bound = 0
+        self.evicted_reclaim = 0
 
     # -- hashing -------------------------------------------------------
     def _digest(self, parent: bytes, block_tokens: np.ndarray) -> bytes:
@@ -138,6 +146,8 @@ class PrefixCache:
             "cached_blocks": len(self._entries),
             "inserted_blocks": self.inserted_blocks,
             "evicted_blocks": self.evicted_blocks,
+            "evicted_size_bound": self.evicted_size_bound,
+            "evicted_reclaim": self.evicted_reclaim,
         }
 
     # -- the reuse path ------------------------------------------------
@@ -248,6 +258,10 @@ class PrefixCache:
             freed += self.allocator.free_blocks - before
             evicted += 1
             self.evicted_blocks += 1
+            if need_free:
+                self.evicted_reclaim += 1
+            else:
+                self.evicted_size_bound += 1
             if self.journal is not None:
                 self.journal.append(("del", d))
         return freed

@@ -1,0 +1,411 @@
+"""Tiered prefix cache: HBM trie with DRAM/disk spill tiers.
+
+``PrefixCache`` (prefix.py) dies at the HBM block budget: once the
+trie hits ``max_blocks`` (or the scheduler reclaims under pressure), a
+cold prefix is gone and the next request that shares it pays full
+prefill. This subclass keeps the trie's contract — same digests, same
+``match``/``insert`` surface, same fixed shapes, nothing recompiles —
+but **demotes** cold blocks down a tier instead of evicting them:
+
+    HBM trie (live pool blocks)
+      └─ demote: d2h gather → optional codec → HostBlockStore (DRAM)
+           └─ rebalance: LRU → DiskBlockStore (atomic files + journal)
+
+and **promotes** them back on the adoption path: a chain walk that
+falls off the HBM trie into ``_spilled`` reads the payload back
+(verified against its blake2b), scatters it into a freshly allocated
+pool block (h2d), and hands the block to the adopter exactly as if it
+had never left. A digest lives in exactly ONE tier at a time.
+
+The robustness headline — why this is safe to turn on:
+
+* every tier crossing is a registered fault site (``cache.demote``,
+  ``cache.promote``, ``store.write``, ``store.read``) firing BEFORE
+  the corresponding state change, inside the store's retry envelope;
+* a failed demotion leaves the entry intact in its old tier — no torn
+  state, the block is simply still hot;
+* a failed promotion (corrupt payload, missing file, persistently
+  unreadable tier) **degrades to recompute**: the chain walk stops,
+  the adopter prefills that span normally (bitwise-identical output —
+  recompute produces the same KV the spill held), the digest's
+  subtree is purged and the digest quarantined, a ``cache_degraded``
+  alert is counted. Never a wrong token, never a crashed step;
+* the disk tier's index journal makes a restarted frontend's
+  ``recover()`` find every surviving entry (runtime/store.py).
+
+With codec ``"none"`` (the default) spilled payloads are raw KV bytes
+and the greedy streams are bitwise identical with tiers off / DRAM /
+DRAM+disk — asserted under a seeded chaos matrix in the tests. The
+int8/int4 codecs trade that for footprint and are off by default.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ....resilience.errors import InjectedFault, StoreCorruptionError
+from ....resilience.fault_injector import fault_injector
+from ....runtime.store import decode_kv, encode_kv
+from ....telemetry.anomaly import TelemetryAlert
+from ....telemetry.trace import span
+from ..ragged_manager import SchedulingError
+from .prefix import _ROOT, PrefixCache, _Entry
+
+# failures a tier crossing absorbs (leaves consistent state) rather
+# than propagates: transient I/O past its retry budget, verified
+# corruption, injected drills. Anything else is a programming error
+# and must surface.
+_SPILL_FAILURES = (OSError, StoreCorruptionError, InjectedFault,
+                   KeyError)
+
+# a digest that degraded to recompute is quarantined (never re-adopted
+# from a spill tier) until a fresh prefill re-inserts it with live
+# data; bounded so a pathological workload can't grow it forever
+_QUARANTINE_LIMIT = 1024
+
+
+class _SpilledEntry:
+    __slots__ = ("tier", "parent", "tick")
+
+    def __init__(self, tier: str, parent: bytes, tick: int):
+        self.tier = tier
+        self.parent = parent
+        self.tick = tick
+
+
+class TieredPrefixCache(PrefixCache):
+    """``PrefixCache`` + spill tiers.
+
+    ``kv_io`` is the engine adapter: ``read_kv_block(block) -> np
+    array`` (d2h gather of one pool block across layers) and
+    ``write_kv_block(block, arr)`` (h2d scatter) — engine_v2 provides
+    jitted implementations with the block index traced, so demotion
+    and promotion never recompile anything.
+    """
+
+    def __init__(self, block_size: int, allocator, max_blocks: int = 0,
+                 *, kv_io, dram_store, disk_store=None,
+                 codec: str = "none", alert_sink=None):
+        super().__init__(block_size, allocator, max_blocks=max_blocks)
+        self.kv_io = kv_io
+        self.dram = dram_store
+        self.disk = disk_store
+        self.codec = codec
+        self.alert_sink = alert_sink
+        self._spilled: Dict[bytes, _SpilledEntry] = {}
+        self._quarantine: Dict[bytes, bool] = {}  # insertion-ordered
+        # tier-crossing stats (rides get_serving_report()["prefix"])
+        self.demoted_blocks = 0
+        self.promoted_blocks = 0
+        self.demote_failures = 0
+        self.degraded = 0
+        self.spill_evicted_blocks = 0
+
+    # -- introspection --------------------------------------------------
+    @property
+    def spilled_blocks(self) -> int:
+        return len(self._spilled)
+
+    def resident_tier(self, d: bytes) -> Optional[str]:
+        """'hbm' / 'dram' / 'disk' / None — the one tier holding d."""
+        if d in self._entries:
+            return "hbm"
+        s = self._spilled.get(d)
+        return s.tier if s is not None else None
+
+    def stats(self) -> dict:
+        out = super().stats()
+        dram_blocks = len(self.dram) if self.dram is not None else 0
+        out.update({
+            "demoted_blocks": self.demoted_blocks,
+            "promoted_blocks": self.promoted_blocks,
+            "demote_failures": self.demote_failures,
+            "degraded": self.degraded,
+            "spill_evicted_blocks": self.spill_evicted_blocks,
+            "spilled_blocks": len(self._spilled),
+            "quarantined": len(self._quarantine),
+            "dram_blocks": dram_blocks,
+            "dram_bytes": getattr(self.dram, "used_bytes", 0),
+            "disk_blocks": len(self.disk) if self.disk is not None
+            else 0,
+            "disk_bytes": getattr(self.disk, "used_bytes", 0),
+        })
+        return out
+
+    # -- the adoption path: match + promote -----------------------------
+    def match(self, tokens: np.ndarray) -> Tuple[List[int], int]:
+        """Base ``match`` extended one rung down: a chain node absent
+        from the HBM trie but resident in a spill tier is promoted
+        back (store read + verify, decode, pool scatter) and joins the
+        adopted span. Promotion failure ends the walk — the tail of
+        the prompt recomputes, which is the degrade contract."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        n_max = max(0, (len(tokens) - 1) // bs)
+        blocks: List[int] = []
+        parent = _ROOT
+        self._tick += 1
+        for i in range(n_max):
+            d = self._digest(parent, tokens[i * bs:(i + 1) * bs])
+            e = self._entries.get(d)
+            if e is not None:
+                e.tick = self._tick
+                blocks.append(e.block)
+                parent = d
+                continue
+            s = self._spilled.get(d)
+            if s is None or d in self._quarantine:
+                break
+            blk = self._promote(d, s)
+            if blk is None:
+                break
+            blocks.append(blk)
+            parent = d
+        n_tokens = len(blocks) * bs
+        if n_tokens:
+            self.hits += 1
+            self.tokens_reused += n_tokens
+        else:
+            self.misses += 1
+        return blocks, n_tokens
+
+    def _promote(self, d: bytes, s: _SpilledEntry) -> Optional[int]:
+        """One spilled block back into the pool. Returns the pool
+        block id, or None on either of two very different stops:
+
+        * capacity (no free block even after demoting a colder one):
+          the spilled entry SURVIVES — next adopter may have room;
+        * degrade (unreadable/corrupt payload or injected fault): the
+          digest is quarantined and its spilled subtree purged.
+        """
+        store = self.dram if s.tier == "dram" else self.disk
+        try:
+            with span("cache.promote", tier=s.tier):
+                fault_injector.fire("cache.promote", detail=s.tier)
+                if store is None:
+                    raise StoreCorruptionError(
+                        f"spilled entry {d.hex()} names tier "
+                        f"{s.tier!r} but that store is not mounted")
+                payload, meta = store.get(d)
+                arr = decode_kv(payload, meta)
+        except _SPILL_FAILURES as exc:
+            self._degrade(d, exc)
+            return None
+        # a pool block for the promoted payload; under pressure demote
+        # a colder block to make room (LRU displacement across tiers)
+        try:
+            block = self.allocator.allocate(1)[0]
+        except SchedulingError:
+            self._evict(need_free=1)
+            try:
+                block = self.allocator.allocate(1)[0]
+            except SchedulingError:
+                return None  # capacity stop — entry stays spilled
+        self.kv_io.write_kv_block(block, arr)
+        # state change only after the scatter landed: the digest moves
+        # to the HBM trie, the spilled payload is retired (one tier)
+        self._entries[d] = _Entry(block, s.parent, self._tick)
+        self._spilled.pop(d, None)
+        try:
+            store.delete(d)
+        except _SPILL_FAILURES:
+            pass  # orphan payload; recover()/LRU will retire it
+        self.promoted_blocks += 1
+        if self.journal is not None:
+            self.journal.append(("tier", d, "hbm"))
+        return block
+
+    def _degrade(self, d: bytes, exc: Exception) -> None:
+        """The never-a-wrong-token valve: quarantine the digest, purge
+        its spilled subtree (children of an unreadable parent are
+        unreachable by chain construction), count + alert. The adopter
+        recomputes the span through normal prefill — bitwise-identical
+        output, just paid for."""
+        self.degraded += 1
+        self._quarantine[d] = True
+        while len(self._quarantine) > _QUARANTINE_LIMIT:
+            self._quarantine.pop(next(iter(self._quarantine)))
+        # retire the digest's own spilled entry (its payload is
+        # unreadable dead weight) and, through it, the whole subtree
+        self._drop_spilled(d)
+        if self.alert_sink is not None:
+            self.alert_sink(TelemetryAlert(
+                kind="cache_degraded",
+                metric="prefix/degraded",
+                value=float(self.degraded), threshold=0.0,
+                step=self._tick,
+                message=f"spilled block {d.hex()[:12]} degraded to "
+                        f"recompute: {type(exc).__name__}: "
+                        f"{str(exc)[:120]}"))
+
+    # -- eviction becomes demotion --------------------------------------
+    def _evict(self, count: int = 0, need_free: int = 0) -> int:
+        """Leaf-first LRU as in the base class, but a victim is
+        DEMOTED to the DRAM tier instead of evicted. A failed demotion
+        leaves the entry intact in HBM (counted, skipped for this
+        pass) — the drill contract for ``store.write`` faults."""
+        if self.dram is None:
+            return super()._evict(count=count, need_free=need_free)
+        freed = 0
+        demoted = 0
+        failed = set()
+        while self._entries:
+            if count and demoted >= count:
+                break
+            if need_free and freed >= need_free:
+                break
+            leaves = [d for d in self._leaves() if d not in failed]
+            if need_free:
+                leaves = [d for d in leaves
+                          if self.allocator.refcount(
+                              self._entries[d].block) == 1]
+            if not leaves:
+                break
+            d = leaves[0]
+            ok, f = self._demote(d)
+            if ok:
+                demoted += 1
+                freed += f
+            else:
+                failed.add(d)
+                self.demote_failures += 1
+        return freed
+
+    def _demote(self, d: bytes) -> Tuple[bool, int]:
+        """One HBM entry down to DRAM. All fallible work happens
+        BEFORE any trie/pool mutation: gather, encode, store write —
+        an injected kill or exhausted retry budget anywhere in that
+        window returns (False, 0) with the entry untouched."""
+        e = self._entries[d]
+        try:
+            with span("cache.demote", tier="dram", block=e.block):
+                fault_injector.fire("cache.demote", detail="dram")
+                arr = self.kv_io.read_kv_block(e.block)
+                payload, meta = encode_kv(arr, self.codec)
+                self.dram.put(d, payload, meta)
+        except _SPILL_FAILURES:
+            return False, 0
+        self._entries.pop(d)
+        before = self.allocator.free_blocks
+        self.allocator.free([e.block])
+        freed = self.allocator.free_blocks - before
+        self._spilled[d] = _SpilledEntry("dram", e.parent, e.tick)
+        self.demoted_blocks += 1
+        if self.journal is not None:
+            self.journal.append(("tier", d, "dram"))
+        self._rebalance()
+        return True, freed
+
+    def _rebalance(self) -> None:
+        """Keep the spill tiers inside their byte budgets: DRAM
+        overflow rolls down to disk (or true-evicts when no disk tier
+        is mounted / the write fails), disk overflow true-evicts."""
+        while self.dram is not None and self.dram.over_budget:
+            popped = self.dram.pop_lru()
+            if popped is None:
+                break
+            key, payload, meta = popped
+            s = self._spilled.get(key)
+            if s is None:
+                continue
+            if self.disk is not None:
+                try:
+                    self.disk.put(key, payload, meta)
+                    s.tier = "disk"
+                    if self.journal is not None:
+                        self.journal.append(("tier", key, "disk"))
+                    continue
+                except _SPILL_FAILURES:
+                    pass
+            self._drop_spilled(key, in_store=False)
+        while self.disk is not None and self.disk.over_budget:
+            popped = self.disk.pop_lru()
+            if popped is None:
+                break
+            self._drop_spilled(popped[0], in_store=False)
+
+    # -- true eviction of spilled state ---------------------------------
+    def _drop_spilled(self, d: bytes, in_store: bool = True) -> None:
+        if self._spilled.pop(d, None) is None:
+            return
+        self.spill_evicted_blocks += 1
+        if in_store:
+            for store in (self.dram, self.disk):
+                if store is not None and d in store:
+                    try:
+                        store.delete(d)
+                    except _SPILL_FAILURES:
+                        pass
+        if self.journal is not None:
+            self.journal.append(("del", d))
+        self._purge_spilled_subtree(d)
+
+    def _purge_spilled_subtree(self, d: bytes) -> None:
+        """Spilled descendants of a dropped/degraded digest are
+        unreachable (the chain walk can never pass their parent) —
+        retire them so the stores don't hold dead payloads. HBM
+        descendants stay: they hold live pool references and the
+        leaf-first LRU will demote/evict them in due course."""
+        frontier = [d]
+        while frontier:
+            p = frontier.pop()
+            kids = [k for k, s in self._spilled.items()
+                    if s.parent == p]
+            for k in kids:
+                self._spilled.pop(k, None)
+                self.spill_evicted_blocks += 1
+                for store in (self.dram, self.disk):
+                    if store is not None and k in store:
+                        try:
+                            store.delete(k)
+                        except _SPILL_FAILURES:
+                            pass
+                if self.journal is not None:
+                    self.journal.append(("del", k))
+            frontier.extend(kids)
+
+    # -- insert: a fresh live block supersedes a spilled copy ----------
+    def insert(self, tokens: np.ndarray, blocks: List[int]) -> int:
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        n_full = min(len(tokens) // bs, len(blocks))
+        parent = _ROOT
+        for i in range(n_full):
+            d = self._digest(parent, tokens[i * bs:(i + 1) * bs])
+            if d not in self._entries:
+                # the sequence just PREFILLED this block: its live KV
+                # is canonical — retire any spilled copy (and lift any
+                # quarantine: fresh data, nothing suspect about it)
+                self._quarantine.pop(d, None)
+                if d in self._spilled:
+                    self._spilled.pop(d)
+                    for store in (self.dram, self.disk):
+                        if store is not None and d in store:
+                            try:
+                                store.delete(d)
+                            except _SPILL_FAILURES:
+                                pass
+                    # no journal "del": the base insert's "add" below
+                    # moves the digest back to hbm in the same delta
+            parent = d
+        return super().insert(tokens, blocks)
+
+    # -- lifecycle ------------------------------------------------------
+    def clear(self) -> int:
+        """Drop everything — HBM entries (true-evicted through the
+        base path, freeing pool refs) AND all spilled state."""
+        freed = super()._evict(count=len(self._entries)) \
+            if self._entries else 0
+        for d in list(self._spilled):
+            self._drop_spilled(d)
+        self._quarantine.clear()
+        return freed
+
+    def close(self) -> None:
+        """Release the spill tiers' held resources (the disk tier owns
+        an open journal fd). Idempotent; the engine's ``close()``
+        reaches this — the NVMe-store lifecycle rule."""
+        if self.dram is not None:
+            self.dram.close()
+        if self.disk is not None:
+            self.disk.close()

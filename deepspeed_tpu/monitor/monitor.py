@@ -93,7 +93,7 @@ class csvMonitor(Monitor):
                                  name.replace("/", "_") + ".csv")
             new = fname not in self.filenames
             self.filenames[fname] = True
-            with open(fname, "a", newline="") as f:
+            with open(fname, "a", newline="") as f:  # atomic-ok: append-only CSV, torn tail tolerated
                 w = csv.writer(f)
                 if new and os.path.getsize(fname) == 0:
                     w.writerow(["step", name])

@@ -117,7 +117,7 @@ class EventFileWriter:
                  f"{os.uname().nodename}.{os.getpid()}"
                  f"{filename_suffix}")
         self._path = os.path.join(log_dir, fname)
-        self._f = open(self._path, "ab")
+        self._f = open(self._path, "ab")  # atomic-ok: append-only event log
         self._write_record(_version_event(time.time()))
         self.flush()
 

@@ -378,7 +378,7 @@ class FlopsProfiler:
                 top=top_modules or 10, depth=depth))
         text = "\n".join(lines)
         if output_file:
-            with open(output_file, "w") as f:
+            with open(output_file, "w") as f:  # atomic-ok: human-readable report, re-created
                 f.write(text + "\n")
         else:
             logger.info("\n" + text)

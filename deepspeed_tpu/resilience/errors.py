@@ -209,6 +209,15 @@ class JournalCorruptionError(ResilienceError):
     recovering router on a journal its dead predecessor tore."""
 
 
+class StoreCorruptionError(ResilienceError):
+    """A block-store payload failed integrity verification (checksum /
+    size mismatch, or the payload file a journal record promised is
+    missing — the crash-between-journal-append-and-data-write case).
+    Deliberately NOT an OSError: retrying cannot fix corruption, so
+    ``retry_io`` must propagate it immediately and the tiered prefix
+    cache degrades that block to recompute instead of spinning."""
+
+
 class InjectedFault(ResilienceError):
     """A deliberately injected failure (FaultInjector). Base class so
     tests can distinguish injected faults from organic ones."""

@@ -101,6 +101,25 @@ FAULT_SITES = {
     "reshard.h2d":
         "shrink-and-reshard bulk upload: one fire per fused transfer "
         "bucket (elasticity/reshard.py via runtime/transfer/)",
+    # ---- tiered prefix-cache spill (inference/v2/serving/tiered.py,
+    # runtime/store.py) ----
+    "cache.demote":
+        "tiered prefix cache: one fire per block demotion attempt, "
+        "BEFORE any trie/pool state changes (a kill here leaves the "
+        "entry intact in its old tier)",
+    "cache.promote":
+        "tiered prefix cache: one fire per spilled-block promotion "
+        "attempt on the adoption path, BEFORE the pool scatter — a "
+        "fault degrades that span to recompute, never a wrong token",
+    "store.write":
+        "block store payload write (runtime/store.py put; detail = "
+        "tier name 'dram'/'disk'); fires inside the retry_io envelope "
+        "so ioerror specs exercise the backoff path and kill aborts "
+        "the demotion with no torn state",
+    "store.read":
+        "block store payload read + checksum verify (runtime/store.py "
+        "get; detail = tier name); a persistent fault here is the "
+        "degrade-to-recompute drill",
 }
 
 KNOWN_SITES = tuple(FAULT_SITES)

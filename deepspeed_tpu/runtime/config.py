@@ -284,6 +284,39 @@ class TelemetryConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class ServingPrefixTiersConfig(DeepSpeedConfigModel):
+    """Tiered prefix-cache spill (inference/v2/serving/tiered.py +
+    runtime/store.py), config section ``serving.prefix.tiers``: cold
+    trie blocks demote HBM -> host DRAM -> disk instead of evicting,
+    and promote back on adoption. Integrity-verified payloads,
+    registered fault sites on every tier crossing, degrade-to-
+    recompute on any unreadable block. See README "Tiered prefix
+    cache" (including when NOT to enable the disk tier)."""
+    enabled: bool = False
+    # DRAM tier byte budget (MB); overflow rolls down to disk when
+    # enabled, else true-evicts LRU-first
+    dram_max_mb: float = 256.0
+    # disk tier: atomic payload files + crash-safe index journal under
+    # ``disk_path`` (required when enabled); 0 MB = unbounded
+    disk_enabled: bool = False
+    disk_path: str = None
+    disk_max_mb: float = 0.0
+    # spill payload codec: "none" (raw bytes — bitwise-identical
+    # streams, the default), "int8"/"int4" (per-plane absmax
+    # quantization: smaller spills, APPROXIMATE readopted KV)
+    codec: str = "none"
+    # per-crossing I/O envelope (runtime/store.py): bounded retries
+    # with backoff for transient faults, a wall-clock deadline after
+    # which the tier is treated as unreadable (degrade-to-recompute)
+    io_retries: int = 3
+    io_backoff_seconds: float = 0.02
+    io_deadline_seconds: float = 5.0
+    # disk index journal fsync cadence (records per fsync; 1 = every
+    # append — safest, slowest)
+    journal_fsync_every: int = 8
+
+
+@dataclasses.dataclass
 class ServingPrefixConfig(DeepSpeedConfigModel):
     """Prefix-aware KV block reuse (inference/v2/serving/prefix.py):
     shared system-prompt heads map to shared immutable KV blocks."""
@@ -292,6 +325,8 @@ class ServingPrefixConfig(DeepSpeedConfigModel):
     # (leaf-first LRU eviction past the bound, plus the scheduler's
     # reclaim-under-pressure valve either way)
     max_blocks: int = 0
+    # spill tiers: past the bound, demote instead of evict
+    tiers: ServingPrefixTiersConfig = submodel(ServingPrefixTiersConfig)
 
 
 @dataclasses.dataclass
@@ -423,6 +458,14 @@ class ServingFleetConfig(DeepSpeedConfigModel):
     affinity_weight: float = 4.0
     queue_weight: float = 1.0
     kv_weight: float = 1.0
+    # tier residency discount on the affinity term: a prefix resident
+    # in a replica's HBM trie counts full weight (1.0), one spilled to
+    # its host DRAM / disk tier counts these fractions — still far
+    # cheaper to promote locally than to recompute elsewhere, but a
+    # true HBM hit outranks it (tier residency rides the same
+    # TRIE_DELTA stream as the digests themselves)
+    dram_affinity_weight: float = 0.7
+    disk_affinity_weight: float = 0.4
     # router-side block-hash -> replica map bound (LRU entries; the
     # same chained blake2b keys as each replica's prefix trie)
     affinity_map_entries: int = 4096

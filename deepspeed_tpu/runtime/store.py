@@ -1,0 +1,500 @@
+"""Tiered block store: integrity-verified payload tiers with a
+crash-safe disk index.
+
+The tiered prefix cache (inference/v2/serving/tiered.py) demotes cold
+KV blocks out of HBM; this module owns where they land. Two backends
+share one contract:
+
+* ``HostBlockStore`` — the DRAM tier: an LRU byte-budgeted dict. Fast,
+  volatile, still checksummed (a flipped bit in host memory must not
+  become a wrong token any more than a torn disk write may).
+* ``DiskBlockStore`` — the persistent tier: one file per block written
+  through ``resilience.integrity.atomic_write_bytes`` (tmp + fsync +
+  rename — a kill leaves the old file or no file, never a truncated
+  one), fronted by an append-only JSONL **index journal** on a held
+  O_APPEND fd. The journal is written BEFORE the payload, so every
+  crash window is recoverable: ``recover()`` (run at construction)
+  replays the journal tolerantly — a torn tail or a record whose
+  payload never landed becomes a counted, typed
+  ``StoreCorruptionError`` in ``recovery_errors``, never a crash and
+  never a served-from-garbage block (PR 15's journal discipline,
+  pointed at storage).
+
+Every payload carries a blake2b digest recorded at put time and
+re-verified at get time; a mismatch raises ``StoreCorruptionError``
+(NOT an OSError — retrying cannot fix corruption) and the caller
+degrades to recompute. All I/O runs inside a ``retry_io`` +
+wall-clock-deadline envelope with the ``store.write`` / ``store.read``
+fault sites fired inside it, so seeded drills exercise exactly the
+code real disk faults would.
+
+The ``encode_kv`` / ``decode_kv`` codecs mirror the offload payload
+codecs: ``none`` is raw bytes (bitwise round trip — required for the
+serving bitwise-streams contract), ``int8`` / ``int4`` are optional
+per-plane absmax-scaled spill compression (approximate: adopted KV is
+then quantized, so streams may diverge from the uncached path — see
+README "Tiered prefix cache" for when that trade is acceptable).
+"""
+
+import hashlib
+import json
+import os
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..resilience.errors import StoreCorruptionError
+from ..resilience.fault_injector import fault_injector
+from ..resilience.integrity import atomic_write_bytes
+from ..resilience.retry import retry_io
+from ..telemetry.trace import span
+from ..utils.logging import logger
+
+KV_CODECS = ("none", "int8", "int4")
+_DIGEST_SIZE = 16
+
+
+def _blake2b_hex(payload: bytes) -> str:
+    return hashlib.blake2b(payload, digest_size=_DIGEST_SIZE).hexdigest()
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 and friends register through ml_dtypes (a jax
+        # dependency, always present here)
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+# -- spill codecs -------------------------------------------------------
+def encode_kv(arr: np.ndarray, codec: str = "none"
+              ) -> Tuple[bytes, Dict]:
+    """Encode one block's KV tensor -> (payload, meta). ``meta`` is
+    JSON-able and sufficient for ``decode_kv`` (codec, dtype, shape,
+    scale layout)."""
+    if codec not in KV_CODECS:
+        raise ValueError(f"unknown KV codec {codec!r}; "
+                         f"expected one of {KV_CODECS}")
+    arr = np.ascontiguousarray(arr)
+    meta = {"codec": codec, "dtype": str(arr.dtype),
+            "shape": list(arr.shape)}
+    if codec == "none":
+        return arr.tobytes(), meta
+    # int8/int4: per-plane absmax scales over the trailing two axes
+    # (block rows x head_dim) — the offload codecs' grouping applied
+    # to the KV pool layout
+    f = arr.astype(np.float32)
+    planes = f.reshape((-1,) + f.shape[-2:])
+    scales = np.abs(planes).max(axis=(1, 2))
+    qmax = 127.0 if codec == "int8" else 7.0
+    safe = np.where(scales > 0.0, scales, 1.0)
+    q = np.rint(planes / safe[:, None, None] * qmax)
+    q = np.clip(q, -qmax, qmax).astype(np.int8)
+    if codec == "int4":
+        flat = q.reshape(-1)
+        if flat.size % 2:
+            flat = np.concatenate([flat, np.zeros((1,), np.int8)])
+            meta["pad"] = 1
+        lo = (flat[0::2] & 0x0F).astype(np.uint8)
+        hi = ((flat[1::2] & 0x0F) << 4).astype(np.uint8)
+        q = (lo | hi)
+    payload = scales.astype(np.float32).tobytes() + q.tobytes()
+    meta["n_planes"] = int(scales.size)
+    return payload, meta
+
+
+def decode_kv(payload: bytes, meta: Dict) -> np.ndarray:
+    """Inverse of ``encode_kv``."""
+    codec = meta.get("codec", "none")
+    dtype = _np_dtype(meta["dtype"])
+    shape = tuple(int(s) for s in meta["shape"])
+    if codec == "none":
+        return np.frombuffer(payload, dtype=dtype).reshape(shape).copy()
+    n_planes = int(meta["n_planes"])
+    scales = np.frombuffer(payload[:4 * n_planes], np.float32)
+    body = payload[4 * n_planes:]
+    qmax = 127.0 if codec == "int8" else 7.0
+    if codec == "int8":
+        q = np.frombuffer(body, np.int8).astype(np.float32)
+    else:
+        packed = np.frombuffer(body, np.uint8)
+        lo = (packed & 0x0F).astype(np.int8)
+        hi = ((packed >> 4) & 0x0F).astype(np.int8)
+        # sign-extend the nibbles
+        lo = np.where(lo > 7, lo - 16, lo)
+        hi = np.where(hi > 7, hi - 16, hi)
+        q = np.stack([lo, hi], axis=1).reshape(-1)
+        if meta.get("pad"):
+            q = q[:-int(meta["pad"])]
+        q = q.astype(np.float32)
+    planes = q.reshape((n_planes,) + shape[-2:])
+    out = planes * (scales[:, None, None] / qmax) * 1.0
+    out = out * np.where(scales > 0.0, 1.0, 0.0)[:, None, None]
+    return out.reshape(shape).astype(dtype)
+
+
+# -- the shared I/O envelope -------------------------------------------
+class _IoPolicy:
+    """retry_io + wall-clock deadline + fault site, shared by both
+    backends. The fault fires INSIDE the retried callable so an
+    ``ioerror`` spec exercises the backoff path; ``kill``-class
+    injected faults are not OSErrors and propagate immediately."""
+
+    def __init__(self, retries: int, backoff_seconds: float,
+                 deadline_seconds: float):
+        self.retries = max(0, int(retries))
+        self.backoff_seconds = float(backoff_seconds)
+        self.deadline_seconds = float(deadline_seconds)
+
+    def run(self, site: str, tier: str, fn, description: str):
+        t0 = time.monotonic()
+
+        def attempt():
+            if self.deadline_seconds > 0 and \
+                    time.monotonic() - t0 > self.deadline_seconds:
+                raise StoreCorruptionError(
+                    f"{description}: deadline "
+                    f"({self.deadline_seconds:.1f}s) exhausted before "
+                    f"the retry budget — treating the tier as "
+                    f"unreadable")
+            fault_injector.fire(site, detail=tier)  # fault-site-ok: closed over "store.write"/"store.read"
+            return fn()
+
+        return retry_io(attempt, retries=self.retries,
+                        backoff_seconds=self.backoff_seconds,
+                        description=description)
+
+
+class RecoveryReport:
+    """What ``DiskBlockStore.recover()`` found: live entries restored,
+    entries dropped (payload missing / size mismatch — the
+    crash-between-journal-append-and-data-write window), and corrupt
+    journal records (torn tail), each a typed error."""
+
+    def __init__(self):
+        self.recovered_entries = 0
+        self.dropped_entries = 0
+        self.errors: List[StoreCorruptionError] = []
+
+    @property
+    def corrupt_records(self) -> int:
+        return len(self.errors)
+
+    def as_dict(self) -> dict:
+        return {"recovered_entries": self.recovered_entries,
+                "dropped_entries": self.dropped_entries,
+                "corrupt_records": self.corrupt_records}
+
+
+class HostBlockStore:
+    """DRAM tier: LRU byte-budgeted in-memory payload store."""
+
+    tier = "dram"
+
+    def __init__(self, max_bytes: int, *, retries: int = 3,
+                 backoff_seconds: float = 0.02,
+                 deadline_seconds: float = 5.0):
+        self.max_bytes = max(0, int(max_bytes))
+        self._io = _IoPolicy(retries, backoff_seconds, deadline_seconds)
+        # key -> (payload, b2 hex, meta); insertion order IS LRU order
+        self._entries: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self.used_bytes = 0
+        self.puts = 0
+        self.gets = 0
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def over_budget(self) -> bool:
+        return self.max_bytes > 0 and self.used_bytes > self.max_bytes
+
+    def put(self, key: bytes, payload: bytes, meta: Dict) -> None:
+        with span("store.write", tier=self.tier, bytes=len(payload)):
+            self._io.run("store.write", self.tier, lambda: None,
+                         "dram-tier block write")
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.used_bytes -= len(old[0])
+            self._entries[key] = (bytes(payload), _blake2b_hex(payload),
+                                  dict(meta))
+            self.used_bytes += len(payload)
+            self.puts += 1
+
+    def get(self, key: bytes) -> Tuple[bytes, Dict]:
+        e = self._entries.get(key)
+        if e is None:
+            raise KeyError(key.hex())
+        with span("store.read", tier=self.tier):
+            self._io.run("store.read", self.tier, lambda: None,
+                         "dram-tier block read")
+            payload, b2, meta = e
+            if _blake2b_hex(payload) != b2:
+                raise StoreCorruptionError(
+                    f"dram-tier block {key.hex()} failed checksum "
+                    f"verification (host memory corruption)")
+            self._entries.move_to_end(key)
+            self.gets += 1
+            return payload, dict(meta)
+
+    def delete(self, key: bytes) -> None:
+        e = self._entries.pop(key, None)
+        if e is not None:
+            self.used_bytes -= len(e[0])
+
+    def pop_lru(self) -> Optional[Tuple[bytes, bytes, Dict]]:
+        """Coldest (key, payload, meta), removed — the down-tier
+        rebalance primitive. No fault fire: this is internal movement,
+        the tier crossings fire on the destination's put."""
+        if not self._entries:
+            return None
+        key, (payload, _b2, meta) = self._entries.popitem(last=False)
+        self.used_bytes -= len(payload)
+        return key, payload, meta
+
+    def keys(self) -> List[bytes]:
+        return list(self._entries)
+
+    def close(self) -> None:
+        self._entries.clear()
+        self.used_bytes = 0
+
+
+class DiskBlockStore:
+    """Persistent tier: payload-per-file + append-only index journal.
+
+    Write protocol (the crash-safety contract the fault drills pin):
+
+    1. journal ``put`` record appended (+fsync per ``fsync_every``),
+    2. payload written via ``atomic_write_bytes``.
+
+    A crash between 1 and 2 leaves a journal entry whose payload never
+    landed; ``recover()`` drops it with a counted typed error. A crash
+    mid-2 leaves no file under the final name (tmp+rename). The
+    journal fd is HELD open (single O_APPEND writes) — ``close()``
+    must release it, which is exactly what the engine-close lifecycle
+    test asserts.
+    """
+
+    tier = "disk"
+    INDEX_NAME = "index.jsonl"
+
+    def __init__(self, root: str, max_bytes: int = 0, *,
+                 fsync_every: int = 8, retries: int = 3,
+                 backoff_seconds: float = 0.02,
+                 deadline_seconds: float = 5.0):
+        self.root = str(root)
+        self.max_bytes = max(0, int(max_bytes))
+        self.fsync_every = max(0, int(fsync_every))
+        self._io = _IoPolicy(retries, backoff_seconds, deadline_seconds)
+        self._blocks_dir = os.path.join(self.root, "blocks")
+        os.makedirs(self._blocks_dir, exist_ok=True)
+        self.index_path = os.path.join(self.root, self.INDEX_NAME)
+        # key -> {"size", "b2", "meta"}; insertion order IS LRU order
+        self._entries: "OrderedDict[bytes, dict]" = OrderedDict()
+        self.used_bytes = 0
+        self.puts = 0
+        self.gets = 0
+        self._since_sync = 0
+        self._journal_records = 0
+        self.recovery = self.recover()
+        self._jfd: Optional[int] = os.open(
+            self.index_path,
+            os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+
+    # -- crash recovery -------------------------------------------------
+    def recover(self) -> RecoveryReport:
+        """Tolerant index replay + payload verification. Same
+        discipline as the fleet journal: the journal's author may have
+        CRASHED, so a torn tail is the expected case — every line
+        parses independently, content failures become counted typed
+        errors, and replay never raises."""
+        rep = RecoveryReport()
+        live: "OrderedDict[bytes, dict]" = OrderedDict()
+        if os.path.exists(self.index_path):
+            with open(self.index_path, "rb") as f:
+                raw = f.read()
+            lineno = 0
+            for line in raw.split(b"\n"):
+                line = line.strip()
+                if not line:
+                    continue
+                lineno += 1
+                try:
+                    rec = json.loads(line.decode("utf-8"))
+                    if not isinstance(rec, dict):
+                        raise ValueError("record is not a dict")
+                    kind = rec["rec"]
+                    key = bytes.fromhex(rec["k"])
+                    if kind == "put":
+                        live.pop(key, None)
+                        live[key] = {"size": int(rec["size"]),
+                                     "b2": str(rec["b2"]),
+                                     "meta": dict(rec.get("meta") or {})}
+                    elif kind == "del":
+                        live.pop(key, None)
+                    else:
+                        raise ValueError(f"unknown record {kind!r}")
+                except (ValueError, KeyError, TypeError,
+                        UnicodeDecodeError) as e:
+                    rep.errors.append(StoreCorruptionError(
+                        f"store index {self.index_path} line {lineno}: "
+                        f"{type(e).__name__}: {str(e)[:120]}"))
+        # verify each surviving entry's payload actually landed — a
+        # journal record without its file is the crash-mid-put window
+        for key, ent in list(live.items()):
+            path = self._block_path(key)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = -1
+            if size != ent["size"]:
+                live.pop(key)
+                rep.dropped_entries += 1
+                rep.errors.append(StoreCorruptionError(
+                    f"store block {key.hex()}: payload "
+                    + ("missing" if size < 0 else
+                       f"size {size} != journaled {ent['size']}")
+                    + " (crash between journal append and data "
+                      "write); entry dropped"))
+        self._entries = live
+        self.used_bytes = sum(e["size"] for e in live.values())
+        rep.recovered_entries = len(live)
+        if rep.errors:
+            logger.warning(
+                f"disk block store {self.root}: recovered "
+                f"{rep.recovered_entries} entries, dropped "
+                f"{rep.dropped_entries}, {rep.corrupt_records} corrupt "
+                f"record(s)")
+        return rep
+
+    # -- journal --------------------------------------------------------
+    def _block_path(self, key: bytes) -> str:
+        return os.path.join(self._blocks_dir, key.hex() + ".blk")
+
+    def _journal_append(self, rec: dict) -> None:
+        if self._jfd is None:
+            raise StoreCorruptionError(
+                f"disk block store {self.root} is closed")
+        line = json.dumps(rec, separators=(",", ":"),
+                          sort_keys=True).encode() + b"\n"
+        os.write(self._jfd, line)
+        self._journal_records += 1
+        if self.fsync_every:
+            self._since_sync += 1
+            if self._since_sync >= self.fsync_every or \
+                    self._journal_records == 1:
+                os.fsync(self._jfd)
+                self._since_sync = 0
+
+    # -- the store contract ---------------------------------------------
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def over_budget(self) -> bool:
+        return self.max_bytes > 0 and self.used_bytes > self.max_bytes
+
+    def put(self, key: bytes, payload: bytes, meta: Dict) -> None:
+        payload = bytes(payload)
+        b2 = _blake2b_hex(payload)
+        with span("store.write", tier=self.tier, bytes=len(payload)):
+            def write():
+                # journal FIRST (write-ahead), payload second: every
+                # crash interleaving is a recover() case, never a
+                # silently-served torn block
+                self._journal_append(
+                    {"rec": "put", "k": key.hex(), "size": len(payload),
+                     "b2": b2, "meta": meta})
+                atomic_write_bytes(self._block_path(key),
+                                   lambda f: f.write(payload))
+
+            self._io.run("store.write", self.tier, write,
+                         "disk-tier block write")
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.used_bytes -= old["size"]
+            self._entries[key] = {"size": len(payload), "b2": b2,
+                                  "meta": dict(meta)}
+            self.used_bytes += len(payload)
+            self.puts += 1
+
+    def get(self, key: bytes) -> Tuple[bytes, Dict]:
+        ent = self._entries.get(key)
+        if ent is None:
+            raise KeyError(key.hex())
+        with span("store.read", tier=self.tier):
+            def read():
+                with open(self._block_path(key), "rb") as f:
+                    return f.read()
+
+            payload = self._io.run("store.read", self.tier, read,
+                                   "disk-tier block read")
+            if len(payload) != ent["size"] or \
+                    _blake2b_hex(payload) != ent["b2"]:
+                raise StoreCorruptionError(
+                    f"disk-tier block {key.hex()} failed integrity "
+                    f"verification (size {len(payload)} vs "
+                    f"{ent['size']})")
+            self._entries.move_to_end(key)
+            self.gets += 1
+            return payload, dict(ent["meta"])
+
+    def delete(self, key: bytes) -> None:
+        ent = self._entries.pop(key, None)
+        if ent is None:
+            return
+        self.used_bytes -= ent["size"]
+        self._journal_append({"rec": "del", "k": key.hex()})
+        try:
+            os.unlink(self._block_path(key))
+        except OSError:
+            pass  # the journal del already retired it for recovery
+
+    def pop_lru(self) -> Optional[Tuple[bytes, bytes, Dict]]:
+        """Coldest (key, payload, meta), removed from the store. The
+        disk tier is the bottom: its caller true-evicts the entry."""
+        if not self._entries:
+            return None
+        key = next(iter(self._entries))
+        try:
+            payload, meta = self.get(key)
+        except (OSError, StoreCorruptionError, KeyError):
+            payload, meta = b"", {}
+        self.delete(key)
+        return key, payload, meta
+
+    def keys(self) -> List[bytes]:
+        return list(self._entries)
+
+    @property
+    def closed(self) -> bool:
+        return self._jfd is None
+
+    def close(self) -> None:
+        """Release the held journal fd (idempotent). The PR 6 rule:
+        every held OS resource has a close, and engine.close() reaches
+        it."""
+        fd, self._jfd = self._jfd, None
+        if fd is not None:
+            try:
+                os.fsync(fd)
+            except OSError:
+                pass
+            os.close(fd)
+
+    def as_dict(self) -> dict:
+        return {"root": self.root, "entries": len(self._entries),
+                "used_bytes": self.used_bytes, "puts": self.puts,
+                "gets": self.gets, "closed": self.closed,
+                "recovery": self.recovery.as_dict()}

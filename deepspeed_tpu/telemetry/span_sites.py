@@ -133,6 +133,19 @@ SPAN_SITES = {
         "gracefully draining one replica before detach: no new "
         "placements, in-flight work finishes in place (args: slot) — "
         "the rolling-restart primitive",
+    # ---- tiered prefix cache (inference/v2/serving/tiered.py) ----
+    "cache.demote":
+        "one cold block's down-tier demotion: device KV gather (d2h), "
+        "optional codec encode, store write (args: tier, block)",
+    "cache.promote":
+        "one spilled block's promotion on the adoption path: store "
+        "read + verify, decode, pool scatter (h2d) (args: tier)",
+    "store.write":
+        "one block-store payload write incl. its retry envelope "
+        "(args: tier, bytes) — runtime/store.py",
+    "store.read":
+        "one block-store payload read + checksum verify incl. retries "
+        "(args: tier) — runtime/store.py",
     # ---- elastic supervisor (elasticity/supervisor.py) ----
     "supervisor.gate":
         "the pre-dispatch health gate (one per supervised step)",

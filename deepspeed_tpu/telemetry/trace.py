@@ -238,7 +238,7 @@ class Tracer:
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         tmp = path + ".tmp"
-        with open(tmp, "w") as f:
+        with open(tmp, "w") as f:  # atomic-ok: tmp file, renamed below
             json.dump(self.to_chrome_trace(), f)
         os.replace(tmp, path)
         return path

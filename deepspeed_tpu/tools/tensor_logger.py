@@ -151,7 +151,7 @@ class TensorLogger:
         d = dirname(filename)
         if d:
             makedirs(d, exist_ok=True)
-        with open(filename, "wb") as f:
+        with open(filename, "wb") as f:  # atomic-ok: debug dump, re-created on demand
             np.savez(f, **arrays)
         self.clear()
         return filename

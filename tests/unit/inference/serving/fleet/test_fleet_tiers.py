@@ -1,0 +1,167 @@
+"""Fleet × tiered prefix cache: the worker's TRIE_DELTA carries tier
+residency for spilled digests (3-tuple journal records folded into a
+``tiers`` map), the router's affinity map stores ``(slot, tier)`` and
+scores spilled prefixes with the configured DRAM/disk discounts, and
+the SNAPSHOT resync rebuilds residency from ``trie_tiers``."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (FleetRouter, InferenceEngineV2,
+                                        RaggedInferenceEngineConfig,
+                                        RequestState, ServingFrontend)
+from deepspeed_tpu.inference.v2.serving.fleet.worker import WorkerCore
+from deepspeed_tpu.inference.v2.serving.prefix import chain_digests
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+SYS = list(range(1, 18))                 # 2 full 8-token blocks
+
+
+@pytest.fixture(scope="module")
+def params_cfg():
+    import jax
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))
+    return params, cfg
+
+
+def _factory(params_cfg):
+    params, cfg = params_cfg
+
+    def engine_factory(slot):
+        return InferenceEngineV2(
+            params, cfg,
+            RaggedInferenceEngineConfig(
+                token_budget=32, max_ragged_sequence_count=4,
+                n_kv_blocks=48, kv_block_size=8,
+                max_blocks_per_seq=8, kv_dtype="float32"))
+    return engine_factory
+
+
+TIERS = {"prefix": {"max_blocks": 2,
+                    "tiers": {"enabled": True, "dram_max_mb": 64.0}}}
+
+
+def _router(params_cfg, serving=None, n=2):
+    cfg = {"fleet": {"n_replicas": n}}
+    cfg.update(serving or TIERS)
+    return FleetRouter(_factory(params_cfg), cfg)
+
+
+class TestDeltaTiersMap:
+
+    def test_journal_folds_tier_records_into_the_tiers_map(
+            self, params_cfg):
+        fe = ServingFrontend(_factory(params_cfg)(0), TIERS)
+        wc = WorkerCore(0, fe)
+        d1, d2, d3, d4 = (bytes([i]) * 16 for i in range(1, 5))
+        wc._journal[:] = [("add", d1), ("tier", d1, "dram"),
+                          ("add", d2), ("del", d3),
+                          ("tier", d4, "disk"), ("tier", d4, "hbm")]
+        delta = wc._drain_delta()
+        assert sorted(delta["add"]) == sorted(
+            [d1.hex(), d2.hex(), d4.hex()])
+        assert delta["del"] == [d3.hex()]
+        # only non-hbm residents ride the tiers map; d4's later hbm
+        # move (a promotion) nets the earlier spill away
+        assert delta["tiers"] == {d1.hex(): "dram"}
+        # no churn -> no delta, and the map key is absent when empty
+        assert wc._drain_delta() is None
+        wc._journal[:] = [("add", d2)]
+        assert "tiers" not in wc._drain_delta()
+        fe.close()
+
+    def test_snapshot_lists_spilled_digests_with_residency(
+            self, params_cfg):
+        """The resync source of truth: spilled digests are SERVABLE
+        (promote beats recompute) so the snapshot's trie includes
+        them, with ``trie_tiers`` naming the tier."""
+        fe = ServingFrontend(_factory(params_cfg)(0), TIERS)
+        wc = WorkerCore(0, fe)
+        r = fe.submit(SYS + [31], uid=1, max_new_tokens=2)
+        fe.drain()
+        assert r.state == RequestState.FINISHED
+        pc = fe.engine.prefix_cache
+        pc._evict(count=1)                   # spill the chain's leaf
+        assert pc.spilled_blocks == 1
+        snap = wc._full_snapshot("SNAPSHOT_OK")
+        da = chain_digests(np.asarray(SYS + [31], np.int32), 8)
+        assert set(snap["trie"]) == {d.hex() for d in da}
+        assert snap["trie_tiers"] == {da[1].hex(): "dram"}
+        fe.close()
+
+
+class TestRouterAffinityTiers:
+
+    def test_spill_demotes_affinity_weight_not_membership(
+            self, params_cfg):
+        """The fleet acceptance path: a replica-side demotion reaches
+        the router as a residency update — the digest KEEPS pulling
+        traffic to its home slot, at the configured DRAM discount —
+        and the later promotion restores full weight."""
+        router = _router(params_cfg)
+        pa = np.asarray(SYS + [31], np.int32)
+        pb = np.asarray(SYS[:8] + list(range(300, 310)), np.int32)
+        da = chain_digests(pa, 8)
+
+        r1 = router.submit(pa, uid=1, max_new_tokens=3)
+        router.drain()
+        assert r1.state == RequestState.FINISHED
+        home = router._entries[1].slot
+        assert all(router._affinity_map.get(d) == (home, "hbm")
+                   for d in da)
+        assert router._affinity(da) == (home, 2, 2.0)
+
+        # pb shares block 0, overflows the 2-block trie on the same
+        # replica -> pa's leaf DEMOTES (tiers on: not evicted)
+        r2 = router.submit(pb, uid=2, max_new_tokens=3)
+        assert router._entries[2].slot == home
+        router.drain()
+        assert r2.state == RequestState.FINISHED
+        assert router._affinity_map.get(da[1]) == (home, "dram")
+        slot, n, w = router._affinity(da)
+        assert (slot, n) == (home, 2)
+        assert w == pytest.approx(1.0 + 0.7)   # hbm + dram discount
+
+        # resubmitting pa promotes the leaf back -> full weight again
+        r3 = router.submit(pa, uid=3, max_new_tokens=3)
+        router.drain()
+        assert r3.state == RequestState.FINISHED
+        assert router._affinity_map.get(da[1]) == (home, "hbm")
+        assert router._affinity(da) == (home, 2, 2.0)
+        st = router._replicas[home].engine.prefix_cache.stats()
+        assert st["demoted_blocks"] >= 1
+        assert st["promoted_blocks"] >= 1
+
+    def test_tier_weights_come_from_the_fleet_config(self, params_cfg):
+        cfg = {"prefix": TIERS["prefix"],
+               "fleet": {"dram_affinity_weight": 0.5,
+                         "disk_affinity_weight": 0.25}}
+        router = _router(params_cfg, serving=cfg)
+        assert router._tier_weights == {"hbm": 1.0, "dram": 0.5,
+                                        "disk": 0.25}
+        d = bytes(16)
+        router._affinity_map.put(d, (0, "disk"))
+        assert router._affinity([d]) == (0, 1, 0.25)
+
+    def test_resync_rebuilds_tier_residency(self, params_cfg):
+        """A router that lost deltas (seq gap) re-learns residency
+        from the SNAPSHOT's ``trie_tiers`` — spilled digests come back
+        as their tier, not as full-weight hbm."""
+        router = _router(params_cfg)
+        pa = np.asarray(SYS + [31], np.int32)
+        da = chain_digests(pa, 8)
+        r1 = router.submit(pa, uid=1, max_new_tokens=3)
+        router.drain()
+        home = router._entries[1].slot
+        pc = router._replicas[home].engine.prefix_cache
+        pc._evict(count=1)                   # out-of-band spill
+        # poison the map, then force the resync path
+        router._affinity_map.put(da[1], (home, "hbm"))
+        router._resync(home, step=0)
+        assert router._affinity_map.get(da[0]) == (home, "hbm")
+        assert router._affinity_map.get(da[1]) == (home, "dram")
+        slot, n, w = router._affinity(da)
+        assert (slot, n, w) == (home, 2, pytest.approx(1.7))

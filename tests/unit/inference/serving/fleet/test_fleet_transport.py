@@ -463,7 +463,8 @@ class TestAffinityEvictionRegression:
         router.drain()
         assert r1.state == RequestState.FINISHED
         home = router._entries[1].slot
-        assert all(router._affinity_map.get(d) == home for d in da)
+        assert all(router._affinity_map.get(d) == (home, "hbm")
+                   for d in da)
 
         r2 = router.submit(pb, uid=2, max_new_tokens=3)
         assert router._entries[2].slot == home    # affinity pulled it
@@ -472,17 +473,17 @@ class TestAffinityEvictionRegression:
         # the replica evicted pa's leaf block; the delta's del reached
         # the map — no stale entry pulls traffic at evicted KV
         assert router._affinity_map.get(da[1]) is None
-        assert router._affinity_map.get(db[1]) == home
-        assert router._affinity_map.get(da[0]) == home  # still cached
+        assert router._affinity_map.get(db[1]) == (home, "hbm")
+        assert router._affinity_map.get(da[0]) == (home, "hbm")
         # and the affinity walk degrades to the 1-block prefix cleanly
-        assert router._affinity(da) == (home, 1)
+        assert router._affinity(da) == (home, 1, 1.0)
 
         # resubmitting the evicted chain re-inserts it: the NEXT delta
         # refreshes the map instead of leaving it stale forever
         r3 = router.submit(pa, uid=3, max_new_tokens=3)
         router.drain()
         assert r3.state == RequestState.FINISHED
-        assert router._affinity_map.get(da[1]) == home
+        assert router._affinity_map.get(da[1]) == (home, "hbm")
 
 
 def _chaos_serve(params_cfg, specs, n_req=6, max_new_tokens=4,

@@ -1,0 +1,401 @@
+"""TieredPrefixCache — HBM trie with DRAM/disk spill tiers: overflow
+DEMOTES cold blocks down-tier instead of evicting them, ``match``
+promotes spilled blocks back on the adoption path (bitwise-identical
+payloads under codec "none"), DRAM overflow rebalances to disk, and
+the serving-level gate: greedy streams identical with tiers off /
+DRAM / DRAM+disk. The eviction-cause counter split and the
+prefix-thrash detector (satellites) live at the bottom."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig,
+                                        RequestState, ServingFrontend)
+from deepspeed_tpu.inference.v2.ragged_manager import BlockedAllocator
+from deepspeed_tpu.inference.v2.serving.prefix import (PrefixCache,
+                                                       chain_digests)
+from deepspeed_tpu.inference.v2.serving.tiered import TieredPrefixCache
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.runtime.store import DiskBlockStore, HostBlockStore
+
+BS = 4
+
+
+class FakeKV:
+    """Engine stand-in for host-level tests: a dict of per-block
+    payload arrays (what the jitted gather/scatter pair moves)."""
+
+    def __init__(self):
+        self.data = {}
+
+    def read_kv_block(self, block):
+        return self.data[block]
+
+    def write_kv_block(self, block, arr):
+        self.data[block] = np.asarray(arr)
+
+
+def _tiered(n_blocks=16, max_blocks=0, dram_bytes=0, disk=None,
+            **kw):
+    a = BlockedAllocator(n_blocks)
+    kv = FakeKV()
+    pc = TieredPrefixCache(BS, a, max_blocks=max_blocks, kv_io=kv,
+                           dram_store=HostBlockStore(dram_bytes),
+                           disk_store=disk, **kw)
+    return pc, a, kv
+
+
+def _chain(pc, a, kv, seed, n_blocks=1):
+    """Insert one chain of ``n_blocks`` full blocks with deterministic
+    per-block payloads; the caller's refs are released so the cache is
+    sole owner (the state-manager flush idiom)."""
+    prompt = np.arange(seed, seed + n_blocks * BS + 1, dtype=np.int32)
+    blocks = a.allocate(n_blocks)
+    for i, b in enumerate(blocks):
+        kv.write_kv_block(b, np.full((2, 2, BS, 2), seed + i,
+                                     np.float32))
+    pc.insert(prompt, blocks)
+    a.free(blocks)
+    return prompt, blocks
+
+
+class TestSpillAndReadopt:
+
+    def test_overflow_demotes_instead_of_evicting(self):
+        pc, a, kv = _tiered(max_blocks=2)
+        pc.journal = []
+        p1, _ = _chain(pc, a, kv, 0)
+        p2, _ = _chain(pc, a, kv, 100)
+        p3, _ = _chain(pc, a, kv, 200)      # bound 2 -> LRU demoted
+        assert pc.cached_blocks == 2
+        assert pc.spilled_blocks == 1 and pc.demoted_blocks == 1
+        d1 = chain_digests(p1, BS)[0]
+        assert pc.resident_tier(d1) == "dram"
+        assert ("tier", d1, "dram") in pc.journal
+        # the spilled block's pool slot was returned to the allocator
+        assert a.free_blocks == 16 - 2
+        st = pc.stats()
+        assert st["spilled_blocks"] == 1 and st["dram_blocks"] == 1
+        assert st["evicted_blocks"] == 0    # demotion is not eviction
+
+    def test_match_promotes_spilled_block_back_bitwise(self):
+        pc, a, kv = _tiered(max_blocks=2)
+        pc.journal = []
+        p1, _ = _chain(pc, a, kv, 0)
+        _chain(pc, a, kv, 100)
+        _chain(pc, a, kv, 200)
+        d1 = chain_digests(p1, BS)[0]
+        assert pc.resident_tier(d1) == "dram"
+        blocks, n = pc.match(p1)
+        assert n == BS and len(blocks) == 1
+        assert pc.promoted_blocks == 1
+        assert pc.resident_tier(d1) == "hbm"
+        # the promoted payload is the demoted one, bitwise
+        assert np.array_equal(kv.data[blocks[0]],
+                              np.full((2, 2, BS, 2), 0, np.float32))
+        assert len(pc.dram) == 0            # one tier at a time
+        assert ("tier", d1, "hbm") in pc.journal
+
+    def test_promotion_displaces_a_colder_block_under_pressure(self):
+        """No free pool block at promote time: the cache demotes a
+        colder HBM entry to make room (LRU displacement), so the hot
+        set rotates through HBM without the pool growing."""
+        pc, a, kv = _tiered(n_blocks=3, max_blocks=2)
+        p1, _ = _chain(pc, a, kv, 0)
+        p2, _ = _chain(pc, a, kv, 100)
+        p3, _ = _chain(pc, a, kv, 200)
+        # pool: 2 cached + 1 free; soak the free block up
+        hold = a.allocate(1)
+        assert a.free_blocks == 0
+        blocks, n = pc.match(p1)            # promote must displace
+        assert n == BS
+        assert pc.demoted_blocks >= 2       # the displaced victim
+        d1 = chain_digests(p1, BS)[0]
+        assert pc.resident_tier(d1) == "hbm"
+        a.free(hold)
+
+    def test_interior_parent_promotes_before_its_child(self):
+        """A 2-block chain demoted leaf-first then fully re-adopted:
+        the walk promotes parent and child in chain order."""
+        pc, a, kv = _tiered()
+        prompt, _ = _chain(pc, a, kv, 0, n_blocks=2)
+        pc._evict(count=2)                  # both blocks to DRAM
+        assert pc.cached_blocks == 0 and pc.spilled_blocks == 2
+        blocks, n = pc.match(prompt)
+        assert n == 2 * BS and pc.promoted_blocks == 2
+        assert np.array_equal(kv.data[blocks[1]],
+                              np.full((2, 2, BS, 2), 1, np.float32))
+
+    def test_insert_supersedes_spilled_copy(self):
+        """A fresh prefill of a spilled chain: the live KV is
+        canonical — the spilled payload is retired, not promoted."""
+        pc, a, kv = _tiered(max_blocks=2)
+        p1, _ = _chain(pc, a, kv, 0)
+        _chain(pc, a, kv, 100)
+        _chain(pc, a, kv, 200)
+        d1 = chain_digests(p1, BS)[0]
+        assert pc.resident_tier(d1) == "dram"
+        _chain(pc, a, kv, 0)                # same tokens, new prefill
+        assert pc.resident_tier(d1) == "hbm"
+        assert d1 not in pc.dram
+        assert pc.promoted_blocks == 0
+
+    def test_clear_drops_hbm_and_spilled_state(self):
+        pc, a, kv = _tiered(max_blocks=2)
+        for seed in (0, 100, 200):
+            _chain(pc, a, kv, seed)
+        assert pc.spilled_blocks == 1
+        freed = pc.clear()
+        assert freed == 2
+        assert pc.cached_blocks == 0 and pc.spilled_blocks == 0
+        assert len(pc.dram) == 0
+        assert a.free_blocks == 16
+
+    def test_close_is_idempotent(self, tmp_path):
+        disk = DiskBlockStore(str(tmp_path))
+        pc, a, kv = _tiered(disk=disk)
+        pc.close()
+        pc.close()
+        assert disk.closed
+
+
+class TestDiskRebalance:
+
+    def test_dram_overflow_rolls_down_to_disk(self, tmp_path):
+        disk = DiskBlockStore(str(tmp_path))
+        pc, a, kv = _tiered(max_blocks=1, dram_bytes=1, disk=disk)
+        p1, _ = _chain(pc, a, kv, 0)
+        p2, _ = _chain(pc, a, kv, 100)      # demotes p1, over budget
+        d1 = chain_digests(p1, BS)[0]
+        assert pc.resident_tier(d1) == "disk"
+        assert d1 in disk and d1 not in pc.dram
+        # promotion from the disk tier is still bitwise
+        blocks, n = pc.match(p1)
+        assert n == BS
+        assert np.array_equal(kv.data[blocks[0]],
+                              np.full((2, 2, BS, 2), 0, np.float32))
+        assert d1 not in disk               # retired on promote
+        pc.close()
+
+    def test_no_disk_tier_true_evicts_on_dram_overflow(self):
+        pc, a, kv = _tiered(max_blocks=1, dram_bytes=1)
+        p1, _ = _chain(pc, a, kv, 0)
+        p2, _ = _chain(pc, a, kv, 100)
+        d1 = chain_digests(p1, BS)[0]
+        assert pc.resident_tier(d1) is None
+        assert pc.spill_evicted_blocks == 1
+        assert pc.match(p1)[1] == 0         # miss: gone for real
+
+    def test_disk_budget_true_evicts_coldest(self, tmp_path):
+        # room for exactly ONE spilled payload (2*2*BS*2 float32)
+        disk = DiskBlockStore(str(tmp_path),
+                              max_bytes=2 * 2 * BS * 2 * 4)
+        pc, a, kv = _tiered(max_blocks=1, dram_bytes=1, disk=disk)
+        p1, _ = _chain(pc, a, kv, 0)
+        p2, _ = _chain(pc, a, kv, 100)
+        p3, _ = _chain(pc, a, kv, 200)
+        # p1 rolled to disk then fell off its budget; p2 is in disk now
+        d1, d2 = (chain_digests(p, BS)[0] for p in (p1, p2))
+        assert pc.resident_tier(d1) is None
+        assert pc.resident_tier(d2) == "disk"
+        pc.close()
+
+
+@pytest.mark.slow
+class TestCapacitySweep:
+
+    def test_hit_rate_holds_at_10x_hbm_budget(self, tmp_path):
+        """The ISSUE acceptance sweep: insert 10x more chains than the
+        HBM budget holds; with the spill tiers armed EVERY chain still
+        hits (promoted back on match) — the flat cache would miss on
+        all but the last ``max_blocks``."""
+        disk = DiskBlockStore(str(tmp_path))
+        pc, a, kv = _tiered(n_blocks=8, max_blocks=4,
+                            dram_bytes=12 * 2 * 2 * BS * 2 * 4,
+                            disk=disk)
+        prompts = [_chain(pc, a, kv, 1000 * i)[0] for i in range(40)]
+        st = pc.stats()
+        assert st["cached_blocks"] <= 4
+        assert st["spilled_blocks"] == 36
+        assert st["disk_blocks"] > 0        # the DRAM budget rolled
+        for i, p in enumerate(prompts):
+            blocks, n = pc.match(p)
+            assert n == BS, f"chain {i} missed"
+            assert np.array_equal(
+                kv.data[blocks[0]],
+                np.full((2, 2, BS, 2), 1000 * i, np.float32))
+        st = pc.stats()
+        assert st["hits"] == 40 and st["degraded"] == 0
+        assert st["hit_rate"] == 1.0
+        pc.close()
+
+
+# -- serving-level gate ---------------------------------------------------
+
+SYS = list(range(1, 18))                 # 2 full 8-token shared blocks
+SYS2 = list(range(101, 118))
+TAILS = {0: [31, 32, 33], 1: [41, 42], 2: [51], 3: [61, 62]}
+
+
+@pytest.fixture(scope="module")
+def params_cfg():
+    import jax
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))
+    return params, cfg
+
+
+def _engine(params_cfg, **kw):
+    params, cfg = params_cfg
+    eng_kw = dict(token_budget=32, max_ragged_sequence_count=4,
+                  n_kv_blocks=32, kv_block_size=8,
+                  max_blocks_per_seq=8, kv_dtype="float32")
+    eng_kw.update(kw)
+    return InferenceEngineV2(params, cfg,
+                             RaggedInferenceEngineConfig(**eng_kw))
+
+
+def _requests():
+    """A schedule that forces tier crossings under max_blocks=2: the
+    SYS chain spills when SYS2 inserts, then promotes back."""
+    return {900: SYS + TAILS[0], 901: SYS2 + TAILS[1],
+            902: SYS + TAILS[2], 903: SYS2 + TAILS[3],
+            904: SYS + TAILS[0][:1]}
+
+
+def _serve_serial(fe, requests, max_new_tokens=6):
+    out = {}
+    for uid, prompt in requests.items():
+        r = fe.submit(prompt, uid=uid, max_new_tokens=max_new_tokens)
+        fe.drain()
+        assert r.state == RequestState.FINISHED
+        out[uid] = list(r.tokens)
+    return out
+
+
+def _tiers_cfg(tmp_path=None):
+    # DRAM-only: a budget that HOLDS the spills. DRAM+disk: a budget
+    # so tight every spill immediately rolls down to the disk tier.
+    tiers = {"enabled": True,
+             "dram_max_mb": 64.0 if tmp_path is None else 0.001}
+    if tmp_path is not None:
+        tiers.update(disk_enabled=True, disk_path=str(tmp_path))
+    return {"prefix": {"enabled": True, "max_blocks": 2,
+                       "tiers": tiers}}
+
+
+class TestServingBitwiseGate:
+
+    def test_streams_identical_tiers_off_dram_dram_disk(
+            self, params_cfg, tmp_path):
+        """THE acceptance gate: the same greedy request schedule
+        served with tiers off / DRAM only / DRAM+disk produces
+        bitwise-identical streams, with real tier crossings (demotions
+        AND promotions) happening in the tiered runs."""
+        reqs = _requests()
+        # reference: tiers off, no prefix cache at all — each request
+        # on a fresh frontend (no cross-request reuse)
+        ref_eng = _engine(params_cfg)
+        refs = {}
+        for uid, prompt in reqs.items():
+            fe = ServingFrontend(ref_eng)
+            r = fe.submit(prompt, uid=uid, max_new_tokens=6)
+            fe.drain()
+            refs[uid] = list(r.tokens)
+
+        for label, cfg in (
+                ("dram", _tiers_cfg()),
+                ("dram+disk", _tiers_cfg(tmp_path))):
+            fe = ServingFrontend(_engine(params_cfg), cfg)
+            try:
+                got = _serve_serial(fe, reqs)
+                assert got == refs, f"stream diverged with {label}"
+                st = fe.engine.prefix_cache.stats()
+                assert st["demoted_blocks"] > 0, label
+                assert st["promoted_blocks"] > 0, label
+                assert st["degraded"] == 0
+                assert st["hits"] >= 3
+            finally:
+                fe.close()
+
+    def test_frontend_arms_tiers_and_registers_cache_namespace(
+            self, params_cfg, tmp_path):
+        from deepspeed_tpu.telemetry.hub import TelemetryHub
+        fe = ServingFrontend(_engine(params_cfg), _tiers_cfg(tmp_path))
+        try:
+            pc = fe.engine.prefix_cache
+            assert isinstance(pc, TieredPrefixCache)
+            assert pc.disk is not None
+            hub = fe.attach_telemetry(TelemetryHub())
+            sample = hub.sample(step=0)
+            assert "cache/spilled_blocks" in sample
+        finally:
+            fe.close()
+
+    def test_warmed_tiered_cache_survives_a_second_frontend(
+            self, params_cfg):
+        """The warmup-frontend handoff: a second frontend over the
+        same engine must KEEP the seeded tiered cache (and its spilled
+        state), not build a fresh empty one."""
+        eng = _engine(params_cfg)
+        fe1 = ServingFrontend(eng, _tiers_cfg())
+        _serve_serial(fe1, dict(list(_requests().items())[:2]))
+        pc = eng.prefix_cache
+        assert pc.demoted_blocks > 0
+        fe2 = ServingFrontend(eng, _tiers_cfg())
+        assert eng.prefix_cache is pc       # same instance, kept
+        fe2.close()
+
+
+# -- satellites: eviction-cause counters + thrash detector ----------------
+
+
+class TestEvictionCauseCounters:
+
+    def test_size_bound_vs_reclaim_split(self):
+        a = BlockedAllocator(16)
+        pc = PrefixCache(BS, a, max_blocks=2)
+        for seed in (0, 100, 200):
+            prompt = np.arange(seed, seed + BS + 1, dtype=np.int32)
+            blocks = a.allocate(1)
+            pc.insert(prompt, blocks)
+            a.free(blocks)
+        st = pc.stats()
+        assert st["evicted_size_bound"] == 1
+        assert st["evicted_reclaim"] == 0
+        assert pc.reclaim(1) == 1
+        st = pc.stats()
+        assert st["evicted_reclaim"] == 1
+        assert st["evicted_size_bound"] == 1
+        assert st["evicted_blocks"] == 2    # the split sums to total
+
+
+class TestPrefixThrashAlert:
+
+    def test_window_with_more_evictions_than_insertions_alerts(
+            self, params_cfg):
+        fe = ServingFrontend(_engine(params_cfg),
+                             {"prefix": {"enabled": True}})
+        pc = fe.engine.prefix_cache
+        win = ServingFrontend._THRASH_WINDOW
+        # window 1: healthy (insertions keep pace) — no alert
+        pc.inserted_blocks, pc.evicted_blocks = 10, 10
+        fe._step_idx = win
+        fe._check_prefix_thrash()
+        assert not [x for x in fe.alerts if x.kind == "prefix_thrash"]
+        # window 2: churn (evictions outpace insertions) — alert
+        pc.inserted_blocks, pc.evicted_blocks = 12, 30
+        fe._step_idx = 2 * win
+        fe._check_prefix_thrash()
+        (alert,) = [x for x in fe.alerts if x.kind == "prefix_thrash"]
+        assert alert.value == 20.0 and alert.threshold == 2.0
+        assert "tiers" in alert.message
+        # off-window steps never sample
+        pc.evicted_blocks = 99
+        fe._step_idx = 2 * win + 1
+        fe._check_prefix_thrash()
+        assert len([x for x in fe.alerts
+                    if x.kind == "prefix_thrash"]) == 1

@@ -1,0 +1,280 @@
+"""Seeded fault drills for the tiered prefix cache — the ISSUE's
+robustness contract:
+
+* a ``store.write`` kill mid-demote leaves the trie entry INTACT in
+  its old tier (no torn state, the block is simply still hot);
+* a persistently unreadable spill tier DEGRADES TO RECOMPUTE: the
+  serving stream is bitwise identical to the tiers-off run, the
+  digest is quarantined, a ``cache_degraded`` alert is counted —
+  never a wrong token, never a crashed step;
+* a crash between the journal append and the payload write is
+  recovered clean by the next open (entry dropped, counted);
+* the seeded chaos matrix (slow tier): every fault spec in the matrix
+  preserves bitwise streams end-to-end.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (RequestState, ServingFrontend)
+from deepspeed_tpu.inference.v2.serving.prefix import chain_digests
+from deepspeed_tpu.resilience.fault_injector import fault_injector
+from deepspeed_tpu.runtime import store as store_mod
+from deepspeed_tpu.runtime.store import DiskBlockStore
+
+from .test_tiered_cache import (BS, _chain, _engine, _requests,
+                                _serve_serial, _tiered, _tiers_cfg,
+                                params_cfg)  # noqa: F401
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault_injector.reset()
+    yield
+    fault_injector.reset()
+
+
+class TestDemoteFaultDrills:
+
+    @pytest.mark.parametrize("spec", ["store.write:kill@0xinf",
+                                      "cache.demote:kill@0xinf",
+                                      "store.write:ioerror@0xinf"])
+    def test_failed_demotion_leaves_entry_intact(self, spec):
+        """The drill contract: ALL fallible demote work (gather,
+        encode, store write) happens before any trie/pool mutation —
+        a kill anywhere in that window leaves the entry hot."""
+        pc, a, kv = _tiered(max_blocks=2)
+        pc.dram._io.retries = 0
+        pc.dram._io.backoff_seconds = 0.0
+        p1, b1 = _chain(pc, a, kv, 0)
+        _chain(pc, a, kv, 100)
+        with fault_injector.inject(spec):
+            p3, _ = _chain(pc, a, kv, 200)   # overflow -> demote dies
+        d1 = chain_digests(p1, BS)[0]
+        assert pc.resident_tier(d1) == "hbm"     # intact, old tier
+        assert pc.demote_failures >= 1
+        assert pc.demoted_blocks == 0 and len(pc.dram) == 0
+        assert pc.cached_blocks == 3             # over bound, but HOT
+        assert np.array_equal(kv.data[b1[0]],
+                              np.full((2, 2, BS, 2), 0, np.float32))
+        # the fault cleared: the next insert demotes normally
+        _chain(pc, a, kv, 300)
+        assert pc.demoted_blocks > 0
+        assert pc.match(p1)[1] == BS             # p1 still servable
+
+    def test_single_shot_kill_skips_the_victim_not_the_pass(self):
+        """A one-shot kill on the FIRST victim: that entry stays hot
+        (skipped for the pass) while the next leaf demotes normally —
+        the bound is still honored without torn state."""
+        pc, a, kv = _tiered(max_blocks=2)
+        p1, _ = _chain(pc, a, kv, 0)
+        p2, _ = _chain(pc, a, kv, 100)
+        with fault_injector.inject("store.write:kill"):
+            _chain(pc, a, kv, 200)
+        d1, d2 = (chain_digests(p, BS)[0] for p in (p1, p2))
+        assert pc.resident_tier(d1) == "hbm"     # the failed victim
+        assert pc.resident_tier(d2) == "dram"    # the next leaf went
+        assert pc.demote_failures == 1 and pc.demoted_blocks == 1
+        assert pc.cached_blocks == 2             # bound still honored
+
+    def test_failed_demotion_under_reclaim_frees_nothing_torn(self):
+        """need_free + dead store: reclaim returns 0 instead of
+        freeing a block whose payload never landed."""
+        pc, a, kv = _tiered()
+        _chain(pc, a, kv, 0)
+        pc.dram._io.retries = 0
+        with fault_injector.inject("store.write:kill@0xinf"):
+            assert pc.reclaim(1) == 0
+        assert pc.cached_blocks == 1 and pc.spilled_blocks == 0
+
+
+class TestPromoteFaultDrills:
+
+    def _spilled(self):
+        pc, a, kv = _tiered(max_blocks=2)
+        alerts = []
+        pc.alert_sink = alerts.append
+        p1, _ = _chain(pc, a, kv, 0)
+        _chain(pc, a, kv, 100)
+        _chain(pc, a, kv, 200)               # p1 -> dram
+        d1 = chain_digests(p1, BS)[0]
+        assert pc.resident_tier(d1) == "dram"
+        return pc, a, kv, p1, d1, alerts
+
+    @pytest.mark.parametrize("spec", ["store.read:ioerror@0xinf",
+                                      "cache.promote:kill"])
+    def test_unreadable_tier_degrades_and_quarantines(self, spec):
+        pc, a, kv, p1, d1, alerts = self._spilled()
+        pc.dram._io.retries = 0
+        pc.dram._io.backoff_seconds = 0.0
+        with fault_injector.inject(spec):
+            blocks, n = pc.match(p1)
+        assert n == 0 and blocks == []       # recompute, not a crash
+        assert pc.degraded == 1
+        assert d1 in pc._quarantine
+        assert pc.resident_tier(d1) is None  # spilled copy purged
+        (alert,) = [x for x in alerts if x.kind == "cache_degraded"]
+        assert "degraded to recompute" in alert.message
+        # a fresh prefill of the chain lifts the quarantine
+        _chain(pc, a, kv, 0)
+        assert d1 not in pc._quarantine
+        assert pc.match(p1)[1] == BS
+
+    def test_corrupt_disk_payload_degrades_not_serves(self, tmp_path):
+        """Same-size bit rot in a spilled payload file: the blake2b
+        check turns it into degrade-to-recompute, never adopted KV."""
+        disk = DiskBlockStore(str(tmp_path))
+        pc, a, kv = _tiered(max_blocks=1, dram_bytes=1, disk=disk)
+        alerts = []
+        pc.alert_sink = alerts.append
+        p1, _ = _chain(pc, a, kv, 0)
+        _chain(pc, a, kv, 100)
+        d1 = chain_digests(p1, BS)[0]
+        assert pc.resident_tier(d1) == "disk"
+        path = disk._block_path(d1)
+        with open(path, "rb") as f:
+            raw = bytearray(f.read())
+        raw[0] ^= 0xFF
+        with open(path, "wb") as f:  # atomic-ok: test plants same-size corruption
+            f.write(bytes(raw))
+        assert pc.match(p1)[1] == 0
+        assert pc.degraded == 1
+        assert [x.kind for x in alerts] == ["cache_degraded"]
+        pc.close()
+
+    def test_degraded_parent_purges_spilled_subtree(self):
+        """Children of an unreadable parent are unreachable by chain
+        construction — they are retired with it, not stranded."""
+        pc, a, kv = _tiered()
+        prompt, _ = _chain(pc, a, kv, 0, n_blocks=3)
+        pc._evict(count=3)                   # whole chain spilled
+        ds = chain_digests(prompt, BS)
+        pc.dram.delete(ds[0])                # lose the ROOT's payload
+        assert pc.match(prompt)[1] == 0      # KeyError -> degrade
+        assert pc.degraded == 1
+        assert pc.spilled_blocks == 0        # subtree purged with it
+        assert len(pc.dram) == 0
+
+
+class TestCrashRecoveryDrill:
+
+    def test_crash_between_journal_append_and_payload_write(
+            self, tmp_path, monkeypatch):
+        """The write protocol's one open crash window, driven through
+        the REAL put path: the journal record lands, the process dies
+        before the payload — the next open drops the entry with a
+        counted typed error and every other entry survives."""
+        s = DiskBlockStore(str(tmp_path), fsync_every=1)
+        s.put(b"\x01", b"survivor", {})
+
+        def die(path, writer):
+            raise SystemExit("crash after journal append")
+
+        monkeypatch.setattr(store_mod, "atomic_write_bytes", die)
+        with pytest.raises(SystemExit):
+            s.put(b"\x02", b"never-lands", {})
+        # "crash": the fd just goes away, no close() bookkeeping
+        import os
+        os.close(s._jfd)
+        s._jfd = None
+        monkeypatch.undo()
+
+        r = DiskBlockStore(str(tmp_path))
+        assert r.recovery.recovered_entries == 1
+        assert r.recovery.dropped_entries == 1
+        assert r.recovery.corrupt_records == 1
+        assert b"\x02" not in r
+        assert r.get(b"\x01")[0] == b"survivor"
+        r.close()
+
+
+class TestServingDegradeSmoke:
+
+    def test_degrade_to_recompute_stream_is_bitwise(self, params_cfg):
+        """The tier-1 degrade smoke: warm the tiers, then nuke the
+        DRAM tier's reads — the promotion path degrades and the
+        serving stream still matches the tiers-off reference bitwise,
+        with the ``cache_degraded`` alert on the frontend."""
+        reqs = _requests()
+        ref_eng = _engine(params_cfg)
+        refs = {}
+        for uid, prompt in reqs.items():
+            fe = ServingFrontend(ref_eng)
+            r = fe.submit(prompt, uid=uid, max_new_tokens=6)
+            fe.drain()
+            refs[uid] = list(r.tokens)
+
+        fe = ServingFrontend(_engine(params_cfg), _tiers_cfg())
+        try:
+            pc = fe.engine.prefix_cache
+            pc.dram._io.retries = 0
+            pc.dram._io.backoff_seconds = 0.0
+            uids = list(reqs)
+            got = _serve_serial(fe, {u: reqs[u] for u in uids[:2]})
+            assert pc.demoted_blocks > 0     # the tiers are warm
+            with fault_injector.inject("store.read:ioerror@0xinf"):
+                got.update(_serve_serial(
+                    fe, {u: reqs[u] for u in uids[2:]}))
+            assert got == refs               # BITWISE under the fault
+            st = pc.stats()
+            assert st["degraded"] > 0
+            # quarantine was LIFTED again: the recomputed prefill
+            # re-inserted each degraded chain with fresh live data
+            assert st["quarantined"] == 0
+            assert any(a.kind == "cache_degraded" for a in fe.alerts)
+        finally:
+            fe.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestChaosMatrix:
+
+    SPECS = ["cache.demote:kill@1x2",
+             "cache.promote:kill",
+             "store.write:ioerror@0x3",
+             "store.write:kill@2x2",
+             "store.read:ioerror@0x2",
+             "store.read:ioerror@0xinf",
+             "cache.demote:kill@0xinf,store.read:ioerror@1x3"]
+
+    def test_streams_bitwise_under_every_spec(self, params_cfg,
+                                              tmp_path):
+        """The acceptance chaos matrix: DRAM+disk tiers with each
+        seeded fault spec armed for the WHOLE serve — drop-outs,
+        kills, transient and persistent read/write faults — and every
+        greedy stream stays bitwise identical to the tiers-off
+        reference. Deterministic: ordinal-windowed specs replay the
+        identical drill."""
+        reqs = _requests()
+        ref_eng = _engine(params_cfg)
+        refs = {}
+        for uid, prompt in reqs.items():
+            fe = ServingFrontend(ref_eng)
+            r = fe.submit(prompt, uid=uid, max_new_tokens=6)
+            fe.drain()
+            refs[uid] = list(r.tokens)
+
+        for i, spec in enumerate(self.SPECS):
+            cfg = _tiers_cfg(tmp_path / f"run{i}")
+            cfg["prefix"]["tiers"].update(io_retries=1,
+                                          io_backoff_seconds=0.0)
+            fe = ServingFrontend(_engine(params_cfg), cfg)
+            try:
+                with fault_injector.inject(spec):
+                    got = _serve_serial(fe, reqs)
+                assert got == refs, f"stream diverged under {spec!r}"
+                st = fe.engine.prefix_cache.stats()
+                # consistency: every spilled digest is in exactly one
+                # tier's store, quarantine bounded
+                pc = fe.engine.prefix_cache
+                for d, s in pc._spilled.items():
+                    tier_store = pc.dram if s.tier == "dram" \
+                        else pc.disk
+                    assert d in tier_store, (spec, s.tier)
+                assert st["quarantined"] <= 1024
+            finally:
+                fe.close()
+                fault_injector.reset()

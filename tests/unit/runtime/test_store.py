@@ -1,0 +1,302 @@
+"""runtime/store.py — the tiered block store under the tiered prefix
+cache: KV spill codecs (bitwise ``none``, approximate int8/int4), the
+DRAM tier's LRU byte budget, the disk tier's write-ahead index journal
+with crash-window recovery (torn tail, journal-without-payload),
+integrity verification on every read, the retry/deadline I/O envelope
+around the ``store.write``/``store.read`` fault sites, and close()
+releasing the held journal fd."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.resilience.errors import (InjectedIOError,
+                                             StoreCorruptionError)
+from deepspeed_tpu.resilience.fault_injector import fault_injector
+from deepspeed_tpu.runtime.store import (KV_CODECS, DiskBlockStore,
+                                         HostBlockStore, decode_kv,
+                                         encode_kv)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault_injector.reset()
+    yield
+    fault_injector.reset()
+
+
+def _arr(seed=0, shape=(2, 2, 8, 4), dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(shape).astype(dtype)
+
+
+class TestCodecs:
+
+    def test_none_roundtrip_is_bitwise(self):
+        a = _arr(1)
+        payload, meta = encode_kv(a, "none")
+        b = decode_kv(payload, meta)
+        assert b.dtype == a.dtype and b.shape == a.shape
+        assert np.array_equal(
+            a.view(np.uint8), b.view(np.uint8))  # bitwise, not approx
+
+    def test_none_roundtrip_bfloat16(self):
+        """The serving KV dtype path: bfloat16 has no stdlib numpy
+        name — decode resolves it through ml_dtypes."""
+        import ml_dtypes
+        a = _arr(2).astype(ml_dtypes.bfloat16)
+        payload, meta = encode_kv(a, "none")
+        b = decode_kv(payload, meta)
+        assert b.dtype == a.dtype
+        assert np.array_equal(a.view(np.uint8), b.view(np.uint8))
+
+    @pytest.mark.parametrize("codec,bound", [("int8", 0.05),
+                                             ("int4", 0.5)])
+    def test_quantized_roundtrip_is_close(self, codec, bound):
+        a = _arr(3)
+        payload, meta = encode_kv(a, codec)
+        assert len(payload) < a.nbytes          # it actually compresses
+        b = decode_kv(payload, meta)
+        err = np.abs(a - b.astype(np.float32)).max() / \
+            np.abs(a).max()
+        assert err < bound
+
+    def test_int4_odd_element_count_pads(self):
+        a = _arr(4, shape=(1, 3, 3)).astype(np.float32)  # 9 elements
+        payload, meta = encode_kv(a, "int4")
+        assert meta.get("pad") == 1
+        b = decode_kv(payload, meta)
+        assert b.shape == a.shape
+
+    def test_zero_plane_stays_zero(self):
+        a = np.zeros((1, 4, 4), np.float32)
+        for codec in KV_CODECS:
+            payload, meta = encode_kv(a, codec)
+            assert np.array_equal(decode_kv(payload, meta), a)
+
+    def test_unknown_codec_rejected(self):
+        with pytest.raises(ValueError, match="unknown KV codec"):
+            encode_kv(_arr(), "zstd")
+
+
+class TestHostBlockStore:
+
+    def test_roundtrip_and_lru_touch(self):
+        s = HostBlockStore(1 << 20)
+        s.put(b"a", b"payload-a", {"m": 1})
+        s.put(b"b", b"payload-b", {"m": 2})
+        assert b"a" in s and len(s) == 2
+        payload, meta = s.get(b"a")           # touches a -> b is LRU
+        assert payload == b"payload-a" and meta == {"m": 1}
+        key, payload, _ = s.pop_lru()
+        assert key == b"b" and payload == b"payload-b"
+
+    def test_byte_budget_and_delete(self):
+        s = HostBlockStore(10)
+        s.put(b"a", b"x" * 8, {})
+        assert not s.over_budget
+        s.put(b"b", b"y" * 8, {})
+        assert s.over_budget and s.used_bytes == 16
+        s.delete(b"a")
+        assert not s.over_budget and s.used_bytes == 8
+        s.delete(b"a")                         # idempotent
+        assert s.used_bytes == 8
+
+    def test_overwrite_replaces_bytes_not_leaks(self):
+        s = HostBlockStore(0)
+        s.put(b"a", b"x" * 100, {})
+        s.put(b"a", b"y" * 4, {})
+        assert s.used_bytes == 4
+        assert s.get(b"a")[0] == b"y" * 4
+
+    def test_missing_key_raises_keyerror(self):
+        with pytest.raises(KeyError):
+            HostBlockStore(0).get(b"nope")
+
+    def test_host_corruption_detected(self):
+        """A flipped bit in host memory must degrade, not serve: the
+        payload is verified against its put-time blake2b on get."""
+        s = HostBlockStore(0)
+        s.put(b"a", b"payload", {})
+        payload, b2, meta = s._entries[b"a"]
+        s._entries[b"a"] = (b"pAyload", b2, meta)
+        with pytest.raises(StoreCorruptionError, match="checksum"):
+            s.get(b"a")
+
+    def test_close_clears(self):
+        s = HostBlockStore(0)
+        s.put(b"a", b"x", {})
+        s.close()
+        assert len(s) == 0 and s.used_bytes == 0
+
+
+class TestDiskBlockStore:
+
+    def test_roundtrip_delete_and_stats(self, tmp_path):
+        s = DiskBlockStore(str(tmp_path))
+        s.put(b"\x01", b"payload-1", {"shape": [2]})
+        payload, meta = s.get(b"\x01")
+        assert payload == b"payload-1" and meta == {"shape": [2]}
+        assert s.as_dict()["entries"] == 1
+        s.delete(b"\x01")
+        assert b"\x01" not in s and s.used_bytes == 0
+        s.close()
+
+    def test_reopen_recovers_live_entries(self, tmp_path):
+        s = DiskBlockStore(str(tmp_path))
+        s.put(b"\x01", b"one", {})
+        s.put(b"\x02", b"two", {})
+        s.delete(b"\x01")
+        s.close()
+        r = DiskBlockStore(str(tmp_path))
+        assert r.recovery.recovered_entries == 1
+        assert r.recovery.corrupt_records == 0
+        assert b"\x01" not in r                # the del replayed
+        assert r.get(b"\x02")[0] == b"two"
+        r.close()
+
+    def test_torn_journal_tail_is_counted_not_fatal(self, tmp_path):
+        """The journal's author may have CRASHED mid-append: a torn
+        tail is the expected case, replayed tolerantly as a counted
+        typed error."""
+        s = DiskBlockStore(str(tmp_path))
+        s.put(b"\x01", b"one", {})
+        s.close()
+        with open(s.index_path, "ab") as f:  # atomic-ok: test simulates a torn journal tail
+            f.write(b'{"rec": "put", "k": "02", "si')
+        r = DiskBlockStore(str(tmp_path))
+        assert r.recovery.recovered_entries == 1
+        assert r.recovery.corrupt_records == 1
+        assert all(isinstance(e, StoreCorruptionError)
+                   for e in r.recovery.errors)
+        assert r.get(b"\x01")[0] == b"one"
+        r.close()
+
+    def test_journal_without_payload_is_dropped(self, tmp_path):
+        """The crash window the write protocol leaves open BY DESIGN
+        (journal first, payload second): a put record whose file never
+        landed is dropped with a counted error — never served."""
+        s = DiskBlockStore(str(tmp_path))
+        s.put(b"\x01", b"one", {})
+        s._journal_append({"rec": "put", "k": "02", "size": 3,
+                           "b2": "00" * 16, "meta": {}})
+        s.close()                              # crashed before payload
+        r = DiskBlockStore(str(tmp_path))
+        assert r.recovery.recovered_entries == 1
+        assert r.recovery.dropped_entries == 1
+        assert b"\x02" not in r
+        r.close()
+
+    def test_payload_size_mismatch_dropped_on_recovery(self, tmp_path):
+        s = DiskBlockStore(str(tmp_path))
+        s.put(b"\x01", b"payload-full", {})
+        path = s._block_path(b"\x01")
+        s.close()
+        with open(path, "wb") as f:  # atomic-ok: test simulates a truncated payload file
+            f.write(b"pay")
+        r = DiskBlockStore(str(tmp_path))
+        assert r.recovery.dropped_entries == 1
+        assert b"\x01" not in r
+        r.close()
+
+    def test_corrupt_payload_raises_typed_error_on_get(self, tmp_path):
+        """Same-size bit rot passes the recovery size check but MUST
+        fail the blake2b verification on read."""
+        s = DiskBlockStore(str(tmp_path))
+        s.put(b"\x01", b"payload-full", {})
+        with open(s._block_path(b"\x01"), "wb") as f:  # atomic-ok: test plants same-size corruption
+            f.write(b"pAyload-full")
+        with pytest.raises(StoreCorruptionError, match="integrity"):
+            s.get(b"\x01")
+        s.close()
+
+    def test_budget_and_pop_lru(self, tmp_path):
+        s = DiskBlockStore(str(tmp_path), max_bytes=10)
+        s.put(b"\x01", b"x" * 8, {})
+        s.put(b"\x02", b"y" * 8, {})
+        assert s.over_budget
+        key, payload, _ = s.pop_lru()
+        assert key == b"\x01" and payload == b"x" * 8
+        assert not s.over_budget
+        s.close()
+
+    def test_close_is_idempotent_and_fences_writes(self, tmp_path):
+        s = DiskBlockStore(str(tmp_path))
+        s.put(b"\x01", b"one", {})
+        assert not s.closed
+        s.close()
+        s.close()                              # idempotent
+        assert s.closed
+        with pytest.raises(StoreCorruptionError, match="closed"):
+            s.put(b"\x02", b"two", {})
+
+    def test_close_releases_the_journal_fd(self, tmp_path):
+        n0 = len(os.listdir("/proc/self/fd"))
+        s = DiskBlockStore(str(tmp_path))
+        assert len(os.listdir("/proc/self/fd")) == n0 + 1
+        s.put(b"\x01", b"one", {})
+        s.close()
+        assert len(os.listdir("/proc/self/fd")) == n0
+
+    def test_journal_records_are_one_json_per_line(self, tmp_path):
+        s = DiskBlockStore(str(tmp_path), fsync_every=1)
+        s.put(b"\x01", b"one", {"codec": "none"})
+        s.delete(b"\x01")
+        s.close()
+        with open(s.index_path) as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+        assert [r["rec"] for r in recs] == ["put", "del"]
+        assert recs[0]["size"] == 3 and recs[0]["meta"] == \
+            {"codec": "none"}
+
+
+@pytest.mark.fault
+class TestIoEnvelope:
+
+    def test_transient_ioerror_is_retried(self, tmp_path):
+        """One injected I/O error inside the retry budget: the write
+        succeeds on the re-attempt, nothing propagates."""
+        s = DiskBlockStore(str(tmp_path), backoff_seconds=0.0)
+        with fault_injector.inject("store.write:ioerror"):
+            s.put(b"\x01", b"one", {})
+        assert s.get(b"\x01")[0] == b"one"
+        s.close()
+
+    def test_persistent_ioerror_exhausts_retries(self, tmp_path):
+        s = DiskBlockStore(str(tmp_path), retries=2,
+                           backoff_seconds=0.0)
+        with fault_injector.inject("store.write:ioerror@0xinf"):
+            with pytest.raises(InjectedIOError):
+                s.put(b"\x01", b"one", {})
+        # the failed put left no entry (journal-first is recover-safe,
+        # the in-memory index only commits after both writes)
+        assert b"\x01" not in s
+        s.close()
+
+    def test_deadline_exhaustion_is_typed_non_retryable(self, tmp_path):
+        """A wall-clock deadline crossing between attempts surfaces as
+        StoreCorruptionError — NOT an OSError, so the retry loop stops
+        instead of spinning on a dead tier."""
+        s = DiskBlockStore(str(tmp_path), retries=50,
+                           backoff_seconds=0.05,
+                           deadline_seconds=0.01)
+        with fault_injector.inject("store.read:ioerror@0xinf"):
+            s.put(b"\x01", b"one", {})  # write path unaffacted by spec
+            with pytest.raises(StoreCorruptionError, match="deadline"):
+                s.get(b"\x01")
+        s.close()
+
+    def test_targeted_spec_hits_only_the_named_tier(self, tmp_path):
+        """The drills aim at one tier: ``store.write@disk:...`` must
+        not trip the DRAM store's writes (fired with detail='dram')."""
+        disk = DiskBlockStore(str(tmp_path), retries=0,
+                              backoff_seconds=0.0)
+        dram = HostBlockStore(0, retries=0)
+        with fault_injector.inject("store.write@disk:ioerror"):
+            dram.put(b"\x01", b"one", {})      # unaffected
+            with pytest.raises(OSError):
+                disk.put(b"\x01", b"one", {})
+        assert b"\x01" in dram and b"\x01" not in disk
+        disk.close()

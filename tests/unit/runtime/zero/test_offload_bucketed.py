@@ -94,6 +94,7 @@ def test_bucketed_bit_identical_to_per_leaf(eight_devices, grad_dtype,
                                       np.asarray(f1[i]))
 
 
+@pytest.mark.slow  # tier-1 diet (ISSUE 16): bit-identical parity smoke stays
 def test_bucket_counters_reported_and_bounded(eight_devices):
     """The decomposition carries the per-bucket counters, the schedule
     respects the ceil(stream_bytes/bucket) bound, and fuses many

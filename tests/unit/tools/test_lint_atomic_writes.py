@@ -1,0 +1,134 @@
+"""tools/lint_atomic_writes.py: bare write-mode ``open()`` calls (and
+writer helpers into inline opens) are flagged as torn-file hazards,
+the ``# atomic-ok:`` annotation escapes with a reason, append-only
+``os.open`` journal fds are exempt by construction, and the shipped
+package is clean under the lint."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..", "..",
+    "tools"))
+from lint_atomic_writes import scan_file  # noqa: E402
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "..", "..", "..")
+
+
+def _scan(tmp_path, src, rel="deepspeed_tpu/mod.py"):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(src))
+    return scan_file(str(p), rel)
+
+
+def test_bare_write_open_flagged(tmp_path):
+    v = _scan(tmp_path, """
+        def save(path, blob):
+            with open(path, "wb") as f:
+                f.write(blob)
+    """)
+    assert len(v) == 1 and "'wb'" in v[0][2]
+
+
+def test_read_open_passes(tmp_path):
+    v = _scan(tmp_path, """
+        def load(path):
+            with open(path) as f:
+                return f.read()
+
+        def load_b(path):
+            with open(path, "rb") as f:
+                return f.read()
+    """)
+    assert v == []
+
+
+def test_dynamic_mode_is_suspicious(tmp_path):
+    v = _scan(tmp_path, """
+        def save(path, mode):
+            with open(path, mode) as f:
+                f.write(b"")
+    """)
+    assert len(v) == 1 and "'?'" in v[0][2]
+
+
+def test_annotation_on_the_call_line_escapes(tmp_path):
+    v = _scan(tmp_path, """
+        def save(path, blob):
+            with open(path, "wb") as f:  # atomic-ok: scratch file
+                f.write(blob)
+    """)
+    assert v == []
+
+
+def test_annotation_on_another_line_does_not_escape(tmp_path):
+    """The annotation must sit ON the flagged call's line — a stray
+    comment above it doesn't vouch for anything."""
+    v = _scan(tmp_path, """
+        def save(path, blob):
+            # atomic-ok: scratch file
+            with open(path, "wb") as f:
+                f.write(blob)
+    """)
+    assert len(v) == 1
+
+
+def test_writer_helper_into_inline_open_flagged(tmp_path):
+    v = _scan(tmp_path, """
+        import json
+        import numpy as np
+
+        def save(path, obj, arr):
+            json.dump(obj, open(path, "w"))
+            np.save(open(path + ".npy", "wb"), arr)
+    """)
+    # each line carries TWO hazards: the inline open itself and the
+    # writer pouring into it
+    assert len(v) == 4
+
+
+def test_writer_into_existing_handle_is_the_openers_problem(tmp_path):
+    v = _scan(tmp_path, """
+        import json
+
+        def save(f, obj):
+            json.dump(obj, f)
+    """)
+    assert v == []
+
+
+def test_os_open_append_journal_is_exempt(tmp_path):
+    """Append-only journal fds are the crash-safe primitive the
+    stores build on — ``os.open(...O_APPEND)`` isn't a plain open()
+    and must pass unflagged."""
+    v = _scan(tmp_path, """
+        import os
+
+        def open_journal(path):
+            return os.open(path, os.O_WRONLY | os.O_CREAT |
+                           os.O_APPEND, 0o644)
+    """)
+    assert v == []
+
+
+def test_integrity_module_is_exempt(tmp_path):
+    v = _scan(tmp_path, """
+        def atomic_write_bytes(path, writer):
+            with open(path + ".tmp", "wb") as f:
+                writer(f)
+    """, rel="deepspeed_tpu/resilience/integrity.py")
+    assert v == []
+
+
+def test_package_is_clean():
+    """The shipped tree passes its own lint (annotated escapes and
+    the integrity module aside) — the CI wiring the README documents."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "lint_atomic_writes.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "clean" in out.stdout

@@ -92,7 +92,7 @@ TRACKED_CONFIGS = ("7_frontend", "8_fleet")
 # the key's introduction compare clean — same arming rule as
 # TRACKED_CONFIGS, applied one level down.
 TRACKED_DECOMP_KEYS = {"5": ("speculation",),
-                       "7_frontend": ("speculation",),
+                       "7_frontend": ("speculation", "cache"),
                        "8_fleet": ("transport", "bootstrap")}
 
 # absolute vs_baseline floors: once a config's LINEAGE has cleared

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Repo lint: persistent-file writes must go through the atomic
+helpers.
+
+A bare ``open(path, "wb")`` (or ``np.save``/``json.dump`` straight to
+a final path) can crash mid-write and leave a torn file under the
+name readers trust — exactly the corruption class the checkpoint
+store, the fleet journal and the tiered block store were built to
+survive. Those layers route every durable write through
+``resilience/integrity.py`` (tmp + flush + fsync + rename), and this
+lint keeps new code from quietly regressing the discipline:
+
+* every ``open(..., mode)`` call whose mode writes bytes or text
+  (``w``/``wb``/``w+``/``a`` with ``b``, etc.) in ``deepspeed_tpu/``
+  must live either in the integrity module itself, inside a function
+  whose name marks it as a tmp/scratch writer, or carry a
+  ``# atomic-ok: <why>`` annotation on the call line;
+* ``np.save``/``np.savez``/``pickle.dump``/``json.dump`` writing
+  through a file object are traced to the same rule via their
+  enclosing call line;
+* append-mode journal fds opened via ``os.open(...O_APPEND...)`` are
+  exempt by construction: appends are the crash-safe primitive the
+  journals build on (a torn TAIL is tolerated by replay; renames
+  can't express appends).
+
+Legitimate escapes and what to write:
+  ``# atomic-ok: scratch file, re-created every run``
+  ``# atomic-ok: append-only journal, torn tail tolerated by replay``
+
+Usage: python tools/lint_atomic_writes.py [root_dir]
+Exit code 0 = clean, 1 = violations found.
+"""
+
+import ast
+import os
+import sys
+
+_ANNOTATION = "# atomic-ok:"
+# modules whose whole purpose is the atomic/tmp write machinery
+_EXEMPT_FILES = ("resilience/integrity.py",)
+# writer helpers like np.save(f, ...) / pickle.dump(obj, f) — flagged
+# only when their file argument is a direct open(...) call (writing
+# into an already-open handle is the handle's opener's problem)
+_WRITER_FUNCS = {"save", "savez", "savez_compressed", "dump"}
+
+
+def _iter_py(root):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for f in filenames:
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def _mode_writes(mode: str) -> bool:
+    return any(c in mode for c in "wax+") and "r" not in mode.split(
+        "+")[0].replace("b", "")
+
+
+def _open_mode(node):
+    """The literal mode of an ``open(...)`` call, or None when the
+    call isn't a plain open / the mode is dynamic."""
+    fn = node.func
+    is_open = (isinstance(fn, ast.Name) and fn.id == "open") or \
+        (isinstance(fn, ast.Attribute) and fn.attr == "open"
+         and isinstance(fn.value, ast.Name) and fn.value.id == "io")
+    if not is_open:
+        return None
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return "?"   # dynamic mode: treat as suspicious
+
+
+def scan_file(path, rel):
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, f"syntax error: {e.msg}")]
+    if any(rel.endswith(x) for x in _EXEMPT_FILES):
+        return []
+    lines = src.splitlines()
+    violations = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) \
+            else ""
+        if _ANNOTATION in line:
+            continue
+        mode = _open_mode(node)
+        if mode is not None and (mode == "?" or _mode_writes(mode)):
+            violations.append(
+                (path, node.lineno,
+                 f"open(..., {mode!r}) writes to a path directly; "
+                 "route durable writes through resilience/integrity "
+                 f"helpers or annotate '{_ANNOTATION} <why>'"))
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and \
+                fn.attr in _WRITER_FUNCS and node.args:
+            target = node.args[1] if fn.attr == "dump" and \
+                len(node.args) > 1 else node.args[0]
+            if isinstance(target, ast.Call) and \
+                    _open_mode(target) is not None:
+                violations.append(
+                    (path, node.lineno,
+                     f"{fn.attr}() into an inline open(): torn-file "
+                     "hazard; use the integrity helpers or annotate "
+                     f"'{_ANNOTATION} <why>'"))
+    return violations
+
+
+def main(root=None):
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = root or os.path.join(os.path.dirname(here), "deepspeed_tpu")
+    violations = []
+    base = os.path.dirname(root.rstrip(os.sep))
+    for path in sorted(_iter_py(root)):
+        violations.extend(
+            scan_file(path, os.path.relpath(path, base)))
+    for path, lineno, msg in violations:
+        print(f"{path}:{lineno}: {msg}")
+    if violations:
+        print(f"\n{len(violations)} atomic-write violation(s).")
+        return 1
+    print("atomic-write lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))
