@@ -128,6 +128,11 @@ class ServingFrontend:
             # seeded tiers must survive into the serving front-end
             # exactly like the flat cache does
             from .tiered import TieredPrefixCache
+            if engine.prefix_cache is not None:
+                # the flat trie holds one allocator incref per cached
+                # block — clear() releases them, or every block cached
+                # before the swap leaks for the life of the pool
+                engine.prefix_cache.clear()
             engine.prefix_cache = TieredPrefixCache(
                 engine._config.kv_block_size,
                 engine._state_manager.kv.allocator,

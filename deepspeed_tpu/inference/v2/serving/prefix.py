@@ -223,7 +223,8 @@ class PrefixCache:
         return sorted((d for d in self._entries if d not in parents),
                       key=lambda d: self._entries[d].tick)
 
-    def _evict(self, count: int = 0, need_free: int = 0) -> int:
+    def _evict(self, count: int = 0, need_free: int = 0,
+               exclude=None) -> int:
         """Leaf-first LRU eviction, two modes:
 
         * ``count`` (the ``max_blocks`` size bound): evict that many
@@ -236,6 +237,13 @@ class PrefixCache:
           ``need_free`` blocks returned to the free list or no
           reclaimable leaf remains.
 
+        ``exclude`` is a digest set that must never be picked as a
+        victim — the tiered cache's in-flight match walk: an entry it
+        already matched holds a block the caller will adopt, but its
+        pool refcount is still 1 (adoption increfs only after
+        ``match`` returns), so evicting it would hand a block on the
+        returned list back to the free pool.
+
         Returns blocks returned to the free list."""
         freed = 0
         evicted = 0
@@ -245,6 +253,8 @@ class PrefixCache:
             if need_free and freed >= need_free:
                 break
             leaves = self._leaves()
+            if exclude:
+                leaves = [d for d in leaves if d not in exclude]
             if need_free:
                 leaves = [d for d in leaves
                           if self.allocator.refcount(

@@ -23,7 +23,10 @@ The robustness headline — why this is safe to turn on:
   ``cache.promote``, ``store.write``, ``store.read``) firing BEFORE
   the corresponding state change, inside the store's retry envelope;
 * a failed demotion leaves the entry intact in its old tier — no torn
-  state, the block is simply still hot;
+  state, the block is simply still hot. Under the scheduler's reclaim
+  (``need_free``) a persistently failing spill tier falls back to TRUE
+  eviction instead: the pressure valve must keep freeing pool blocks
+  even when the tier is dead, or serving degrades to overload errors;
 * a failed promotion (corrupt payload, missing file, persistently
   unreadable tier) **degrades to recompute**: the chain walk stops,
   the adopter prefills that span normally (bitwise-identical output —
@@ -93,6 +96,15 @@ class TieredPrefixCache(PrefixCache):
         self.codec = codec
         self.alert_sink = alert_sink
         self._spilled: Dict[bytes, _SpilledEntry] = {}
+        # parent digest -> spilled child digests, kept in lockstep
+        # with _spilled so a subtree purge walks only the subtree
+        # instead of scanning every spilled entry per frontier node
+        self._spill_children: Dict[bytes, set] = {}
+        # digests touched by the match walk currently in flight: their
+        # blocks are on the list match() will return but are NOT yet
+        # increfed by the adopter, so mid-walk eviction (a promotion
+        # displacing a colder block) must never pick them as victims
+        self._walk_guard: frozenset = frozenset()
         self._quarantine: Dict[bytes, bool] = {}  # insertion-ordered
         # tier-crossing stats (rides get_serving_report()["prefix"])
         self.demoted_blocks = 0
@@ -145,22 +157,33 @@ class TieredPrefixCache(PrefixCache):
         blocks: List[int] = []
         parent = _ROOT
         self._tick += 1
-        for i in range(n_max):
-            d = self._digest(parent, tokens[i * bs:(i + 1) * bs])
-            e = self._entries.get(d)
-            if e is not None:
-                e.tick = self._tick
-                blocks.append(e.block)
+        # every digest this walk hands out is shielded from eviction
+        # until the walk ends: its block is on the returned list but
+        # the adopter's incref only lands AFTER match() returns, so a
+        # promotion's make-room eviction could otherwise free it
+        guard = set()
+        self._walk_guard = guard
+        try:
+            for i in range(n_max):
+                d = self._digest(parent, tokens[i * bs:(i + 1) * bs])
+                e = self._entries.get(d)
+                if e is not None:
+                    e.tick = self._tick
+                    blocks.append(e.block)
+                    guard.add(d)
+                    parent = d
+                    continue
+                s = self._spilled.get(d)
+                if s is None or d in self._quarantine:
+                    break
+                blk = self._promote(d, s)
+                if blk is None:
+                    break
+                blocks.append(blk)
+                guard.add(d)
                 parent = d
-                continue
-            s = self._spilled.get(d)
-            if s is None or d in self._quarantine:
-                break
-            blk = self._promote(d, s)
-            if blk is None:
-                break
-            blocks.append(blk)
-            parent = d
+        finally:
+            self._walk_guard = frozenset()
         n_tokens = len(blocks) * bs
         if n_tokens:
             self.hits += 1
@@ -205,7 +228,7 @@ class TieredPrefixCache(PrefixCache):
         # state change only after the scatter landed: the digest moves
         # to the HBM trie, the spilled payload is retired (one tier)
         self._entries[d] = _Entry(block, s.parent, self._tick)
-        self._spilled.pop(d, None)
+        self._spill_remove(d)
         try:
             store.delete(d)
         except _SPILL_FAILURES:
@@ -239,13 +262,25 @@ class TieredPrefixCache(PrefixCache):
                         f"{str(exc)[:120]}"))
 
     # -- eviction becomes demotion --------------------------------------
-    def _evict(self, count: int = 0, need_free: int = 0) -> int:
+    def _evict(self, count: int = 0, need_free: int = 0,
+               exclude=None) -> int:
         """Leaf-first LRU as in the base class, but a victim is
         DEMOTED to the DRAM tier instead of evicted. A failed demotion
         leaves the entry intact in HBM (counted, skipped for this
-        pass) — the drill contract for ``store.write`` faults."""
+        pass) — the drill contract for ``store.write`` faults — EXCEPT
+        under ``need_free``: the scheduler's pressure valve must free
+        pool blocks even with a dead spill tier, so demote failures
+        there fall back to TRUE eviction of the remaining victims
+        (the entry is dropped whole — nothing torn, the prefix just
+        recomputes later). ``count`` mode never falls back: the size
+        bound is soft, the entry stays hot and the next pass retries.
+        """
+        guard = self._walk_guard
+        if exclude:
+            guard = guard | set(exclude)
         if self.dram is None:
-            return super()._evict(count=count, need_free=need_free)
+            return super()._evict(count=count, need_free=need_free,
+                                  exclude=guard)
         freed = 0
         demoted = 0
         failed = set()
@@ -254,7 +289,8 @@ class TieredPrefixCache(PrefixCache):
                 break
             if need_free and freed >= need_free:
                 break
-            leaves = [d for d in self._leaves() if d not in failed]
+            leaves = [d for d in self._leaves()
+                      if d not in failed and d not in guard]
             if need_free:
                 leaves = [d for d in leaves
                           if self.allocator.refcount(
@@ -269,6 +305,9 @@ class TieredPrefixCache(PrefixCache):
             else:
                 failed.add(d)
                 self.demote_failures += 1
+        if need_free and freed < need_free and failed:
+            freed += super()._evict(need_free=need_free - freed,
+                                    exclude=guard)
         return freed
 
     def _demote(self, d: bytes) -> Tuple[bool, int]:
@@ -289,7 +328,7 @@ class TieredPrefixCache(PrefixCache):
         before = self.allocator.free_blocks
         self.allocator.free([e.block])
         freed = self.allocator.free_blocks - before
-        self._spilled[d] = _SpilledEntry("dram", e.parent, e.tick)
+        self._spill_add(d, _SpilledEntry("dram", e.parent, e.tick))
         self.demoted_blocks += 1
         if self.journal is not None:
             self.journal.append(("tier", d, "dram"))
@@ -324,9 +363,27 @@ class TieredPrefixCache(PrefixCache):
                 break
             self._drop_spilled(popped[0], in_store=False)
 
+    # -- spilled-state bookkeeping --------------------------------------
+    # _spilled and _spill_children mutate ONLY through this pair so the
+    # parent->children index can never drift from the entry map
+    def _spill_add(self, d: bytes, s: _SpilledEntry) -> None:
+        self._spilled[d] = s
+        self._spill_children.setdefault(s.parent, set()).add(d)
+
+    def _spill_remove(self, d: bytes) -> Optional[_SpilledEntry]:
+        s = self._spilled.pop(d, None)
+        if s is None:
+            return None
+        kids = self._spill_children.get(s.parent)
+        if kids is not None:
+            kids.discard(d)
+            if not kids:
+                self._spill_children.pop(s.parent, None)
+        return s
+
     # -- true eviction of spilled state ---------------------------------
     def _drop_spilled(self, d: bytes, in_store: bool = True) -> None:
-        if self._spilled.pop(d, None) is None:
+        if self._spill_remove(d) is None:
             return
         self.spill_evicted_blocks += 1
         if in_store:
@@ -345,14 +402,15 @@ class TieredPrefixCache(PrefixCache):
         unreachable (the chain walk can never pass their parent) —
         retire them so the stores don't hold dead payloads. HBM
         descendants stay: they hold live pool references and the
-        leaf-first LRU will demote/evict them in due course."""
+        leaf-first LRU will demote/evict them in due course. Walks the
+        parent->children index, so cost is proportional to the subtree
+        being purged, not to the whole spilled population."""
         frontier = [d]
         while frontier:
             p = frontier.pop()
-            kids = [k for k, s in self._spilled.items()
-                    if s.parent == p]
-            for k in kids:
-                self._spilled.pop(k, None)
+            for k in list(self._spill_children.get(p, ())):
+                if self._spill_remove(k) is None:
+                    continue
                 self.spill_evicted_blocks += 1
                 for store in (self.dram, self.disk):
                     if store is not None and k in store:
@@ -362,7 +420,7 @@ class TieredPrefixCache(PrefixCache):
                             pass
                 if self.journal is not None:
                     self.journal.append(("del", k))
-            frontier.extend(kids)
+                frontier.append(k)
 
     # -- insert: a fresh live block supersedes a spilled copy ----------
     def insert(self, tokens: np.ndarray, blocks: List[int]) -> int:
@@ -378,7 +436,7 @@ class TieredPrefixCache(PrefixCache):
                 # quarantine: fresh data, nothing suspect about it)
                 self._quarantine.pop(d, None)
                 if d in self._spilled:
-                    self._spilled.pop(d)
+                    self._spill_remove(d)
                     for store in (self.dram, self.disk):
                         if store is not None and d in store:
                             try:
@@ -398,6 +456,7 @@ class TieredPrefixCache(PrefixCache):
             if self._entries else 0
         for d in list(self._spilled):
             self._drop_spilled(d)
+        self._spill_children.clear()
         self._quarantine.clear()
         return freed
 

@@ -280,7 +280,11 @@ class DiskBlockStore:
     mid-2 leaves no file under the final name (tmp+rename). The
     journal fd is HELD open (single O_APPEND writes) — ``close()``
     must release it, which is exactly what the engine-close lifecycle
-    test asserts.
+    test asserts. Once dead records outnumber live entries
+    ``COMPACT_DEAD_RATIO``-fold (past a ``COMPACT_MIN_RECORDS``
+    floor), the journal is compacted — atomically rewritten as live
+    entries only — so churny workloads don't grow it, or the next
+    ``recover()``'s replay, without bound.
     """
 
     tier = "disk"
@@ -304,10 +308,12 @@ class DiskBlockStore:
         self.gets = 0
         self._since_sync = 0
         self._journal_records = 0
+        self.compactions = 0
         self.recovery = self.recover()
         self._jfd: Optional[int] = os.open(
             self.index_path,
             os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        self._maybe_compact()
 
     # -- crash recovery -------------------------------------------------
     def recover(self) -> RecoveryReport:
@@ -347,6 +353,10 @@ class DiskBlockStore:
                     rep.errors.append(StoreCorruptionError(
                         f"store index {self.index_path} line {lineno}: "
                         f"{type(e).__name__}: {str(e)[:120]}"))
+            # replayed records count toward the compaction threshold:
+            # a journal bloated by a previous life compacts promptly
+            # instead of growing from its inherited size
+            self._journal_records = lineno
         # verify each surviving entry's payload actually landed — a
         # journal record without its file is the crash-mid-put window
         for key, ent in list(live.items()):
@@ -394,6 +404,45 @@ class DiskBlockStore:
                 os.fsync(self._jfd)
                 self._since_sync = 0
 
+    # an append-only journal grows with CHURN, not contents — bound it
+    # by rewriting live entries once dead records dominate (and only
+    # past a floor, so small stores never pay the rewrite)
+    COMPACT_MIN_RECORDS = 512
+    COMPACT_DEAD_RATIO = 4
+
+    def _maybe_compact(self) -> None:
+        if self._journal_records >= self.COMPACT_MIN_RECORDS and \
+                self._journal_records > self.COMPACT_DEAD_RATIO * \
+                max(1, len(self._entries)):
+            self._compact()
+
+    def _compact(self) -> None:
+        """Atomically rewrite the journal as one live ``put`` record
+        per entry (tmp + fsync + rename — a kill leaves the old
+        journal or the compacted one, both replayable), then reopen
+        the append fd on the new file. Bounds both journal size and
+        the next ``recover()``'s replay time."""
+        if self._jfd is None:
+            return
+
+        def write(f):
+            for key, ent in self._entries.items():
+                f.write(json.dumps(
+                    {"rec": "put", "k": key.hex(),
+                     "size": ent["size"], "b2": ent["b2"],
+                     "meta": ent["meta"]},
+                    separators=(",", ":"), sort_keys=True
+                ).encode() + b"\n")
+
+        atomic_write_bytes(self.index_path, write)
+        os.close(self._jfd)
+        self._jfd = os.open(self.index_path,
+                            os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                            0o644)
+        self._journal_records = len(self._entries)
+        self._since_sync = 0
+        self.compactions += 1
+
     # -- the store contract ---------------------------------------------
     def __contains__(self, key: bytes) -> bool:
         return key in self._entries
@@ -409,13 +458,16 @@ class DiskBlockStore:
         payload = bytes(payload)
         b2 = _blake2b_hex(payload)
         with span("store.write", tier=self.tier, bytes=len(payload)):
+            # journal FIRST (write-ahead), payload second: every crash
+            # interleaving is a recover() case, never a silently-served
+            # torn block. Appended OUTSIDE the retry envelope — inside
+            # it, every re-attempt would append a duplicate record and
+            # a retried workload would bloat the journal.
+            self._journal_append(
+                {"rec": "put", "k": key.hex(), "size": len(payload),
+                 "b2": b2, "meta": meta})
+
             def write():
-                # journal FIRST (write-ahead), payload second: every
-                # crash interleaving is a recover() case, never a
-                # silently-served torn block
-                self._journal_append(
-                    {"rec": "put", "k": key.hex(), "size": len(payload),
-                     "b2": b2, "meta": meta})
                 atomic_write_bytes(self._block_path(key),
                                    lambda f: f.write(payload))
 
@@ -428,6 +480,7 @@ class DiskBlockStore:
                                   "meta": dict(meta)}
             self.used_bytes += len(payload)
             self.puts += 1
+            self._maybe_compact()
 
     def get(self, key: bytes) -> Tuple[bytes, Dict]:
         ent = self._entries.get(key)
@@ -460,6 +513,7 @@ class DiskBlockStore:
             os.unlink(self._block_path(key))
         except OSError:
             pass  # the journal del already retired it for recovery
+        self._maybe_compact()
 
     def pop_lru(self) -> Optional[Tuple[bytes, bytes, Dict]]:
         """Coldest (key, payload, meta), removed from the store. The
@@ -484,7 +538,10 @@ class DiskBlockStore:
     def close(self) -> None:
         """Release the held journal fd (idempotent). The PR 6 rule:
         every held OS resource has a close, and engine.close() reaches
-        it."""
+        it. A churn-bloated journal is compacted on the way out so the
+        next open's replay starts from live entries only."""
+        if self._jfd is not None:
+            self._maybe_compact()
         fd, self._jfd = self._jfd, None
         if fd is not None:
             try:
@@ -497,4 +554,6 @@ class DiskBlockStore:
         return {"root": self.root, "entries": len(self._entries),
                 "used_bytes": self.used_bytes, "puts": self.puts,
                 "gets": self.gets, "closed": self.closed,
+                "journal_records": self._journal_records,
+                "compactions": self.compactions,
                 "recovery": self.recovery.as_dict()}

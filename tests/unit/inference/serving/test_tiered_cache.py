@@ -115,6 +115,39 @@ class TestSpillAndReadopt:
         assert pc.resident_tier(d1) == "hbm"
         a.free(hold)
 
+    def test_promotion_never_displaces_a_block_already_matched(self):
+        """The mid-walk hazard: the entry matched immediately before a
+        spilled child is a refcount-1 leaf (the adopter's incref lands
+        only AFTER match returns), so the promotion's make-room
+        eviction could pick it — freeing a pool block that is already
+        on the list match() will hand back, letting the promotion
+        scatter (or another sequence) overwrite KV the adopter then
+        attends over. The walk guard must force a capacity stop
+        instead."""
+        pc, a, kv = _tiered(n_blocks=2)
+        prompt, _ = _chain(pc, a, kv, 0, n_blocks=2)
+        pc._evict(count=1)                  # the leaf child -> dram
+        d0, d1 = chain_digests(prompt, BS)
+        assert pc.resident_tier(d0) == "hbm"
+        assert pc.resident_tier(d1) == "dram"
+        hold = a.allocate(1)                # soak the freed block
+        assert a.free_blocks == 0
+        blocks, n = pc.match(prompt)
+        # no room to promote the child without evicting the matched
+        # parent: capacity stop — the parent serves, INTACT
+        assert n == BS and len(blocks) == 1
+        assert pc.resident_tier(d0) == "hbm"
+        assert blocks[0] == pc._entries[d0].block
+        assert a.refcount(blocks[0]) == 1   # never freed mid-match
+        assert np.array_equal(kv.data[blocks[0]],
+                              np.full((2, 2, BS, 2), 0, np.float32))
+        assert pc.resident_tier(d1) == "dram"   # survived the stop
+        a.free(hold)
+        blocks, n = pc.match(prompt)        # room again: full adopt
+        assert n == 2 * BS
+        assert np.array_equal(kv.data[blocks[1]],
+                              np.full((2, 2, BS, 2), 1, np.float32))
+
     def test_interior_parent_promotes_before_its_child(self):
         """A 2-block chain demoted leaf-first then fully re-adopted:
         the walk promotes parent and child in chain order."""
@@ -334,6 +367,28 @@ class TestServingBitwiseGate:
             assert "cache/spilled_blocks" in sample
         finally:
             fe.close()
+
+    def test_tier_swap_releases_the_flat_caches_blocks(
+            self, params_cfg):
+        """A flat trie armed before the tiered swap holds one
+        allocator incref per cached block; the swap must clear() it or
+        those blocks never return to the free list for the life of the
+        process (the warmup-then-serve leak)."""
+        eng = _engine(params_cfg)
+        fe1 = ServingFrontend(eng, {"prefix": {"enabled": True}})
+        _serve_serial(fe1, dict(list(_requests().items())[:2]))
+        flat = eng.prefix_cache
+        assert not isinstance(flat, TieredPrefixCache)
+        assert flat.cached_blocks > 0
+        fe2 = ServingFrontend(eng, _tiers_cfg())
+        try:
+            assert isinstance(eng.prefix_cache, TieredPrefixCache)
+            assert flat.cached_blocks == 0      # refs released
+            # nothing leaked: with no live sequences every pool block
+            # is back on the free list
+            assert eng.free_blocks == eng._config.n_kv_blocks
+        finally:
+            fe2.close()
 
     def test_warmed_tiered_cache_survives_a_second_frontend(
             self, params_cfg):

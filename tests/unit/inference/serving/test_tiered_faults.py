@@ -79,15 +79,24 @@ class TestDemoteFaultDrills:
         assert pc.demote_failures == 1 and pc.demoted_blocks == 1
         assert pc.cached_blocks == 2             # bound still honored
 
-    def test_failed_demotion_under_reclaim_frees_nothing_torn(self):
-        """need_free + dead store: reclaim returns 0 instead of
-        freeing a block whose payload never landed."""
+    def test_failed_demotion_under_reclaim_falls_back_to_eviction(
+            self):
+        """need_free + dead store: the scheduler's pressure valve must
+        still free pool blocks — demotion failure falls back to TRUE
+        eviction (the entry dropped whole and counted as a reclaim
+        eviction, its payload never half-landed anywhere), never to a
+        reclaim that frees 0 forever while serving degrades to
+        overload errors."""
         pc, a, kv = _tiered()
         _chain(pc, a, kv, 0)
         pc.dram._io.retries = 0
         with fault_injector.inject("store.write:kill@0xinf"):
-            assert pc.reclaim(1) == 0
-        assert pc.cached_blocks == 1 and pc.spilled_blocks == 0
+            assert pc.reclaim(1) == 1
+        assert pc.cached_blocks == 0 and pc.spilled_blocks == 0
+        assert pc.demote_failures == 1 and len(pc.dram) == 0
+        st = pc.stats()
+        assert st["evicted_reclaim"] == 1 and st["demoted_blocks"] == 0
+        assert a.free_blocks == 16          # actually back in the pool
 
 
 class TestPromoteFaultDrills:
@@ -156,6 +165,7 @@ class TestPromoteFaultDrills:
         assert pc.degraded == 1
         assert pc.spilled_blocks == 0        # subtree purged with it
         assert len(pc.dram) == 0
+        assert not pc._spill_children        # the index emptied too
 
 
 class TestCrashRecoveryDrill:

@@ -251,6 +251,30 @@ class TestDiskBlockStore:
         assert recs[0]["size"] == 3 and recs[0]["meta"] == \
             {"codec": "none"}
 
+    def test_journal_compacts_past_dead_record_threshold(
+            self, tmp_path):
+        """Churn (put/del pairs) grows an append-only journal without
+        bound and slows every future ``recover()`` replay; once dead
+        records dominate, the journal is atomically rewritten as live
+        entries only — and the compacted journal replays identically."""
+        s = DiskBlockStore(str(tmp_path))
+        s.put(b"\xaa", b"keeper", {"m": 1})
+        n_appends = 1
+        for i in range(DiskBlockStore.COMPACT_MIN_RECORDS + 100):
+            s.put(b"\x01", b"x" * 8, {})
+            s.delete(b"\x01")
+            n_appends += 2
+        assert s.compactions >= 1
+        s.close()
+        with open(s.index_path) as f:
+            n_lines = sum(1 for line in f if line.strip())
+        assert n_lines < n_appends // 2     # bounded by churn, not ops
+        r = DiskBlockStore(str(tmp_path))
+        assert r.recovery.corrupt_records == 0
+        assert r.recovery.recovered_entries == 1
+        assert r.get(b"\xaa")[0] == b"keeper" and b"\x01" not in r
+        r.close()
+
 
 @pytest.mark.fault
 class TestIoEnvelope:
@@ -263,6 +287,20 @@ class TestIoEnvelope:
             s.put(b"\x01", b"one", {})
         assert s.get(b"\x01")[0] == b"one"
         s.close()
+
+    def test_retried_put_appends_one_journal_record(self, tmp_path):
+        """The write-ahead record lands OUTSIDE the retry envelope:
+        two failed attempts before the success must not leave three
+        identical put records bloating the journal."""
+        s = DiskBlockStore(str(tmp_path), backoff_seconds=0.0,
+                           fsync_every=1)
+        with fault_injector.inject("store.write:ioerror@0x2"):
+            s.put(b"\x01", b"one", {})
+        assert s.get(b"\x01")[0] == b"one"
+        s.close()
+        with open(s.index_path) as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+        assert [r["rec"] for r in recs] == ["put"]
 
     def test_persistent_ioerror_exhausts_retries(self, tmp_path):
         s = DiskBlockStore(str(tmp_path), retries=2,
