@@ -96,6 +96,22 @@ class InferenceEngineV2:
             ec.linear_impl, quantized=bits is not None,
             tp_size=ec.tp_size)
         self.moe_impl = instantiate_moe(ec.moe_impl, ep_size=ec.ep_size)
+        # cold-start weight stream: pass a ParamStoreSource (the
+        # training wire's param store, runtime/zero/param_stream.py)
+        # instead of a params tree and the weights stream store ->
+        # device in layer order during init — each group's device_put
+        # is async, so the h2d rides behind pool/pipeline setup
+        # instead of gating step 0 on a resident full-model upload
+        self._param_source = None
+        from ...runtime.zero.param_stream import ParamStoreSource
+        if isinstance(params, ParamStoreSource):
+            self._param_source = params
+            params = params.load_tree()
+            r = self._param_source.report
+            logger.info(
+                f"cold-start weight stream: {r['cold_leaves']} leaves, "
+                f"{r['cold_bytes'] / 1e6:.1f} MB in "
+                f"{r['fetch_ms']:.0f} ms (store -> device)")
         # one-time policy/LayerContainer mapping: family params ->
         # (static arch spec, normalized tree) — reference analog:
         # v2/model_implementations/layer_container_base.py
@@ -833,6 +849,11 @@ class InferenceEngineV2:
         pc = self.prefix_cache
         if pc is not None and hasattr(pc, "close"):
             pc.close()
+        if self._param_source is not None:
+            # cold-start weight source: closes the param store it owns
+            # (a DiskBlockStore's journal fd)
+            self._param_source.close()
+            self._param_source = None
 
     # -- admission control / backpressure -------------------------------
     @property

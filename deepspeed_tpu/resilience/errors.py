@@ -218,6 +218,16 @@ class StoreCorruptionError(ResilienceError):
     cache degrades that block to recompute instead of spinning."""
 
 
+class ParamStreamError(ResilienceError):
+    """The parameter-residency wire (runtime/zero/param_stream.py)
+    failed to make a streamed weight device-resident: a store fetch or
+    fused h2d bucket upload still failing after its retry budget, or a
+    leaf missing from the store entirely. Typed so the trainer halts
+    loudly — a parameter that cannot be fetched must never be replaced
+    by a stale or zero tensor. Checksum mismatches are raised as
+    ``StoreCorruptionError`` instead (retrying cannot fix those)."""
+
+
 class InjectedFault(ResilienceError):
     """A deliberately injected failure (FaultInjector). Base class so
     tests can distinguish injected faults from organic ones."""

@@ -120,6 +120,18 @@ FAULT_SITES = {
         "block store payload read + checksum verify (runtime/store.py "
         "get; detail = tier name); a persistent fault here is the "
         "degrade-to-recompute drill",
+    # ---- parameter-residency wire (runtime/zero/param_stream.py) ----
+    "param.fetch":
+        "param stream: one fire per leaf fetched from the param store "
+        "(detail = leaf name), inside the wire's own retry_io "
+        "envelope ON TOP of the store's — a transient fault retries, "
+        "a persistent one raises typed ParamStreamError (never a "
+        "silently wrong weight; checksum mismatches raise "
+        "StoreCorruptionError unretried)",
+    "param.h2d":
+        "param stream: one fire per fused h2d bucket upload of a "
+        "layer group's staged parameters, inside the retry envelope "
+        "(runtime/zero/param_stream.py _kick_group)",
 }
 
 KNOWN_SITES = tuple(FAULT_SITES)

@@ -272,6 +272,19 @@ class DeepSpeedEngine:
                 f"offload_param.device={zc.offload_param.device!r} "
                 "unsupported; TPU-VM offload targets host DRAM ('cpu'); "
                 "an NVMe tier would layer on the same seam")
+        # ZeRO-Infinity parameter STREAMING (the explicit wire, vs the
+        # memory-kind full swap above): between steps params live in a
+        # tiered block store (DRAM / NVMe) + host mirrors; a per-layer
+        # prefetch ring streams each layer group's fused bucket back to
+        # HBM ahead of the gather (runtime/zero/param_stream.py)
+        self._param_stream = None
+        self._param_stream_cfg = zc.offload_param \
+            if zc.offload_param.enabled else None
+        if self._param_stream_cfg is not None and jax.process_count() > 1:
+            raise NotImplementedError(
+                "offload_param.enabled (param streaming) is "
+                "single-process for now; multi-host would need the "
+                "store partitioned by addressable shard")
 
         # checkpoint engine: validated (and constructed) at init so a
         # config typo fails here, not hours later at the first save
@@ -525,9 +538,25 @@ class DeepSpeedEngine:
                                 global_step=jnp.int32(0),
                                 skipped_steps=jnp.int32(0))
         self._params_initialized = True
+        if self._param_stream_cfg is not None:
+            self._setup_param_stream()
         n_params = tree_parameter_count(master)
         log_dist(f"Engine state initialized: {n_params/1e6:.2f}M params "
                  f"(master fp32 sharded: stage {self.zero_stage})", ranks=[0])
+
+    def _setup_param_stream(self):
+        """Arm the parameter-residency wire over the master tree's
+        streamable leaves (offload-owned leaves excluded — those
+        already re-upload each step through the grad wire). The state
+        keeps holding real arrays throughout: device copies while
+        resident, host-memory-kind mirrors between steps."""
+        from .zero.param_stream import ParamStreamCoordinator
+        master = self.state.master_params
+        names = [n for n, _ in named_leaves(master)]
+        leaves = jax.tree_util.tree_leaves(master)
+        exclude = self._offload.off_idx if self._offload is not None else ()
+        self._param_stream = ParamStreamCoordinator(
+            names, leaves, self._param_stream_cfg, exclude_idx=exclude)
 
     def _setup_offload(self, master):
         """Move the offload-selected leaves' fp32 master + optimizer
@@ -1026,6 +1055,10 @@ class DeepSpeedEngine:
         # probes (soak harness, bench) call lifecycle.memory_gauges()
         # directly for the full census.
         out["process_memory"] = memory_gauges(include_arrays=False)
+        # always-present (stable schema): the param-residency wire's
+        # report, or {"enabled": False} when the wire is off
+        out["param_stream"] = self._param_stream.report() \
+            if self._param_stream is not None else {"enabled": False}
         return out
 
     def _build_telemetry_hub(self, tcfg):
@@ -1995,6 +2028,17 @@ class DeepSpeedEngine:
                     skip=skip, stream=stream_tok, probe=probe)
                 self.state = self.state._replace(master_params=new_master)
                 self._verify_offload_if_armed()
+        if self._param_stream is not None:
+            # residency cycle AFTER the offload submit (a blocking
+            # param drain before the DPU hand-off would serialize the
+            # very overlap DPU buys): stream the step's output params
+            # down to the store, rebind host mirrors, and re-arm the
+            # prefetch ring for the next step's gather. The d2h kicks
+            # inside ride DMA against the still-running device step
+            # (probe = the loss output marks device-done).
+            self.state = self.state._replace(
+                master_params=self._param_stream.cycle(
+                    self.state.master_params, probe=metrics["loss"]))
         self.timers(TRAIN_BATCH_TIMER).stop(sync=True)
         self.tput_timer.stop(global_step=True)
 
@@ -2175,12 +2219,23 @@ class DeepSpeedEngine:
         """(grad D2H, host Adam, param H2D, overlap residue) of the
         newest completed host step, in ms — the audited decomposition
         (VERDICT round 3 item 1)."""
-        if self._offload is None:
+        if self._offload is None and self._param_stream is None:
             return {}
-        out = dict(self._offload.last_breakdown)
-        out["overlap_residue_ms"] = getattr(self, "_offload_wait_ms",
-                                            0.0)
-        out["post_restore_repairs"] = self._offload.repairs
+        if self._offload is not None:
+            out = dict(self._offload.last_breakdown)
+            out["overlap_residue_ms"] = getattr(self, "_offload_wait_ms",
+                                                0.0)
+            out["post_restore_repairs"] = self._offload.repairs
+        else:
+            out = {}
+        if self._param_stream is not None:
+            out.update(self._param_stream.last_breakdown)
+        elif self._offload is not None:
+            # stable schema: the param-stream keys are always present
+            # once ANY offload surface reports (zeros when the wire is
+            # off), so dashboards never key-error across configs
+            from .zero.param_stream import ZERO_BREAKDOWN
+            out.update(ZERO_BREAKDOWN)
         return out
 
     def forward(self, batch):
@@ -2699,6 +2754,10 @@ class DeepSpeedEngine:
             # the mirror tracks the DEVICE leaves; it must follow every
             # state replacement, not just optimizer-state reloads
             self._offload.resync_mirror(self.state.master_params)
+        if self._param_stream is not None:
+            # in-flight prefetched buckets hold PRE-restore bytes;
+            # drop them and reseed the store from the restored leaves
+            self._param_stream.resync(self.state.master_params)
         if self._config.lifecycle_config.invalidate_on_restore:
             # every state leaf was just rebuilt by device_put; the next
             # step must compile against THOSE buffers instead of
@@ -2785,6 +2844,11 @@ class DeepSpeedEngine:
                 # NVMe tier: release the O_DIRECT fd + native IO pool
                 # now, not whenever the cyclic GC reaches __del__
                 self._offload.store.close()
+        if self._param_stream is not None:
+            # releases the host mirror staging, in-flight device
+            # buckets, and the param store (an NVMe tier's journal fd)
+            self._param_stream.close()
+            self._param_stream = None
         self._reset_compiled_steps()
         self.state = None
         self._accum_grads = None
@@ -2825,9 +2889,19 @@ class DeepSpeedEngine:
         self._accum_count = 0
 
     def _swap_state_in(self):
-        """Param-offload swap-in: state host -> device (no-op otherwise).
+        """Make the state device-resident before a compute dispatch:
+        the param-stream gather (wait the prefetched fused buckets,
+        scatter back to leaves — MAIN thread, it dispatches the cached
+        unpack program) and/or the param-offload memory-kind swap-in
+        (mutually exclusive by config validation). No-op otherwise.
         Runs outside jit — see _compile_train_step's offload comment."""
-        if not self._param_offload_host or self.state is None:
+        if self.state is None:
+            return
+        if self._param_stream is not None:
+            gathered = self._param_stream.gather(self.state.master_params)
+            if gathered is not None:
+                self.state = self.state._replace(master_params=gathered)
+        if not self._param_offload_host:
             return
         if not hasattr(self, "_device_state_sh"):
             return  # state not built yet
@@ -2876,6 +2950,10 @@ class DeepSpeedEngine:
             raise RuntimeError(
                 "get_flops_profile: run at least one train_batch first")
         from ..profiling.flops_profiler import cost_analysis_of
+        if self._param_stream is not None:
+            # lower against device-resident leaves — the mirrors'
+            # host placement would change the lowered signature
+            self._swap_state_in()
         # profile the program training actually runs: with compression
         # active, the default static args would lower an unquantized
         # variant and miss the quant/prune ops
@@ -2905,6 +2983,8 @@ class DeepSpeedEngine:
         # a re-lower + text parse of the whole step costs seconds on a
         # real model, and only the aggregation depth varies per call
         if getattr(self, "_module_flops_profile", None) is None:
+            if self._param_stream is not None:
+                self._swap_state_in()
             comp_bits, prune_on = self._compression_eval_args()
             lowered = self._jit_train_step.lower(
                 self.state, self._profile_batch_struct, self._rng,

@@ -118,7 +118,8 @@ class WireGroup:
 
 
 def build_wire_groups(slot_layers: Sequence[Optional[int]],
-                      per_leaf: int) -> List[WireGroup]:
+                      per_leaf: int, forward: bool = False
+                      ) -> List[WireGroup]:
     """Slot groups in expected arrival (backward-completion) order.
 
     ``slot_layers[slot]`` is the layer index parsed from the leaf name
@@ -129,19 +130,30 @@ def build_wire_groups(slot_layers: Sequence[Optional[int]],
     first, embedding last) — form one trailing group. When no leaf
     carries a layer index (toy trees), every slot becomes its own
     group in reverse flatten order — flatten order roughly follows the
-    forward, so its reverse approximates the backward."""
+    forward, so its reverse approximates the backward.
+
+    ``forward=True`` flips the ordering for the param-residency wire's
+    upload direction (zero/param_stream.py): the FORWARD consumes
+    layer 0 first, so layers are ordered ascending with the non-layer
+    group LEADING (embeddings are the first weights the forward
+    touches), and the toy fallback keeps plain flatten order."""
     layers = sorted({l for l in slot_layers if l is not None},
-                    reverse=True)
+                    reverse=not forward)
     if not layers:
-        return [WireGroup(f"slot{s}", [s], per_leaf)
-                for s in range(len(slot_layers) - 1, -1, -1)]
+        order = range(len(slot_layers)) if forward \
+            else range(len(slot_layers) - 1, -1, -1)
+        return [WireGroup(f"slot{s}", [s], per_leaf) for s in order]
     groups = [WireGroup(f"layer{l}",
                         [s for s, sl in enumerate(slot_layers)
                          if sl == l], per_leaf)
               for l in layers]
     rest = [s for s, sl in enumerate(slot_layers) if sl is None]
     if rest:
-        groups.append(WireGroup("rest", rest, per_leaf))
+        rest_group = WireGroup("rest", rest, per_leaf)
+        if forward:
+            groups.insert(0, rest_group)
+        else:
+            groups.append(rest_group)
     return groups
 
 
@@ -205,17 +217,20 @@ class WireClock:
         self._waits.append((t0, t1))
         self._t_last = t1 if self._t_last is None else max(self._t_last, t1)
 
-    def split(self) -> dict:
-        """``d2h_exposed_ms``: blocking wait wall after the device
-        finished (what a perfect wire would save). ``d2h_overlapped_ms``:
+    def split(self, prefix: str = "d2h") -> dict:
+        """``<prefix>_exposed_ms``: blocking wait wall after the device
+        finished (what a perfect wire would save). ``<prefix>_overlapped_ms``:
         the rest of the wire window (kick -> last arrival) — copy time
         absorbed by device compute or pipelined host work. Without a
         probe (or before it lands) every blocking wait counts as
-        exposed — the conservative reading."""
+        exposed — the conservative reading. ``prefix`` renames the keys
+        for clocks attributing other wires (the param-residency wire
+        publishes ``param_d2h_*`` through the same split)."""
         if self.t_kick is None or self._t_last is None:
-            return {"d2h_exposed_ms": 0.0, "d2h_overlapped_ms": 0.0}
+            return {f"{prefix}_exposed_ms": 0.0,
+                    f"{prefix}_overlapped_ms": 0.0}
         done = self.t_done if self.t_done is not None else self.t_kick
         exposed = sum(max(0.0, b - max(a, done)) for a, b in self._waits)
         window = self._t_last - self.t_kick
-        return {"d2h_exposed_ms": exposed * 1e3,
-                "d2h_overlapped_ms": max(0.0, window - exposed) * 1e3}
+        return {f"{prefix}_exposed_ms": exposed * 1e3,
+                f"{prefix}_overlapped_ms": max(0.0, window - exposed) * 1e3}

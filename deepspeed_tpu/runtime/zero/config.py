@@ -37,16 +37,82 @@ class OffloadDeviceEnum(str, Enum):
 
 @dataclasses.dataclass
 class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
-    """reference: runtime/zero/offload_config.py OffloadParamConfig"""
+    """reference: runtime/zero/offload_config.py OffloadParamConfig
+
+    Two distinct mechanisms share this section:
+
+    * ``device: "cpu"`` — the memory-kind full swap: the whole state
+      tree lives in host memory kind and is swapped to device around
+      every compute entry point (the pre-streaming seam).
+    * ``enabled: true`` — the ZeRO-Infinity parameter-residency WIRE
+      (runtime/zero/param_stream.py): between steps the master params
+      live in a tiered block store (DRAM, optionally NVMe), each
+      step's outputs stream d2h into the store and the next step's
+      inputs stream back h2d through fused fixed-size buckets, with a
+      windowed per-layer prefetch ring. Mutually exclusive with
+      ``device: "cpu"`` (pick the swap or the wire, not both).
+    """
     device: str = "none"
     nvme_path: str = None
     buffer_count: int = 5          # [compat]
     buffer_size: int = 100_000_000  # [compat]
     max_in_cpu: int = 1_000_000_000  # [compat]
     pin_memory: bool = False
+    # ---- parameter-residency wire (runtime/zero/param_stream.py) ----
+    enabled: bool = False
+    # where the between-steps authority lives: "dram" = HostBlockStore,
+    # "nvme" = DiskBlockStore rooted at nvme_path (blake2b-verified,
+    # crash-tolerant journal — runtime/store.py)
+    tier: str = "dram"
+    # layer groups kicked h2d ahead of the gather (the between-steps
+    # in-flight window, bounding device residency); 0 = kick every
+    # group at drop time for maximum overlap
+    prefetch: int = 0
+    # fused h2d bucket size; fractional MB allowed (tests force
+    # multi-bucket plans on tiny trees)
+    bucket_mb: float = 64.0
+    # store payload codec: "none" (bitwise round trip — required for
+    # the streamed-vs-resident bitwise contract) or "int8"/"int4"
+    # (opt-in lossy wire compression; runtime/store.py encode_kv)
+    codec: str = "none"
+    # simulated HBM budget for residency accounting/benching: the
+    # published residency gauges compare total param bytes and the
+    # in-flight window against it; 0 = unknown/unlimited
+    hbm_budget_mb: float = 0.0
 
     COMPAT_FIELDS = frozenset({"buffer_count", "buffer_size",
                                "max_in_cpu"})
+
+    def _validate(self):
+        if self.enabled:
+            if self.tier not in ("dram", "nvme"):
+                raise ValueError(
+                    f"offload_param.tier must be 'dram' or 'nvme', "
+                    f"got {self.tier!r}")
+            if self.tier == "nvme" and not self.nvme_path:
+                raise ValueError(
+                    "offload_param.tier='nvme' requires nvme_path")
+            if self.codec not in ("none", "int8", "int4"):
+                raise ValueError(
+                    f"offload_param.codec must be none/int8/int4, "
+                    f"got {self.codec!r}")
+            if self.device == "cpu":
+                raise ValueError(
+                    "offload_param.enabled (the streaming wire) and "
+                    "offload_param.device='cpu' (the memory-kind full "
+                    "swap) are mutually exclusive — pick one")
+        if int(self.prefetch) < 0:
+            raise ValueError(
+                f"offload_param.prefetch must be >= 0 (0 = kick all "
+                f"groups at drop time), got {self.prefetch!r}")
+        if not float(self.bucket_mb) > 0:
+            raise ValueError(
+                f"offload_param.bucket_mb must be positive, got "
+                f"{self.bucket_mb!r}")
+        if float(self.hbm_budget_mb) < 0:
+            raise ValueError(
+                f"offload_param.hbm_budget_mb must be >= 0 (0 = "
+                f"unlimited), got {self.hbm_budget_mb!r}")
 
 
 @dataclasses.dataclass

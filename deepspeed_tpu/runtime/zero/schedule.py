@@ -577,6 +577,18 @@ def offload_wire_groups(leaf_names, off_idx, per_leaf: int) -> List:
     return build_wire_groups(slot_layers, per_leaf)
 
 
+def param_wire_groups(leaf_names) -> List:
+    """Per-layer wire groups for the param-residency wire
+    (runtime/zero/param_stream.py), in FORWARD consumption order:
+    non-layer leaves (embeddings lead the forward) first, then layers
+    ascending — the order the prefetch ring should land uploads in.
+    Slots are positions into ``leaf_names`` (the streamed-leaf list),
+    one wire tensor per slot."""
+    from ..transfer.streaming import build_wire_groups
+    slot_layers = [layer_index_of(n) for n in leaf_names]
+    return build_wire_groups(slot_layers, per_leaf=1, forward=True)
+
+
 def _remat_wrap(layer_fn, policy):
     if policy in (None, "none"):
         return layer_fn

@@ -63,12 +63,18 @@ def memory_snapshot() -> Dict[str, float]:
     for a per-step stream; soaks call lifecycle.memory_gauges()
     directly)."""
     from ..runtime.lifecycle import memory_gauges
+    from ..runtime.zero.param_stream import residency_gauges
     pm = memory_gauges(include_arrays=False)
+    pr = residency_gauges()
     return {
         "device_gb_in_use": pm.get("device_bytes_in_use", 0) / 1e9,
         "device_gb_peak": pm.get("device_peak_bytes", 0) / 1e9,
         "host_rss_gb": pm.get("host_rss_gb", 0.0),
         "live_executables": pm.get("live_executables", 0),
+        # param-residency wire byte totals (zeros when no wire armed)
+        "param_store_gb": pr["param_store_bytes"] / 1e9,
+        "param_mirror_gb": pr["param_mirror_bytes"] / 1e9,
+        "param_device_gb": pr["param_device_bytes"] / 1e9,
     }
 
 

@@ -146,6 +146,16 @@ SPAN_SITES = {
     "store.read":
         "one block-store payload read + checksum verify incl. retries "
         "(args: tier) — runtime/store.py",
+    # ---- parameter-residency wire (runtime/zero/param_stream.py) ----
+    "param.prefetch":
+        "one layer group's store fetch + staging + fused h2d bucket "
+        "kick (args: group, buckets) — on the drop path this is the "
+        "prefetch ring arming ahead of the next step; on the gather "
+        "path it is the late (exposed) fallback",
+    "param.drop":
+        "one layer group's device->store demotion: d2h arrival wait, "
+        "codec encode, store put, host-mirror rebind (args: group, "
+        "n) — after this span the group's device copies are released",
     # ---- elastic supervisor (elasticity/supervisor.py) ----
     "supervisor.gate":
         "the pre-dispatch health gate (one per supervised step)",

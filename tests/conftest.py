@@ -20,8 +20,38 @@ import jax  # noqa: E402
 # overrides environments (like axon TPU tunnels) whose site hooks force
 # their own jax_platforms selection.
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache (tier-1 wall, PR 17). The suite
+# compiles near-identical tiny-model programs hundreds of times —
+# across test modules in one run and again on every rerun; the cache
+# is keyed on (HLO, compile options, backend), so hits are exactly the
+# executables jit would have produced, and in-memory dispatch
+# signatures (ScheduledStep caches, recompile-count assertions) are
+# unaffected. It is opt-in PER PACKAGE via the named fixture below:
+# once any cache write has happened in the process, the elasticity
+# chaos drill (kill mid-dispatch + respawn) segfaults old jaxlib's CPU
+# runtime — so the cache must stay off until every elasticity drill
+# has run, and only the expensive packages that sort after elasticity
+# opt in (their conftests wrap this fixture autouse).
+# test_compile_cache.py saves/restores these knobs around its own
+# engine-level cache assertions.
+T1_COMPILE_CACHE_DIR = os.environ.get("DS_T1_COMPILE_CACHE",
+                                      "/tmp/ds_tpu_t1_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="package")
+def persistent_compile_cache():
+    """Enable the persistent XLA compile cache for one package (wrapped
+    autouse by the opt-in package conftests). Package scope so it is
+    active before module-scoped engine fixtures compile."""
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", T1_COMPILE_CACHE_DIR)
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
 
 
 @pytest.fixture(autouse=True)

@@ -156,6 +156,7 @@ class TestLayerReduction:
     """Depth compression (reference: compress.py:206-231
     student_initialization — student layer i <- teacher_layer[i])."""
 
+    @pytest.mark.slow  # tier-1 diet (PR 17): the keep-count/prefix rejection smokes keep layer reduction tier-1
     def test_student_init_from_selected_teacher_layers(self):
         import dataclasses
         import jax

@@ -69,6 +69,7 @@ def _gather(tree):
 @pytest.mark.fault
 class TestLadder:
 
+    @pytest.mark.slow  # tier-1 diet (PR 17): chaos_smoke[0] keeps the kill -> rollback -> bitwise-replay e2e tier-1
     def test_kill_rolls_back_and_replays_bitwise(self, tmp_path,
                                                  eight_devices):
         """Kill at step 3 -> immediate detection at the dispatch gate,

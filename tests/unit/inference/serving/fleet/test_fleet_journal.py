@@ -226,6 +226,7 @@ class TestLoopbackCrashRecover:
     uid exercises the re-place path — bitwise replay from position 0
     via the fold_in sampling-key contract."""
 
+    @pytest.mark.slow  # tier-1 diet (PR 17): bootstrap's kill-router-mid-decode drill keeps journal recovery bitwise tier-1
     def test_crash_mid_decode_recover_replays_bitwise(self, params_cfg,
                                                       tmp_path):
         N = 4

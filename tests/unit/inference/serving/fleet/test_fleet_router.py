@@ -507,6 +507,7 @@ class TestElasticRecovery:
 
 class TestAcceptanceE2E:
 
+    @pytest.mark.slow  # tier-1 diet (PR 17): bootstrap's kill-router-mid-decode drill keeps the kill path tier-1
     def test_fleet_kill_mid_decode_acceptance(self, params_cfg):
         """The ISSUE acceptance e2e: N=2 replicas, staggered
         shared-prefix requests through router.serve(); one replica

@@ -646,6 +646,7 @@ def _acceptance_drill(params_cfg, serving=None):
 
 class TestTransportAcceptanceE2E:
 
+    @pytest.mark.slow  # tier-1 diet (PR 17): bootstrap's kill-router drill + chaos_drop_smoke keep kill/drop recovery tier-1
     def test_kill_under_send_drop_loopback(self, params_cfg):
         """Loopback channel: kill mid-decode + drop~0.1, every stream
         bitwise; recompiles <= 1 and steady_blocking_syncs == 0 per

@@ -117,7 +117,7 @@ def test_mistral_sliding_window():
     assert out[1] == ref
 
 
-@pytest.mark.slow  # tier-1 diet (ISSUE 16): gpt2/mistral/gptj/moe-routing smokes stay
+@pytest.mark.slow  # tier-1 diet (ISSUE 16): gpt2/mistral/moe-routing smokes stay
 def test_falcon_family():
     from deepspeed_tpu.models.falcon import (FalconConfig,
                                              FalconForCausalLM)
@@ -126,7 +126,7 @@ def test_falcon_family():
     _check_family(model, _init(model), cfg)
 
 
-@pytest.mark.slow  # tier-1 diet (ISSUE 16): gpt2/mistral/gptj/moe-routing smokes stay
+@pytest.mark.slow  # tier-1 diet (ISSUE 16): gpt2/mistral/moe-routing smokes stay
 def test_phi_family():
     from deepspeed_tpu.models.phi import PhiConfig, PhiForCausalLM
     cfg = PhiConfig.tiny()       # partial rotary, parallel, biased head
@@ -134,6 +134,7 @@ def test_phi_family():
     _check_family(model, _init(model), cfg)
 
 
+@pytest.mark.slow  # tier-1 diet (PR 17): gpt2/mistral/moe-routing smokes stay; rotary rides the llama/mistral paths
 def test_gptj_family():
     from deepspeed_tpu.models.gptj import GPTJConfig, GPTJForCausalLM
     cfg = GPTJConfig.tiny()      # interleaved rotary, parallel residual
@@ -141,7 +142,7 @@ def test_gptj_family():
     _check_family(model, _init(model), cfg)
 
 
-@pytest.mark.slow  # tier-1 diet (ISSUE 16): gpt2/mistral/gptj/moe-routing smokes stay
+@pytest.mark.slow  # tier-1 diet (ISSUE 16): gpt2/mistral/moe-routing smokes stay
 def test_qwen2_family():
     from deepspeed_tpu.models.qwen2 import Qwen2Config, Qwen2ForCausalLM
     cfg = Qwen2Config.tiny()     # llama arch + biased q/k/v
@@ -149,7 +150,7 @@ def test_qwen2_family():
     _check_family(model, _init(model), cfg)
 
 
-@pytest.mark.slow  # tier-1 diet (ISSUE 16): gpt2/mistral/gptj/moe-routing smokes stay
+@pytest.mark.slow  # tier-1 diet (ISSUE 16): gpt2/mistral/moe-routing smokes stay
 def test_mixtral_moe_family():
     from deepspeed_tpu.models.mixtral import (MixtralConfig,
                                               MixtralForCausalLM)

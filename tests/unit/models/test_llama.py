@@ -32,6 +32,7 @@ class TestLlamaForward:
         assert np.isfinite(float(loss))
         assert float(loss) > 0
 
+    @pytest.mark.slow  # tier-1 diet (PR 17): forward/decode-cache/transformers-parity smokes stay; grads ride chunked-ce parity
     def test_gradients_finite(self, tiny_model):
         cfg, model, params = tiny_model
         ids = np.arange(32, dtype=np.int32).reshape(2, 16) % cfg.vocab_size

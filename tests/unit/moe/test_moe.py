@@ -104,6 +104,7 @@ class TestMoELayer:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow  # tier-1 diet (PR 17): expert-parallel + single-expert-dense smokes stay
     def test_residual_moe(self, rng):
         x = jnp.asarray(rng.standard_normal((4, 16)).astype(np.float32))
         moe = MoE(hidden_size=16, num_experts=2, use_residual=True,

@@ -1,0 +1,10 @@
+"""Opt this package into the persistent XLA compile cache — see the
+cache comment in tests/conftest.py for why it is per-package opt-in
+(packages sorting before elasticity must stay uncached)."""
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="package")
+def _compile_cache(persistent_compile_cache):
+    yield

@@ -81,6 +81,7 @@ class TestKernel:
         np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
                                    rtol=2e-3, atol=2e-3)
 
+    @pytest.mark.slow  # tier-1 diet (PR 17): fwd-parity + cpu-fallback smokes stay
     def test_gradients_match_reference(self, qkv):
         q, k, v = qkv
         L = make_layout("fixed", 4, 4, num_local_blocks=1,

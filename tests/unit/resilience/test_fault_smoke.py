@@ -68,7 +68,10 @@ def test_data_fetch_site_recovers_via_retry(eight_devices):
 
 
 @pytest.mark.parametrize("site", [
-    "offload.d2h",
+    # tier-1 diet (PR 17): the bucketed transfer.d2h drill
+    # (test_offload_bucketed) keeps a d2h fault-retry path tier-1
+    pytest.param("offload.d2h",
+                 marks=pytest.mark.slow),
     pytest.param("offload.h2d",
                  marks=pytest.mark.slow)])  # tier-1 diet (PR 5)
 def test_offload_transfer_site_recovers_via_retry(

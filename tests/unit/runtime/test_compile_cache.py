@@ -27,6 +27,7 @@ def test_config_parses_section():
     assert cc.min_compile_time_secs == 0
 
 
+@pytest.mark.slow  # tier-1 diet (PR 17): config-section smokes stay; the populate integration rides the slow tier
 def test_engine_populates_cache_dir(tmp_path, rng, eight_devices):
     cache_dir = tmp_path / "xla_cache"
     prev_dir = jax.config.jax_compilation_cache_dir

@@ -39,6 +39,7 @@ def test_get_batch_info(eight_devices):
     assert engine.get_batch_info() == (16, 1, 2)   # 1 micro * 2 gas * 8 dp
 
 
+@pytest.mark.slow  # tier-1 diet (PR 17): the exclude_frozen variant keeps save_fp16_model tier-1
 def test_save_fp16_model_alias(tmp_path, rng, eight_devices):
     engine, _ = _engine()
     ids = rng.integers(0, 256, size=(16, 16), dtype=np.int32)
@@ -96,6 +97,7 @@ def test_custom_schedule_before_dataloader_is_held(eight_devices):
     assert engine.curriculum_scheduler.get_difficulty(1) == 9
 
 
+@pytest.mark.slow  # tier-1 diet (PR 17): the two cheaper curriculum-hook smokes stay
 def test_post_process_hook_gets_curriculum_state(eight_devices):
     """With curriculum enabled the hook must actually fire (the sampler
     wrapper delegates reads only) and receive the scheduler state."""

@@ -131,6 +131,7 @@ class TestLora:
         assert out.shape == (1, 6)
 
 
+@pytest.mark.slow  # tier-1 diet (PR 17): the zero-init LoRA smoke keeps the hybrid engine tier-1
 def test_param_refresh_is_lazy(hybrid):
     eng, ids = hybrid
     prompt = np.asarray([[1, 2, 3]], np.int32)

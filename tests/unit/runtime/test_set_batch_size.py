@@ -85,7 +85,7 @@ def test_set_train_batch_size_rebuilds_engine_loader(rng, eight_devices):
     assert engine.curriculum_scheduler.get_difficulty(99) == 8
 
 
-@pytest.mark.slow  # tier-1 diet (ISSUE 7): gas-change + reset smokes stay
+@pytest.mark.slow  # tier-1 diet (ISSUE 7): micro-change reset smoke stays
 def test_set_train_micro_batch_size_keeps_gas(rng, eight_devices):
     engine = _engine()
     engine.train_batch(batch=_batch(rng, 16))
@@ -96,6 +96,7 @@ def test_set_train_micro_batch_size_keeps_gas(rng, eight_devices):
     assert np.isfinite(loss)
 
 
+@pytest.mark.slow  # tier-1 diet (PR 17): micro_change_resets_compiled_steps pins the same all-steps reset contract
 def test_gas_change_resets_all_compiled_steps(rng, eight_devices):
     """A gas change must reset EVERY compiled step together — the old
     behavior reset only _jit_train_step, leaving the gas-keyed siblings

@@ -199,6 +199,7 @@ class TestParamOffloadHost:
         np.testing.assert_allclose(losses[False], losses[True],
                                    rtol=2e-2)
 
+    @pytest.mark.slow  # tier-1 diet (PR 17): state_lives_on_host smoke stays; eval rides test_eval_batch
     def test_eager_triple_and_eval_with_param_offload(self):
         """eval_batch and the eager forward/backward/step triple must
         swap host state through the device too (review finding: only
@@ -285,6 +286,7 @@ class TestCompressedWire:
     # invalidates the AOT step caches (asserted below), and the suite
     # sweeps dead engines per test module (tests/conftest.py
     # _lifecycle_sweep).
+    @pytest.mark.slow  # tier-1 diet (PR 17): param_stream's over-budget checkpoint round-trip keeps restore -> wire-resync tier-1
     def test_mirror_resynced_after_checkpoint_restore(
             self, eight_devices, tmp_path):
         """After load_checkpoint the mirror must equal the RESTORED

@@ -198,6 +198,7 @@ def test_streamed_window_bit_identical_and_bounded(eight_devices):
 
 
 @pytest.mark.perf
+@pytest.mark.slow  # tier-1 diet (PR 17): telemetry e2e keeps per-bucket d2h tracing tier-1; param_stream pins the overlap keys
 def test_streamed_overlap_attribution_and_trace(eight_devices):
     """ISSUE acceptance (tests satellite): (a) the breakdown carries
     the exposed/overlapped split with exposed <= the blocking wall

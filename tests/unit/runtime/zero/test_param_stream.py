@@ -1,0 +1,457 @@
+"""Parameter-residency wire (runtime/zero/param_stream.py): bitwise
+streamed-vs-resident training with zero extra recompiles, the
+prefetch-ring overlap attribution, over-budget training + checkpoint
+round-trip, the serving cold-start weight stream, seeded fault drills
+on the param.fetch/param.h2d envelopes, and the open/stream/close
+lifecycle (flat fd table + RSS)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.parallel.mesh import mesh_manager
+from deepspeed_tpu.resilience import fault_injector
+from deepspeed_tpu.resilience.errors import ParamStreamError
+from deepspeed_tpu.runtime.transfer.streaming import (WireClock,
+                                                      build_wire_groups)
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroOffloadParamConfig
+from deepspeed_tpu.runtime.zero.param_stream import (ParamStoreSource,
+                                                     ParamStreamCoordinator,
+                                                     open_param_store,
+                                                     residency_gauges,
+                                                     save_params_to_store)
+from deepspeed_tpu.utils.tree import flatten_with_names
+
+
+def _config(stream=True, tier="dram", prefetch=0, bucket_mb=0.25,
+            codec="none", nvme_path=None, hbm_budget_mb=0.0):
+    c = {"train_micro_batch_size_per_gpu": 4,
+         "gradient_accumulation_steps": 1,
+         "optimizer": {"type": "AdamW",
+                       "params": {"lr": 1e-3, "weight_decay": 0.01}},
+         "bf16": {"enabled": True},
+         "zero_optimization": {"stage": 2},
+         "gradient_clipping": 1.0,
+         "steps_per_print": 0}
+    if stream:
+        op = {"enabled": True, "tier": tier, "prefetch": prefetch,
+              "bucket_mb": bucket_mb, "codec": codec,
+              "hbm_budget_mb": hbm_budget_mb}
+        if nvme_path is not None:
+            op["nvme_path"] = str(nvme_path)
+        c["zero_optimization"]["offload_param"] = op
+    return c
+
+
+def _engine(config):
+    mesh_manager.reset()
+    model = GPT2LMHeadModel(GPT2Config.tiny())
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine
+
+
+def _batch(engine, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 256, size=(engine.train_batch_size(), 16),
+                       dtype=np.int32)
+    return {"input_ids": ids, "labels": ids.copy()}
+
+
+def _train(config, steps=3):
+    engine = _engine(config)
+    batch = _batch(engine)
+    return engine, [float(engine.train_batch(batch=batch))
+                    for _ in range(steps)]
+
+
+def _toy_tree():
+    import jax.numpy as jnp
+    return {"embed": {"w": jnp.arange(12., dtype=jnp.float32).reshape(3, 4)},
+            "layers": [{"w": jnp.ones((4, 4), jnp.float32) * (i + 1),
+                        "b": jnp.arange(4., dtype=jnp.float32) * i}
+                       for i in range(3)],
+            "head": {"w": jnp.full((4, 3), 2.0, jnp.float32)}}
+
+
+def _coordinator(tree, **over):
+    names, leaves, _ = flatten_with_names(tree)
+    kw = dict({"enabled": True, "tier": "dram", "prefetch": 0,
+               "bucket_mb": 0.25, "codec": "none"}, **over)
+    cfg = DeepSpeedZeroOffloadParamConfig.from_dict(kw)
+    return ParamStreamCoordinator(names, leaves, cfg), names, leaves
+
+
+def _n_fds():
+    return len(os.listdir("/proc/self/fd"))
+
+
+def _rss_kb():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    raise RuntimeError("no VmRSS")
+
+
+# ---------------------------------------------------------------------------
+# pure planning / unit pieces (no engine, free)
+# ---------------------------------------------------------------------------
+class TestForwardWireGroups:
+
+    def test_forward_order_rest_leads_layers_ascend(self):
+        # slots: [h.0.w, h.2.w, embed, h.1.w, head]
+        layers = [0, 2, None, 1, None]
+        gs = build_wire_groups(layers, per_leaf=1, forward=True)
+        assert [g.label for g in gs] == ["rest", "layer0", "layer1",
+                                         "layer2"]
+        assert gs[0].slots == [2, 4]       # embeddings lead the forward
+        assert gs[1].slots == [0]
+        # backward mode unchanged: layers descend, rest trails
+        bs = build_wire_groups(layers, per_leaf=1)
+        assert [g.label for g in bs] == ["layer2", "layer1", "layer0",
+                                         "rest"]
+
+    def test_forward_toy_fallback_keeps_flatten_order(self):
+        gs = build_wire_groups([None, None, None], per_leaf=1,
+                               forward=True)
+        assert [g.slots for g in gs] == [[0], [1], [2]]
+        bs = build_wire_groups([None, None, None], per_leaf=1)
+        assert [g.slots for g in bs] == [[2], [1], [0]]
+
+    def test_wire_clock_split_prefix(self):
+        c = WireClock()
+        c.kick()
+        c.t_done = c.t_kick
+        c.note_wait(c.t_kick + 0.01, c.t_kick + 0.02)
+        out = c.split(prefix="param_d2h")
+        assert set(out) == {"param_d2h_exposed_ms",
+                            "param_d2h_overlapped_ms"}
+        assert out["param_d2h_exposed_ms"] > 0
+
+
+class TestCoordinatorUnits:
+
+    def test_cycle_gather_round_trip_bitwise(self):
+        tree = _toy_tree()
+        c, _, leaves = _coordinator(tree)
+        assert [g.label for g in c.groups] == ["rest", "layer0",
+                                               "layer1", "layer2"]
+        mirrored = c.cycle(tree)
+        # mirrors are real correct-valued arrays (checkpoint save /
+        # profiling / sentinel read the state directly between steps)
+        for a, b in zip(leaves, jax.tree_util.tree_leaves(mirrored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        gathered = c.gather(mirrored)
+        for a, b in zip(leaves, jax.tree_util.tree_leaves(gathered)):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        assert c.gather(gathered) is None   # already resident
+        bd = c.last_breakdown
+        assert set(bd) == {"param_d2h_exposed_ms",
+                           "param_d2h_overlapped_ms",
+                           "param_h2d_exposed_ms",
+                           "param_h2d_overlapped_ms", "param_fetch_ms"}
+        c.close()
+
+    def test_quantized_codec_skips_small_leaves(self):
+        # int8 planes need >= 2 trailing axes: 0/1-d leaves (biases)
+        # stay exact while matrices compress
+        tree = _toy_tree()
+        c, names, leaves = _coordinator(tree, codec="int8")
+        mirrored = c.cycle(tree)
+        flat = jax.tree_util.tree_leaves(mirrored)
+        for n, a, b in zip(names, leaves, flat):
+            if np.asarray(a).ndim < 2:
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+            else:
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=0.05, atol=0.05)
+        c.close()
+
+    def test_prefetch_window_bounds_inflight_bytes(self):
+        tree = _toy_tree()
+        c, _, _ = _coordinator(tree, prefetch=1)
+        c.cycle(tree)
+        kicked = [g for g in c.groups if c._gstate[g.label].kicked]
+        assert len(kicked) == 1             # the window, not everything
+        assert c.window_bytes == c._gstate[kicked[0].label].nbytes
+        assert c.window_bytes < c.total_bytes
+        c.gather(tree)                      # late groups fetch exposed
+        c.close()
+
+    def test_residency_gauges_track_the_cycle(self):
+        tree = _toy_tree()
+        c, _, _ = _coordinator(tree)
+        g0 = residency_gauges()
+        # armed non-resident: the whole window is already in flight,
+        # and no host mirrors are bound until the first cycle
+        assert g0["param_device_bytes"] == c.total_bytes
+        assert g0["param_mirror_bytes"] == 0
+        m = c.cycle(tree)
+        g1 = residency_gauges()
+        assert g1["param_mirror_bytes"] == c.total_bytes   # dropped
+        assert g1["param_store_bytes"] > 0
+        c.gather(m)
+        assert residency_gauges()["param_device_bytes"] == c.total_bytes
+        c.close()
+        assert residency_gauges()["param_store_bytes"] == 0
+
+    def test_manifest_round_trip_rebuilds_lists_and_dicts(self):
+        tree = _toy_tree()
+        store = open_param_store("dram")
+        save_params_to_store(tree, store)
+        src = ParamStoreSource(store)
+        out = src.load_tree()
+        fa, ta = jax.tree_util.tree_flatten(tree)
+        fb, tb = jax.tree_util.tree_flatten(out)
+        assert ta == tb                     # lists stayed lists
+        for a, b in zip(fa, fb):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        assert src.report["cold_leaves"] == len(fa)
+        src.close()
+
+
+# ---------------------------------------------------------------------------
+# seeded fault drills (coordinator level: milliseconds per drill)
+# ---------------------------------------------------------------------------
+@pytest.mark.fault
+class TestFaultDrills:
+
+    def test_fetch_transient_retries_inside_the_envelope(self):
+        tree = _toy_tree()
+        c, _, leaves = _coordinator(tree)
+        with fault_injector.inject("param.fetch:ioerror"):
+            m = c.cycle(tree)               # prefetch kicks fetch here
+            assert fault_injector.fired == ["param.fetch:ioerror@0"]
+        g = c.gather(m)
+        for a, b in zip(leaves, jax.tree_util.tree_leaves(g)):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        c.close()
+
+    def test_fetch_persistent_raises_typed(self):
+        tree = _toy_tree()
+        c, _, _ = _coordinator(tree)
+        with fault_injector.inject("param.fetch:ioerror@0xinf"):
+            with pytest.raises(ParamStreamError, match="unfetchable"):
+                c.cycle(tree)
+        c.close()
+
+    def test_h2d_transient_retries_persistent_raises(self):
+        tree = _toy_tree()
+        c, _, leaves = _coordinator(tree)
+        with fault_injector.inject("param.h2d:ioerror"):
+            m = c.cycle(tree)
+        g = c.gather(m)
+        for a, b in zip(leaves, jax.tree_util.tree_leaves(g)):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        with fault_injector.inject("param.h2d:ioerror@0xinf"):
+            with pytest.raises(ParamStreamError, match="h2d bucket"):
+                c.cycle(g)
+        c.close()
+
+    def test_missing_leaf_raises_typed_not_silent(self):
+        # prefetch=1: only "rest" kicks at cycle time; punch the hole
+        # AFTER the cycle (a cycle re-puts every leaf) so the gather's
+        # late fetch of layer2 hits it
+        tree = _toy_tree()
+        c, _, _ = _coordinator(tree, prefetch=1)
+        m = c.cycle(tree)
+        c.store.delete(b"param/layers.2.w")
+        with pytest.raises(ParamStreamError, match="unfetchable"):
+            c.gather(m)
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: open/stream/close soak (coordinator) + engine smoke
+# ---------------------------------------------------------------------------
+class TestLifecycle:
+
+    def test_soak_20_cycles_flat_fds_and_rss(self, tmp_path):
+        tree = _toy_tree()
+        # warm allocator/caches once so the measured window is steady
+        c, _, _ = _coordinator(tree, tier="nvme",
+                               nvme_path=str(tmp_path / "warm"))
+        c.gather(c.cycle(tree))
+        c.close()
+        fd0, rss0 = _n_fds(), _rss_kb()
+        for i in range(20):
+            c, _, _ = _coordinator(tree, tier="nvme",
+                                   nvme_path=str(tmp_path / f"c{i}"))
+            assert _n_fds() == fd0 + 1      # the held journal fd
+            m = c.cycle(tree)
+            c.gather(m)
+            c.close()
+            c.close()                       # idempotent
+            assert _n_fds() == fd0, f"fd leak at cycle {i}"
+        assert _rss_kb() - rss0 < 20 * 1024, "RSS grew over the soak"
+        assert residency_gauges()["param_store_bytes"] == 0
+
+    @pytest.mark.slow  # tier-1 diet: the coordinator soak above is
+    # the tier-1 fd/RSS gate; every engine test also closes clean
+    def test_engine_open_stream_close_smoke(self, tmp_path):
+        # warm one engine first: lazily-opened process fds (compile
+        # cache, plugin loads) must not count against the cycles
+        engine, _ = _train(_config(tier="nvme",
+                                   nvme_path=tmp_path / "warm"), steps=1)
+        engine.close()
+        fd0 = _n_fds()
+        for i in range(3):
+            engine, losses = _train(
+                _config(tier="nvme", nvme_path=tmp_path / f"e{i}"),
+                steps=1)
+            assert np.isfinite(losses[0])
+            engine.close()
+            assert engine._param_stream is None
+            assert _n_fds() <= fd0, f"fd leak at engine cycle {i}"
+
+
+# ---------------------------------------------------------------------------
+# engine-level: the acceptance contracts
+# ---------------------------------------------------------------------------
+class TestEngineStreaming:
+
+    def test_streamed_bitwise_resident_single_compile_overlap(self):
+        """The headline contract: streaming only changes WHERE params
+        live between steps — losses are bitwise equal to the resident
+        run, streaming adds ZERO compiled signatures over the resident
+        baseline (the wire gathers through the canonicalizing unpack
+        before the first dispatch, so every step presents the same
+        shardings), and the h2d window is overlapped, not exposed."""
+        e0, l0 = _train(_config(stream=False), steps=3)
+        e1, l1 = _train(_config(stream=True), steps=3)
+        assert l0 == l1                     # bitwise, not allclose
+        s0 = e0._scheduled_steps.get("train_step")
+        s1 = e1._scheduled_steps.get("train_step")
+        if s0 is not None and s1 is not None:
+            # both modes share the engine's one-time init->steady-state
+            # warmup signature; streaming must not add any of its own
+            assert s1.cache_size <= s0.cache_size
+        bd = e1.get_offload_breakdown()
+        assert bd["param_h2d_overlapped_ms"] > bd["param_h2d_exposed_ms"]
+        rep = e1.get_schedule_report()["param_stream"]
+        assert rep["enabled"] and rep["steps"] == 3
+        assert rep["store_used_bytes"] == rep["total_param_bytes"]
+        # the wire's gauges reach the shared memory snapshot
+        from deepspeed_tpu.telemetry.hub import memory_snapshot
+        assert memory_snapshot()["param_store_gb"] > 0
+        e0.close()
+        e1.close()
+
+    def test_over_budget_trains_and_checkpoint_round_trips(self, tmp_path):
+        """A param footprint over the (simulated) HBM budget still
+        trains — loss falls — and the checkpoint round-trips through
+        a fresh streamed engine bitwise. Runs on the NVMe tier, so
+        this is also the tier-1 engine-level disk-store smoke."""
+        cfg = _config(hbm_budget_mb=0.1, prefetch=1, tier="nvme",
+                      nvme_path=tmp_path / "m0")
+        e0 = _engine(cfg)
+        batch = _batch(e0)
+        losses = [float(e0.train_batch(batch=batch)) for _ in range(3)]
+        assert losses[-1] < losses[0]
+        rep = e0.get_schedule_report()["param_stream"]
+        assert rep["over_budget"]
+        assert rep["window_bytes"] < rep["total_param_bytes"]
+        assert rep["store_disk_bytes"] == rep["total_param_bytes"]
+        assert (tmp_path / "m0" / "param_store").is_dir()
+        ck = tmp_path / "ckpt"
+        e0.save_checkpoint(str(ck), tag="s3")
+        l0 = float(e0.train_batch(batch=batch))
+        # fresh engine (own store dir): one step to initialize params,
+        # then restore (load_checkpoint needs an initialized state
+        # tree to rebuffer); resync() reseeds the new store
+        e1, _ = _train(_config(hbm_budget_mb=0.1, prefetch=1,
+                               tier="nvme",
+                               nvme_path=tmp_path / "m1"), steps=1)
+        e1.load_checkpoint(str(ck), tag="s3")
+        l1 = float(e1.train_batch(batch=batch))
+        assert l0 == l1                     # restored stream, bitwise
+        e0.close()
+        e1.close()
+
+    @pytest.mark.fault
+    @pytest.mark.slow
+    def test_engine_persistent_fetch_fault_raises_typed(self):
+        engine, _ = _train(_config(), steps=1)
+        batch = _batch(engine)
+        with fault_injector.inject("param.fetch:ioerror@0xinf"):
+            with pytest.raises(ParamStreamError):
+                engine.train_batch(batch=batch)
+        engine.close()
+
+    @pytest.mark.slow
+    def test_nvme_tier_and_prefetch_matrix_bitwise(self, tmp_path):
+        _, ref = _train(_config(stream=False), steps=3)
+        for i, kw in enumerate([dict(tier="nvme"),
+                                dict(prefetch=1),
+                                dict(tier="nvme", prefetch=2)]):
+            if "nvme" in kw.get("tier", ""):
+                kw["nvme_path"] = tmp_path / f"m{i}"
+            e, ls = _train(_config(**kw), steps=3)
+            assert ls == ref, kw
+            e.close()
+
+    @pytest.mark.slow
+    def test_codec_ab_trains_close_to_exact(self):
+        _, exact = _train(_config(), steps=3)
+        for codec in ("int8", "int4"):
+            e, ls = _train(_config(codec=codec), steps=3)
+            assert np.isfinite(ls).all()
+            assert ls[-1] < ls[0] * 1.05, (codec, ls)
+            # lossy but sane: first-step loss within a few percent
+            assert abs(ls[0] - exact[0]) / exact[0] < 0.05, (codec, ls)
+            e.close()
+
+    @pytest.mark.slow  # tier-1 diet: the over-budget acceptance test
+    # runs on the nvme tier, and the coordinator soak cycles nvme fds
+    def test_nvme_smoke(self, tmp_path):
+        engine, losses = _train(_config(tier="nvme",
+                                        nvme_path=tmp_path), steps=2)
+        assert losses[-1] < losses[0]
+        store_dir = tmp_path / "param_store"
+        assert store_dir.is_dir() and any(store_dir.iterdir())
+        rep = engine.get_schedule_report()["param_stream"]
+        assert rep["tier"] == "nvme"
+        assert rep["store_disk_bytes"] == rep["total_param_bytes"]
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# serving cold start
+# ---------------------------------------------------------------------------
+class TestColdServe:
+
+    def test_cold_started_engine_streams_bitwise(self, tmp_path):
+        """Direct-params engine vs store-cold-started engine emit
+        identical greedy streams (codec none = byte-exact wire)."""
+        from deepspeed_tpu.inference.v2 import InferenceEngineV2
+        from deepspeed_tpu.inference.v2.engine_v2 import \
+            RaggedInferenceEngineConfig
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 8), np.int32))
+        kw = dict(token_budget=32, max_ragged_sequence_count=4,
+                  n_kv_blocks=16, kv_block_size=8, max_blocks_per_seq=8,
+                  kv_dtype="float32")
+        prompts = {1: [3, 1, 4, 1, 5], 2: [2, 7]}
+        direct = InferenceEngineV2(params, cfg,
+                                   RaggedInferenceEngineConfig(**kw))
+        want = direct.generate_batch(prompts, max_new_tokens=6)
+        direct.close()
+        store = open_param_store("nvme", nvme_path=str(tmp_path))
+        save_params_to_store(params, store)
+        fd_held = _n_fds()
+        cold = InferenceEngineV2(ParamStoreSource(store), cfg,
+                                 RaggedInferenceEngineConfig(**kw))
+        assert cold._param_source.report["cold_leaves"] > 0
+        got = cold.generate_batch(prompts, max_new_tokens=6)
+        assert got == want
+        cold.close()
+        assert _n_fds() < fd_held           # the journal fd is gone
+        cold.close()                        # idempotent

@@ -210,6 +210,7 @@ class TestLayerScan:
         params = model.init(jax.random.PRNGKey(0), ids)
         return cfg, model, mesh, batch, params
 
+    @pytest.mark.slow  # tier-1 diet (PR 17): prefetch-ring + scan-forward bit-exact smokes stay
     def test_spec_decomposition_bit_exact(self, eight_devices):
         """The model's embed/layer/head functions, unrolled in a plain
         Python loop, reproduce the flat forward AND backward bitwise —

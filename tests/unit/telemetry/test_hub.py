@@ -172,5 +172,11 @@ class TestHubBehavior:
 def test_memory_snapshot_schema():
     snap = memory_snapshot()
     assert set(snap) == {"device_gb_in_use", "device_gb_peak",
-                         "host_rss_gb", "live_executables"}
+                         "host_rss_gb", "live_executables",
+                         "param_store_gb", "param_mirror_gb",
+                         "param_device_gb"}
     assert snap["host_rss_gb"] > 0
+    # param-residency gauges: always present, zero with no wire armed
+    assert snap["param_store_gb"] == 0.0
+    assert snap["param_mirror_gb"] == 0.0
+    assert snap["param_device_gb"] == 0.0

@@ -202,21 +202,31 @@ class TestReportSchemas:
             "collective_count", "bytes_moved", "collectives", "flops",
             "bytes_accessed", "est_compute_ms", "est_comm_ms",
             "overlap_estimate", "options_applied", "options_dropped",
-            "donation_refused", "process_memory"}
+            "donation_refused", "process_memory", "param_stream"}
         for v in rep["collectives"].values():
             assert set(v) == {"count", "bytes"}
         assert set(rep["donation_refused"]) == {"count", "bytes"}
+        # param-residency wire block: always present; collapsed to
+        # {"enabled": False} when the wire is off (this fixture)
+        assert rep["param_stream"] == {"enabled": False}
 
     def test_offload_breakdown_keys(self, setup):
         rep = setup["engine"].get_offload_breakdown()
         # d2h_exposed_ms/d2h_overlapped_ms: the wire-clock split of
         # grad_d2h_ms (PR 10) — present on the bucketed AND streamed
         # wires; streamed runs swap d2h_buckets for d2h_groups
+        # the param_* keys are the param-residency wire's split
+        # (runtime/zero/param_stream.py) — present as zeros whenever
+        # ANY offload surface reports, so the stable schema holds
+        # across configs with and without the wire
         assert set(rep) == {
             "grad_d2h_ms", "host_adam_ms", "param_h2d_ms",
             "d2h_buckets", "h2d_buckets", "overlap_residue_ms",
             "d2h_exposed_ms", "d2h_overlapped_ms",
-            "post_restore_repairs"}
+            "post_restore_repairs",
+            "param_d2h_exposed_ms", "param_d2h_overlapped_ms",
+            "param_h2d_exposed_ms", "param_h2d_overlapped_ms",
+            "param_fetch_ms"}
 
     def test_recovery_report_keys(self, setup):
         rep = setup["engine"].get_recovery_report()

@@ -86,6 +86,7 @@ def test_grads_match_direct_jax_grad(model_and_vars):
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 diet (PR 17): capture/activation/save-load smokes stay
 def test_grad_accumulation_appends(model_and_vars):
     model, variables, batch = model_and_vars
     tl = TensorLogger(model, start_iteration=1, end_iteration=1,

@@ -83,7 +83,7 @@ def parse_per_config(text):
 
 # configs that must not vanish from the lineage: present in the old
 # artifact -> required comparable in the new one (see module docstring)
-TRACKED_CONFIGS = ("7_frontend", "8_fleet")
+TRACKED_CONFIGS = ("7_frontend", "8_fleet", "9_bigmodel")
 
 # decomposition keys that must not vanish from a config's lineage:
 # once the OLD artifact's row publishes the key, a new row without it
@@ -93,7 +93,8 @@ TRACKED_CONFIGS = ("7_frontend", "8_fleet")
 # TRACKED_CONFIGS, applied one level down.
 TRACKED_DECOMP_KEYS = {"5": ("speculation",),
                        "7_frontend": ("speculation", "cache"),
-                       "8_fleet": ("transport", "bootstrap")}
+                       "8_fleet": ("transport", "bootstrap"),
+                       "9_bigmodel": ("param_stream",)}
 
 # absolute vs_baseline floors: once a config's LINEAGE has cleared
 # the bar (old side >= floor), no new run may fall back under it —
