@@ -663,10 +663,14 @@ def bench_config7():
     # Tiered spill pinned ON too (ISSUE 16): the cache decomposition
     # block pins demote/promote/degraded counters next to the hit rate
     spill_dir = tempfile.mkdtemp(prefix="bench7_cache_")
+    # Async tiered I/O pinned ON (ISSUE 18): demotions kick after the
+    # step dispatch, promotions stage ahead of prefill — the cache
+    # decomposition must show the store time on the overlapped side
     spec_cfg = {"speculation": {"enabled": True},
                 "prefix": {"tiers": {
                     "enabled": True, "dram_max_mb": 64.0,
-                    "disk_enabled": True, "disk_path": spill_dir}}}
+                    "disk_enabled": True, "disk_path": spill_dir,
+                    "async_io": True}}}
 
     # warmup front-end compiles the fused verify executable (and
     # seeds the prefix cache exactly once per system prompt)
@@ -738,6 +742,21 @@ def bench_config7():
                 "spilled_blocks": pfx.get("spilled_blocks", 0),
                 "evicted_size_bound": pfx.get("evicted_size_bound", 0),
                 "evicted_reclaim": pfx.get("evicted_reclaim", 0),
+                # the ISSUE-18 row: where the tier-crossing time went —
+                # overlapped must dwarf exposed when write-behind and
+                # promote-ahead are healthy, and backpressure stays 0
+                "cache_demote_exposed_ms": round(
+                    pfx.get("cache_demote_exposed_ms", 0.0), 2),
+                "cache_demote_overlapped_ms": round(
+                    pfx.get("cache_demote_overlapped_ms", 0.0), 2),
+                "cache_promote_exposed_ms": round(
+                    pfx.get("cache_promote_exposed_ms", 0.0), 2),
+                "cache_promote_overlapped_ms": round(
+                    pfx.get("cache_promote_overlapped_ms", 0.0), 2),
+                "prefetch_kicks": pfx.get("prefetch_kicks", 0),
+                "prefetch_hits": pfx.get("prefetch_hits", 0),
+                "spill_backpressure": pfx.get("spill_backpressure", 0),
+                "demote_aborts": pfx.get("demote_aborts", 0),
             },
             "memory": _memory_decomposition(
                 memory_gauges(include_arrays=False)),
@@ -994,9 +1013,12 @@ def bench_config9(tiny=False):
             "steps_per_print": 0,
         }
         if stream:
+            # async_io pinned ON (ISSUE 18): drop-phase store writes
+            # ride the spill queue, overlapped with the next step
             config["zero_optimization"]["offload_param"] = {
                 "enabled": True, "tier": "dram", "prefetch": 0,
-                "bucket_mb": 64, "hbm_budget_mb": budget_mb}
+                "bucket_mb": 64, "hbm_budget_mb": budget_mb,
+                "async_io": True}
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=GPT2LMHeadModel(cfg), config=config)
         gb = engine.train_batch_size()
@@ -1093,6 +1115,12 @@ def bench_config9(tiny=False):
                     rep["param_h2d_exposed_ms"], 2),
                 "param_h2d_overlapped_ms": round(
                     rep["param_h2d_overlapped_ms"], 2),
+                # the ISSUE-18 split: drop-phase store writes moved
+                # behind the next step's compute by the spill queue
+                "param_drop_exposed_ms": round(
+                    rep.get("param_drop_exposed_ms", 0.0), 2),
+                "param_drop_overlapped_ms": round(
+                    rep.get("param_drop_overlapped_ms", 0.0), 2),
                 "param_fetch_ms": round(rep["param_fetch_ms"], 2),
                 "cold_start_ttft_ms": round(cold_ms, 1),
                 "direct_ttft_ms": round(direct_ms, 1),

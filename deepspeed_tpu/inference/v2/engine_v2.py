@@ -832,6 +832,19 @@ class InferenceEngineV2:
         bs = self._config.kv_block_size
         return np.asarray(gather(self.pools, block * bs))
 
+    def read_kv_block_async(self, block: int):
+        """The async-demotion half of ``read_kv_block``: dispatch the
+        jitted gather (MAIN thread — the PR 2 rule) and kick the d2h
+        copy, but DON'T wait arrival. Returns the device array; the
+        background IoWorker's ``np.asarray`` on it is the (thread-
+        safe) arrival wait, off the serving thread."""
+        from ...runtime.transfer import start_host_copy
+        gather, _ = self._kv_block_fns()
+        bs = self._config.kv_block_size
+        dev = gather(self.pools, block * bs)
+        start_host_copy(dev)
+        return dev
+
     def write_kv_block(self, block: int, data) -> None:
         """Scatter ``data`` (the ``read_kv_block`` layout) into pool
         block ``block`` (h2d). The promotion path's restore; called

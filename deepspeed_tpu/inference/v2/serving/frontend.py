@@ -133,15 +133,34 @@ class ServingFrontend:
                 # block — clear() releases them, or every block cached
                 # before the swap leaks for the life of the pool
                 engine.prefix_cache.clear()
+            tc = cfg.prefix.tiers
+            dram = self._build_dram_store(tc)
+            disk = self._build_disk_store(tc)
+            if getattr(tc, "async_io", False):
+                # write-behind spills + prefetch staging share ONE
+                # IoWorker across both tiers (PR 18): demote flushes,
+                # disk rebalances and promote prefetches are all host
+                # I/O on the same drain thread
+                from ....runtime.store import AsyncSpillQueue
+                cap = int(tc.spill_queue_mb * 1024 * 1024)
+                dram = AsyncSpillQueue(dram, max_pending_bytes=cap,
+                                       name="cache-spill")
+                if disk is not None:
+                    disk = AsyncSpillQueue(disk, max_pending_bytes=cap,
+                                           worker=dram.worker)
             engine.prefix_cache = TieredPrefixCache(
                 engine._config.kv_block_size,
                 engine._state_manager.kv.allocator,
                 max_blocks=cfg.prefix.max_blocks,
                 kv_io=engine,
-                dram_store=self._build_dram_store(cfg.prefix.tiers),
-                disk_store=self._build_disk_store(cfg.prefix.tiers),
-                codec=cfg.prefix.tiers.codec,
-                alert_sink=self._note_alert)
+                dram_store=dram,
+                disk_store=disk,
+                codec=tc.codec,
+                alert_sink=self._note_alert,
+                async_io=getattr(tc, "async_io", False),
+                prefetch_depth=getattr(tc, "prefetch_depth", 4),
+                max_inflight_demotions=getattr(
+                    tc, "max_inflight_demotions", 4))
         elif cfg.prefix.enabled and engine.prefix_cache is None:
             from .prefix import PrefixCache
             engine.prefix_cache = PrefixCache(
@@ -215,6 +234,8 @@ class ServingFrontend:
             tc.disk_path,
             max_bytes=int(tc.disk_max_mb * 1024 * 1024),
             fsync_every=tc.journal_fsync_every,
+            fsync_deadline_seconds=getattr(
+                tc, "journal_fsync_deadline_ms", 0.0) / 1e3,
             retries=tc.io_retries,
             backoff_seconds=tc.io_backoff_seconds,
             deadline_seconds=tc.io_deadline_seconds)
@@ -336,6 +357,12 @@ class ServingFrontend:
             self._base_key = None          # rebuilt at next dispatch
         self._requests[uid] = req
         self._queue.append(uid)
+        pc = self.engine.prefix_cache
+        if pc is not None and getattr(pc, "async_io", False):
+            # scheduler hint: ring-prefetch this prompt's spilled
+            # prefix span NOW, behind the in-flight step's compute,
+            # so the adoption walk at join time finds it staged
+            pc.hint_adoptions(prompt)
         self.metrics.record_request("submitted")
         return req
 
@@ -634,6 +661,12 @@ class ServingFrontend:
                 "serving front-end stuck: requests waiting but no "
                 "schedulable work and nothing in flight (out of KV "
                 "blocks / engine full)")
+        pc = engine.prefix_cache
+        if pc is not None and getattr(pc, "async_io", False):
+            # async tiered demotion: kick right AFTER the dispatch so
+            # the d2h + encode + store flush overlap step k+1's device
+            # compute; finalization happens on the NEXT kick's poll
+            pc.kick_demotions()
         t1 = metrics.now()
 
         # ---- collect step k while k+1 computes; deliver tokens

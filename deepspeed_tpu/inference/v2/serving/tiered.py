@@ -42,13 +42,18 @@ DRAM+disk — asserted under a seeded chaos matrix in the tests. The
 int8/int4 codecs trade that for footprint and are off by default.
 """
 
+import threading
+import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ....resilience.errors import InjectedFault, StoreCorruptionError
+from ....resilience.errors import (InjectedFault, StoreBackpressure,
+                                   StoreCorruptionError)
 from ....resilience.fault_injector import fault_injector
 from ....runtime.store import decode_kv, encode_kv
+from ....runtime.transfer.ring import PrefetchRing
 from ....telemetry.anomaly import TelemetryAlert
 from ....telemetry.trace import span
 from ..ragged_manager import SchedulingError
@@ -76,6 +81,21 @@ class _SpilledEntry:
         self.tick = tick
 
 
+class _Staged:
+    """One ring-prefetched spilled block parked host-side: the
+    IoWorker sets ``arr``/``error`` + ``seconds`` then ``event``; the
+    adoption walk consumes it (or the sync path ignores it)."""
+    __slots__ = ("event", "arr", "error", "seconds", "tier", "ring")
+
+    def __init__(self, tier: str):
+        self.event = threading.Event()
+        self.arr = None
+        self.error: Optional[Exception] = None
+        self.seconds = 0.0
+        self.tier = tier
+        self.ring: Optional[PrefetchRing] = None
+
+
 class TieredPrefixCache(PrefixCache):
     """``PrefixCache`` + spill tiers.
 
@@ -86,15 +106,52 @@ class TieredPrefixCache(PrefixCache):
     and promotion never recompile anything.
     """
 
+    # staged prefetches parked at once (LRU-bounded; a stale stage is
+    # just a wasted read, never wrong data — promote re-checks)
+    _STAGE_LIMIT = 64
+
     def __init__(self, block_size: int, allocator, max_blocks: int = 0,
                  *, kv_io, dram_store, disk_store=None,
-                 codec: str = "none", alert_sink=None):
+                 codec: str = "none", alert_sink=None,
+                 async_io: bool = False, prefetch_depth: int = 4,
+                 max_inflight_demotions: int = 4):
         super().__init__(block_size, allocator, max_blocks=max_blocks)
         self.kv_io = kv_io
         self.dram = dram_store
         self.disk = disk_store
         self.codec = codec
         self.alert_sink = alert_sink
+        # ---- async tiered I/O (PR 18) ----
+        # requires dram_store to be an AsyncSpillQueue (the frontend
+        # builds one from serving.prefix.tiers.async_io); its IoWorker
+        # also runs the promotion prefetch staging
+        self.async_io = bool(async_io) and dram_store is not None \
+            and hasattr(dram_store, "put_async")
+        self.prefetch_depth = max(0, int(prefetch_depth))
+        self.max_inflight_demotions = max(1, int(max_inflight_demotions))
+        self._worker = dram_store.worker if self.async_io else None
+        self._async_lock = threading.Lock()
+        # digest -> {"tick": tick-at-kick}; the gathered payload is in
+        # flight on the IoWorker, the entry is STILL HOT in the trie
+        self._demote_inflight: Dict[bytes, dict] = {}
+        self._demote_done: List[tuple] = []   # (d, err, seconds)
+        self._prefetch_stage: "OrderedDict[bytes, _Staged]" = \
+            OrderedDict()
+        # the ring whose kick is currently executing (hint rearm or a
+        # consumed stage's advance) — _stage_fetch stamps it on the
+        # _Staged it creates so consuming THAT stage advances too
+        self._ring_box: Optional[PrefetchRing] = None
+        self.demote_aborts = 0
+        self.spill_backpressure = 0
+        self.prefetch_kicks = 0
+        self.prefetch_hits = 0
+        self.prefetch_misses = 0
+        self.prefetch_errors = 0
+        # the overlap split the bench decompositions publish
+        self.cache_demote_exposed_ms = 0.0
+        self.cache_demote_overlapped_ms = 0.0
+        self.cache_promote_exposed_ms = 0.0
+        self.cache_promote_overlapped_ms = 0.0
         self._spilled: Dict[bytes, _SpilledEntry] = {}
         # parent digest -> spilled child digests, kept in lockstep
         # with _spilled so a subtree purge walks only the subtree
@@ -141,6 +198,30 @@ class TieredPrefixCache(PrefixCache):
             "disk_blocks": len(self.disk) if self.disk is not None
             else 0,
             "disk_bytes": getattr(self.disk, "used_bytes", 0),
+            # async tiered I/O (zeros when synchronous — the schema
+            # is stable so dashboards/watchers never lose the metric)
+            "async_io": int(self.async_io),
+            "demote_inflight": len(self._demote_inflight),
+            "demote_aborts": self.demote_aborts,
+            "spill_backpressure": self.spill_backpressure,
+            "prefetch_kicks": self.prefetch_kicks,
+            "prefetch_hits": self.prefetch_hits,
+            "prefetch_misses": self.prefetch_misses,
+            "prefetch_errors": self.prefetch_errors,
+            "cache_demote_exposed_ms": self.cache_demote_exposed_ms,
+            "cache_demote_overlapped_ms":
+                self.cache_demote_overlapped_ms,
+            "cache_promote_exposed_ms": self.cache_promote_exposed_ms,
+            "cache_promote_overlapped_ms":
+                self.cache_promote_overlapped_ms,
+        })
+        q = self.dram.stats() if hasattr(self.dram, "stats") else {}
+        out.update({
+            "spill_queued": q.get("queued", 0),
+            "spill_flushed": q.get("flushed", 0),
+            "spill_flush_errors": q.get("flush_errors", 0),
+            "spill_backlog": q.get("backlog", 0),
+            "spill_backlog_bytes": q.get("backlog_bytes", 0),
         })
         return out
 
@@ -200,17 +281,56 @@ class TieredPrefixCache(PrefixCache):
           the spilled entry SURVIVES — next adopter may have room;
         * degrade (unreadable/corrupt payload or injected fault): the
           digest is quarantined and its spilled subtree purged.
-        """
+
+        The serving-thread wall of this call is the *exposed* half of
+        ``cache_promote_*``; a consumed prefetch stage moves the store
+        read + decode into the *overlapped* half."""
+        t_wall = time.perf_counter()
+        try:
+            return self._promote_impl(d, s)
+        finally:
+            self.cache_promote_exposed_ms += \
+                (time.perf_counter() - t_wall) * 1e3
+
+    def _promote_impl(self, d: bytes, s: _SpilledEntry) -> Optional[int]:
+        arr = None
+        staged = self._prefetch_stage.pop(d, None) \
+            if self.async_io else None
+        if staged is not None:
+            staged.event.wait()    # residual wait — exposed
+            if staged.error is None:
+                arr = staged.arr
+                self.prefetch_hits += 1
+                self.cache_promote_overlapped_ms += \
+                    staged.seconds * 1e3
+                if staged.ring is not None:
+                    # windowed release: pull the chain's next spilled
+                    # block into the stage behind this adoption
+                    self._ring_box = staged.ring
+                    try:
+                        staged.ring.advance()
+                    finally:
+                        self._ring_box = None
+            else:
+                # prefetch is ADVISORY: a failed staging fetch falls
+                # back to the synchronous read below — it must never
+                # degrade the block on its own
+                self.prefetch_errors += 1
+        elif self.async_io:
+            self.prefetch_misses += 1
         store = self.dram if s.tier == "dram" else self.disk
         try:
             with span("cache.promote", tier=s.tier):
+                # one choke point for the promote drill + degrade
+                # valve whether or not the bytes were prefetched
                 fault_injector.fire("cache.promote", detail=s.tier)
-                if store is None:
-                    raise StoreCorruptionError(
-                        f"spilled entry {d.hex()} names tier "
-                        f"{s.tier!r} but that store is not mounted")
-                payload, meta = store.get(d)
-                arr = decode_kv(payload, meta)
+                if arr is None:
+                    if store is None:
+                        raise StoreCorruptionError(
+                            f"spilled entry {d.hex()} names tier "
+                            f"{s.tier!r} but that store is not mounted")
+                    payload, meta = store.get(d)
+                    arr = decode_kv(payload, meta)
         except _SPILL_FAILURES as exc:
             self._degrade(d, exc)
             return None
@@ -261,6 +381,201 @@ class TieredPrefixCache(PrefixCache):
                         f"recompute: {type(exc).__name__}: "
                         f"{str(exc)[:120]}"))
 
+    # -- async demotion: kick after dispatch, finalize on next poll -----
+    def kick_demotions(self) -> int:
+        """Serving-thread entry point the frontend calls right AFTER
+        dispatching the step's compiled work (the PR 2 rule: compiled
+        multi-device dispatch stays on the main thread — what moves to
+        the IoWorker is host copies + store I/O only). First finalizes
+        flushes that landed, then — while the trie is over
+        ``max_blocks`` — kicks up to ``max_inflight_demotions``
+        leaf-first victims: the jitted d2h gather is dispatched HERE,
+        arrival wait + encode + checksum + store put run on the
+        worker. The entry stays HOT until ``poll_demotions`` sees its
+        flush land, so a crash, kill drill, or backpressure anywhere
+        in between leaves the block exactly where it was (the PR 16
+        contract, now spanning a step boundary)."""
+        if not self.async_io:
+            return 0
+        self.poll_demotions()
+        if not self.max_blocks:
+            return 0
+        t0 = time.perf_counter()
+        kicked = 0
+        failed: set = set()
+        # entries minus inflight = trie size once pending flushes
+        # finalize; stop kicking when THAT is inside the budget
+        while (len(self._demote_inflight) < self.max_inflight_demotions
+               and len(self._entries) - len(self._demote_inflight)
+               > self.max_blocks):
+            guard = (self._walk_guard | set(self._demote_inflight)
+                     | failed)
+            leaves = [d for d in self._leaves() if d not in guard]
+            if not leaves:
+                break
+            if self._kick_one_demotion(leaves[0]):
+                kicked += 1
+            else:
+                failed.add(leaves[0])
+        # only the kick wall (gather dispatch + queue handoff) is on
+        # the serving thread — that's the exposed half
+        self.cache_demote_exposed_ms += (time.perf_counter() - t0) * 1e3
+        return kicked
+
+    def _kick_one_demotion(self, d: bytes) -> bool:
+        """Dispatch the gather and hand the flush to the IoWorker.
+        Returns False — entry stays hot, counted — on gather faults or
+        spill-queue backpressure."""
+        e = self._entries[d]
+        try:
+            with span("cache.demote", tier="dram", block=e.block):
+                # same drill choke point as the sync path: a kill here
+                # drops the demotion before any state moved
+                fault_injector.fire("cache.demote", detail="dram")
+                read_async = getattr(self.kv_io,
+                                     "read_kv_block_async", None)
+                dev = (read_async(e.block) if read_async is not None
+                       else self.kv_io.read_kv_block(e.block))
+        except _SPILL_FAILURES:
+            self.demote_failures += 1
+            return False
+        self._demote_inflight[d] = {"tick": e.tick}
+        try:
+            self.dram.put_async(
+                d, dev, self.codec,
+                on_done=lambda err, secs, _d=d:
+                    self._note_demote_done(_d, err, secs))
+        except StoreBackpressure:
+            # the valve: skip this demotion, entry stays hot, the
+            # next kick retries once the queue drains
+            self._demote_inflight.pop(d, None)
+            self.spill_backpressure += 1
+            return False
+        return True
+
+    def _note_demote_done(self, d: bytes, err, seconds: float) -> None:
+        """IoWorker-thread callback: record only — every trie/pool
+        mutation happens on the serving thread in poll_demotions."""
+        with self._async_lock:
+            self._demote_done.append((d, err, seconds))
+
+    def poll_demotions(self) -> int:
+        """Finalize flushes that landed since the last call (serving
+        thread). Only here does cache state move: a flush whose entry
+        was touched meanwhile — re-adopted (tick moved), mid-walk, or
+        gone — is ABORTED: the just-spilled payload is deleted (the
+        one-tier invariant) and the entry keeps its HBM residency."""
+        if not self.async_io:
+            return 0
+        with self._async_lock:
+            if not self._demote_done:
+                return 0
+            done, self._demote_done = self._demote_done, []
+        finalized = 0
+        for d, err, seconds in done:
+            rec = self._demote_inflight.pop(d, None)
+            if rec is None:
+                continue
+            if err is not None:
+                self.demote_failures += 1  # entry stays hot
+                continue
+            e = self._entries.get(d)
+            if (e is None or e.tick != rec["tick"]
+                    or d in self._walk_guard):
+                self.demote_aborts += 1
+                try:
+                    self.dram.delete(d)
+                except _SPILL_FAILURES:
+                    pass
+                continue
+            self._entries.pop(d)
+            self.allocator.free([e.block])
+            self._spill_add(d, _SpilledEntry("dram", e.parent, e.tick))
+            self.demoted_blocks += 1
+            # the flush's worker-side wall (arrival wait + encode +
+            # checksum + put) — work the step compute hid
+            self.cache_demote_overlapped_ms += seconds * 1e3
+            if self.journal is not None:
+                self.journal.append(("tier", d, "dram"))
+            finalized += 1
+        if finalized:
+            self._rebalance()
+        return finalized
+
+    # -- promotion prefetch: stage ahead of the adoption walk -----------
+    def hint_adoptions(self, tokens: np.ndarray) -> int:
+        """Scheduler hint at submit time: walk the prompt's digest
+        chain WITHOUT mutating anything and ring-prefetch the spilled
+        span the adoption walk is about to promote. Purely advisory —
+        the stage is consumed (or ignored) by ``_promote``; a stale or
+        failed stage costs a wasted read, never a wrong byte."""
+        if not self.async_io:
+            return 0
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        n_max = max(0, (len(tokens) - 1) // bs)
+        parent = _ROOT
+        chain: List[bytes] = []
+        for i in range(n_max):
+            d = self._digest(parent, tokens[i * bs:(i + 1) * bs])
+            if d in self._entries:
+                parent = d
+                continue  # hot — the walk sails past it
+            s = self._spilled.get(d)
+            if s is None or d in self._quarantine:
+                break  # the walk will stop here too
+            if d not in self._prefetch_stage:
+                chain.append(d)
+            parent = d
+        if not chain:
+            return 0
+        # windowed ring over the spilled span: the first
+        # prefetch_depth blocks stage now, each consumed stage
+        # advances the ring one block (in _promote)
+        ring = PrefetchRing(chain, kick=self._stage_fetch)
+        before = self.prefetch_kicks
+        self._ring_box = ring
+        try:
+            ring.rearm(self.prefetch_depth)
+        finally:
+            self._ring_box = None
+        return self.prefetch_kicks - before
+
+    def _stage_fetch(self, d: bytes) -> None:
+        """Ring kick target: park one staged read on the IoWorker."""
+        s = self._spilled.get(d)
+        if (s is None or d in self._quarantine
+                or d in self._prefetch_stage):
+            return
+        store = self.dram if s.tier == "dram" else self.disk
+        if store is None:
+            return
+        st = _Staged(s.tier)
+        st.ring = self._ring_box
+        self._prefetch_stage[d] = st
+        while len(self._prefetch_stage) > self._STAGE_LIMIT:
+            self._prefetch_stage.popitem(last=False)
+        self.prefetch_kicks += 1
+
+        def _job():
+            t0 = time.perf_counter()
+            try:
+                with span("cache.prefetch", tier=st.tier):
+                    # advisory site: a fault here only voids the
+                    # staged copy — _promote falls back to the sync
+                    # read, never degrades on a prefetch failure
+                    fault_injector.fire("cache.prefetch",
+                                        detail=st.tier)
+                    payload, meta = store.get(d)
+                    st.arr = decode_kv(payload, meta)
+            except _SPILL_FAILURES as exc:
+                st.error = exc
+            finally:
+                st.seconds = time.perf_counter() - t0
+                st.event.set()
+
+        self._worker.submit(_job)
+
     # -- eviction becomes demotion --------------------------------------
     def _evict(self, count: int = 0, need_free: int = 0,
                exclude=None) -> int:
@@ -278,6 +593,17 @@ class TieredPrefixCache(PrefixCache):
         guard = self._walk_guard
         if exclude:
             guard = guard | set(exclude)
+        if self._demote_inflight:
+            # a digest mid-flight to the spill queue must not be
+            # sync-demoted (or evicted) underneath its pending flush:
+            # poll's abort path would then delete the LIVE payload
+            guard = guard | set(self._demote_inflight)
+        if count and not need_free and self.async_io:
+            # async mode: the size bound is enforced by
+            # kick_demotions after dispatch — insert() never blocks
+            # on a demotion. need_free (the scheduler's pressure
+            # valve) stays fully synchronous below.
+            return 0
         if self.dram is None:
             return super()._evict(count=count, need_free=need_free,
                                   exclude=guard)
@@ -314,8 +640,10 @@ class TieredPrefixCache(PrefixCache):
         """One HBM entry down to DRAM. All fallible work happens
         BEFORE any trie/pool mutation: gather, encode, store write —
         an injected kill or exhausted retry budget anywhere in that
-        window returns (False, 0) with the entry untouched."""
+        window returns (False, 0) with the entry untouched. The whole
+        wall is serving-thread blocking — all *exposed*."""
         e = self._entries[d]
+        t0 = time.perf_counter()
         try:
             with span("cache.demote", tier="dram", block=e.block):
                 fault_injector.fire("cache.demote", detail="dram")
@@ -324,6 +652,9 @@ class TieredPrefixCache(PrefixCache):
                 self.dram.put(d, payload, meta)
         except _SPILL_FAILURES:
             return False, 0
+        finally:
+            self.cache_demote_exposed_ms += \
+                (time.perf_counter() - t0) * 1e3
         self._entries.pop(d)
         before = self.allocator.free_blocks
         self.allocator.free([e.block])
@@ -372,6 +703,10 @@ class TieredPrefixCache(PrefixCache):
 
     def _spill_remove(self, d: bytes) -> Optional[_SpilledEntry]:
         s = self._spilled.pop(d, None)
+        # a spilled entry leaving its tier invalidates any parked
+        # prefetch of it (_promote pops its OWN stage before landing
+        # here, so a consumed stage is never discarded)
+        self._prefetch_stage.pop(d, None)
         if s is None:
             return None
         kids = self._spill_children.get(s.parent)
@@ -458,6 +793,10 @@ class TieredPrefixCache(PrefixCache):
             self._drop_spilled(d)
         self._spill_children.clear()
         self._quarantine.clear()
+        self._prefetch_stage.clear()
+        # _demote_inflight is NOT cleared: a pending flush's payload
+        # still lands in the store, and poll_demotions' abort path
+        # (entry gone) is what deletes it again
         return freed
 
     def close(self) -> None:

@@ -228,6 +228,16 @@ class ParamStreamError(ResilienceError):
     ``StoreCorruptionError`` instead (retrying cannot fix those)."""
 
 
+class StoreBackpressure(ResilienceError):
+    """The write-behind spill queue (runtime/store.py
+    AsyncSpillQueue) is at its byte bound: background flushes are not
+    draining as fast as the caller produces spills. Typed so callers
+    choose their own valve — the tiered cache skips the demotion (the
+    entry stays hot, retried next step), the param wire falls back to
+    a synchronous put (counted exposed) — instead of an unbounded
+    pending queue eating the host."""
+
+
 class InjectedFault(ResilienceError):
     """A deliberately injected failure (FaultInjector). Base class so
     tests can distinguish injected faults from organic ones."""

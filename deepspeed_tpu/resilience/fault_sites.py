@@ -120,6 +120,21 @@ FAULT_SITES = {
         "block store payload read + checksum verify (runtime/store.py "
         "get; detail = tier name); a persistent fault here is the "
         "degrade-to-recompute drill",
+    "store.flush":
+        "write-behind spill flush on the background IoWorker "
+        "(runtime/store.py AsyncSpillQueue._flush; detail = tier): "
+        "fires BEFORE the encode + store put, so a kill here drops "
+        "the flush — the entry stays hot in its old tier (async "
+        "demotions are only finalized after the flush reports "
+        "success) and a pending param drop latches a typed error "
+        "raised at the next cycle",
+    "cache.prefetch":
+        "tiered prefix cache: one fire per ring-prefetched staging "
+        "fetch (tiered.py _stage_fetch; detail = tier), on the "
+        "IoWorker BEFORE the store read. Prefetch is advisory: a "
+        "fault here only voids the staged copy — the adoption walk "
+        "falls back to the synchronous promote path, it never "
+        "degrades the block",
     # ---- parameter-residency wire (runtime/zero/param_stream.py) ----
     "param.fetch":
         "param stream: one fire per leaf fetched from the param store "

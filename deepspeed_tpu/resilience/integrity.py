@@ -34,17 +34,25 @@ def atomic_write_text(path: str, text: str):
     os.replace(tmp, path)
 
 
-def atomic_write_bytes(path: str, payload_writer):
+def atomic_write_bytes(path: str, payload_writer, durable: bool = True):
     """Atomic binary write: ``payload_writer(fileobj)`` streams the
     payload into a tmp file which is fsynced then renamed over
     ``path``. A kill at ANY point leaves either the old file or no
-    file — never a truncated one under the final name."""
+    file — never a truncated one under the final name.
+
+    ``durable=False`` skips the per-file fsync — for callers that
+    batch durability themselves (the block store's group-commit
+    cadence) and hold an integrity backstop (checksum verify at read)
+    against the power-loss torn-page window the fsync closed. The
+    rename atomicity (old-or-new, never partial under the final name)
+    is unaffected."""
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
             payload_writer(f)
             f.flush()
-            os.fsync(f.fileno())
+            if durable:
+                os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:
         # don't leave tmp litter behind on failure; the original

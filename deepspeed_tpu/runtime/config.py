@@ -263,6 +263,10 @@ class TelemetryAnomalyConfig(DeepSpeedConfigModel):
     slope_window: int = 16
     rss_slope_gb_per_step: float = 0.0
     hbm_slope_gb_per_step: float = 0.0
+    # write-behind spill-queue backlog growth (entries/step): the
+    # async tiered-I/O queue filling faster than its IoWorker drains
+    # is a stall-in-waiting (cache/spill_backlog metric); 0 disables
+    spill_backlog_slope_per_step: float = 2.0
 
 
 @dataclasses.dataclass
@@ -312,8 +316,29 @@ class ServingPrefixTiersConfig(DeepSpeedConfigModel):
     io_backoff_seconds: float = 0.02
     io_deadline_seconds: float = 5.0
     # disk index journal fsync cadence (records per fsync; 1 = every
-    # append — safest, slowest)
+    # append — safest, slowest). With >1 the payload fsync rides the
+    # same group commit (see README "Async tiered I/O")
     journal_fsync_every: int = 8
+    # group-commit deadline (ms): an unsynced journal tail older than
+    # this fsyncs on the next append even below the count cadence,
+    # bounding crash loss in wall time; 0 = count cadence only
+    journal_fsync_deadline_ms: float = 0.0
+    # ---- async tiered I/O (PR 18) ----
+    # write-behind demotion + ring-prefetched promotion: tier
+    # crossings ride a background IoWorker instead of blocking the
+    # serving thread. Greedy streams stay bitwise identical async
+    # on/off (same payload bytes, same degrade valve); off = every
+    # crossing synchronous (simplest failure semantics)
+    async_io: bool = False
+    # pending write-behind queue bound (MB); at the bound demotions
+    # are skipped for the step (typed StoreBackpressure, entry stays
+    # hot) instead of growing host memory
+    spill_queue_mb: float = 64.0
+    # demotions in flight at once (kicked after a step's dispatch)
+    max_inflight_demotions: int = 4
+    # spilled chain blocks staged ahead of prefill per adoption hint
+    # (the shared prefetch ring's window); 0 disables prefetch
+    prefetch_depth: int = 4
 
 
 @dataclasses.dataclass

@@ -39,13 +39,14 @@ README "Tiered prefix cache" for when that trade is acceptable).
 import hashlib
 import json
 import os
+import threading
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..resilience.errors import StoreCorruptionError
+from ..resilience.errors import StoreBackpressure, StoreCorruptionError
 from ..resilience.fault_injector import fault_injector
 from ..resilience.integrity import atomic_write_bytes
 from ..resilience.retry import retry_io
@@ -293,10 +294,19 @@ class DiskBlockStore:
     def __init__(self, root: str, max_bytes: int = 0, *,
                  fsync_every: int = 8, retries: int = 3,
                  backoff_seconds: float = 0.02,
-                 deadline_seconds: float = 5.0):
+                 deadline_seconds: float = 5.0,
+                 fsync_deadline_seconds: float = 0.0):
         self.root = str(root)
         self.max_bytes = max(0, int(max_bytes))
         self.fsync_every = max(0, int(fsync_every))
+        # group-commit deadline: an unsynced journal tail older than
+        # this is fsynced on the next append even below the count
+        # cadence, bounding the crash-loss window in wall time (0 =
+        # count cadence only)
+        self.fsync_deadline_seconds = max(0.0, float(
+            fsync_deadline_seconds))
+        self._first_unsynced_t = 0.0
+        self.fsyncs = 0
         self._io = _IoPolicy(retries, backoff_seconds, deadline_seconds)
         self._blocks_dir = os.path.join(self.root, "blocks")
         os.makedirs(self._blocks_dir, exist_ok=True)
@@ -398,11 +408,30 @@ class DiskBlockStore:
         os.write(self._jfd, line)
         self._journal_records += 1
         if self.fsync_every:
+            if self._since_sync == 0:
+                self._first_unsynced_t = time.perf_counter()
             self._since_sync += 1
+            deadline_due = (
+                self.fsync_deadline_seconds > 0.0
+                and time.perf_counter() - self._first_unsynced_t
+                >= self.fsync_deadline_seconds)
             if self._since_sync >= self.fsync_every or \
-                    self._journal_records == 1:
-                os.fsync(self._jfd)
-                self._since_sync = 0
+                    self._journal_records == 1 or deadline_due:
+                self._journal_fsync()
+
+    def _journal_fsync(self) -> None:
+        """The group-commit point: every appended record is durable
+        after this returns."""
+        if self._jfd is not None and self._since_sync:
+            os.fsync(self._jfd)
+            self.fsyncs += 1
+            self._since_sync = 0
+
+    def flush(self) -> None:
+        """Force the group commit now (durability barrier for callers
+        that need 'everything journaled so far survives a crash' —
+        checkpoint save, drain-on-close)."""
+        self._journal_fsync()
 
     # an append-only journal grows with CHURN, not contents — bound it
     # by rewriting live entries once dead records dominate (and only
@@ -467,9 +496,22 @@ class DiskBlockStore:
                 {"rec": "put", "k": key.hex(), "size": len(payload),
                  "b2": b2, "meta": meta})
 
+            # PR 18 bugfix: the put path used to fsync once PER
+            # APPEND — the payload file's own fsync inside
+            # atomic_write_bytes — even while journal_fsync_every > 1
+            # batched the index. Fold it into the group-commit
+            # cadence: in group mode the payload write stays atomic
+            # (rename) but not individually durable; durability is
+            # the journal's batched fsync + the blake2b/size verify
+            # at get() and recover() (a torn payload degrades to
+            # recompute, never serves). fsync_every<=1 keeps the
+            # strict legacy per-put durability.
+            per_put_durable = self.fsync_every <= 1
+
             def write():
                 atomic_write_bytes(self._block_path(key),
-                                   lambda f: f.write(payload))
+                                   lambda f: f.write(payload),
+                                   durable=per_put_durable)
 
             self._io.run("store.write", self.tier, write,
                          "disk-tier block write")
@@ -557,3 +599,245 @@ class DiskBlockStore:
                 "journal_records": self._journal_records,
                 "compactions": self.compactions,
                 "recovery": self.recovery.as_dict()}
+
+
+class AsyncSpillQueue:
+    """Write-behind front for a block store (PR 18).
+
+    Wraps a ``HostBlockStore`` / ``DiskBlockStore`` with (a) a
+    **bounded pending queue** of un-flushed puts drained by a shared
+    background ``IoWorker`` (runtime/transfer/ring.py), and (b) a
+    **lock** serializing every store access, so the serving/train
+    thread and the flush thread can both touch the underlying store
+    safely. The wrapper implements the same store contract as what it
+    wraps — callers swap it in without code changes.
+
+    Semantics the callers rely on:
+
+    * ``put_async(key, arr, codec)`` enqueues the ENCODE as well as
+      the write: the caller hands over the raw array (host ndarray,
+      or an already-dispatched device array — ``np.asarray`` on the
+      worker is the d2h arrival wait, thread-safe per the PR 2 rule)
+      and pays none of the checksum/codec/fsync cost. Queue full →
+      typed ``StoreBackpressure`` (callers choose the valve; the
+      pending map never grows past ``max_pending_bytes``).
+    * **Coalescing**: a re-put of a pending key replaces the pending
+      value in place (param leaves re-put every cycle); the
+      superseded flush job no-ops. A *synchronous* ``put`` of a
+      pending key cancels the pending flush first, so a stale
+      background value can never overwrite a newer direct write.
+    * **Read-through**: ``get`` of a pending key encodes the pending
+      array on the reader's thread — byte-identical to what the
+      flush will eventually store, so readers never observe the
+      write-behind window (the param wire re-fetches leaves it just
+      dropped; bitwise contract holds).
+    * Flush errors are reported via the ``on_done`` callback when
+      given, else latched (``take_error``) — a failed spill must
+      surface, not vanish on a daemon thread.
+    * ``drain()`` blocks until the queue is empty; ``close()`` drains
+      then closes the store (write-behind never loses acknowledged
+      puts on an orderly shutdown).
+    """
+
+    def __init__(self, store, *, max_pending_bytes: int = 64 << 20,
+                 worker=None, name: Optional[str] = None):
+        from .transfer.ring import IoWorker
+        self._store = store
+        self.tier = store.tier
+        self.max_pending_bytes = max(1, int(max_pending_bytes))
+        self._lock = threading.RLock()
+        self.worker = worker if worker is not None else IoWorker(
+            name or f"spill-{store.tier}")
+        # key -> pending record; drained FIFO by _flush jobs on the
+        # worker (one job per put_async; superseded jobs no-op)
+        self._pending: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._pending_bytes = 0
+        self._seq = 0
+        self._errors: List[Exception] = []  # latched; drained by take_error
+        self.queued = 0
+        self.flushed = 0
+        self.coalesced = 0
+        self.backpressure_events = 0
+        self.flush_errors = 0
+        self.read_through = 0
+        self.flush_ms = 0.0
+
+    # -- write-behind ---------------------------------------------------
+    def put_async(self, key: bytes, arr, codec: str = "none",
+                  on_done: Optional[Callable] = None) -> None:
+        """Enqueue ``arr`` (host or device array) for background
+        encode + put. Raises ``StoreBackpressure`` when the pending
+        queue is at its byte bound and ``key`` is not coalescable."""
+        nbytes = int(getattr(arr, "nbytes", 0))
+        with self._lock:
+            prior = self._pending.get(key)
+            if prior is None and \
+                    self._pending_bytes + nbytes > self.max_pending_bytes:
+                self.backpressure_events += 1
+                raise StoreBackpressure(
+                    f"spill queue ({self.tier}) full: "
+                    f"{self._pending_bytes + nbytes} pending bytes "
+                    f"over the {self.max_pending_bytes} bound "
+                    f"(backlog {len(self._pending)})")
+            self._seq += 1
+            seq = self._seq
+            if prior is not None:
+                self._pending_bytes -= prior["nbytes"]
+                self.coalesced += 1
+            self._pending[key] = {"arr": arr, "codec": codec,
+                                  "nbytes": nbytes, "seq": seq,
+                                  "on_done": on_done}
+            self._pending_bytes += nbytes
+            self.queued += 1
+        self.worker.submit(lambda: self._flush(key, seq))
+
+    def _flush(self, key: bytes, seq: int) -> None:
+        """Worker-side flush of one pending put. Superseded (newer
+        put_async / sync put / delete of the key) → no-op."""
+        with self._lock:
+            rec = self._pending.get(key)
+            if rec is None or rec["seq"] != seq:
+                return
+            arr, codec = rec["arr"], rec["codec"]
+        err: Optional[Exception] = None
+        t0 = time.perf_counter()
+        try:
+            with span("store.flush", tier=self.tier,
+                      bytes=rec["nbytes"]):
+                fault_injector.fire("store.flush", detail=self.tier)
+                # np.ascontiguousarray inside encode_kv is the d2h
+                # arrival wait when ``arr`` is a device array
+                payload, meta = encode_kv(np.asarray(arr), codec)
+                with self._lock:
+                    cur = self._pending.get(key)
+                    if cur is None or cur["seq"] != seq:
+                        return  # superseded while encoding
+                    self._store.put(key, payload, meta)
+                    self._pending.pop(key)
+                    self._pending_bytes -= rec["nbytes"]
+                    self.flushed += 1
+        except Exception as e:  # noqa: BLE001 — any flush failure latches
+            err = e
+            with self._lock:
+                cur = self._pending.get(key)
+                if cur is not None and cur["seq"] == seq:
+                    self._pending.pop(key)
+                    self._pending_bytes -= rec["nbytes"]
+                self.flush_errors += 1
+                if rec["on_done"] is None:
+                    self._errors.append(e)
+        seconds = time.perf_counter() - t0
+        with self._lock:
+            self.flush_ms += seconds * 1e3
+        if rec["on_done"] is not None:
+            rec["on_done"](err, seconds)
+
+    def take_error(self) -> Optional[Exception]:
+        """Pop the first latched flush error (None when clean)."""
+        with self._lock:
+            return self._errors.pop(0) if self._errors else None
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every background job (including other users of
+        a shared worker) has finished."""
+        return self.worker.drain(timeout)
+
+    # -- the store contract (lock-serialized passthrough) ---------------
+    def put(self, key: bytes, payload: bytes, meta: Dict) -> None:
+        with self._lock:
+            prior = self._pending.pop(key, None)
+            if prior is not None:
+                # cancel the pending flush: the direct write is newer
+                self._pending_bytes -= prior["nbytes"]
+            self._store.put(key, payload, meta)
+
+    def get(self, key: bytes) -> Tuple[bytes, Dict]:
+        with self._lock:
+            rec = self._pending.get(key)
+            if rec is not None:
+                self.read_through += 1
+                return encode_kv(np.asarray(rec["arr"]), rec["codec"])
+            return self._store.get(key)
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            prior = self._pending.pop(key, None)
+            if prior is not None:
+                self._pending_bytes -= prior["nbytes"]
+            self._store.delete(key)
+
+    def pop_lru(self):
+        # rebalance pops flushed entries only; pending ones are not
+        # yet resident in this tier
+        with self._lock:
+            return self._store.pop_lru()
+
+    def keys(self) -> List[bytes]:
+        with self._lock:
+            ks = self._store.keys()
+            ks.extend(k for k in self._pending if k not in self._store)
+            return ks
+
+    def __contains__(self, key: bytes) -> bool:
+        with self._lock:
+            return key in self._pending or key in self._store
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._store) + sum(
+                1 for k in self._pending if k not in self._store)
+
+    @property
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._store.used_bytes
+
+    @property
+    def over_budget(self) -> bool:
+        with self._lock:
+            return self._store.over_budget
+
+    @property
+    def backlog(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    @property
+    def backlog_bytes(self) -> int:
+        with self._lock:
+            return self._pending_bytes
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {"queued": self.queued, "flushed": self.flushed,
+                    "coalesced": self.coalesced,
+                    "backpressure_events": self.backpressure_events,
+                    "flush_errors": self.flush_errors,
+                    "read_through": self.read_through,
+                    "backlog": len(self._pending),
+                    "backlog_bytes": self._pending_bytes,
+                    "flush_ms": self.flush_ms}
+
+    def close(self) -> None:
+        """Drain then close: write-behind must not lose acknowledged
+        puts on an orderly shutdown (crash loss is the journal's
+        group-commit window, covered by recover())."""
+        if not self.drain(timeout=30.0):
+            logger.warning(
+                "spill queue (%s): close() drain timed out with %d "
+                "pending", self.tier, self.backlog)
+        with self._lock:
+            self._pending.clear()
+            self._pending_bytes = 0
+            if hasattr(self._store, "flush"):
+                try:
+                    self._store.flush()
+                except OSError:
+                    pass
+            self._store.close()
+
+    def __getattr__(self, name):
+        # read-only stats/introspection passthrough (as_dict,
+        # recovery, max_bytes, ...); the mutating contract above is
+        # explicit and lock-serialized
+        return getattr(self._store, name)

@@ -7,6 +7,7 @@ path (comm/comm.py all_reduce_coalesced)."""
 from .bucketizer import (ArrivalTracker, BucketPlan, FillTracker,  # noqa: F401
                          StreamPlan, bucket_ranges)
 from .engine import TransferEngine, start_host_copy  # noqa: F401
+from .ring import IoWorker, OverlapClock, PrefetchRing  # noqa: F401
 from .staging import StagingPair  # noqa: F401
 from .streaming import (StreamSchedule, WireClock, WireGroup,  # noqa: F401
                         build_wire_groups)

@@ -1,0 +1,200 @@
+"""One shared prefetch/demotion ring for train and serve (PR 18).
+
+PR 17's parameter-residency wire and PR 16's tiered prefix cache each
+grew half of the same machine: a *windowed kick/collect ring* over an
+ordered list of labeled transfers, plus exposed/overlapped wall-clock
+attribution, plus (implicitly) a background thread for the host half
+of the I/O. This module is the extraction — three small pieces the
+two surfaces now share instead of re-implementing:
+
+``PrefetchRing``
+    The windowed kick state machine. ``rearm(window)`` kicks the
+    first ``window`` items (0 = all, the maximum-overlap mode);
+    ``ensure(label)`` late-kicks on demand (the *exposed* path — the
+    consumer arrived before the prefetch did); ``advance()`` releases
+    the next unkicked item after a collect, so a window of k keeps k
+    transfers in flight across the whole pass instead of only the
+    first k. Every kick opens a ``ring.kick`` span. The ring does NOT
+    perform I/O itself — the kick callback does — so the same state
+    machine drives store fetch + ``device_put`` (param wire), store
+    get + decode staging (cache promotion), and anything else with
+    "ordered items, bounded lookahead" shape.
+
+``OverlapClock``
+    Kick→collect attribution without a device probe:
+    ``mark_kick()`` once when the window opens, ``note_block(t0,t1)``
+    per blocking wait, ``split(prefix)`` returns
+    ``{prefix}_exposed_ms`` (wall the caller actually blocked) and
+    ``{prefix}_overlapped_ms`` (the rest of the kick→last-collect
+    window — transfer time hidden behind other work). This is the
+    inline math ``param_stream.gather`` used for ``param_h2d_*``,
+    extracted; ``WireClock`` (transfer/streaming.py) remains the
+    probe-based d2h variant.
+
+``IoWorker``
+    ONE lazily-started daemon thread draining a FIFO of host-I/O
+    thunks — the execution substrate for write-behind spills
+    (store.AsyncSpillQueue) and prefetch staging (tiered cache).
+    Jobs must be **host work only**: ``np.asarray`` of device arrays,
+    codec encode/decode, store puts/gets. Compiled multi-device
+    dispatch stays on the main thread (the PR 2 rule — background
+    dispatch deadlocks the collective rendezvous); transfers of
+    already-dispatched arrays are thread-safe (the ``_ProbeWatcher``
+    precedent, streaming.py). Jobs may not raise: the worker guards
+    and logs, because one bad spill must not kill the drain thread
+    every later spill depends on.
+"""
+
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ...telemetry.trace import span
+from ...utils.logging import logger
+
+__all__ = ["IoWorker", "OverlapClock", "PrefetchRing"]
+
+
+class OverlapClock:
+    """Exposed/overlapped attribution for one kick→collect window."""
+
+    def __init__(self):
+        self.t_kick = 0.0
+        self.t_last = 0.0
+        self._waits: List[tuple] = []
+
+    def mark_kick(self):
+        """Stamp the window open; resets prior waits."""
+        self.t_kick = time.perf_counter()
+        self.t_last = self.t_kick
+        self._waits = []
+
+    def note_block(self, t0: float, t1: float):
+        """Record one blocking wait ``[t0, t1]`` on the caller."""
+        if t1 > t0:
+            self._waits.append((t0, t1))
+        if t1 > self.t_last:
+            self.t_last = t1
+
+    def split(self, prefix: str) -> Dict[str, float]:
+        """``{prefix}_exposed_ms`` = wall the caller blocked;
+        ``{prefix}_overlapped_ms`` = rest of the kick→last window."""
+        exposed = sum(b - a for a, b in self._waits)
+        total = max(0.0, self.t_last - self.t_kick)
+        return {
+            f"{prefix}_exposed_ms": exposed * 1e3,
+            f"{prefix}_overlapped_ms": max(0.0, total - exposed) * 1e3,
+        }
+
+
+class IoWorker:
+    """One daemon thread draining a FIFO of host-I/O thunks."""
+
+    def __init__(self, name: str = "io-worker"):
+        self.name = name
+        # drained continuously by _run; depth is bounded by the
+        # callers' own backpressure (AsyncSpillQueue byte cap, ring
+        # window), not by the queue itself
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._outstanding = 0
+        self.errors = 0
+
+    def submit(self, fn: Callable[[], None]):
+        """Enqueue ``fn`` to run on the worker thread (FIFO)."""
+        with self._cv:
+            self._outstanding += 1
+        self._q.put(fn)
+        self._ensure_thread()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted job has finished. Returns
+        False when ``timeout`` (seconds) elapsed first."""
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: self._outstanding == 0, timeout)
+
+    @property
+    def backlog(self) -> int:
+        with self._cv:
+            return self._outstanding
+
+    def _ensure_thread(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name=self.name, daemon=True)
+                self._thread.start()
+
+    def _run(self):
+        while True:
+            fn = self._q.get()
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — worker must survive any job
+                self.errors += 1
+                logger.exception(
+                    "io worker %s: job raised (job errors should be "
+                    "latched by the submitter, not thrown)", self.name)
+            finally:
+                with self._cv:
+                    self._outstanding -= 1
+                    self._cv.notify_all()
+
+
+class PrefetchRing:
+    """Windowed kick state machine over ordered labeled items."""
+
+    def __init__(self, labels: Sequence[str],
+                 kick: Callable[[str], None],
+                 nbytes: Optional[Callable[[str], int]] = None):
+        self.labels = list(labels)
+        self._kick = kick
+        self._nbytes = nbytes or (lambda label: 0)
+        self._kicked = set()
+
+    def rearm(self, window: int) -> int:
+        """Reset and kick the first ``window`` items (0 = all).
+        Returns the total bytes kicked (the in-flight window)."""
+        self._kicked.clear()
+        kicked_bytes = 0
+        for i, label in enumerate(self.labels):
+            if window and i >= int(window):
+                break
+            self._do_kick(label)
+            kicked_bytes += int(self._nbytes(label))
+        return kicked_bytes
+
+    def ensure(self, label: str) -> bool:
+        """Late-kick ``label`` if its prefetch never fired. Returns
+        True when the kick happened here (the exposed path)."""
+        if label in self._kicked:
+            return False
+        self._do_kick(label)
+        return True
+
+    def advance(self) -> Optional[str]:
+        """Kick the next never-kicked item, if any — called after a
+        collect so a window of k stays k deep across the pass."""
+        for label in self.labels:
+            if label not in self._kicked:
+                self._do_kick(label)
+                return label
+        return None
+
+    def kicked(self, label: str) -> bool:
+        return label in self._kicked
+
+    def _do_kick(self, label: str):
+        # labels may be bytes digests (cache rings) — hexlify for the
+        # JSON trace sink; param-group labels pass through unchanged
+        tag = label.hex()[:12] if isinstance(label, bytes) \
+            else str(label)
+        with span("ring.kick", label=tag):
+            self._kick(label)
+        self._kicked.add(label)

@@ -79,6 +79,16 @@ class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
     # published residency gauges compare total param bytes and the
     # in-flight window against it; 0 = unknown/unlimited
     hbm_budget_mb: float = 0.0
+    # write-behind drop phase (PR 18): cycle() enqueues the store
+    # puts on a background IoWorker (runtime/store.py AsyncSpillQueue)
+    # and overlaps them with the next step's compute; a flush failure
+    # latches and raises typed ParamStreamError at the next cycle,
+    # backpressure falls back to a synchronous put (counted exposed).
+    # Bitwise: the wire re-reads pending leaves through the queue
+    # (byte-identical read-through), so streamed losses are unchanged
+    async_io: bool = False
+    # pending write-behind bound (MB) before the synchronous fallback
+    spill_queue_mb: float = 256.0
 
     COMPAT_FIELDS = frozenset({"buffer_count", "buffer_size",
                                "max_in_cpu"})
@@ -113,6 +123,10 @@ class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
             raise ValueError(
                 f"offload_param.hbm_budget_mb must be >= 0 (0 = "
                 f"unlimited), got {self.hbm_budget_mb!r}")
+        if not float(self.spill_queue_mb) > 0:
+            raise ValueError(
+                f"offload_param.spill_queue_mb must be positive, got "
+                f"{self.spill_queue_mb!r}")
 
 
 @dataclasses.dataclass

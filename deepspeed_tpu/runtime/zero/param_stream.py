@@ -59,6 +59,7 @@ instead of requiring a resident full-model upload before step 0.
 """
 
 import json
+import threading
 import time
 import weakref
 from typing import Dict, List, Optional, Sequence
@@ -66,14 +67,17 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
-from ...resilience.errors import ParamStreamError, StoreCorruptionError
+from ...resilience.errors import (ParamStreamError, StoreBackpressure,
+                                  StoreCorruptionError)
 from ...resilience.fault_injector import fault_injector
 from ...resilience.retry import retry_io
 from ...telemetry.trace import span
 from ...utils.jax_compat import TRANSFER_ERRORS
 from ...utils.logging import logger
-from ..store import DiskBlockStore, HostBlockStore, decode_kv, encode_kv
+from ..store import (AsyncSpillQueue, DiskBlockStore, HostBlockStore,
+                     decode_kv, encode_kv)
 from ..transfer import TransferEngine, start_host_copy
+from ..transfer.ring import OverlapClock, PrefetchRing
 from ..transfer.streaming import WireClock
 from .schedule import param_wire_groups
 
@@ -91,7 +95,13 @@ ZERO_BREAKDOWN = {"param_d2h_exposed_ms": 0.0,
                   "param_d2h_overlapped_ms": 0.0,
                   "param_h2d_exposed_ms": 0.0,
                   "param_h2d_overlapped_ms": 0.0,
-                  "param_fetch_ms": 0.0}
+                  "param_fetch_ms": 0.0,
+                  # drop-phase store-put split (PR 18): exposed = the
+                  # cycle's own put wall (sync puts, or async enqueue
+                  # + backpressure fallbacks); overlapped = background
+                  # flush wall reported since the previous cycle
+                  "param_drop_exposed_ms": 0.0,
+                  "param_drop_overlapped_ms": 0.0}
 
 
 def _leaf_key(name: str) -> bytes:
@@ -206,8 +216,30 @@ class ParamStreamCoordinator:
             plan = self._transfer.plan_specs(
                 [self._specs[s] for s in g.slots])
             self._gstate[g.label] = _GroupState(plan)
-        self._store = open_param_store(self.tier,
-                                       nvme_path=cfg.nvme_path)
+        store = open_param_store(self.tier, nvme_path=cfg.nvme_path)
+        self._async = bool(getattr(cfg, "async_io", False))
+        if self._async:
+            # write-behind drop phase: store puts ride the IoWorker;
+            # the wire re-reads pending leaves through the queue
+            # (byte-identical read-through), so prefetch correctness
+            # and the bitwise contract are untouched
+            store = AsyncSpillQueue(
+                store, max_pending_bytes=max(1, int(float(
+                    getattr(cfg, "spill_queue_mb", 256.0)) * (1 << 20))),
+                name="param-spill")
+        self._store = store
+        self._drop_lock = threading.Lock()
+        self._drop_err: Optional[Exception] = None
+        self._drop_overlap_s = 0.0
+        self.drop_backpressure = 0
+        # the shared windowed kick/collect ring (transfer/ring.py) —
+        # the same machine the tiered cache's promotion prefetch runs
+        self._gmap = {g.label: g for g in self.groups}
+        self._fetch_box = None
+        self._ring = PrefetchRing(
+            [g.label for g in self.groups], kick=self._ring_kick,
+            nbytes=lambda label: self._gstate[label].nbytes)
+        self._h2d_clock = OverlapClock()
         self._resident = True
         self._mirrored = False     # host mirrors bound into the tree?
         self._closed = False
@@ -246,6 +278,41 @@ class ParamStreamCoordinator:
                                   self._codec_for(slot))
         self._store.put(_leaf_key(self.names[slot]), payload, meta)
 
+    def _store_put_async(self, slot: int, value: np.ndarray) -> None:
+        """Drop-phase put: write-behind when the wire is async (the
+        flush overlaps the next step's compute), synchronous
+        otherwise — and the synchronous FALLBACK when the spill queue
+        is at its bound (counted, exposed)."""
+        if self._async:
+            try:
+                self._store.put_async(
+                    _leaf_key(self.names[slot]), np.asarray(value),
+                    self._codec_for(slot), on_done=self._on_drop_flush)
+                return
+            except StoreBackpressure:
+                self.drop_backpressure += 1
+        self._store_put(slot, value)
+
+    def _on_drop_flush(self, err: Optional[Exception],
+                       seconds: float) -> None:
+        # IoWorker thread: latch only — raised typed at the next cycle
+        with self._drop_lock:
+            if err is not None:
+                if self._drop_err is None:
+                    self._drop_err = err
+            else:
+                self._drop_overlap_s += seconds
+
+    def _raise_drop_error(self) -> None:
+        with self._drop_lock:
+            err, self._drop_err = self._drop_err, None
+        if err is not None:
+            if isinstance(err, StoreCorruptionError):
+                raise err
+            raise ParamStreamError(
+                f"param stream: background drop flush failed "
+                f"({type(err).__name__}: {err})") from err
+
     def seed(self, leaves) -> None:
         """(Re)write every streamed leaf's current value into the
         store — construction, and after a checkpoint restore replaced
@@ -262,6 +329,7 @@ class ParamStreamCoordinator:
         and re-arm the prefetch ring for the next gather. Returns the
         new master tree. MAIN thread (the h2d kicks dispatch
         ``device_put`` transfers; the d2h waits are plain transfers)."""
+        self._raise_drop_error()
         flat, treedef = jax.tree_util.tree_flatten(master)
         arrs = [flat[s] for s in self.idx]
         clock = WireClock()
@@ -269,14 +337,17 @@ class ParamStreamCoordinator:
             start_host_copy(a)
         clock.kick(probe)
         host_np = [None] * len(self.idx)
+        drop_exposed = 0.0
         for g in self.groups:
             with span("param.drop", group=g.label, n=len(g.slots)):
                 t0 = time.perf_counter()
                 vals = [np.asarray(arrs[s]) for s in g.slots]
                 clock.note_wait(t0, time.perf_counter())
+                t1 = time.perf_counter()
                 for s, v in zip(g.slots, vals):
-                    self._store_put(s, v)
+                    self._store_put_async(s, v)
                     host_np[s] = v
+                drop_exposed += time.perf_counter() - t1
         d2h = clock.split(prefix="param_d2h")
         new_flat = list(flat)
         for slot, i in enumerate(self.idx):
@@ -292,25 +363,39 @@ class ParamStreamCoordinator:
         # gather recorded must survive until the NEXT gather replaces it
         self.last_breakdown.update(d2h)
         self.last_breakdown["param_fetch_ms"] = fetch_ms[0]
+        self.last_breakdown["param_drop_exposed_ms"] = \
+            drop_exposed * 1e3
+        # flush wall the IoWorker reported since the previous cycle —
+        # by construction that wall ran UNDER the step's compute (one
+        # cycle of lag; the soak's steady state is exact)
+        with self._drop_lock:
+            self.last_breakdown["param_drop_overlapped_ms"] = \
+                self._drop_overlap_s * 1e3
+            self._drop_overlap_s = 0.0
         return jax.tree_util.tree_unflatten(treedef, new_flat)
 
     def _rearm(self, fetch_ms=None) -> None:
-        """Drop per-group staging and kick the first ``prefetch``
-        groups' fused uploads; the tree is non-resident until the next
-        gather scatters the buckets back."""
-        self._h2d_t_kick = time.perf_counter()
-        self.window_bytes = 0
-        kicked = 0
+        """Drop per-group staging and re-arm the shared prefetch ring:
+        the first ``prefetch`` groups' fused uploads kick now (0 =
+        all); the tree is non-resident until the next gather scatters
+        the buckets back."""
+        self._h2d_clock.mark_kick()
+        self._h2d_t_kick = self._h2d_clock.t_kick
         for g in self.groups:
             st = self._gstate[g.label]
             st.dev = None
             st.kicked = False
-        for g in self.groups:
-            if self.prefetch == 0 or kicked < self.prefetch:
-                self._kick_group(g, fetch_ms)
-                kicked += 1
-                self.window_bytes += self._gstate[g.label].nbytes
+        self._fetch_box = fetch_ms
+        try:
+            self.window_bytes = self._ring.rearm(self.prefetch)
+        finally:
+            self._fetch_box = None
         self._resident = False
+
+    def _ring_kick(self, label: str) -> None:
+        """The ring's kick callback: one layer group's store fetch +
+        staged fused ``device_put``."""
+        self._kick_group(self._gmap[label], self._fetch_box)
 
     def _mirror(self, value: np.ndarray, slot: int):
         """Bind one streamed leaf's host bytes back into the state
@@ -383,23 +468,19 @@ class ParamStreamCoordinator:
         if self._resident:
             return None
         flat, treedef = jax.tree_util.tree_flatten(master)
-        t_kick = self._h2d_t_kick or time.perf_counter()
-        exposed = 0.0
-        t_last = t_kick
+        clk = self._h2d_clock
         new_flat = list(flat)
         for g in self.groups:
             st = self._gstate[g.label]
             if not st.kicked:
                 # prefetch window exhausted before this group: the
                 # late (exposed) fallback — fetch + upload now
-                self._kick_group(g)
+                self._ring.ensure(g.label)
             t0 = time.perf_counter()
             for buckets in st.dev:
                 for b in buckets:
                     b.block_until_ready()
-            t1 = time.perf_counter()
-            exposed += t1 - t0
-            t_last = t1
+            clk.note_block(t0, time.perf_counter())
             leaves = self._transfer.unpack(
                 st.plan, st.dev,
                 shardings=[self._shardings[s] for s in g.slots])
@@ -407,10 +488,11 @@ class ParamStreamCoordinator:
                 new_flat[self.idx[s]] = leaves[m]
             st.dev = None
             st.kicked = False
-        window = max(0.0, t_last - t_kick)
-        self.last_breakdown["param_h2d_exposed_ms"] = exposed * 1e3
-        self.last_breakdown["param_h2d_overlapped_ms"] = \
-            max(0.0, window - exposed) * 1e3
+            # windowed release: pull the next never-kicked group
+            # forward so its fetch + h2d overlaps this group's unpack
+            # and the remaining waits (a window of k stays k deep)
+            self._ring.advance()
+        self.last_breakdown.update(clk.split("param_h2d"))
         self._resident = True
         self._mirrored = False
         return jax.tree_util.tree_unflatten(treedef, new_flat)
@@ -456,9 +538,14 @@ class ParamStreamCoordinator:
                "hbm_budget_bytes": int(self.hbm_budget_bytes),
                "over_budget": bool(
                    self.hbm_budget_bytes
-                   and self.total_bytes > self.hbm_budget_bytes)}
+                   and self.total_bytes > self.hbm_budget_bytes),
+               "async_io": bool(self._async)}
         out.update(self.residency())
         out.update(self.last_breakdown)
+        if self._async:
+            out["drop_backpressure"] = int(self.drop_backpressure)
+            out.update({f"spill_{k}": v
+                        for k, v in self._store.stats().items()})
         return out
 
     def close(self) -> None:

@@ -209,4 +209,11 @@ def default_watchers(anomaly_cfg) -> List[Watcher]:
     if hbm > 0:
         ws.append(SlopeWatcher("memory/device_gb_in_use", hbm,
                                window=win))
+    sb = float(getattr(anomaly_cfg, "spill_backlog_slope_per_step",
+                       0.0))
+    if sb > 0:
+        # the async tiered-I/O stall watch: the write-behind spill
+        # queue growing without draining means the IoWorker can't
+        # keep up — backpressure (skipped demotions) is next
+        ws.append(SlopeWatcher("cache/spill_backlog", sb, window=win))
     return ws

@@ -146,6 +146,21 @@ SPAN_SITES = {
     "store.read":
         "one block-store payload read + checksum verify incl. retries "
         "(args: tier) — runtime/store.py",
+    "store.flush":
+        "one write-behind spill flush on the background IoWorker "
+        "(args: tier, bytes): d2h arrival wait (serving demotions), "
+        "codec encode + blake2b, store put — runtime/store.py "
+        "AsyncSpillQueue._flush; the wall here is the overlapped half "
+        "of cache_demote/param_drop",
+    "cache.prefetch":
+        "one spilled block's ring-prefetched staging ahead of prefill "
+        "(args: tier): store read + verify + decode on the IoWorker, "
+        "parked host-side until the adoption walk consumes it — "
+        "tiered.py _stage_fetch",
+    "ring.kick":
+        "one prefetch-ring item kick (args: label) — the shared "
+        "windowed ring (runtime/transfer/ring.py) arming a transfer: "
+        "param layer-group fetch+h2d, or a cache prefetch stage",
     # ---- parameter-residency wire (runtime/zero/param_stream.py) ----
     "param.prefetch":
         "one layer group's store fetch + staging + fused h2d bucket "

@@ -1,0 +1,333 @@
+"""Async tiered-store I/O for the serving cache (PR 18): demotions
+kicked after step dispatch and finalized on a later poll (write-behind
+via the shared IoWorker), ring-prefetched promotion staged ahead of
+prefill, the PR 16 contracts (crash-leaves-entry-hot, one tier at a
+time, walk guard, degrade-to-recompute) held across the new async
+window — and the acceptance gate: greedy streams bitwise identical
+with async on/off, including under seeded chaos."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import RequestState, ServingFrontend
+from deepspeed_tpu.inference.v2.ragged_manager import BlockedAllocator
+from deepspeed_tpu.inference.v2.serving.prefix import chain_digests
+from deepspeed_tpu.inference.v2.serving.tiered import TieredPrefixCache
+from deepspeed_tpu.resilience.fault_injector import fault_injector
+from deepspeed_tpu.runtime.store import AsyncSpillQueue, HostBlockStore
+
+from .test_tiered_cache import (BS, FakeKV, _chain, _engine, _requests,
+                                _tiers_cfg, params_cfg)  # noqa: F401
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault_injector.reset()
+    yield
+    fault_injector.reset()
+
+
+def _async_tiered(n_blocks=16, max_blocks=0, dram_bytes=0,
+                  queue_bytes=64 << 20, **kw):
+    a = BlockedAllocator(n_blocks)
+    kv = FakeKV()
+    dram = AsyncSpillQueue(HostBlockStore(dram_bytes),
+                           max_pending_bytes=queue_bytes)
+    pc = TieredPrefixCache(BS, a, max_blocks=max_blocks, kv_io=kv,
+                           dram_store=dram, disk_store=None,
+                           async_io=True, **kw)
+    assert pc.async_io
+    return pc, a, kv
+
+
+def _settle(pc, timeout=10.0):
+    """Deterministic finalize: drain the spill worker, then poll."""
+    assert pc.dram.drain(timeout=timeout)
+    return pc.poll_demotions()
+
+
+class TestAsyncDemotion:
+
+    def test_insert_defers_and_kick_finalizes_with_overlap(self):
+        pc, a, kv = _async_tiered(max_blocks=2)
+        p1, _ = _chain(pc, a, kv, 0)
+        _chain(pc, a, kv, 100)
+        _chain(pc, a, kv, 200)
+        # async mode: the size bound did NOT demote inside insert()
+        assert pc.cached_blocks == 3 and pc.demoted_blocks == 0
+        assert pc.kick_demotions() == 1
+        d1 = chain_digests(p1, BS)[0]
+        assert d1 in pc._demote_inflight
+        assert pc.resident_tier(d1) == "hbm"   # hot until finalized
+        assert _settle(pc) == 1
+        assert pc.resident_tier(d1) == "dram"  # one tier at a time
+        assert pc.cached_blocks == 2 and pc.demoted_blocks == 1
+        assert a.free_blocks == 16 - 2
+        st = pc.stats()
+        assert st["cache_demote_exposed_ms"] > 0.0
+        assert st["cache_demote_overlapped_ms"] > 0.0
+        assert st["demote_inflight"] == 0
+        # the spilled payload promotes back bitwise
+        blocks, n = pc.match(p1)
+        assert n == BS
+        assert np.array_equal(kv.data[blocks[0]],
+                              np.full((2, 2, BS, 2), 0, np.float32))
+
+    def test_killed_flush_leaves_entry_hot(self):
+        """THE drill: a kill on the background flush drops the spill
+        — nothing torn, the entry simply stays in HBM and the next
+        kick retries."""
+        pc, a, kv = _async_tiered(max_blocks=2)
+        p1, b1 = _chain(pc, a, kv, 0)
+        _chain(pc, a, kv, 100)
+        _chain(pc, a, kv, 200)
+        with fault_injector.inject("store.flush:kill"):
+            pc.kick_demotions()
+            _settle(pc)
+        d1 = chain_digests(p1, BS)[0]
+        assert pc.resident_tier(d1) == "hbm"
+        assert pc.demote_failures == 1 and pc.demoted_blocks == 0
+        assert len(pc.dram) == 0
+        assert np.array_equal(kv.data[b1[0]],
+                              np.full((2, 2, BS, 2), 0, np.float32))
+        pc.kick_demotions()                    # fault cleared: retried
+        _settle(pc)
+        assert pc.demoted_blocks == 1
+
+    def test_kill_at_the_kick_never_reaches_the_queue(self):
+        pc, a, kv = _async_tiered(max_blocks=2)
+        p1, _ = _chain(pc, a, kv, 0)
+        _chain(pc, a, kv, 100)
+        _chain(pc, a, kv, 200)
+        with fault_injector.inject("cache.demote:kill@0xinf"):
+            assert pc.kick_demotions() == 0
+        assert pc.demote_failures >= 1
+        assert not pc._demote_inflight
+        assert pc.resident_tier(chain_digests(p1, BS)[0]) == "hbm"
+
+    def test_backpressure_skips_the_demotion_not_the_step(self):
+        pc, a, kv = _async_tiered(max_blocks=2, queue_bytes=1)
+        p1, _ = _chain(pc, a, kv, 0)
+        _chain(pc, a, kv, 100)
+        _chain(pc, a, kv, 200)
+        assert pc.kick_demotions() == 0        # valve: skipped, typed
+        assert pc.spill_backpressure >= 1
+        assert pc.resident_tier(chain_digests(p1, BS)[0]) == "hbm"
+        assert not pc._demote_inflight
+
+    def test_readopted_entry_aborts_its_inflight_demotion(self):
+        """The coherence hazard the tick check closes: the entry got
+        HOT again while its gathered payload was in flight — the
+        finalize must abort and delete the spilled copy, never leave
+        the digest in two tiers (or demote a block someone adopted)."""
+        pc, a, kv = _async_tiered(max_blocks=2)
+        p1, _ = _chain(pc, a, kv, 0)
+        _chain(pc, a, kv, 100)
+        _chain(pc, a, kv, 200)
+        pc.kick_demotions()
+        assert pc.dram.drain(timeout=10.0)     # flush landed...
+        assert pc.match(p1)[1] == BS           # ...but p1 re-adopted
+        assert pc.poll_demotions() == 0
+        assert pc.demote_aborts == 1
+        d1 = chain_digests(p1, BS)[0]
+        assert pc.resident_tier(d1) == "hbm"   # stayed hot
+        assert len(pc.dram) == 0               # spilled copy retired
+
+    def test_sync_reclaim_never_steals_an_inflight_digest(self):
+        """need_free stays synchronous in async mode, and must route
+        AROUND digests with a pending flush — a sync demote of the
+        same digest would race its own background copy."""
+        pc, a, kv = _async_tiered(max_blocks=2)
+        p1, _ = _chain(pc, a, kv, 0)
+        p2, _ = _chain(pc, a, kv, 100)
+        _chain(pc, a, kv, 200)
+        gate = threading.Event()
+        pc.dram.worker.submit(gate.wait)       # park the flush
+        pc.kick_demotions()
+        d1, d2 = (chain_digests(p, BS)[0] for p in (p1, p2))
+        assert d1 in pc._demote_inflight
+        assert pc.reclaim(1) == 1              # sync valve, d1 shielded
+        assert pc.resident_tier(d2) == "dram"  # the NEXT leaf went
+        gate.set()
+        _settle(pc)
+        assert pc.resident_tier(d1) == "dram"  # flush finalized clean
+        assert pc.demote_aborts == 0
+
+    def test_clear_with_inflight_flush_retires_the_orphan(self):
+        pc, a, kv = _async_tiered(max_blocks=2)
+        _chain(pc, a, kv, 0)
+        _chain(pc, a, kv, 100)
+        _chain(pc, a, kv, 200)
+        gate = threading.Event()
+        pc.dram.worker.submit(gate.wait)
+        pc.kick_demotions()
+        pc.clear()
+        assert pc.cached_blocks == 0
+        gate.set()
+        assert pc.dram.drain(timeout=10.0)     # orphan payload landed
+        pc.poll_demotions()                    # entry gone -> abort
+        assert pc.demote_aborts == 1
+        assert len(pc.dram) == 0
+
+
+class TestPromotePrefetch:
+
+    def _spilled_chain(self, n_blocks=3, **kw):
+        pc, a, kv = _async_tiered(**kw)
+        prompt, _ = _chain(pc, a, kv, 0, n_blocks=n_blocks)
+        pc.reclaim(n_blocks)                   # whole chain to dram
+        assert pc.spilled_blocks == n_blocks
+        return pc, a, kv, prompt
+
+    def test_hint_stages_and_match_consumes_overlapped(self):
+        pc, a, kv, prompt = self._spilled_chain(prefetch_depth=4)
+        assert pc.hint_adoptions(prompt) == 3
+        assert pc.dram.drain(timeout=10.0)     # staging off-thread
+        blocks, n = pc.match(prompt)
+        assert n == 3 * BS
+        st = pc.stats()
+        assert st["prefetch_kicks"] == 3 and st["prefetch_hits"] == 3
+        assert st["prefetch_misses"] == 0
+        assert st["cache_promote_overlapped_ms"] > 0.0
+        for i, b in enumerate(blocks):         # bitwise payloads
+            assert np.array_equal(
+                kv.data[b], np.full((2, 2, BS, 2), i, np.float32))
+        assert not pc._prefetch_stage          # stages consumed
+
+    def test_windowed_ring_advances_behind_the_walk(self):
+        """prefetch_depth=1 over a 3-block spilled span: only the
+        first block stages at hint time; each consumed stage advances
+        the ring, so the whole chain still arrives prefetched."""
+        pc, a, kv, prompt = self._spilled_chain(prefetch_depth=1)
+        assert pc.hint_adoptions(prompt) == 1
+        assert pc.match(prompt)[1] == 3 * BS
+        st = pc.stats()
+        assert st["prefetch_kicks"] == 3 and st["prefetch_hits"] == 3
+
+    def test_prefetch_fault_is_advisory_never_degrades(self):
+        pc, a, kv, prompt = self._spilled_chain()
+        with fault_injector.inject("cache.prefetch:ioerror@0xinf"):
+            pc.hint_adoptions(prompt)
+            assert pc.dram.drain(timeout=10.0)
+            blocks, n = pc.match(prompt)       # sync fallback reads
+        assert n == 3 * BS and pc.degraded == 0
+        assert pc.prefetch_errors >= 1
+        assert pc.stats()["cache_promote_exposed_ms"] > 0.0
+
+    def test_unhinted_match_counts_misses_and_still_serves(self):
+        pc, a, kv, prompt = self._spilled_chain()
+        assert pc.match(prompt)[1] == 3 * BS
+        st = pc.stats()
+        assert st["prefetch_misses"] == 3 and st["prefetch_hits"] == 0
+
+    def test_fresh_insert_invalidates_the_stale_stage(self):
+        """A prefill re-inserting a spilled digest retires the spilled
+        copy AND its parked stage — the stage must never outlive the
+        tier residency it was read from."""
+        pc, a, kv, prompt = self._spilled_chain(n_blocks=1)
+        pc.hint_adoptions(prompt)
+        assert pc.dram.drain(timeout=10.0)
+        assert len(pc._prefetch_stage) == 1
+        _chain(pc, a, kv, 0)                   # fresh prefill, same chain
+        assert not pc._prefetch_stage
+        assert pc.match(prompt)[1] == BS       # served from HBM
+        assert pc.stats()["prefetch_hits"] == 0
+
+    def test_hint_stops_at_the_quarantine_exactly_like_the_walk(self):
+        pc, a, kv, prompt = self._spilled_chain()
+        d1 = chain_digests(prompt, BS)[0]
+        pc._quarantine[d1] = True
+        assert pc.hint_adoptions(prompt) == 0  # walk would stop too
+
+
+class TestServingAsyncBitwiseGate:
+
+    def _serve_settled(self, fe, requests, max_new_tokens=6):
+        """Serial serve that deterministically finalizes the async
+        demotions between requests (drain the spill worker + poll),
+        so tier crossings actually happen before the next submit's
+        hint/match — same schedule, no timing dependence."""
+        pc = fe.engine.prefix_cache
+        out = {}
+        for uid, prompt in requests.items():
+            r = fe.submit(prompt, uid=uid,
+                          max_new_tokens=max_new_tokens)
+            fe.drain()
+            assert r.state == RequestState.FINISHED
+            out[uid] = list(r.tokens)
+            if getattr(pc, "async_io", False):
+                assert pc.dram.drain(timeout=30.0)
+                pc.poll_demotions()
+        return out
+
+    def _async_cfg(self):
+        cfg = _tiers_cfg()
+        cfg["prefix"]["tiers"]["async_io"] = True
+        return cfg
+
+    def test_streams_identical_async_on_off_with_real_crossings(
+            self, params_cfg):
+        """THE acceptance gate: same greedy schedule, sync tiers vs
+        async tiers — bitwise-identical streams, with real async
+        demotions, staged promotions AND zero added blocking syncs
+        (`blocking_sync` counts only the no-dispatch drain steps,
+        exactly like the sync run)."""
+        reqs = _requests()
+        fe_sync = ServingFrontend(_engine(params_cfg), _tiers_cfg())
+        try:
+            refs = self._serve_settled(fe_sync, reqs)
+        finally:
+            fe_sync.close()
+
+        fe = ServingFrontend(_engine(params_cfg), self._async_cfg())
+        try:
+            got = self._serve_settled(fe, reqs)
+            assert got == refs, "stream diverged with async tiers"
+            pc = fe.engine.prefix_cache
+            st = pc.stats()
+            assert st["async_io"] == 1
+            assert st["demoted_blocks"] > 0      # write-behind spills
+            assert st["promoted_blocks"] > 0
+            assert st["prefetch_hits"] > 0       # promote-ahead landed
+            assert st["degraded"] == 0
+            assert st["cache_demote_overlapped_ms"] > 0.0
+            assert st["cache_promote_overlapped_ms"] > 0.0
+            # the serving report carries the async counter schema
+            rep = fe.engine.get_serving_report()["prefix"]
+            for k in ("spill_backlog", "demote_aborts",
+                      "cache_demote_exposed_ms", "prefetch_kicks"):
+                assert k in rep
+        finally:
+            fe.close()
+
+    @pytest.mark.slow
+    def test_chaos_matrix_streams_stay_bitwise(self, params_cfg):
+        """Seeded chaos across every async crossing: killed flushes,
+        slow flushes, failed prefetches, killed demote kicks — the
+        streams never move (degrade-to-recompute + entry-stays-hot do
+        the absorbing) and nothing crashes."""
+        reqs = _requests()
+        fe_sync = ServingFrontend(_engine(params_cfg), _tiers_cfg())
+        try:
+            refs = self._serve_settled(fe_sync, reqs)
+        finally:
+            fe_sync.close()
+        for spec in ("store.flush:kill",
+                     "store.flush:slow@0xinf~0.005",
+                     "cache.prefetch:ioerror@0xinf",
+                     "cache.demote:kill",
+                     "cache.promote:kill"):
+            fe = ServingFrontend(_engine(params_cfg),
+                                 self._async_cfg())
+            try:
+                with fault_injector.inject(spec):
+                    got = self._serve_settled(fe, reqs)
+                assert got == refs, f"stream diverged under {spec}"
+                assert fe.engine.prefix_cache.stats()[
+                    "spilled_blocks"] >= 0   # internals stayed sane
+            finally:
+                fe.close()

@@ -179,7 +179,7 @@ class TestCrashRecoveryDrill:
         s = DiskBlockStore(str(tmp_path), fsync_every=1)
         s.put(b"\x01", b"survivor", {})
 
-        def die(path, writer):
+        def die(path, writer, **kw):
             raise SystemExit("crash after journal append")
 
         monkeypatch.setattr(store_mod, "atomic_write_bytes", die)

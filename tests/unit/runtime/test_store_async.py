@@ -1,0 +1,259 @@
+"""runtime/store.py PR 18 surfaces: the write-behind AsyncSpillQueue
+(background encode+put with coalescing, read-through, typed
+backpressure, latched errors, drain-on-close) and the disk tier's
+journal group commit (payload fsync folded into the batched cadence —
+the syscall-count pin — plus the deadline valve and recovery re-run
+against a torn group tail)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.resilience.errors import (InjectedIOError,
+                                             StoreBackpressure)
+from deepspeed_tpu.resilience.fault_injector import fault_injector
+from deepspeed_tpu.runtime.store import (AsyncSpillQueue,
+                                         DiskBlockStore,
+                                         HostBlockStore, decode_kv,
+                                         encode_kv)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault_injector.reset()
+    yield
+    fault_injector.reset()
+
+
+def _arr(seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((2, 2, 8, 4)).astype(np.float32)
+
+
+def _blocked_queue(**kw):
+    """Queue whose worker is parked on a gate, so pending state is
+    observable deterministically before any flush runs."""
+    q = AsyncSpillQueue(HostBlockStore(0), **kw)
+    gate = threading.Event()
+    q.worker.submit(gate.wait)
+    return q, gate
+
+
+class TestAsyncSpillQueue:
+
+    def test_put_async_flushes_bitwise(self):
+        q = AsyncSpillQueue(HostBlockStore(0))
+        a = _arr(1)
+        q.put_async(b"k", a)
+        assert q.drain(timeout=10.0)
+        payload, meta = q.get(b"k")
+        assert np.array_equal(decode_kv(payload, meta), a)
+        st = q.stats()
+        assert st["queued"] == 1 and st["flushed"] == 1
+        assert st["backlog"] == 0 and st["backlog_bytes"] == 0
+        assert st["flush_ms"] > 0.0
+
+    def test_read_through_serves_pending_bytes_identically(self):
+        q, gate = _blocked_queue()
+        a = _arr(2)
+        q.put_async(b"k", a)
+        assert b"k" in q and len(q) == 1     # visible before flush
+        payload, meta = q.get(b"k")          # reader-thread encode
+        assert q.stats()["read_through"] == 1
+        gate.set()
+        assert q.drain(timeout=10.0)
+        flushed_payload, flushed_meta = q.get(b"k")
+        # the write-behind window was never observable: read-through
+        # bytes == the bytes the flush eventually stored
+        assert payload == flushed_payload and meta == flushed_meta
+
+    def test_coalescing_keeps_only_the_newest_value(self):
+        q, gate = _blocked_queue()
+        q.put_async(b"k", _arr(3))
+        q.put_async(b"k", _arr(4))           # supersedes in place
+        gate.set()
+        assert q.drain(timeout=10.0)
+        st = q.stats()
+        assert st["coalesced"] == 1 and st["flushed"] == 1
+        payload, meta = q.get(b"k")
+        assert np.array_equal(decode_kv(payload, meta), _arr(4))
+
+    def test_backpressure_is_typed_and_coalesce_exempt(self):
+        a = _arr(5)
+        q, gate = _blocked_queue(max_pending_bytes=a.nbytes)
+        q.put_async(b"k1", a)
+        with pytest.raises(StoreBackpressure):
+            q.put_async(b"k2", a)            # new key over the bound
+        q.put_async(b"k1", _arr(6))          # re-put coalesces fine
+        assert q.stats()["backpressure_events"] == 1
+        gate.set()
+        assert q.drain(timeout=10.0)
+
+    def test_sync_put_cancels_the_pending_flush(self):
+        q, gate = _blocked_queue()
+        q.put_async(b"k", _arr(7))
+        direct = encode_kv(_arr(8), "none")
+        q.put(b"k", *direct)                 # newer direct write
+        gate.set()
+        assert q.drain(timeout=10.0)
+        # the stale background value never overwrote the direct one
+        assert q.get(b"k")[0] == direct[0]
+        assert q.stats()["flushed"] == 0
+
+    def test_delete_cancels_the_pending_flush(self):
+        q, gate = _blocked_queue()
+        q.put_async(b"k", _arr(9))
+        q.delete(b"k")      # pending cancelled; store never had it
+        gate.set()
+        assert q.drain(timeout=10.0)
+        assert b"k" not in q and q.stats()["flushed"] == 0
+
+    def test_flush_error_is_latched_not_lost(self):
+        q = AsyncSpillQueue(HostBlockStore(0))
+        with fault_injector.inject("store.flush:ioerror"):
+            q.put_async(b"k", _arr(10))
+            assert q.drain(timeout=10.0)
+        assert q.stats()["flush_errors"] == 1
+        assert isinstance(q.take_error(), InjectedIOError)
+        assert q.take_error() is None        # drained
+        assert b"k" not in q                 # pending retired too
+
+    def test_on_done_callback_reports_success_and_failure(self):
+        q = AsyncSpillQueue(HostBlockStore(0))
+        done = []
+        q.put_async(b"ok", _arr(11),
+                    on_done=lambda e, s: done.append((e, s)))
+        with fault_injector.inject("store.flush:ioerror"):
+            q.put_async(b"bad", _arr(12),
+                        on_done=lambda e, s: done.append((e, s)))
+            assert q.drain(timeout=10.0)
+        assert done[0][0] is None and done[0][1] > 0.0
+        assert isinstance(done[1][0], InjectedIOError)
+        assert q.take_error() is None        # on_done owns the error
+
+    def test_close_drains_before_closing(self):
+        q = AsyncSpillQueue(HostBlockStore(0))
+        for i in range(4):
+            q.put_async(bytes([i]), _arr(i))
+        q.close()
+        assert q.stats()["flushed"] == 4     # nothing lost on shutdown
+
+    def test_shared_worker_serves_two_tiers(self, tmp_path):
+        dram = AsyncSpillQueue(HostBlockStore(0))
+        disk = AsyncSpillQueue(DiskBlockStore(str(tmp_path)),
+                               worker=dram.worker)
+        dram.put_async(b"a", _arr(13))
+        disk.put_async(b"b", _arr(14))
+        assert dram.drain(timeout=10.0)      # drains the SHARED worker
+        assert b"a" in dram and b"b" in disk
+        disk.close()
+
+    def test_passthrough_contract_matches_the_store(self, tmp_path):
+        q = AsyncSpillQueue(DiskBlockStore(str(tmp_path)))
+        q.put(b"k", *encode_kv(_arr(15), "none"))
+        assert q.tier == "disk"
+        assert q.used_bytes > 0 and not q.over_budget
+        assert q.keys() == [b"k"]
+        assert q.pop_lru()[0] == b"k"
+        assert q.as_dict()["entries"] == 0   # __getattr__ passthrough
+        q.close()
+        assert q.closed
+
+
+class TestJournalGroupCommit:
+
+    def _count_fsyncs(self, monkeypatch):
+        calls = []
+        real = os.fsync
+        monkeypatch.setattr(os, "fsync",
+                            lambda fd: (calls.append(fd), real(fd)))
+        return calls
+
+    def test_group_mode_batches_payload_and_journal_fsyncs(
+            self, tmp_path, monkeypatch):
+        """THE bugfix pin: with journal_fsync_every=8, 9 puts used to
+        cost ~11 fsyncs (one per payload inside atomic_write_bytes +
+        the batched journal ones). Folded into the group-commit
+        cadence they cost exactly 2 (first-record commit + one full
+        8-record group), a syscall count a regression can't dodge."""
+        s = DiskBlockStore(str(tmp_path), fsync_every=8)
+        calls = self._count_fsyncs(monkeypatch)
+        for i in range(9):
+            s.put(bytes([i]), b"x" * 32, {})
+        assert len(calls) == 2               # zero payload fsyncs
+        assert s.fsyncs == 2                 # record 1 + the full group
+
+    def test_strict_mode_keeps_per_put_durability(self, tmp_path,
+                                                  monkeypatch):
+        s = DiskBlockStore(str(tmp_path), fsync_every=1)
+        calls = self._count_fsyncs(monkeypatch)
+        for i in range(4):
+            s.put(bytes([i]), b"x" * 32, {})
+        # journal fsync per append AND payload fsync per put
+        assert len(calls) >= 8
+
+    def test_deadline_forces_the_commit_between_groups(self, tmp_path):
+        s = DiskBlockStore(str(tmp_path), fsync_every=1000,
+                           fsync_deadline_seconds=0.01)
+        s.put(b"\x01", b"x", {})             # first record commits
+        assert s.fsyncs == 1
+        s.put(b"\x02", b"x", {})             # group far from full
+        assert s.fsyncs == 1
+        time.sleep(0.02)                     # deadline elapses
+        s.put(b"\x03", b"x", {})
+        assert s.fsyncs == 2                 # committed by age, not fill
+
+    def test_flush_is_the_explicit_commit_barrier(self, tmp_path):
+        s = DiskBlockStore(str(tmp_path), fsync_every=1000)
+        s.put(b"\x01", b"x", {})
+        s.put(b"\x02", b"x", {})
+        before = s.fsyncs
+        s.flush()
+        assert s.fsyncs == before + 1
+        s.flush()                            # nothing unsynced: no-op
+        assert s.fsyncs == before + 1
+
+    def test_recovery_survives_a_torn_group_tail(self, tmp_path):
+        """Crash inside the group-commit window: the journal's tail
+        record is torn mid-line. The next open replays every intact
+        record, counts the torn one as a typed error, verifies the
+        surviving payloads, and never raises."""
+        s = DiskBlockStore(str(tmp_path), fsync_every=64)
+        for i in range(4):
+            s.put(bytes([i]), bytes(16 + i), {})
+        os.close(s._jfd)                     # crash: no flush/compact
+        s._jfd = None
+        with open(s.index_path, "rb") as f:
+            raw = f.read()
+        lines = raw.rstrip(b"\n").split(b"\n")
+        torn = b"\n".join(lines[:-1]) + b"\n" + lines[-1][:9]
+        with open(s.index_path, "wb") as f:  # atomic-ok: test plants the torn tail
+            f.write(torn)
+        s2 = DiskBlockStore(str(tmp_path), fsync_every=64)
+        assert len(s2) == 3                  # intact group survives
+        assert s2.recovery.corrupt_records == 1  # the torn line, counted
+        for i in range(3):
+            payload, _ = s2.get(bytes([i]))
+            assert payload == bytes(16 + i)  # verified, not just listed
+        assert bytes([3]) not in s2
+        s2.close()
+
+    def test_recovery_drops_group_entries_missing_their_payload(
+            self, tmp_path):
+        """The other crash interleaving inside a group: journal
+        records landed (OS buffer) but a payload file didn't — each
+        such entry is dropped and counted, the rest survive."""
+        s = DiskBlockStore(str(tmp_path), fsync_every=64)
+        for i in range(3):
+            s.put(bytes([i]), b"p" * 24, {})
+        os.unlink(s._block_path(bytes([1])))  # its payload never hit
+        os.close(s._jfd)
+        s._jfd = None
+        s2 = DiskBlockStore(str(tmp_path), fsync_every=64)
+        assert len(s2) == 2
+        assert s2.recovery.dropped_entries == 1
+        assert bytes([1]) not in s2
+        s2.close()

@@ -1,0 +1,145 @@
+"""runtime/transfer/ring.py — the shared prefetch/demotion ring (PR
+18): the windowed kick state machine both the param wire and the
+tiered cache drive, the kick→collect overlap clock behind every
+``*_exposed_ms``/``*_overlapped_ms`` split, and the IoWorker daemon
+that executes write-behind spills and prefetch staging."""
+
+import threading
+
+import pytest
+
+from deepspeed_tpu.runtime.transfer.ring import (IoWorker, OverlapClock,
+                                                 PrefetchRing)
+
+
+class TestPrefetchRing:
+
+    def _ring(self, labels, window=0, nbytes=None):
+        kicks = []
+        ring = PrefetchRing(labels, kick=kicks.append, nbytes=nbytes)
+        ring.rearm(window)
+        return ring, kicks
+
+    def test_rearm_zero_kicks_everything_in_order(self):
+        ring, kicks = self._ring(["a", "b", "c"])
+        assert kicks == ["a", "b", "c"]
+        assert all(ring.kicked(x) for x in "abc")
+
+    def test_rearm_window_kicks_prefix_only(self):
+        ring, kicks = self._ring(["a", "b", "c", "d"], window=2)
+        assert kicks == ["a", "b"]
+        assert not ring.kicked("c")
+
+    def test_rearm_returns_kicked_bytes(self):
+        sizes = {"a": 10, "b": 20, "c": 40}
+        ring, _ = self._ring(["a", "b", "c"], window=2,
+                             nbytes=sizes.__getitem__)
+        assert ring.rearm(2) == 30
+        assert ring.rearm(0) == 70
+
+    def test_ensure_late_kicks_exactly_once(self):
+        ring, kicks = self._ring(["a", "b", "c"], window=1)
+        assert ring.ensure("b") is True      # the exposed path
+        assert ring.ensure("b") is False     # already in flight
+        assert ring.ensure("a") is False     # rearm kicked it
+        assert kicks == ["a", "b"]
+
+    def test_advance_releases_next_unkicked(self):
+        ring, kicks = self._ring(["a", "b", "c"], window=1)
+        assert ring.advance() == "b"
+        assert ring.advance() == "c"
+        assert ring.advance() is None        # pass exhausted
+        assert kicks == ["a", "b", "c"]
+
+    def test_advance_skips_late_kicked_items(self):
+        ring, kicks = self._ring(["a", "b", "c"], window=1)
+        ring.ensure("b")
+        assert ring.advance() == "c"
+        assert kicks == ["a", "b", "c"]
+
+    def test_rearm_resets_the_pass(self):
+        ring, kicks = self._ring(["a", "b"], window=0)
+        ring.rearm(0)
+        assert kicks == ["a", "b", "a", "b"]
+
+    def test_bytes_labels_survive_the_kick_span(self):
+        # cache rings use digest (bytes) labels; the ring.kick span
+        # must hexlify them for the JSON trace sink, not crash
+        ring, kicks = self._ring([b"\x01\x02", b"\x03\x04"])
+        assert kicks == [b"\x01\x02", b"\x03\x04"]
+
+    def test_kick_failure_propagates_and_item_stays_unkicked(self):
+        def boom(label):
+            raise OSError("kick died")
+
+        ring = PrefetchRing(["a"], kick=boom)
+        with pytest.raises(OSError):
+            ring.rearm(0)
+        assert not ring.kicked("a")          # retryable via ensure
+
+
+class TestOverlapClock:
+
+    def test_split_attributes_exposed_vs_overlapped(self):
+        c = OverlapClock()
+        c.mark_kick()
+        t = c.t_kick
+        c.note_block(t + 0.010, t + 0.020)   # 10ms blocked
+        c.note_block(t + 0.030, t + 0.050)   # 20ms blocked, last=50ms
+        out = c.split("param_h2d")
+        assert out["param_h2d_exposed_ms"] == pytest.approx(30.0)
+        assert out["param_h2d_overlapped_ms"] == pytest.approx(20.0)
+
+    def test_zero_length_wait_is_not_recorded(self):
+        c = OverlapClock()
+        c.mark_kick()
+        t = c.t_kick
+        c.note_block(t + 0.010, t + 0.010)
+        out = c.split("x")
+        assert out["x_exposed_ms"] == 0.0
+        assert out["x_overlapped_ms"] == pytest.approx(10.0)
+
+    def test_mark_kick_resets_prior_window(self):
+        c = OverlapClock()
+        c.mark_kick()
+        c.note_block(c.t_kick, c.t_kick + 1.0)
+        c.mark_kick()
+        out = c.split("x")
+        assert out["x_exposed_ms"] == 0.0
+        assert out["x_overlapped_ms"] == 0.0
+
+
+class TestIoWorker:
+
+    def test_jobs_run_fifo_and_drain_waits(self):
+        w = IoWorker("t-fifo")
+        got = []
+        for i in range(8):
+            w.submit(lambda i=i: got.append(i))
+        assert w.drain(timeout=10.0)
+        assert got == list(range(8))
+        assert w.backlog == 0
+
+    def test_a_raising_job_does_not_kill_the_drain_thread(self):
+        w = IoWorker("t-err")
+        got = []
+        w.submit(lambda: (_ for _ in ()).throw(OSError("boom")))
+        w.submit(lambda: got.append("alive"))
+        assert w.drain(timeout=10.0)
+        assert got == ["alive"] and w.errors == 1
+
+    def test_drain_timeout_returns_false(self):
+        w = IoWorker("t-slow")
+        gate = threading.Event()
+        w.submit(gate.wait)
+        assert w.drain(timeout=0.05) is False
+        assert w.backlog == 1
+        gate.set()
+        assert w.drain(timeout=10.0)
+
+    def test_thread_is_lazy_and_restarts_after_death(self):
+        w = IoWorker("t-lazy")
+        assert w._thread is None             # nothing until a submit
+        w.submit(lambda: None)
+        assert w.drain(timeout=10.0)
+        assert w._thread is not None and w._thread.daemon

@@ -28,7 +28,8 @@ from deepspeed_tpu.utils.tree import flatten_with_names
 
 
 def _config(stream=True, tier="dram", prefetch=0, bucket_mb=0.25,
-            codec="none", nvme_path=None, hbm_budget_mb=0.0):
+            codec="none", nvme_path=None, hbm_budget_mb=0.0,
+            async_io=False):
     c = {"train_micro_batch_size_per_gpu": 4,
          "gradient_accumulation_steps": 1,
          "optimizer": {"type": "AdamW",
@@ -40,7 +41,7 @@ def _config(stream=True, tier="dram", prefetch=0, bucket_mb=0.25,
     if stream:
         op = {"enabled": True, "tier": tier, "prefetch": prefetch,
               "bucket_mb": bucket_mb, "codec": codec,
-              "hbm_budget_mb": hbm_budget_mb}
+              "hbm_budget_mb": hbm_budget_mb, "async_io": async_io}
         if nvme_path is not None:
             op["nvme_path"] = str(nvme_path)
         c["zero_optimization"]["offload_param"] = op
@@ -153,7 +154,9 @@ class TestCoordinatorUnits:
         assert set(bd) == {"param_d2h_exposed_ms",
                            "param_d2h_overlapped_ms",
                            "param_h2d_exposed_ms",
-                           "param_h2d_overlapped_ms", "param_fetch_ms"}
+                           "param_h2d_overlapped_ms", "param_fetch_ms",
+                           "param_drop_exposed_ms",
+                           "param_drop_overlapped_ms"}
         c.close()
 
     def test_quantized_codec_skips_small_leaves(self):
@@ -213,6 +216,57 @@ class TestCoordinatorUnits:
             assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
         assert src.report["cold_leaves"] == len(fa)
         src.close()
+
+
+# ---------------------------------------------------------------------------
+# async drop overlap (PR 18): drop-phase store writes on the IoWorker
+# ---------------------------------------------------------------------------
+class TestAsyncDropOverlap:
+
+    def test_async_cycle_gather_bitwise_with_drop_overlap(self):
+        tree = _toy_tree()
+        c, _, leaves = _coordinator(tree, async_io=True)
+        m = c.cycle(tree)
+        # cycle returned with drop flushes still in flight — gather's
+        # read-through serves the pending bytes identically
+        g = c.gather(m)
+        for a, b in zip(leaves, jax.tree_util.tree_leaves(g)):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        assert c._store.drain(timeout=10.0)
+        c.cycle(g)
+        bd = c.last_breakdown
+        # the overlapped half reports with a one-cycle lag: cycle 2
+        # publishes cycle 1's background flush wall
+        assert bd["param_drop_overlapped_ms"] > 0.0
+        rep = c.report()
+        assert rep["async_io"] is True
+        assert rep["spill_flushed"] > 0
+        assert rep["drop_backpressure"] == 0
+        c.close()
+
+    def test_async_backpressure_falls_back_to_sync_put(self):
+        tree = _toy_tree()
+        c, _, leaves = _coordinator(tree, async_io=True,
+                                    spill_queue_mb=1e-6)
+        m = c.cycle(tree)            # every leaf over the 1-byte bound
+        g = c.gather(m)
+        for a, b in zip(leaves, jax.tree_util.tree_leaves(g)):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        assert c.report()["drop_backpressure"] > 0
+        c.close()
+
+    @pytest.mark.fault
+    def test_async_flush_error_latches_and_raises_typed(self):
+        tree = _toy_tree()
+        c, _, _ = _coordinator(tree, async_io=True)
+        with fault_injector.inject("store.flush:ioerror@0xinf"):
+            c.cycle(tree)
+            assert c._store.drain(timeout=10.0)
+        # a background flush failure must not vanish on the worker:
+        # the NEXT cycle surfaces it as the wire's typed error
+        with pytest.raises(ParamStreamError):
+            c.cycle(tree)
+        c.close()
 
 
 # ---------------------------------------------------------------------------
@@ -372,6 +426,40 @@ class TestEngineStreaming:
         assert l0 == l1                     # restored stream, bitwise
         e0.close()
         e1.close()
+
+    def test_streamed_losses_bitwise_async_drop(self):
+        """The train-side PR 18 overlap smoke: with async_io the
+        drop-phase store writes ride the IoWorker behind the next
+        step's compute — losses stay bitwise, and the breakdown's
+        drop split shows hidden (overlapped) wall."""
+        _, ref = _train(_config(), steps=3)
+        e, got = _train(_config(async_io=True), steps=3)
+        assert got == ref                   # bitwise, not allclose
+        bd = e.get_offload_breakdown()
+        assert bd["param_drop_overlapped_ms"] > 0.0
+        rep = e.get_schedule_report()["param_stream"]
+        assert rep["async_io"] and rep["spill_flushed"] > 0
+        e.close()
+
+    @pytest.mark.slow
+    def test_async_tier_codec_matrix_bitwise_or_sane(self, tmp_path):
+        """async x tier x codec: codec none stays bitwise with the
+        sync reference on both tiers; lossy codecs stay finite and
+        training still converges (same bar as the sync codec A/B)."""
+        _, ref = _train(_config(), steps=3)
+        for i, kw in enumerate([dict(tier="dram"),
+                                dict(tier="nvme"),
+                                dict(tier="nvme", prefetch=1)]):
+            if kw.get("tier") == "nvme":
+                kw["nvme_path"] = tmp_path / f"a{i}"
+            e, ls = _train(_config(async_io=True, **kw), steps=3)
+            assert ls == ref, kw
+            e.close()
+        for codec in ("int8", "int4"):
+            e, ls = _train(_config(async_io=True, codec=codec), steps=3)
+            assert np.isfinite(ls).all()
+            assert ls[-1] < ls[0] * 1.05, (codec, ls)
+            e.close()
 
     @pytest.mark.fault
     @pytest.mark.slow

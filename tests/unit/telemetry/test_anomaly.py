@@ -100,13 +100,15 @@ class TestDefaults:
         assert metrics == {
             "train/step_time_ms", "offload/overlap_residue_ms",
             "serving/ttft_ms/p50", "serving/itl_ms/p50",
-            "memory/host_rss_gb", "memory/device_gb_in_use"}
+            "memory/host_rss_gb", "memory/device_gb_in_use",
+            "cache/spill_backlog"}
 
     def test_zeros_disable(self):
         from deepspeed_tpu.runtime.config import TelemetryAnomalyConfig
         cfg = TelemetryAnomalyConfig.from_dict({
             "step_time_spike_factor": 0,
-            "residue_spike_factor": 0})
+            "residue_spike_factor": 0,
+            "spill_backlog_slope_per_step": 0})
         assert default_watchers(cfg) == []
 
     def test_alert_is_flat_jsonable(self):

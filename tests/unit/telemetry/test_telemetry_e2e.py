@@ -226,7 +226,8 @@ class TestReportSchemas:
             "post_restore_repairs",
             "param_d2h_exposed_ms", "param_d2h_overlapped_ms",
             "param_h2d_exposed_ms", "param_h2d_overlapped_ms",
-            "param_fetch_ms"}
+            "param_fetch_ms",
+            "param_drop_exposed_ms", "param_drop_overlapped_ms"}
 
     def test_recovery_report_keys(self, setup):
         rep = setup["engine"].get_recovery_report()

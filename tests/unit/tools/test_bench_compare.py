@@ -148,6 +148,48 @@ class TestCompare:
             {"2": row_with({})}, 0.10, {}, set())
         assert miss == []
 
+    def test_dotted_decomp_keys_reach_inside_blocks(self):
+        """ISSUE 18: the async overlap splits are tracked one level
+        INSIDE their blocks — a new row keeping the ``cache`` block
+        but dropping ``cache_demote_overlapped_ms`` from it still
+        fails the gate; lineages predating the split arm nothing."""
+        from bench_compare import TRACKED_DECOMP_KEYS
+        for dk in ("cache.cache_demote_exposed_ms",
+                   "cache.cache_demote_overlapped_ms",
+                   "cache.cache_promote_exposed_ms",
+                   "cache.cache_promote_overlapped_ms"):
+            assert dk in TRACKED_DECOMP_KEYS["7_frontend"]
+        for dk in ("param_stream.param_drop_exposed_ms",
+                   "param_stream.param_drop_overlapped_ms"):
+            assert dk in TRACKED_DECOMP_KEYS["9_bigmodel"]
+
+        def row_with(decomp):
+            r = _row(1.0)
+            r["decomposition"] = decomp
+            return r
+
+        full = {"9_bigmodel": row_with({"param_stream": {
+            "param_drop_exposed_ms": 0.1,
+            "param_drop_overlapped_ms": 9.0}})}
+        split_lost = {"9_bigmodel": row_with({"param_stream": {
+            "streamed_tps": 100.0}})}
+        pre = {"9_bigmodel": row_with({"param_stream": {
+            "streamed_tps": 90.0}})}
+        # armed lineage, new row kept the block but lost the split
+        rows, reg, miss = compare(full, split_lost, 0.10, {}, set())
+        assert reg == []
+        assert rows[0]["status"] == "MISSING-DECOMP"
+        assert sorted(miss) == [
+            "9_bigmodel.decomposition.param_stream.param_drop_exposed_ms",
+            "9_bigmodel.decomposition.param_stream.param_drop_overlapped_ms"]
+        # pre-split lineage arms neither the dotted keys nor a false
+        # positive on the still-present block
+        _, reg, miss = compare(pre, split_lost, 0.10, {}, set())
+        assert reg == [] and miss == []
+        # keeping the split is clean
+        _, reg, miss = compare(full, dict(full), 0.10, {}, set())
+        assert reg == [] and miss == []
+
     def test_floor_trips_after_lineage_clears_it(self):
         """Config 4's 0.8 floor: dormant while the lineage is still
         below the bar (r04->r05 era compares clean), armed once the

@@ -90,11 +90,31 @@ TRACKED_CONFIGS = ("7_frontend", "8_fleet", "9_bigmodel")
 # fails the gate (a refactor silently losing the speculation block
 # would otherwise pass with one fewer number). Artifacts predating
 # the key's introduction compare clean — same arming rule as
-# TRACKED_CONFIGS, applied one level down.
+# TRACKED_CONFIGS, applied one level down. Dotted entries reach
+# INSIDE a block ("cache.cache_demote_overlapped_ms"): the async
+# overlap splits are individually load-bearing — a refactor keeping
+# the cache block but dropping the split must still fail.
 TRACKED_DECOMP_KEYS = {"5": ("speculation",),
-                       "7_frontend": ("speculation", "cache"),
+                       "7_frontend": ("speculation", "cache",
+                                      "cache.cache_demote_exposed_ms",
+                                      "cache.cache_demote_overlapped_ms",
+                                      "cache.cache_promote_exposed_ms",
+                                      "cache.cache_promote_overlapped_ms"),
                        "8_fleet": ("transport", "bootstrap"),
-                       "9_bigmodel": ("param_stream",)}
+                       "9_bigmodel": ("param_stream",
+                                      "param_stream.param_drop_exposed_ms",
+                                      "param_stream.param_drop_overlapped_ms")}
+
+
+def _decomp_has(decomp, key):
+    """Dotted-path membership in a decomposition dict: "a.b" means
+    decomp["a"]["b"] exists (each level a dict along the way)."""
+    node = decomp
+    for part in key.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return False
+        node = node[part]
+    return True
 
 # absolute vs_baseline floors: once a config's LINEAGE has cleared
 # the bar (old side >= floor), no new run may fall back under it —
@@ -147,8 +167,8 @@ def compare(old, new, threshold, per_config, require, floors=None):
             # decomposition-key vanish gate: armed per key once the
             # old row publishes it (pre-introduction rows arm nothing)
             lost = [dk for dk in TRACKED_DECOMP_KEYS.get(key, ())
-                    if dk in (o.get("decomposition") or {})
-                    and dk not in (n.get("decomposition") or {})]
+                    if _decomp_has(o.get("decomposition") or {}, dk)
+                    and not _decomp_has(n.get("decomposition") or {}, dk)]
             row.update(old=ob, new=nb, delta=delta,
                        status="REGRESSION" if regressed
                        else "BELOW-FLOOR" if below_floor
