@@ -825,6 +825,12 @@ def bench_config8(tiny=False, transport="loopback"):
         kv_block_size=block, max_blocks_per_seq=per_seq,
         kv_dtype=kv_dtype, prefix_cache=True)
     fleet_cfg = {"n_replicas": R}
+    # peer block transfer armed (ISSUE 19): shared-prefix traffic that
+    # lands off its home replica FETCHES the prefix over the frame
+    # protocol instead of recomputing it — the decomposition's
+    # blockxfer block prices the trade (near-free on loopback, real
+    # wire cost over --transport socket)
+    fleet_cfg["transfer"] = {"enabled": True}
     if transport == "socket":
         if not tiny:
             # the full-size bench params are shape-only zeros built
@@ -964,6 +970,17 @@ def bench_config8(tiny=False, transport="loopback"):
                     for k in ("records_written", "fsyncs")}
                     if rep["bootstrap"]["journal"] else None),
             },
+            # the peer-transfer ledger (fleet-wide prefix sharing):
+            # blocks fetched from peers vs recomputed, push traffic
+            # (placement prefetch + warm starts), the exposed/
+            # overlapped split of the fetch wall (tracked by the
+            # lineage gate)
+            "blockxfer": {
+                k: rep["blockxfer"][k]
+                for k in ("enabled", "fetched_blocks", "pushed_blocks",
+                          "fetch_hit_rate", "fetch_bytes",
+                          "fetch_exposed_ms", "fetch_overlapped_ms",
+                          "recompute_fallbacks")},
             "memory": _memory_decomposition(
                 memory_gauges(include_arrays=False)),
         },

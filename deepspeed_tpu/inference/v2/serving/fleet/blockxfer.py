@@ -1,0 +1,363 @@
+"""Fleet-wide KV block transfer: peer-to-peer prefix fetch + push.
+
+The router's ``(slot, tier)`` residency map (PR 16) only *scores*
+placement: when affinity loses — a hot replica sheds, a replica dies
+and respawns cold, a prefix spilled to a peer's DRAM/disk — the
+landing replica recomputes the whole shared prefix from tokens. This
+module makes the tier directory a **transfer source**: immutable trie
+blocks (already blake2b-addressed and store-encoded) move between
+replicas over the PR 14 frame protocol instead of being recomputed.
+
+Two RPCs, both riding the existing deadline/retry machinery:
+
+``BLOCK_FETCH`` (read-only)
+    "serve me these digests" — the owner exports each block straight
+    from its HBM trie (d2h gather + codec encode) or spill tier (the
+    stored payload verbatim), each with its blake2b checksum. A
+    re-asked fetch just re-reads; no reply-cache entry needed.
+
+``BLOCK_PUSH`` (effectful, exactly-once via the worker reply cache)
+    "land these verified blocks" — the receiver checks every payload
+    against its checksum and lands it in its DRAM tier as an ordinary
+    spilled entry. The next adoption walk promotes it through the
+    UNCHANGED ``_promote`` path: same verify, same degrade valve,
+    same bitwise output as if the replica had demoted it itself.
+
+``PeerBlockSource`` is the router-side consumer: it fetches a chain
+in ``fetch_chunk_blocks``-sized chunks through a ``PrefetchRing``
+(ordered, windowed, ``ring.kick`` spans), verifies blake2b on arrival
+on its own ``IoWorker`` (the *overlapped* half — chunk i verifies
+while chunk i+1's RPC is in flight), truncates the chain at the first
+missing/corrupt block, and pushes the verified prefix to the
+destination BEFORE the request is submitted there. Every failure mode
+— owner died, RPC timed out, payload corrupt, policy declined — falls
+through to the existing degrade-to-recompute choke point: the
+destination simply prefills the span it didn't receive. Never a wrong
+token, and greedy streams are **bitwise identical** transfer on/off
+(the adopted KV bytes are the same bytes prefill would produce; codec
+``"none"`` is exact).
+
+``TransferPolicy`` decides fetch-vs-recompute from a measured wire
+bytes/ms EWMA against a static recompute-cost prior — optimistic
+before the first sample (the first fetch is also the measurement).
+
+Fault sites (consumer-side, so loopback's synchronous handler
+execution can't leak an InjectedFault into ``Replica._call``'s
+worker-failure accounting): ``blockxfer.fetch`` fires per fetch RPC —
+kind ``corrupt`` poisons the fetched payload (the checksum catches
+it, the chain truncates, the tail recomputes), anything else aborts
+the fetch; ``blockxfer.push`` fires per push RPC before any state
+lands.
+"""
+
+import time
+from typing import Dict, List, Optional
+
+from .....resilience.errors import InjectedFault, WorkerFailureError
+from .....resilience.fault_injector import fault_injector
+from .....runtime.store import blake2b_hex
+from .....runtime.transfer.ring import IoWorker, OverlapClock, \
+    PrefetchRing
+from .....telemetry.trace import span
+from .....utils.logging import logger
+
+__all__ = ["PeerBlockSource", "TransferPolicy"]
+
+
+class TransferPolicy:
+    """Fetch-vs-recompute from a measured wire-rate EWMA.
+
+    Fetch when ``estimated_wire_ms < fetch_margin *
+    recompute_ms_per_block * n_blocks``. The wire rate (payload
+    bytes/ms) and the mean block payload size are EWMAs over completed
+    fetches; before the first sample the policy is OPTIMISTIC (the
+    first fetch is how the rate gets measured at all)."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self._alpha = min(1.0, max(0.0, float(cfg.ewma_alpha)))
+        self.bytes_per_ms = 0.0   # 0 = unmeasured
+        self.block_bytes = 0.0
+
+    def _ewma(self, old: float, new: float) -> float:
+        return new if not old else \
+            (1.0 - self._alpha) * old + self._alpha * new
+
+    def note_fetch(self, nbytes: int, ms: float, n_blocks: int) -> None:
+        if nbytes <= 0 or ms <= 0.0 or n_blocks <= 0:
+            return
+        self.bytes_per_ms = self._ewma(self.bytes_per_ms, nbytes / ms)
+        self.block_bytes = self._ewma(self.block_bytes,
+                                      nbytes / n_blocks)
+
+    def est_fetch_ms(self, n_blocks: int) -> float:
+        """0.0 while unmeasured (the optimistic prior)."""
+        if not self.bytes_per_ms or not self.block_bytes:
+            return 0.0
+        return n_blocks * self.block_bytes / self.bytes_per_ms
+
+    def should_fetch(self, n_blocks: int) -> bool:
+        if n_blocks < max(1, int(self.cfg.min_fetch_blocks)):
+            return False
+        budget = float(self.cfg.fetch_margin) \
+            * float(self.cfg.recompute_ms_per_block) * n_blocks
+        return self.est_fetch_ms(n_blocks) < max(budget, 1e-9)
+
+
+class _ChunkState:
+    """One fetch chunk's lifecycle: the RPC reply parked for the
+    IoWorker's verify pass, then the verified blocks."""
+    __slots__ = ("raw", "error", "verified", "t_done")
+
+    def __init__(self):
+        self.raw: Optional[list] = None
+        self.error: Optional[Exception] = None
+        # list of (digest_hex, payload bytes, meta) in chunk order;
+        # None marks a failed checksum (chain truncation point)
+        self.verified: Optional[list] = None
+        self.t_done = 0.0
+
+
+class PeerBlockSource:
+    """Router-side fetch/verify/push pipeline + the transfer stats
+    block the fleet report publishes under ``"blockxfer"``."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.policy = TransferPolicy(cfg)
+        self._worker = IoWorker("blockxfer")
+        # -- stats (the bench decomposition's blockxfer block) --
+        self.fetch_rpcs = 0
+        self.fetched_blocks = 0
+        self.fetch_bytes = 0
+        self.fetch_failures = 0        # RPC-level (timeout/dead owner)
+        self.fetch_rejects = 0         # checksum failures on arrival
+        self.fetch_hits = 0            # placements that landed blocks
+        self.recompute_fallbacks = 0   # placements that landed none
+        self.policy_declines = 0
+        self.push_rpcs = 0
+        self.pushed_blocks = 0
+        self.push_bytes = 0
+        self.push_failures = 0
+        self.warm_starts = 0
+        self.fetch_exposed_ms = 0.0
+        self.fetch_overlapped_ms = 0.0
+        self.push_ms = 0.0
+
+    # -- stats --------------------------------------------------------
+    @staticmethod
+    def zero_stats() -> Dict:
+        """The ``stats()`` schema, all zeros — what a transfer-off
+        router publishes so the blockxfer block never changes shape
+        with the feature toggle (watchers and the bench decomposition
+        key on a stable schema)."""
+        return {
+            "fetch_rpcs": 0, "fetched_blocks": 0, "fetch_bytes": 0,
+            "fetch_failures": 0, "fetch_rejects": 0, "fetch_hits": 0,
+            "fetch_hit_rate": 0.0, "recompute_fallbacks": 0,
+            "policy_declines": 0, "push_rpcs": 0, "pushed_blocks": 0,
+            "push_bytes": 0, "push_failures": 0, "warm_starts": 0,
+            "fetch_exposed_ms": 0.0, "fetch_overlapped_ms": 0.0,
+            "push_ms": 0.0, "wire_bytes_per_ms": 0.0,
+        }
+
+    def stats(self) -> Dict:
+        attempts = self.fetch_hits + self.recompute_fallbacks
+        return {
+            "fetch_rpcs": self.fetch_rpcs,
+            "fetched_blocks": self.fetched_blocks,
+            "fetch_bytes": self.fetch_bytes,
+            "fetch_failures": self.fetch_failures,
+            "fetch_rejects": self.fetch_rejects,
+            "fetch_hits": self.fetch_hits,
+            "fetch_hit_rate": (self.fetch_hits / attempts)
+            if attempts else 0.0,
+            "recompute_fallbacks": self.recompute_fallbacks,
+            "policy_declines": self.policy_declines,
+            "push_rpcs": self.push_rpcs,
+            "pushed_blocks": self.pushed_blocks,
+            "push_bytes": self.push_bytes,
+            "push_failures": self.push_failures,
+            "warm_starts": self.warm_starts,
+            "fetch_exposed_ms": self.fetch_exposed_ms,
+            "fetch_overlapped_ms": self.fetch_overlapped_ms,
+            "push_ms": self.push_ms,
+            "wire_bytes_per_ms": self.policy.bytes_per_ms,
+        }
+
+    # -- the pipeline -------------------------------------------------
+    def transfer_chain(self, owner, dest, digests: List[bytes],
+                       warm_start: bool = False) -> int:
+        """Fetch ``digests`` (chain order, root-first) from ``owner``,
+        verify, and push the verified prefix into ``dest``'s DRAM
+        tier. Returns blocks landed; 0 on any failure (the caller's
+        recompute path covers the span). Both replicas' RPCs run on
+        the calling (router) thread — only host-side verify work rides
+        the IoWorker."""
+        cap = max(1, int(self.cfg.max_fetch_blocks))
+        digests = list(digests)[:cap]
+        if not digests:
+            return 0
+        if not self.policy.should_fetch(len(digests)):
+            self.policy_declines += 1
+            return 0
+        blocks = self._fetch_verified(owner, digests)
+        if not blocks:
+            self.recompute_fallbacks += 1
+            return 0
+        landed = self._push(dest, blocks)
+        if landed:
+            self.fetch_hits += 1
+            if warm_start:
+                self.warm_starts += 1
+        else:
+            self.recompute_fallbacks += 1
+        return landed
+
+    def _fetch_verified(self, owner, digests: List[bytes]) -> List[dict]:
+        """-> verified push payloads (chain order, truncated at the
+        first missing/corrupt block), [] on fetch failure."""
+        csz = max(1, int(self.cfg.fetch_chunk_blocks))
+        chunks = [digests[i:i + csz] for i in range(0, len(digests),
+                                                    csz)]
+        states = [_ChunkState() for _ in chunks]
+        clock = OverlapClock()
+        clock.mark_kick()
+        wire_ms = [0.0]
+
+        def _kick(idx):
+            st = states[idx]
+            chunk = chunks[idx]
+            spec = fault_injector.consume(
+                "blockxfer.fetch", detail=f"replica{owner.slot}")
+            if spec is not None and spec.kind != "corrupt":
+                st.error = InjectedFault(
+                    f"blockxfer.fetch: injected {spec.kind}")
+                return
+            t0 = time.perf_counter()
+            try:
+                with span("blockxfer.fetch", slot=owner.slot,
+                          n=len(chunk)):
+                    st.raw = owner.fetch_blocks(
+                        [d.hex() for d in chunk])
+            except WorkerFailureError as e:
+                st.error = e
+                return
+            finally:
+                t1 = time.perf_counter()
+                clock.note_block(t0, t1)   # RPC wait = exposed
+                wire_ms[0] += (t1 - t0) * 1e3
+            self.fetch_rpcs += 1
+            poison = spec is not None
+            self._worker.submit(
+                lambda st=st, poison=poison: self._verify(st, poison))
+
+        ring = PrefetchRing(list(range(len(chunks))), kick=_kick)
+        ring.rearm(1)
+        for i in range(1, len(chunks)):
+            if states[i - 1].error is not None:
+                break   # chain is truncated anyway — stop fetching
+            ring.advance()
+        t0 = time.perf_counter()
+        self._worker.drain(timeout=30.0)
+        clock.note_block(t0, time.perf_counter())  # residual verify wait
+        # worker-side verify walls extend the window -> overlapped
+        for st in states:
+            if st.t_done:
+                clock.note_block(st.t_done, st.t_done)
+        sp = clock.split("fetch")
+        self.fetch_exposed_ms += sp["fetch_exposed_ms"]
+        self.fetch_overlapped_ms += sp["fetch_overlapped_ms"]
+
+        # stitch chunks back into one chain, truncating at the first
+        # hole (a child past a missing parent can never be adopted)
+        out: List[dict] = []
+        nbytes = 0
+        parent_hex = ""
+        done = False
+        for chunk, st in zip(chunks, states):
+            if done:
+                break
+            if st.error is not None or st.verified is None:
+                if isinstance(st.error, (WorkerFailureError,
+                                         InjectedFault)):
+                    self.fetch_failures += 1
+                break
+            by_d = {v[0]: v for v in st.verified if v is not None}
+            for d in chunk:
+                v = by_d.get(d.hex())
+                if v is None:
+                    done = True
+                    break
+                hx, payload, meta = v
+                out.append({"d": hx, "parent": parent_hex,
+                            "payload": payload.hex(),
+                            "b2": blake2b_hex(payload), "meta": meta})
+                nbytes += len(payload)
+                parent_hex = hx
+        if out:
+            self.fetched_blocks += len(out)
+            self.fetch_bytes += nbytes
+            self.policy.note_fetch(nbytes, wire_ms[0], len(out))
+        return out
+
+    def _verify(self, st: _ChunkState, poison: bool) -> None:
+        """IoWorker job: hex-decode + checksum one chunk's reply.
+        ``poison`` is the seeded blockxfer.fetch corrupt drill — the
+        payload is mangled BEFORE the check, so the checksum catches
+        it exactly as it would real wire corruption."""
+        try:
+            with span("blockxfer.stage",
+                      n=len(st.raw.get("blocks", []))):
+                verified = []
+                for blk in st.raw.get("blocks", []):
+                    payload = bytes.fromhex(blk["payload"])
+                    if poison and payload:
+                        payload = bytes([payload[0] ^ 0xFF]) \
+                            + payload[1:]
+                        poison = False   # one block per fired spec
+                    if blake2b_hex(payload) != blk.get("b2"):
+                        self.fetch_rejects += 1
+                        verified.append(None)
+                        continue
+                    verified.append((blk["d"], payload,
+                                     blk.get("meta") or {}))
+                st.verified = verified
+        except (ValueError, TypeError, KeyError) as e:
+            st.error = e
+        finally:
+            st.t_done = time.perf_counter()
+
+    def _push(self, dest, blocks: List[dict]) -> int:
+        """Push verified blocks into ``dest`` in chunks; returns
+        blocks the receiver actually landed. A push failure is
+        terminal for the remaining chunks (children of an unlanded
+        parent can't land either)."""
+        csz = max(1, int(self.cfg.fetch_chunk_blocks))
+        landed = 0
+        t0 = time.perf_counter()
+        try:
+            for i in range(0, len(blocks), csz):
+                chunk = blocks[i:i + csz]
+                try:
+                    fault_injector.fire(
+                        "blockxfer.push", detail=f"replica{dest.slot}")
+                    with span("blockxfer.push", slot=dest.slot,
+                              n=len(chunk)):
+                        reply = dest.push_blocks(chunk)
+                except (WorkerFailureError, InjectedFault) as e:
+                    self.push_failures += 1
+                    logger.debug("blockxfer: push to slot %d failed: "
+                                 "%s", dest.slot, e)
+                    break
+                self.push_rpcs += 1
+                got = int(reply.get("landed", 0))
+                landed += got
+                self.pushed_blocks += got
+                self.push_bytes += sum(len(b["payload"]) // 2
+                                       for b in chunk)
+                if got < len(chunk):
+                    break   # a refused parent orphans the tail
+        finally:
+            self.push_ms += (time.perf_counter() - t0) * 1e3
+        return landed

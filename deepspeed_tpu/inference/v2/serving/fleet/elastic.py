@@ -74,6 +74,9 @@ class FleetSupervisor:
         self.requeued = 0
         self.respawns = 0
         self.drains = 0
+        # chains warm-started into a successor/respawn via BLOCK_PUSH
+        # (incremented by the router's blockxfer warm-start path)
+        self.warm_starts = 0
 
     # -- detectors ------------------------------------------------------
     def check(self, step: int) -> int:
@@ -151,6 +154,7 @@ class FleetSupervisor:
             "requeued": self.requeued,
             "respawns": self.respawns,
             "drains": self.drains,
+            "warm_starts": self.warm_starts,
             "events": [e.as_dict() for e in self.events],
             "mttr_s": {
                 "last": mttr[-1] if mttr else 0.0,

@@ -39,10 +39,11 @@ from .....resilience.errors import (InjectedFault, TransportError,
 from .....resilience.fault_injector import fault_injector
 from .....telemetry.trace import span
 from .....utils.logging import logger
-from .transport import (MSG_CANCEL, MSG_HEARTBEAT, MSG_HELLO,
-                        MSG_SHUTDOWN, MSG_SNAPSHOT, MSG_STEP,
-                        MSG_SUBMIT, MSG_TOKENS, FaultyChannel,
-                        HealthProber, RpcClient, TransportStats)
+from .transport import (MSG_BLOCK_FETCH, MSG_BLOCK_PUSH, MSG_CANCEL,
+                        MSG_HEARTBEAT, MSG_HELLO, MSG_SHUTDOWN,
+                        MSG_SNAPSHOT, MSG_STEP, MSG_SUBMIT,
+                        MSG_TOKENS, FaultyChannel, HealthProber,
+                        RpcClient, TransportStats)
 from .worker import sampling_to_wire
 
 _FOREVER = float("inf")
@@ -273,6 +274,30 @@ class Replica:
             raise WorkerFailureError(
                 self.slot, "error",
                 f"tokens transport failure: {e}") from e
+
+    # -- fleet block transfer (blockxfer.py) ---------------------------
+    def fetch_blocks(self, digests: list) -> dict:
+        """One read-only BLOCK_FETCH RPC: this worker's store-encoded
+        blocks (hex payload + blake2b) for ``digests`` (hex strings,
+        chain order). Same typed transport contract as ``submit``."""
+        try:
+            return self._call(MSG_BLOCK_FETCH,
+                              {"digests": [str(d) for d in digests]})
+        except TransportError as e:
+            raise WorkerFailureError(
+                self.slot, "error",
+                f"block fetch transport failure: {e}") from e
+
+    def push_blocks(self, blocks: list) -> dict:
+        """One BLOCK_PUSH RPC landing verified blocks in this
+        worker's DRAM tier (effectful — rides the exactly-once reply
+        cache, so a retried push never double-lands)."""
+        try:
+            return self._call(MSG_BLOCK_PUSH, {"blocks": list(blocks)})
+        except TransportError as e:
+            raise WorkerFailureError(
+                self.slot, "error",
+                f"block push transport failure: {e}") from e
 
     # -- the supervised step -------------------------------------------
     def step(self, cursors: Optional[dict] = None) -> Optional[dict]:

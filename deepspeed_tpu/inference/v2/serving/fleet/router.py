@@ -83,6 +83,7 @@ from ..frontend import (ServingFrontend, _normalize_config,
 from ..prefix import chain_digests
 from ..request import Request, RequestState, TokenStream
 from . import journal as journal_mod
+from .blockxfer import PeerBlockSource
 from .elastic import FleetSupervisor
 from .journal import RequestJournal
 from .replica import Replica
@@ -257,6 +258,16 @@ class FleetRouter:
             "hbm": 1.0,
             "dram": float(getattr(fc, "dram_affinity_weight", 0.7)),
             "disk": float(getattr(fc, "disk_affinity_weight", 0.4))}
+        # peer-to-peer KV block transfer (blockxfer.py): when enabled
+        # the router FETCHES a remote-resident prefix into the landing
+        # replica's DRAM tier instead of letting it recompute, and
+        # remote residency earns a discounted affinity score
+        xcfg = getattr(fc, "transfer", None)
+        self._transfer_cfg = xcfg
+        enabled = bool(xcfg is not None and xcfg.enabled)
+        self._blockxfer = PeerBlockSource(xcfg) if enabled else None
+        self._remote_discount = float(
+            xcfg.remote_affinity_discount) if enabled else 0.0
         self._trie_seqs = {rep.slot: int(rep.hello.get("trie_seq", 0))
                            for rep in self._replicas}
         self._block_size = int(self._replicas[0].kv_block_size
@@ -327,7 +338,8 @@ class FleetRouter:
         return {"replicas": reps, "router": self._router_stats(),
                 "prefix": self._fleet_prefix_stats(),
                 "transport": self._transport_stats(),
-                "bootstrap": self._bootstrap_stats()}
+                "bootstrap": self._bootstrap_stats(),
+                "blockxfer": self._blockxfer_stats()}
 
     # -- introspection --------------------------------------------------
     @property
@@ -671,7 +683,19 @@ class FleetRouter:
         n_blocks = max(1, len(entry.digests))
         scored = []
         for s, snap in probed:
-            af = aff_w / n_blocks if s == aff_slot else 0.0
+            if s == aff_slot:
+                af = aff_w / n_blocks
+            elif aff_slot is not None and self._remote_discount > 0.0:
+                # transfer enabled: residency on a PEER still counts,
+                # but through the remote discount ON TOP of the tier
+                # weight — fetching beats recomputing, yet a replica's
+                # own DRAM hit (0.7) always outranks a peer's disk hit
+                # (discount 0.5 * 0.4 = 0.2). Without the transfer
+                # machinery remote residency is worth nothing here
+                # (the old behavior, bit for bit).
+                af = self._remote_discount * aff_w / n_blocks
+            else:
+                af = 0.0
             scored.append((1 if snap.get("suspect") else 0,
                            -self.policy.score(snap, af), s))
         scored.sort()
@@ -718,6 +742,15 @@ class FleetRouter:
                     self._journal.note_place(uid, slot)
                 if slot == aff_slot:
                     self.affinity_routed += 1
+                elif aff_slot is not None:
+                    # the request landed AWAY from its prefix's home:
+                    # fetch the chain into this replica's DRAM tier so
+                    # the admission-time adoption walk promotes it
+                    # instead of recomputing. Submit only QUEUED the
+                    # request — prefill happens on the next STEP RPC,
+                    # after this push has landed. Any failure falls
+                    # through to recompute (never blocks placement).
+                    self._maybe_prefetch(e, slot, aff_slot)
                 return True
         return False
 
@@ -1049,6 +1082,12 @@ class FleetRouter:
         self._trie_seqs[slot] = int(rep.hello.get("trie_seq", 0))
         self._pool.add(slot)
         self._monitor.restore(slot, step)
+        if self._blockxfer is not None and \
+                bool(self._transfer_cfg.push_on_respawn):
+            # warm-start: the fresh worker came up with an empty trie
+            # — seed its DRAM tier with the hottest chains from the
+            # survivors before traffic lands on it cold
+            self._warm_start_push(slot)
         return True
 
     def _place_backlog(self) -> None:
@@ -1060,6 +1099,91 @@ class FleetRouter:
                 continue
             if not self._place(uid):
                 self._backlog.append(uid)   # defer: capacity frees up
+
+    # -- peer block transfer (blockxfer.py consumer hooks) --------------
+    def _owner_chain(self, digests, owner_slot: int) -> List[bytes]:
+        """The consecutive-from-root head of ``digests`` the affinity
+        map places on ``owner_slot`` — the only span a fetch can adopt
+        (a child past a hole can never land)."""
+        chain: List[bytes] = []
+        for d in digests:
+            v = self._affinity_map.get(d)
+            if v is None or v[0] != owner_slot:
+                break
+            chain.append(d)
+        return chain
+
+    def _transfer_ok(self, owner_slot: Optional[int],
+                     dest_slot: int) -> bool:
+        if self._blockxfer is None or owner_slot is None \
+                or owner_slot == dest_slot:
+            return False
+        if owner_slot not in self._pool:
+            return False
+        owner = self._replicas[owner_slot]
+        return owner.alive and not owner.prober.suspect
+
+    def _maybe_prefetch(self, entry: "_FleetEntry", dest_slot: int,
+                        aff_slot: Optional[int]) -> int:
+        """Fetch the prefix chain a just-placed request left behind on
+        its home replica into the landing replica's DRAM tier. Every
+        failure mode (dead owner, timeout, corruption, policy decline)
+        returns 0 and the destination recomputes — placement already
+        happened and is never unwound."""
+        if not self._transfer_ok(aff_slot, dest_slot):
+            return 0
+        chain = self._owner_chain(entry.digests, aff_slot)
+        if not chain:
+            return 0
+        return self._blockxfer.transfer_chain(
+            self._replicas[aff_slot], self._replicas[dest_slot], chain)
+
+    def _warm_start_push(self, dest_slot: int,
+                         src_slot: Optional[int] = None) -> int:
+        """Seed ``dest_slot``'s DRAM tier with the hottest
+        recently-routed chains (most recent submissions first, one
+        transfer per distinct chain head, up to ``warm_start_chains``)
+        — the evacuation/respawn warm start. ``src_slot`` restricts
+        the source to one leaving replica (the drain path, where its
+        blocks are about to vanish); None pulls from whichever
+        survivor owns each chain (the respawn path — the dead slot's
+        map entries were already purged)."""
+        bx = self._blockxfer
+        xcfg = self._transfer_cfg
+        limit = 0 if bx is None else max(0, int(xcfg.warm_start_chains))
+        if not limit:
+            return 0
+        dest = self._replicas[dest_slot]
+        if not dest.alive:
+            return 0
+        landed = 0
+        sent = 0
+        heads: Set[bytes] = set()
+        for uid in reversed(list(self._entries)):
+            if sent >= limit:
+                break
+            digests = self._entries[uid].digests
+            if not digests or digests[0] in heads:
+                continue
+            heads.add(digests[0])
+            v = self._affinity_map.get(digests[0])
+            if v is None:
+                continue
+            owner_slot = v[0]
+            if src_slot is not None and owner_slot != src_slot:
+                continue
+            if not self._transfer_ok(owner_slot, dest_slot):
+                continue
+            chain = self._owner_chain(digests, owner_slot)
+            if not chain:
+                continue
+            sent += 1
+            got = bx.transfer_chain(self._replicas[owner_slot], dest,
+                                    chain, warm_start=True)
+            landed += got
+        if landed:
+            self._supervisor.warm_starts += 1
+        return landed
 
     def _check_imbalance(self, step: int) -> None:
         spread_max = int(self.config.fleet.imbalance_alert_spread)
@@ -1113,6 +1237,18 @@ class FleetRouter:
                     steps += 1
         finally:
             self._draining.discard(slot)
+        if self._blockxfer is not None and \
+                bool(self._transfer_cfg.push_on_drain):
+            # the leaving replica's blocks are about to vanish with
+            # its channel: push its hottest chains to the least-loaded
+            # survivor while it can still answer BLOCK_FETCH
+            survivors = [s for s in self._pool
+                         if s != slot and s not in self._draining
+                         and self._replicas[s].alive]
+            if survivors:
+                self._warm_start_push(
+                    min(survivors, key=self._outstanding),
+                    src_slot=slot)
         self._replicas[slot].detach()
         self._pool.discard(slot)
         self._monitor.retire(slot)
@@ -1349,10 +1485,20 @@ class FleetRouter:
         }
         return redact_auth(out)
 
+    def _blockxfer_stats(self) -> dict:
+        """The fleet report's ``blockxfer`` block: the peer-transfer
+        pipeline's counters. Schema-stable when the transfer is off —
+        every key present, zeroed — so dashboards, watchers and the
+        bench decomposition never lose the metric by toggling the
+        feature."""
+        if self._blockxfer is not None:
+            return {"enabled": 1, **self._blockxfer.stats()}
+        return {"enabled": 0, **PeerBlockSource.zero_stats()}
+
     def get_fleet_report(self) -> dict:
         """Per-replica snapshots + router totals + aggregated prefix
         reuse + the transport block + the bootstrap block + the
-        supervisor's recovery history."""
+        blockxfer block + the supervisor's recovery history."""
         return {
             "replicas": {str(rep.slot): rep.snapshot()
                          for rep in self._replicas},
@@ -1360,5 +1506,6 @@ class FleetRouter:
             "prefix": self._fleet_prefix_stats(),
             "transport": self._transport_stats(),
             "bootstrap": self._bootstrap_stats(),
+            "blockxfer": self._blockxfer_stats(),
             "recovery": self._supervisor.report(),
         }

@@ -88,6 +88,14 @@ MSG_HEARTBEAT = "HEARTBEAT"
 MSG_SHUTDOWN = "SHUTDOWN"
 MSG_ERR = "ERR"
 
+# fleet-wide KV block transfer (blockxfer.py): BLOCK_FETCH is a
+# read-only request — "serve me these store-encoded trie blocks (hex
+# payload + blake2b) from your HBM trie or spill tiers"; BLOCK_PUSH
+# lands verified blocks into the receiver's DRAM tier and is
+# effectful, so it rides the exactly-once reply cache like SUBMIT.
+MSG_BLOCK_FETCH = "BLOCK_FETCH"
+MSG_BLOCK_PUSH = "BLOCK_PUSH"
+
 # bootstrap handshake (pre-HELLO, same frame format, rpc id 0): a
 # dial-in worker opens with JOIN; the router fences on epochs, then —
 # when auth is required — answers JOIN_CHALLENGE with a fresh nonce;

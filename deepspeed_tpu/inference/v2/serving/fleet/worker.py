@@ -48,15 +48,20 @@ from .....resilience.errors import (BootstrapAuthError, FencingError,
                                     UnknownRequestError)
 from .....resilience.retry import backoff_delay
 from .....runtime.lifecycle import BoundedCache
+from .....runtime.store import blake2b_hex, encode_kv
 from .....utils.logging import logger
 from ..frontend import ServingFrontend
-from .transport import (MSG_CANCEL, MSG_ERR, MSG_HEARTBEAT, MSG_HELLO,
+from .transport import (MSG_BLOCK_FETCH, MSG_BLOCK_PUSH, MSG_CANCEL,
+                        MSG_ERR, MSG_HEARTBEAT, MSG_HELLO,
                         MSG_SHUTDOWN, MSG_SNAPSHOT, MSG_STEP,
                         MSG_SUBMIT, MSG_TOKENS, PROTOCOL_VERSION,
                         TransportDecodeError, client_ssl_context,
                         decode_frame, encode_frame, worker_join)
 
-_EFFECTFUL = (MSG_SUBMIT, MSG_CANCEL, MSG_STEP)
+# BLOCK_PUSH lands blocks in the DRAM tier — effectful, so a retried
+# push rides the reply cache instead of double-landing. BLOCK_FETCH is
+# a pure read (re-serving the same bytes is harmless) and stays out.
+_EFFECTFUL = (MSG_SUBMIT, MSG_CANCEL, MSG_STEP, MSG_BLOCK_PUSH)
 
 
 def _sampling_from_wire(d: Optional[dict]):
@@ -159,6 +164,10 @@ class WorkerCore:
             return {"kind": "HEARTBEAT_OK",
                     "queued": fe.queued_requests,
                     "active": fe.active_requests}
+        if kind == MSG_BLOCK_FETCH:
+            return self._block_fetch(msg)
+        if kind == MSG_BLOCK_PUSH:
+            return self._block_push(msg)
         if kind == MSG_SHUTDOWN:
             self.shutdown = True
             return {"kind": "BYE"}
@@ -185,6 +194,73 @@ class WorkerCore:
             deadline_ms=msg.get("deadline_ms"),
             on_token=buf.append)
         return {"kind": "SUBMIT_OK"}
+
+    # -- fleet block transfer (blockxfer.py consumer) -------------------
+    def _block_fetch(self, msg: dict) -> dict:
+        """Read-only: serve the requested digests (hex, chain order)
+        store-encoded with their blake2b checksums. The walk stops at
+        the first non-resident digest — blocks past a hole can never
+        be adopted by the fetcher anyway (chain construction)."""
+        pc = self.frontend.engine.prefix_cache
+        blocks, missing = [], []
+        for hx in msg.get("digests") or []:
+            out = self._export_block(pc, bytes.fromhex(hx)) \
+                if pc is not None else None
+            if out is None:
+                missing.append(hx)
+                break
+            payload, meta = out[0], out[1]
+            blocks.append({"d": hx, "payload": payload.hex(),
+                           "b2": blake2b_hex(payload),
+                           "meta": meta, "tier": out[2]})
+        return {"kind": "BLOCK_FETCH_OK", "blocks": blocks,
+                "missing": missing}
+
+    def _export_block(self, pc, d: bytes):
+        """-> (payload, meta, tier) or None. A tiered cache exports
+        through its own tier-aware path; a flat trie serves straight
+        from the HBM pool (d2h gather + exact encode) so a non-tiered
+        owner can still feed peers."""
+        export = getattr(pc, "export_block", None)
+        if export is not None:
+            out = export(d)
+            if out is None:
+                return None
+            payload, meta, _parent, tier = out
+            return payload, meta, tier
+        e = pc._entries.get(d)
+        if e is None:
+            return None
+        arr = self.frontend.engine.read_kv_block(e.block)
+        payload, meta = encode_kv(arr, "none")
+        return payload, meta, "hbm"
+
+    def _block_push(self, msg: dict) -> dict:
+        """Land peer-pushed blocks in the DRAM tier after re-checking
+        every payload against its checksum HERE (the receiver trusts
+        nothing that rode the wire). A replica without a tiered cache
+        refuses — there is no spill tier to land into."""
+        pc = self.frontend.engine.prefix_cache
+        land = getattr(pc, "land_remote_block", None)
+        landed = rejected = 0
+        for blk in msg.get("blocks") or []:
+            try:
+                payload = bytes.fromhex(blk["payload"])
+                parent = bytes.fromhex(blk.get("parent") or "")
+                d = bytes.fromhex(blk["d"])
+            except (ValueError, KeyError, TypeError):
+                rejected += 1
+                continue
+            if land is None \
+                    or blake2b_hex(payload) != blk.get("b2"):
+                rejected += 1
+                continue
+            if land(d, parent, payload, blk.get("meta") or {}):
+                landed += 1
+            else:
+                rejected += 1
+        return {"kind": "BLOCK_PUSH_OK", "landed": landed,
+                "rejected": rejected}
 
     def _step(self, msg: dict) -> dict:
         cursors = msg.get("cursors") or {}

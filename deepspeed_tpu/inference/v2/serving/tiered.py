@@ -783,6 +783,66 @@ class TieredPrefixCache(PrefixCache):
             parent = d
         return super().insert(tokens, blocks)
 
+    # -- fleet block transfer (serving/fleet/blockxfer.py) --------------
+    def export_block(self, d: bytes
+                     ) -> Optional[Tuple[bytes, Dict, bytes, str]]:
+        """Serve one resident block for a peer replica's BLOCK_FETCH:
+        ``(payload, meta, parent, tier)`` store-encoded exactly as the
+        spill tiers hold it, or None when the digest is not resident /
+        quarantined / unreadable. Read-only — exporting never moves
+        the block between tiers."""
+        e = self._entries.get(d)
+        if e is not None:
+            try:
+                arr = self.kv_io.read_kv_block(e.block)
+                payload, meta = encode_kv(arr, self.codec)
+            except _SPILL_FAILURES:
+                return None
+            return payload, meta, e.parent, "hbm"
+        s = self._spilled.get(d)
+        if s is None or d in self._quarantine:
+            return None
+        store = self.dram if s.tier == "dram" else self.disk
+        if store is None:
+            return None
+        try:
+            payload, meta = store.get(d)
+        except _SPILL_FAILURES:
+            return None
+        return payload, meta, s.parent, s.tier
+
+    def land_remote_block(self, d: bytes, parent: bytes,
+                          payload: bytes, meta: Dict) -> bool:
+        """Land one peer-pushed (already checksum-verified) block in
+        the DRAM tier as an ordinary spilled entry, so the next
+        adoption walk promotes it through the unchanged ``_promote``
+        path — same verify, same degrade valve, same bitwise output as
+        if this replica had demoted it itself. Refuses (False) rather
+        than adopts on anything questionable: no DRAM tier, an
+        orphaned parent (the chain invariant — a child whose parent is
+        not resident is unreachable by construction), or a store
+        write failure. Already-resident digests are a True no-op."""
+        if self.dram is None:
+            return False
+        if d in self._entries or d in self._spilled:
+            return True
+        if parent != _ROOT and parent not in self._entries \
+                and parent not in self._spilled:
+            return False
+        try:
+            self.dram.put(d, payload, meta)
+        except _SPILL_FAILURES:
+            return False
+        # fresh verified data supersedes any earlier quarantine
+        self._quarantine.pop(d, None)
+        self._spill_add(d, _SpilledEntry("dram", parent, self._tick))
+        if self.journal is not None:
+            # nets to ("add", d) + tier "dram" in the worker's delta
+            # drain, so the router learns the new (slot, tier) home
+            self.journal.append(("tier", d, "dram"))
+        self._rebalance()
+        return True
+
     # -- lifecycle ------------------------------------------------------
     def clear(self) -> int:
         """Drop everything — HBM entries (true-evicted through the

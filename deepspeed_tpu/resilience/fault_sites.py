@@ -135,6 +135,26 @@ FAULT_SITES = {
         "fault here only voids the staged copy — the adoption walk "
         "falls back to the synchronous promote path, it never "
         "degrades the block",
+    # ---- fleet block transfer (inference/v2/serving/fleet/blockxfer.py) ----
+    # both sites live CONSUMER-side (in PeerBlockSource, on the router)
+    # rather than in the worker's RPC handlers: over the loopback
+    # channel a handler-side InjectedFault would surface as a replica
+    # failure in Replica._call, turning a transfer drill into a death
+    # drill. Per-target grammar applies — "blockxfer.fetch@replica1:
+    # corrupt" matches only transfers whose peer is slot 1.
+    "blockxfer.fetch":
+        "peer block fetch: one consume() per BLOCK_FETCH chunk RPC, "
+        "detail = 'replica<owner slot>'. kind=corrupt poisons one "
+        "fetched payload BEFORE checksum verify — the blake2b reject "
+        "truncates the chain there and the tail degrades to recompute "
+        "(never a wrong token); any other kind aborts the whole fetch "
+        "(counted as a fetch failure, request falls through to "
+        "recompute)",
+    "blockxfer.push":
+        "peer block push: one fire per BLOCK_PUSH chunk RPC, detail = "
+        "'replica<dest slot>', BEFORE the wire call — a fault drops "
+        "the push (nothing lands; warm-start/prefetch is advisory, "
+        "the destination just recomputes)",
     # ---- parameter-residency wire (runtime/zero/param_stream.py) ----
     "param.fetch":
         "param stream: one fire per leaf fetched from the param store "

@@ -267,6 +267,11 @@ class TelemetryAnomalyConfig(DeepSpeedConfigModel):
     # async tiered-I/O queue filling faster than its IoWorker drains
     # is a stall-in-waiting (cache/spill_backlog metric); 0 disables
     spill_backlog_slope_per_step: float = 2.0
+    # fleet block-transfer stall: alert when the router's fetch
+    # exposed-ms (fleet/blockxfer/fetch_exposed_ms) spikes past
+    # factor x its EWMA — peer fetches no longer hiding behind
+    # prefill; <= 1 disables
+    blockxfer_stall_factor: float = 3.0
 
 
 @dataclasses.dataclass
@@ -468,6 +473,46 @@ class FleetTransportConfig(DeepSpeedConfigModel):
 
 
 @dataclasses.dataclass
+class FleetTransferConfig(DeepSpeedConfigModel):
+    """Fleet-wide KV block transfer (serving/fleet/blockxfer.py),
+    config section ``serving.fleet.transfer``: peer-to-peer prefix
+    fetch over BLOCK_FETCH/BLOCK_PUSH plus warm-start pushes on
+    evacuation/respawn. Off by default — with ``enabled`` False the
+    router scores and places exactly as before and no transfer RPC is
+    ever issued."""
+    enabled: bool = False
+    # affinity discount for residency on a REMOTE replica when the
+    # transfer machinery can move the blocks here: the remote tier
+    # weight is multiplied by this, so a local DRAM hit (0.7) always
+    # outranks a peer disk hit (0.5 * 0.4 = 0.2). 0 disables remote
+    # scoring entirely (remote residency counts nothing).
+    remote_affinity_discount: float = 0.5
+    # blocks per BLOCK_FETCH RPC (chunking bound — each chunk is one
+    # length-prefixed frame riding the normal deadline/retry budget)
+    fetch_chunk_blocks: int = 4
+    # longest chain fetched per placement (caps the bytes a single
+    # cold request can pull through the wire)
+    max_fetch_blocks: int = 32
+    # don't bother fetching chains shorter than this (the RPC
+    # overhead beats recomputing a block or two)
+    min_fetch_blocks: int = 1
+    # fetch-vs-recompute policy: fetch when estimated wire ms <
+    # margin * (recompute_ms_per_block * n_blocks). Wire bytes/ms is
+    # a measured EWMA (optimistic before the first sample); the
+    # recompute cost per block is a static prior.
+    fetch_margin: float = 1.0
+    recompute_ms_per_block: float = 5.0
+    ewma_alpha: float = 0.3
+    # warm-start pushes: on drain, push the leaving replica's chains
+    # to the best survivor; on respawn, seed the fresh replica with
+    # the hottest chains from the survivors
+    push_on_drain: bool = True
+    push_on_respawn: bool = True
+    # most-recent request chains pushed per warm-start event
+    warm_start_chains: int = 4
+
+
+@dataclasses.dataclass
 class ServingFleetConfig(DeepSpeedConfigModel):
     """Fleet router knobs (inference/v2/serving/fleet/), config section
     ``serving.fleet``: N data-parallel replicas behind one router with
@@ -509,6 +554,8 @@ class ServingFleetConfig(DeepSpeedConfigModel):
     imbalance_alert_spread: int = 0
     # the RPC layer between router and replica workers
     transport: FleetTransportConfig = submodel(FleetTransportConfig)
+    # peer-to-peer KV block transfer (fetch-not-recompute + warm-start)
+    transfer: FleetTransferConfig = submodel(FleetTransferConfig)
     # multi-host dial-in bootstrap + the durable-router journal
     bootstrap: FleetBootstrapConfig = submodel(FleetBootstrapConfig)
 

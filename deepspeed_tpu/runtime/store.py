@@ -61,6 +61,13 @@ def _blake2b_hex(payload: bytes) -> str:
     return hashlib.blake2b(payload, digest_size=_DIGEST_SIZE).hexdigest()
 
 
+# public alias: the fleet block-transfer wire (serving/fleet/
+# blockxfer.py) checksums payloads with the SAME function the stores
+# use, so a block fetched from a peer verifies against the digest its
+# owner's store computed — one hash, every tier, both sides of the RPC.
+blake2b_hex = _blake2b_hex
+
+
 def _np_dtype(name: str) -> np.dtype:
     try:
         return np.dtype(name)

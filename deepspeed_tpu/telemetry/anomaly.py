@@ -216,4 +216,12 @@ def default_watchers(anomaly_cfg) -> List[Watcher]:
         # queue growing without draining means the IoWorker can't
         # keep up — backpressure (skipped demotions) is next
         ws.append(SlopeWatcher("cache/spill_backlog", sb, window=win))
+    f = float(getattr(anomaly_cfg, "blockxfer_stall_factor", 3.0))
+    if f > 1.0:
+        # peer-fetch stall watch: exposed fetch wall (wire wait the
+        # prefill could not hide) spiking against its own EWMA means a
+        # peer or link went slow — the fetch-vs-recompute policy will
+        # start declining, but the operator should see WHY
+        ws.append(EwmaSpikeWatcher("fleet/blockxfer/fetch_exposed_ms",
+                                   factor=f))
     return ws

@@ -133,6 +133,20 @@ SPAN_SITES = {
         "gracefully draining one replica before detach: no new "
         "placements, in-flight work finishes in place (args: slot) — "
         "the rolling-restart primitive",
+    # ---- fleet block transfer (inference/v2/serving/fleet/blockxfer.py) ----
+    "blockxfer.fetch":
+        "one BLOCK_FETCH chunk RPC to the owning peer (args: slot, "
+        "n): the wire wait is the EXPOSED half of the fetch window — "
+        "it feeds fleet/blockxfer/fetch_exposed_ms and the stall "
+        "watcher",
+    "blockxfer.stage":
+        "one fetched chunk's hex-decode + blake2b verify on the "
+        "shared IoWorker (args: n) — the OVERLAPPED half; a checksum "
+        "mismatch here truncates the chain, it never lands",
+    "blockxfer.push":
+        "one BLOCK_PUSH chunk RPC landing verified blocks into a "
+        "peer's DRAM tier (args: slot, n) — placement prefetch and "
+        "evacuation/respawn warm-start both ride this",
     # ---- tiered prefix cache (inference/v2/serving/tiered.py) ----
     "cache.demote":
         "one cold block's down-tier demotion: device KV gather (d2h), "

@@ -1,0 +1,608 @@
+"""Fleet-wide KV block transfer (blockxfer): the transfer policy
+units, the tiered cache's export/land surface, the worker's
+BLOCK_FETCH/BLOCK_PUSH handlers (chain truncation, checksum
+re-verification, exactly-once), a real-socket RPC smoke, the loopback
+acceptance e2e (peer fetch beats recompute, bitwise streams, seeded
+corruption degrades to recompute, kill-mid-decode warm start, drain
+push-ahead), and the chaos matrix with transfers armed.
+
+Tier-1 keeps the policy/handler units, one socketpair smoke and the
+loopback acceptance; the subprocess-socket acceptance and the chaos
+matrix ride the slow tier (the 870s-wall diet rule)."""
+
+import socket
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (FleetRouter, InferenceEngineV2,
+                                        RaggedInferenceEngineConfig,
+                                        RequestState, ServingFrontend)
+from deepspeed_tpu.inference.v2.serving.fleet.blockxfer import (
+    PeerBlockSource, TransferPolicy)
+from deepspeed_tpu.inference.v2.serving.fleet.transport import (
+    MSG_BLOCK_FETCH, MSG_BLOCK_PUSH, MSG_SHUTDOWN, RpcClient,
+    SocketChannel)
+from deepspeed_tpu.inference.v2.serving.fleet.worker import (
+    WorkerCore, serve_socket)
+from deepspeed_tpu.inference.v2.serving.prefix import chain_digests
+from deepspeed_tpu.resilience.errors import ServingOverloadError
+from deepspeed_tpu.resilience.fault_injector import fault_injector
+from deepspeed_tpu.runtime.config import (FleetTransferConfig,
+                                          FleetTransportConfig)
+from deepspeed_tpu.runtime.store import blake2b_hex, decode_kv
+
+SYS = [list(range(1, 18)), list(range(101, 118)),
+       list(range(201, 218))]
+
+# engine geometry shared with every fleet test module; queue depth 1
+# is the forcing function — a second same-prefix arrival OVERFLOWS the
+# prefix's home replica, so the router must place it on the non-owner
+# and the transfer path (fetch-instead-of-recompute) actually runs
+ENG = dict(token_budget=32, max_ragged_sequence_count=4,
+           n_kv_blocks=48, kv_block_size=8, max_blocks_per_seq=8,
+           kv_dtype="float32")
+TIERS = {"tiers": {"enabled": True, "dram_max_mb": 64.0}}
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault_injector.reset()
+    yield
+    fault_injector.reset()
+
+
+def _factory(params_cfg, **kw):
+    params, cfg = params_cfg
+    eng_kw = dict(ENG)
+    eng_kw.update(kw)
+
+    def engine_factory(slot):
+        return InferenceEngineV2(params, cfg,
+                                 RaggedInferenceEngineConfig(**eng_kw))
+    return engine_factory
+
+
+def _router(params_cfg, n=2, serving=None, engine_kw=None, **kw):
+    cfg = {"fleet": {"n_replicas": n}}
+    for k, v in (serving or {}).items():
+        if k == "fleet":
+            cfg["fleet"].update(v)
+        else:
+            cfg[k] = v
+    return FleetRouter(_factory(params_cfg, **(engine_kw or {})),
+                       cfg, **kw)
+
+
+def _xfer_serving(**fleet_kw):
+    # recompute_ms_per_block pinned high: the e2e drills test the
+    # TRANSFER machinery, so the fetch-vs-recompute policy must always
+    # choose fetch — a CPU-host loopback "wire" measures slow enough
+    # that the default 5 ms/block budget legitimately declines the
+    # second fetch (the decline math has its own TransferPolicy units)
+    fleet = {"transfer": {"enabled": True,
+                          "recompute_ms_per_block": 1000.0}}
+    fleet.update(fleet_kw)
+    return {"prefix": dict(TIERS), "fleet": fleet}
+
+
+def _single_frontend_refs(params_cfg, requests, max_new_tokens):
+    eng = _factory(params_cfg)(0)
+    refs = {}
+    for uid, prompt in requests.items():
+        fe = ServingFrontend(eng)
+        r = fe.submit(prompt, uid=uid, max_new_tokens=max_new_tokens)
+        fe.drain()
+        assert r.state == RequestState.FINISHED
+        refs[uid] = list(r.tokens)
+    return refs
+
+
+def _xcfg(**kw):
+    base = {"enabled": True}
+    base.update(kw)
+    return FleetTransferConfig(**base)
+
+
+class TestTransferPolicy:
+    """Engine-free: the fetch-vs-recompute decision math."""
+
+    def test_optimistic_before_first_measurement(self):
+        p = TransferPolicy(_xcfg())
+        assert p.est_fetch_ms(8) == 0.0
+        assert p.should_fetch(1) and p.should_fetch(32)
+
+    def test_min_fetch_blocks_gate(self):
+        p = TransferPolicy(_xcfg(min_fetch_blocks=2))
+        assert not p.should_fetch(1)
+        assert p.should_fetch(2)
+
+    def test_measured_rate_declines_a_slow_wire(self):
+        # 10 B/ms, 1000 B/block -> 4 blocks cost ~400ms against a
+        # 4 * 5ms recompute budget: recompute wins
+        slow = TransferPolicy(_xcfg())
+        slow.note_fetch(1000, 100.0, 1)
+        assert slow.est_fetch_ms(4) == pytest.approx(400.0)
+        assert not slow.should_fetch(4)
+        # 100 kB/ms: fetching is ~free, fetch wins
+        fast = TransferPolicy(_xcfg())
+        fast.note_fetch(1000, 0.01, 1)
+        assert fast.should_fetch(4)
+
+    def test_ewma_blend_and_degenerate_samples(self):
+        p = TransferPolicy(_xcfg(ewma_alpha=0.3))
+        p.note_fetch(1000, 100.0, 1)           # rate 10, first sample
+        p.note_fetch(1000, 50.0, 1)            # rate 20, blended
+        assert p.bytes_per_ms == pytest.approx(0.7 * 10 + 0.3 * 20)
+        before = p.bytes_per_ms
+        p.note_fetch(0, 1.0, 1)                # degenerate: ignored
+        p.note_fetch(1000, 0.0, 1)
+        p.note_fetch(1000, 1.0, 0)
+        assert p.bytes_per_ms == before
+
+    def test_zero_stats_matches_live_schema(self):
+        src = PeerBlockSource(_xcfg())
+        assert set(PeerBlockSource.zero_stats()) == set(src.stats())
+
+
+class TestWorkerBlockRpcs:
+    """The two new RPCs against real tiered engines: export from HBM
+    and from the spill tier, chain truncation at the first hole,
+    receiver-side checksum re-verification, the chain-parent
+    invariant, idempotence, and the exactly-once reply cache."""
+
+    def test_fetch_push_handlers_roundtrip(self, params_cfg):
+        prompt = SYS[0] + [31]
+        da = chain_digests(np.asarray(prompt, np.int32), 8)
+        fe = ServingFrontend(_factory(params_cfg)(0),
+                             {"prefix": dict(TIERS)})
+        wc = WorkerCore(0, fe)
+        r = fe.submit(prompt, uid=1, max_new_tokens=4)
+        fe.drain()
+        assert r.state == RequestState.FINISHED
+        ref_tokens = list(r.tokens)
+        pc = fe.engine.prefix_cache
+
+        # -- export straight from the HBM trie, chain order ----------
+        rep = wc._block_fetch({"digests": [d.hex() for d in da]})
+        assert rep["kind"] == "BLOCK_FETCH_OK" and not rep["missing"]
+        assert [b["d"] for b in rep["blocks"]] == [d.hex() for d in da]
+        for b in rep["blocks"]:
+            payload = bytes.fromhex(b["payload"])
+            assert blake2b_hex(payload) == b["b2"]
+            assert b["tier"] == "hbm"
+            decode_kv(payload, b["meta"])     # well-formed encoding
+
+        # -- the walk stops at the first hole ------------------------
+        hole = wc._block_fetch(
+            {"digests": [da[0].hex(), "00" * 16, da[1].hex()]})
+        assert [b["d"] for b in hole["blocks"]] == [da[0].hex()]
+        assert hole["missing"] == ["00" * 16]
+
+        # -- a spilled leaf exports from its tier, read-only ---------
+        pc._evict(count=1)
+        assert pc.spilled_blocks == 1
+        rep2 = wc._block_fetch({"digests": [d.hex() for d in da]})
+        assert [b["tier"] for b in rep2["blocks"]] == ["hbm", "dram"]
+        assert pc.spilled_blocks == 1          # export moved nothing
+
+        # -- receiver: land with re-verification ---------------------
+        fe2 = ServingFrontend(_factory(params_cfg)(1),
+                              {"prefix": dict(TIERS)})
+        wc2 = WorkerCore(1, fe2)
+        blocks = []
+        parent = ""
+        for b in rep2["blocks"]:
+            blocks.append({"d": b["d"], "parent": parent,
+                           "payload": b["payload"], "b2": b["b2"],
+                           "meta": b["meta"]})
+            parent = b["d"]
+
+        # orphan child alone: the chain invariant refuses it
+        orphan = wc2._block_push({"blocks": [blocks[1]]})
+        assert orphan == {"kind": "BLOCK_PUSH_OK", "landed": 0,
+                          "rejected": 1}
+        # a payload that fails its checksum never lands
+        bad = dict(blocks[0], payload="00" + blocks[0]["payload"][2:])
+        assert wc2._block_push({"blocks": [bad]})["rejected"] == 1
+        assert fe2.engine.prefix_cache.spilled_blocks == 0
+
+        # the good chain lands exactly once through the reply cache
+        msg = {"v": 1, "id": 77, "kind": MSG_BLOCK_PUSH,
+               "blocks": blocks}
+        r1 = wc2.handle(dict(msg))
+        r2 = wc2.handle(dict(msg))             # the re-asked duplicate
+        assert r1["kind"] == "BLOCK_PUSH_OK" and r1["landed"] == 2
+        assert r2 == r1
+        pc2 = fe2.engine.prefix_cache
+        assert pc2.spilled_blocks == 2
+        # re-landing resident digests is an idempotent True
+        assert wc2._block_push({"blocks": blocks})["landed"] == 2
+
+        # -- the landed chain adopts bitwise on the receiver ---------
+        r2req = fe2.submit(prompt, uid=9, max_new_tokens=4)
+        fe2.drain()
+        assert list(r2req.tokens) == ref_tokens
+        st = pc2.stats()
+        assert st["promoted_blocks"] >= 2 and pc2.hits >= 1
+        assert fe2.metrics.report()["prompt_tokens"] == len(prompt) - 16
+        fe.close()
+        fe2.close()
+
+    def test_flat_trie_export_fallback_and_push_refusal(self):
+        """A replica without spill tiers still FEEDS peers (HBM gather
+        + exact encode) but refuses pushes — no tier to land into."""
+        arr = np.arange(48, dtype=np.float32).reshape(2, 3, 8)
+        d = bytes(range(16))
+        pc = types.SimpleNamespace(
+            _entries={d: types.SimpleNamespace(block=3)})
+        eng = types.SimpleNamespace(prefix_cache=pc,
+                                    read_kv_block=lambda b: arr)
+        wc = WorkerCore(0, types.SimpleNamespace(engine=eng))
+        rep = wc._block_fetch({"digests": [d.hex()]})
+        blk = rep["blocks"][0]
+        assert blk["tier"] == "hbm"
+        payload = bytes.fromhex(blk["payload"])
+        assert blake2b_hex(payload) == blk["b2"]
+        np.testing.assert_array_equal(decode_kv(payload, blk["meta"]),
+                                      arr)
+        push = wc._block_push({"blocks": [dict(blk, parent="")]})
+        assert push == {"kind": "BLOCK_PUSH_OK", "landed": 0,
+                        "rejected": 1}
+
+
+class TestSocketBlockRpcSmoke:
+    """The tier-1 socket smoke: both RPCs over a REAL framed stream
+    (OS socketpair + the worker serve loop — no subprocess; the
+    subprocess fleet rides the slow-tier acceptance)."""
+
+    def test_fetch_clear_push_adopt_over_socketpair(self, params_cfg):
+        prompt = SYS[1] + [41]
+        da = chain_digests(np.asarray(prompt, np.int32), 8)
+        fe = ServingFrontend(_factory(params_cfg)(0),
+                             {"prefix": dict(TIERS)})
+        r = fe.submit(prompt, uid=1, max_new_tokens=4)
+        fe.drain()
+        ref_tokens = list(r.tokens)
+        core = WorkerCore(0, fe)
+        a, b = socket.socketpair()
+        t = threading.Thread(target=serve_socket, args=(core, b),
+                             daemon=True)
+        t.start()
+        ch = SocketChannel(lambda: (None, a))
+        ch.connect()
+        rpc = RpcClient(ch, 0, FleetTransportConfig(
+            rpc_deadline_seconds=10.0, retry_backoff_seconds=0.0))
+        try:
+            rep = rpc.call(MSG_BLOCK_FETCH,
+                           {"digests": [d.hex() for d in da]})
+            assert rep["kind"] == "BLOCK_FETCH_OK" and not rep["missing"]
+            blocks, parent = [], ""
+            for blk in rep["blocks"]:
+                payload = bytes.fromhex(blk["payload"])
+                assert blake2b_hex(payload) == blk["b2"]
+                blocks.append({"d": blk["d"], "parent": parent,
+                               "payload": blk["payload"],
+                               "b2": blk["b2"], "meta": blk["meta"]})
+                parent = blk["d"]
+            # wipe the trie, push the chain back over the wire, adopt
+            fe.engine.prefix_cache.clear()
+            push = rpc.call(MSG_BLOCK_PUSH, {"blocks": blocks})
+            assert push["kind"] == "BLOCK_PUSH_OK"
+            assert push["landed"] == 2 and push["rejected"] == 0
+            assert fe.engine.prefix_cache.spilled_blocks == 2
+            rpc.call(MSG_SHUTDOWN)
+            t.join(timeout=10.0)
+        finally:
+            ch.close()
+        r2 = fe.submit(prompt, uid=2, max_new_tokens=4)
+        fe.drain()
+        assert list(r2.tokens) == ref_tokens
+        assert fe.engine.prefix_cache.stats()["promoted_blocks"] >= 2
+        fe.close()
+
+
+class TestAcceptanceLoopback:
+    """The acceptance e2e over the loopback channel: shared-prefix
+    traffic forced onto the non-owning replica is FETCHED, not
+    recomputed — strictly fewer prefill tokens than the identical
+    no-transfer run, bitwise-identical streams, <= 1 recompile and 0
+    steady blocking syncs per replica — then seeded fetch corruption
+    degrades to recompute, a kill-mid-decode respawn warm-starts from
+    pushed blocks, and a graceful drain pushes the leaving replica's
+    chains ahead.
+
+    Tier-1 keeps the lean smoke (the 870s-wall diet); the full
+    multi-phase drill with its no-transfer control fleet rides the
+    slow tier."""
+
+    def test_peer_fetch_loopback_smoke(self, params_cfg):
+        """Tier-1: one forced off-home placement fetches instead of
+        recomputing — 2 blocks cross the wire, the peer adopts them
+        (16 of 18 prompt tokens never prefill), streams stay bitwise,
+        and the hub publishes the blockxfer namespace."""
+        from deepspeed_tpu.telemetry.hub import TelemetryHub
+        prompts = {k: SYS[0] + [30 + k] for k in range(1, 4)}
+        refs = _single_frontend_refs(params_cfg, prompts, 4)
+        router = _router(params_cfg, n=2, serving=_xfer_serving(),
+                         engine_kw={"max_queue_depth": 1})
+        hub = TelemetryHub()
+        router.attach_telemetry(hub)
+        router.submit(prompts[1], uid=1, max_new_tokens=4)
+        router.drain()
+        home = router._entries[1].slot
+        other = 1 - home
+        router.submit(prompts[2], uid=2, max_new_tokens=4)
+        router.submit(prompts[3], uid=3, max_new_tokens=4)
+        assert router._entries[2].slot == home     # affinity held
+        assert router._entries[3].slot == other    # forced off-home
+        bx = router.get_fleet_report()["blockxfer"]
+        assert bx["enabled"] == 1 and bx["fetch_hit_rate"] > 0
+        assert bx["fetched_blocks"] == 2 == bx["pushed_blocks"]
+        assert bx["fetch_bytes"] > 0 and bx["fetch_failures"] == 0
+        router.drain()
+        for uid in (1, 2, 3):
+            r = router.get_request(uid)
+            assert r.state == RequestState.FINISHED
+            assert list(r.tokens) == refs[uid], uid   # bitwise
+        # the non-owner ADOPTED the fetched chain: only the 2-token
+        # tail prefilled, against the 18 a cold recompute pays
+        peer_pc = router._replicas[other].engine.prefix_cache
+        assert peer_pc.stats()["promoted_blocks"] >= 2
+        assert peer_pc.hits >= 1 and peer_pc.misses == 0
+        assert router._replicas[other].frontend.metrics \
+            .report()["prompt_tokens"] == 2
+        for s in router.pooled_replicas:
+            frep = router._replicas[s].frontend.get_serving_report()
+            assert frep["recompiles"] <= 1, s
+            assert frep["steady_blocking_syncs"] == 0, s
+        flat = hub.sample(1)
+        assert flat["fleet/blockxfer/fetched_blocks"] == 2.0
+        assert "fleet/blockxfer/fetch_exposed_ms" in flat
+
+    @pytest.mark.slow
+    def test_peer_fetch_acceptance(self, params_cfg):
+        from deepspeed_tpu.telemetry.hub import TelemetryHub
+        prompts = {k: SYS[0] + [30 + k] for k in range(1, 8)}
+        refs = _single_frontend_refs(params_cfg, prompts, 4)
+        serving = _xfer_serving()
+
+        # -- control: same traffic, transfer OFF ---------------------
+        ctl = _router(params_cfg, n=2,
+                      serving={"prefix": dict(TIERS)},
+                      engine_kw={"max_queue_depth": 1})
+        c1 = ctl.submit(prompts[1], uid=1, max_new_tokens=4)
+        ctl.drain()
+        ctl.submit(prompts[2], uid=2, max_new_tokens=4)
+        ctl.submit(prompts[3], uid=3, max_new_tokens=4)
+        ctl.drain()
+        assert c1.state == RequestState.FINISHED
+        ctl_prefill = sum(
+            ctl._replicas[s].frontend.metrics.report()["prompt_tokens"]
+            for s in ctl.pooled_replicas)
+        ctl_bx = ctl.get_fleet_report()["blockxfer"]
+        assert ctl_bx["enabled"] == 0          # schema-stable when off
+        assert ctl_bx["fetched_blocks"] == 0
+
+        # -- transfer ON: the overflow placement fetches -------------
+        router = _router(params_cfg, n=2, serving=serving,
+                         engine_kw={"max_queue_depth": 1})
+        hub = TelemetryHub()
+        router.attach_telemetry(hub)
+        r1 = router.submit(prompts[1], uid=1, max_new_tokens=4)
+        router.drain()
+        home = router._entries[1].slot
+        other = 1 - home
+        r2 = router.submit(prompts[2], uid=2, max_new_tokens=4)
+        r3 = router.submit(prompts[3], uid=3, max_new_tokens=4)
+        assert router._entries[2].slot == home     # affinity held
+        assert router._entries[3].slot == other    # forced off-home
+        bx = router.get_fleet_report()["blockxfer"]
+        assert bx["enabled"] == 1 and bx["fetch_hit_rate"] > 0
+        assert bx["fetched_blocks"] == 2 == bx["pushed_blocks"]
+        assert bx["fetch_bytes"] > 0 and bx["fetch_failures"] == 0
+        router.drain()
+        for uid in (1, 2, 3):
+            r = router.get_request(uid)
+            assert r.state == RequestState.FINISHED
+            assert list(r.tokens) == refs[uid], uid   # bitwise
+        # the non-owner ADOPTED the fetched chain instead of
+        # recomputing it: 16 of 18 prompt tokens never prefilled
+        peer_pc = router._replicas[other].engine.prefix_cache
+        assert peer_pc.stats()["promoted_blocks"] >= 2
+        assert peer_pc.hits >= 1 and peer_pc.misses == 0
+        xfer_prefill = sum(
+            router._replicas[s].frontend.metrics
+            .report()["prompt_tokens"] for s in router.pooled_replicas)
+        assert xfer_prefill < ctl_prefill          # strictly below
+        # the zero-recompile + steady-window contracts held
+        for s in router.pooled_replicas:
+            frep = router._replicas[s].frontend.get_serving_report()
+            assert frep["recompiles"] <= 1, s
+            assert frep["steady_blocking_syncs"] == 0, s
+        # the hub publishes the blockxfer namespace flat
+        flat = hub.sample(1)
+        assert flat["fleet/blockxfer/fetched_blocks"] == 2.0
+        assert "fleet/blockxfer/fetch_exposed_ms" in flat
+
+        # -- seeded corruption degrades to recompute, still bitwise --
+        rejects0 = bx["fetch_rejects"]
+        fault_injector.configure("blockxfer.fetch:corrupt")
+        try:
+            router.submit(prompts[4], uid=4, max_new_tokens=4)
+            router.submit(prompts[5], uid=5, max_new_tokens=4)
+        finally:
+            fault_injector.reset()
+        router.drain()
+        bx = router.get_fleet_report()["blockxfer"]
+        assert bx["fetch_rejects"] == rejects0 + 1
+        assert bx["recompute_fallbacks"] >= 1
+        assert bx["pushed_blocks"] == 2       # the poisoned fetch: none
+        for uid in (4, 5):
+            assert list(router.get_request(uid).tokens) == refs[uid]
+
+        # -- kill mid-decode: the respawn warm-starts from pushes ----
+        r6 = router.submit(prompts[6], uid=6, max_new_tokens=4)
+        for _ in range(2):
+            router.step()
+        owner_now = router._affinity_map.get(
+            chain_digests(np.asarray(prompts[6], np.int32), 8)[0])[0]
+        victim = 1 - owner_now
+        fault_injector.configure(router.spec_for(victim, 0, "kill"))
+        try:
+            router.drain()
+        finally:
+            fault_injector.reset()
+        assert r6.state == RequestState.FINISHED
+        assert list(r6.tokens) == refs[6]
+        rep = router.get_fleet_report()
+        assert rep["recovery"]["deaths"] == 1
+        assert rep["recovery"]["respawns"] == 1
+        assert rep["recovery"]["warm_starts"] >= 1
+        assert rep["blockxfer"]["warm_starts"] >= 1
+        # the fresh worker's DRAM tier was seeded before traffic hit
+        respawned_pc = router._replicas[victim].engine.prefix_cache
+        assert respawned_pc.spilled_blocks >= 2 or \
+            respawned_pc.cached_blocks >= 2
+
+        # -- graceful drain pushes the leaver's chains ahead ---------
+        warm0 = rep["blockxfer"]["warm_starts"]
+        owner_now = router._affinity_map.get(
+            chain_digests(np.asarray(prompts[6], np.int32), 8)[0])[0]
+        router.drain_replica(owner_now)
+        rep = router.get_fleet_report()
+        assert rep["recovery"]["drains"] == 1
+        assert rep["blockxfer"]["warm_starts"] >= warm0
+        r7 = router.submit(prompts[7], uid=7, max_new_tokens=4)
+        router.drain()
+        assert list(r7.tokens) == refs[7]
+
+
+class TestAcceptanceSocket:
+
+    @pytest.mark.slow
+    def test_peer_fetch_acceptance_socket(self, params_cfg):
+        """The same forced-off-home drill over REAL worker processes:
+        the chain crosses the frame protocol twice (fetch from the
+        owner process, push into the peer process) and the peer
+        adopts it — fetch_hit_rate > 0, streams bitwise, recompiles
+        <= 1 per replica. Slow tier: two worker cold starts."""
+        prompts = {k: SYS[2] + [60 + k] for k in range(1, 4)}
+        refs = _single_frontend_refs(params_cfg, prompts, 4)
+        worker_engine = dict(ENG, max_queue_depth=1,
+                             max_tracked_sequences=16,
+                             prefix_cache=True)
+        serving = _xfer_serving(transport={
+            "channel": "socket",
+            "worker_args": {"engine": worker_engine}})
+        serving["max_queue_depth"] = 1
+        router = _router(params_cfg, n=2, serving=serving,
+                         engine_kw={"max_queue_depth": 1})
+        try:
+            r1 = router.submit(prompts[1], uid=1, max_new_tokens=4)
+            router.drain()
+            assert r1.state == RequestState.FINISHED
+            home = router._entries[1].slot
+            router.submit(prompts[2], uid=2, max_new_tokens=4)
+            router.submit(prompts[3], uid=3, max_new_tokens=4)
+            placed = {router._entries[u].slot for u in (2, 3)}
+            assert placed == {home, 1 - home}      # one forced off-home
+            router.drain()
+            bx = router.get_fleet_report()["blockxfer"]
+            assert bx["fetch_hit_rate"] > 0
+            assert bx["fetched_blocks"] >= 2
+            assert bx["pushed_blocks"] >= 2
+            for uid in (1, 2, 3):
+                assert list(router.get_request(uid).tokens) == \
+                    refs[uid], uid
+            for slot in router.pooled_replicas:
+                replica = router._replicas[slot]
+                assert replica.frontend is None    # real processes
+                assert replica.snapshot()["recompiles"] <= 1, slot
+        finally:
+            for slot in router.pooled_replicas:
+                router._replicas[slot].kill("test teardown")
+
+
+def _xfer_chaos_serve(params_cfg, specs, n_pairs=3, max_new_tokens=4):
+    """Shared-prefix pressure through a 2-replica transfer-enabled
+    fleet with chaos armed. Arrivals come in SAME-PREFIX pairs
+    released only when the fleet is idle: with queue depth 1 the
+    first of a pair takes the prefix's home replica and the second is
+    forced onto the other one — from the second pair of a group on,
+    that is a guaranteed live peer transfer under fire."""
+    n_req = 2 * n_pairs
+    reqs_in = {800 + k: SYS[(k // 2) % 2] + [50 + k]
+               for k in range(n_req)}
+    refs = _single_frontend_refs(params_cfg, reqs_in, max_new_tokens)
+    router = _router(params_cfg, n=2, serving=_xfer_serving(),
+                     engine_kw={"max_queue_depth": 1})
+    handles = {}
+
+    def poll(r, step):
+        k = len(handles)
+        if k < n_req and all(h.state == RequestState.FINISHED
+                             for h in handles.values()):
+            for uid in (800 + k, 800 + k + 1):   # the idle-burst pair
+                try:
+                    handles[uid] = r.submit(
+                        reqs_in[uid], uid=uid,
+                        max_new_tokens=max_new_tokens)
+                except ServingOverloadError:
+                    pass      # a replica refused; retry next step
+        return len(handles) < n_req or any(
+            h.state != RequestState.FINISHED for h in handles.values())
+    fault_injector.configure(specs)
+    try:
+        router.serve(poll=poll, max_steps=800)
+    finally:
+        fault_injector.reset()
+    router.drain()
+    return router, handles, refs
+
+
+def _assert_chaos_exact(router, handles, refs, n_req):
+    assert len(handles) == n_req
+    for uid, r in handles.items():
+        assert r.state == RequestState.FINISHED, (uid, r.state,
+                                                  r.shed_reason)
+        assert r.tokens == refs[uid], uid
+    rep = router.get_fleet_report()
+    assert rep["router"]["replay_mismatches"] == 0
+    assert rep["router"]["abandoned"] == 0
+    return rep
+
+
+class TestChaosWithTransfersArmed:
+    """Satellite 3: the transport fault matrix OVER live peer
+    transfers, plus seeded blockxfer corruption — bitwise streams, no
+    lost/doubled tokens, poisoned fetches degrade to recompute."""
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("kind", ["drop", "delay", "dup",
+                                      "reorder", "truncate"])
+    def test_chaos_matrix(self, params_cfg, kind):
+        router, handles, refs = _xfer_chaos_serve(
+            params_cfg, f"transport.send:{kind}~0.15,"
+                        f"transport.recv:{kind}~0.15")
+        rep = _assert_chaos_exact(router, handles, refs, 6)
+        assert rep["transport"]["injected"] > 0
+
+    @pytest.mark.slow
+    def test_chaos_with_seeded_fetch_corruption(self, params_cfg):
+        """Drops both ways + every peer fetch poisoned: the checksum
+        rejects each one, every off-home placement recomputes, and
+        the streams stay bitwise — corruption can cost time, never
+        a wrong token."""
+        router, handles, refs = _xfer_chaos_serve(
+            params_cfg, "transport.send:drop~0.1,"
+                        "transport.recv:drop~0.1,"
+                        "blockxfer.fetch:corruptx999")
+        rep = _assert_chaos_exact(router, handles, refs, 6)
+        bx = rep["blockxfer"]
+        assert bx["fetch_rpcs"] > 0            # transfers really ran
+        assert bx["fetch_rejects"] > 0
+        assert bx["recompute_fallbacks"] > 0
+        assert bx["fetch_hits"] == 0 and bx["pushed_blocks"] == 0

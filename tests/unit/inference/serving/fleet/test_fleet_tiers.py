@@ -4,6 +4,8 @@ residency for spilled digests (3-tuple journal records folded into a
 scores spilled prefixes with the configured DRAM/disk discounts, and
 the SNAPSHOT resync rebuilds residency from ``trie_tiers``."""
 
+import types
+
 import numpy as np
 import pytest
 
@@ -165,3 +167,54 @@ class TestRouterAffinityTiers:
         assert router._affinity_map.get(da[1]) == (home, "dram")
         slot, n, w = router._affinity(da)
         assert (slot, n, w) == (home, 2, pytest.approx(1.7))
+
+
+class TestRemoteDiscountOrdering:
+    """ISSUE 19 satellite: with peer block transfer enabled, remote
+    residency scores through ``remote_affinity_discount`` ON TOP of
+    the tier weight. The regression this pins: a replica's own DRAM
+    hit must always outrank a peer's disk hit — an early transfer
+    draft applied the discount to the HBM weight regardless of the
+    remote tier, which ranked a peer's disk-spilled chain above local
+    DRAM and shipped prefixes BACKWARD (fetching cold peer blocks
+    while warm local ones sat unused)."""
+
+    XFER = {"prefix": TIERS["prefix"],
+            "fleet": {"n_replicas": 2, "transfer": {"enabled": True}}}
+
+    def test_effective_weight_ladder(self, params_cfg):
+        router = _router(params_cfg, serving=dict(self.XFER))
+        disc = router._remote_discount
+        w = router._tier_weights
+        assert disc == pytest.approx(0.5)
+        # local hbm > local dram > peer hbm > local disk
+        #   > peer dram > peer disk — strictly, no ties
+        ladder = [w["hbm"], w["dram"], disc * w["hbm"], w["disk"],
+                  disc * w["dram"], disc * w["disk"]]
+        assert ladder == sorted(ladder, reverse=True)
+        assert len(set(ladder)) == len(ladder)
+        # the pinned ordering itself
+        assert w["dram"] > disc * w["disk"]
+
+    def test_ranked_slots_keep_owner_ahead_of_discounted_peer(
+            self, params_cfg):
+        """A disk-resident chain on slot 1: slot 1 scores the full
+        disk weight (0.4), slot 0 only the discounted remote value
+        (0.2) — the owner stays first in the placement order."""
+        router = _router(params_cfg, serving=dict(self.XFER))
+        d = bytes(16)
+        router._affinity_map.put(d, (1, "disk"))
+        entry = types.SimpleNamespace(digests=[d])
+        order, aff_slot, aff_n = router._ranked_slots(entry)
+        assert (aff_slot, aff_n) == (1, 1)
+        assert order[0] == 1
+        assert router._affinity([d]) == \
+            (1, 1, pytest.approx(router._tier_weights["disk"]))
+
+    def test_transfer_off_scores_remote_residency_zero(self,
+                                                       params_cfg):
+        """Feature toggle off: the discount is exactly 0.0, so the
+        scoring pass reproduces the pre-transfer behavior bit for
+        bit (remote residency worth nothing)."""
+        router = _router(params_cfg)
+        assert router._remote_discount == 0.0

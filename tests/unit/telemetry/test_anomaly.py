@@ -101,15 +101,29 @@ class TestDefaults:
             "train/step_time_ms", "offload/overlap_residue_ms",
             "serving/ttft_ms/p50", "serving/itl_ms/p50",
             "memory/host_rss_gb", "memory/device_gb_in_use",
-            "cache/spill_backlog"}
+            "cache/spill_backlog", "fleet/blockxfer/fetch_exposed_ms"}
 
     def test_zeros_disable(self):
         from deepspeed_tpu.runtime.config import TelemetryAnomalyConfig
         cfg = TelemetryAnomalyConfig.from_dict({
             "step_time_spike_factor": 0,
             "residue_spike_factor": 0,
-            "spill_backlog_slope_per_step": 0})
+            "spill_backlog_slope_per_step": 0,
+            "blockxfer_stall_factor": 0})
         assert default_watchers(cfg) == []
+
+    def test_blockxfer_stall_watcher_spikes(self):
+        """The peer-fetch stall watch (fleet blockxfer): exposed fetch
+        wall spiking against its own EWMA alerts through the standard
+        ewma_spike kind — same schema, fleet/blockxfer namespace."""
+        from deepspeed_tpu.runtime.config import TelemetryAnomalyConfig
+        ws = default_watchers(TelemetryAnomalyConfig())
+        w = next(x for x in ws
+                 if x.metric == "fleet/blockxfer/fetch_exposed_ms")
+        alerts = _feed(w, [5.0, 5.0, 5.0, 5.0, 40.0],
+                       "fleet/blockxfer/fetch_exposed_ms")
+        assert alerts and alerts[-1].kind == "ewma_spike"
+        assert alerts[-1].metric == "fleet/blockxfer/fetch_exposed_ms"
 
     def test_alert_is_flat_jsonable(self):
         import json

@@ -100,7 +100,11 @@ TRACKED_DECOMP_KEYS = {"5": ("speculation",),
                                       "cache.cache_demote_overlapped_ms",
                                       "cache.cache_promote_exposed_ms",
                                       "cache.cache_promote_overlapped_ms"),
-                       "8_fleet": ("transport", "bootstrap"),
+                       "8_fleet": ("transport", "bootstrap",
+                                   "blockxfer",
+                                   "blockxfer.fetch_hit_rate",
+                                   "blockxfer.fetch_exposed_ms",
+                                   "blockxfer.fetch_overlapped_ms"),
                        "9_bigmodel": ("param_stream",
                                       "param_stream.param_drop_exposed_ms",
                                       "param_stream.param_drop_overlapped_ms")}
