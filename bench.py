@@ -764,7 +764,203 @@ def bench_config7():
     }
 
 
-def bench_config8(tiny=False, transport="loopback"):
+def _fleet_decomp_common(rep):
+    """The fleet-report slices EVERY 8_fleet row variant publishes —
+    one copy so the disagg row cannot drift from the plain row's
+    tracked-key surface (tools/bench_compare.py's lineage gate keys
+    on these blocks and their dotted members)."""
+    return {
+        # the RPC tax: near-zero on loopback, priced for real
+        # over --transport socket (tracked by the lineage gate)
+        "transport": {
+            k: rep["transport"][k]
+            for k in ("channel", "rpcs", "retries", "timeouts",
+                      "reconnects", "bytes_sent", "bytes_recv",
+                      "probes", "probe_latency_ms")},
+        # the bootstrap tax (--transport remote): dial-in joins,
+        # auth/fencing refusals, the fencing epoch, write-ahead
+        # journal durability counters; loopback/socket rows keep
+        # listener/journal null (tracked by the lineage gate)
+        "bootstrap": {
+            "channel": rep["bootstrap"]["channel"],
+            "epoch": rep["bootstrap"]["epoch"],
+            "listener": ({
+                k: rep["bootstrap"]["listener"][k]
+                for k in ("joins", "auth_failures", "fenced",
+                          "handshake_errors")}
+                if rep["bootstrap"]["listener"] else None),
+            "journal": ({
+                k: rep["bootstrap"]["journal"][k]
+                for k in ("records_written", "fsyncs")}
+                if rep["bootstrap"]["journal"] else None),
+        },
+        # the peer-transfer ledger (fleet-wide prefix sharing):
+        # blocks fetched from peers vs recomputed, push traffic
+        # (placement prefetch + warm starts), the exposed/
+        # overlapped split of the fetch wall (tracked by the
+        # lineage gate)
+        "blockxfer": {
+            k: rep["blockxfer"][k]
+            for k in ("enabled", "fetched_blocks", "pushed_blocks",
+                      "fetch_hit_rate", "fetch_bytes",
+                      "fetch_exposed_ms", "fetch_overlapped_ms",
+                      "recompute_fallbacks")},
+        # the disagg handoff ledger (zeros on a mixed fleet): phase-A
+        # pipelined pushes vs the phase-B exposed flush, landed vs
+        # degraded-to-prefill-side-decode handoffs (tracked by the
+        # lineage gate once a row publishes it)
+        "handoff": {
+            k: rep["handoff"][k]
+            for k in ("enabled", "pushes", "pushed_blocks",
+                      "push_bytes", "push_stalls", "landed",
+                      "fallbacks", "mixed_placements", "resumes",
+                      "handoff_exposed_ms", "handoff_overlapped_ms")},
+    }
+
+
+def _bench8_disagg(engine_factory, fleet_cfg, vocab, tiny, transport,
+                   block):
+    """The config-8 DISAGGREGATED variant (``--disagg``): the same
+    fleet machinery role-split 2 prefill + 2 decode, measured on the
+    workload disaggregation exists for — steady decode streams with a
+    seeded prefill BURST landing mid-decode. Runs the identical
+    workload TWICE in one invocation: a mixed-fleet control first,
+    then the role-split fleet; asserts the streams are bitwise
+    identical (the disagg invariant) and publishes decode ITL
+    p50/p99 for both sides plus the handoff decomposition
+    (pipelined-push overlap vs exposed flush). Caveat for reading the
+    tiny loopback numbers: replicas step SEQUENTIALLY in one process,
+    so the control's prefill interference and the disagg side's
+    isolation both dilute into the shared step wall — the ITL spread
+    prices the handoff machinery's own cost there, while the
+    interference split needs ``--transport socket`` (real processes)
+    or the accelerator box."""
+    import jax
+
+    from deepspeed_tpu.inference.v2 import FleetRouter
+    from deepspeed_tpu.runtime.lifecycle import memory_gauges
+
+    R = int(fleet_cfg["n_replicas"])
+    if tiny:
+        D, P, new_decode, burst_step = 4, 3, 24, 6
+        burst_len, tail_len = 4 * block + 8, 8
+    else:
+        D, P, new_decode, burst_step = 8, 6, 48, 8
+        burst_len, tail_len = 3 * block + 32, 32
+    rng = np.random.default_rng(80)
+    warm = [rng.integers(0, vocab, size=block, dtype=np.int32)
+            for _ in range(R)]
+    # steady decode streams: short prompts (2 blocks incl. the unique
+    # tail), long outputs — the ITL-sensitive population
+    decode_prompts = [rng.integers(0, vocab, size=block + tail_len,
+                                   dtype=np.int32) for _ in range(D)]
+    # the burst: long prompts (several full blocks each, together a
+    # multiple of the token budget so SplitFuse chunks them across
+    # steps — the window phase-A pushes pipeline behind), 2 tokens out
+    burst_prompts = [rng.integers(0, vocab, size=burst_len,
+                                  dtype=np.int32) for _ in range(P)]
+
+    def run(roles):
+        fleet = dict(fleet_cfg)
+        if roles is not None:
+            fleet["disagg"] = {"enabled": True, "roles": list(roles)}
+        # the DRAM tier is the landing pad for pushed handoff blocks
+        # (BLOCK_PUSH -> adopt/promote); the control gets the same
+        # config so the role split is the ONLY variable
+        router = FleetRouter(
+            engine_factory,
+            {"prefix": {"enabled": True,
+                        "tiers": {"enabled": True,
+                                  "dram_max_mb": 64.0}},
+             "fleet": fleet})
+        for w in warm:
+            router.submit(w, max_new_tokens=2)
+        router.drain()
+        stamps = [[] for _ in range(D)]
+
+        def cb(k):
+            return lambda tok: stamps[k].append(time.perf_counter())
+
+        handles = {}
+
+        def poll(r, step):
+            if step == 0:
+                for k in range(D):
+                    handles[f"d{k}"] = r.submit(
+                        decode_prompts[k], max_new_tokens=new_decode,
+                        on_token=cb(k))
+            if step == burst_step:
+                for j in range(P):
+                    handles[f"p{j}"] = r.submit(burst_prompts[j],
+                                                max_new_tokens=2)
+            return step < burst_step
+
+        t0 = time.time()
+        steps = router.serve(poll=poll)
+        wall = time.time() - t0
+        rep = router.get_fleet_report()
+        assert rep["router"]["finished"] == D + P + R, rep["router"]
+        streams = {key: list(h.tokens) for key, h in handles.items()}
+        if transport == "socket":
+            for replica in router._replicas:
+                try:
+                    replica.detach()
+                except Exception:
+                    pass
+        itl = [d * 1000.0 for s in stamps if len(s) > 1
+               for d in np.diff(s)]
+        return rep, streams, itl, wall, steps
+
+    _, ctl_streams, ctl_itl, _, _ = run(None)
+    rep, streams, itl, wall, steps = run(
+        ["prefill", "prefill", "decode", "decode"])
+    # THE disagg invariant: role split is a placement/transport
+    # change, never a numerics change — fold_in(uid, pos) keys make
+    # the streams bitwise identical disagg on/off
+    assert streams == ctl_streams, \
+        "disagg streams diverged from the mixed control"
+    ho = rep["handoff"]
+    assert ho["landed"] > 0, ho
+    assert ho["handoff_overlapped_ms"] > 0.0, ho
+    trace_tokens = sum(len(t) for t in streams.values())
+    sustained = trace_tokens / wall if wall > 0 else 0.0
+
+    def pct(xs, q):
+        return round(float(np.percentile(xs, q)), 2) if xs else 0.0
+
+    return {
+        "config": "8_fleet",
+        "model": ("llama_tiny" if tiny else "llama7b_shape_4l"),
+        "chips": jax.device_count(),
+        "metric": "fleet_sustained_tok_per_s",
+        "value": round(sustained, 1),
+        "unit": (f"tok/s disagg 2P+2D over {steps} steps ({D} decode "
+                 f"streams, {P}-prompt prefill burst @step "
+                 f"{burst_step})"),
+        "vs_baseline": round(sustained / (1000.0 * R), 4),
+        "decomposition": {
+            "sustained_fleet_tok_per_s": round(sustained, 1),
+            "replicas": R,
+            "roles": list(rep["handoff"]["roles"]),
+            # decode ITL under the burst, disagg vs the mixed control
+            # run in the SAME invocation (ms per token, steady decode
+            # streams only, first token excluded)
+            "itl_p50_ms": pct(itl, 50),
+            "itl_p99_ms": pct(itl, 99),
+            "control_itl_p50_ms": pct(ctl_itl, 50),
+            "control_itl_p99_ms": pct(ctl_itl, 99),
+            "bitwise_vs_control": 1,
+            "cross_replica_prefix_hit_rate": round(
+                rep["prefix"]["hit_rate"], 4),
+            "router": rep["router"],
+            **_fleet_decomp_common(rep),
+            "memory": _memory_decomposition(
+                memory_gauges(include_arrays=False)),
+        },
+    }
+
+
+def bench_config8(tiny=False, transport="loopback", disagg=False):
     """Fleet serving over 3 data-parallel replicas (ISSUE 11): the
     config-7 open-world Poisson shared-prefix arrival mix routed
     through ``FleetRouter`` (prefix-affinity scoring) instead of one
@@ -778,9 +974,12 @@ def bench_config8(tiny=False, transport="loopback"):
     retries, timeouts, reconnects, bytes, probe latency): the RPC tax
     the loopback default keeps near zero and ``transport="socket"``
     (one OS process per replica, ``--transport socket``, tiny-only)
-    prices for real. ``tiny=True`` shrinks the model/engine shapes
-    for the local logic-validation run (standing constraint (b):
-    full-size numbers need the accelerator box)."""
+    prices for real. ``disagg=True`` (``--disagg``) switches to the
+    role-split 2-prefill + 2-decode variant measured against a
+    mixed-fleet control — see ``_bench8_disagg``. ``tiny=True``
+    shrinks the model/engine shapes for the local logic-validation
+    run (standing constraint (b): full-size numbers need the
+    accelerator box)."""
     import dataclasses
 
     import jax
@@ -791,7 +990,13 @@ def bench_config8(tiny=False, transport="loopback"):
     from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from deepspeed_tpu.runtime.lifecycle import memory_gauges
 
-    R = 3
+    if disagg and transport not in ("loopback", "socket"):
+        # the remote path's out-of-band workers take their own serving
+        # config; threading the tiered-cache block through that spawn
+        # is not worth a bench-only branch
+        raise ValueError("--disagg requires --transport loopback or "
+                         "socket")
+    R = 4 if disagg else 3
     if tiny:
         cfg = LlamaConfig.tiny()
         block, budget, B, per_seq, new, N = 8, 32, 4, 8, 4, 12
@@ -843,6 +1048,9 @@ def bench_config8(tiny=False, transport="loopback"):
             # bench engine geometry (geometry must match fleet-wide)
             "worker_args": {"engine": worker_engine},
         }
+    if disagg:
+        return _bench8_disagg(engine_factory, fleet_cfg,
+                              cfg.vocab_size, tiny, transport, block)
     listener = procs = None
     if transport == "remote":
         if not tiny:
@@ -946,41 +1154,7 @@ def bench_config8(tiny=False, transport="loopback"):
             "prefix": rep["prefix"],
             "router": rep["router"],
             "per_replica": per_replica,
-            # the RPC tax: near-zero on loopback, priced for real
-            # over --transport socket (tracked by the lineage gate)
-            "transport": {
-                k: rep["transport"][k]
-                for k in ("channel", "rpcs", "retries", "timeouts",
-                          "reconnects", "bytes_sent", "bytes_recv",
-                          "probes", "probe_latency_ms")},
-            # the bootstrap tax (--transport remote): dial-in joins,
-            # auth/fencing refusals, the fencing epoch, write-ahead
-            # journal durability counters; loopback/socket rows keep
-            # listener/journal null (tracked by the lineage gate)
-            "bootstrap": {
-                "channel": rep["bootstrap"]["channel"],
-                "epoch": rep["bootstrap"]["epoch"],
-                "listener": ({
-                    k: rep["bootstrap"]["listener"][k]
-                    for k in ("joins", "auth_failures", "fenced",
-                              "handshake_errors")}
-                    if rep["bootstrap"]["listener"] else None),
-                "journal": ({
-                    k: rep["bootstrap"]["journal"][k]
-                    for k in ("records_written", "fsyncs")}
-                    if rep["bootstrap"]["journal"] else None),
-            },
-            # the peer-transfer ledger (fleet-wide prefix sharing):
-            # blocks fetched from peers vs recomputed, push traffic
-            # (placement prefetch + warm starts), the exposed/
-            # overlapped split of the fetch wall (tracked by the
-            # lineage gate)
-            "blockxfer": {
-                k: rep["blockxfer"][k]
-                for k in ("enabled", "fetched_blocks", "pushed_blocks",
-                          "fetch_hit_rate", "fetch_bytes",
-                          "fetch_exposed_ms", "fetch_overlapped_ms",
-                          "recompute_fallbacks")},
+            **_fleet_decomp_common(rep),
             "memory": _memory_decomposition(
                 memory_gauges(include_arrays=False)),
         },
@@ -1170,7 +1344,15 @@ def main():
                         "remote (out-of-band dial-in workers over the "
                         "authenticated JOIN bootstrap, journal armed; "
                         "requires --tiny)")
+    p.add_argument("--disagg", action="store_true",
+                   help="config 8_fleet only: the disaggregated "
+                        "prefill/decode variant (2 prefill + 2 decode "
+                        "replicas, seeded prefill burst over steady "
+                        "decode streams, mixed-fleet control run in "
+                        "the same invocation; loopback or socket)")
     args = p.parse_args()
+    if args.disagg and args.config != "8_fleet":
+        p.error("--disagg is only valid with --config 8_fleet")
     if args.tiny and args.config not in ("8_fleet", "9_bigmodel"):
         # a tiny-shape row must never land in an artifact lineage the
         # gate compares against real hardware numbers
@@ -1189,7 +1371,8 @@ def main():
            "5_int4": lambda: bench_config5(weight_dtype="int4"),
            "6_recovery": bench_config6, "7_frontend": bench_config7,
            "8_fleet": lambda: bench_config8(tiny=args.tiny,
-                                            transport=args.transport),
+                                            transport=args.transport,
+                                            disagg=args.disagg),
            "9_bigmodel": lambda: bench_config9(tiny=args.tiny)}
     if args.config != "0":
         print(json.dumps(fns[args.config]()))

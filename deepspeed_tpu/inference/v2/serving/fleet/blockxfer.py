@@ -51,7 +51,7 @@ lands.
 """
 
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .....resilience.errors import InjectedFault, WorkerFailureError
 from .....resilience.fault_injector import fault_injector
@@ -213,6 +213,76 @@ class PeerBlockSource:
         else:
             self.recompute_fallbacks += 1
         return landed
+
+    def handoff_segment(self, owner, dest, digests: List[bytes],
+                        parent_hex: str = "", chunk: int = 4
+                        ) -> Tuple[int, int]:
+        """Disagg prefill->decode handoff mover: fetch ``digests``
+        (chain order, anchored at ``parent_hex`` — mid-chain segments
+        resume behind blocks already landed) from the prefill owner,
+        verify inline, and push into the decode dest's DRAM tier
+        through the ordinary BLOCK_PUSH land path. No policy gate and
+        no blockxfer counters — the handoff contract requires the
+        blocks to move (failure degrades at the ROUTER's choke point,
+        which also owns the ``handoff`` stats block). Fault site
+        ``handoff.push`` fires once per segment; kind ``corrupt``
+        poisons one payload AFTER its checksum is stamped (the
+        receiver refuses it and the landed count truncates there), any
+        other kind aborts the segment before the fetch. Returns
+        ``(blocks landed, payload bytes landed)``."""
+        if not digests:
+            return 0, 0
+        spec = fault_injector.consume("handoff.push",
+                                      detail=f"replica{dest.slot}")
+        if spec is not None and spec.kind != "corrupt":
+            logger.debug("handoff.push: injected %s", spec.kind)
+            return 0, 0
+        with span("handoff.push", slot=dest.slot, n=len(digests)):
+            try:
+                raw = owner.fetch_blocks([d.hex() for d in digests])
+            except WorkerFailureError:
+                return 0, 0
+            by_d = {}
+            for blk in raw.get("blocks", []):
+                try:
+                    payload = bytes.fromhex(blk["payload"])
+                except (KeyError, TypeError, ValueError):
+                    continue
+                if blake2b_hex(payload) != blk.get("b2"):
+                    continue
+                by_d[blk["d"]] = (payload, blk.get("meta") or {})
+            out: List[dict] = []
+            sizes: List[int] = []
+            parent = parent_hex
+            for d in digests:
+                v = by_d.get(d.hex())
+                if v is None:
+                    break   # hole: children past it can never land
+                payload, meta = v
+                b2 = blake2b_hex(payload)
+                if spec is not None and payload:
+                    payload = bytes([payload[0] ^ 0xFF]) + payload[1:]
+                    spec = None
+                out.append({"d": d.hex(), "parent": parent,
+                            "payload": payload.hex(), "b2": b2,
+                            "meta": meta})
+                sizes.append(len(payload))
+                parent = d.hex()
+            landed = 0
+            csz = max(1, int(chunk))
+            for i in range(0, len(out), csz):
+                ch = out[i:i + csz]
+                try:
+                    reply = dest.push_blocks(ch)
+                except WorkerFailureError as e:
+                    logger.debug("handoff: push to slot %d failed: %s",
+                                 dest.slot, e)
+                    break
+                got = int(reply.get("landed", 0))
+                landed += got
+                if got < len(ch):
+                    break   # a refused parent orphans the tail
+        return landed, sum(sizes[:landed])
 
     def _fetch_verified(self, owner, digests: List[bytes]) -> List[dict]:
         """-> verified push payloads (chain order, truncated at the

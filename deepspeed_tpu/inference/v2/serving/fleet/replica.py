@@ -40,10 +40,10 @@ from .....resilience.fault_injector import fault_injector
 from .....telemetry.trace import span
 from .....utils.logging import logger
 from .transport import (MSG_BLOCK_FETCH, MSG_BLOCK_PUSH, MSG_CANCEL,
-                        MSG_HEARTBEAT, MSG_HELLO, MSG_SHUTDOWN,
-                        MSG_SNAPSHOT, MSG_STEP, MSG_SUBMIT,
-                        MSG_TOKENS, FaultyChannel, HealthProber,
-                        RpcClient, TransportStats)
+                        MSG_HEARTBEAT, MSG_HELLO, MSG_SEQ_HANDOFF,
+                        MSG_SHUTDOWN, MSG_SNAPSHOT, MSG_STEP,
+                        MSG_SUBMIT, MSG_TOKENS, FaultyChannel,
+                        HealthProber, RpcClient, TransportStats)
 from .worker import sampling_to_wire
 
 _FOREVER = float("inf")
@@ -60,11 +60,15 @@ class Replica:
     in-flight frames can never cross generations."""
 
     def __init__(self, slot: int, channel_factory, transport_cfg,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, role: str = "mixed"):
         self.slot = int(slot)
         self._factory = channel_factory
         self._tcfg = transport_cfg
         self._clock = clock
+        # disaggregation role, re-announced on every (re)connect's
+        # HELLO — a respawned worker re-learns it (the socket worker's
+        # serving config never carries the fleet block)
+        self.role = str(role or "mixed")
         self.stats = TransportStats()
         self.prober = HealthProber()
         self.generation = 1
@@ -91,7 +95,7 @@ class Replica:
         # process and shuts the half-open socket down both ways.
         try:
             self.hello = self._rpc.call(
-                MSG_HELLO,
+                MSG_HELLO, {"role": self.role},
                 deadline_s=float(self._tcfg.connect_deadline_seconds))
         except BaseException:
             try:
@@ -230,7 +234,8 @@ class Replica:
                max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None, sampling=None,
                priority: int = 0,
-               deadline_ms: Optional[float] = None):
+               deadline_ms: Optional[float] = None,
+               handoff: bool = False):
         """One SUBMIT RPC. Typed replica-side refusals
         (``ServingOverloadError`` et al.) come back re-raised; an
         exhausted transport budget surfaces as the same typed
@@ -248,6 +253,8 @@ class Replica:
             "priority": int(priority),
             "deadline_ms": deadline_ms,
         }
+        if handoff:
+            payload["handoff"] = True
         try:
             return self._call(MSG_SUBMIT, payload)
         except TransportError as e:
@@ -298,6 +305,17 @@ class Replica:
             raise WorkerFailureError(
                 self.slot, "error",
                 f"block push transport failure: {e}") from e
+
+    def seq_handoff(self, payload: dict) -> dict:
+        """One SEQ_HANDOFF RPC (op export/land/resume/release —
+        effectful ops ride the exactly-once reply cache like SUBMIT).
+        Same typed transport contract as ``submit``."""
+        try:
+            return self._call(MSG_SEQ_HANDOFF, dict(payload))
+        except TransportError as e:
+            raise WorkerFailureError(
+                self.slot, "error",
+                f"handoff transport failure: {e}") from e
 
     # -- the supervised step -------------------------------------------
     def step(self, cursors: Optional[dict] = None) -> Optional[dict]:

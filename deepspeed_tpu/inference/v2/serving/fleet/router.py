@@ -74,6 +74,7 @@ from .....resilience.errors import (CollectiveTimeout,
                                     TransportError,
                                     UnknownRequestError,
                                     WorkerFailureError)
+from .....resilience.fault_injector import fault_injector
 from .....runtime.lifecycle import BoundedCache
 from .....telemetry.anomaly import TelemetryAlert
 from .....telemetry.trace import span
@@ -129,9 +130,11 @@ class RoundRobinPolicy:
 
 class _FleetEntry:
     """Router-side bookkeeping for one request: the user-visible
-    ``Request`` handle plus placement + replay-cursor state."""
+    ``Request`` handle plus placement + replay-cursor state (and, on
+    a disagg fleet, the pipelined-handoff plan)."""
     __slots__ = ("req", "slot", "kwargs", "digests", "seen",
-                 "requeues", "user_on_token")
+                 "requeues", "user_on_token", "handoff", "decode_slot",
+                 "pushed", "hb", "parked")
 
     def __init__(self, req, kwargs, digests, user_on_token):
         self.req = req
@@ -141,6 +144,12 @@ class _FleetEntry:
         self.seen = 0          # tokens seen from the CURRENT attempt
         self.requeues = 0
         self.user_on_token = user_on_token
+        # -- disagg handoff plan (all reset on requeue) --
+        self.handoff = False             # live prefill->decode plan
+        self.decode_slot: Optional[int] = None   # chosen at admission
+        self.pushed = 0      # full blocks already landed on the target
+        self.hb = 0          # prefill-reported committed full blocks
+        self.parked = False  # prefill reported first-token park
 
 
 class FleetRouter:
@@ -231,8 +240,23 @@ class FleetRouter:
         # replica that silently shed a routed request would corrupt
         # the router's placement bookkeeping
         self._replica_cfg = _dc.replace(cfg, on_overload="raise")
+        # disaggregated prefill/decode (the disagg PR): per-slot roles
+        # ride the HELLO RPC (re-announced on every connect, so a
+        # respawned worker re-learns its role). The default — disagg
+        # off, every slot "mixed" — is today's behavior bit for bit.
+        dcfg = getattr(fc, "disagg", None)
+        self._disagg_cfg = dcfg
+        self._disagg = bool(dcfg is not None and dcfg.enabled)
+        roles = [str(r) for r in
+                 (dcfg.roles or [] if self._disagg else [])]
+        bad = sorted(set(roles) - {"prefill", "decode", "mixed"})
+        if bad:
+            raise ValueError(f"serving.fleet.disagg.roles must be "
+                             f"prefill/decode/mixed, got {bad}")
+        self._roles = [roles[s] if s < len(roles) else "mixed"
+                       for s in range(n)]
         self._replicas = [Replica(slot, self._channel_factory, tc,
-                                  clock)
+                                  clock, role=self._roles[slot])
                           for slot in range(n)]
         self._pool: Set[int] = set(range(n))  # the router's view
         from .....resilience.watchdog import HeartbeatMonitor
@@ -265,9 +289,31 @@ class FleetRouter:
         xcfg = getattr(fc, "transfer", None)
         self._transfer_cfg = xcfg
         enabled = bool(xcfg is not None and xcfg.enabled)
-        self._blockxfer = PeerBlockSource(xcfg) if enabled else None
+        # the handoff pipeline rides the same fetch/verify/push
+        # machinery, so disagg arms the PeerBlockSource too — but the
+        # CLASSIC transfer paths (off-home prefetch, warm starts,
+        # affinity discount) stay gated on transfer.enabled alone:
+        # turning disagg on must not silently turn them on
+        self._transfer_on = enabled
+        self._blockxfer = PeerBlockSource(xcfg) \
+            if (enabled or (self._disagg and xcfg is not None)) \
+            else None
         self._remote_discount = float(
             xcfg.remote_affinity_discount) if enabled else 0.0
+        # in-flight off-home prefetch dedup: (dest slot, chain-head
+        # digest) -> router-step expiry (entries also clear early when
+        # the destination's TRIE_DELTA confirms the head landed)
+        self._prefetch_inflight: Dict[Tuple[int, bytes], int] = {}
+        self.prefetch_dedup_skips = 0
+        # the fleet report's ``handoff`` block (schema-stable: every
+        # key present, zeroed, whether disagg is on or off)
+        self._hstats = {
+            "pushes": 0, "pushed_blocks": 0, "push_bytes": 0,
+            "push_stalls": 0, "landed": 0, "fallbacks": 0,
+            "fallback_reasons": {}, "mixed_placements": 0,
+            "resumes": 0, "releases_failed": 0,
+            "handoff_exposed_ms": 0.0, "handoff_overlapped_ms": 0.0,
+        }
         self._trie_seqs = {rep.slot: int(rep.hello.get("trie_seq", 0))
                            for rep in self._replicas}
         self._block_size = int(self._replicas[0].kv_block_size
@@ -339,7 +385,8 @@ class FleetRouter:
                 "prefix": self._fleet_prefix_stats(),
                 "transport": self._transport_stats(),
                 "bootstrap": self._bootstrap_stats(),
-                "blockxfer": self._blockxfer_stats()}
+                "blockxfer": self._blockxfer_stats(),
+                "handoff": self._handoff_stats()}
 
     # -- introspection --------------------------------------------------
     @property
@@ -704,6 +751,19 @@ class FleetRouter:
             aff_slot = None
         return order, aff_slot, aff_n
 
+    def _attempt_kwargs(self, e: "_FleetEntry") -> dict:
+        """Per-attempt submit kwargs. The deadline clock does NOT
+        restart on a requeue: the survivor's gate sees only the budget
+        the request has left (0 left -> it sheds there, and the router
+        propagates) — a client's deadline is end-to-end, not
+        per-attempt."""
+        kwargs = e.kwargs
+        if kwargs.get("deadline_ms") is not None:
+            elapsed_ms = (self._clock() - e.req.submitted_t) * 1e3
+            kwargs = dict(kwargs, deadline_ms=max(
+                0.0, kwargs["deadline_ms"] - elapsed_ms))
+        return kwargs
+
     def _place(self, uid: int) -> bool:
         """One scoring pass + SUBMIT RPC; returns False when every
         pooled replica refused (fleet saturated). The affinity map is
@@ -712,17 +772,17 @@ class FleetRouter:
         placement-time writes went stale the moment a replica evicted
         an entry, and kept pulling traffic at KV that was gone)."""
         e = self._entries[uid]
+        if self._disagg:
+            placed = self._place_disagg(e)
+            if placed is not None:
+                return placed
+            # pools empty / collapsed / every prefill candidate
+            # refused: degrade to the ordinary mixed placement below
+            # (counted — a disagg fleet quietly serving mixed is a
+            # config smell worth a dashboard)
+            self._hstats["mixed_placements"] += 1
         order, aff_slot, aff_n = self._ranked_slots(e)
-        kwargs = e.kwargs
-        if kwargs.get("deadline_ms") is not None:
-            # the deadline clock does NOT restart on a requeue: the
-            # survivor's gate sees only the budget the request has
-            # left (0 left -> it sheds there, and the router
-            # propagates) — a client's deadline is end-to-end, not
-            # per-attempt
-            elapsed_ms = (self._clock() - e.req.submitted_t) * 1e3
-            kwargs = dict(kwargs, deadline_ms=max(
-                0.0, kwargs["deadline_ms"] - elapsed_ms))
+        kwargs = self._attempt_kwargs(e)
         with span("fleet.route", uid=uid, affinity=aff_n):
             for slot in order:
                 rep = self._replicas[slot]
@@ -753,6 +813,241 @@ class FleetRouter:
                     self._maybe_prefetch(e, slot, aff_slot)
                 return True
         return False
+
+    # -- disaggregated prefill/decode (two-stage placement + the
+    # -- pipelined KV handoff) ------------------------------------------
+    def _role_pool(self, want: str) -> List[int]:
+        """Pooled, non-draining slots eligible for ``want`` duty
+        ("mixed" slots serve both pools)."""
+        return [s for s in sorted(self._pool)
+                if s not in self._draining
+                and self._roles[s] in (want, "mixed")]
+
+    def _rank_prefill(self) -> List[int]:
+        """Stage 1: the prefill pool ordered by wire-reported prefill
+        backlog (prompt tokens not yet prefilled) — suspects last,
+        router-side outstanding then slot id break ties."""
+        scored = []
+        for s in self._role_pool("prefill"):
+            snap = self._scoring_snapshot(s)
+            if not snap.get("alive"):
+                continue
+            scored.append((1 if snap.get("suspect") else 0,
+                           int(snap.get("prefill_backlog", 0)),
+                           int(snap.get("outstanding", 0)), s))
+        scored.sort()
+        return [s for *_, s in scored]
+
+    def _rank_decode(self, entry: "_FleetEntry") -> List[int]:
+        """Stage 2: the decode pool under the ordinary scoring policy
+        (KV headroom pushes, prefix affinity pulls) — the
+        admission-time decode-target choice."""
+        aff_slot, _aff_n, aff_w = self._affinity(entry.digests)
+        n_blocks = max(1, len(entry.digests))
+        scorer = getattr(self.policy, "score", None)
+        scored = []
+        for s in self._role_pool("decode"):
+            snap = self._scoring_snapshot(s)
+            if not snap.get("alive"):
+                continue
+            af = (aff_w / n_blocks) if s == aff_slot else 0.0
+            sc = scorer(snap, af) if scorer is not None else 0.0
+            scored.append((1 if snap.get("suspect") else 0, -sc, s))
+        scored.sort()
+        return [s for _, _, s in scored]
+
+    def _place_disagg(self, e: "_FleetEntry") -> Optional[bool]:
+        """Two-stage disagg placement: the prompt lands on the prefill
+        pool (least backlog first) with its decode target chosen NOW
+        from the decode pool. Returns True when placed with a live
+        handoff plan, None to degrade to the ordinary mixed placement
+        (a pool is empty, the pools collapse onto one slot, or every
+        prefill candidate refused) — nothing is ever unwound."""
+        uid = e.req.uid
+        prefills = self._rank_prefill()
+        decodes = self._rank_decode(e)
+        if not prefills or not decodes:
+            return None
+        kwargs = self._attempt_kwargs(e)
+        with span("fleet.route", uid=uid, affinity=0):
+            for slot in prefills:
+                target = next((d for d in decodes if d != slot), None)
+                if target is None:
+                    return None
+                rep = self._replicas[slot]
+                try:
+                    rep.submit(e.req.prompt, uid=uid, handoff=True,
+                               **kwargs)
+                except (ServingOverloadError, WorkerFailureError):
+                    continue
+                e.slot = slot
+                e.seen = 0
+                e.handoff = True
+                e.decode_slot = target
+                e.pushed = 0
+                e.hb = 0
+                e.parked = False
+                self._placed.setdefault(slot, set()).add(uid)
+                if self._journal is not None:
+                    self._journal.note_place(uid, slot)
+                return True
+        return None
+
+    def _handoff_target_ok(self, e: "_FleetEntry") -> bool:
+        t = e.decode_slot
+        if t is None or t not in self._pool or t in self._draining:
+            return False
+        rep = self._replicas[t]
+        return rep.alive and not rep.prober.suspect
+
+    def _handoff_pass(self, step: int) -> None:
+        """The pipelined-handoff driver, once per fleet step. Phase A:
+        every live handoff entry's newly committed full blocks move to
+        its decode target behind the remaining chunks' compute
+        (accounted ``handoff_overlapped_ms``). Phase B, once the
+        prefill side reports the uid PARKED: flush the remainder, then
+        the residue RPCs (export -> land -> release) on the critical
+        path of the first decode step (``handoff_exposed_ms``). Every
+        failure funnels through ``_handoff_fallback`` — one typed
+        choke point: the prefill replica resumes the decode itself,
+        bitwise identical (fold_in(uid, pos) sampling keys)."""
+        bx = self._blockxfer
+        dcfg = self._disagg_cfg
+        for uid in sorted(self._entries):
+            e = self._entries[uid]
+            if not e.handoff or e.req.done or e.slot is None \
+                    or e.slot not in self._pool:
+                continue
+            t_ok = self._handoff_target_ok(e)
+            if e.parked:
+                t0 = self._clock()
+                ok, why = (self._handoff_finish(e)
+                           if t_ok and bx is not None
+                           else (False, "target_unavailable"))
+                self._hstats["handoff_exposed_ms"] += \
+                    (self._clock() - t0) * 1e3
+                if ok:
+                    self._hstats["landed"] += 1
+                    self._placed.get(e.slot, set()).discard(uid)
+                    self._placed.setdefault(e.decode_slot,
+                                            set()).add(uid)
+                    e.slot = e.decode_slot
+                    e.handoff = False
+                    # e.seen is NOT reset: the decode side's buffer
+                    # starts with the first token at position 0, so
+                    # the delivered-token cursor lines up exactly and
+                    # the dedup suppresses the replayed first token
+                    if self._journal is not None:
+                        self._journal.note_place(uid, e.slot)
+                else:
+                    self._handoff_fallback(e, why)
+            elif t_ok and bx is not None and e.hb > e.pushed \
+                    and e.pushed < len(e.digests):
+                # phase A: push what prefill committed since last step
+                limit = max(1, int(dcfg.max_push_blocks_per_step))
+                hi = min(e.hb, len(e.digests), e.pushed + limit)
+                t0 = self._clock()
+                self._push_segment(e, e.digests[e.pushed:hi])
+                self._hstats["handoff_overlapped_ms"] += \
+                    (self._clock() - t0) * 1e3
+
+    def _push_segment(self, e: "_FleetEntry", seg) -> None:
+        landed, nb = self._blockxfer.handoff_segment(
+            self._replicas[e.slot], self._replicas[e.decode_slot],
+            seg,
+            parent_hex="" if e.pushed == 0
+            else e.digests[e.pushed - 1].hex(),
+            chunk=int(self._disagg_cfg.push_chunk_blocks))
+        self._hstats["pushes"] += 1
+        self._hstats["pushed_blocks"] += landed
+        self._hstats["push_bytes"] += nb
+        if not landed:
+            self._hstats["push_stalls"] += 1
+        e.pushed += landed
+
+    def _handoff_finish(self, e: "_FleetEntry") -> Tuple[bool, str]:
+        """Phase B: flush unpushed full blocks, export the residue off
+        the prefill side, land it on the decode target, release the
+        prefill copy. Consumer-side ``handoff.land`` fault site:
+        ``corrupt`` poisons the tail payload so the RECEIVER's
+        checksum refuses it (exactly like wire corruption would); any
+        other kind aborts before the land RPC. Returns ``(ok,
+        fallback reason)``. A land whose success reply is LOST still
+        lands (exactly-once reply cache) — the fallback then resumes
+        the prefill side too, and the decode-side orphan decodes
+        unobserved (its uid never enters that slot's cursors): wasted
+        compute, never a wrong or duplicated token."""
+        from .worker import sampling_to_wire
+        uid = e.req.uid
+        prefill = self._replicas[e.slot]
+        decode = self._replicas[e.decode_slot]
+        n_full = len(e.digests)
+        if e.pushed < n_full:
+            self._push_segment(e, e.digests[e.pushed:n_full])
+            if e.pushed < n_full:
+                return False, "push_incomplete"
+        try:
+            res = prefill.seq_handoff({"op": "export", "uid": uid})
+        except (WorkerFailureError, ValueError):
+            # a transport failure OR the worker's typed refusal
+            # ("not parked": the uid finished/was cancelled there)
+            return False, "export_failed"
+        tail = dict(res.get("tail") or {})
+        spec = fault_injector.consume(
+            "handoff.land", detail=f"replica{decode.slot}")
+        if spec is not None:
+            if spec.kind == "corrupt" and tail.get("payload"):
+                raw = bytes.fromhex(tail["payload"])
+                tail["payload"] = \
+                    (bytes([raw[0] ^ 0xFF]) + raw[1:]).hex()
+            else:
+                return False, f"injected_{spec.kind}"
+        kw = e.kwargs
+        payload = {
+            "op": "land", "uid": uid,
+            "prompt": [int(t) for t in e.req.prompt],
+            "first_token": int(res["first_token"]),
+            "remaining": int(res["remaining"]),
+            "max_new_tokens": int(kw["max_new_tokens"]),
+            "eos_token_id": kw.get("eos_token_id"),
+            "sampling": sampling_to_wire(kw.get("sampling")),
+            "tail": tail,
+        }
+        try:
+            with span("handoff.land", uid=uid, slot=decode.slot):
+                decode.seq_handoff(payload)
+        except (WorkerFailureError, ValueError):
+            # transport failure, checksum reject, or the decode
+            # frontend's typed refusal (chain not resident / full)
+            return False, "land_failed"
+        try:
+            prefill.seq_handoff({"op": "release", "uid": uid})
+        except (WorkerFailureError, ValueError):
+            # the decode side owns the stream either way; the parked
+            # prefill copy dies with its replica or gets pruned
+            self._hstats["releases_failed"] += 1
+        return True, ""
+
+    def _handoff_fallback(self, e: "_FleetEntry", why: str) -> None:
+        """The typed degrade: the prefill replica un-parks the uid and
+        decodes it itself — bitwise identical to the disagg-off stream.
+        A resume that cannot reach the prefill replica is left alone:
+        the supervisor's death ladder requeues the uid and the replay
+        contract covers it from there."""
+        uid = e.req.uid
+        self._hstats["fallbacks"] += 1
+        reasons = self._hstats["fallback_reasons"]
+        reasons[why] = reasons.get(why, 0) + 1
+        logger.warning(f"fleet handoff for uid {uid} degraded to "
+                       f"prefill-side decode ({why})")
+        e.handoff = False
+        e.decode_slot = None
+        try:
+            self._replicas[e.slot].seq_handoff(
+                {"op": "resume", "uid": uid})
+            self._hstats["resumes"] += 1
+        except (WorkerFailureError, ValueError):
+            pass
 
     def _overload_error(self, shed_uids) -> ServingOverloadError:
         snaps = {}
@@ -810,6 +1105,11 @@ class FleetRouter:
                                progressed=bool(reply.get("progressed")))
             if "states" in reply:
                 self._ingest_step_reply(slot, reply, step)
+        if self._disagg:
+            # after every reply landed (freshest push cursors / park
+            # flags), before the probe pass: the replicas compute the
+            # NEXT step while these RPCs fly — that is the overlap
+            self._handoff_pass(step)
         self._probe_pass(step)
         self._supervisor.check(step)
         if self._backlog:
@@ -860,6 +1160,13 @@ class FleetRouter:
             if req.done:
                 placed.discard(uid)
                 continue
+            if st is not None and e.handoff and e.slot == slot:
+                hp = st.get("handoff")
+                if hp:
+                    # the pipelined-push cursor rides the state sync:
+                    # full blocks committed so far + the park flag
+                    e.hb = max(e.hb, int(hp.get("hb", 0)))
+                    e.parked = bool(hp.get("parked"))
             if st is None:
                 # the replica RETIRED it (past max_retained_requests)
                 # before this sync: it reached a terminal state there.
@@ -940,8 +1247,11 @@ class FleetRouter:
         self._trie_seqs[slot] = seq
         tiers = delta.get("tiers") or {}
         for hx in delta.get("add", ()):
-            self._affinity_map.put(bytes.fromhex(hx),
-                                   (slot, tiers.get(hx, "hbm")))
+            d = bytes.fromhex(hx)
+            self._affinity_map.put(d, (slot, tiers.get(hx, "hbm")))
+            # the destination PROVED the prefetched head landed: clear
+            # its in-flight dedup entry before the step TTL runs out
+            self._prefetch_inflight.pop((slot, d), None)
         for hx in delta.get("del", ()):
             d = bytes.fromhex(hx)
             cur = self._affinity_map.pop(d)
@@ -1037,6 +1347,15 @@ class FleetRouter:
                 e.slot = None
                 e.seen = 0
                 e.requeues += 1
+                # a death mid-handoff voids the plan: the fresh
+                # attempt re-decides placement from scratch (pushed
+                # blocks already landed on the old target are harmless
+                # DRAM-tier orphans — LRU reclaims them)
+                e.handoff = False
+                e.decode_slot = None
+                e.pushed = 0
+                e.hb = 0
+                e.parked = False
                 if e.requeues > \
                         self.config.fleet.max_requeues_per_request:
                     self._abandon(
@@ -1082,7 +1401,7 @@ class FleetRouter:
         self._trie_seqs[slot] = int(rep.hello.get("trie_seq", 0))
         self._pool.add(slot)
         self._monitor.restore(slot, step)
-        if self._blockxfer is not None and \
+        if self._blockxfer is not None and self._transfer_on and \
                 bool(self._transfer_cfg.push_on_respawn):
             # warm-start: the fresh worker came up with an empty trie
             # — seed its DRAM tier with the hottest chains from the
@@ -1115,8 +1434,8 @@ class FleetRouter:
 
     def _transfer_ok(self, owner_slot: Optional[int],
                      dest_slot: int) -> bool:
-        if self._blockxfer is None or owner_slot is None \
-                or owner_slot == dest_slot:
+        if not self._transfer_on or self._blockxfer is None \
+                or owner_slot is None or owner_slot == dest_slot:
             return False
         if owner_slot not in self._pool:
             return False
@@ -1135,6 +1454,25 @@ class FleetRouter:
         chain = self._owner_chain(entry.digests, aff_slot)
         if not chain:
             return 0
+        # in-flight dedup: a placement wave can land several requests
+        # sharing one prefix head on the same cold replica within a
+        # few steps — only the first BLOCK_FETCH moves bytes; a
+        # re-issue for a chain already in flight is pure wire waste.
+        # Entries expire after ``prefetch_dedup_steps`` router steps,
+        # or early when the destination's TRIE_DELTA confirms the
+        # head digest landed (``_apply_trie_delta``).
+        key = (dest_slot, chain[0])
+        exp = self._prefetch_inflight.get(key)
+        if exp is not None and exp > self._step_idx:
+            self.prefetch_dedup_skips += 1
+            return 0
+        ttl = max(1, int(getattr(self._transfer_cfg,
+                                 "prefetch_dedup_steps", 16)))
+        self._prefetch_inflight[key] = self._step_idx + ttl
+        if len(self._prefetch_inflight) > 256:
+            self._prefetch_inflight = {
+                k: v for k, v in self._prefetch_inflight.items()
+                if v > self._step_idx}
         return self._blockxfer.transfer_chain(
             self._replicas[aff_slot], self._replicas[dest_slot], chain)
 
@@ -1237,7 +1575,7 @@ class FleetRouter:
                     steps += 1
         finally:
             self._draining.discard(slot)
-        if self._blockxfer is not None and \
+        if self._blockxfer is not None and self._transfer_on and \
                 bool(self._transfer_cfg.push_on_drain):
             # the leaving replica's blocks are about to vanish with
             # its channel: push its hottest chains to the least-loaded
@@ -1414,6 +1752,7 @@ class FleetRouter:
             "deaths": self._supervisor.deaths,
             "respawns": self._supervisor.respawns,
             "affinity_routed": self.affinity_routed,
+            "prefetch_dedup_skips": self.prefetch_dedup_skips,
             "replay_mismatches": self.replay_mismatches,
             "backlog": len(self._backlog),
             "pooled": len(self._pool),
@@ -1491,9 +1830,19 @@ class FleetRouter:
         every key present, zeroed — so dashboards, watchers and the
         bench decomposition never lose the metric by toggling the
         feature."""
-        if self._blockxfer is not None:
+        if self._blockxfer is not None and self._transfer_on:
             return {"enabled": 1, **self._blockxfer.stats()}
         return {"enabled": 0, **PeerBlockSource.zero_stats()}
+
+    def _handoff_stats(self) -> dict:
+        """The fleet report's ``handoff`` block (the disagg pipeline):
+        pipelined-push counters, the typed fallback ledger, and the
+        exposed/overlapped decomposition. Schema-stable whether disagg
+        is on or off — every key present, zeroed."""
+        out = dict(self._hstats)
+        out["fallback_reasons"] = dict(out["fallback_reasons"])
+        return {"enabled": 1 if self._disagg else 0,
+                "roles": list(self._roles), **out}
 
     def get_fleet_report(self) -> dict:
         """Per-replica snapshots + router totals + aggregated prefix
@@ -1507,5 +1856,6 @@ class FleetRouter:
             "transport": self._transport_stats(),
             "bootstrap": self._bootstrap_stats(),
             "blockxfer": self._blockxfer_stats(),
+            "handoff": self._handoff_stats(),
             "recovery": self._supervisor.report(),
         }

@@ -96,6 +96,18 @@ MSG_ERR = "ERR"
 MSG_BLOCK_FETCH = "BLOCK_FETCH"
 MSG_BLOCK_PUSH = "BLOCK_PUSH"
 
+# disaggregated prefill/decode handoff (router-mediated, star
+# topology — workers never dial each other). One kind, four ops:
+# "export" reads the residue off the prefill replica (partial tail
+# block + seq state + first sampled token — read-only), "land"
+# ingests it on the decode replica (effectful: adopts the pushed
+# full-block chain, installs the tail via the existing jitted
+# scatter, seeds the token buffer — exactly-once like SUBMIT),
+# "resume" un-parks the sequence for prefill-side decode (the typed
+# fallback), "release" frees the prefill side's copy after a landed
+# handoff.
+MSG_SEQ_HANDOFF = "SEQ_HANDOFF"
+
 # bootstrap handshake (pre-HELLO, same frame format, rpc id 0): a
 # dial-in worker opens with JOIN; the router fences on epochs, then —
 # when auth is required — answers JOIN_CHALLENGE with a fresh nonce;

@@ -48,20 +48,26 @@ from .....resilience.errors import (BootstrapAuthError, FencingError,
                                     UnknownRequestError)
 from .....resilience.retry import backoff_delay
 from .....runtime.lifecycle import BoundedCache
-from .....runtime.store import blake2b_hex, encode_kv
+from .....runtime.store import blake2b_hex, decode_kv, encode_kv
 from .....utils.logging import logger
 from ..frontend import ServingFrontend
+from ..prefix import chain_digests
 from .transport import (MSG_BLOCK_FETCH, MSG_BLOCK_PUSH, MSG_CANCEL,
                         MSG_ERR, MSG_HEARTBEAT, MSG_HELLO,
-                        MSG_SHUTDOWN, MSG_SNAPSHOT, MSG_STEP,
-                        MSG_SUBMIT, MSG_TOKENS, PROTOCOL_VERSION,
-                        TransportDecodeError, client_ssl_context,
-                        decode_frame, encode_frame, worker_join)
+                        MSG_SEQ_HANDOFF, MSG_SHUTDOWN, MSG_SNAPSHOT,
+                        MSG_STEP, MSG_SUBMIT, MSG_TOKENS,
+                        PROTOCOL_VERSION, TransportDecodeError,
+                        client_ssl_context, decode_frame, encode_frame,
+                        worker_join)
 
 # BLOCK_PUSH lands blocks in the DRAM tier — effectful, so a retried
 # push rides the reply cache instead of double-landing. BLOCK_FETCH is
 # a pure read (re-serving the same bytes is harmless) and stays out.
-_EFFECTFUL = (MSG_SUBMIT, MSG_CANCEL, MSG_STEP, MSG_BLOCK_PUSH)
+# SEQ_HANDOFF's land/resume/release ops all mutate frontend state, so
+# the whole kind rides the cache (its export op is a read, but caching
+# a read's reply is merely harmless).
+_EFFECTFUL = (MSG_SUBMIT, MSG_CANCEL, MSG_STEP, MSG_BLOCK_PUSH,
+              MSG_SEQ_HANDOFF)
 
 
 def _sampling_from_wire(d: Optional[dict]):
@@ -93,6 +99,15 @@ class WorkerCore:
         self.frontend = frontend
         self.shutdown = False
         self.steps = 0
+        # disaggregation role, assigned by the router's HELLO payload
+        # (the socket worker never sees the fleet config block)
+        self.role = "mixed"
+        # handoff export maps: a handoff-marked uid's MID-PREFILL full
+        # blocks are servable over BLOCK_FETCH by digest before
+        # register_prefix makes them trie-resident — digest ->
+        # (uid, block index) plus the per-uid chain for cleanup
+        self._handoff_digests = {}
+        self._handoff_chains = {}
         # rpc_id -> recorded reply: the exactly-once seam. 64 entries
         # cover far more channel lag than a held/duplicated frame can
         # accumulate before the retry budget gives up on it.
@@ -145,7 +160,7 @@ class WorkerCore:
 
     def _dispatch(self, kind: str, msg: dict) -> dict:
         if kind == MSG_HELLO:
-            return self._hello()
+            return self._hello(msg)
         if kind == MSG_SUBMIT:
             return self._submit(msg)
         if kind == MSG_CANCEL:
@@ -168,15 +183,21 @@ class WorkerCore:
             return self._block_fetch(msg)
         if kind == MSG_BLOCK_PUSH:
             return self._block_push(msg)
+        if kind == MSG_SEQ_HANDOFF:
+            return self._seq_handoff(msg)
         if kind == MSG_SHUTDOWN:
             self.shutdown = True
             return {"kind": "BYE"}
         raise ValueError(f"unknown message kind {kind!r}")
 
     # -- handlers -------------------------------------------------------
-    def _hello(self) -> dict:
+    def _hello(self, msg: Optional[dict] = None) -> dict:
+        role = (msg or {}).get("role")
+        if role:
+            self.role = str(role)
         out = self._full_snapshot("HELLO_OK")
         out["slot"] = self.slot
+        out["role"] = self.role
         out["kv_block_size"] = \
             self.frontend.engine._config.kv_block_size
         return out
@@ -184,16 +205,33 @@ class WorkerCore:
     def _submit(self, msg: dict) -> dict:
         uid = int(msg["uid"])
         buf = self._tokens[uid] = []     # fresh attempt, fresh tail
+        prompt = np.asarray(msg["prompt"], np.int32)
         self.frontend.submit(
-            np.asarray(msg["prompt"], np.int32),
+            prompt,
             uid=uid,
             max_new_tokens=msg.get("max_new_tokens"),
             eos_token_id=msg.get("eos_token_id"),
             sampling=_sampling_from_wire(msg.get("sampling")),
             priority=int(msg.get("priority", 0)),
             deadline_ms=msg.get("deadline_ms"),
-            on_token=buf.append)
+            on_token=buf.append,
+            handoff=bool(msg.get("handoff")))
+        if msg.get("handoff"):
+            # arm the mid-prefill export map: the router's pipelined
+            # push fetches these digests while the trie doesn't hold
+            # them yet (register_prefix runs at prompt completion)
+            bs = self.frontend.engine._config.kv_block_size
+            chain = chain_digests(prompt, bs)
+            self._drop_handoff(uid)
+            self._handoff_chains[uid] = chain
+            for i, d in enumerate(chain):
+                self._handoff_digests[d] = (uid, i)
         return {"kind": "SUBMIT_OK"}
+
+    def _drop_handoff(self, uid: int) -> None:
+        for d in self._handoff_chains.pop(uid, ()):
+            if self._handoff_digests.get(d, (None,))[0] == uid:
+                self._handoff_digests.pop(d, None)
 
     # -- fleet block transfer (blockxfer.py consumer) -------------------
     def _block_fetch(self, msg: dict) -> dict:
@@ -204,8 +242,7 @@ class WorkerCore:
         pc = self.frontend.engine.prefix_cache
         blocks, missing = [], []
         for hx in msg.get("digests") or []:
-            out = self._export_block(pc, bytes.fromhex(hx)) \
-                if pc is not None else None
+            out = self._export_block(pc, bytes.fromhex(hx))
             if out is None:
                 missing.append(hx)
                 break
@@ -220,18 +257,37 @@ class WorkerCore:
         """-> (payload, meta, tier) or None. A tiered cache exports
         through its own tier-aware path; a flat trie serves straight
         from the HBM pool (d2h gather + exact encode) so a non-tiered
-        owner can still feed peers."""
-        export = getattr(pc, "export_block", None)
-        if export is not None:
-            out = export(d)
-            if out is None:
-                return None
-            payload, meta, _parent, tier = out
-            return payload, meta, tier
-        e = pc._entries.get(d)
-        if e is None:
+        owner can still feed peers. A digest neither holds falls back
+        to the handoff export map: a handoff-marked uid's mid-prefill
+        blocks are servable by digest once their tokens committed (the
+        jitted gather orders after the in-flight dispatch)."""
+        if pc is not None:
+            export = getattr(pc, "export_block", None)
+            if export is not None:
+                out = export(d)
+                if out is not None:
+                    payload, meta, _parent, tier = out
+                    return payload, meta, tier
+            else:
+                e = pc._entries.get(d)
+                if e is not None:
+                    arr = self.frontend.engine.read_kv_block(e.block)
+                    payload, meta = encode_kv(arr, "none")
+                    return payload, meta, "hbm"
+        return self._export_handoff_block(d)
+
+    def _export_handoff_block(self, d: bytes):
+        hit = self._handoff_digests.get(d)
+        if hit is None:
             return None
-        arr = self.frontend.engine.read_kv_block(e.block)
+        uid, idx = hit
+        eng = self.frontend.engine
+        seq = eng._state_manager.get_sequence(uid)
+        bs = eng._config.kv_block_size
+        if seq is None or idx >= len(seq.blocks) \
+                or (idx + 1) * bs > seq.seen_tokens:
+            return None                  # not committed yet
+        arr = eng.read_kv_block(seq.blocks[idx])
         payload, meta = encode_kv(arr, "none")
         return payload, meta, "hbm"
 
@@ -261,6 +317,64 @@ class WorkerCore:
                 rejected += 1
         return {"kind": "BLOCK_PUSH_OK", "landed": landed,
                 "rejected": rejected}
+
+    # -- disaggregated handoff (SEQ_HANDOFF ops) ------------------------
+    def _seq_handoff(self, msg: dict) -> dict:
+        """Four ops on one exactly-once kind: ``export`` reads the
+        parked residue off the prefill side, ``land`` ingests it on
+        the decode side (checksum re-checked HERE — the receiver
+        trusts nothing that rode the wire), ``resume`` degrades to
+        prefill-side decode, ``release`` frees the prefill side's copy
+        after a landed handoff. Every refusal is a typed ERR the
+        router converts into the bitwise fallback."""
+        op = msg.get("op")
+        fe = self.frontend
+        uid = int(msg["uid"])
+        if op == "export":
+            out = fe.export_handoff(uid)
+            if out is None:
+                raise ValueError(
+                    f"uid {uid} is not parked for handoff export")
+            payload, meta = encode_kv(out.pop("tail"), "none")
+            out["tail"] = {"payload": payload.hex(),
+                           "b2": blake2b_hex(payload), "meta": meta}
+            out["kind"] = "SEQ_HANDOFF_OK"
+            out["op"] = op
+            return out
+        if op == "land":
+            tail = msg.get("tail") or {}
+            try:
+                payload = bytes.fromhex(tail["payload"])
+            except (KeyError, ValueError, TypeError):
+                raise ValueError("handoff tail frame unreadable") \
+                    from None
+            if blake2b_hex(payload) != tail.get("b2"):
+                raise ValueError("handoff tail checksum mismatch")
+            arr = decode_kv(payload, tail.get("meta") or {})
+            buf = self._tokens[uid] = [int(msg["first_token"])]
+            try:
+                fe.ingest_handoff(
+                    uid=uid, prompt=msg["prompt"],
+                    first_token=int(msg["first_token"]),
+                    remaining=int(msg["remaining"]),
+                    max_new_tokens=int(msg["max_new_tokens"]),
+                    eos_token_id=msg.get("eos_token_id"),
+                    sampling=_sampling_from_wire(msg.get("sampling")),
+                    tail_block=arr, on_token=buf.append)
+            except Exception:
+                self._tokens.pop(uid, None)
+                raise
+            return {"kind": "SEQ_HANDOFF_OK", "op": op,
+                    "landed": True}
+        if op == "resume":
+            return {"kind": "SEQ_HANDOFF_OK", "op": op,
+                    "resumed": bool(fe.resume_handoff(uid))}
+        if op == "release":
+            ok = fe.release_handoff(uid)
+            self._drop_handoff(uid)
+            return {"kind": "SEQ_HANDOFF_OK", "op": op,
+                    "released": bool(ok)}
+        raise ValueError(f"unknown SEQ_HANDOFF op {op!r}")
 
     def _step(self, msg: dict) -> dict:
         cursors = msg.get("cursors") or {}
@@ -300,6 +414,11 @@ class WorkerCore:
             else:
                 states[uid_s] = {"state": rr.state.name,
                                  "shed_reason": rr.shed_reason}
+                hp = fe.handoff_progress(uid)
+                if hp is not None:
+                    # the router's pipelined-push cursor: full blocks
+                    # committed so far + whether the uid has parked
+                    states[uid_s]["handoff"] = hp
         return {"tokens": tokens, "states": states}
 
     def _prune_buffers(self, cursors: dict) -> None:
@@ -315,6 +434,13 @@ class WorkerCore:
             rr = self.frontend.get_request(uid)
             if rr is None or rr.done:
                 del self._tokens[uid]
+                self._drop_handoff(uid)
+        for uid in list(self._handoff_chains):
+            if uid in live or uid in self._tokens:
+                continue
+            rr = self.frontend.get_request(uid)
+            if rr is None or rr.done:
+                self._drop_handoff(uid)
 
     def _drain_delta(self) -> Optional[dict]:
         """Fold the journal into one net TRIE_DELTA (an add+del of the
@@ -406,6 +532,12 @@ class WorkerCore:
             "tokens_emitted": q["tokens_emitted"],
             "recompiles": q["recompiles"],
             "blocking_syncs": q["blocking_syncs"],
+            # disaggregation: the router scores the prefill pool from
+            # wire-reported state, never by peeking into loopback
+            # frontends
+            "role": self.role,
+            "prefill_backlog": int(getattr(fe, "prefill_backlog", 0)),
+            "parked": len(getattr(fe, "parked_uids", ())),
         }
         pc = eng.prefix_cache
         if pc is not None:

@@ -185,6 +185,13 @@ class ServingFrontend:
         self._full_prompts: Dict[int, np.ndarray] = {}
         self._decode: Dict[int, object] = {}   # uid -> int | TokenRef
         self._remaining: Dict[int, int] = {}
+        # disaggregated handoff (fleet seam): uids marked at submit
+        # sit out the lookahead placeholder and PARK at first-token
+        # delivery — moved out of ``_decode`` with KV retained — until
+        # the router lands them on a decode replica (release) or
+        # degrades to local decode (resume)
+        self._handoff: set = set()
+        self._parked: Dict[int, int] = {}      # uid -> first token
         self._inflight: Optional[StepRecord] = None
         self._retired: deque = deque()
         self._next_uid = 1
@@ -292,7 +299,7 @@ class ServingFrontend:
                sampling: Optional[SamplingParams] = None,
                priority: int = 0,
                deadline_ms: Optional[float] = None,
-               on_token=None) -> Request:
+               on_token=None, handoff: bool = False) -> Request:
         """Queue one request; returns its live ``Request`` handle.
         Joining the batch happens at the next ``step()`` (the
         admission gate's call). ``serving.max_queue_depth`` bounds
@@ -357,6 +364,8 @@ class ServingFrontend:
             self._base_key = None          # rebuilt at next dispatch
         self._requests[uid] = req
         self._queue.append(uid)
+        if handoff:
+            self._handoff.add(uid)
         pc = self.engine.prefix_cache
         if pc is not None and getattr(pc, "async_io", False):
             # scheduler hint: ring-prefetch this prompt's spilled
@@ -443,6 +452,8 @@ class ServingFrontend:
         self._full_prompts.pop(uid, None)
         self._decode.pop(uid, None)
         self._remaining.pop(uid, None)
+        self._parked.pop(uid, None)
+        self._handoff.discard(uid)
         if self._inflight is not None and uid in self._inflight.slot:
             self._inflight.cancelled.add(self._inflight.slot[uid])
         if self._spec is not None:
@@ -584,9 +595,14 @@ class ServingFrontend:
                 if isinstance(v, TokenRef):
                     assert v.step is self._inflight, \
                         "stale device-token ref"
-                    if self._remaining[uid] > 1 and not (
+                    if self._remaining[uid] > 1 and \
+                            uid not in self._handoff and not (
                             spec is not None and spec.wants_spec(
                                 uid, self._remaining[uid])):
+                        # a handoff-marked uid never gets the lookahead
+                        # placeholder: its first token must park with
+                        # NO speculative row dispatched (the decode
+                        # replica takes the stream from there)
                         sched_decode[uid] = 0      # placeholder id
                     # a spec-bound uid sits this step out: its token
                     # goes host-known at collect, then it drafts
@@ -798,8 +814,179 @@ class ServingFrontend:
                 cur = self._decode.get(uid)
                 if isinstance(cur, (TokenRef, SpecRef)) and \
                         cur.step is collected:
-                    self._decode[uid] = tok   # host-known from here on
+                    if uid in self._handoff:
+                        # PARK: first token host-known, no follow-up
+                        # row in flight (the schedule loop skipped the
+                        # placeholder), KV retained — the router now
+                        # hands the stream to the decode replica, or
+                        # resumes local decode on handoff failure
+                        self._parked[uid] = tok
+                        del self._decode[uid]
+                    else:
+                        self._decode[uid] = tok  # host-known from here
         return n_new
+
+    # -- disaggregated handoff seam (fleet router/worker surface) -------
+    # A handoff-marked request prefillls here, emits its FIRST token,
+    # then parks (``_deliver``) instead of decoding: the router pushes
+    # the full-block KV behind the remaining chunks' compute, lands the
+    # residue on the decode replica (``ingest_handoff``) and releases
+    # this side's copy — or, on any failure, resumes local decode
+    # (``resume_handoff``), bitwise identical either way because every
+    # sampled draw keys off fold_in(base, uid, position).
+
+    @property
+    def prefill_backlog(self) -> int:
+        """Prompt tokens not yet prefilled — queued prompts whole plus
+        joined prompts' unconsumed tails. The router's prefill-pool
+        placement signal (rides worker SNAPSHOTs)."""
+        q = sum(len(self._requests[u].prompt) for u in self._queue
+                if u in self._requests)
+        return int(q + sum(len(t) for t in self._pending.values()))
+
+    @property
+    def parked_uids(self):
+        return tuple(self._parked)
+
+    def handoff_progress(self, uid: int) -> Optional[dict]:
+        """Pipelined-push cursor for a live handoff-marked uid:
+        ``hb`` full blocks whose KV is committed (safe to export —
+        the jitted gather orders after the in-flight dispatch) and
+        whether the uid has parked. None once the uid left."""
+        if uid not in self._handoff and uid not in self._parked:
+            return None
+        seq = self.engine._state_manager.get_sequence(uid)
+        prompt = self._full_prompts.get(uid)
+        if seq is None or prompt is None:
+            return None
+        bs = self.engine._config.kv_block_size
+        n_full = (len(prompt) - 1) // bs
+        return {"hb": int(min(seq.seen_tokens // bs, n_full)),
+                "parked": uid in self._parked}
+
+    def export_handoff(self, uid: int) -> Optional[dict]:
+        """Residue read for a PARKED uid (read-only): the partial
+        tail KV block (full [*, block_size, *] shape; rows past
+        ``tail_valid`` are masked garbage), the token budget left,
+        and the first sampled token. None unless parked."""
+        tok = self._parked.get(uid)
+        prompt = self._full_prompts.get(uid)
+        seq = self.engine._state_manager.get_sequence(uid)
+        if tok is None or prompt is None or seq is None:
+            return None
+        bs = self.engine._config.kv_block_size
+        n = len(prompt)
+        n_full = (n - 1) // bs
+        if len(seq.blocks) <= n_full:
+            return None
+        return {"first_token": int(tok),
+                "remaining": int(self._remaining[uid]),
+                "n_tokens": int(n),
+                "tail_valid": int(n - n_full * bs),
+                "tail": self.engine.read_kv_block(seq.blocks[n_full])}
+
+    def resume_handoff(self, uid: int) -> bool:
+        """Un-park ``uid`` for LOCAL decode — the typed degrade path
+        for any handoff failure. The parked first token becomes a
+        plain host-known decode row; fold_in(uid, pos) keys keep the
+        stream bitwise identical to the disagg-off run."""
+        tok = self._parked.pop(uid, None)
+        if tok is None:
+            return False
+        self._handoff.discard(uid)
+        self._decode[uid] = int(tok)
+        return True
+
+    def release_handoff(self, uid: int) -> bool:
+        """Finalize a LANDED handoff on the prefill side: the decode
+        replica owns the stream now — free this side's KV and close
+        the local request handle out."""
+        if uid not in self._parked:
+            return False
+        req = self._requests.get(uid)
+        with span("frontend.leave", uid=uid, why="handoff"):
+            self._leave(uid)
+            if req is not None and not req.done:
+                req.advance(RequestState.CANCELLED)
+                req.finished_t = self._clock()
+        self._retire(uid)
+        return True
+
+    def ingest_handoff(self, *, uid: int, prompt, first_token: int,
+                       remaining: int, max_new_tokens: int,
+                       eos_token_id: Optional[int] = None,
+                       sampling: Optional[SamplingParams] = None,
+                       tail_block=None, on_token=None) -> Request:
+        """Decode-side ingest: adopt the pushed full-block chain from
+        the local prefix cache (the unchanged adopt/promote path),
+        install the partial tail block through the existing jitted
+        scatter, seed the stream with the first sampled token, and
+        enter plain decode — zero new compile signatures. Raises a
+        ``ValueError`` (typed refusal: the router degrades to
+        prefill-side decode) when the chain isn't fully resident or
+        the engine can't take the sequence."""
+        engine = self.engine
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = len(prompt)
+        if n == 0 or remaining < 1:
+            raise ValueError("handoff needs a prompt and a token "
+                             "budget left")
+        if uid in self._requests and not self._requests[uid].done:
+            raise ValueError(f"uid {uid} is already live")
+        if sampling is not None and sampling.seed is not None:
+            if self._seed is not None and self._seed != sampling.seed:
+                raise ValueError(
+                    f"handoff seed {sampling.seed} conflicts with the "
+                    f"front-end's base seed {self._seed}")
+            if self._seed is None:
+                self._seed = sampling.seed
+                self._base_key = None
+        if tail_block is None:
+            raise ValueError("handoff without a tail block")
+        bs = engine._config.kv_block_size
+        n_full = (n - 1) // bs
+        tail_valid = n - n_full * bs
+        try:
+            tail = engine.adopt_prefix(uid, prompt)
+            if len(tail) != tail_valid:
+                engine.flush(uid)
+                raise ValueError(
+                    f"handoff prefix chain not fully resident: uid "
+                    f"{uid} adopted {n - len(tail)}/{n_full * bs} "
+                    f"pushed tokens")
+            seq = engine._state_manager.get_sequence(uid)
+            if seq is None:       # single-block prompt: nothing to
+                seq = engine._state_manager \
+                    .get_or_create_sequence(uid)   # adopt, just a tail
+            engine._state_manager.kv.maybe_allocate(seq, tail_valid)
+        except SchedulingError as e:
+            engine.flush(uid)
+            raise ValueError(f"handoff refused: {e}") from e
+        engine.write_kv_block(seq.blocks[n_full], tail_block)
+        seq.seen_tokens = n
+        req = Request(
+            uid=uid, prompt=prompt, max_new_tokens=max_new_tokens,
+            eos_token_id=(self.config.eos_token_id
+                          if eos_token_id is None else eos_token_id),
+            sampling=sampling, on_token=on_token,
+            submitted_t=self._clock())
+        req.tokens.append(int(first_token))
+        req.advance(RequestState.PREFILL)
+        req.first_token_t = self._clock()
+        req.advance(RequestState.DECODE)
+        self._requests[uid] = req
+        self._full_prompts[uid] = prompt
+        self._remaining[uid] = int(remaining)
+        self._decode[uid] = int(first_token)
+        if self._spec is not None:
+            self._spec.admit(
+                uid, prompt,
+                k_req=None if sampling is None
+                else sampling.speculation)
+        if sampling is not None and not self._use_sampled:
+            self._use_sampled = True
+        self.metrics.record_request("submitted")
+        return req
 
     # -- driver ---------------------------------------------------------
     def serve(self, poll=None, max_steps: Optional[int] = None) -> int:

@@ -155,6 +155,25 @@ FAULT_SITES = {
         "'replica<dest slot>', BEFORE the wire call — a fault drops "
         "the push (nothing lands; warm-start/prefetch is advisory, "
         "the destination just recomputes)",
+    # ---- disaggregated prefill/decode handoff (fleet/router.py +
+    # ---- fleet/blockxfer.py) — consumer-side like the blockxfer
+    # ---- sites, and for the same reason ----
+    "handoff.push":
+        "disagg handoff pipelined push: one consume() per pushed "
+        "segment (blockxfer.py handoff_segment; detail = "
+        "'replica<decode slot>'). kind=corrupt poisons one payload "
+        "AFTER its checksum is stamped — the RECEIVER refuses it and "
+        "the push cursor truncates there (the residue flush retries; "
+        "an incomplete flush degrades typed to prefill-side decode); "
+        "any other kind drops the segment before the fetch",
+    "handoff.land":
+        "disagg handoff residue land: one consume() per SEQ_HANDOFF "
+        "land attempt (router.py _handoff_finish; detail = "
+        "'replica<decode slot>'), between the prefill-side export and "
+        "the land RPC. kind=corrupt poisons the tail payload so the "
+        "decode worker's checksum rejects it (typed ERR -> the "
+        "bitwise prefill-side-decode fallback); any other kind aborts "
+        "the land the same way",
     # ---- parameter-residency wire (runtime/zero/param_stream.py) ----
     "param.fetch":
         "param stream: one fire per leaf fetched from the param store "

@@ -510,6 +510,39 @@ class FleetTransferConfig(DeepSpeedConfigModel):
     push_on_respawn: bool = True
     # most-recent request chains pushed per warm-start event
     warm_start_chains: int = 4
+    # off-home prefetch dedup: router steps an in-flight
+    # (target, head-digest) fetch entry suppresses duplicate
+    # BLOCK_FETCH re-issues for (entries also clear early when the
+    # target's TRIE_DELTA confirms the digest landed)
+    prefetch_dedup_steps: int = 16
+
+
+@dataclasses.dataclass
+class FleetDisaggConfig(DeepSpeedConfigModel):
+    """Disaggregated prefill/decode serving
+    (serving/fleet/router.py), config section
+    ``serving.fleet.disagg``: replicas get a role — ``prefill`` |
+    ``decode`` | ``mixed`` — and the router places in two stages:
+    prompts land on the prefill pool (scored by wire-reported
+    prefill backlog), a decode target is chosen at admission (KV
+    headroom + prefix affinity), finished KV blocks are pushed to
+    the decode target pipelined behind the remaining prefill
+    chunks, and a SEQ_HANDOFF RPC moves the residue (partial tail
+    block + seq state + first sampled token). Off by default —
+    disabled is today's mixed fleet bit for bit. Any handoff
+    failure degrades typed to the prefill replica decoding the
+    request itself, still bitwise (fold_in(uid, pos) sampling
+    keys)."""
+    enabled: bool = False
+    # per-slot roles, padded with "mixed" when shorter than
+    # n_replicas (e.g. ["prefill", "prefill", "decode", "decode"])
+    roles: list = dataclasses.field(default_factory=list)
+    # blocks per BLOCK_PUSH chunk on the pipelined handoff path
+    push_chunk_blocks: int = 4
+    # newly finished full blocks pushed per router step while the
+    # prefill chunks are still computing (bounds per-step wire work;
+    # the residue flush at park pushes whatever remains)
+    max_push_blocks_per_step: int = 8
 
 
 @dataclasses.dataclass
@@ -556,6 +589,8 @@ class ServingFleetConfig(DeepSpeedConfigModel):
     transport: FleetTransportConfig = submodel(FleetTransportConfig)
     # peer-to-peer KV block transfer (fetch-not-recompute + warm-start)
     transfer: FleetTransferConfig = submodel(FleetTransferConfig)
+    # disaggregated prefill/decode roles + pipelined KV handoff
+    disagg: FleetDisaggConfig = submodel(FleetDisaggConfig)
     # multi-host dial-in bootstrap + the durable-router journal
     bootstrap: FleetBootstrapConfig = submodel(FleetBootstrapConfig)
 

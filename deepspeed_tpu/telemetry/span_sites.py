@@ -147,6 +147,18 @@ SPAN_SITES = {
         "one BLOCK_PUSH chunk RPC landing verified blocks into a "
         "peer's DRAM tier (args: slot, n) — placement prefetch and "
         "evacuation/respawn warm-start both ride this",
+    # ---- disaggregated prefill/decode handoff ----
+    "handoff.push":
+        "one pipelined handoff segment (fetch off the prefill owner, "
+        "verify, BLOCK_PUSH chunks into the decode target's DRAM "
+        "tier; args: slot, n) — phase A rides behind the remaining "
+        "prefill chunks' compute (handoff_overlapped_ms), the phase-B "
+        "flush is exposed (handoff_exposed_ms)",
+    "handoff.land":
+        "one SEQ_HANDOFF residue land RPC onto the decode target "
+        "(args: uid, slot): partial tail block + seq state + first "
+        "sampled token — the exactly-once step that makes the decode "
+        "replica's first step a plain decode row",
     # ---- tiered prefix cache (inference/v2/serving/tiered.py) ----
     "cache.demote":
         "one cold block's down-tier demotion: device KV gather (d2h), "

@@ -575,6 +575,52 @@ def _assert_chaos_exact(router, handles, refs, n_req):
     return rep
 
 
+class TestPrefetchDedup:
+    """The in-flight prefetch dedup (disagg PR satellite): a
+    placement wave landing several same-head requests on one cold
+    replica must move the chain ONCE — keyed (dest slot, head
+    digest), TTL'd in router steps, cleared early by the
+    destination's TRIE_DELTA confirmation."""
+
+    def test_inflight_dedup_ttl_and_delta_clear(self, params_cfg):
+        prompts = {k: SYS[0] + [30 + k] for k in range(1, 4)}
+        router = _router(params_cfg, n=2, serving=_xfer_serving(),
+                         engine_kw={"max_queue_depth": 1})
+        router.submit(prompts[1], uid=1, max_new_tokens=4)
+        router.drain()
+        home = router._entries[1].slot
+        other = 1 - home
+        router.submit(prompts[2], uid=2, max_new_tokens=4)
+        router.submit(prompts[3], uid=3, max_new_tokens=4)
+        e = router._entries[3]
+        assert e.slot == other            # forced off-home: prefetched
+        assert router.get_fleet_report()["blockxfer"][
+            "fetched_blocks"] == 2
+        key = (other, e.digests[0])
+        assert router._prefetch_inflight[key] > router._step_idx
+        # a second same-head placement inside the TTL window is pure
+        # wire waste: skipped, counted, NO second fetch
+        assert router._maybe_prefetch(e, other, home) == 0
+        assert router.prefetch_dedup_skips == 1
+        assert router.get_fleet_report()["blockxfer"][
+            "fetched_blocks"] == 2
+        assert router.get_fleet_report()["router"][
+            "prefetch_dedup_skips"] == 1
+        # an EXPIRED entry no longer suppresses the re-issue (and the
+        # re-issue re-stamps a fresh TTL)
+        router._prefetch_inflight[key] = router._step_idx
+        router._maybe_prefetch(e, other, home)
+        assert router.prefetch_dedup_skips == 1
+        assert router._prefetch_inflight[key] > router._step_idx
+        # the destination's TRIE_DELTA proves the head landed: the
+        # in-flight entry clears before the TTL runs out
+        router.drain()
+        assert key not in router._prefetch_inflight
+        for uid in (1, 2, 3):
+            assert router.get_request(uid).state == \
+                RequestState.FINISHED
+
+
 class TestChaosWithTransfersArmed:
     """Satellite 3: the transport fault matrix OVER live peer
     transfers, plus seeded blockxfer corruption — bitwise streams, no

@@ -1,0 +1,351 @@
+"""Disaggregated prefill/decode serving: role-split placement, the
+pipelined full-prompt KV handoff (phase-A pushes behind remaining
+prefill compute, phase-B residue flush + SEQ_HANDOFF land), the
+bitwise degrade-to-prefill-side-decode fallback, exactly-once land
+semantics, and the disagg chaos cells (kill-prefill-mid-push,
+corrupt-handoff-frame).
+
+Tier-1 keeps the loopback e2e, the land-corrupt fallback drill, one
+kill-prefill chaos smoke and the engine-free units; the socket e2e,
+the mixed-fleet control cross-check and the full chaos matrix ride
+the slow tier (the 870s-wall diet rule)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import RequestState
+from deepspeed_tpu.inference.v2.serving.fleet.worker import WorkerCore
+from deepspeed_tpu.resilience.fault_injector import fault_injector
+from deepspeed_tpu.runtime.store import blake2b_hex, encode_kv
+
+from tests.unit.inference.serving.fleet.test_fleet_router import (
+    SYS, _assert_replicas_clean, _router, _single_frontend_refs)
+from tests.unit.inference.serving.fleet.test_fleet_transport import (
+    _FakeFrontend)
+
+ROLES = ["prefill", "prefill", "decode", "decode"]
+# engine geometry shared with the other fleet modules (test_fleet_
+# blockxfer.ENG); the socket leg pins the worker subprocesses to it
+ENG = dict(token_budget=32, max_ragged_sequence_count=4,
+           n_kv_blocks=48, kv_block_size=8, max_blocks_per_seq=8,
+           kv_dtype="float32")
+
+# 6 requests over the 3 shared heads, each with a unique 24-token
+# tail: 41 prompt tokens > the 32-token budget, so SplitFuse chunks
+# every prefill across >=2 steps — the window phase-A pushes pipeline
+# behind (a sub-budget prompt parks in its first step and everything
+# would flush exposed)
+N_REQ, NEW = 6, 5
+REQS = {900 + k: SYS[k % 3] + [(60 + 7 * k + j) % 250
+                               for j in range(24)]
+        for k in range(N_REQ)}
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault_injector.reset()
+    yield
+    fault_injector.reset()
+
+
+@pytest.fixture(scope="module")
+def disagg_refs(params_cfg):
+    """Undisturbed single-frontend control streams, once per module
+    (every drill below asserts bitwise against these)."""
+    return _single_frontend_refs(params_cfg, REQS, NEW)
+
+
+def _disagg_serving(roles=ROLES, fleet=None):
+    f = {"disagg": {"enabled": True, "roles": list(roles)}}
+    f.update(fleet or {})
+    # the DRAM tier is the landing pad for pushed handoff blocks
+    # (BLOCK_PUSH -> adopt/promote); without it every handoff would
+    # degrade (still bitwise, but nothing under test would run)
+    return {"prefix": {"enabled": True,
+                       "tiers": {"enabled": True,
+                                 "dram_max_mb": 64.0}},
+            "fleet": f}
+
+
+def _serve_disagg(router, max_steps=500):
+    """Staggered shared-prefix traffic; returns the handle map."""
+    from deepspeed_tpu.resilience.errors import ServingOverloadError
+    handles = {}
+
+    def poll(r, step):
+        k = len(handles)
+        if step % 2 == 0 and k < N_REQ:
+            uid = 900 + k
+            try:
+                handles[uid] = r.submit(REQS[uid], uid=uid,
+                                        max_new_tokens=NEW)
+            except ServingOverloadError:
+                pass        # mid-recovery refusal; retry next poll
+        return len(handles) < N_REQ
+
+    router.serve(poll=poll, max_steps=max_steps)
+    return handles
+
+
+def _assert_bitwise(handles, refs):
+    assert len(handles) == N_REQ
+    for uid, r in handles.items():
+        assert r.state == RequestState.FINISHED, (uid, r.state,
+                                                  r.shed_reason)
+        assert r.tokens == refs[uid], uid
+
+
+class TestDisaggE2E:
+
+    def test_disagg_e2e_bitwise_with_pipelined_handoff(
+            self, params_cfg, disagg_refs):
+        """The ISSUE acceptance e2e (loopback leg): 2 prefill + 2
+        decode replicas, every stream bitwise identical to the
+        undisturbed control, every handoff landed (no degrades), the
+        push pipeline genuinely overlapped prefill compute, <= 1
+        compile and 0 steady blocking syncs per replica."""
+        router = _router(params_cfg, n=4, serving=_disagg_serving())
+        handles = _serve_disagg(router)
+        _assert_bitwise(handles, disagg_refs)
+        rep = router.get_fleet_report()
+        ho = rep["handoff"]
+        assert ho["enabled"] == 1 and ho["roles"] == ROLES
+        assert ho["landed"] == N_REQ
+        assert ho["fallbacks"] == 0 and ho["fallback_reasons"] == {}
+        assert ho["mixed_placements"] == 0
+        # phase A ran (pushes pipelined behind remaining prefill
+        # chunks) AND phase B ran (the residue flush + land)
+        assert ho["pushes"] >= N_REQ
+        assert ho["pushed_blocks"] >= 4 * N_REQ
+        assert ho["push_bytes"] > 0 and ho["push_stalls"] == 0
+        assert ho["handoff_overlapped_ms"] > 0.0
+        assert ho["handoff_exposed_ms"] > 0.0
+        # every request ended its life on a DECODE replica
+        for uid in handles:
+            assert router._entries[uid].slot in (2, 3), uid
+        assert rep["router"]["replay_mismatches"] == 0
+        # the role + prefill-backlog scoring signals ride the wire
+        # (SNAPSHOT schema, satellite): the router's replica view
+        # reports them for every slot
+        for slot, snap in rep["replicas"].items():
+            assert snap["role"] == ROLES[int(slot)], slot
+            assert "prefill_backlog" in snap and "parked" in snap
+        # the PR-9 contract holds through the handoff: one compile
+        # per executable, zero steady blocking syncs — the landed
+        # sequence's first decode step is a plain decode row, never a
+        # new signature
+        for slot in router.pooled_replicas:
+            frep = router._replicas[slot].frontend.get_serving_report()
+            assert frep["recompiles"] <= 1, slot
+            assert frep["steady_blocking_syncs"] == 0, slot
+        _assert_replicas_clean(router)
+
+    def test_handoff_land_corrupt_degrades_bitwise(
+            self, params_cfg, disagg_refs):
+        """The handoff-failure drill: a corrupted SEQ_HANDOFF tail is
+        refused by the decode worker's checksum (typed ERR), the
+        router degrades that request to prefill-side decode via the
+        resume op — and the stream is STILL bitwise identical (the
+        fallback is a routing change, never a numerics change)."""
+        router = _router(params_cfg, n=4, serving=_disagg_serving())
+        fault_injector.configure("handoff.land:corrupt")
+        try:
+            handles = _serve_disagg(router)
+        finally:
+            fault_injector.reset()
+        _assert_bitwise(handles, disagg_refs)
+        ho = router.get_fleet_report()["handoff"]
+        assert ho["fallbacks"] == 1
+        assert ho["fallback_reasons"] == {"land_failed": 1}
+        assert ho["resumes"] == 1
+        assert ho["landed"] == N_REQ - 1
+        _assert_replicas_clean(router)
+
+    def test_bad_role_rejected(self, params_cfg):
+        with pytest.raises(ValueError, match="role"):
+            _router(params_cfg, n=2,
+                    serving={"fleet": {"disagg": {
+                        "enabled": True,
+                        "roles": ["prefill", "router"]}}})
+
+    @pytest.mark.slow
+    def test_disagg_socket_e2e(self, params_cfg, disagg_refs):
+        """The socket leg: one OS process per replica, the role
+        assignments and the whole handoff pipeline crossing a real
+        wire — still bitwise, still landed."""
+        router = _router(
+            params_cfg, n=4,
+            serving=_disagg_serving(fleet={
+                "transport": {"channel": "socket",
+                              "worker_args": {"engine": dict(ENG)}}}))
+        try:
+            handles = _serve_disagg(router)
+            _assert_bitwise(handles, disagg_refs)
+            rep = router.get_fleet_report()
+            ho = rep["handoff"]
+            assert ho["landed"] == N_REQ and ho["fallbacks"] == 0
+            assert ho["handoff_overlapped_ms"] > 0.0
+            assert rep["transport"]["channel"] == "socket"
+            for slot, snap in rep["replicas"].items():
+                assert snap["role"] == ROLES[int(slot)], slot
+                assert snap["recompiles"] <= 1, slot
+        finally:
+            for replica in router._replicas:
+                try:
+                    replica.detach()
+                except Exception:
+                    pass
+
+    @pytest.mark.slow
+    def test_disagg_matches_mixed_fleet_control(self, params_cfg):
+        """The mixed-fleet cross-check: the SAME 4-replica fleet with
+        disagg off produces byte-identical streams (roles are pure
+        placement; fold_in(uid, pos) sampling keys never move)."""
+        def run(serving):
+            router = _router(params_cfg, n=4, serving=serving)
+            handles = _serve_disagg(router)
+            return {u: list(r.tokens) for u, r in handles.items()}
+
+        mixed = run({"prefix": _disagg_serving()["prefix"]})
+        disagg = run(_disagg_serving())
+        assert disagg == mixed
+
+
+# -- chaos cells ---------------------------------------------------------
+
+def run_disagg_chaos_drill(params_cfg, refs, cell, seed=0):
+    """One disagg chaos drill; cells:
+
+    * ``kill_prefill_mid_push`` — a prefill replica dies while its
+      handoff segments are in flight; the evacuation resets the plan,
+      the requeue re-places through the disagg path, the respawn
+      re-learns the slot's role over HELLO;
+    * ``corrupt_push_frame`` — a pushed segment is poisoned after its
+      checksum is stamped; the receiver refuses it, the phase-B flush
+      re-pushes and the handoff still lands;
+    * ``corrupt_both_frames`` — push + land corruption in one trace:
+      the push stalls-and-recovers, the land degrades typed.
+
+    Every cell asserts bitwise streams and block conservation."""
+    rng = np.random.default_rng(seed)
+    router = _router(params_cfg, n=4,
+                     serving=_disagg_serving(fleet={
+                         "heartbeat_timeout_steps": 1,
+                         "progress_timeout_steps": 2}))
+    if cell == "kill_prefill_mid_push":
+        victim = int(rng.integers(0, 2))          # a PREFILL slot
+        fault_step = int(rng.integers(2, 5))
+        fault_injector.configure(
+            router.spec_for(victim, fault_step, "kill"))
+    elif cell == "corrupt_push_frame":
+        fault_injector.configure("handoff.push:corrupt@0")
+    elif cell == "corrupt_both_frames":
+        fault_injector.configure(
+            "handoff.push:corrupt@0,handoff.land:corrupt@1")
+    else:
+        raise ValueError(cell)
+    try:
+        handles = _serve_disagg(router)
+    finally:
+        fault_injector.reset()
+    _assert_bitwise(handles, refs)
+    rep = router.get_fleet_report()
+    ho = rep["handoff"]
+    assert rep["router"]["replay_mismatches"] == 0
+    if cell == "kill_prefill_mid_push":
+        rec = rep["recovery"]
+        assert rec["deaths"] == 1 and rec["respawns"] == 1
+        # the respawned slot re-learned its PREFILL role over HELLO
+        assert sorted(router.pooled_replicas) == [0, 1, 2, 3]
+    else:
+        assert rep["recovery"]["deaths"] == 0
+        assert ho["push_stalls"] >= 1      # the refused segment
+        assert ho["landed"] >= 1
+        if cell == "corrupt_both_frames":
+            assert ho["fallbacks"] == 1
+            assert ho["fallback_reasons"] == {"land_failed": 1}
+    _assert_replicas_clean(router)
+    return rep
+
+
+@pytest.mark.chaos
+@pytest.mark.fault
+@pytest.mark.parametrize("cell,seed", [
+    ("kill_prefill_mid_push", 0),
+    # tier-1 diet: ONE kill smoke in tier-1; the frame-corruption
+    # cells and the second kill draw ride the slow sweep
+    pytest.param("kill_prefill_mid_push", 3, marks=pytest.mark.slow),
+    pytest.param("corrupt_push_frame", 0, marks=pytest.mark.slow),
+    pytest.param("corrupt_both_frames", 0, marks=pytest.mark.slow),
+])
+def test_disagg_chaos_cells(cell, seed, params_cfg, disagg_refs):
+    rep = run_disagg_chaos_drill(params_cfg, disagg_refs, cell,
+                                 seed=seed)
+    assert rep["router"]["finished"] == N_REQ
+
+
+# -- engine-free units ---------------------------------------------------
+
+class TestSeqHandoffExactlyOnce:
+    """SEQ_HANDOFF rides the worker's effectful reply cache: a
+    duplicate land (the retried ask after a lost reply) must not
+    ingest twice, and a typed refusal must not be pinned."""
+
+    def _land_msg(self, msg_id=21, poison=False):
+        payload, meta = encode_kv(np.zeros((2, 4), np.float32), "none")
+        b2 = blake2b_hex(payload)
+        if poison:
+            payload = bytes([payload[0] ^ 0xFF]) + payload[1:]
+        return {"v": 1, "id": msg_id, "kind": "SEQ_HANDOFF",
+                "op": "land", "uid": 5, "prompt": [1, 2, 3],
+                "first_token": 7, "remaining": 3, "max_new_tokens": 4,
+                "tail": {"payload": payload.hex(), "b2": b2,
+                         "meta": meta}}
+
+    def test_duplicate_land_ingests_once(self):
+        fe = _FakeFrontend()
+        lands = []
+        fe.ingest_handoff = lambda **kw: lands.append(kw["uid"])
+        core = WorkerCore(0, fe)
+        msg = self._land_msg()
+        r1 = core.handle(dict(msg))
+        r2 = core.handle(dict(msg))           # the re-asked duplicate
+        assert r1["kind"] == "SEQ_HANDOFF_OK" and r1["landed"]
+        assert r2 == r1
+        assert lands == [5]                   # ONE effect
+        # the first-token seed is in the collect buffer at position 0
+        assert core._tokens[5] == [7]
+
+    def test_corrupt_land_refused_typed_and_not_cached(self):
+        fe = _FakeFrontend()
+        lands = []
+        fe.ingest_handoff = lambda **kw: lands.append(kw["uid"])
+        core = WorkerCore(0, fe)
+        r = core.handle(self._land_msg(msg_id=3, poison=True))
+        assert r["kind"] == "ERR" and r["etype"] == "value"
+        assert "checksum" in r["error"]
+        assert lands == [] and 5 not in core._tokens
+        # same id, intact frame: the ERR was not cached, the re-ask
+        # re-executes (exactly-once holds for SUCCESS, not failure)
+        r = core.handle(self._land_msg(msg_id=3))
+        assert r["kind"] == "SEQ_HANDOFF_OK"
+        assert lands == [5]
+
+    def test_ingest_failure_rolls_back_token_buffer(self):
+        fe = _FakeFrontend()
+
+        def boom(**kw):
+            raise ValueError("no KV headroom")
+        fe.ingest_handoff = boom
+        core = WorkerCore(0, fe)
+        r = core.handle(self._land_msg(msg_id=9))
+        assert r["kind"] == "ERR" and r["etype"] == "value"
+        # the pre-seeded collect buffer was rolled back: the slot
+        # holds no phantom first token for a sequence it never owned
+        assert 5 not in core._tokens
+
+    def test_unknown_op_is_a_value_error(self):
+        core = WorkerCore(0, _FakeFrontend())
+        r = core.handle({"v": 1, "id": 2, "kind": "SEQ_HANDOFF",
+                         "op": "teleport", "uid": 1})
+        assert r["kind"] == "ERR" and r["etype"] == "value"

@@ -420,9 +420,19 @@ class TestSocketServeLoop:
 
     def test_rpc_roundtrip_and_shutdown(self):
         core, ch, rpc, t = self._serve(_FakeFrontend())
-        hello = rpc.call(MSG_HELLO)
+        hello = rpc.call(MSG_HELLO, {"role": "decode"})
         assert hello["kind"] == "HELLO_OK"
         assert hello["kv_block_size"] == 8
+        # disagg schema: the role rides HELLO both ways (the router
+        # assigns it in the payload, the worker echoes what it
+        # learned) and the snapshot carries the prefill-pool scoring
+        # signals the router places from
+        assert hello["role"] == "decode"
+        snap = hello["snapshot"]
+        assert snap["role"] == "decode"
+        assert snap["prefill_backlog"] == 0 and snap["parked"] == 0
+        # a role-less HELLO (reconnect without reassignment) keeps it
+        assert rpc.call(MSG_HELLO)["role"] == "decode"
         assert rpc.call(MSG_HEARTBEAT)["kind"] == "HEARTBEAT_OK"
         assert rpc.call(MSG_SHUTDOWN)["kind"] == "BYE"
         t.join(timeout=10.0)

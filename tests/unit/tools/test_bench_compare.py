@@ -190,6 +190,59 @@ class TestCompare:
         _, reg, miss = compare(full, dict(full), 0.10, {}, set())
         assert reg == [] and miss == []
 
+    def test_disagg_handoff_keys_join_the_vanish_gate(self):
+        """Disagg PR: the 8_fleet lineage tracks the ``handoff``
+        block, its overlap split and the disagg row's ``itl_p99_ms``.
+        Arming is per key: pre-disagg artifacts (blockxfer era, no
+        handoff block) compare clean, plain post-disagg rows arm the
+        handoff block but NOT itl_p99_ms (only ``--disagg`` rows
+        publish it), and an armed lineage that loses either fails."""
+        from bench_compare import TRACKED_DECOMP_KEYS
+        for dk in ("handoff", "handoff.handoff_exposed_ms",
+                   "handoff.handoff_overlapped_ms", "itl_p99_ms"):
+            assert dk in TRACKED_DECOMP_KEYS["8_fleet"]
+
+        def row_with(decomp):
+            r = _row(1.0)
+            r["decomposition"] = decomp
+            return r
+
+        ho = {"enabled": 0, "landed": 0,
+              "handoff_exposed_ms": 0.0, "handoff_overlapped_ms": 0.0}
+        pre = {"8_fleet": row_with({"blockxfer": {}})}
+        plain = {"8_fleet": row_with({"blockxfer": {},
+                                      "handoff": dict(ho)})}
+        disagg = {"8_fleet": row_with({"blockxfer": {},
+                                       "handoff": dict(ho),
+                                       "itl_p99_ms": 4.2})}
+        # pre-disagg lineage arms nothing
+        _, reg, miss = compare(pre, plain, 0.10, {}, set())
+        assert reg == [] and miss == []
+        # plain rows arm the handoff block; a new row losing it fails
+        rows, reg, miss = compare(plain, pre, 0.10, {}, set())
+        assert reg == []
+        assert rows[0]["status"] == "MISSING-DECOMP"
+        assert sorted(miss) == [
+            "8_fleet.decomposition.handoff",
+            "8_fleet.decomposition.handoff.handoff_exposed_ms",
+            "8_fleet.decomposition.handoff.handoff_overlapped_ms"]
+        # keeping the overlap split inside the block is what's gated:
+        # a row that keeps "handoff" but drops the split still fails
+        split_lost = {"8_fleet": row_with({"blockxfer": {},
+                                           "handoff": {"landed": 3}})}
+        _, reg, miss = compare(plain, split_lost, 0.10, {}, set())
+        assert sorted(miss) == [
+            "8_fleet.decomposition.handoff.handoff_exposed_ms",
+            "8_fleet.decomposition.handoff.handoff_overlapped_ms"]
+        # a plain row never arms the disagg-only ITL key...
+        _, reg, miss = compare(plain, dict(plain), 0.10, {}, set())
+        assert reg == [] and miss == []
+        # ...but a --disagg lineage does
+        _, reg, miss = compare(disagg, plain, 0.10, {}, set())
+        assert miss == ["8_fleet.decomposition.itl_p99_ms"]
+        _, reg, miss = compare(disagg, dict(disagg), 0.10, {}, set())
+        assert reg == [] and miss == []
+
     def test_floor_trips_after_lineage_clears_it(self):
         """Config 4's 0.8 floor: dormant while the lineage is still
         below the bar (r04->r05 era compares clean), armed once the

@@ -104,7 +104,16 @@ TRACKED_DECOMP_KEYS = {"5": ("speculation",),
                                    "blockxfer",
                                    "blockxfer.fetch_hit_rate",
                                    "blockxfer.fetch_exposed_ms",
-                                   "blockxfer.fetch_overlapped_ms"),
+                                   "blockxfer.fetch_overlapped_ms",
+                                   # disagg handoff: the overlap split
+                                   # is the number the pipelined push
+                                   # exists for; itl_p99_ms only
+                                   # appears on --disagg rows, so it
+                                   # arms per-lineage like the rest
+                                   "handoff",
+                                   "handoff.handoff_exposed_ms",
+                                   "handoff.handoff_overlapped_ms",
+                                   "itl_p99_ms"),
                        "9_bigmodel": ("param_stream",
                                       "param_stream.param_drop_exposed_ms",
                                       "param_stream.param_drop_overlapped_ms")}
